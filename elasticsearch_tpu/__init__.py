@@ -1,0 +1,19 @@
+"""elasticsearch_tpu — a TPU-native distributed search & analytics engine.
+
+Built from scratch in JAX/XLA with the capabilities of Elasticsearch
+6.0.0-beta1 (reference: /root/reference), redesigned TPU-first:
+
+- segments are block-packed dense arrays in HBM (not byte-compressed
+  skip-list postings),
+- per-shard query execution is a single jit-compiled program (BM25
+  scatter-add scoring + ``lax.top_k``), not a virtual-call collector chain,
+- cross-shard scatter/gather rides mesh collectives (``shard_map`` +
+  ``psum``/``all_gather``) instead of an RPC data plane,
+- the control plane (cluster state, mapping, REST) is host-side Python.
+
+See SURVEY.md for the structural map of the reference this is built against.
+"""
+
+from elasticsearch_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,136 @@
+"""Hierarchical memory circuit breakers.
+
+Role model: ``HierarchyCircuitBreakerService`` + ``ChildMemoryCircuitBreaker``
+(core/.../indices/breaker/HierarchyCircuitBreakerService.java:43,
+common/breaker/ChildMemoryCircuitBreaker.java): child breakers (request,
+fielddata, in-flight, accounting) account bytes; the parent trips when the
+sum crosses its limit; trips surface as HTTP 429.
+
+TPU adaptation: the accounted resource is *host + HBM staging* memory for
+query-time data structures (agg buckets, fielddata ordinal maps, in-flight
+request payloads). HBM-resident segment data is accounted by the
+"accounting" breaker the way Lucene segment memory is in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from elasticsearch_tpu.common.errors import CircuitBreakingException
+
+
+class CircuitBreaker:
+    PARENT = "parent"
+    REQUEST = "request"
+    FIELDDATA = "fielddata"
+    IN_FLIGHT_REQUESTS = "in_flight_requests"
+    ACCOUNTING = "accounting"
+
+    def __init__(self, name: str, limit_bytes: int, overhead: float = 1.0,
+                 parent: Optional["CircuitBreaker"] = None):
+        self.name = name
+        self.limit_bytes = limit_bytes
+        self.overhead = overhead
+        self.parent = parent
+        self._used = 0
+        self._trip_count = 0
+        self._lock = threading.Lock()
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    @property
+    def trip_count(self) -> int:
+        return self._trip_count
+
+    def add_estimate_bytes_and_maybe_break(self, bytes_: int, label: str = "") -> int:
+        with self._lock:
+            new_used = self._used + bytes_
+            estimate = int(new_used * self.overhead)
+            if bytes_ > 0 and self.limit_bytes > 0 and estimate > self.limit_bytes:
+                self._trip_count += 1
+                raise CircuitBreakingException(
+                    f"[{self.name}] Data too large, data for [{label}] would be "
+                    f"[{estimate}/{estimate}b], which is larger than the limit of "
+                    f"[{self.limit_bytes}b]",
+                    bytes_wanted=estimate,
+                    byte_limit=self.limit_bytes,
+                )
+            self._used = new_used
+        if self.parent is not None:
+            try:
+                self.parent.check_parent(label)
+            except CircuitBreakingException:
+                with self._lock:
+                    self._used -= bytes_
+                raise
+        return self._used
+
+    def add_without_breaking(self, bytes_: int) -> int:
+        with self._lock:
+            self._used += bytes_
+            return self._used
+
+    def check_parent(self, label: str) -> None:
+        # parent looks at the sum of its children (tracked by the service)
+        pass
+
+    def stats(self) -> dict:
+        return {
+            "limit_size_in_bytes": self.limit_bytes,
+            "estimated_size_in_bytes": self._used,
+            "overhead": self.overhead,
+            "tripped": self._trip_count,
+        }
+
+
+class ParentBreaker(CircuitBreaker):
+    def __init__(self, limit_bytes: int, children: Dict[str, CircuitBreaker]):
+        super().__init__(CircuitBreaker.PARENT, limit_bytes)
+        self.children = children
+
+    def check_parent(self, label: str) -> None:
+        total = sum(c.used_bytes for c in self.children.values())
+        if self.limit_bytes > 0 and total > self.limit_bytes:
+            with self._lock:
+                self._trip_count += 1
+            raise CircuitBreakingException(
+                f"[parent] Data too large, data for [{label}] would be [{total}b], "
+                f"which is larger than the limit of [{self.limit_bytes}b]",
+                bytes_wanted=total,
+                byte_limit=self.limit_bytes,
+            )
+
+
+class CircuitBreakerService:
+    """Builds the breaker hierarchy from settings and hands out children."""
+
+    def __init__(self, total_limit: int = 0, request_limit: int = 0,
+                 fielddata_limit: int = 0):
+        children: Dict[str, CircuitBreaker] = {}
+        self.parent = ParentBreaker(total_limit, children)
+        for name, limit in (
+            (CircuitBreaker.REQUEST, request_limit),
+            (CircuitBreaker.FIELDDATA, fielddata_limit),
+            (CircuitBreaker.IN_FLIGHT_REQUESTS, total_limit),
+            (CircuitBreaker.ACCOUNTING, 0),
+        ):
+            children[name] = CircuitBreaker(name, limit, parent=self.parent)
+        self._children = children
+
+    def get_breaker(self, name: str) -> CircuitBreaker:
+        if name == CircuitBreaker.PARENT:
+            return self.parent
+        return self._children[name]
+
+    def stats(self) -> dict:
+        out = {name: b.stats() for name, b in self._children.items()}
+        out[CircuitBreaker.PARENT] = self.parent.stats()
+        return out
+
+
+def noop_breaker_service() -> CircuitBreakerService:
+    """Breakers with no limits — used by tests and single-user tools."""
+    return CircuitBreakerService(0, 0, 0)

@@ -1,0 +1,165 @@
+"""Exception taxonomy with REST status codes.
+
+Role model: the reference's ``ElasticsearchException`` hierarchy
+(core/src/main/java/org/elasticsearch/ElasticsearchException.java) where
+every failure maps to an HTTP status and serializes to a structured JSON
+body (``type``, ``reason``, nested ``caused_by``).
+"""
+
+from __future__ import annotations
+
+
+class ElasticsearchTpuException(Exception):
+    """Base for all engine errors; carries an HTTP status."""
+
+    status_code = 500
+
+    def __init__(self, reason: str, **metadata):
+        super().__init__(reason)
+        self.reason = reason
+        self.metadata = metadata
+
+    @property
+    def error_type(self) -> str:
+        # CamelCase -> snake_case, mirroring ES "type" strings like
+        # "index_not_found_exception".
+        name = type(self).__name__
+        out = []
+        for i, ch in enumerate(name):
+            if ch.isupper() and i > 0:
+                out.append("_")
+            out.append(ch.lower())
+        return "".join(out)
+
+    def to_dict(self) -> dict:
+        err = {"type": self.error_type, "reason": self.reason}
+        err.update(self.metadata)
+        cause = self.__cause__
+        if isinstance(cause, ElasticsearchTpuException):
+            err["caused_by"] = cause.to_dict()
+        elif cause is not None:
+            err["caused_by"] = {"type": type(cause).__name__, "reason": str(cause)}
+        return {"error": err, "status": self.status_code}
+
+
+class IndexNotFoundException(ElasticsearchTpuException):
+    status_code = 404
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+
+
+class IndexAlreadyExistsException(ElasticsearchTpuException):
+    status_code = 400
+
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists", index=index)
+
+
+class DocumentMissingException(ElasticsearchTpuException):
+    status_code = 404
+
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(f"[{index}]: document missing [{doc_id}]", index=index)
+
+
+class ShardNotFoundException(ElasticsearchTpuException):
+    status_code = 404
+
+
+class ParsingException(ElasticsearchTpuException):
+    """Malformed query DSL / request body (ES: ParsingException, 400)."""
+
+    status_code = 400
+
+
+class QueryShardException(ElasticsearchTpuException):
+    """Query cannot execute against this shard's mapping (ES: 400)."""
+
+    status_code = 400
+
+
+class MapperParsingException(ElasticsearchTpuException):
+    status_code = 400
+
+
+class IllegalArgumentException(ElasticsearchTpuException):
+    status_code = 400
+
+
+class ActionRequestValidationException(ElasticsearchTpuException):
+    status_code = 400
+
+
+class ResourceNotFoundException(ElasticsearchTpuException):
+    status_code = 404
+
+
+class ResourceAlreadyExistsException(ElasticsearchTpuException):
+    status_code = 400
+
+
+class VersionConflictEngineException(ElasticsearchTpuException):
+    """Optimistic concurrency failure (ES: 409)."""
+
+    status_code = 409
+
+    def __init__(self, doc_id: str, current_version: int, expected: int):
+        super().__init__(
+            f"[{doc_id}]: version conflict, current version [{current_version}] "
+            f"is different than the one provided [{expected}]"
+        )
+
+
+class CircuitBreakingException(ElasticsearchTpuException):
+    """Memory circuit breaker tripped (ES: 429)."""
+
+    status_code = 429
+
+    def __init__(self, reason: str, bytes_wanted: int = 0, byte_limit: int = 0):
+        super().__init__(reason, bytes_wanted=bytes_wanted, bytes_limit=byte_limit)
+
+
+class EsRejectedExecutionException(ElasticsearchTpuException):
+    """Thread-pool queue full — backpressure signal (ES: 429)."""
+
+    status_code = 429
+
+
+class TaskCancelledException(ElasticsearchTpuException):
+    status_code = 400
+
+
+class SearchPhaseExecutionException(ElasticsearchTpuException):
+    status_code = 500
+
+    def __init__(self, phase: str, reason: str, shard_failures=()):
+        super().__init__(reason, phase=phase)
+        self.shard_failures = list(shard_failures)
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["error"]["failed_shards"] = [
+            {"shard": f.get("shard"), "index": f.get("index"), "reason": f.get("reason")}
+            for f in self.shard_failures
+        ]
+        return d
+
+
+class NodeNotConnectedException(ElasticsearchTpuException):
+    status_code = 500
+
+
+class MasterNotDiscoveredException(ElasticsearchTpuException):
+    status_code = 503
+
+
+class ClusterBlockException(ElasticsearchTpuException):
+    status_code = 403
+
+
+class InvalidIndexNameException(ElasticsearchTpuException):
+    status_code = 400
+
+    def __init__(self, index: str, reason: str):
+        super().__init__(f"Invalid index name [{index}], {reason}", index=index)

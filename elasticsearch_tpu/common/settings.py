@@ -1,0 +1,462 @@
+"""Typed, scoped, dynamically-updatable settings.
+
+Role model: ``Setting``/``Settings``/``ClusterSettings``
+(core/src/main/java/org/elasticsearch/common/settings/Setting.java,
+ClusterSettings.java) — every tunable is a typed ``Setting`` object with a
+scope (node or index), a default, optional dynamic updatability, and
+registered update listeners. ``Settings`` itself is an immutable string map;
+typed access always goes through a ``Setting``.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.units import parse_byte_size, parse_time_value
+
+
+class Settings:
+    """Immutable flat key->value map with typed getters.
+
+    Keys are dotted paths ("index.number_of_shards"). Values are stored as
+    given (str/int/float/bool/list); typed getters coerce.
+    """
+
+    EMPTY: "Settings"
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self._data: Dict[str, Any] = dict(data or {})
+
+    @staticmethod
+    def of(**kwargs) -> "Settings":
+        return Settings({k.replace("__", "."): v for k, v in kwargs.items()})
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "Settings":
+        """Flatten a possibly-nested dict into dotted keys."""
+        flat: Dict[str, Any] = {}
+
+        def walk(prefix: str, obj):
+            for k, v in obj.items():
+                if isinstance(v, dict):
+                    walk(prefix + k + ".", v)
+                else:
+                    flat[prefix + k] = v
+
+        walk("", d or {})
+        return Settings(flat)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._data)
+
+    def as_nested_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key, value in sorted(self._data.items()):
+            node = out
+            parts = key.split(".")
+            for p in parts[:-1]:
+                nxt = node.get(p)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    node[p] = nxt
+                node = nxt
+            node[parts[-1]] = value
+        return out
+
+    def keys(self) -> Iterable[str]:
+        return self._data.keys()
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Settings) and self._data == other._data
+
+    def __hash__(self):
+        return hash(tuple(sorted((k, str(v)) for k, v in self._data.items())))
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+    def get_str(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        v = self._data.get(key)
+        return default if v is None else str(v)
+
+    def get_int(self, key: str, default: Optional[int] = None) -> Optional[int]:
+        v = self._data.get(key)
+        if v is None:
+            return default
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            raise IllegalArgumentException(
+                f"Failed to parse value [{v}] for setting [{key}]"
+            ) from None
+
+    def get_float(self, key: str, default: Optional[float] = None) -> Optional[float]:
+        v = self._data.get(key)
+        if v is None:
+            return default
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            raise IllegalArgumentException(
+                f"Failed to parse value [{v}] for setting [{key}]"
+            ) from None
+
+    def get_bool(self, key: str, default: Optional[bool] = None) -> Optional[bool]:
+        v = self._data.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        s = str(v).lower()
+        if s == "true":
+            return True
+        if s == "false":
+            return False
+        raise IllegalArgumentException(
+            f"Failed to parse value [{v}] as only [true] or [false] are allowed for "
+            f"setting [{key}]"
+        )
+
+    def get_list(self, key: str, default: Optional[list] = None) -> Optional[list]:
+        v = self._data.get(key)
+        if v is None:
+            return default
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        return [p.strip() for p in str(v).split(",") if p.strip()]
+
+    def get_time(self, key: str, default: Optional[float] = None) -> Optional[float]:
+        v = self._data.get(key)
+        return default if v is None else parse_time_value(v, key)
+
+    def get_bytes(self, key: str, default: Optional[int] = None) -> Optional[int]:
+        v = self._data.get(key)
+        return default if v is None else parse_byte_size(v, key)
+
+    def filtered_by_prefix(self, prefix: str) -> "Settings":
+        return Settings({k: v for k, v in self._data.items() if k.startswith(prefix)})
+
+    def merged_with(self, other: "Settings") -> "Settings":
+        d = dict(self._data)
+        for k, v in other._data.items():
+            if v is None:
+                d.pop(k, None)
+            else:
+                d[k] = v
+        return Settings(d)
+
+
+Settings.EMPTY = Settings()
+
+
+class Scope:
+    NODE = "node"
+    INDEX = "index"
+
+
+class Setting:
+    """A typed setting definition.
+
+    parser: raw value -> typed value (raises IllegalArgumentException on bad
+    input). validator: typed value -> None or raises.
+    """
+
+    def __init__(
+        self,
+        key: str,
+        default: Any,
+        parser: Callable[[Any], Any],
+        scope: str = Scope.NODE,
+        dynamic: bool = False,
+        validator: Optional[Callable[[Any], None]] = None,
+        deprecated: bool = False,
+    ):
+        self.key = key
+        self.default = default
+        self.parser = parser
+        self.scope = scope
+        self.dynamic = dynamic
+        self.validator = validator
+        self.deprecated = deprecated
+
+    def get(self, settings: Settings) -> Any:
+        raw = settings.get(self.key)
+        if raw is None:
+            value = self.default(settings) if callable(self.default) else self.default
+        else:
+            try:
+                value = self.parser(raw)
+            except IllegalArgumentException:
+                raise
+            except (TypeError, ValueError) as e:
+                raise IllegalArgumentException(
+                    f"Failed to parse value [{raw}] for setting [{self.key}]"
+                ) from e
+        if self.validator is not None and value is not None:
+            self.validator(value)
+        return value
+
+    def exists(self, settings: Settings) -> bool:
+        return self.key in settings
+
+    # --- typed constructors, mirroring Setting.intSetting/boolSetting/... ---
+
+    @staticmethod
+    def int_setting(key, default, min_value=None, max_value=None, **kw) -> "Setting":
+        def validate(v):
+            if min_value is not None and v < min_value:
+                raise IllegalArgumentException(
+                    f"Failed to parse value [{v}] for setting [{key}] must be >= {min_value}"
+                )
+            if max_value is not None and v > max_value:
+                raise IllegalArgumentException(
+                    f"Failed to parse value [{v}] for setting [{key}] must be <= {max_value}"
+                )
+
+        return Setting(key, default, int, validator=validate, **kw)
+
+    @staticmethod
+    def bool_setting(key, default, **kw) -> "Setting":
+        def parse(v):
+            if isinstance(v, bool):
+                return v
+            s = str(v).lower()
+            if s in ("true", "false"):
+                return s == "true"
+            raise IllegalArgumentException(
+                f"Failed to parse value [{v}] as only [true] or [false] are allowed for "
+                f"setting [{key}]"
+            )
+
+        return Setting(key, default, parse, **kw)
+
+    @staticmethod
+    def float_setting(key, default, min_value=None, **kw) -> "Setting":
+        def validate(v):
+            if min_value is not None and v < min_value:
+                raise IllegalArgumentException(
+                    f"Failed to parse value [{v}] for setting [{key}] must be >= {min_value}"
+                )
+
+        return Setting(key, default, float, validator=validate, **kw)
+
+    @staticmethod
+    def str_setting(key, default, choices=None, **kw) -> "Setting":
+        def validate(v):
+            if choices is not None and v not in choices:
+                raise IllegalArgumentException(
+                    f"unknown value [{v}] for setting [{key}], allowed: {sorted(choices)}"
+                )
+
+        return Setting(key, default, str, validator=validate, **kw)
+
+    @staticmethod
+    def time_setting(key, default, **kw) -> "Setting":
+        return Setting(key, default, lambda v: parse_time_value(v, key), **kw)
+
+    @staticmethod
+    def bytes_setting(key, default, **kw) -> "Setting":
+        return Setting(key, default, lambda v: parse_byte_size(v, key), **kw)
+
+    @staticmethod
+    def list_setting(key, default, **kw) -> "Setting":
+        def parse(v):
+            if isinstance(v, (list, tuple)):
+                return list(v)
+            return [p.strip() for p in str(v).split(",") if p.strip()]
+
+        return Setting(key, default, parse, **kw)
+
+
+class AbstractScopedSettings:
+    """Registry of known settings for one scope + dynamic update dispatch.
+
+    Role model: ``AbstractScopedSettings`` / ``ClusterSettings``
+    (common/settings/ClusterSettings.java:416 is the master list).
+    """
+
+    def __init__(self, scope: str, registered: Iterable[Setting]):
+        self.scope = scope
+        self._settings: Dict[str, Setting] = {}
+        self._listeners: list = []  # (setting, callback)
+        for s in registered:
+            self.register(s)
+
+    def register(self, setting: Setting) -> None:
+        if setting.scope != self.scope:
+            raise IllegalArgumentException(
+                f"setting [{setting.key}] has scope [{setting.scope}], expected "
+                f"[{self.scope}]"
+            )
+        if setting.key in self._settings:
+            raise IllegalArgumentException(f"setting [{setting.key}] already registered")
+        self._settings[setting.key] = setting
+
+    def get_setting(self, key: str) -> Optional[Setting]:
+        return self._settings.get(key)
+
+    def is_registered(self, key: str) -> bool:
+        return key in self._settings or any(
+            fnmatch.fnmatch(key, pat) for pat in self._settings if "*" in pat
+        )
+
+    def is_dynamic(self, key: str) -> bool:
+        s = self._settings.get(key)
+        return s is not None and s.dynamic
+
+    def validate(self, settings: Settings, allow_unknown: bool = False) -> None:
+        for key in settings.keys():
+            if not self.is_registered(key):
+                if not allow_unknown:
+                    raise IllegalArgumentException(f"unknown setting [{key}]")
+                continue
+            s = self._settings.get(key)
+            if s is not None:
+                s.get(settings)  # parse+validate
+
+    def validate_dynamic_update(self, settings: Settings) -> None:
+        for key in settings.keys():
+            s = self._settings.get(key)
+            if s is None:
+                raise IllegalArgumentException(f"unknown setting [{key}]")
+            if not s.dynamic:
+                raise IllegalArgumentException(
+                    f"final or non-dynamic setting [{key}] cannot be updated"
+                )
+            s.get(settings)
+
+    def add_settings_update_consumer(self, setting: Setting, consumer) -> None:
+        if setting.key not in self._settings:
+            raise IllegalArgumentException(f"setting [{setting.key}] not registered")
+        self._listeners.append((setting, consumer))
+
+    def apply_settings(self, old: Settings, new: Settings) -> None:
+        """Fire update consumers for settings whose value changed."""
+        for setting, consumer in self._listeners:
+            before, after = setting.get(old), setting.get(new)
+            if before != after:
+                consumer(after)
+
+
+# ---------------------------------------------------------------------------
+# The registered node + index settings (growing list; ES has ~400).
+# ---------------------------------------------------------------------------
+
+CLUSTER_NAME = Setting.str_setting("cluster.name", "elasticsearch-tpu")
+NODE_NAME = Setting.str_setting("node.name", "node-0")
+NODE_DATA = Setting.bool_setting("node.data", True)
+NODE_MASTER = Setting.bool_setting("node.master", True)
+NODE_INGEST = Setting.bool_setting("node.ingest", True)
+PATH_DATA = Setting.str_setting("path.data", "data")
+PATH_REPO = Setting.list_setting("path.repo", [])
+HTTP_PORT = Setting.int_setting("http.port", 9200, min_value=0, max_value=65535)
+HTTP_HOST = Setting.str_setting("http.host", "127.0.0.1")
+ACTION_AUTO_CREATE_INDEX = Setting.bool_setting(
+    "action.auto_create_index", True, dynamic=True
+)
+ACTION_DESTRUCTIVE_REQUIRES_NAME = Setting.bool_setting(
+    "action.destructive_requires_name", False, dynamic=True
+)
+SEARCH_DEFAULT_SIZE = Setting.int_setting("search.default_size", 10, min_value=0)
+SEARCH_MAX_BUCKETS = Setting.int_setting(
+    "search.max_buckets", 65536, min_value=1, dynamic=True
+)
+SEARCH_KEEPALIVE = Setting.time_setting(
+    "search.default_keep_alive", "5m", dynamic=True
+)
+BREAKER_TOTAL_LIMIT = Setting.str_setting(
+    "indices.breaker.total.limit", "70%", dynamic=True
+)
+BREAKER_REQUEST_LIMIT = Setting.str_setting(
+    "indices.breaker.request.limit", "60%", dynamic=True
+)
+BREAKER_FIELDDATA_LIMIT = Setting.str_setting(
+    "indices.breaker.fielddata.limit", "60%", dynamic=True
+)
+
+NODE_SETTINGS = [
+    CLUSTER_NAME,
+    NODE_NAME,
+    NODE_DATA,
+    NODE_MASTER,
+    NODE_INGEST,
+    PATH_DATA,
+    PATH_REPO,
+    HTTP_PORT,
+    HTTP_HOST,
+    ACTION_AUTO_CREATE_INDEX,
+    ACTION_DESTRUCTIVE_REQUIRES_NAME,
+    SEARCH_DEFAULT_SIZE,
+    SEARCH_MAX_BUCKETS,
+    SEARCH_KEEPALIVE,
+    BREAKER_TOTAL_LIMIT,
+    BREAKER_REQUEST_LIMIT,
+    BREAKER_FIELDDATA_LIMIT,
+]
+
+# --- index-scoped ---
+
+INDEX_NUMBER_OF_SHARDS = Setting.int_setting(
+    "index.number_of_shards", 1, min_value=1, max_value=1024, scope=Scope.INDEX
+)
+INDEX_NUMBER_OF_REPLICAS = Setting.int_setting(
+    "index.number_of_replicas", 1, min_value=0, scope=Scope.INDEX, dynamic=True
+)
+INDEX_REFRESH_INTERVAL = Setting.time_setting(
+    "index.refresh_interval", "1s", scope=Scope.INDEX, dynamic=True
+)
+INDEX_MAX_RESULT_WINDOW = Setting.int_setting(
+    "index.max_result_window", 10000, min_value=1, scope=Scope.INDEX, dynamic=True
+)
+INDEX_BLOCK_SIZE = Setting.int_setting(
+    # TPU-specific: posting block width (lane dimension); must stay a
+    # multiple of 128 so blocks map onto VPU lanes.
+    "index.tpu.posting_block_size",
+    128,
+    min_value=128,
+    scope=Scope.INDEX,
+)
+INDEX_TRANSLOG_DURABILITY = Setting.str_setting(
+    "index.translog.durability",
+    "request",
+    choices={"request", "async"},
+    scope=Scope.INDEX,
+    dynamic=True,
+)
+INDEX_TRANSLOG_FLUSH_THRESHOLD = Setting.bytes_setting(
+    "index.translog.flush_threshold_size", "512mb", scope=Scope.INDEX, dynamic=True
+)
+INDEX_QUERY_DEFAULT_FIELD = Setting.str_setting(
+    "index.query.default_field", "_all", scope=Scope.INDEX, dynamic=True
+)
+INDEX_MAPPING_TOTAL_FIELDS_LIMIT = Setting.int_setting(
+    "index.mapping.total_fields.limit", 1000, min_value=1, scope=Scope.INDEX, dynamic=True
+)
+
+INDEX_SETTINGS = [
+    INDEX_NUMBER_OF_SHARDS,
+    INDEX_NUMBER_OF_REPLICAS,
+    INDEX_REFRESH_INTERVAL,
+    INDEX_MAX_RESULT_WINDOW,
+    INDEX_BLOCK_SIZE,
+    INDEX_TRANSLOG_DURABILITY,
+    INDEX_TRANSLOG_FLUSH_THRESHOLD,
+    INDEX_QUERY_DEFAULT_FIELD,
+    INDEX_MAPPING_TOTAL_FIELDS_LIMIT,
+]
+
+
+def cluster_settings() -> AbstractScopedSettings:
+    return AbstractScopedSettings(Scope.NODE, NODE_SETTINGS)
+
+
+def index_scoped_settings() -> AbstractScopedSettings:
+    return AbstractScopedSettings(Scope.INDEX, INDEX_SETTINGS)

@@ -1,0 +1,74 @@
+"""MurmurHash3 x86 32-bit — the document routing hash.
+
+Role model: ``Murmur3HashFunction``
+(core/src/main/java/org/elasticsearch/cluster/routing/Murmur3HashFunction.java)
+which hashes the routing key (UTF-16 code units in Java; we hash UTF-8
+bytes, which only changes *which* shard a given id lands on, not the
+uniformity) and ``OperationRouting.generateShardId``
+(cluster/routing/OperationRouting.java:232): shard = floorMod(hash, num_shards).
+"""
+
+from __future__ import annotations
+
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _fmix32(h: int) -> int:
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3_x86_32, returns signed 32-bit int (Java parity)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h1 = seed & _M32
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        k1 = int.from_bytes(data[i * 4 : i * 4 + 4], "little")
+        k1 = (k1 * c1) & _M32
+        k1 = _rotl32(k1, 15)
+        k1 = (k1 * c2) & _M32
+        h1 ^= k1
+        h1 = _rotl32(h1, 13)
+        h1 = (h1 * 5 + 0xE6546B64) & _M32
+    tail = data[nblocks * 4 :]
+    k1 = 0
+    if len(tail) >= 3:
+        k1 ^= tail[2] << 16
+    if len(tail) >= 2:
+        k1 ^= tail[1] << 8
+    if len(tail) >= 1:
+        k1 ^= tail[0]
+        k1 = (k1 * c1) & _M32
+        k1 = _rotl32(k1, 15)
+        k1 = (k1 * c2) & _M32
+        h1 ^= k1
+    h1 ^= len(data)
+    h1 = _fmix32(h1)
+    return h1 - (1 << 32) if h1 >= (1 << 31) else h1
+
+
+def hash_routing(routing: str) -> int:
+    return murmur3_32(routing.encode("utf-8"))
+
+
+def shard_id_for(routing: str, num_shards: int, partition_size: int = 1,
+                 partition_offset: int = 0) -> int:
+    """floorMod(murmur3(routing) [+ offset], num_shards).
+
+    ``partition_size`` mirrors ``index.routing_partition_size``
+    (OperationRouting.java:244): a custom-routed doc may land on any of
+    ``partition_size`` shards offset by a hash of its ``_id``.
+    """
+    h = hash_routing(routing)
+    if partition_size > 1:
+        h += partition_offset % partition_size
+    return h % num_shards  # Python % is floorMod

@@ -1,0 +1,15 @@
+"""Version constants.
+
+Mirrors the role of the reference's ``Version`` class
+(core/src/main/java/org/elasticsearch/Version.java) — a single place for
+the engine version and the wire/index compatibility floor.
+"""
+
+__version__ = "0.1.0"
+
+# Index format version written into segment metadata; bumped on
+# incompatible changes to the on-disk segment layout.
+INDEX_FORMAT_VERSION = 1
+
+# Lucene-equivalent: version of the block-packed posting layout.
+POSTING_FORMAT_VERSION = 1

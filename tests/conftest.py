@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's in-one-JVM multi-node testing strategy
+(test/framework/.../InternalTestCluster.java): instead of real TPU chips,
+tests run on the CPU backend with 8 virtual devices so mesh/sharding code
+paths execute deterministically (SURVEY.md §4.6.3).
+
+Must run before any jax import — pytest imports conftest first.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_data_dir(tmp_path):
+    return str(tmp_path / "data")

@@ -1,0 +1,149 @@
+"""Foundation tests: settings, units, errors, breakers, murmur3 routing."""
+
+import pytest
+
+from elasticsearch_tpu.common import settings as S
+from elasticsearch_tpu.common.breaker import CircuitBreakerService
+from elasticsearch_tpu.common.errors import (
+    CircuitBreakingException,
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    IndexNotFoundException,
+)
+from elasticsearch_tpu.common.settings import Setting, Settings
+from elasticsearch_tpu.common.units import (
+    format_time_value,
+    parse_byte_size,
+    parse_ratio_or_bytes,
+    parse_time_value,
+)
+from elasticsearch_tpu.utils.murmur3 import murmur3_32, shard_id_for
+
+
+class TestSettings:
+    def test_flatten_nested(self):
+        s = Settings.from_dict({"index": {"number_of_shards": 3, "refresh_interval": "5s"}})
+        assert s.get_int("index.number_of_shards") == 3
+        assert s.get_time("index.refresh_interval") == 5.0
+
+    def test_nested_roundtrip(self):
+        s = Settings({"a.b.c": 1, "a.b.d": 2, "a.e": "x"})
+        assert s.as_nested_dict() == {"a": {"b": {"c": 1, "d": 2}, "e": "x"}}
+
+    def test_typed_getters(self):
+        s = Settings({"i": "42", "f": "1.5", "b": "true", "l": "a, b,c"})
+        assert s.get_int("i") == 42
+        assert s.get_float("f") == 1.5
+        assert s.get_bool("b") is True
+        assert s.get_list("l") == ["a", "b", "c"]
+        assert s.get_int("missing", 7) == 7
+
+    def test_bad_bool_raises(self):
+        with pytest.raises(IllegalArgumentException):
+            Settings({"b": "yes"}).get_bool("b")
+
+    def test_merge_removes_none(self):
+        merged = Settings({"a": 1, "b": 2}).merged_with(Settings({"b": None, "c": 3}))
+        assert merged.as_dict() == {"a": 1, "c": 3}
+
+    def test_setting_default_and_validation(self):
+        shards = S.INDEX_NUMBER_OF_SHARDS
+        assert shards.get(Settings.EMPTY) == 1
+        assert shards.get(Settings({"index.number_of_shards": "4"})) == 4
+        with pytest.raises(IllegalArgumentException):
+            shards.get(Settings({"index.number_of_shards": 0}))
+
+    def test_scoped_registry_rejects_unknown_and_non_dynamic(self):
+        reg = S.index_scoped_settings()
+        with pytest.raises(IllegalArgumentException):
+            reg.validate(Settings({"index.bogus": 1}))
+        with pytest.raises(IllegalArgumentException):
+            reg.validate_dynamic_update(Settings({"index.number_of_shards": 2}))
+        reg.validate_dynamic_update(Settings({"index.number_of_replicas": 2}))
+
+    def test_update_consumer_fires_on_change(self):
+        reg = S.cluster_settings()
+        seen = []
+        reg.add_settings_update_consumer(S.SEARCH_MAX_BUCKETS, seen.append)
+        reg.apply_settings(Settings.EMPTY, Settings({"search.max_buckets": 100}))
+        reg.apply_settings(
+            Settings({"search.max_buckets": 100}), Settings({"search.max_buckets": 100})
+        )
+        assert seen == [100]
+
+
+class TestUnits:
+    def test_time_values(self):
+        assert parse_time_value("30s") == 30.0
+        assert parse_time_value("1m") == 60.0
+        assert parse_time_value("500ms") == 0.5
+        assert parse_time_value("2h") == 7200.0
+        assert parse_time_value("-1") == -1.0
+        with pytest.raises(IllegalArgumentException):
+            parse_time_value("10 parsecs")
+        with pytest.raises(IllegalArgumentException):
+            parse_time_value(10)  # bare number needs a unit
+
+    def test_format_time(self):
+        assert format_time_value(5.0) == "5s"
+        assert format_time_value(0.25) == "250ms"
+
+    def test_byte_sizes(self):
+        assert parse_byte_size("1kb") == 1024
+        assert parse_byte_size("2mb") == 2 * 1024**2
+        assert parse_byte_size("1.5gb") == int(1.5 * 1024**3)
+        assert parse_byte_size(123) == 123
+        assert parse_ratio_or_bytes("50%", 1000) == 500
+
+
+class TestErrors:
+    def test_error_type_snake_case(self):
+        assert IndexNotFoundException("idx").error_type == "index_not_found_exception"
+
+    def test_to_dict_with_cause(self):
+        try:
+            try:
+                raise ValueError("inner")
+            except ValueError as e:
+                raise IndexNotFoundException("idx") from e
+        except ElasticsearchTpuException as outer:
+            d = outer.to_dict()
+        assert d["status"] == 404
+        assert d["error"]["index"] == "idx"
+        assert d["error"]["caused_by"]["reason"] == "inner"
+
+
+class TestBreakers:
+    def test_child_trips_at_limit(self):
+        svc = CircuitBreakerService(total_limit=1000, request_limit=100)
+        b = svc.get_breaker("request")
+        b.add_estimate_bytes_and_maybe_break(90, "agg")
+        with pytest.raises(CircuitBreakingException) as ei:
+            b.add_estimate_bytes_and_maybe_break(20, "agg")
+        assert ei.value.status_code == 429
+        assert b.used_bytes == 90  # failed reservation rolled back
+
+    def test_parent_trips_on_child_sum(self):
+        svc = CircuitBreakerService(total_limit=100, request_limit=80, fielddata_limit=80)
+        svc.get_breaker("request").add_estimate_bytes_and_maybe_break(70, "r")
+        with pytest.raises(CircuitBreakingException):
+            svc.get_breaker("fielddata").add_estimate_bytes_and_maybe_break(50, "f")
+        assert svc.get_breaker("fielddata").used_bytes == 0
+
+
+class TestMurmur3:
+    def test_known_vectors(self):
+        # Public MurmurHash3_x86_32 test vectors (seed 0).
+        assert murmur3_32(b"") == 0
+        assert murmur3_32(b"hello") == 0x248BFA47
+        assert murmur3_32(b"aaaa") == 0x7EEF2A67  # 4-byte block path (regression pin)
+
+    def test_shard_distribution_uniform(self):
+        counts = [0] * 5
+        for i in range(10000):
+            counts[shard_id_for(f"doc-{i}", 5)] += 1
+        for c in counts:
+            assert 1600 < c < 2400
+
+    def test_stable(self):
+        assert shard_id_for("user-123", 8) == shard_id_for("user-123", 8)
