@@ -1,0 +1,448 @@
+"""Field types: JSON value -> indexable terms + columnar doc values.
+
+Role model: ``MappedFieldType`` and the concrete mappers
+(core/.../index/mapper/TextFieldMapper.java, KeywordFieldMapper.java,
+NumberFieldMapper.java, DateFieldMapper.java, BooleanFieldMapper.java,
+IpFieldMapper.java, ScaledFloatFieldMapper.java). Each type decides how a
+field value is (a) analyzed into inverted-index terms and (b) encoded into
+a columnar doc value for sorting/aggregations.
+
+TPU adaptation: doc values are *always* numeric float64/int64 columns
+(keywords become ordinals at segment seal), so every aggregation/sort is a
+dense vector op. Range queries on numerics run against the column, not a
+BKD tree.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import ipaddress
+import math
+from typing import Any, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    MapperParsingException,
+)
+
+NUMERIC_TYPES = {
+    "long", "integer", "short", "byte", "double", "float", "half_float",
+    "scaled_float",
+}
+
+_INT_RANGES = {
+    "long": (-(2**63), 2**63 - 1),
+    "integer": (-(2**31), 2**31 - 1),
+    "short": (-(2**15), 2**15 - 1),
+    "byte": (-(2**7), 2**7 - 1),
+}
+
+
+def parse_date(value: Any, formats: Optional[List[str]] = None) -> int:
+    """Parse a date value to epoch milliseconds (UTC).
+
+    Reference behavior: DateFieldMapper with default format
+    ``strict_date_optional_time||epoch_millis``.
+    """
+    if isinstance(value, bool):
+        raise MapperParsingException(f"failed to parse date field [{value}]")
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value).strip()
+    if formats:
+        for fmt in formats:
+            if fmt == "epoch_millis":
+                try:
+                    return int(s)
+                except ValueError:
+                    continue
+            if fmt == "epoch_second":
+                try:
+                    return int(s) * 1000
+                except ValueError:
+                    continue
+            try:
+                dt = _dt.datetime.strptime(s, _java_to_strptime(fmt))
+                return _to_millis(dt)
+            except ValueError:
+                continue
+        raise MapperParsingException(
+            f"failed to parse date field [{s}] with format [{'||'.join(formats)}]"
+        )
+    # default: ISO-8601 (strict_date_optional_time) or epoch_millis
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        iso = s.replace("Z", "+00:00")
+        if len(iso) == 10:  # yyyy-MM-dd
+            dt = _dt.datetime.fromisoformat(iso + "T00:00:00+00:00")
+        else:
+            dt = _dt.datetime.fromisoformat(iso)
+        return _to_millis(dt)
+    except ValueError:
+        raise MapperParsingException(f"failed to parse date field [{s}]") from None
+
+
+def _to_millis(dt: _dt.datetime) -> int:
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+_JAVA_FMT = {
+    "yyyy": "%Y", "MM": "%m", "dd": "%d", "HH": "%H", "mm": "%M", "ss": "%S",
+}
+
+
+def _java_to_strptime(fmt: str) -> str:
+    out = fmt
+    for j, p in _JAVA_FMT.items():
+        out = out.replace(j, p)
+    return out
+
+
+def format_epoch_millis(millis: int) -> str:
+    dt = _dt.datetime.fromtimestamp(millis / 1000.0, tz=_dt.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def parse_ip(value: Any) -> int:
+    """Encode an IP as an integer (IPv4-mapped into IPv6 space, like Lucene's
+    16-byte encoding; we keep a python int, stored as the doc value)."""
+    try:
+        addr = ipaddress.ip_address(str(value))
+    except ValueError:
+        raise MapperParsingException(f"'{value}' is not an IP string literal.") from None
+    if isinstance(addr, ipaddress.IPv4Address):
+        addr = ipaddress.IPv6Address(f"::ffff:{addr}")
+    return int(addr)
+
+
+def format_ip(value: int) -> str:
+    addr = ipaddress.IPv6Address(int(value))
+    v4 = addr.ipv4_mapped
+    return str(v4) if v4 is not None else str(addr)
+
+
+class FieldType:
+    """Base field type. Subclasses override value handling.
+
+    Attributes mirror the mapping parameters the reference accepts for the
+    type (index, doc_values, store, boost, analyzer, ...).
+    """
+
+    type_name = "object"
+    # does this type produce inverted-index terms?
+    indexable = True
+    # does this type produce a numeric doc-value column?
+    has_doc_values = True
+    # string-ordinal doc values (keyword-family) vs plain numeric
+    ordinal_doc_values = False
+
+    def __init__(self, name: str, params: Optional[dict] = None):
+        self.name = name
+        self.params = dict(params or {})
+        self.index = self.params.get("index", True)
+        self.doc_values = self.params.get("doc_values", self.has_doc_values)
+        self.boost = float(self.params.get("boost", 1.0))
+        self.null_value = self.params.get("null_value")
+
+    # --- index-time ---
+
+    def index_terms(self, value: Any, analyzers) -> List[str]:
+        """Terms for the inverted index (already analyzed)."""
+        raise NotImplementedError
+
+    def doc_value(self, value: Any):
+        """Columnar value: float for numerics/dates/bools, str for ordinals."""
+        raise NotImplementedError
+
+    # --- query-time ---
+
+    def term_for_query(self, value: Any, analyzers) -> str:
+        """Normalize a user-provided term the way index_terms would."""
+        return str(value)
+
+    def numeric_for_query(self, value: Any) -> float:
+        raise IllegalArgumentException(
+            f"Field [{self.name}] of type [{self.type_name}] does not support numeric queries"
+        )
+
+    def to_mapping(self) -> dict:
+        out = {"type": self.type_name}
+        out.update({k: v for k, v in self.params.items() if k != "type"})
+        return out
+
+
+class TextFieldType(FieldType):
+    type_name = "text"
+    has_doc_values = False  # like ES: text has no doc_values (fielddata opt-in)
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.analyzer = self.params.get("analyzer", "standard")
+        self.search_analyzer = self.params.get("search_analyzer", self.analyzer)
+        self.fielddata = bool(self.params.get("fielddata", False))
+
+    def index_terms(self, value, analyzers):
+        return analyzers.get(self.analyzer).analyze(str(value))
+
+    def doc_value(self, value):
+        return None
+
+    def term_for_query(self, value, analyzers):
+        toks = analyzers.get(self.search_analyzer).analyze(str(value))
+        return toks[0] if toks else ""
+
+    def query_terms(self, value, analyzers):
+        return analyzers.get(self.search_analyzer).analyze(str(value))
+
+
+class KeywordFieldType(FieldType):
+    type_name = "keyword"
+    ordinal_doc_values = True
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.ignore_above = int(self.params.get("ignore_above", 2**31 - 1))
+        self.normalizer = self.params.get("normalizer")
+
+    def _normalize(self, s: str) -> str:
+        if self.normalizer == "lowercase":
+            return s.lower()
+        return s
+
+    def index_terms(self, value, analyzers):
+        s = str(value)
+        if len(s) > self.ignore_above:
+            return []
+        return [self._normalize(s)]
+
+    def doc_value(self, value):
+        s = str(value)
+        if len(s) > self.ignore_above:
+            return None
+        return self._normalize(s)
+
+    def term_for_query(self, value, analyzers):
+        return self._normalize(str(value))
+
+
+class NumberFieldType(FieldType):
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.coerce = bool(self.params.get("coerce", True))
+
+    def _parse(self, value):
+        if isinstance(value, bool):
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}] of type [{self.type_name}]: "
+                f"booleans are not numbers"
+            )
+        try:
+            if isinstance(value, str) and not self.coerce:
+                raise ValueError(value)
+            f = float(value)
+        except (TypeError, ValueError):
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}] of type [{self.type_name}] "
+                f"value [{value}]"
+            ) from None
+        if math.isnan(f) or math.isinf(f):
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}]: non-finite value"
+            )
+        return f
+
+    def index_terms(self, value, analyzers):
+        # numeric "terms" are the doc values themselves; term queries on
+        # numerics run against the column (no BKD analog needed).
+        return []
+
+    def numeric_for_query(self, value):
+        return self._parse(value)
+
+
+class IntegerLikeFieldType(NumberFieldType):
+    def doc_value(self, value):
+        f = self._parse(value)
+        i = int(f)
+        if not self.coerce and f != i:
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}]: [{value}] has a decimal part"
+            )
+        lo, hi = _INT_RANGES[self.type_name]
+        if not (lo <= i <= hi):
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}]: value [{value}] is out of "
+                f"range for type [{self.type_name}]"
+            )
+        return float(i)
+
+
+class LongFieldType(IntegerLikeFieldType):
+    type_name = "long"
+
+
+class IntegerFieldType(IntegerLikeFieldType):
+    type_name = "integer"
+
+
+class ShortFieldType(IntegerLikeFieldType):
+    type_name = "short"
+
+
+class ByteFieldType(IntegerLikeFieldType):
+    type_name = "byte"
+
+
+class DoubleFieldType(NumberFieldType):
+    type_name = "double"
+
+    def doc_value(self, value):
+        return self._parse(value)
+
+
+class FloatFieldType(DoubleFieldType):
+    type_name = "float"
+
+
+class HalfFloatFieldType(DoubleFieldType):
+    type_name = "half_float"
+
+
+class ScaledFloatFieldType(NumberFieldType):
+    type_name = "scaled_float"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        if "scaling_factor" not in self.params:
+            raise MapperParsingException(
+                f"Field [{name}] misses required parameter [scaling_factor]"
+            )
+        self.scaling_factor = float(self.params["scaling_factor"])
+
+    def doc_value(self, value):
+        # stored scaled+rounded, like the reference (value*factor rounded to long)
+        return float(round(self._parse(value) * self.scaling_factor)) / self.scaling_factor
+
+    def numeric_for_query(self, value):
+        return self._parse(value)
+
+
+class DateFieldType(FieldType):
+    type_name = "date"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        fmt = self.params.get("format")
+        self.formats = fmt.split("||") if isinstance(fmt, str) else None
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return float(parse_date(value, self.formats))
+
+    def numeric_for_query(self, value):
+        return float(parse_date(value, self.formats))
+
+
+class BooleanFieldType(FieldType):
+    type_name = "boolean"
+
+    def _parse(self, value) -> bool:
+        if isinstance(value, bool):
+            return value
+        s = str(value)
+        if s == "true":
+            return True
+        if s == "false":
+            return False
+        raise MapperParsingException(
+            f"Failed to parse value [{value}] as only [true] or [false] are allowed."
+        )
+
+    def index_terms(self, value, analyzers):
+        return ["T" if self._parse(value) else "F"]
+
+    def doc_value(self, value):
+        return 1.0 if self._parse(value) else 0.0
+
+    def term_for_query(self, value, analyzers):
+        return "T" if self._parse(value) else "F"
+
+    def numeric_for_query(self, value):
+        return 1.0 if self._parse(value) else 0.0
+
+
+class IpFieldType(FieldType):
+    type_name = "ip"
+    ordinal_doc_values = True  # store dotted string as ordinal; range via int
+
+    def index_terms(self, value, analyzers):
+        return [format_ip(parse_ip(value))]
+
+    def doc_value(self, value):
+        return format_ip(parse_ip(value))
+
+    def term_for_query(self, value, analyzers):
+        return format_ip(parse_ip(value))
+
+
+class GeoPointFieldType(FieldType):
+    """geo_point: stored as two numeric columns (<name>.lat / <name>.lon)
+    managed by the segment writer; distance/bbox filters are vector math."""
+
+    type_name = "geo_point"
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return self.parse_point(value)
+
+    @staticmethod
+    def parse_point(value):
+        if isinstance(value, dict):
+            lat, lon = value.get("lat"), value.get("lon")
+        elif isinstance(value, (list, tuple)) and len(value) == 2:
+            lon, lat = value  # GeoJSON order [lon, lat]
+        elif isinstance(value, str):
+            parts = value.split(",")
+            if len(parts) != 2:
+                raise MapperParsingException(f"failed to parse geo_point [{value}]")
+            lat, lon = float(parts[0]), float(parts[1])
+        else:
+            raise MapperParsingException(f"failed to parse geo_point [{value}]")
+        lat, lon = float(lat), float(lon)
+        if not (-90.0 <= lat <= 90.0) or not (-180.0 <= lon <= 180.0):
+            raise MapperParsingException(
+                f"illegal latitude/longitude value [{lat}, {lon}]"
+            )
+        return (lat, lon)
+
+
+FIELD_TYPES = {
+    t.type_name: t
+    for t in [
+        TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
+        ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
+        HalfFloatFieldType, ScaledFloatFieldType, DateFieldType,
+        BooleanFieldType, IpFieldType, GeoPointFieldType,
+    ]
+}
+
+
+def create_field_type(name: str, params: dict) -> FieldType:
+    typ = params.get("type")
+    if typ is None and "properties" in params:
+        typ = "object"
+    cls = FIELD_TYPES.get(typ)
+    if cls is None:
+        raise MapperParsingException(
+            f"No handler for type [{typ}] declared on field [{name}]"
+        )
+    return cls(name, params)

@@ -1,0 +1,108 @@
+"""Analysis chain tests (ref: index/analysis + modules/analysis-common)."""
+
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import (
+    AnalysisRegistry,
+    html_strip_char_filter,
+    make_ngram_tokenizer,
+    make_shingle_filter,
+    porter_light_stem,
+)
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.settings import Settings
+
+
+class TestBuiltinAnalyzers:
+    def setup_method(self):
+        self.reg = AnalysisRegistry()
+
+    def test_standard(self):
+        assert self.reg.get("standard").analyze("The QUICK brown-fox, 42!") == [
+            "the", "quick", "brown", "fox", "42",
+        ]
+
+    def test_simple_drops_digits(self):
+        assert self.reg.get("simple").analyze("abc 123 Def") == ["abc", "def"]
+
+    def test_whitespace_preserves_case(self):
+        assert self.reg.get("whitespace").analyze("Foo Bar") == ["Foo", "Bar"]
+
+    def test_keyword_single_token(self):
+        assert self.reg.get("keyword").analyze("New York") == ["New York"]
+
+    def test_stop_analyzer(self):
+        assert self.reg.get("stop").analyze("the quick fox") == ["quick", "fox"]
+
+    def test_english_stems(self):
+        toks = self.reg.get("english").analyze("the running dogs jumped")
+        assert "the" not in toks
+        assert "runn" in toks or "run" in toks
+        assert "dog" in toks
+
+    def test_unknown_analyzer_raises(self):
+        with pytest.raises(IllegalArgumentException):
+            self.reg.get("nope")
+
+
+class TestComponents:
+    def test_ngram(self):
+        tok = make_ngram_tokenizer(2, 3)
+        texts = [t for t, _, _ in tok("abcd")]
+        assert "ab" in texts and "abc" in texts and "cd" in texts
+
+    def test_edge_ngram(self):
+        tok = make_ngram_tokenizer(1, 3, edge=True)
+        assert [t for t, _, _ in tok("abcd")] == ["a", "ab", "abc"]
+
+    def test_shingle(self):
+        f = make_shingle_filter(2, 2)
+        toks = f([("quick", 0, 5), ("brown", 6, 11), ("fox", 12, 15)])
+        texts = [t for t, _, _ in toks]
+        assert "quick brown" in texts and "brown fox" in texts and "quick" in texts
+
+    def test_html_strip(self):
+        assert html_strip_char_filter("<p>hello <b>world</b></p>").split() == [
+            "hello", "world",
+        ]
+
+    def test_stemmer(self):
+        assert porter_light_stem("dogs") == "dog"
+        assert porter_light_stem("cities") == "citi"
+
+
+class TestCustomAnalyzers:
+    def test_custom_from_settings(self):
+        settings = Settings.from_dict({
+            "index": {"analysis": {
+                "char_filter": {"my_map": {"type": "mapping", "mappings": ["& => and"]}},
+                "filter": {"my_stop": {"type": "stop", "stopwords": ["a", "the"]}},
+                "analyzer": {"my_an": {
+                    "type": "custom",
+                    "tokenizer": "standard",
+                    "char_filter": ["my_map"],
+                    "filter": ["lowercase", "my_stop"],
+                }},
+            }}
+        })
+        reg = AnalysisRegistry(settings)
+        assert reg.get("my_an").analyze("The Cat & Dog") == ["cat", "and", "dog"]
+
+    def test_custom_ngram_tokenizer(self):
+        settings = Settings.from_dict({
+            "index": {"analysis": {
+                "tokenizer": {"grams": {"type": "edge_ngram", "min_gram": 2, "max_gram": 4}},
+                "analyzer": {"ac": {"tokenizer": "grams", "filter": ["lowercase"]}},
+            }}
+        })
+        assert AnalysisRegistry(settings).get("ac").analyze("Search") == [
+            "se", "sea", "sear",
+        ]
+
+    def test_unknown_filter_fails_at_build(self):
+        settings = Settings.from_dict({
+            "index": {"analysis": {"analyzer": {"bad": {
+                "tokenizer": "standard", "filter": ["nope"]}}}}
+        })
+        with pytest.raises(IllegalArgumentException):
+            AnalysisRegistry(settings)

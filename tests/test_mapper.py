@@ -1,0 +1,158 @@
+"""Mapper tests (ref: index/mapper — DocumentParser, MapperService.merge)."""
+
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    MapperParsingException,
+)
+from elasticsearch_tpu.mapper.field_types import (
+    format_ip,
+    parse_date,
+    parse_ip,
+)
+from elasticsearch_tpu.mapper.mapping import MapperService
+
+
+def make_service(mapping=None, **kw):
+    return MapperService(AnalysisRegistry(), mapping, **kw)
+
+
+class TestFieldTypes:
+    def test_date_parsing(self):
+        assert parse_date("2017-01-01") == 1483228800000
+        assert parse_date("2017-01-01T00:00:00Z") == 1483228800000
+        assert parse_date(1483228800000) == 1483228800000
+        assert parse_date("1483228800000") == 1483228800000
+        with pytest.raises(MapperParsingException):
+            parse_date("not a date")
+
+    def test_date_custom_format(self):
+        assert parse_date("01/01/2017", ["dd/MM/yyyy"]) == 1483228800000
+        with pytest.raises(MapperParsingException):
+            parse_date("2017-01-01", ["dd/MM/yyyy"])
+
+    def test_ip(self):
+        assert format_ip(parse_ip("192.168.1.1")) == "192.168.1.1"
+        assert format_ip(parse_ip("::1")) == "::1"
+        assert parse_ip("10.0.0.2") > parse_ip("10.0.0.1")
+        with pytest.raises(MapperParsingException):
+            parse_ip("not-an-ip")
+
+
+class TestExplicitMapping:
+    MAPPING = {
+        "properties": {
+            "title": {"type": "text", "fields": {"raw": {"type": "keyword"}}},
+            "tags": {"type": "keyword"},
+            "views": {"type": "long"},
+            "rating": {"type": "double"},
+            "published": {"type": "date"},
+            "active": {"type": "boolean"},
+            "author": {"properties": {"name": {"type": "text"}, "age": {"type": "integer"}}},
+        }
+    }
+
+    def setup_method(self):
+        self.svc = make_service(self.MAPPING)
+
+    def test_parse_full_doc(self):
+        doc = self.svc.parse_document("1", {
+            "title": "The Quick Fox",
+            "tags": ["news", "animals"],
+            "views": 42,
+            "rating": 4.5,
+            "published": "2017-06-01",
+            "active": True,
+            "author": {"name": "Jane Doe", "age": 34},
+        })
+        assert doc.terms["title"] == ["the", "quick", "fox"]
+        assert doc.terms["title.raw"] == ["The Quick Fox"]
+        assert doc.terms["tags"] == ["news", "animals"]
+        assert doc.numeric_values["views"] == [42.0]
+        assert doc.numeric_values["author.age"] == [34.0]
+        assert doc.string_values["tags"] == ["news", "animals"]
+        assert doc.terms["author.name"] == ["jane", "doe"]
+        assert doc.terms["active"] == ["T"]
+        assert "views" in doc.field_names
+        assert doc.mapping_update is None
+
+    def test_long_range_check(self):
+        with pytest.raises(MapperParsingException):
+            self.svc.parse_document("1", {"author": {"age": 2**40}})
+
+    def test_bad_number(self):
+        with pytest.raises(MapperParsingException):
+            self.svc.parse_document("1", {"views": "many"})
+
+    def test_object_vs_concrete_conflict(self):
+        with pytest.raises(MapperParsingException):
+            self.svc.parse_document("1", {"author": "just a string"})
+
+
+class TestDynamicMapping:
+    def test_infers_types(self):
+        svc = make_service()
+        doc = svc.parse_document("1", {
+            "name": "Alice", "age": 30, "score": 1.5, "ok": True,
+            "joined": "2020-05-01T10:00:00Z", "nested": {"x": 1},
+        })
+        props = svc.mapping_dict()["properties"]
+        assert props["name"]["type"] == "text"
+        assert props["name"]["fields"]["keyword"]["type"] == "keyword"
+        assert props["age"]["type"] == "long"
+        assert props["score"]["type"] == "float"
+        assert props["ok"]["type"] == "boolean"
+        assert props["joined"]["type"] == "date"
+        assert props["nested"]["properties"]["x"]["type"] == "long"
+        # text got an automatic .keyword subfield indexed too
+        assert doc.terms["name.keyword"] == ["Alice"]
+
+    def test_dynamic_strict_rejects(self):
+        svc = make_service({"dynamic": "strict", "properties": {"a": {"type": "long"}}})
+        svc.parse_document("1", {"a": 1})
+        with pytest.raises(MapperParsingException):
+            svc.parse_document("2", {"b": 1})
+
+    def test_dynamic_false_ignores(self):
+        svc = make_service({"dynamic": "false", "properties": {"a": {"type": "long"}}})
+        doc = svc.parse_document("1", {"a": 1, "b": "ignored"})
+        assert "b" not in doc.terms and "b" not in doc.string_values
+        assert "b" not in svc.mapping_dict()["properties"]
+
+    def test_field_limit(self):
+        svc = make_service(total_fields_limit=3)
+        with pytest.raises(IllegalArgumentException):
+            svc.parse_document("1", {"a": "x", "b": "y"})  # each text adds .keyword
+
+
+class TestMerge:
+    def test_merge_adds_fields(self):
+        svc = make_service({"properties": {"a": {"type": "long"}}})
+        svc.merge({"properties": {"b": {"type": "keyword"}}})
+        props = svc.mapping_dict()["properties"]
+        assert props["a"]["type"] == "long" and props["b"]["type"] == "keyword"
+
+    def test_merge_type_conflict(self):
+        svc = make_service({"properties": {"a": {"type": "long"}}})
+        with pytest.raises(IllegalArgumentException):
+            svc.merge({"properties": {"a": {"type": "keyword"}}})
+
+    def test_merge_nested(self):
+        svc = make_service({"properties": {"o": {"properties": {"x": {"type": "long"}}}}})
+        svc.merge({"properties": {"o": {"properties": {"y": {"type": "boolean"}}}}})
+        props = svc.mapping_dict()["properties"]["o"]["properties"]
+        assert set(props) == {"x", "y"}
+
+
+class TestFieldPatterns:
+    def test_simple_match(self):
+        svc = make_service({"properties": {
+            "user.name": {"type": "text"},
+        }})
+        svc.merge({"properties": {"username": {"type": "keyword"}, "age": {"type": "long"}}})
+        m = svc.mapper
+        assert m.simple_match_to_fields("user*") == ["user.name", "username"]
+        assert m.simple_match_to_fields("age") == ["age"]
+        assert m.simple_match_to_fields("missing") == []
