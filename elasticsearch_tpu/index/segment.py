@@ -1,0 +1,464 @@
+"""Immutable block-packed segments — the TPU-native "Lucene segment".
+
+Role model: a Lucene segment (postings + norms + doc values + stored
+fields) as used through ``index/engine/InternalEngine.java`` and
+``index/store/Store.java`` in the reference. The design is inverted for
+TPU execution (SURVEY.md §7.1):
+
+- Postings are **block-packed dense arrays**: every term's postings are
+  padded to multiples of BLOCK=128 docs and laid out in one big
+  ``[n_blocks, 128]`` int32 matrix (lane dimension = 128, matching the VPU
+  lane width). A query gathers its terms' block rows and scores them in one
+  fused program — no skip lists, no branchy iteration.
+- Norms are exact float32 per-field doc-length columns (Lucene's lossy
+  1-byte SmallFloat encoding is unnecessary in HBM).
+- Doc values are columnar: numerics/dates as float64 CSR (value, doc)
+  pairs plus a dense first-value column for sorting; keywords as ordinal
+  CSR against a sorted per-field term dictionary (the reference's
+  per-segment ordinals, index/fielddata/).
+- Stored fields (_source) stay host-side; only ids/doc-values/postings are
+  staged to device.
+
+All shapes are padded to power-of-two buckets so XLA programs cache across
+segments of similar size.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+BLOCK = 128  # posting block width == TPU lane count
+
+# Field-name separator in composite term keys ("field\x1ftoken").
+FIELD_SEP = "\x1f"
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class NumericColumn:
+    """CSR numeric doc values + dense sort columns (host numpy)."""
+
+    flat_values: np.ndarray  # [n_vals] float64, padded with 0
+    flat_docs: np.ndarray  # [n_vals] int32, padded with sentinel doc
+    first_value: np.ndarray  # [nd_pad] float64 (first value per doc, 0 if missing)
+    min_value: np.ndarray  # [nd_pad] float64 (for asc sort)
+    max_value: np.ndarray  # [nd_pad] float64 (for desc sort)
+    exists: np.ndarray  # [nd_pad] bool
+    count: int  # real number of values
+
+
+@dataclass
+class OrdinalColumn:
+    """String doc values as ordinals against a sorted term list."""
+
+    terms: List[str]  # sorted unique values; ordinal = index
+    flat_ords: np.ndarray  # [n_vals] int32
+    flat_docs: np.ndarray  # [n_vals] int32
+    first_ord: np.ndarray  # [nd_pad] int32, -1 if missing (sorts last)
+    exists: np.ndarray  # [nd_pad] bool
+    count: int
+
+    def ord_of(self, term: str) -> int:
+        i = bisect.bisect_left(self.terms, term)
+        if i < len(self.terms) and self.terms[i] == term:
+            return i
+        return -1
+
+    def ord_range(self, lo: Optional[str], hi: Optional[str],
+                  include_lo: bool, include_hi: bool) -> Tuple[int, int]:
+        """[lo_ord, hi_ord) half-open ordinal range for a term range query."""
+        lo_ord = 0
+        if lo is not None:
+            lo_ord = (bisect.bisect_left(self.terms, lo) if include_lo
+                      else bisect.bisect_right(self.terms, lo))
+        hi_ord = len(self.terms)
+        if hi is not None:
+            hi_ord = (bisect.bisect_right(self.terms, hi) if include_hi
+                      else bisect.bisect_left(self.terms, hi))
+        return lo_ord, hi_ord
+
+
+@dataclass
+class GeoColumn:
+    lat: np.ndarray  # [n_vals] float32
+    lon: np.ndarray  # [n_vals] float32
+    flat_docs: np.ndarray  # [n_vals] int32
+    first_lat: np.ndarray  # [nd_pad] float32
+    first_lon: np.ndarray  # [nd_pad] float32
+    exists: np.ndarray  # [nd_pad] bool
+    count: int
+
+
+class Segment:
+    """An immutable sealed segment.
+
+    Host numpy arrays; ``device_arrays()`` stages the query-relevant subset
+    to the default JAX device once and caches it (HBM staging ≙ the
+    reference's filesystem page cache warming at shard open).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        num_docs: int,
+        doc_ids: List[str],
+        sources: List[dict],
+        routings: List[Optional[str]],
+        seqnos: np.ndarray,
+        versions: np.ndarray,
+        term_keys: List[str],
+        term_block_start: np.ndarray,
+        term_block_count: np.ndarray,
+        term_doc_freq: np.ndarray,
+        block_docs: np.ndarray,
+        block_tfs: np.ndarray,
+        field_stats: Dict[str, dict],
+        field_norm_idx: Dict[str, int],
+        norms: np.ndarray,
+        numeric_columns: Dict[str, NumericColumn],
+        ordinal_columns: Dict[str, OrdinalColumn],
+        geo_columns: Dict[str, GeoColumn],
+        exists_masks: Dict[str, np.ndarray],
+        positions: Optional[Dict[int, dict]] = None,
+    ):
+        self.name = name
+        self.num_docs = num_docs
+        self.nd_pad = next_pow2(max(num_docs, 1))
+        self.doc_ids = doc_ids
+        self.sources = sources
+        self.routings = routings
+        self.seqnos = seqnos
+        self.versions = versions
+        # sorted composite term keys; term_id = position
+        self.term_keys = term_keys
+        self.term_block_start = term_block_start
+        self.term_block_count = term_block_count
+        self.term_doc_freq = term_doc_freq
+        self.block_docs = block_docs  # [n_blocks, BLOCK] int32, pad = nd_pad
+        self.block_tfs = block_tfs  # [n_blocks, BLOCK] float32
+        # field -> {"doc_count": int, "sum_ttf": int} for BM25 stats
+        self.field_stats = field_stats
+        # text field -> row in the stacked norms matrix
+        self.field_norm_idx = field_norm_idx
+        self.norms = norms  # [n_norm_fields, nd_pad + 1] float32, last col = 1
+        self.numeric_columns = numeric_columns
+        self.ordinal_columns = ordinal_columns
+        self.geo_columns = geo_columns
+        self.exists_masks = exists_masks  # field -> [nd_pad] bool
+        # term_id -> {local_doc: np.ndarray positions} for phrase queries
+        self.positions = positions or {}
+        # tombstones for deleted docs (set by the engine on update/delete)
+        self.live = np.ones(self.nd_pad, dtype=bool)
+        self.live[num_docs:] = False
+        self._id_to_doc: Optional[Dict[str, int]] = None
+        self._device: Optional[dict] = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def live_doc_count(self) -> int:
+        return int(self.live[: self.num_docs].sum())
+
+    def id_to_doc(self) -> Dict[str, int]:
+        if self._id_to_doc is None:
+            self._id_to_doc = {i: d for d, i in enumerate(self.doc_ids)}
+        return self._id_to_doc
+
+    def delete_doc(self, local_doc: int) -> None:
+        self.live[local_doc] = False
+        if self._device is not None:  # restage only the live mask
+            import jax.numpy as jnp
+
+            self._device["live"] = jnp.asarray(self.live)
+
+    def term_id(self, field_name: str, token: str) -> int:
+        key = f"{field_name}{FIELD_SEP}{token}"
+        i = bisect.bisect_left(self.term_keys, key)
+        if i < len(self.term_keys) and self.term_keys[i] == key:
+            return i
+        return -1
+
+    def terms_for_field(self, field_name: str) -> List[Tuple[str, int]]:
+        """All (token, term_id) of a field, in sorted token order."""
+        prefix = f"{field_name}{FIELD_SEP}"
+        lo = bisect.bisect_left(self.term_keys, prefix)
+        hi = bisect.bisect_left(self.term_keys, prefix + "￿")
+        return [(self.term_keys[i][len(prefix):], i) for i in range(lo, hi)]
+
+    def field_avgdl(self, field_name: str) -> float:
+        st = self.field_stats.get(field_name)
+        if not st or st["doc_count"] == 0:
+            return 1.0
+        return max(st["sum_ttf"] / st["doc_count"], 1.0)
+
+    # ------------------------------------------------------------------
+    # Device staging
+    # ------------------------------------------------------------------
+
+    def device_arrays(self) -> dict:
+        """Stage postings/norms/live-mask to the default device (cached)."""
+        if self._device is None:
+            import jax.numpy as jnp
+
+            self._device = {
+                "block_docs": jnp.asarray(self.block_docs),
+                "block_tfs": jnp.asarray(self.block_tfs),
+                "norms": jnp.asarray(self.norms),
+                "live": jnp.asarray(self.live),
+            }
+        return self._device
+
+    def memory_bytes(self) -> int:
+        total = self.block_docs.nbytes + self.block_tfs.nbytes + self.norms.nbytes
+        for c in self.numeric_columns.values():
+            total += c.flat_values.nbytes + c.flat_docs.nbytes + c.first_value.nbytes
+        for c in self.ordinal_columns.values():
+            total += c.flat_ords.nbytes + c.flat_docs.nbytes + c.first_ord.nbytes
+        return total
+
+    def stats(self) -> dict:
+        return {
+            "name": self.name,
+            "num_docs": self.num_docs,
+            "deleted_docs": self.num_docs - self.live_doc_count,
+            "num_terms": len(self.term_keys),
+            "num_posting_blocks": int(self.block_docs.shape[0]),
+            "memory_in_bytes": self.memory_bytes(),
+        }
+
+
+class SegmentBuilder:
+    """Accumulates parsed documents, seals into a Segment.
+
+    Role model: Lucene's in-memory indexing buffer inside ``IndexWriter``
+    as driven by ``InternalEngine.indexIntoLucene``
+    (index/engine/InternalEngine.java:763). Documents are buffered as
+    Python/numpy structures; ``seal()`` performs the "flush to segment":
+    sort terms, block-pack postings, build columns.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.doc_ids: List[str] = []
+        self.sources: List[dict] = []
+        self.routings: List[Optional[str]] = []
+        self.seqnos: List[int] = []
+        self.versions: List[int] = []
+        # term_key -> list[(doc, tf)] — appended in doc order, so sorted by doc
+        self.postings: Dict[str, List[Tuple[int, int]]] = {}
+        # term_key -> {doc: [positions]}
+        self.positions: Dict[str, Dict[int, List[int]]] = {}
+        # field -> {doc: token_count}
+        self.field_lengths: Dict[str, Dict[int, int]] = {}
+        self.numeric_values: Dict[str, List[Tuple[int, float]]] = {}
+        self.string_values: Dict[str, List[Tuple[int, str]]] = {}
+        self.geo_values: Dict[str, List[Tuple[int, float, float]]] = {}
+        self.field_docs: Dict[str, set] = {}
+
+    @property
+    def num_docs(self) -> int:
+        return len(self.doc_ids)
+
+    def add_document(self, parsed, seqno: int, version: int = 1) -> int:
+        """parsed: mapper.ParsedDocument. Returns the local doc id."""
+        doc = len(self.doc_ids)
+        self.doc_ids.append(parsed.doc_id)
+        self.sources.append(parsed.source)
+        self.routings.append(parsed.routing)
+        self.seqnos.append(seqno)
+        self.versions.append(version)
+        for field_name, tokens in parsed.terms.items():
+            self.field_lengths.setdefault(field_name, {})[doc] = len(tokens)
+            self.field_docs.setdefault(field_name, set()).add(doc)
+            counts: Dict[str, int] = {}
+            for pos, tok in enumerate(tokens):
+                counts[tok] = counts.get(tok, 0) + 1
+                key = f"{field_name}{FIELD_SEP}{tok}"
+                self.positions.setdefault(key, {}).setdefault(doc, []).append(pos)
+            for tok, tf in counts.items():
+                key = f"{field_name}{FIELD_SEP}{tok}"
+                self.postings.setdefault(key, []).append((doc, tf))
+        for field_name, vals in parsed.numeric_values.items():
+            self.field_docs.setdefault(field_name, set()).add(doc)
+            self.numeric_values.setdefault(field_name, []).extend(
+                (doc, v) for v in vals
+            )
+        for field_name, vals in parsed.string_values.items():
+            self.field_docs.setdefault(field_name, set()).add(doc)
+            self.string_values.setdefault(field_name, []).extend(
+                (doc, v) for v in vals
+            )
+        for field_name, pts in parsed.geo_values.items():
+            self.field_docs.setdefault(field_name, set()).add(doc)
+            self.geo_values.setdefault(field_name, []).extend(
+                (doc, lat, lon) for lat, lon in pts
+            )
+        return doc
+
+    # ------------------------------------------------------------------
+
+    def seal(self) -> Segment:
+        nd = self.num_docs
+        nd_pad = next_pow2(max(nd, 1))
+        term_keys = sorted(self.postings.keys())
+        term_ids = {k: i for i, k in enumerate(term_keys)}
+
+        # --- block-pack postings ---
+        n_terms = len(term_keys)
+        term_block_start = np.zeros(n_terms, dtype=np.int32)
+        term_block_count = np.zeros(n_terms, dtype=np.int32)
+        term_doc_freq = np.zeros(n_terms, dtype=np.int32)
+        total_blocks = sum(
+            (len(p) + BLOCK - 1) // BLOCK for p in self.postings.values()
+        )
+        total_blocks = max(total_blocks, 1)
+        block_docs = np.full((total_blocks, BLOCK), nd_pad, dtype=np.int32)
+        block_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
+        b = 0
+        for key in term_keys:
+            plist = self.postings[key]
+            tid = term_ids[key]
+            term_doc_freq[tid] = len(plist)
+            term_block_start[tid] = b
+            nblocks = (len(plist) + BLOCK - 1) // BLOCK
+            term_block_count[tid] = nblocks
+            docs = np.fromiter((d for d, _ in plist), dtype=np.int32, count=len(plist))
+            tfs = np.fromiter((t for _, t in plist), dtype=np.float32, count=len(plist))
+            for i in range(nblocks):
+                chunk = docs[i * BLOCK : (i + 1) * BLOCK]
+                block_docs[b, : len(chunk)] = chunk
+                block_tfs[b, : len(chunk)] = tfs[i * BLOCK : (i + 1) * BLOCK]
+                b += 1
+
+        # --- norms (per text field doc-length columns) ---
+        field_norm_idx = {f: i for i, f in enumerate(sorted(self.field_lengths))}
+        norms = np.ones((max(len(field_norm_idx), 1), nd_pad + 1), dtype=np.float32)
+        field_stats: Dict[str, dict] = {}
+        for f, idx in field_norm_idx.items():
+            lengths = self.field_lengths[f]
+            col = np.zeros(nd_pad + 1, dtype=np.float32)
+            for doc, ln in lengths.items():
+                col[doc] = ln
+            col[nd_pad] = 1.0
+            norms[idx] = col
+            field_stats[f] = {
+                "doc_count": len(lengths),
+                "sum_ttf": int(sum(lengths.values())),
+            }
+
+        # --- numeric columns ---
+        numeric_columns = {}
+        for f, pairs in self.numeric_values.items():
+            pairs.sort(key=lambda p: p[0])
+            n_vals = len(pairs)
+            cap = next_pow2(max(n_vals, 1))
+            flat_docs = np.full(cap, nd_pad, dtype=np.int32)
+            flat_values = np.zeros(cap, dtype=np.float64)
+            first_value = np.zeros(nd_pad, dtype=np.float64)
+            min_value = np.full(nd_pad, np.inf, dtype=np.float64)
+            max_value = np.full(nd_pad, -np.inf, dtype=np.float64)
+            exists = np.zeros(nd_pad, dtype=bool)
+            for i, (doc, v) in enumerate(pairs):
+                flat_docs[i] = doc
+                flat_values[i] = v
+                if not exists[doc]:
+                    first_value[doc] = v
+                exists[doc] = True
+                min_value[doc] = min(min_value[doc], v)
+                max_value[doc] = max(max_value[doc], v)
+            numeric_columns[f] = NumericColumn(
+                flat_values, flat_docs, first_value, min_value, max_value, exists, n_vals
+            )
+
+        # --- ordinal (string) columns ---
+        ordinal_columns = {}
+        for f, pairs in self.string_values.items():
+            terms = sorted({v for _, v in pairs})
+            ord_map = {t: i for i, t in enumerate(terms)}
+            pairs.sort(key=lambda p: p[0])
+            n_vals = len(pairs)
+            cap = next_pow2(max(n_vals, 1))
+            flat_docs = np.full(cap, nd_pad, dtype=np.int32)
+            flat_ords = np.zeros(cap, dtype=np.int32)
+            first_ord = np.full(nd_pad, -1, dtype=np.int32)
+            exists = np.zeros(nd_pad, dtype=bool)
+            for i, (doc, v) in enumerate(pairs):
+                flat_docs[i] = doc
+                flat_ords[i] = ord_map[v]
+                if first_ord[doc] < 0:
+                    first_ord[doc] = ord_map[v]
+                exists[doc] = True
+            ordinal_columns[f] = OrdinalColumn(
+                terms, flat_ords, flat_docs, first_ord, exists, n_vals
+            )
+
+        # --- geo columns ---
+        geo_columns = {}
+        for f, triples in self.geo_values.items():
+            triples.sort(key=lambda p: p[0])
+            n_vals = len(triples)
+            cap = next_pow2(max(n_vals, 1))
+            flat_docs = np.full(cap, nd_pad, dtype=np.int32)
+            lat = np.zeros(cap, dtype=np.float32)
+            lon = np.zeros(cap, dtype=np.float32)
+            first_lat = np.zeros(nd_pad, dtype=np.float32)
+            first_lon = np.zeros(nd_pad, dtype=np.float32)
+            exists = np.zeros(nd_pad, dtype=bool)
+            for i, (doc, la, lo) in enumerate(triples):
+                flat_docs[i] = doc
+                lat[i], lon[i] = la, lo
+                if not exists[doc]:
+                    first_lat[doc], first_lon[doc] = la, lo
+                exists[doc] = True
+            geo_columns[f] = GeoColumn(lat, lon, flat_docs, first_lat, first_lon,
+                                       exists, n_vals)
+
+        # --- exists masks ---
+        exists_masks = {}
+        for f, docs in self.field_docs.items():
+            mask = np.zeros(nd_pad, dtype=bool)
+            for d in docs:
+                mask[d] = True
+            exists_masks[f] = mask
+
+        # --- positions (host-side, for phrase queries) ---
+        positions = {}
+        for key, per_doc in self.positions.items():
+            positions[term_ids[key]] = {
+                doc: np.asarray(pos, dtype=np.int32) for doc, pos in per_doc.items()
+            }
+
+        return Segment(
+            name=self.name,
+            num_docs=nd,
+            doc_ids=list(self.doc_ids),
+            sources=list(self.sources),
+            routings=list(self.routings),
+            seqnos=np.asarray(self.seqnos, dtype=np.int64),
+            versions=np.asarray(self.versions, dtype=np.int64),
+            term_keys=term_keys,
+            term_block_start=term_block_start,
+            term_block_count=term_block_count,
+            term_doc_freq=term_doc_freq,
+            block_docs=block_docs,
+            block_tfs=block_tfs,
+            field_stats=field_stats,
+            field_norm_idx=field_norm_idx,
+            norms=norms,
+            numeric_columns=numeric_columns,
+            ordinal_columns=ordinal_columns,
+            geo_columns=geo_columns,
+            exists_masks=exists_masks,
+            positions=positions,
+        )

@@ -1,0 +1,261 @@
+"""On-disk segment persistence + commit points.
+
+Role model: ``Store`` (core/.../index/store/Store.java) + Lucene commits +
+``MetaDataStateFormat`` atomic state files (gateway/MetaDataStateFormat).
+A commit point is a JSON file listing the live segment set, max seqno and
+tombstones, written atomically (tmp + rename). Segment payloads are
+numpy ``.npz`` archives + JSON sidecars (term dictionary, sources).
+
+Checksums: each segment directory carries a metadata file with per-array
+SHA-256 digests, verified on load — the analog of Store's checksum
+verification of Lucene segment files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+from elasticsearch_tpu.index.segment import (
+    GeoColumn,
+    NumericColumn,
+    OrdinalColumn,
+    Segment,
+)
+
+
+class CorruptIndexException(ElasticsearchTpuException):
+    status_code = 500
+
+
+class Store:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------------
+
+    def _seg_dir(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def _commit_path(self) -> str:
+        return os.path.join(self.directory, "commit.json")
+
+    def commit(self, segments: List[Segment], max_seqno: int,
+               version_map: Optional[dict] = None) -> None:
+        for seg in segments:
+            if not os.path.exists(self._seg_dir(seg.name)):
+                self.write_segment(seg)
+            # always refresh the live (tombstone) mask — cheap
+            np.save(os.path.join(self._seg_dir(seg.name), "live.npy"), seg.live)
+        commit = {
+            "segments": [s.name for s in segments],
+            "max_seq_no": int(max_seqno),
+        }
+        tmp = self._commit_path() + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(commit, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._commit_path())
+        # garbage-collect segments dropped from the commit (post-merge)
+        live_names = set(commit["segments"])
+        for entry in os.listdir(self.directory):
+            p = os.path.join(self.directory, entry)
+            if os.path.isdir(p) and entry not in live_names:
+                import shutil
+
+                shutil.rmtree(p, ignore_errors=True)
+
+    def read_commit(self) -> Optional[dict]:
+        try:
+            with open(self._commit_path(), encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def load_segments(self) -> List[Segment]:
+        commit = self.read_commit()
+        if commit is None:
+            return []
+        return [self.read_segment(name) for name in commit["segments"]]
+
+    # ------------------------------------------------------------------
+
+    def write_segment(self, seg: Segment) -> None:
+        d = self._seg_dir(seg.name)
+        os.makedirs(d, exist_ok=True)
+        arrays = {
+            "term_block_start": seg.term_block_start,
+            "term_block_count": seg.term_block_count,
+            "term_doc_freq": seg.term_doc_freq,
+            "block_docs": seg.block_docs,
+            "block_tfs": seg.block_tfs,
+            "norms": seg.norms,
+            "seqnos": seg.seqnos,
+            "versions": seg.versions,
+        }
+        for f, col in seg.numeric_columns.items():
+            arrays[f"num.{f}.flat_values"] = col.flat_values
+            arrays[f"num.{f}.flat_docs"] = col.flat_docs
+            arrays[f"num.{f}.first_value"] = col.first_value
+            arrays[f"num.{f}.min_value"] = col.min_value
+            arrays[f"num.{f}.max_value"] = col.max_value
+            arrays[f"num.{f}.exists"] = col.exists
+        for f, col in seg.ordinal_columns.items():
+            arrays[f"ord.{f}.flat_ords"] = col.flat_ords
+            arrays[f"ord.{f}.flat_docs"] = col.flat_docs
+            arrays[f"ord.{f}.first_ord"] = col.first_ord
+            arrays[f"ord.{f}.exists"] = col.exists
+        for f, col in seg.geo_columns.items():
+            arrays[f"geo.{f}.lat"] = col.lat
+            arrays[f"geo.{f}.lon"] = col.lon
+            arrays[f"geo.{f}.flat_docs"] = col.flat_docs
+            arrays[f"geo.{f}.first_lat"] = col.first_lat
+            arrays[f"geo.{f}.first_lon"] = col.first_lon
+            arrays[f"geo.{f}.exists"] = col.exists
+        for f, mask in seg.exists_masks.items():
+            arrays[f"exists.{f}"] = mask
+        np.savez(os.path.join(d, "arrays.npz"), **arrays)
+        np.save(os.path.join(d, "live.npy"), seg.live)
+
+        meta = {
+            "name": seg.name,
+            "num_docs": seg.num_docs,
+            "term_keys": seg.term_keys,
+            "field_stats": seg.field_stats,
+            "field_norm_idx": seg.field_norm_idx,
+            "numeric_fields": {f: c.count for f, c in seg.numeric_columns.items()},
+            "ordinal_fields": {
+                f: {"terms": c.terms, "count": c.count}
+                for f, c in seg.ordinal_columns.items()
+            },
+            "geo_fields": {f: c.count for f, c in seg.geo_columns.items()},
+            "doc_ids": seg.doc_ids,
+            "routings": seg.routings,
+        }
+        with open(os.path.join(d, "meta.json"), "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+        with open(os.path.join(d, "sources.jsonl"), "w", encoding="utf-8") as f:
+            for src in seg.sources:
+                f.write(json.dumps(src, separators=(",", ":")) + "\n")
+        # positions sidecar (phrase queries): term_id -> {doc: [pos...]}
+        with open(os.path.join(d, "positions.json"), "w", encoding="utf-8") as f:
+            json.dump(
+                {str(tid): {str(doc): pos.tolist() for doc, pos in per_doc.items()}
+                 for tid, per_doc in seg.positions.items()},
+                f,
+            )
+        self._write_checksums(d)
+
+    def _write_checksums(self, d: str) -> None:
+        sums = {}
+        for fn in ("arrays.npz", "meta.json", "sources.jsonl", "positions.json"):
+            with open(os.path.join(d, fn), "rb") as f:
+                sums[fn] = hashlib.sha256(f.read()).hexdigest()
+        with open(os.path.join(d, "checksums.json"), "w", encoding="utf-8") as f:
+            json.dump(sums, f)
+
+    def verify_checksums(self, name: str) -> None:
+        d = self._seg_dir(name)
+        try:
+            with open(os.path.join(d, "checksums.json"), encoding="utf-8") as f:
+                sums = json.load(f)
+        except FileNotFoundError:
+            raise CorruptIndexException(f"segment [{name}] missing checksums") from None
+        for fn, expected in sums.items():
+            with open(os.path.join(d, fn), "rb") as f:
+                actual = hashlib.sha256(f.read()).hexdigest()
+            if actual != expected:
+                raise CorruptIndexException(
+                    f"checksum failed for [{name}/{fn}] (stored={expected[:12]}, "
+                    f"actual={actual[:12]})"
+                )
+
+    def read_segment(self, name: str) -> Segment:
+        d = self._seg_dir(name)
+        self.verify_checksums(name)
+        with open(os.path.join(d, "meta.json"), encoding="utf-8") as f:
+            meta = json.load(f)
+        data = np.load(os.path.join(d, "arrays.npz"))
+        sources = []
+        with open(os.path.join(d, "sources.jsonl"), encoding="utf-8") as f:
+            for line in f:
+                if line.strip():
+                    sources.append(json.loads(line))
+        with open(os.path.join(d, "positions.json"), encoding="utf-8") as f:
+            pos_raw = json.load(f)
+        positions = {
+            int(tid): {int(doc): np.asarray(pos, dtype=np.int32)
+                       for doc, pos in per_doc.items()}
+            for tid, per_doc in pos_raw.items()
+        }
+
+        numeric_columns: Dict[str, NumericColumn] = {}
+        for f_name, count in meta["numeric_fields"].items():
+            numeric_columns[f_name] = NumericColumn(
+                data[f"num.{f_name}.flat_values"],
+                data[f"num.{f_name}.flat_docs"],
+                data[f"num.{f_name}.first_value"],
+                data[f"num.{f_name}.min_value"],
+                data[f"num.{f_name}.max_value"],
+                data[f"num.{f_name}.exists"],
+                count,
+            )
+        ordinal_columns: Dict[str, OrdinalColumn] = {}
+        for f_name, info in meta["ordinal_fields"].items():
+            ordinal_columns[f_name] = OrdinalColumn(
+                info["terms"],
+                data[f"ord.{f_name}.flat_ords"],
+                data[f"ord.{f_name}.flat_docs"],
+                data[f"ord.{f_name}.first_ord"],
+                data[f"ord.{f_name}.exists"],
+                info["count"],
+            )
+        geo_columns: Dict[str, GeoColumn] = {}
+        for f_name, count in meta["geo_fields"].items():
+            geo_columns[f_name] = GeoColumn(
+                data[f"geo.{f_name}.lat"],
+                data[f"geo.{f_name}.lon"],
+                data[f"geo.{f_name}.flat_docs"],
+                data[f"geo.{f_name}.first_lat"],
+                data[f"geo.{f_name}.first_lon"],
+                data[f"geo.{f_name}.exists"],
+                count,
+            )
+        exists_masks = {
+            k[len("exists."):]: data[k] for k in data.files if k.startswith("exists.")
+        }
+
+        seg = Segment(
+            name=meta["name"],
+            num_docs=meta["num_docs"],
+            doc_ids=meta["doc_ids"],
+            sources=sources,
+            routings=meta["routings"],
+            seqnos=data["seqnos"],
+            versions=data["versions"],
+            term_keys=meta["term_keys"],
+            term_block_start=data["term_block_start"],
+            term_block_count=data["term_block_count"],
+            term_doc_freq=data["term_doc_freq"],
+            block_docs=data["block_docs"],
+            block_tfs=data["block_tfs"],
+            field_stats=meta["field_stats"],
+            field_norm_idx=meta["field_norm_idx"],
+            norms=data["norms"],
+            numeric_columns=numeric_columns,
+            ordinal_columns=ordinal_columns,
+            geo_columns=geo_columns,
+            exists_masks=exists_masks,
+            positions=positions,
+        )
+        live_path = os.path.join(d, "live.npy")
+        if os.path.exists(live_path):
+            seg.live = np.load(live_path)
+        return seg

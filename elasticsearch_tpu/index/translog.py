@@ -1,0 +1,187 @@
+"""Per-shard write-ahead log with generations.
+
+Role model: ``Translog`` (core/.../index/translog/Translog.java:94, add:488)
+— a sequential op log with monotonically increasing sequence numbers,
+generation files rolled at flush, fsync policies (``request`` fsyncs every
+write, ``async`` batches), and replay snapshots for recovery
+(index/engine/InternalEngine recoverFromTranslog).
+
+Format: one JSON line per operation + a small checkpoint file recording
+(generation, max_seqno, last-committed seqno) — the analog of Translog's
+``translog.ckp``. JSON-lines keeps ops human-debuggable; the op volume is
+host-side and never touches the TPU path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional
+
+
+class TranslogOp:
+    INDEX = "index"
+    DELETE = "delete"
+    NO_OP = "no_op"
+
+    def __init__(self, op_type: str, seqno: int, doc_id: Optional[str] = None,
+                 source: Optional[dict] = None, routing: Optional[str] = None,
+                 version: int = 1, primary_term: int = 1):
+        self.op_type = op_type
+        self.seqno = seqno
+        self.doc_id = doc_id
+        self.source = source
+        self.routing = routing
+        self.version = version
+        self.primary_term = primary_term
+
+    def to_dict(self) -> dict:
+        d = {"op": self.op_type, "seq_no": self.seqno, "primary_term": self.primary_term,
+             "version": self.version}
+        if self.doc_id is not None:
+            d["id"] = self.doc_id
+        if self.source is not None:
+            d["source"] = self.source
+        if self.routing is not None:
+            d["routing"] = self.routing
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "TranslogOp":
+        return TranslogOp(
+            d["op"], d["seq_no"], d.get("id"), d.get("source"), d.get("routing"),
+            d.get("version", 1), d.get("primary_term", 1),
+        )
+
+
+class Translog:
+    DURABILITY_REQUEST = "request"
+    DURABILITY_ASYNC = "async"
+
+    def __init__(self, directory: str, durability: str = DURABILITY_REQUEST):
+        self.directory = directory
+        self.durability = durability
+        os.makedirs(directory, exist_ok=True)
+        ckp = self._read_checkpoint()
+        self.generation: int = ckp.get("generation", 1)
+        self.max_seqno: int = ckp.get("max_seqno", -1)
+        # ops at or below this seqno are in a committed segment set
+        self.committed_seqno: int = ckp.get("committed_seqno", -1)
+        self._writer = open(self._gen_path(self.generation), "a", encoding="utf-8")
+        self._ops_since_sync = 0
+
+    # ------------------------------------------------------------------
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.directory, f"translog-{gen}.log")
+
+    def _ckp_path(self) -> str:
+        return os.path.join(self.directory, "translog.ckp")
+
+    def _read_checkpoint(self) -> dict:
+        try:
+            with open(self._ckp_path(), encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def _write_checkpoint(self) -> None:
+        tmp = self._ckp_path() + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "generation": self.generation,
+                    "max_seqno": self.max_seqno,
+                    "committed_seqno": self.committed_seqno,
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckp_path())  # atomic, like MetaDataStateFormat
+
+    # ------------------------------------------------------------------
+
+    def add(self, op: TranslogOp) -> None:
+        """Append one op; fsync per the durability policy (Translog.add:488)."""
+        self._writer.write(json.dumps(op.to_dict(), separators=(",", ":")) + "\n")
+        self.max_seqno = max(self.max_seqno, op.seqno)
+        if self.durability == self.DURABILITY_REQUEST:
+            self.sync()
+        else:
+            self._ops_since_sync += 1
+
+    def sync(self) -> None:
+        self._writer.flush()
+        os.fsync(self._writer.fileno())
+        self._ops_since_sync = 0
+        self._write_checkpoint()
+
+    def roll_generation(self) -> None:
+        """Start a new generation file (rolled at flush)."""
+        self.sync()
+        self._writer.close()
+        self.generation += 1
+        self._writer = open(self._gen_path(self.generation), "a", encoding="utf-8")
+        self._write_checkpoint()
+
+    def mark_committed(self, seqno: int) -> None:
+        """Engine flushed a commit covering ops <= seqno; trim old generations
+        whose ops are all committed (CombinedDeletionPolicy analog)."""
+        self.committed_seqno = max(self.committed_seqno, seqno)
+        self.sync()
+        # trim: delete generations strictly older than current whose max op
+        # seqno <= committed_seqno
+        for gen in range(1, self.generation):
+            path = self._gen_path(gen)
+            if not os.path.exists(path):
+                continue
+            try:
+                ops = list(self._read_gen(gen))
+            except (OSError, json.JSONDecodeError):
+                continue
+            if not ops or all(op.seqno <= self.committed_seqno for op in ops):
+                os.remove(path)
+
+    def _read_gen(self, gen: int) -> Iterator[TranslogOp]:
+        with open(self._gen_path(gen), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield TranslogOp.from_dict(json.loads(line))
+
+    def snapshot(self, from_seqno: int = 0) -> List[TranslogOp]:
+        """All retained ops with seqno >= from_seqno, in log order.
+        (Translog.newSnapshot — used by recovery phase2 and resync.)"""
+        self._writer.flush()
+        out: List[TranslogOp] = []
+        for gen in range(1, self.generation + 1):
+            if not os.path.exists(self._gen_path(gen)):
+                continue
+            for op in self._read_gen(gen):
+                if op.seqno >= from_seqno:
+                    out.append(op)
+        return out
+
+    def uncommitted_ops(self) -> List[TranslogOp]:
+        return self.snapshot(self.committed_seqno + 1)
+
+    def stats(self) -> dict:
+        n_ops = len(self.snapshot(0))
+        size = sum(
+            os.path.getsize(self._gen_path(g))
+            for g in range(1, self.generation + 1)
+            if os.path.exists(self._gen_path(g))
+        )
+        return {
+            "operations": n_ops,
+            "size_in_bytes": size,
+            "uncommitted_operations": len(self.uncommitted_ops()),
+            "generation": self.generation,
+        }
+
+    def close(self) -> None:
+        try:
+            self.sync()
+        finally:
+            self._writer.close()

@@ -1,0 +1,11 @@
+"""TPU compute kernels.
+
+64-bit note: doc-value columns (dates = epoch millis, longs) need int64/
+float64 precision, so the engine enables jax x64 globally. The scoring hot
+path stays explicitly float32/bfloat16 — x64 only changes *defaults*, and
+all kernels here pin their dtypes.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

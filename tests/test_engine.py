@@ -1,0 +1,263 @@
+"""Engine/segment/translog/store tests (ref: index/engine, index/translog)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.common.errors import VersionConflictEngineException
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.segment import BLOCK, SegmentBuilder
+from elasticsearch_tpu.index.store import CorruptIndexException, Store
+from elasticsearch_tpu.index.translog import Translog, TranslogOp
+from elasticsearch_tpu.mapper.mapping import MapperService
+
+
+def make_engine(tmp_path, store=True):
+    svc = MapperService(AnalysisRegistry())
+    tl = Translog(str(tmp_path / "translog"))
+    st = Store(str(tmp_path / "store")) if store else None
+    return Engine("test-shard-0", svc, tl, st)
+
+
+class TestSegmentBuilder:
+    def _seal(self, docs):
+        svc = MapperService(AnalysisRegistry())
+        b = SegmentBuilder("s1")
+        for i, src in enumerate(docs):
+            b.add_document(svc.parse_document(str(i), src), seqno=i)
+        return b.seal()
+
+    def test_postings_block_packed(self):
+        seg = self._seal([{"body": "quick fox"}, {"body": "quick dog"}])
+        tid = seg.term_id("body", "quick")
+        assert tid >= 0
+        assert seg.term_doc_freq[tid] == 2
+        start = seg.term_block_start[tid]
+        assert seg.term_block_count[tid] == 1
+        row = seg.block_docs[start]
+        assert list(row[:2]) == [0, 1]
+        # padding points at the sentinel slot
+        assert (row[2:] == seg.nd_pad).all()
+        assert seg.block_tfs[start][0] == 1.0
+
+    def test_tf_counted(self):
+        seg = self._seal([{"body": "go go go stop"}])
+        tid = seg.term_id("body", "go")
+        assert seg.block_tfs[seg.term_block_start[tid]][0] == 3.0
+
+    def test_norms_are_field_lengths(self):
+        seg = self._seal([{"body": "one two three"}, {"body": "one"}])
+        idx = seg.field_norm_idx["body"]
+        assert seg.norms[idx][0] == 3.0
+        assert seg.norms[idx][1] == 1.0
+        assert seg.field_avgdl("body") == 2.0
+
+    def test_large_term_spans_blocks(self):
+        n = BLOCK + 10
+        seg = self._seal([{"body": "common"} for _ in range(n)])
+        tid = seg.term_id("body", "common")
+        assert seg.term_block_count[tid] == 2
+        assert seg.term_doc_freq[tid] == n
+
+    def test_numeric_column(self):
+        seg = self._seal([{"n": 5}, {"x": "no n"}, {"n": [1, 9]}])
+        col = seg.numeric_columns["n"]
+        assert col.count == 3
+        assert col.exists[0] and not col.exists[1] and col.exists[2]
+        assert col.first_value[0] == 5.0
+        assert col.min_value[2] == 1.0 and col.max_value[2] == 9.0
+
+    def test_ordinal_column_sorted(self):
+        seg = self._seal([{"t": "b"}, {"t": "a"}, {"t": ["c", "a"]}])
+        col = seg.ordinal_columns["t.keyword"]
+        assert col.terms == ["a", "b", "c"]
+        assert col.ord_of("b") == 1
+        assert col.ord_of("zz") == -1
+        assert col.first_ord[1] == 0
+
+    def test_positions_stored(self):
+        seg = self._seal([{"body": "alpha beta alpha"}])
+        tid = seg.term_id("body", "alpha")
+        assert list(seg.positions[tid][0]) == [0, 2]
+
+    def test_terms_for_field(self):
+        seg = self._seal([{"a": "x y", "b": "z"}])
+        toks = [t for t, _ in seg.terms_for_field("a")]
+        assert toks == ["x", "y"]
+
+
+class TestEngine:
+    def test_index_refresh_visibility(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"title": "hello world"})
+        assert e.num_docs == 0  # not yet refreshed (NRT semantics)
+        assert e.buffered_docs == 1
+        e.refresh()
+        assert e.num_docs == 1
+
+    def test_realtime_get_sees_unrefreshed(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"v": 1})
+        g = e.get("1")
+        assert g.found and g.source == {"v": 1} and g.version == 1
+
+    def test_update_bumps_version_and_tombstones(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"v": 1})
+        e.refresh()
+        r = e.index("1", {"v": 2})
+        assert r["_version"] == 2 and r["result"] == "updated"
+        e.refresh()
+        assert e.num_docs == 1  # old copy tombstoned
+        assert e.get("1").source == {"v": 2}
+
+    def test_version_conflict(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"v": 1})
+        with pytest.raises(VersionConflictEngineException):
+            e.index("1", {"v": 2}, version=99)
+        with pytest.raises(VersionConflictEngineException):
+            e.index("1", {"v": 2}, op_type="create")
+
+    def test_delete(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"v": 1})
+        e.refresh()
+        r = e.delete("1")
+        assert r["result"] == "deleted"
+        assert not e.get("1").found
+        assert e.num_docs == 0
+        assert e.delete("nope")["result"] == "not_found"
+
+    def test_seqnos_monotonic(self, tmp_path):
+        e = make_engine(tmp_path)
+        for i in range(5):
+            e.index(str(i), {"i": i})
+        assert e.max_seqno == 4
+        assert e.local_checkpoint == 4
+
+    def test_force_merge_single_segment(self, tmp_path):
+        e = make_engine(tmp_path)
+        for i in range(3):
+            e.index(str(i), {"i": i})
+            e.refresh()
+        e.delete("1")
+        assert len(e.segments) == 3
+        e.force_merge()
+        assert len(e.segments) == 1
+        assert e.num_docs == 2
+        assert e.segments[0].num_docs == 2  # deletes expunged
+
+    def test_recover_from_translog(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"v": 1})
+        e.index("2", {"v": 2})
+        e.index("1", {"v": 10})
+        e.delete("2")
+        e.close()
+        # crash: new engine over the same translog, no flush happened
+        e2 = make_engine(tmp_path)
+        n = e2.recover_from_translog()
+        assert n == 4
+        assert e2.get("1").source == {"v": 10}
+        assert e2.get("1").version == 2
+        assert not e2.get("2").found
+        assert e2.num_docs == 1
+
+    def test_flush_then_recover_skips_committed(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("1", {"v": 1})
+        e.flush()
+        e.index("2", {"v": 2})
+        e.close()
+        e2 = make_engine(tmp_path)
+        e2.segments = e2.store.load_segments()
+        # rebuild version map from loaded segments (shard open path)
+        for seg in e2.segments:
+            for doc, doc_id in enumerate(seg.doc_ids):
+                if seg.live[doc]:
+                    from elasticsearch_tpu.index.engine import VersionEntry
+                    e2.version_map[doc_id] = VersionEntry(
+                        int(seg.versions[doc]), int(seg.seqnos[doc]), seg.name, doc
+                    )
+            e2.note_external_seqno(int(seg.seqnos.max()) if seg.num_docs else -1)
+        assert e2.recover_from_translog() == 1  # only the uncommitted op
+        assert e2.num_docs == 2
+
+
+class TestTranslog:
+    def test_append_and_snapshot(self, tmp_path):
+        tl = Translog(str(tmp_path))
+        tl.add(TranslogOp(TranslogOp.INDEX, 0, "1", {"a": 1}))
+        tl.add(TranslogOp(TranslogOp.DELETE, 1, "1"))
+        ops = tl.snapshot()
+        assert [o.op_type for o in ops] == ["index", "delete"]
+        assert ops[0].source == {"a": 1}
+
+    def test_generation_roll_and_trim(self, tmp_path):
+        tl = Translog(str(tmp_path))
+        tl.add(TranslogOp(TranslogOp.INDEX, 0, "1", {"a": 1}))
+        tl.roll_generation()
+        tl.add(TranslogOp(TranslogOp.INDEX, 1, "2", {"a": 2}))
+        assert tl.generation == 2
+        tl.mark_committed(0)  # gen-1 fully committed -> trimmed
+        assert len(tl.snapshot()) == 1
+        assert tl.uncommitted_ops()[0].doc_id == "2"
+
+    def test_reopen_preserves_state(self, tmp_path):
+        tl = Translog(str(tmp_path))
+        tl.add(TranslogOp(TranslogOp.INDEX, 7, "x", {}))
+        tl.close()
+        tl2 = Translog(str(tmp_path))
+        assert tl2.max_seqno == 7
+        assert len(tl2.snapshot()) == 1
+
+
+class TestStore:
+    def _segment(self):
+        svc = MapperService(AnalysisRegistry())
+        b = SegmentBuilder("seg_1")
+        b.add_document(svc.parse_document("a", {"body": "hello world", "n": 3}), 0)
+        b.add_document(svc.parse_document("b", {"body": "hello", "t": "tag"}), 1)
+        return b.seal()
+
+    def test_roundtrip(self, tmp_path):
+        st = Store(str(tmp_path))
+        seg = self._segment()
+        seg.delete_doc(1)
+        st.commit([seg], max_seqno=1, version_map=None)
+        loaded = st.load_segments()
+        assert len(loaded) == 1
+        l = loaded[0]
+        assert l.num_docs == 2
+        assert l.doc_ids == ["a", "b"]
+        assert not l.live[1]
+        assert l.term_id("body", "hello") == seg.term_id("body", "hello")
+        np.testing.assert_array_equal(l.block_docs, seg.block_docs)
+        assert l.numeric_columns["n"].first_value[0] == 3.0
+        assert l.ordinal_columns["t.keyword"].terms == ["tag"]
+        assert l.sources[0] == {"body": "hello world", "n": 3}
+        tid = l.term_id("body", "hello")
+        assert list(l.positions[tid][0]) == [0]
+
+    def test_corruption_detected(self, tmp_path):
+        st = Store(str(tmp_path))
+        seg = self._segment()
+        st.commit([seg], 1)
+        # flip bits in the arrays file
+        import os
+        p = os.path.join(str(tmp_path), "seg_1", "arrays.npz")
+        with open(p, "r+b") as f:
+            f.seek(100)
+            f.write(b"\xff\xff\xff")
+        with pytest.raises(CorruptIndexException):
+            st.read_segment("seg_1")
+
+    def test_commit_gc_removes_dropped_segments(self, tmp_path):
+        st = Store(str(tmp_path))
+        seg = self._segment()
+        st.commit([seg], 1)
+        import os
+        assert os.path.exists(os.path.join(str(tmp_path), "seg_1"))
+        st.commit([], 1)
+        assert not os.path.exists(os.path.join(str(tmp_path), "seg_1"))
