@@ -384,9 +384,11 @@ class SegmentBuilder:
         # --- ordinal (string) columns ---
         ordinal_columns = {}
         for f, pairs in self.string_values.items():
+            # dedupe (doc, value): SortedSetDocValues semantics — a doc holds
+            # each distinct value once (terms agg counts rely on this)
+            pairs = sorted(set(pairs), key=lambda p: p[0])
             terms = sorted({v for _, v in pairs})
             ord_map = {t: i for i, t in enumerate(terms)}
-            pairs.sort(key=lambda p: p[0])
             n_vals = len(pairs)
             cap = next_pow2(max(n_vals, 1))
             flat_docs = np.full(cap, nd_pad, dtype=np.int32)

@@ -1,0 +1,222 @@
+"""Aggregation kernels: segment-sum buckets, stats, HLL++ cardinality.
+
+Replaces the reference's per-doc collector tree
+(search/aggregations/AggregatorBase, BucketsAggregator,
+GlobalOrdinalsStringTermsAggregator, HyperLogLogPlusPlus) with dense
+scatter-add programs over the matched-doc mask:
+
+- terms agg     -> one-hot counts over the ordinal CSR column
+                   (GlobalOrdinalsStringTermsAggregator's ordinal-array
+                   counting, vectorized)
+- histogram     -> bucket-id computation + segment-sum
+- stats         -> masked reductions
+- cardinality   -> HLL++ register scatter-max (HyperLogLogPlusPlus.java's
+                   2^p registers in BigArrays ≙ a [2^p] int32 vector)
+
+Partials are associative, so cross-segment and cross-shard reduction is a
+plain elementwise combine — exactly the property the reference exploits in
+InternalAggregation.doReduce, here mapped onto psum-style tree reduction
+(SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Bucket aggs
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_ords",))
+def ordinal_counts(flat_docs, flat_ords, mask, n_ords: int):
+    """Per-ordinal doc counts over matched docs (terms agg heart).
+
+    mask: [nd1] bool (matched & live). Multi-valued docs count once per
+    distinct value (matches the reference: a doc adds 1 to each of its
+    ordinals' buckets).
+    """
+    contrib = mask[flat_docs].astype(jnp.int32)
+    return jnp.zeros((n_ords,), jnp.int32).at[flat_ords].add(contrib, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("n_ords",))
+def ordinal_sums(flat_docs, flat_ords, mask, values_by_doc, n_ords: int):
+    """Sum of a per-doc metric value, bucketed by ordinal (terms + sub-sum)."""
+    contrib = jnp.where(mask[flat_docs], values_by_doc[flat_docs], 0.0)
+    return jnp.zeros((n_ords,), jnp.float64).at[flat_ords].add(contrib, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("n_buckets",))
+def histogram_counts(flat_docs, flat_values, mask, interval, offset, min_bucket_key,
+                     n_buckets: int):
+    """Fixed-interval histogram: bucket = floor((v - offset)/interval),
+    rebased by min_bucket_key; out-of-range values drop (callers size the
+    bucket range from segment min/max so nothing real drops)."""
+    bucket = jnp.floor((flat_values - offset) / interval).astype(jnp.int64) - min_bucket_key
+    valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
+    contrib = valid.astype(jnp.int32)
+    bucket = jnp.clip(bucket, 0, n_buckets - 1)
+    return jnp.zeros((n_buckets,), jnp.int32).at[bucket].add(contrib)
+
+
+@functools.partial(jax.jit, static_argnames=("n_ranges",))
+def range_counts(flat_docs, flat_values, mask, lo, hi, n_ranges: int):
+    """Counts per [lo_i, hi_i) range (range agg; ranges may overlap).
+    lo/hi: [n_ranges] float64. Counts DOCS (not values): a doc lands in a
+    range once even if several of its values do."""
+    nd1 = mask.shape[0]
+    in_range = (flat_values[None, :] >= lo[:, None]) & (flat_values[None, :] < hi[:, None])
+    # per-range doc mask via scatter-or, then masked popcount
+    def one(r_mask):
+        per_doc = jnp.zeros((nd1,), bool).at[flat_docs].max(r_mask)
+        return jnp.sum((per_doc & mask).astype(jnp.int32))
+
+    return jax.vmap(one)(in_range)
+
+
+@functools.partial(jax.jit, static_argnames=("n_buckets",))
+def value_histogram_sums(flat_docs, flat_values, metric_by_doc, mask, interval,
+                         offset, min_bucket_key, n_buckets: int):
+    """Sum of a per-doc metric grouped by histogram bucket of this field."""
+    bucket = jnp.floor((flat_values - offset) / interval).astype(jnp.int64) - min_bucket_key
+    valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
+    contrib = jnp.where(valid, metric_by_doc[flat_docs], 0.0)
+    bucket = jnp.clip(bucket, 0, n_buckets - 1)
+    return jnp.zeros((n_buckets,), jnp.float64).at[bucket].add(contrib)
+
+
+# ---------------------------------------------------------------------------
+# Metric aggs
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def numeric_stats(flat_docs, flat_values, valid, mask):
+    """(count, sum, min, max, sum_of_squares) over values of matched docs.
+
+    valid: [n_vals] bool — real (non-padding) CSR entries.
+    """
+    sel = valid & mask[flat_docs]
+    vals = jnp.where(sel, flat_values, 0.0)
+    count = jnp.sum(sel.astype(jnp.int64))
+    total = jnp.sum(vals)
+    sq = jnp.sum(vals * vals)
+    vmin = jnp.min(jnp.where(sel, flat_values, jnp.inf))
+    vmax = jnp.max(jnp.where(sel, flat_values, -jnp.inf))
+    return count, total, vmin, vmax, sq
+
+
+@jax.jit
+def value_count(flat_docs, valid, mask):
+    return jnp.sum((valid & mask[flat_docs]).astype(jnp.int64))
+
+
+# --- HyperLogLog++ ---------------------------------------------------------
+
+HLL_DEFAULT_PRECISION = 14  # ES default precision_threshold≈3000 -> p≈14
+
+
+def _fmix64(h):
+    h = h.astype(jnp.uint64)
+    h ^= h >> 33
+    h *= jnp.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> 33
+    h *= jnp.uint64(0xC4CEB9FE1A85EC53)
+    h ^= h >> 33
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def hll_registers(flat_docs, hashes, valid, mask, precision: int = HLL_DEFAULT_PRECISION):
+    """Build HLL++ registers from per-value 64-bit hashes.
+
+    hashes: [n_vals] uint64 (precomputed per ordinal/value, see
+    hash_numeric_values / OrdinalColumn hashing at seal).
+    Register j = max over values with bucket j of (position of first set
+    bit of the remaining hash bits).
+    """
+    m = 1 << precision
+    sel = valid & mask[flat_docs]
+    h = _fmix64(hashes)
+    bucket = (h >> jnp.uint64(64 - precision)).astype(jnp.int32)
+    rest = (h << jnp.uint64(precision)) | jnp.uint64(1 << (precision - 1))
+    # rho = number of leading zeros of `rest` + 1
+    rho = (_clz64(rest) + 1).astype(jnp.int32)
+    rho = jnp.where(sel, rho, 0)
+    bucket = jnp.where(sel, bucket, 0)
+    return jnp.zeros((m,), jnp.int32).at[bucket].max(rho)
+
+
+def _clz64(x):
+    x = x.astype(jnp.uint64)
+    n = jnp.zeros(x.shape, jnp.int32)
+    for shift in (32, 16, 8, 4, 2, 1):
+        mask = x >= (jnp.uint64(1) << jnp.uint64(64 - shift))
+        # if the top `shift` bits are empty, shift left and count
+        empty = x < (jnp.uint64(1) << jnp.uint64(64 - shift))
+        n = n + jnp.where(empty, shift, 0)
+        x = jnp.where(empty, x << jnp.uint64(shift), x)
+        del mask
+    return jnp.where(x == 0, 64, n)
+
+
+@jax.jit
+def hll_merge(regs_a, regs_b):
+    """Associative register merge (cross-segment / cross-shard reduce)."""
+    return jnp.maximum(regs_a, regs_b)
+
+
+def hll_estimate(registers: np.ndarray) -> float:
+    """Harmonic-mean estimate with small-range correction (host-side; the
+    reference's HyperLogLogPlusPlus.cardinality())."""
+    regs = np.asarray(registers)
+    m = regs.shape[0]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    est = alpha * m * m / np.sum(np.power(2.0, -regs.astype(np.float64)))
+    zeros = int(np.sum(regs == 0))
+    if est <= 2.5 * m and zeros > 0:
+        est = m * np.log(m / zeros)  # linear counting
+    return float(est)
+
+
+def hash_numeric_values(values: np.ndarray) -> np.ndarray:
+    """Host-side 64-bit hashing of numeric values for HLL (at query time,
+    once per segment column; cached). Uses the float64 bit pattern."""
+    bits = np.asarray(values, dtype=np.float64).view(np.uint64)
+    h = bits.copy()
+    h ^= h >> 33
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> 33
+    return h
+
+
+def hash_string_values(terms) -> np.ndarray:
+    """Hash a term dictionary (ordinal -> hash) for HLL over keywords."""
+    import hashlib
+
+    out = np.empty(len(terms), dtype=np.uint64)
+    for i, t in enumerate(terms):
+        out[i] = np.frombuffer(
+            hashlib.blake2b(t.encode("utf-8"), digest_size=8).digest(), dtype=np.uint64
+        )[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Percentiles (TDigest-lite: exact-on-device histogram of matched values is
+# impractical for float ranges; we collect a bounded sample + exact small-n)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def masked_values_for_sample(flat_docs, flat_values, valid, mask):
+    """Values of matched docs with -inf elsewhere; host draws the sample/
+    sorts exactly. For large segments a Pallas reservoir kernel replaces
+    this (future work)."""
+    sel = valid & mask[flat_docs]
+    return jnp.where(sel, flat_values, jnp.nan)

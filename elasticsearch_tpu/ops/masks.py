@@ -1,0 +1,89 @@
+"""Filter evaluation as dense boolean masks over doc-value columns.
+
+Replaces the reference's filter clauses / Lucene filter iterators
+(bool filter context, range queries via BKD trees, exists via
+``_field_names``) with vector comparisons + scatter-or over the columnar
+CSR doc values (segment.NumericColumn / OrdinalColumn). A filter never
+touches postings; it is a pure doc-value computation, which XLA fuses into
+the scoring program.
+
+All masks are ``[nd1] bool`` where nd1 = nd_pad + 1; the sentinel slot
+(last) may receive padding writes and is excluded by the live mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def numeric_range_mask(flat_docs, flat_values, lo, hi, nd1_arr):
+    """Docs with ANY value in [lo, hi] (CSR scatter-or).
+
+    nd1_arr: zeros([nd1], bool) template (carries the static shape).
+    """
+    cond = (flat_values >= lo) & (flat_values <= hi)
+    return nd1_arr.at[flat_docs].max(cond)
+
+
+@jax.jit
+def numeric_term_mask(flat_docs, flat_values, value, nd1_arr):
+    return nd1_arr.at[flat_docs].max(flat_values == value)
+
+
+@jax.jit
+def numeric_terms_mask(flat_docs, flat_values, values, nd1_arr):
+    """Docs with any value in the given set ([K] padded with NaN)."""
+    cond = (flat_values[:, None] == values[None, :]).any(axis=1)
+    return nd1_arr.at[flat_docs].max(cond)
+
+
+@jax.jit
+def ord_range_mask(flat_docs, flat_ords, lo_ord, hi_ord, nd1_arr):
+    """Keyword range as a half-open ordinal interval [lo_ord, hi_ord)."""
+    cond = (flat_ords >= lo_ord) & (flat_ords < hi_ord)
+    return nd1_arr.at[flat_docs].max(cond)
+
+
+@jax.jit
+def ord_terms_mask(flat_docs, flat_ords, ords, nd1_arr):
+    """Docs with any ordinal in the set ([K] int32 padded with -1)."""
+    cond = (flat_ords[:, None] == ords[None, :]).any(axis=1)
+    return nd1_arr.at[flat_docs].max(cond)
+
+
+@jax.jit
+def geo_bounding_box_mask(flat_docs, lat, lon, top, left, bottom, right, nd1_arr):
+    cond = (lat <= top) & (lat >= bottom)
+    # handle boxes crossing the antimeridian
+    crosses = left > right
+    in_lon = jnp.where(crosses, (lon >= left) | (lon <= right),
+                       (lon >= left) & (lon <= right))
+    return nd1_arr.at[flat_docs].max(cond & in_lon)
+
+
+_EARTH_RADIUS_M = 6371008.8
+
+
+@jax.jit
+def haversine_distance_m(lat1, lon1, lat2, lon2):
+    rl1, rl2 = jnp.radians(lat1), jnp.radians(lat2)
+    dlat = rl2 - rl1
+    dlon = jnp.radians(lon2 - lon1)
+    a = jnp.sin(dlat / 2) ** 2 + jnp.cos(rl1) * jnp.cos(rl2) * jnp.sin(dlon / 2) ** 2
+    return 2 * _EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(a))
+
+
+@jax.jit
+def geo_distance_mask(flat_docs, lat, lon, center_lat, center_lon, radius_m, nd1_arr):
+    d = haversine_distance_m(lat, lon, center_lat, center_lon)
+    return nd1_arr.at[flat_docs].max(d <= radius_m)
+
+
+@functools.partial(jax.jit, static_argnames=("nd1",))
+def docs_mask(doc_indices, nd1: int):
+    """Mask from explicit local doc ids (ids query; padded with nd1-1)."""
+    return jnp.zeros((nd1,), bool).at[doc_indices].set(True)

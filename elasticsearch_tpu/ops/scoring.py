@@ -1,0 +1,143 @@
+"""BM25 scoring kernels over block-packed postings.
+
+This replaces the reference's per-segment hot loop — Lucene's
+``BulkScorer``/BM25 scoring inside ``searcher.search(query, collector)``
+(search/query/QueryPhase.java:272) — with one fused XLA program:
+
+    gather posting blocks -> BM25 contributions -> scatter-add dense scores
+
+The dense score accumulator (``[nd_pad + 1]``, sentinel slot last) makes
+disjunctions, conjunction counting and filter masking pure vector ops; the
+MXU/VPU see large, static-shaped elementwise work instead of branchy
+posting iteration. Scoring is *exhaustive* (every posting scored), which on
+TPU is faster than WAND-style skipping for all but pathological terms and
+guarantees recall@k = 1.0 vs the scalar reference (BASELINE.md gate).
+
+All functions here are shape-polymorphic jit targets; callers bucket
+shapes (see search/execute.py) so programs cache across queries.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Lucene 7 BM25 defaults (index/similarity/SimilarityService.java — BM25 default)
+K1 = 1.2
+B = 0.75
+
+
+def bm25_idf(doc_freq, doc_count):
+    """Lucene BM25Similarity.idfExplain: ln(1 + (N - df + 0.5)/(df + 0.5))."""
+    import math
+
+    return math.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5))
+
+
+@functools.partial(jax.jit, static_argnames=("k1", "b"))
+def score_term_blocks(
+    block_docs,  # [n_blocks, BLOCK] int32 — segment postings matrix
+    block_tfs,  # [n_blocks, BLOCK] float32
+    norms,  # [n_norm_fields, nd_pad + 1] float32 — per-field doc lengths
+    q_blocks,  # [QB] int32 — indices of this query's posting blocks
+    q_weights,  # [QB] float32 — idf * boost per block (0 for padding)
+    q_norm_rows,  # [QB] int32 — norm row (field) per block
+    q_avgdl,  # [QB] float32 — average field length per block
+    q_valid,  # [QB] bool — False for padding lanes (gates match counting)
+    k1: float = K1,
+    b: float = B,
+):
+    """Score a weighted disjunction of terms; also count distinct matched
+    terms per doc (for operator=and / minimum_should_match).
+
+    Returns (scores [nd1] f32, match_counts [nd1] f32); nd1 = nd_pad + 1,
+    the last slot collecting all padding writes (discarded by callers).
+    """
+    docs = block_docs[q_blocks]  # [QB, BLOCK]
+    tfs = block_tfs[q_blocks]  # [QB, BLOCK]
+    doc_len = norms[q_norm_rows[:, None], docs]  # [QB, BLOCK]
+    denom = tfs + k1 * (1.0 - b + b * doc_len / q_avgdl[:, None])
+    contrib = q_weights[:, None] * tfs * (k1 + 1.0) / denom
+    matched = (tfs > 0.0) & q_valid[:, None]
+    contrib = jnp.where(matched, contrib, 0.0)
+    nd1 = norms.shape[1]
+    scores = jnp.zeros((nd1,), jnp.float32).at[docs].add(
+        contrib, mode="drop", unique_indices=False
+    )
+    counts = jnp.zeros((nd1,), jnp.float32).at[docs].add(
+        matched.astype(jnp.float32), mode="drop"
+    )
+    return scores, counts
+
+
+@functools.partial(jax.jit, static_argnames=("k1", "b", "num_fields"))
+def score_term_blocks_bm25f(
+    block_docs,
+    block_tfs,
+    norms,
+    q_blocks,
+    q_weights,
+    q_norm_rows,
+    q_avgdl,
+    q_valid,
+    q_field_boosts,  # [QB] f32 — per-field weight for BM25F-style combining
+    num_fields: int = 1,
+    k1: float = K1,
+    b: float = B,
+):
+    """Multi-field variant: per-field boosts fold into the term weight
+    (cross_fields-style combining for multi_match / more_like_this)."""
+    return score_term_blocks(
+        block_docs, block_tfs, norms, q_blocks,
+        q_weights * q_field_boosts, q_norm_rows, q_avgdl, q_valid, k1=k1, b=b,
+    )
+
+
+@jax.jit
+def constant_score(matched, boost):
+    return jnp.where(matched, boost, 0.0).astype(jnp.float32)
+
+
+@jax.jit
+def combine_should(scores_list, matched_list, min_should_match):
+    """Sum scores of matching 'should' clauses; matched when at least
+    min_should_match clauses matched (BooleanQuery semantics)."""
+    total = jnp.zeros_like(scores_list[0])
+    count = jnp.zeros_like(scores_list[0])
+    for s, m in zip(scores_list, matched_list):
+        total = total + jnp.where(m, s, 0.0)
+        count = count + m.astype(jnp.float32)
+    return total, count >= min_should_match
+
+
+def select_topk(scores, matched, live1, k: int):
+    """Final selection: mask out non-matching/deleted docs, take top-k by
+    score with index tiebreak (ascending doc id, like Lucene's collector).
+
+    Returns (top_scores [k], top_docs [k]); non-matching slots have
+    score = -inf.
+    """
+    masked = jnp.where(matched & live1, scores, -jnp.inf)
+    k = min(k, masked.shape[0])
+    top_scores, top_docs = lax.top_k(masked, k)
+    return top_scores, top_docs
+
+
+select_topk = functools.partial(jax.jit, static_argnames=("k",))(select_topk)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def select_topk_by_key(sort_keys, matched, live1, k: int):
+    """Top-k by an arbitrary sort key (field sort). Keys must already be
+    oriented so that larger = better (callers negate for ascending)."""
+    masked = jnp.where(matched & live1, sort_keys, -jnp.inf)
+    k = min(k, masked.shape[0])
+    return lax.top_k(masked, k)
+
+
+@jax.jit
+def count_matches(matched, live1):
+    return jnp.sum((matched & live1).astype(jnp.int32))

@@ -1,0 +1,78 @@
+"""Scalar reference implementations for golden-value tests.
+
+The kernels in elasticsearch_tpu/ops must agree with these simple,
+obviously-correct Python loops (the reference's behavior re-derived from
+Lucene BM25Similarity / aggregation semantics). SURVEY.md §7.2.3: kernels
+are gated on recall parity vs a scalar reference scorer.
+"""
+
+import math
+from collections import defaultdict
+
+K1 = 1.2
+B = 0.75
+
+
+def bm25_idf(df, doc_count):
+    return math.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+
+
+def score_corpus(docs_tokens, query_terms, k1=K1, b=B):
+    """docs_tokens: list[list[str]]; returns {doc: score} for docs matching
+    ANY query term (disjunction), plus {doc: n_matched_terms}."""
+    n = len(docs_tokens)
+    postings = defaultdict(dict)  # term -> {doc: tf}
+    for d, toks in enumerate(docs_tokens):
+        for t in toks:
+            postings[t][d] = postings[t].get(d, 0) + 1
+    doc_len = [len(t) for t in docs_tokens]
+    with_field = [d for d in range(n) if doc_len[d] > 0]
+    avgdl = max(sum(doc_len) / max(len(with_field), 1), 1.0) if with_field else 1.0
+    doc_count = len(with_field)
+    scores = defaultdict(float)
+    matched = defaultdict(int)
+    for term in query_terms:
+        plist = postings.get(term)
+        if not plist:
+            continue
+        idf = bm25_idf(len(plist), doc_count)
+        for d, tf in plist.items():
+            denom = tf + k1 * (1 - b + b * doc_len[d] / avgdl)
+            scores[d] += idf * tf * (k1 + 1) / denom
+            matched[d] += 1
+    return dict(scores), dict(matched)
+
+
+def top_k(scores, k):
+    """Sorted (score desc, doc asc) top-k list of (doc, score)."""
+    return sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+
+
+def terms_agg(docs_values, mask):
+    """docs_values: list[list[str]] per doc; mask: matched docs set."""
+    counts = defaultdict(int)
+    for d in mask:
+        for v in set(docs_values[d]):
+            counts[v] += 1
+    return dict(counts)
+
+
+def histogram_agg(docs_values, mask, interval, offset=0.0):
+    counts = defaultdict(int)
+    for d in mask:
+        for v in docs_values[d]:
+            counts[math.floor((v - offset) / interval)] += 1
+    return dict(counts)
+
+
+def stats_agg(docs_values, mask):
+    vals = [v for d in mask for v in docs_values[d]]
+    if not vals:
+        return {"count": 0, "sum": 0.0, "min": None, "max": None}
+    return {
+        "count": len(vals),
+        "sum": sum(vals),
+        "min": min(vals),
+        "max": max(vals),
+        "avg": sum(vals) / len(vals),
+    }

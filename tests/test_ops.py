@@ -1,0 +1,277 @@
+"""Golden-value tests: TPU kernels vs scalar reference implementations.
+
+Seeded-random corpora (the reference's randomized testing discipline,
+SURVEY.md §4.1) — scoring must match the scalar BM25 to float tolerance
+and top-k ordering must match exactly (recall@k = 1.0).
+"""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+import golden
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.mapper.mapping import MapperService
+from elasticsearch_tpu.ops import aggs as agg_ops
+from elasticsearch_tpu.ops import masks as mask_ops
+from elasticsearch_tpu.ops import scoring
+
+import jax.numpy as jnp
+
+VOCAB = [f"w{i}" for i in range(50)]
+
+
+def random_corpus(rng, n_docs, max_len=30):
+    return [
+        [rng.choice(VOCAB) for _ in range(rng.randint(1, max_len))]
+        for _ in range(n_docs)
+    ]
+
+
+def build_segment(docs_tokens):
+    svc = MapperService(
+        AnalysisRegistry(), {"properties": {"body": {"type": "text", "analyzer": "whitespace"}}}
+    )
+    b = SegmentBuilder("s")
+    for i, toks in enumerate(docs_tokens):
+        b.add_document(svc.parse_document(str(i), {"body": " ".join(toks)}), i)
+    return b.seal()
+
+
+def query_arrays(seg, field, terms, qb_pad=8):
+    """Host-side query planning: term lookup -> block gather arrays."""
+    blocks, weights, rows, avgdls = [], [], [], []
+    doc_count = seg.field_stats.get(field, {}).get("doc_count", 0)
+    avgdl = seg.field_avgdl(field)
+    row = seg.field_norm_idx.get(field, 0)
+    for t in terms:
+        tid = seg.term_id(field, t)
+        if tid < 0:
+            continue
+        idf = scoring.bm25_idf(int(seg.term_doc_freq[tid]), doc_count)
+        start, cnt = int(seg.term_block_start[tid]), int(seg.term_block_count[tid])
+        for bi in range(start, start + cnt):
+            blocks.append(bi)
+            weights.append(idf)
+            rows.append(row)
+            avgdls.append(avgdl)
+    qb = max(qb_pad, 1)
+    while qb < len(blocks):
+        qb *= 2
+    pad = qb - len(blocks)
+    return (
+        jnp.asarray(np.array(blocks + [0] * pad, dtype=np.int32)),
+        jnp.asarray(np.array(weights + [0.0] * pad, dtype=np.float32)),
+        jnp.asarray(np.array(rows + [0] * pad, dtype=np.int32)),
+        jnp.asarray(np.array(avgdls + [1.0] * pad, dtype=np.float32)),
+        jnp.asarray(np.array([True] * len(blocks) + [False] * pad)),
+    )
+
+
+def run_query(seg, terms, field="body"):
+    dev = seg.device_arrays()
+    qb, qw, qr, qa, qv = query_arrays(seg, field, terms)
+    scores, counts = scoring.score_term_blocks(
+        dev["block_docs"], dev["block_tfs"], dev["norms"], qb, qw, qr, qa, qv
+    )
+    return np.asarray(scores), np.asarray(counts)
+
+
+class TestBM25Golden:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_scores_match_scalar_reference(self, seed):
+        rng = random.Random(seed)
+        docs = random_corpus(rng, rng.randint(5, 200))
+        q = [rng.choice(VOCAB) for _ in range(rng.randint(1, 4))]
+        q = list(dict.fromkeys(q))  # unique terms
+        seg = build_segment(docs)
+        scores, counts = run_query(seg, q)
+        ref_scores, ref_matched = golden.score_corpus(docs, q)
+        for d in range(len(docs)):
+            assert scores[d] == pytest.approx(ref_scores.get(d, 0.0), rel=1e-5, abs=1e-6)
+            assert counts[d] == ref_matched.get(d, 0)
+
+    def test_topk_ordering_exact(self):
+        rng = random.Random(42)
+        docs = random_corpus(rng, 500)
+        q = ["w0", "w1", "w2"]
+        seg = build_segment(docs)
+        scores, counts = run_query(seg, q)
+        dev = seg.device_arrays()
+        live1 = jnp.concatenate([dev["live"], jnp.zeros(1, bool)])
+        top_scores, top_docs = scoring.select_topk(
+            jnp.asarray(scores), jnp.asarray(counts) > 0, live1, 10
+        )
+        ref_scores, _ = golden.score_corpus(docs, q)
+        ref_top = golden.top_k(ref_scores, 10)
+        got = [(int(d), float(s)) for s, d in zip(top_scores, top_docs) if s > -np.inf]
+        # same doc set and same score ordering (ties may permute)
+        assert {d for d, _ in got} == {d for d, _ in ref_top}
+        got_scores = [s for _, s in got]
+        assert got_scores == sorted(got_scores, reverse=True)
+        for (d, s), (rd, rs) in zip(got, ref_top):
+            assert s == pytest.approx(dict(ref_top)[d], rel=1e-5)
+
+    def test_conjunction_counting(self):
+        docs = [["a", "b"], ["a"], ["b"], ["a", "b", "c"]]
+        seg = build_segment(docs)
+        scores, counts = run_query(seg, ["a", "b"])
+        # operator=and --> count == 2
+        assert [int(c) for c in counts[:4]] == [2, 1, 1, 2]
+
+    def test_multi_block_term(self):
+        # term spanning >1 posting block still scores every doc once
+        docs = [["common"] for _ in range(300)]
+        seg = build_segment(docs)
+        scores, counts = run_query(seg, ["common"])
+        assert (counts[:300] == 1).all()
+        assert np.allclose(scores[:300], scores[0])
+
+    def test_idf_formula(self):
+        assert scoring.bm25_idf(1, 2) == pytest.approx(math.log(1 + 1.5 / 1.5))
+
+
+class TestMasks:
+    def _col_segment(self):
+        svc = MapperService(AnalysisRegistry())
+        b = SegmentBuilder("s")
+        vals = [5, 15, 25, 35, 10]
+        for i, v in enumerate(vals):
+            b.add_document(svc.parse_document(str(i), {"price": v, "tag": f"t{i % 2}"}), i)
+        return b.seal(), vals
+
+    def test_numeric_range(self):
+        seg, vals = self._col_segment()
+        col = seg.numeric_columns["price"]
+        nd1 = jnp.zeros(seg.nd_pad + 1, bool)
+        m = np.asarray(mask_ops.numeric_range_mask(
+            jnp.asarray(col.flat_docs), jnp.asarray(col.flat_values), 10.0, 30.0, nd1
+        ))
+        expect = [10 <= v <= 30 for v in vals]
+        assert list(m[:5]) == expect
+
+    def test_ord_terms(self):
+        seg, _ = self._col_segment()
+        col = seg.ordinal_columns["tag.keyword"]
+        nd1 = jnp.zeros(seg.nd_pad + 1, bool)
+        t0 = col.ord_of("t0")
+        m = np.asarray(mask_ops.ord_terms_mask(
+            jnp.asarray(col.flat_docs), jnp.asarray(col.flat_ords),
+            jnp.asarray(np.array([t0, -1], dtype=np.int32)), nd1
+        ))
+        assert list(m[:5]) == [True, False, True, False, True]
+
+    def test_geo_distance(self):
+        svc = MapperService(AnalysisRegistry(), {"properties": {"loc": {"type": "geo_point"}}})
+        b = SegmentBuilder("s")
+        pts = [(48.8566, 2.3522), (51.5074, -0.1278), (48.86, 2.35)]  # paris, london, paris2
+        for i, (la, lo) in enumerate(pts):
+            b.add_document(svc.parse_document(str(i), {"loc": {"lat": la, "lon": lo}}), i)
+        seg = b.seal()
+        col = seg.geo_columns["loc"]
+        nd1 = jnp.zeros(seg.nd_pad + 1, bool)
+        m = np.asarray(mask_ops.geo_distance_mask(
+            jnp.asarray(col.flat_docs), jnp.asarray(col.lat), jnp.asarray(col.lon),
+            48.8566, 2.3522, 10_000.0, nd1
+        ))
+        assert list(m[:3]) == [True, False, True]
+
+
+class TestAggOps:
+    def test_ordinal_counts_match_golden(self):
+        rng = random.Random(7)
+        docs_vals = [[rng.choice(["a", "b", "c"]) for _ in range(rng.randint(1, 3))]
+                     for _ in range(100)]
+        svc = MapperService(AnalysisRegistry())
+        b = SegmentBuilder("s")
+        for i, vs in enumerate(docs_vals):
+            b.add_document(svc.parse_document(str(i), {"tag": vs}), i)
+        seg = b.seal()
+        col = seg.ordinal_columns["tag.keyword"]
+        matched_docs = set(range(0, 100, 2))
+        mask = np.zeros(seg.nd_pad + 1, dtype=bool)
+        for d in matched_docs:
+            mask[d] = True
+        counts = np.asarray(agg_ops.ordinal_counts(
+            jnp.asarray(col.flat_docs), jnp.asarray(col.flat_ords),
+            jnp.asarray(mask), len(col.terms)
+        ))
+        ref = golden.terms_agg(docs_vals, matched_docs)
+        got = {col.terms[i]: int(c) for i, c in enumerate(counts) if c > 0}
+        assert got == ref
+
+    def test_histogram_matches_golden(self):
+        rng = random.Random(9)
+        docs_vals = [[rng.uniform(0, 100)] for _ in range(200)]
+        svc = MapperService(AnalysisRegistry())
+        b = SegmentBuilder("s")
+        for i, vs in enumerate(docs_vals):
+            b.add_document(svc.parse_document(str(i), {"x": vs[0]}), i)
+        seg = b.seal()
+        col = seg.numeric_columns["x"]
+        mask = np.zeros(seg.nd_pad + 1, dtype=bool)
+        mask[:200] = True
+        interval = 10.0
+        counts = np.asarray(agg_ops.histogram_counts(
+            jnp.asarray(col.flat_docs), jnp.asarray(col.flat_values),
+            jnp.asarray(mask), interval, 0.0, 0, 16
+        ))
+        ref = golden.histogram_agg(docs_vals, set(range(200)), interval)
+        got = {i: int(c) for i, c in enumerate(counts) if c > 0}
+        assert got == ref
+
+    def test_stats(self):
+        svc = MapperService(AnalysisRegistry())
+        b = SegmentBuilder("s")
+        vals = [3.0, 7.0, 1.0, 9.0]
+        for i, v in enumerate(vals):
+            b.add_document(svc.parse_document(str(i), {"x": v}), i)
+        seg = b.seal()
+        col = seg.numeric_columns["x"]
+        mask = np.zeros(seg.nd_pad + 1, dtype=bool)
+        mask[:3] = True  # only docs 0..2
+        valid = np.arange(len(col.flat_docs)) < col.count
+        count, total, vmin, vmax, sq = agg_ops.numeric_stats(
+            jnp.asarray(col.flat_docs), jnp.asarray(col.flat_values),
+            jnp.asarray(valid), jnp.asarray(mask)
+        )
+        assert int(count) == 3
+        assert float(total) == 11.0
+        assert float(vmin) == 1.0 and float(vmax) == 7.0
+
+    def test_hll_cardinality_accuracy(self):
+        rng = np.random.RandomState(3)
+        n_unique = 5000
+        values = rng.choice(n_unique, size=20000).astype(np.float64)
+        hashes = agg_ops.hash_numeric_values(values)
+        docs = np.arange(len(values), dtype=np.int32)
+        mask = np.ones(len(values) + 1, dtype=bool)
+        valid = np.ones(len(values), dtype=bool)
+        regs = agg_ops.hll_registers(
+            jnp.asarray(docs), jnp.asarray(hashes), jnp.asarray(valid), jnp.asarray(mask)
+        )
+        est = agg_ops.hll_estimate(np.asarray(regs))
+        true_card = len(np.unique(values))
+        assert abs(est - true_card) / true_card < 0.05  # HLL p=14 ~0.8% typical
+
+    def test_hll_merge_associative(self):
+        rng = np.random.RandomState(4)
+        a_vals = rng.choice(1000, 5000).astype(np.float64)
+        b_vals = (rng.choice(1000, 5000) + 500).astype(np.float64)
+
+        def regs_of(vals):
+            h = agg_ops.hash_numeric_values(vals)
+            docs = np.arange(len(vals), dtype=np.int32)
+            return agg_ops.hll_registers(
+                jnp.asarray(docs), jnp.asarray(h),
+                jnp.asarray(np.ones(len(vals), bool)),
+                jnp.asarray(np.ones(len(vals) + 1, bool)),
+            )
+
+        merged = agg_ops.hll_merge(regs_of(a_vals), regs_of(b_vals))
+        est = agg_ops.hll_estimate(np.asarray(merged))
+        true_card = len(np.unique(np.concatenate([a_vals, b_vals])))
+        assert abs(est - true_card) / true_card < 0.05
