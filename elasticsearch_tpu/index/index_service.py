@@ -1,0 +1,235 @@
+"""IndexService: one index = N shards + mapping + routing + search fan-out.
+
+Role model: ``IndexService`` (core/.../index/IndexService.java) for shard
+ownership, ``OperationRouting`` (cluster/routing/OperationRouting.java:232)
+for doc->shard routing, and ``TransportSearchAction`` +
+``SearchPhaseController`` for the scatter-gather + merge. In the
+single-node path the "network boundary" between coordinator and shards is
+a method call; the distributed path (parallel/) replaces the per-shard
+loop with a shard_map over a device mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.common.errors import DocumentMissingException
+from elasticsearch_tpu.common.settings import (
+    INDEX_NUMBER_OF_REPLICAS,
+    INDEX_NUMBER_OF_SHARDS,
+    INDEX_TRANSLOG_DURABILITY,
+    Settings,
+)
+from elasticsearch_tpu.index.shard import IndexShard
+from elasticsearch_tpu.mapper.mapping import MapperService
+from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggregations
+from elasticsearch_tpu.search.service import fetch_hits, merge_refs, normalize_sort
+from elasticsearch_tpu.utils.murmur3 import shard_id_for
+
+
+class IndexService:
+    def __init__(self, name: str, settings: Settings = Settings.EMPTY,
+                 mapping: Optional[dict] = None, data_path: Optional[str] = None):
+        self.name = name
+        self.settings = settings
+        self.creation_date = int(time.time() * 1000)
+        self.uuid = f"{name}-{self.creation_date:x}"
+        self.num_shards = INDEX_NUMBER_OF_SHARDS.get(settings)
+        self.num_replicas = INDEX_NUMBER_OF_REPLICAS.get(settings)
+        self.analyzers = AnalysisRegistry(settings)
+        self.mapper_service = MapperService(self.analyzers, mapping)
+        self.data_path = data_path
+        durability = INDEX_TRANSLOG_DURABILITY.get(settings)
+        self.shards: Dict[int, IndexShard] = {}
+        for sid in range(self.num_shards):
+            shard_path = os.path.join(data_path, str(sid)) if data_path else None
+            shard = IndexShard(name, sid, self.mapper_service, shard_path,
+                               durability=durability)
+            if shard_path and shard.engine.store.read_commit() is not None:
+                shard.recover_from_store()
+            elif shard_path and os.path.exists(
+                os.path.join(shard_path, "translog", "translog.ckp")
+            ):
+                shard.recover_from_store()
+            else:
+                shard.start_fresh()
+            self.shards[sid] = shard
+
+    # ------------------------------------------------------------------
+    # Routing + document ops
+    # ------------------------------------------------------------------
+
+    def _route(self, doc_id: str, routing: Optional[str] = None) -> int:
+        return shard_id_for(routing if routing is not None else doc_id,
+                            self.num_shards)
+
+    def index_doc(self, doc_id: str, source: dict, routing: Optional[str] = None,
+                  **kw) -> dict:
+        shard = self.shards[self._route(doc_id, routing)]
+        return shard.index_doc(doc_id, source, routing, **kw)
+
+    def get_doc(self, doc_id: str, routing: Optional[str] = None):
+        shard = self.shards[self._route(doc_id, routing)]
+        return shard.get_doc(doc_id)
+
+    def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kw) -> dict:
+        shard = self.shards[self._route(doc_id, routing)]
+        return shard.delete_doc(doc_id, **kw)
+
+    def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None) -> dict:
+        """Update API (action/update/TransportUpdateAction): partial doc
+        merge, upsert, doc_as_upsert; scripted updates support the
+        bucket-script expression subset."""
+        shard = self.shards[self._route(doc_id, routing)]
+        existing = shard.get_doc(doc_id)
+        if not existing.found:
+            if body.get("doc_as_upsert") and "doc" in body:
+                return shard.index_doc(doc_id, body["doc"], routing)
+            if "upsert" in body:
+                return shard.index_doc(doc_id, body["upsert"], routing)
+            raise DocumentMissingException(self.name, doc_id)
+        if "doc" in body:
+            merged = _deep_merge(dict(existing.source), body["doc"])
+            if merged == existing.source and body.get("detect_noop", True):
+                return {
+                    "_index": self.name, "_id": doc_id,
+                    "_version": existing.version, "result": "noop",
+                }
+            return shard.index_doc(doc_id, merged, routing)
+        raise DocumentMissingException(self.name, doc_id)
+
+    def refresh(self) -> None:
+        for shard in self.shards.values():
+            shard.refresh()
+
+    def flush(self) -> None:
+        for shard in self.shards.values():
+            shard.flush()
+
+    def force_merge(self) -> None:
+        for shard in self.shards.values():
+            shard.force_merge()
+
+    # ------------------------------------------------------------------
+    # Search (scatter -> merge -> fetch; §3.2 of SURVEY.md)
+    # ------------------------------------------------------------------
+
+    def search(self, body: Optional[dict] = None,
+               preference_shards: Optional[List[int]] = None) -> dict:
+        t0 = time.monotonic()
+        body = body or {}
+        from_ = int(body.get("from", 0) or 0)
+        size = int(body.get("size")) if body.get("size") is not None else 10
+        k = from_ + size
+        shard_ids = preference_shards or sorted(self.shards)
+        sort_spec = normalize_sort(body.get("sort"))
+
+        shard_results = []
+        failures = []
+        for sid in shard_ids:
+            try:
+                shard_results.append(
+                    self.shards[sid].searcher.query(body, size_hint=max(k, 1))
+                )
+            except Exception:
+                # per-shard failure tolerance comes with the replicated path;
+                # single-copy shards surface the error to the caller
+                raise
+        total = sum(r.total_hits for r in shard_results)
+        max_score = None
+        for r in shard_results:
+            if r.max_score is not None:
+                max_score = r.max_score if max_score is None else max(max_score, r.max_score)
+        refs = merge_refs(
+            [ref for r in shard_results for ref in r.refs], sort_spec, max(k, 0)
+        )
+        refs_window = refs[from_: from_ + size] if size >= 0 else refs[from_:]
+
+        aggregations = None
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        if agg_specs:
+            views = [v for r in shard_results for v in r.agg_views]
+            aggregations = run_aggregations(agg_specs, views)
+
+        hits = fetch_hits(refs_window, self.shards, body, self.name)
+        took = int((time.monotonic() - t0) * 1000)
+        resp = {
+            "took": took,
+            "timed_out": False,
+            "_shards": {
+                "total": len(shard_ids),
+                "successful": len(shard_results),
+                "skipped": 0,
+                "failed": len(failures),
+            },
+            "hits": {
+                "total": total,
+                "max_score": max_score,
+                "hits": hits,
+            },
+        }
+        if failures:
+            resp["_shards"]["failures"] = failures
+        if aggregations is not None:
+            resp["aggregations"] = aggregations
+        return resp
+
+    def count(self, body: Optional[dict] = None) -> dict:
+        body = dict(body or {})
+        body["size"] = 0
+        r = self.search(body)
+        return {"count": r["hits"]["total"], "_shards": r["_shards"]}
+
+    # ------------------------------------------------------------------
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.num_docs for s in self.shards.values())
+
+    def stats(self) -> dict:
+        shard_stats = {sid: s.stats() for sid, s in self.shards.items()}
+        totals = {
+            "docs": {"count": self.num_docs},
+            "indexing": {
+                "index_total": sum(s["indexing"]["index_total"] for s in shard_stats.values()),
+                "delete_total": sum(s["indexing"]["delete_total"] for s in shard_stats.values()),
+            },
+            "search": {
+                "query_total": sum(s["search"]["query_total"] for s in shard_stats.values()),
+                "query_time_in_millis": sum(
+                    s["search"]["query_time_in_millis"] for s in shard_stats.values()
+                ),
+            },
+            "segments": {
+                "count": sum(s["segments"]["count"] for s in shard_stats.values()),
+                "memory_in_bytes": sum(
+                    s["segments"]["memory_in_bytes"] for s in shard_stats.values()
+                ),
+            },
+            "translog": {
+                "operations": sum(s["translog"]["operations"] for s in shard_stats.values()),
+            },
+        }
+        return {"primaries": totals, "total": totals, "shards": shard_stats}
+
+    def mapping_dict(self) -> dict:
+        return self.mapper_service.mapping_dict()
+
+    def put_mapping(self, mapping: dict) -> None:
+        self.mapper_service.merge(mapping)
+
+    def close(self) -> None:
+        for shard in self.shards.values():
+            shard.close()
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    for key, value in patch.items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            base[key] = _deep_merge(dict(base[key]), value)
+        else:
+            base[key] = value
+    return base

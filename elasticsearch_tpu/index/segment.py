@@ -163,6 +163,8 @@ class Segment:
         self.live[num_docs:] = False
         self._id_to_doc: Optional[Dict[str, int]] = None
         self._device: Optional[dict] = None
+        # generic device-array cache for doc-value columns (key -> jnp array)
+        self.dev_cache: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
 
@@ -181,6 +183,9 @@ class Segment:
             import jax.numpy as jnp
 
             self._device["live"] = jnp.asarray(self.live)
+            self._device["live1"] = jnp.asarray(
+                np.concatenate([self.live, np.zeros(1, dtype=bool)])
+            )
 
     def term_id(self, field_name: str, token: str) -> int:
         key = f"{field_name}{FIELD_SEP}{token}"
@@ -211,13 +216,23 @@ class Segment:
         if self._device is None:
             import jax.numpy as jnp
 
+            live1 = np.concatenate([self.live, np.zeros(1, dtype=bool)])
             self._device = {
                 "block_docs": jnp.asarray(self.block_docs),
                 "block_tfs": jnp.asarray(self.block_tfs),
                 "norms": jnp.asarray(self.norms),
                 "live": jnp.asarray(self.live),
+                "live1": jnp.asarray(live1),
             }
         return self._device
+
+    def device_column(self, key: str, build) -> Any:
+        """Cached device staging for a doc-value array (build() -> np array)."""
+        if key not in self.dev_cache:
+            import jax.numpy as jnp
+
+            self.dev_cache[key] = jnp.asarray(build())
+        return self.dev_cache[key]
 
     def memory_bytes(self) -> int:
         total = self.block_docs.nbytes + self.block_tfs.nbytes + self.norms.nbytes

@@ -1,0 +1,150 @@
+"""IndexShard: one shard's lifecycle, write entry points, search entry.
+
+Role model: ``IndexShard`` (core/.../index/shard/IndexShard.java, 2401 LoC)
+— the shard state machine (CREATED → RECOVERING → POST_RECOVERY → STARTED →
+CLOSED), primary-term fencing for writes, searcher acquisition, and
+refresh/flush scheduling. The TPU build keeps the same state names; the
+"searcher" is the ShardSearcher over sealed segments.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.index.engine import Engine, VersionEntry
+from elasticsearch_tpu.index.store import Store
+from elasticsearch_tpu.index.translog import Translog, TranslogOp
+from elasticsearch_tpu.search.service import ShardSearcher
+
+
+class ShardState:
+    CREATED = "CREATED"
+    RECOVERING = "RECOVERING"
+    POST_RECOVERY = "POST_RECOVERY"
+    STARTED = "STARTED"
+    CLOSED = "CLOSED"
+
+
+class IndexShard:
+    def __init__(self, index_name: str, shard_id: int, mapper_service,
+                 data_path: Optional[str] = None, primary: bool = True,
+                 durability: str = Translog.DURABILITY_REQUEST):
+        self.index_name = index_name
+        self.shard_id = shard_id
+        self.mapper_service = mapper_service
+        self.primary = primary
+        self.primary_term = 1
+        self.state = ShardState.CREATED
+        if data_path:
+            os.makedirs(data_path, exist_ok=True)
+            translog = Translog(os.path.join(data_path, "translog"), durability)
+            store = Store(os.path.join(data_path, "index"))
+        else:
+            import tempfile
+
+            self._tmp = tempfile.TemporaryDirectory(prefix="estpu-shard-")
+            translog = Translog(os.path.join(self._tmp.name, "translog"), durability)
+            store = Store(os.path.join(self._tmp.name, "index"))
+        self.engine = Engine(
+            f"{index_name}[{shard_id}]", mapper_service, translog, store,
+            segment_prefix=f"{index_name}_{shard_id}_seg",
+        )
+        self.searcher = ShardSearcher(shard_id, self.engine, mapper_service)
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    # Recovery (store + translog replay; §3.5 / §5.4 of SURVEY.md)
+    # ------------------------------------------------------------------
+
+    def recover_from_store(self) -> None:
+        self.state = ShardState.RECOVERING
+        segments = self.engine.store.load_segments()
+        self.engine.segments = segments
+        max_seq = -1
+        for seg in segments:
+            for local, doc_id in enumerate(seg.doc_ids):
+                if seg.live[local]:
+                    self.engine.version_map[doc_id] = VersionEntry(
+                        int(seg.versions[local]), int(seg.seqnos[local]),
+                        seg.name, local,
+                    )
+            if seg.num_docs:
+                max_seq = max(max_seq, int(seg.seqnos.max()))
+        if max_seq >= 0:
+            self.engine.note_external_seqno(max_seq)
+        self.engine.recover_from_translog()
+        self.state = ShardState.POST_RECOVERY
+        self.state = ShardState.STARTED
+
+    def start_fresh(self) -> None:
+        self.state = ShardState.STARTED
+
+    # ------------------------------------------------------------------
+    # Write ops (primary-term fenced in the clustered path)
+    # ------------------------------------------------------------------
+
+    def index_doc(self, doc_id: str, source: dict, routing: Optional[str] = None,
+                  version: Optional[int] = None, version_type: str = "internal",
+                  op_type: str = "index", seqno: Optional[int] = None) -> dict:
+        self._ensure_started()
+        r = self.engine.index(doc_id, source, routing, version, version_type,
+                              op_type, seqno)
+        r["_index"] = self.index_name
+        r["_shard"] = self.shard_id
+        r["_primary_term"] = self.primary_term
+        return r
+
+    def delete_doc(self, doc_id: str, version: Optional[int] = None,
+                   seqno: Optional[int] = None) -> dict:
+        self._ensure_started()
+        r = self.engine.delete(doc_id, version, seqno)
+        r["_index"] = self.index_name
+        r["_primary_term"] = self.primary_term
+        return r
+
+    def get_doc(self, doc_id: str):
+        self._ensure_started()
+        return self.engine.get(doc_id)
+
+    def refresh(self) -> bool:
+        return self.engine.refresh()
+
+    def flush(self) -> None:
+        self.engine.flush()
+
+    def force_merge(self) -> None:
+        self.engine.force_merge()
+
+    def _ensure_started(self) -> None:
+        if self.state not in (ShardState.STARTED, ShardState.POST_RECOVERY):
+            raise IllegalArgumentException(
+                f"shard [{self.index_name}][{self.shard_id}] is not started "
+                f"(state: {self.state})"
+            )
+
+    # ------------------------------------------------------------------
+
+    @property
+    def num_docs(self) -> int:
+        return self.engine.num_docs
+
+    def stats(self) -> dict:
+        s = self.engine.stats()
+        s["search"] = {
+            "query_total": self.searcher.query_total,
+            "query_time_in_millis": int(self.searcher.query_time * 1000),
+            "fetch_total": self.searcher.fetch_total,
+        }
+        s["routing"] = {
+            "state": self.state,
+            "primary": self.primary,
+        }
+        return s
+
+    def close(self) -> None:
+        self.state = ShardState.CLOSED
+        self.engine.close()

@@ -1,0 +1,869 @@
+"""Aggregations: parse, per-segment partials, associative reduce.
+
+Role model: search/aggregations/ in the reference (368 files) — an
+``Aggregator`` tree collecting per-doc into buckets, with two-level reduce
+(shard partials -> coordinator merge, InternalAggregation.doReduce:129)
+and pipeline aggs post-processing the reduced tree.
+
+TPU design: partials are computed by the kernels in ops/aggs.py over the
+query's matched-doc mask (no per-doc collector calls); every partial is an
+associative structure (count maps, HLL registers, stats tuples) so the
+same reduce works across segments, shards, and — via psum-style tree
+reduction — across a device mesh (SURVEY.md §5.7). Sub-aggregations use a
+two-phase protocol: reduce picks the surviving buckets, then each bucket's
+filter mask drives a recursive partial pass (the reference's deferred /
+breadth-first collection, bucket/BestBucketsDeferringCollector).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import ParsingException
+from elasticsearch_tpu.mapper.field_types import format_epoch_millis, parse_date
+from elasticsearch_tpu.ops import aggs as agg_ops
+
+# ---------------------------------------------------------------------------
+# Specs (parse)
+# ---------------------------------------------------------------------------
+
+BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
+                "filter", "filters", "global", "missing"}
+METRIC_TYPES = {"min", "max", "sum", "avg", "stats", "extended_stats",
+                "value_count", "cardinality", "percentiles", "top_hits"}
+PIPELINE_TYPES = {"derivative", "cumulative_sum", "moving_avg", "avg_bucket",
+                  "sum_bucket", "min_bucket", "max_bucket", "stats_bucket",
+                  "bucket_script", "bucket_selector", "bucket_sort", "serial_diff"}
+
+
+class AggSpec:
+    def __init__(self, name: str, agg_type: str, body: dict, subs: List["AggSpec"]):
+        self.name = name
+        self.type = agg_type
+        self.body = body
+        self.subs = subs
+
+
+def parse_aggs(aggs_body: Optional[dict]) -> List[AggSpec]:
+    if not aggs_body:
+        return []
+    specs = []
+    for name, spec in aggs_body.items():
+        sub_body = spec.get("aggs") or spec.get("aggregations")
+        types = [k for k in spec if k not in ("aggs", "aggregations", "meta")]
+        if len(types) != 1:
+            raise ParsingException(
+                f"Expected exactly one aggregation type for [{name}], found {types}"
+            )
+        t = types[0]
+        if t not in BUCKET_TYPES | METRIC_TYPES | PIPELINE_TYPES:
+            raise ParsingException(f"Unknown aggregation type [{t}] for [{name}]")
+        specs.append(AggSpec(name, t, spec[t], parse_aggs(sub_body)))
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Per-segment partial computation
+# ---------------------------------------------------------------------------
+# A "SegmentAccess" duck: needs .segment (Segment), .mask (np bool [nd1]),
+# and .query_ctx for filter/filters sub-queries.
+
+
+class SegmentView:
+    """One segment + the matched mask for the current (sub-)aggregation."""
+
+    def __init__(self, segment, mask: np.ndarray, shard_ctx=None,
+                 scores: Optional[np.ndarray] = None):
+        self.segment = segment
+        self.mask = mask  # np bool [nd1], already includes live
+        self.shard_ctx = shard_ctx  # ShardQueryContext for filter aggs
+        self.scores = scores  # np f32 [nd1] (top_hits)
+
+    def with_mask(self, mask: np.ndarray) -> "SegmentView":
+        return SegmentView(self.segment, mask, self.shard_ctx, self.scores)
+
+
+def _resolve_value_field(segment, field: str):
+    """Find the numeric column for a field (falls back to .keyword-stripped)."""
+    col = segment.numeric_columns.get(field)
+    if col is not None:
+        return col
+    return None
+
+
+def _resolve_ordinal_field(segment, field: str):
+    col = segment.ordinal_columns.get(field)
+    if col is not None:
+        return col
+    # terms on "myfield" where mapping used text + .keyword multi-field
+    return segment.ordinal_columns.get(f"{field}.keyword")
+
+
+def compute_partial(spec: AggSpec, view: SegmentView) -> dict:
+    fn = _PARTIAL_FNS.get(spec.type)
+    if fn is None:
+        raise ParsingException(f"Unsupported aggregation type [{spec.type}]")
+    return fn(spec, view)
+
+
+# --- metrics ---
+
+
+def _metric_values(spec: AggSpec, view: SegmentView) -> np.ndarray:
+    """All values of matched docs for the agg's field (host numpy)."""
+    field = spec.body.get("field")
+    seg = view.segment
+    col = _resolve_value_field(seg, field)
+    if col is None:
+        ocol = _resolve_ordinal_field(seg, field)
+        if ocol is not None:
+            sel = view.mask[ocol.flat_docs[: ocol.count]]
+            return ocol.flat_ords[: ocol.count][sel].astype(np.float64)
+        return np.empty(0, dtype=np.float64)
+    sel = view.mask[col.flat_docs[: col.count]]
+    vals = col.flat_values[: col.count][sel]
+    if "missing" in spec.body:
+        # docs matched but without the field contribute the missing value
+        missing_docs = int(view.mask[: seg.nd_pad][~col.exists].sum())
+        if missing_docs:
+            vals = np.concatenate([vals, np.full(missing_docs, float(spec.body["missing"]))])
+    return vals
+
+
+def _partial_stats(spec, view):
+    vals = _metric_values(spec, view)
+    if vals.size == 0:
+        return {"count": 0, "sum": 0.0, "min": math.inf, "max": -math.inf, "sq": 0.0}
+    return {
+        "count": int(vals.size),
+        "sum": float(vals.sum()),
+        "min": float(vals.min()),
+        "max": float(vals.max()),
+        "sq": float((vals * vals).sum()),
+    }
+
+
+def _partial_cardinality(spec, view):
+    field = spec.body.get("field")
+    seg = view.segment
+    precision = _hll_precision(spec.body.get("precision_threshold"))
+    mask_dev = jnp.asarray(view.mask)
+    ocol = _resolve_ordinal_field(seg, field)
+    if ocol is not None:
+        key = f"hll.ord.{field}"
+        if key not in seg.dev_cache:
+            hashes = agg_ops.hash_string_values(ocol.terms)
+            seg.dev_cache[key] = jnp.asarray(hashes[np.clip(ocol.flat_ords, 0, None)])
+        hashes = seg.dev_cache[key]
+        valid = jnp.asarray(np.arange(len(ocol.flat_docs)) < ocol.count)
+        regs = agg_ops.hll_registers(
+            jnp.asarray(ocol.flat_docs), hashes, valid, mask_dev, precision=precision
+        )
+        return {"registers": np.asarray(regs), "precision": precision}
+    col = _resolve_value_field(seg, field)
+    if col is None:
+        return {"registers": np.zeros(1 << precision, np.int32), "precision": precision}
+    key = f"hll.num.{field}"
+    if key not in seg.dev_cache:
+        seg.dev_cache[key] = jnp.asarray(agg_ops.hash_numeric_values(col.flat_values))
+    hashes = seg.dev_cache[key]
+    valid = jnp.asarray(np.arange(len(col.flat_docs)) < col.count)
+    regs = agg_ops.hll_registers(
+        jnp.asarray(col.flat_docs), hashes, valid, mask_dev, precision=precision
+    )
+    return {"registers": np.asarray(regs), "precision": precision}
+
+
+def _hll_precision(threshold) -> int:
+    if threshold is None:
+        return agg_ops.HLL_DEFAULT_PRECISION
+    # ES: registers ~ threshold*... pick smallest p with 2^p >= 5*threshold
+    t = max(int(threshold), 1)
+    p = 4
+    while (1 << p) < 5 * t and p < 18:
+        p += 1
+    return p
+
+
+def _partial_percentiles(spec, view):
+    # exact sample (the reference approximates with TDigest; exact values
+    # are a superset in accuracy — partials carry the raw matched values,
+    # bounded by sampling at 100k per segment)
+    vals = _metric_values(spec, view)
+    limit = 100_000
+    if vals.size > limit:
+        rng = np.random.RandomState(13)
+        vals = rng.choice(vals, limit, replace=False)
+    return {"values": vals}
+
+
+def _partial_top_hits(spec, view):
+    size = int(spec.body.get("size", 3))
+    seg = view.segment
+    scores = view.scores if view.scores is not None else np.zeros(seg.nd_pad + 1, np.float32)
+    masked = np.where(view.mask[: seg.nd_pad], scores[: seg.nd_pad], -np.inf)
+    if masked.size == 0:
+        return {"hits": []}
+    k = min(size, masked.size)
+    idx = np.argpartition(-masked, k - 1)[:k]
+    idx = idx[np.argsort(-masked[idx], kind="stable")]
+    hits = []
+    for d in idx:
+        if masked[d] == -np.inf:
+            continue
+        hits.append({
+            "_id": seg.doc_ids[d],
+            "_score": float(masked[d]),
+            "_source": seg.sources[d],
+        })
+    return {"hits": hits}
+
+
+# --- buckets ---
+
+
+def _partial_terms(spec, view):
+    field = spec.body["field"]
+    seg = view.segment
+    ocol = _resolve_ordinal_field(seg, field)
+    mask_dev = jnp.asarray(view.mask)
+    if ocol is not None and ocol.count > 0:
+        docs = seg.device_column(f"ord.{_f(seg, field)}.docs", lambda: ocol.flat_docs)
+        ords = seg.device_column(f"ord.{_f(seg, field)}.ords", lambda: ocol.flat_ords)
+        counts = np.asarray(agg_ops.ordinal_counts(docs, ords, mask_dev, len(ocol.terms)))
+        return {"counts": {ocol.terms[i]: int(c) for i, c in enumerate(counts) if c > 0},
+                "doc_count_error_upper_bound": 0}
+    col = _resolve_value_field(seg, field)
+    if col is None or col.count == 0:
+        return {"counts": {}, "doc_count_error_upper_bound": 0}
+    sel = view.mask[col.flat_docs[: col.count]]
+    vals = col.flat_values[: col.count][sel]
+    docs_sel = col.flat_docs[: col.count][sel]
+    # numeric terms: dedupe (doc, value)
+    uniq = set(zip(docs_sel.tolist(), vals.tolist()))
+    counts: Dict = {}
+    for _, v in uniq:
+        k = int(v) if float(v).is_integer() else float(v)
+        counts[k] = counts.get(k, 0) + 1
+    return {"counts": counts, "doc_count_error_upper_bound": 0}
+
+
+def _f(seg, field):
+    """Resolve the actual ordinal column name used for a field."""
+    return field if field in seg.ordinal_columns else f"{field}.keyword"
+
+
+_CAL_INTERVALS = {"year": "Y", "quarter": None, "month": "M", "week": "W",
+                  "day": "D", "hour": "h", "minute": "m", "second": "s"}
+_FIXED_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+
+
+def _date_interval_ms(interval: str) -> Optional[float]:
+    """Fixed intervals -> millis; calendar intervals return None."""
+    s = str(interval)
+    if s in _CAL_INTERVALS:
+        return None
+    for unit in sorted(_FIXED_MS, key=len, reverse=True):
+        if s.endswith(unit):
+            try:
+                return float(s[: -len(unit)]) * _FIXED_MS[unit]
+            except ValueError:
+                break
+    raise ParsingException(f"unable to parse interval [{interval}]")
+
+
+def _calendar_bucket_keys(millis: np.ndarray, interval: str) -> np.ndarray:
+    """Calendar rounding via numpy datetime64 (host columnar op)."""
+    dt = millis.astype("int64").astype("datetime64[ms]")
+    if interval == "quarter":
+        months = dt.astype("datetime64[M]").astype(np.int64)
+        q_start = (months // 3) * 3
+        return q_start.astype("datetime64[M]").astype("datetime64[ms]").astype(np.int64)
+    unit = _CAL_INTERVALS[interval]
+    return dt.astype(f"datetime64[{unit}]").astype("datetime64[ms]").astype(np.int64)
+
+
+def _partial_histogram(spec, view, is_date=False):
+    field = spec.body["field"]
+    seg = view.segment
+    col = _resolve_value_field(seg, field)
+    if col is None or col.count == 0:
+        return {"counts": {}}
+    sel = view.mask[col.flat_docs[: col.count]]
+    vals = col.flat_values[: col.count][sel]
+    if vals.size == 0:
+        return {"counts": {}}
+    if is_date:
+        interval = spec.body.get("interval") or spec.body.get("calendar_interval") \
+            or spec.body.get("fixed_interval")
+        ms = _date_interval_ms(interval)
+        if ms is None:
+            keys = _calendar_bucket_keys(vals.astype(np.int64), str(interval))
+        else:
+            offset = float(spec.body.get("offset", 0) or 0)
+            keys = (np.floor((vals - offset) / ms) * ms + offset).astype(np.int64)
+    else:
+        interval = float(spec.body["interval"])
+        offset = float(spec.body.get("offset", 0.0))
+        keys = np.floor((vals - offset) / interval) * interval + offset
+    counts: Dict = {}
+    uniq, cnt = np.unique(keys, return_counts=True)
+    for k, c in zip(uniq.tolist(), cnt.tolist()):
+        counts[k] = counts.get(k, 0) + int(c)
+    return {"counts": counts}
+
+
+def _partial_range(spec, view, is_date=False):
+    field = spec.body["field"]
+    ranges = spec.body["ranges"]
+    seg = view.segment
+    col = _resolve_value_field(seg, field)
+    out = []
+    conv = (lambda v: float(parse_date(v))) if is_date else float
+    for r in ranges:
+        lo = conv(r["from"]) if "from" in r else -np.inf
+        hi = conv(r["to"]) if "to" in r else np.inf
+        if col is None or col.count == 0:
+            out.append(0)
+            continue
+        sel = view.mask[col.flat_docs[: col.count]]
+        in_r = (col.flat_values[: col.count] >= lo) & (col.flat_values[: col.count] < hi) & sel
+        out.append(int(len(set(col.flat_docs[: col.count][in_r].tolist()))))
+    return {"range_counts": out}
+
+
+def _partial_filter(spec, view):
+    from elasticsearch_tpu.search import plan as P
+    from elasticsearch_tpu.search.query_dsl import parse_query
+
+    qb = parse_query(spec.body)
+    node = qb.to_plan(view.shard_ctx, view.segment)
+    _, matched = P.execute(view.segment.device_arrays(), node)
+    sub_mask = np.asarray(matched) & view.mask
+    return {"doc_count": int(sub_mask[: view.segment.nd_pad].sum()),
+            "_mask": sub_mask}
+
+
+def _partial_filters(spec, view):
+    filters = spec.body.get("filters")
+    out = {}
+    if isinstance(filters, dict):
+        items = filters.items()
+    else:
+        items = ((str(i), f) for i, f in enumerate(filters))
+    for key, f in items:
+        sub = _partial_filter(AggSpec(key, "filter", f, []), view)
+        out[key] = sub
+    return {"filters": out}
+
+
+def _partial_global(spec, view):
+    seg = view.segment
+    mask = np.concatenate([seg.live, np.zeros(1, bool)])
+    return {"doc_count": int(seg.live_doc_count), "_mask": mask}
+
+
+def _partial_missing(spec, view):
+    field = spec.body["field"]
+    seg = view.segment
+    exists = seg.exists_masks.get(field)
+    if exists is None:
+        sub_mask = view.mask.copy()
+    else:
+        sub_mask = view.mask.copy()
+        sub_mask[: seg.nd_pad] &= ~exists
+    return {"doc_count": int(sub_mask[: seg.nd_pad].sum()), "_mask": sub_mask}
+
+
+_PARTIAL_FNS: Dict[str, Callable] = {
+    "min": _partial_stats, "max": _partial_stats, "sum": _partial_stats,
+    "avg": _partial_stats, "stats": _partial_stats, "extended_stats": _partial_stats,
+    "value_count": _partial_stats,
+    "cardinality": _partial_cardinality,
+    "percentiles": _partial_percentiles,
+    "top_hits": _partial_top_hits,
+    "terms": _partial_terms,
+    "histogram": lambda s, v: _partial_histogram(s, v, is_date=False),
+    "date_histogram": lambda s, v: _partial_histogram(s, v, is_date=True),
+    "range": lambda s, v: _partial_range(s, v, is_date=False),
+    "date_range": lambda s, v: _partial_range(s, v, is_date=True),
+    "filter": _partial_filter,
+    "filters": _partial_filters,
+    "global": _partial_global,
+    "missing": _partial_missing,
+}
+
+
+# ---------------------------------------------------------------------------
+# Reduce (partials -> final response), two-phase sub-agg execution
+# ---------------------------------------------------------------------------
+
+
+def _reduce_stats(partials: List[dict]) -> dict:
+    out = {"count": 0, "sum": 0.0, "min": math.inf, "max": -math.inf, "sq": 0.0}
+    for p in partials:
+        out["count"] += p["count"]
+        out["sum"] += p["sum"]
+        out["min"] = min(out["min"], p["min"])
+        out["max"] = max(out["max"], p["max"])
+        out["sq"] += p["sq"]
+    return out
+
+
+def _finalize_metric(spec: AggSpec, partials: List[dict]) -> dict:
+    t = spec.type
+    if t in ("min", "max", "sum", "avg", "stats", "extended_stats", "value_count"):
+        st = _reduce_stats(partials)
+        count, total = st["count"], st["sum"]
+        if t == "min":
+            return {"value": None if count == 0 else st["min"]}
+        if t == "max":
+            return {"value": None if count == 0 else st["max"]}
+        if t == "sum":
+            return {"value": total}
+        if t == "avg":
+            return {"value": None if count == 0 else total / count}
+        if t == "value_count":
+            return {"value": count}
+        base = {
+            "count": count,
+            "min": None if count == 0 else st["min"],
+            "max": None if count == 0 else st["max"],
+            "avg": None if count == 0 else total / count,
+            "sum": total,
+        }
+        if t == "stats":
+            return base
+        variance = 0.0
+        if count > 0:
+            variance = max(st["sq"] / count - (total / count) ** 2, 0.0)
+        base.update({
+            "sum_of_squares": st["sq"],
+            "variance": variance,
+            "std_deviation": math.sqrt(variance),
+            "std_deviation_bounds": {
+                "upper": (total / count + 2 * math.sqrt(variance)) if count else None,
+                "lower": (total / count - 2 * math.sqrt(variance)) if count else None,
+            },
+        })
+        return base
+    if t == "cardinality":
+        regs = None
+        for p in partials:
+            regs = p["registers"] if regs is None else np.maximum(regs, p["registers"])
+        if regs is None:
+            return {"value": 0}
+        return {"value": int(round(agg_ops.hll_estimate(regs)))}
+    if t == "percentiles":
+        vals = np.concatenate([p["values"] for p in partials]) if partials else np.empty(0)
+        pcts = spec.body.get("percents", [1, 5, 25, 50, 75, 95, 99])
+        if vals.size == 0:
+            return {"values": {str(float(p)): None for p in pcts}}
+        return {"values": {
+            str(float(p)): float(np.percentile(vals, p)) for p in pcts
+        }}
+    if t == "top_hits":
+        size = int(spec.body.get("size", 3))
+        all_hits = [h for p in partials for h in p["hits"]]
+        all_hits.sort(key=lambda h: -h["_score"])
+        return {"hits": {
+            "total": len(all_hits),
+            "hits": all_hits[:size],
+        }}
+    raise ParsingException(f"cannot finalize metric [{t}]")
+
+
+def run_aggregations(specs: List[AggSpec], views: List[SegmentView]) -> dict:
+    """Execute an agg tree over segment views; returns the response dict
+    keyed by agg name (single-node path: segments of one or more shards)."""
+    out = {}
+    pipeline_specs = [s for s in specs if s.type in PIPELINE_TYPES]
+    for spec in specs:
+        if spec.type in PIPELINE_TYPES:
+            continue
+        out[spec.name] = _run_one(spec, views)
+    for spec in pipeline_specs:
+        _apply_pipeline(spec, out)
+    return out
+
+
+def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
+    if spec.type in METRIC_TYPES:
+        partials = [compute_partial(spec, v) for v in views]
+        return _finalize_metric(spec, partials)
+
+    if spec.type in ("filter", "global", "missing"):
+        partials = [compute_partial(spec, v) for v in views]
+        doc_count = sum(p["doc_count"] for p in partials)
+        result = {"doc_count": doc_count}
+        if spec.subs:
+            sub_views = [v.with_mask(p["_mask"]) for v, p in zip(views, partials)]
+            result.update(run_aggregations(spec.subs, sub_views))
+        return result
+
+    if spec.type == "filters":
+        partials = [compute_partial(spec, v) for v in views]
+        buckets = {}
+        keys = partials[0]["filters"].keys() if partials else []
+        for key in keys:
+            doc_count = sum(p["filters"][key]["doc_count"] for p in partials)
+            b = {"doc_count": doc_count}
+            if spec.subs:
+                sub_views = [v.with_mask(p["filters"][key]["_mask"])
+                             for v, p in zip(views, partials)]
+                b.update(run_aggregations(spec.subs, sub_views))
+            buckets[key] = b
+        return {"buckets": buckets}
+
+    if spec.type == "terms":
+        partials = [compute_partial(spec, v) for v in views]
+        merged: Dict = {}
+        for p in partials:
+            for k, c in p["counts"].items():
+                merged[k] = merged.get(k, 0) + c
+        size = int(spec.body.get("size", 10))
+        order = spec.body.get("order", {"_count": "desc"})
+        items = list(merged.items())
+        items = _sort_buckets(items, order)
+        selected = items[:size]
+        sum_other = sum(c for _, c in items[size:])
+        buckets = []
+        for key, count in selected:
+            b = {"key": key, "doc_count": count}
+            if spec.subs:
+                sub_views = [
+                    v.with_mask(_term_bucket_mask(v, spec.body["field"], key))
+                    for v in views
+                ]
+                b.update(run_aggregations(spec.subs, sub_views))
+            buckets.append(b)
+        return {
+            "doc_count_error_upper_bound": 0,
+            "sum_other_doc_count": sum_other,
+            "buckets": buckets,
+        }
+
+    if spec.type in ("histogram", "date_histogram"):
+        is_date = spec.type == "date_histogram"
+        partials = [compute_partial(spec, v) for v in views]
+        merged = {}
+        for p in partials:
+            for k, c in p["counts"].items():
+                merged[k] = merged.get(k, 0) + c
+        min_doc_count = int(spec.body.get("min_doc_count", 1 if not is_date else 0))
+        keys = sorted(merged.keys())
+        # date_histogram fills empty buckets between min and max (min_doc_count=0)
+        if keys and min_doc_count == 0:
+            interval = spec.body.get("interval") or spec.body.get(
+                "calendar_interval") or spec.body.get("fixed_interval")
+            ms = _date_interval_ms(interval) if is_date else float(spec.body["interval"])
+            if ms is not None:
+                full, k = [], keys[0]
+                while k <= keys[-1] and len(full) < 10000:
+                    full.append(k)
+                    k += ms if not is_date else int(ms)
+                keys = [k for k in full]
+        buckets = []
+        for key in keys:
+            count = merged.get(key, 0)
+            if count < min_doc_count:
+                continue
+            b = {"key": key, "doc_count": count}
+            if is_date:
+                b["key_as_string"] = format_epoch_millis(int(key))
+            if spec.subs and count > 0:
+                sub_views = [
+                    v.with_mask(_histo_bucket_mask(v, spec, key, is_date))
+                    for v in views
+                ]
+                b.update(run_aggregations(spec.subs, sub_views))
+            elif spec.subs:
+                empty_views = [v.with_mask(np.zeros_like(v.mask)) for v in views]
+                b.update(run_aggregations(spec.subs, empty_views))
+            buckets.append(b)
+        return {"buckets": buckets}
+
+    if spec.type in ("range", "date_range"):
+        is_date = spec.type == "date_range"
+        partials = [compute_partial(spec, v) for v in views]
+        ranges = spec.body["ranges"]
+        buckets = []
+        for i, r in enumerate(ranges):
+            count = sum(p["range_counts"][i] for p in partials)
+            key = r.get("key")
+            if key is None:
+                lo = r.get("from", "*")
+                hi = r.get("to", "*")
+                key = f"{lo}-{hi}"
+            b = {"key": key, "doc_count": count}
+            if "from" in r:
+                b["from"] = parse_date(r["from"]) if is_date else float(r["from"])
+            if "to" in r:
+                b["to"] = parse_date(r["to"]) if is_date else float(r["to"])
+            if spec.subs:
+                sub_views = [
+                    v.with_mask(_range_bucket_mask(v, spec.body["field"], r, is_date))
+                    for v in views
+                ]
+                b.update(run_aggregations(spec.subs, sub_views))
+            buckets.append(b)
+        return {"buckets": buckets}
+
+    raise ParsingException(f"Unsupported aggregation type [{spec.type}]")
+
+
+def _sort_buckets(items: List[Tuple], order) -> List[Tuple]:
+    if isinstance(order, list):
+        order = order[0] if order else {"_count": "desc"}
+    ((key, direction),) = order.items()
+    reverse = str(direction).lower() == "desc"
+    if key == "_count":
+        return sorted(items, key=lambda kv: (-kv[1] if reverse else kv[1], str(kv[0])))
+    if key in ("_key", "_term"):
+        return sorted(items, key=lambda kv: kv[0], reverse=reverse)
+    # sub-agg ordering unsupported pre-selection; fall back to count desc
+    return sorted(items, key=lambda kv: (-kv[1], str(kv[0])))
+
+
+def _term_bucket_mask(view: SegmentView, field: str, key) -> np.ndarray:
+    seg = view.segment
+    ocol = _resolve_ordinal_field(seg, field)
+    mask = np.zeros_like(view.mask)
+    if ocol is not None:
+        o = ocol.ord_of(str(key))
+        if o < 0:
+            return mask
+        sel = ocol.flat_ords[: ocol.count] == o
+        mask[ocol.flat_docs[: ocol.count][sel]] = True
+        return mask & view.mask
+    col = _resolve_value_field(seg, field)
+    if col is None:
+        return mask
+    sel = col.flat_values[: col.count] == float(key)
+    mask[col.flat_docs[: col.count][sel]] = True
+    return mask & view.mask
+
+
+def _histo_bucket_mask(view: SegmentView, spec: AggSpec, key, is_date: bool) -> np.ndarray:
+    seg = view.segment
+    col = _resolve_value_field(seg, spec.body["field"])
+    mask = np.zeros_like(view.mask)
+    if col is None:
+        return mask
+    vals = col.flat_values[: col.count]
+    if is_date:
+        interval = spec.body.get("interval") or spec.body.get(
+            "calendar_interval") or spec.body.get("fixed_interval")
+        ms = _date_interval_ms(interval)
+        if ms is None:
+            keys = _calendar_bucket_keys(vals.astype(np.int64), str(interval))
+            sel = keys == int(key)
+        else:
+            offset = float(spec.body.get("offset", 0) or 0)
+            sel = (np.floor((vals - offset) / ms) * ms + offset).astype(np.int64) == int(key)
+    else:
+        interval = float(spec.body["interval"])
+        offset = float(spec.body.get("offset", 0.0))
+        sel = (np.floor((vals - offset) / interval) * interval + offset) == float(key)
+    mask[col.flat_docs[: col.count][sel]] = True
+    return mask & view.mask
+
+
+def _range_bucket_mask(view: SegmentView, field: str, r: dict, is_date: bool) -> np.ndarray:
+    seg = view.segment
+    col = _resolve_value_field(seg, field)
+    mask = np.zeros_like(view.mask)
+    if col is None:
+        return mask
+    conv = (lambda v: float(parse_date(v))) if is_date else float
+    lo = conv(r["from"]) if "from" in r else -np.inf
+    hi = conv(r["to"]) if "to" in r else np.inf
+    vals = col.flat_values[: col.count]
+    sel = (vals >= lo) & (vals < hi)
+    mask[col.flat_docs[: col.count][sel]] = True
+    return mask & view.mask
+
+
+# ---------------------------------------------------------------------------
+# Pipeline aggregations (post-process the reduced tree; search/aggregations/
+# pipeline/ in the reference)
+# ---------------------------------------------------------------------------
+
+
+def _buckets_path_values(out: dict, path: str) -> List[Optional[float]]:
+    """Resolve 'agg>metric' or 'agg' paths against reduced output."""
+    parts = path.split(">")
+    top = out.get(parts[0])
+    if top is None or "buckets" not in top:
+        raise ParsingException(f"No bucket aggregation found for path [{path}]")
+    buckets = top["buckets"]
+    if isinstance(buckets, dict):
+        buckets = list(buckets.values())
+    values = []
+    for b in buckets:
+        node = b
+        ok = True
+        for p in parts[1:]:
+            metric = p.split(".")
+            node = node.get(metric[0])
+            if node is None:
+                ok = False
+                break
+            if isinstance(node, dict):
+                if len(metric) > 1:
+                    node = node.get(metric[1])
+                elif "value" in node:
+                    node = node["value"]
+        if not ok:
+            values.append(None)
+        elif isinstance(node, dict):
+            values.append(node.get("value"))
+        else:
+            values.append(b["doc_count"] if len(parts) == 1 else node)
+    if len(parts) == 1:
+        values = [b["doc_count"] for b in buckets]
+    return values
+
+
+def _apply_pipeline(spec: AggSpec, out: dict) -> None:
+    t = spec.type
+    path = spec.body.get("buckets_path")
+    if t == "bucket_script" or t == "bucket_selector":
+        _apply_bucket_script(spec, out)
+        return
+    if t == "bucket_sort":
+        _apply_bucket_sort(spec, out)
+        return
+    values = _buckets_path_values(out, path)
+    parent = path.split(">")[0]
+    buckets = out[parent]["buckets"]
+    if isinstance(buckets, dict):
+        buckets = list(buckets.values())
+    if t == "derivative":
+        prev = None
+        for b, v in zip(buckets, values):
+            if prev is not None and v is not None:
+                b[spec.name] = {"value": v - prev}
+            prev = v
+    elif t == "serial_diff":
+        lag = int(spec.body.get("lag", 1))
+        for i, b in enumerate(buckets):
+            if i >= lag and values[i] is not None and values[i - lag] is not None:
+                b[spec.name] = {"value": values[i] - values[i - lag]}
+    elif t == "cumulative_sum":
+        acc = 0.0
+        for b, v in zip(buckets, values):
+            acc += v or 0.0
+            b[spec.name] = {"value": acc}
+    elif t == "moving_avg":
+        window = int(spec.body.get("window", 5))
+        for i, b in enumerate(buckets):
+            if i == 0:
+                continue
+            w = [v for v in values[max(0, i - window): i] if v is not None]
+            if w:
+                b[spec.name] = {"value": sum(w) / len(w)}
+    elif t in ("avg_bucket", "sum_bucket", "min_bucket", "max_bucket", "stats_bucket"):
+        vals = [v for v in values if v is not None]
+        if t == "avg_bucket":
+            out[spec.name] = {"value": sum(vals) / len(vals) if vals else None}
+        elif t == "sum_bucket":
+            out[spec.name] = {"value": sum(vals)}
+        elif t == "min_bucket":
+            out[spec.name] = {"value": min(vals) if vals else None}
+        elif t == "max_bucket":
+            out[spec.name] = {"value": max(vals) if vals else None}
+        else:
+            out[spec.name] = {
+                "count": len(vals),
+                "min": min(vals) if vals else None,
+                "max": max(vals) if vals else None,
+                "avg": sum(vals) / len(vals) if vals else None,
+                "sum": sum(vals),
+            }
+
+
+_SCRIPT_ALLOWED = set("0123456789.+-*/()% eE")
+
+
+def _eval_bucket_script(script: str, params: Dict[str, Optional[float]]) -> Optional[float]:
+    """Tiny safe arithmetic evaluator for bucket_script (the reference uses
+    Painless; this accepts +-*/%() and params.<name> references)."""
+    expr = script
+    for name, value in sorted(params.items(), key=lambda kv: -len(kv[0])):
+        if value is None:
+            return None
+        expr = expr.replace(f"params.{name}", repr(float(value)))
+    if not all(c in _SCRIPT_ALLOWED for c in expr):
+        raise ParsingException(f"unsupported bucket_script [{script}]")
+    try:
+        return float(eval(expr, {"__builtins__": {}}, {}))  # noqa: S307 — sanitized above
+    except ZeroDivisionError:
+        return None
+    except Exception as e:
+        raise ParsingException(f"failed to evaluate bucket_script [{script}]: {e}") from e
+
+
+def _apply_bucket_script(spec: AggSpec, out: dict) -> None:
+    paths = spec.body["buckets_path"]
+    script = spec.body["script"]
+    if isinstance(script, dict):
+        script = script.get("source") or script.get("inline")
+    parents = {p.split(">")[0] for p in paths.values()}
+    if len(parents) != 1:
+        raise ParsingException("bucket_script paths must share one parent")
+    parent = parents.pop()
+    per_param = {name: _buckets_path_values(out, path) for name, path in paths.items()}
+    buckets = out[parent]["buckets"]
+    if isinstance(buckets, dict):
+        buckets = list(buckets.values())
+    keep = []
+    for i, b in enumerate(buckets):
+        params = {name: vals[i] for name, vals in per_param.items()}
+        value = _eval_bucket_script(script, params)
+        if spec.type == "bucket_selector":
+            if value:  # truthy keeps the bucket
+                keep.append(b)
+        else:
+            if value is not None:
+                b[spec.name] = {"value": value}
+    if spec.type == "bucket_selector":
+        out[parent]["buckets"] = keep
+
+
+def _apply_bucket_sort(spec: AggSpec, out: dict) -> None:
+    # operates on sibling buckets; sort keys limited to doc_count/_key/metrics
+    sorts = spec.body.get("sort", [])
+    size = spec.body.get("size")
+    from_ = int(spec.body.get("from", 0))
+    for parent_name, parent in out.items():
+        if not isinstance(parent, dict) or "buckets" not in parent:
+            continue
+        buckets = parent["buckets"]
+        if isinstance(buckets, dict):
+            continue
+        for s in reversed(sorts):
+            if isinstance(s, str):
+                key, direction = s, "asc"
+            else:
+                ((key, spec_dir),) = s.items()
+                direction = spec_dir.get("order", "asc") if isinstance(spec_dir, dict) else spec_dir
+
+            def sort_key(b, key=key):
+                if key == "_key":
+                    return b.get("key")
+                if key == "doc_count":
+                    return b.get("doc_count")
+                node = b.get(key)
+                return node.get("value") if isinstance(node, dict) else node
+
+            buckets.sort(key=sort_key, reverse=(direction == "desc"))
+        if size is not None:
+            parent["buckets"] = buckets[from_: from_ + int(size)]
+        elif from_:
+            parent["buckets"] = buckets[from_:]
+        break  # bucket_sort applies to its sibling context: first bucket agg

@@ -1,0 +1,521 @@
+"""Query execution plans: a query tree compiled to ONE jitted XLA program.
+
+Role model inversion: the reference executes a query as a virtual-call
+tree of Lucene ``Weight``/``Scorer`` objects driven doc-at-a-time by a
+collector (search/query/QueryPhase.java:272). Here the whole boolean/
+scoring tree is *traced once* into a single XLA program operating on dense
+``[nd1]`` score/match vectors (SURVEY.md §7.1): leaves gather posting
+blocks or doc-value columns; combiners are elementwise ops; XLA fuses the
+lot. Programs are cached by plan *structure* (node types + array shapes);
+the same shaped query never recompiles.
+
+Every node emits ``(scores f32[nd1], matched bool[nd1])``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.ops import masks as mask_ops
+from elasticsearch_tpu.ops.scoring import B, K1
+
+
+class PlanNode:
+    """Base: subclasses define emit(ctx), structural key(), arrays()."""
+
+    def emit(self, ctx: "EmitCtx"):
+        raise NotImplementedError
+
+    def key(self) -> str:
+        raise NotImplementedError
+
+    def arrays(self) -> List:
+        return []
+
+    def children(self) -> List["PlanNode"]:
+        return []
+
+    def flat_arrays(self) -> List:
+        out = list(self.arrays())
+        for c in self.children():
+            out.extend(c.flat_arrays())
+        return out
+
+
+class EmitCtx:
+    """Carries the segment device arrays + the flat plan-array iterator
+    during tracing."""
+
+    def __init__(self, seg_arrays: dict, plan_arrays: List):
+        self.seg = seg_arrays
+        self._arrays = plan_arrays
+        self._pos = 0
+
+    def take(self, n: int) -> List:
+        out = self._arrays[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    @property
+    def nd1(self) -> int:
+        return self.seg["norms"].shape[1]
+
+    def zeros_f(self):
+        return jnp.zeros((self.nd1,), jnp.float32)
+
+    def zeros_b(self):
+        return jnp.zeros((self.nd1,), bool)
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+
+class ScoreTermsNode(PlanNode):
+    """Weighted disjunction of term posting blocks with BM25 scoring and a
+    minimum-distinct-match threshold (match/term/multi_match leaves)."""
+
+    def __init__(self, q_blocks, q_weights, q_norm_rows, q_avgdl, q_valid,
+                 min_match, k1: float = K1, b: float = B):
+        self.q_blocks = q_blocks
+        self.q_weights = q_weights
+        self.q_norm_rows = q_norm_rows
+        self.q_avgdl = q_avgdl
+        self.q_valid = q_valid
+        self.min_match = np.float32(min_match)
+        self.k1, self.b = k1, b
+
+    def key(self):
+        return f"terms[{len(self.q_blocks)},{self.k1},{self.b}]"
+
+    def arrays(self):
+        return [self.q_blocks, self.q_weights, self.q_norm_rows, self.q_avgdl,
+                self.q_valid, self.min_match]
+
+    def emit(self, ctx):
+        q_blocks, q_weights, q_norm_rows, q_avgdl, q_valid, min_match = ctx.take(6)
+        docs = ctx.seg["block_docs"][q_blocks]
+        tfs = ctx.seg["block_tfs"][q_blocks]
+        doc_len = ctx.seg["norms"][q_norm_rows[:, None], docs]
+        denom = tfs + self.k1 * (1.0 - self.b + self.b * doc_len / q_avgdl[:, None])
+        matched = (tfs > 0.0) & q_valid[:, None]
+        contrib = jnp.where(matched, q_weights[:, None] * tfs * (self.k1 + 1.0) / denom, 0.0)
+        scores = ctx.zeros_f().at[docs].add(contrib)
+        counts = ctx.zeros_f().at[docs].add(matched.astype(jnp.float32))
+        return scores, counts >= min_match
+
+
+class PhraseScoreNode(PlanNode):
+    """Pre-verified phrase matches (host position intersection) scored with
+    BM25 over the phrase frequency — MatchPhraseQuery semantics. docs/freqs
+    are [K]-padded (doc = nd1-1 sentinel, freq = 0)."""
+
+    def __init__(self, docs, freqs, weight, norm_row, avgdl,
+                 k1: float = K1, b: float = B):
+        self.docs = docs
+        self.freqs = freqs
+        self.weight = np.float32(weight)
+        self.norm_row = int(norm_row)
+        self.avgdl = np.float32(avgdl)
+        self.k1, self.b = k1, b
+
+    def key(self):
+        return f"phrase[{len(self.docs)},{self.norm_row},{self.k1},{self.b}]"
+
+    def arrays(self):
+        return [self.docs, self.freqs, self.weight, self.avgdl]
+
+    def emit(self, ctx):
+        docs, freqs, weight, avgdl = ctx.take(4)
+        doc_len = ctx.seg["norms"][self.norm_row][docs]
+        denom = freqs + self.k1 * (1.0 - self.b + self.b * doc_len / avgdl)
+        matched_v = freqs > 0
+        contrib = jnp.where(matched_v, weight * freqs * (self.k1 + 1.0) / denom, 0.0)
+        scores = ctx.zeros_f().at[docs].add(contrib)
+        matched = ctx.zeros_b().at[docs].max(matched_v)
+        return scores, matched
+
+
+class MatchAllNode(PlanNode):
+    def __init__(self, boost: float = 1.0):
+        self.boost = np.float32(boost)
+
+    def key(self):
+        return "all"
+
+    def arrays(self):
+        return [self.boost]
+
+    def emit(self, ctx):
+        (boost,) = ctx.take(1)
+        matched = ctx.seg["live1"]
+        return jnp.where(matched, boost, 0.0).astype(jnp.float32), matched
+
+
+class MatchNoneNode(PlanNode):
+    def key(self):
+        return "none"
+
+    def emit(self, ctx):
+        return ctx.zeros_f(), ctx.zeros_b()
+
+
+class NumericRangeNode(PlanNode):
+    def __init__(self, flat_docs, flat_values, lo: float, hi: float):
+        self.flat_docs = flat_docs
+        self.flat_values = flat_values
+        self.lo = np.float64(lo)
+        self.hi = np.float64(hi)
+
+    def key(self):
+        return f"nrange[{len(self.flat_docs)}]"
+
+    def arrays(self):
+        return [self.flat_docs, self.flat_values, self.lo, self.hi]
+
+    def emit(self, ctx):
+        flat_docs, flat_values, lo, hi = ctx.take(4)
+        cond = (flat_values >= lo) & (flat_values <= hi)
+        return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(cond)
+
+
+class NumericTermsNode(PlanNode):
+    def __init__(self, flat_docs, flat_values, values):
+        self.flat_docs = flat_docs
+        self.flat_values = flat_values
+        self.values = values  # [K] f64 padded with nan
+
+    def key(self):
+        return f"nterms[{len(self.flat_docs)},{len(self.values)}]"
+
+    def arrays(self):
+        return [self.flat_docs, self.flat_values, self.values]
+
+    def emit(self, ctx):
+        flat_docs, flat_values, values = ctx.take(3)
+        cond = (flat_values[:, None] == values[None, :]).any(axis=1)
+        return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(cond)
+
+
+class OrdTermsNode(PlanNode):
+    def __init__(self, flat_docs, flat_ords, ords):
+        self.flat_docs = flat_docs
+        self.flat_ords = flat_ords
+        self.ords = ords  # [K] int32 padded with -1
+
+    def key(self):
+        return f"oterms[{len(self.flat_docs)},{len(self.ords)}]"
+
+    def arrays(self):
+        return [self.flat_docs, self.flat_ords, self.ords]
+
+    def emit(self, ctx):
+        flat_docs, flat_ords, ords = ctx.take(3)
+        cond = (flat_ords[:, None] == ords[None, :]).any(axis=1)
+        return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(cond)
+
+
+class OrdRangeNode(PlanNode):
+    def __init__(self, flat_docs, flat_ords, lo_ord: int, hi_ord: int):
+        self.flat_docs = flat_docs
+        self.flat_ords = flat_ords
+        self.lo_ord = np.int32(lo_ord)
+        self.hi_ord = np.int32(hi_ord)
+
+    def key(self):
+        return f"orange[{len(self.flat_docs)}]"
+
+    def arrays(self):
+        return [self.flat_docs, self.flat_ords, self.lo_ord, self.hi_ord]
+
+    def emit(self, ctx):
+        flat_docs, flat_ords, lo, hi = ctx.take(4)
+        cond = (flat_ords >= lo) & (flat_ords < hi)
+        return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(cond)
+
+
+class DenseMaskNode(PlanNode):
+    """A precomputed [nd1] bool mask (exists query, ids query)."""
+
+    def __init__(self, mask, label: str = "mask"):
+        self.mask = mask
+        self.label = label
+
+    def key(self):
+        return f"dense[{len(self.mask)}]"
+
+    def arrays(self):
+        return [self.mask]
+
+    def emit(self, ctx):
+        (mask,) = ctx.take(1)
+        return ctx.zeros_f(), mask
+
+
+class GeoDistanceNode(PlanNode):
+    def __init__(self, flat_docs, lat, lon, center_lat, center_lon, radius_m):
+        self.flat_docs = flat_docs
+        self.lat = lat
+        self.lon = lon
+        self.center_lat = np.float32(center_lat)
+        self.center_lon = np.float32(center_lon)
+        self.radius_m = np.float32(radius_m)
+
+    def key(self):
+        return f"geodist[{len(self.flat_docs)}]"
+
+    def arrays(self):
+        return [self.flat_docs, self.lat, self.lon, self.center_lat,
+                self.center_lon, self.radius_m]
+
+    def emit(self, ctx):
+        flat_docs, lat, lon, clat, clon, radius = ctx.take(6)
+        d = mask_ops.haversine_distance_m(lat, lon, clat, clon)
+        return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(d <= radius)
+
+
+class GeoBoxNode(PlanNode):
+    def __init__(self, flat_docs, lat, lon, top, left, bottom, right):
+        self.flat_docs = flat_docs
+        self.lat = lat
+        self.lon = lon
+        self.box = np.asarray([top, left, bottom, right], dtype=np.float32)
+
+    def key(self):
+        return f"geobox[{len(self.flat_docs)}]"
+
+    def arrays(self):
+        return [self.flat_docs, self.lat, self.lon, self.box]
+
+    def emit(self, ctx):
+        flat_docs, lat, lon, box = ctx.take(4)
+        top, left, bottom, right = box[0], box[1], box[2], box[3]
+        in_lat = (lat <= top) & (lat >= bottom)
+        crosses = left > right
+        in_lon = jnp.where(crosses, (lon >= left) | (lon <= right),
+                           (lon >= left) & (lon <= right))
+        return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(in_lat & in_lon)
+
+
+# ---------------------------------------------------------------------------
+# Combiners
+# ---------------------------------------------------------------------------
+
+
+class BoolNode(PlanNode):
+    """BooleanQuery semantics (org.apache.lucene.search.BooleanQuery as used
+    by index/query/BoolQueryBuilder): score = sum of matching scoring
+    clauses; filters gate without scoring; minimum_should_match applies to
+    should when must/filter present (default 0) else 1."""
+
+    def __init__(self, must: List[PlanNode], filter_: List[PlanNode],
+                 should: List[PlanNode], must_not: List[PlanNode],
+                 min_should_match: int, boost: float = 1.0):
+        self.must = must
+        self.filter = filter_
+        self.should = should
+        self.must_not = must_not
+        self.msm = np.float32(min_should_match)
+        self.boost = np.float32(boost)
+
+    def key(self):
+        return (f"bool[{len(self.must)},{len(self.filter)},{len(self.should)},"
+                f"{len(self.must_not)}](" +
+                ",".join(c.key() for c in self.children()) + ")")
+
+    def children(self):
+        return self.must + self.filter + self.should + self.must_not
+
+    def arrays(self):
+        return [self.msm, self.boost]
+
+    def emit(self, ctx):
+        msm, boost = ctx.take(2)
+        matched = ctx.seg["live1"]
+        scores = ctx.zeros_f()
+        for c in self.must:
+            s, m = c.emit(ctx)
+            scores = scores + s
+            matched = matched & m
+        for c in self.filter:
+            _, m = c.emit(ctx)
+            matched = matched & m
+        if self.should:
+            s_count = ctx.zeros_f()
+            for c in self.should:
+                s, m = c.emit(ctx)
+                scores = scores + jnp.where(m, s, 0.0)
+                s_count = s_count + m.astype(jnp.float32)
+            matched = matched & (s_count >= msm)
+        for c in self.must_not:
+            _, m = c.emit(ctx)
+            matched = matched & ~m
+        return jnp.where(matched, scores * boost, 0.0).astype(jnp.float32), matched
+
+
+class ConstantScoreNode(PlanNode):
+    def __init__(self, child: PlanNode, boost: float = 1.0):
+        self.child = child
+        self.boost = np.float32(boost)
+
+    def key(self):
+        return f"const({self.child.key()})"
+
+    def children(self):
+        return [self.child]
+
+    def arrays(self):
+        return [self.boost]
+
+    def emit(self, ctx):
+        (boost,) = ctx.take(1)
+        _, m = self.child.emit(ctx)
+        return jnp.where(m, boost, 0.0).astype(jnp.float32), m
+
+
+class BoostNode(PlanNode):
+    def __init__(self, child: PlanNode, boost: float):
+        self.child = child
+        self.boost = np.float32(boost)
+
+    def key(self):
+        return f"boost({self.child.key()})"
+
+    def children(self):
+        return [self.child]
+
+    def arrays(self):
+        return [self.boost]
+
+    def emit(self, ctx):
+        (boost,) = ctx.take(1)
+        s, m = self.child.emit(ctx)
+        return s * boost, m
+
+
+class DisMaxNode(PlanNode):
+    def __init__(self, nodes: List[PlanNode], tie_breaker: float = 0.0):
+        self.nodes = nodes
+        self.tie_breaker = np.float32(tie_breaker)
+
+    def key(self):
+        return "dismax(" + ",".join(c.key() for c in self.nodes) + ")"
+
+    def children(self):
+        return self.nodes
+
+    def arrays(self):
+        return [self.tie_breaker]
+
+    def emit(self, ctx):
+        (tie,) = ctx.take(1)
+        best = None
+        total = ctx.zeros_f()
+        matched = ctx.zeros_b()
+        for c in self.nodes:
+            s, m = c.emit(ctx)
+            s = jnp.where(m, s, 0.0)
+            best = s if best is None else jnp.maximum(best, s)
+            total = total + s
+            matched = matched | m
+        scores = best + tie * (total - best)
+        return scores, matched
+
+
+class FunctionScoreNode(PlanNode):
+    """function_score (index/query/functionscore/): child score combined
+    with functions. Round-1 functions: weight, field_value_factor,
+    random_score (deterministic hash) — combined multiplicatively; boost_mode
+    multiply/replace/sum."""
+
+    MODES = ("multiply", "replace", "sum", "avg", "max", "min")
+
+    def __init__(self, child: PlanNode, factor_columns: List, weight: float,
+                 boost_mode: str = "multiply"):
+        self.child = child
+        self.factor_columns = factor_columns  # list of dense [nd1] f32 factors
+        self.weight = np.float32(weight)
+        self.boost_mode = boost_mode
+
+    def key(self):
+        return f"fscore[{len(self.factor_columns)},{self.boost_mode}]({self.child.key()})"
+
+    def children(self):
+        return [self.child]
+
+    def arrays(self):
+        return [self.weight] + list(self.factor_columns)
+
+    def emit(self, ctx):
+        taken = ctx.take(1 + len(self.factor_columns))
+        weight, cols = taken[0], taken[1:]
+        s, m = self.child.emit(ctx)
+        fn = jnp.full_like(s, 1.0) * weight
+        for col in cols:
+            fn = fn * col
+        if self.boost_mode == "multiply":
+            out = s * fn
+        elif self.boost_mode == "replace":
+            out = fn
+        elif self.boost_mode == "sum":
+            out = s + fn
+        elif self.boost_mode == "avg":
+            out = (s + fn) / 2.0
+        elif self.boost_mode == "max":
+            out = jnp.maximum(s, fn)
+        else:
+            out = jnp.minimum(s, fn)
+        return jnp.where(m, out, 0.0).astype(jnp.float32), m
+
+
+# ---------------------------------------------------------------------------
+# Compile + run
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_for(structure_key: str, plan_holder) -> "jax.stages.Wrapped":
+    plan = plan_holder.plan
+
+    @jax.jit
+    def run(seg_arrays, plan_arrays):
+        ctx = EmitCtx(seg_arrays, plan_arrays)
+        scores, matched = plan.emit(ctx)
+        matched = matched & ctx.seg["live1"]
+        return scores, matched
+
+    return run
+
+
+class _PlanHolder:
+    """Hashable wrapper so lru_cache keys on the structure string only; the
+    held plan is the FIRST plan seen with that structure (same trace)."""
+
+    __slots__ = ("plan", "_key")
+
+    def __init__(self, plan: PlanNode):
+        self.plan = plan
+        self._key = plan.key()
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _PlanHolder) and self._key == other._key
+
+
+def execute(seg_device: dict, plan: PlanNode):
+    """Run a plan against one segment's device arrays.
+
+    seg_device must contain block_docs, block_tfs, norms, live1.
+    Returns (scores f32[nd1], matched bool[nd1]) on device.
+    """
+    shape_sig = f"@nd{seg_device['norms'].shape}"
+    run = _compiled_for(plan.key() + shape_sig, _PlanHolder(plan))
+    return run(seg_device, plan.flat_arrays())

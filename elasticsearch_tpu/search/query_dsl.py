@@ -1,0 +1,1163 @@
+"""The query DSL: JSON -> QueryBuilder tree -> per-segment PlanNode.
+
+Role model: the 60+ builders under core/.../index/query/ (parsed via
+``AbstractQueryBuilder``/``QueryShardContext``, two-phase rewrite). Each
+builder here mirrors one reference builder's JSON shape and semantics;
+``to_plan(shard_ctx, segment)`` replaces ``QueryBuilder.toQuery`` — it
+resolves terms/ordinals against the segment and produces plan nodes
+(search/plan.py) instead of Lucene Query objects.
+
+Multi-term expansion (prefix/wildcard/fuzzy/regexp) happens host-side
+against the segment's sorted term dictionary, exactly where Lucene expands
+against its terms dict.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    ParsingException,
+    QueryShardException,
+)
+from elasticsearch_tpu.mapper.field_types import (
+    BooleanFieldType,
+    DateFieldType,
+    GeoPointFieldType,
+    IpFieldType,
+    KeywordFieldType,
+    NumberFieldType,
+    TextFieldType,
+)
+from elasticsearch_tpu.ops.scoring import bm25_idf
+from elasticsearch_tpu.search import plan as P
+
+# default max_expansions for multi-term queries (MultiTermQuery rewrites)
+MAX_EXPANSIONS = 1024
+
+
+class ShardQueryContext:
+    """Per-shard query context (≙ QueryShardContext): mapper + analyzers."""
+
+    def __init__(self, mapper_service):
+        self.mapper_service = mapper_service
+        self.analyzers = mapper_service.analyzers
+
+    def field_type(self, name: str):
+        return self.mapper_service.field_type(name)
+
+    def default_fields(self) -> List[str]:
+        # all text fields (the reference's `_all` is deprecated in 6.0; we
+        # approximate all_fields mode: query every text field)
+        return [
+            f for f, ft in self.mapper_service.mapper.fields.items()
+            if isinstance(ft, TextFieldType)
+        ]
+
+
+def _pad_pow2(lst, pad_value, min_len=8, dtype=None):
+    n = max(min_len, 1)
+    while n < len(lst):
+        n *= 2
+    arr = list(lst) + [pad_value] * (n - len(lst))
+    return np.asarray(arr, dtype=dtype)
+
+
+def term_blocks_arrays(segment, weighted_terms):
+    """weighted_terms: list of (field, token, boost). Builds the gather
+    arrays for ScoreTermsNode; returns None if no term exists in segment."""
+    blocks, weights, rows, avgdls = [], [], [], []
+    n_terms_present = 0
+    for field, token, boost in weighted_terms:
+        tid = segment.term_id(field, token)
+        if tid < 0:
+            continue
+        n_terms_present += 1
+        doc_count = segment.field_stats.get(field, {}).get("doc_count", 0)
+        idf = bm25_idf(int(segment.term_doc_freq[tid]), doc_count)
+        row = segment.field_norm_idx.get(field, 0)
+        avgdl = segment.field_avgdl(field)
+        start = int(segment.term_block_start[tid])
+        for bi in range(start, start + int(segment.term_block_count[tid])):
+            blocks.append(bi)
+            weights.append(idf * boost)
+            rows.append(row)
+            avgdls.append(avgdl)
+    return {
+        "q_blocks": _pad_pow2(blocks, 0, dtype=np.int32),
+        "q_weights": _pad_pow2(weights, 0.0, dtype=np.float32),
+        "q_norm_rows": _pad_pow2(rows, 0, dtype=np.int32),
+        "q_avgdl": _pad_pow2(avgdls, 1.0, dtype=np.float32),
+        "q_valid": _pad_pow2([True] * len(blocks), False, dtype=bool),
+        "n_present": n_terms_present,
+    }
+
+
+def score_terms_node(segment, weighted_terms, min_match=1) -> P.PlanNode:
+    arrs = term_blocks_arrays(segment, weighted_terms)
+    if arrs["n_present"] == 0 or min_match > arrs["n_present"]:
+        return P.MatchNoneNode()
+    return P.ScoreTermsNode(
+        arrs["q_blocks"], arrs["q_weights"], arrs["q_norm_rows"],
+        arrs["q_avgdl"], arrs["q_valid"], min_match,
+    )
+
+
+def _numeric_csr(segment, field):
+    col = segment.numeric_columns.get(field)
+    if col is None:
+        return None
+    docs = segment.device_column(f"num.{field}.docs", lambda: col.flat_docs)
+    vals = segment.device_column(f"num.{field}.vals", lambda: col.flat_values)
+    return docs, vals, col
+
+
+def _ordinal_csr(segment, field):
+    col = segment.ordinal_columns.get(field)
+    if col is None:
+        return None
+    docs = segment.device_column(f"ord.{field}.docs", lambda: col.flat_docs)
+    ords = segment.device_column(f"ord.{field}.ords", lambda: col.flat_ords)
+    return docs, ords, col
+
+
+# ---------------------------------------------------------------------------
+# Builders
+# ---------------------------------------------------------------------------
+
+
+class QueryBuilder:
+    name = "base"
+
+    def __init__(self, boost: float = 1.0, _name: Optional[str] = None):
+        self.boost = boost
+        self.query_name = _name
+
+    def to_plan(self, ctx: ShardQueryContext, segment) -> P.PlanNode:
+        raise NotImplementedError
+
+    def _wrap_boost(self, node: P.PlanNode) -> P.PlanNode:
+        if self.boost != 1.0:
+            return P.BoostNode(node, self.boost)
+        return node
+
+
+class MatchAllQueryBuilder(QueryBuilder):
+    name = "match_all"
+
+    def to_plan(self, ctx, segment):
+        return P.MatchAllNode(self.boost)
+
+
+class MatchNoneQueryBuilder(QueryBuilder):
+    name = "match_none"
+
+    def to_plan(self, ctx, segment):
+        return P.MatchNoneNode()
+
+
+class MatchQueryBuilder(QueryBuilder):
+    """Full-text match (index/query/MatchQueryBuilder): analyze the text
+    with the field's search analyzer; OR (default) or AND over terms;
+    minimum_should_match supported."""
+
+    name = "match"
+
+    def __init__(self, field: str, query, operator: str = "or",
+                 minimum_should_match: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.query = query
+        self.operator = operator.lower()
+        self.minimum_should_match = minimum_should_match
+
+    def _analyzed_terms(self, ctx) -> List[str]:
+        ft = ctx.field_type(self.field)
+        if ft is None:
+            return [str(self.query)]
+        if isinstance(ft, TextFieldType):
+            return ft.query_terms(self.query, ctx.analyzers)
+        return ft.index_terms(self.query, ctx.analyzers) or [
+            ft.term_for_query(self.query, ctx.analyzers)
+        ]
+
+    def to_plan(self, ctx, segment):
+        ft = ctx.field_type(self.field)
+        if ft is not None and isinstance(ft, NumberFieldType):
+            return TermQueryBuilder(self.field, self.query, boost=self.boost).to_plan(ctx, segment)
+        if ft is not None and isinstance(ft, (DateFieldType, BooleanFieldType, IpFieldType)):
+            return TermQueryBuilder(self.field, self.query, boost=self.boost).to_plan(ctx, segment)
+        terms = self._analyzed_terms(ctx)
+        if not terms:
+            return P.MatchNoneNode()
+        if self.operator == "and":
+            min_match = len(terms)
+        else:
+            min_match = parse_min_should_match(self.minimum_should_match, len(terms)) or 1
+        node = score_terms_node(
+            segment, [(self.field, t, 1.0) for t in terms], min_match
+        )
+        return self._wrap_boost(node)
+
+
+class MatchPhraseQueryBuilder(QueryBuilder):
+    name = "match_phrase"
+
+    def __init__(self, field: str, query, slop: int = 0, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.query = query
+        self.slop = slop
+
+    def to_plan(self, ctx, segment):
+        ft = ctx.field_type(self.field)
+        if isinstance(ft, TextFieldType):
+            terms = ft.query_terms(self.query, ctx.analyzers)
+        else:
+            terms = [str(self.query)]
+        if not terms:
+            return P.MatchNoneNode()
+        if len(terms) == 1:
+            return MatchQueryBuilder(self.field, self.query, boost=self.boost).to_plan(ctx, segment)
+        # host-side position intersection (SURVEY §7: strings/pointer-chasing
+        # stay host-side); scored on device by phrase frequency
+        tids = [segment.term_id(self.field, t) for t in terms]
+        if any(t < 0 for t in tids):
+            return P.MatchNoneNode()
+        pos_maps = [segment.positions.get(t, {}) for t in tids]
+        candidates = set(pos_maps[0])
+        for pm in pos_maps[1:]:
+            candidates &= set(pm)
+        docs, freqs = [], []
+        for doc in sorted(candidates):
+            freq = _phrase_freq([pm[doc] for pm in pos_maps], self.slop)
+            if freq > 0:
+                docs.append(doc)
+                freqs.append(float(freq))
+        if not docs:
+            return P.MatchNoneNode()
+        # phrase weight: sum of term idfs (Lucene PhraseQuery uses combined
+        # term stats similarly)
+        doc_count = segment.field_stats.get(self.field, {}).get("doc_count", 0)
+        weight = sum(
+            bm25_idf(int(segment.term_doc_freq[t]), doc_count) for t in tids
+        ) * self.boost
+        sentinel = segment.nd_pad
+        return P.PhraseScoreNode(
+            _pad_pow2(docs, sentinel, dtype=np.int32),
+            _pad_pow2(freqs, 0.0, dtype=np.float32),
+            weight,
+            segment.field_norm_idx.get(self.field, 0),
+            segment.field_avgdl(self.field),
+        )
+
+
+def _phrase_freq(positions_per_term: List[np.ndarray], slop: int) -> int:
+    """Exact phrase (slop=0) or sloppy within-window match count."""
+    first = positions_per_term[0]
+    count = 0
+    if slop == 0:
+        others = [set(p.tolist()) for p in positions_per_term[1:]]
+        for p in first.tolist():
+            if all((p + i + 1) in s for i, s in enumerate(others)):
+                count += 1
+        return count
+    # sloppy: greedy window check (approximation of Lucene's sloppy freq)
+    for p in first.tolist():
+        ok = True
+        prev = p
+        for i, arr in enumerate(positions_per_term[1:]):
+            target = p + i + 1
+            diffs = np.abs(arr - target)
+            if diffs.size == 0 or diffs.min() > slop:
+                ok = False
+                break
+        if ok:
+            count += 1
+    return count
+
+
+class MatchPhrasePrefixQueryBuilder(QueryBuilder):
+    name = "match_phrase_prefix"
+
+    def __init__(self, field: str, query, max_expansions: int = 50, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.query = query
+        self.max_expansions = max_expansions
+
+    def to_plan(self, ctx, segment):
+        ft = ctx.field_type(self.field)
+        terms = (ft.query_terms(self.query, ctx.analyzers)
+                 if isinstance(ft, TextFieldType) else [str(self.query)])
+        if not terms:
+            return P.MatchNoneNode()
+        prefix = terms[-1]
+        expansions = [t for t, _ in segment.terms_for_field(self.field)
+                      if t.startswith(prefix)][: self.max_expansions]
+        if len(terms) == 1:
+            if not expansions:
+                return P.MatchNoneNode()
+            return score_terms_node(
+                segment, [(self.field, t, self.boost) for t in expansions], 1
+            )
+        subs = []
+        for exp in expansions:
+            phrase_terms = terms[:-1] + [exp]
+            subs.append(MatchPhraseQueryBuilder(
+                self.field, " ".join(phrase_terms), boost=self.boost
+            ))
+        if not subs:
+            return P.MatchNoneNode()
+        return BoolQueryBuilder(should=subs).to_plan(ctx, segment)
+
+
+class MultiMatchQueryBuilder(QueryBuilder):
+    """multi_match (index/query/MultiMatchQueryBuilder): best_fields
+    (dis_max over per-field match, default), most_fields (sum), and
+    cross_fields (approximated as most_fields)."""
+
+    name = "multi_match"
+
+    def __init__(self, query, fields: List[str], type_: str = "best_fields",
+                 operator: str = "or", tie_breaker: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.query = query
+        self.fields = fields
+        self.type = type_
+        self.operator = operator
+        self.tie_breaker = tie_breaker
+
+    def to_plan(self, ctx, segment):
+        field_boosts = []
+        for f in self.fields:
+            if "^" in f:
+                name, b = f.split("^", 1)
+                for resolved in ctx.mapper_service.mapper.simple_match_to_fields(name) or [name]:
+                    field_boosts.append((resolved, float(b)))
+            else:
+                for resolved in ctx.mapper_service.mapper.simple_match_to_fields(f) or [f]:
+                    field_boosts.append((resolved, 1.0))
+        per_field = [
+            MatchQueryBuilder(f, self.query, operator=self.operator, boost=b)
+            .to_plan(ctx, segment)
+            for f, b in field_boosts
+        ]
+        per_field = [n for n in per_field if not isinstance(n, P.MatchNoneNode)]
+        if not per_field:
+            return P.MatchNoneNode()
+        if self.type in ("best_fields", "phrase", "phrase_prefix"):
+            node = P.DisMaxNode(per_field, self.tie_breaker)
+        else:  # most_fields / cross_fields: sum of field scores
+            node = P.BoolNode([], [], per_field, [], 1)
+        return self._wrap_boost(node)
+
+
+class TermQueryBuilder(QueryBuilder):
+    name = "term"
+
+    def __init__(self, field: str, value, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.value = value
+
+    def to_plan(self, ctx, segment):
+        ft = ctx.field_type(self.field)
+        if isinstance(ft, NumberFieldType) or isinstance(ft, DateFieldType):
+            csr = _numeric_csr(segment, self.field)
+            if csr is None:
+                return P.MatchNoneNode()
+            docs, vals, _ = csr
+            v = ft.numeric_for_query(self.value)
+            return P.ConstantScoreNode(P.NumericTermsNode(
+                docs, vals, _pad_pow2([v], np.nan, min_len=1, dtype=np.float64)
+            ), self.boost)
+        if isinstance(ft, IpFieldType):
+            csr = _numeric_csr(segment, self.field)
+            if csr is None:
+                return P.MatchNoneNode()
+            docs, vals, _ = csr
+            from elasticsearch_tpu.mapper.field_types import parse_ip
+
+            v = float(parse_ip(self.value))
+            return P.ConstantScoreNode(P.NumericTermsNode(
+                docs, vals, _pad_pow2([v], np.nan, min_len=1, dtype=np.float64)
+            ), self.boost)
+        # term against the inverted index (keyword/boolean/text-raw-token)
+        token = (ft.term_for_query(self.value, ctx.analyzers)
+                 if ft is not None and not isinstance(ft, TextFieldType)
+                 else str(self.value))
+        node = score_terms_node(segment, [(self.field, token, self.boost)], 1)
+        return node
+
+
+class TermsQueryBuilder(QueryBuilder):
+    name = "terms"
+
+    def __init__(self, field: str, values: List, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.values = values
+
+    def to_plan(self, ctx, segment):
+        ft = ctx.field_type(self.field)
+        if isinstance(ft, (NumberFieldType, DateFieldType)):
+            csr = _numeric_csr(segment, self.field)
+            if csr is None:
+                return P.MatchNoneNode()
+            docs, vals, _ = csr
+            nums = [ft.numeric_for_query(v) for v in self.values]
+            return P.ConstantScoreNode(P.NumericTermsNode(
+                docs, vals,
+                _pad_pow2(nums, np.nan, min_len=1, dtype=np.float64),
+            ), self.boost)
+        # constant-score terms over ordinals if the field has them, else
+        # inverted-index disjunction
+        col = segment.ordinal_columns.get(self.field)
+        if col is not None:
+            csr = _ordinal_csr(segment, self.field)
+            docs, ords, col = csr
+            norm = (ft.term_for_query if ft is not None else (lambda v, a: str(v)))
+            o = [col.ord_of(norm(v, ctx.analyzers)) for v in self.values]
+            o = [x for x in o if x >= 0]
+            if not o:
+                return P.MatchNoneNode()
+            return P.ConstantScoreNode(P.OrdTermsNode(
+                docs, ords, _pad_pow2(o, -1, min_len=1, dtype=np.int32)
+            ), self.boost)
+        tokens = [
+            (ft.term_for_query(v, ctx.analyzers) if ft is not None else str(v))
+            for v in self.values
+        ]
+        node = score_terms_node(
+            segment, [(self.field, t, self.boost) for t in tokens], 1
+        )
+        return P.ConstantScoreNode(node, self.boost)
+
+
+class RangeQueryBuilder(QueryBuilder):
+    name = "range"
+
+    def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None,
+                 format: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
+
+    def to_plan(self, ctx, segment):
+        ft = ctx.field_type(self.field)
+        if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType, IpFieldType)) or (
+            ft is None and segment.numeric_columns.get(self.field) is not None
+        ):
+            csr = _numeric_csr(segment, self.field)
+            if csr is None:
+                return P.MatchNoneNode()
+            docs, vals, _ = csr
+            conv = (ft.numeric_for_query if ft is not None else float)
+            if isinstance(ft, IpFieldType):
+                from elasticsearch_tpu.mapper.field_types import parse_ip
+                conv = lambda v: float(parse_ip(v))  # noqa: E731
+            lo = -np.inf
+            hi = np.inf
+            if self.gte is not None:
+                lo = conv(self.gte)
+            if self.gt is not None:
+                lo = np.nextafter(conv(self.gt), np.inf)
+            if self.lte is not None:
+                hi = conv(self.lte)
+            if self.lt is not None:
+                hi = np.nextafter(conv(self.lt), -np.inf)
+            return P.ConstantScoreNode(P.NumericRangeNode(docs, vals, lo, hi), self.boost)
+        col = segment.ordinal_columns.get(self.field)
+        if col is not None:
+            docs, ords, col = _ordinal_csr(segment, self.field)
+            lo_ord, hi_ord = col.ord_range(
+                str(self.gte) if self.gte is not None else (
+                    str(self.gt) if self.gt is not None else None),
+                str(self.lte) if self.lte is not None else (
+                    str(self.lt) if self.lt is not None else None),
+                include_lo=self.gt is None,
+                include_hi=self.lt is None,
+            )
+            return P.ConstantScoreNode(P.OrdRangeNode(docs, ords, lo_ord, hi_ord), self.boost)
+        raise QueryShardException(
+            f"field [{self.field}] does not support range queries "
+            "(no doc values in this segment)"
+        )
+
+
+class ExistsQueryBuilder(QueryBuilder):
+    name = "exists"
+
+    def __init__(self, field: str, **kw):
+        super().__init__(**kw)
+        self.field = field
+
+    def to_plan(self, ctx, segment):
+        fields = ctx.mapper_service.mapper.simple_match_to_fields(self.field) or [self.field]
+        masks = []
+        for f in fields:
+            if f in segment.exists_masks:
+                masks.append(segment.device_column(
+                    f"exists.{f}",
+                    lambda f=f: np.concatenate(
+                        [segment.exists_masks[f], np.zeros(1, dtype=bool)]
+                    ),
+                ))
+        if not masks:
+            return P.MatchNoneNode()
+        combined = masks[0]
+        for m in masks[1:]:
+            combined = combined | m
+        return P.ConstantScoreNode(P.DenseMaskNode(combined, f"exists:{self.field}"), self.boost)
+
+
+class IdsQueryBuilder(QueryBuilder):
+    name = "ids"
+
+    def __init__(self, values: List[str], **kw):
+        super().__init__(**kw)
+        self.values = values
+
+    def to_plan(self, ctx, segment):
+        id_map = segment.id_to_doc()
+        docs = [id_map[v] for v in self.values if v in id_map]
+        if not docs:
+            return P.MatchNoneNode()
+        mask = np.zeros(segment.nd_pad + 1, dtype=bool)
+        for d in docs:
+            mask[d] = True
+        return P.ConstantScoreNode(P.DenseMaskNode(mask, "ids"), self.boost)
+
+
+class MultiTermExpandingBuilder(QueryBuilder):
+    """Shared base for prefix/wildcard/regexp/fuzzy: expand against the
+    segment term dictionary, then constant-score disjunction (Lucene
+    MultiTermQuery CONSTANT_SCORE rewrite)."""
+
+    def matches(self, token: str) -> bool:
+        raise NotImplementedError
+
+    def __init__(self, field: str, **kw):
+        super().__init__(**kw)
+        self.field = field
+
+    def to_plan(self, ctx, segment):
+        expansions = [
+            t for t, _ in segment.terms_for_field(self.field) if self.matches(t)
+        ][:MAX_EXPANSIONS]
+        if not expansions:
+            return P.MatchNoneNode()
+        node = score_terms_node(
+            segment, [(self.field, t, 1.0) for t in expansions], 1
+        )
+        return P.ConstantScoreNode(node, self.boost)
+
+
+class PrefixQueryBuilder(MultiTermExpandingBuilder):
+    name = "prefix"
+
+    def __init__(self, field: str, value: str, **kw):
+        super().__init__(field, **kw)
+        self.value = str(value)
+
+    def matches(self, token):
+        return token.startswith(self.value)
+
+
+class WildcardQueryBuilder(MultiTermExpandingBuilder):
+    name = "wildcard"
+
+    def __init__(self, field: str, value: str, **kw):
+        super().__init__(field, **kw)
+        self.value = str(value)
+
+    def matches(self, token):
+        return fnmatch.fnmatchcase(token, self.value)
+
+
+class RegexpQueryBuilder(MultiTermExpandingBuilder):
+    name = "regexp"
+
+    def __init__(self, field: str, value: str, **kw):
+        super().__init__(field, **kw)
+        try:
+            self._rx = re.compile(value)
+        except re.error as e:
+            raise ParsingException(f"failed to parse regexp [{value}]: {e}") from e
+
+    def matches(self, token):
+        return self._rx.fullmatch(token) is not None
+
+
+def _levenshtein_leq(a: str, b: str, k: int) -> bool:
+    """Edit distance <= k with early exit (banded DP)."""
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            row_min = min(row_min, cur[j])
+        if row_min > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
+
+
+class FuzzyQueryBuilder(MultiTermExpandingBuilder):
+    name = "fuzzy"
+
+    def __init__(self, field: str, value: str, fuzziness="AUTO",
+                 prefix_length: int = 0, **kw):
+        super().__init__(field, **kw)
+        self.value = str(value)
+        self.prefix_length = prefix_length
+        if fuzziness in ("AUTO", "auto", None):
+            n = len(self.value)
+            self.max_edits = 0 if n <= 2 else (1 if n <= 5 else 2)
+        else:
+            self.max_edits = int(fuzziness)
+
+    def matches(self, token):
+        if self.prefix_length and not token.startswith(self.value[: self.prefix_length]):
+            return False
+        return _levenshtein_leq(token, self.value, self.max_edits)
+
+
+class BoolQueryBuilder(QueryBuilder):
+    name = "bool"
+
+    def __init__(self, must=None, filter=None, should=None, must_not=None,
+                 minimum_should_match=None, **kw):
+        super().__init__(**kw)
+        self.must = must or []
+        self.filter = filter or []
+        self.should = should or []
+        self.must_not = must_not or []
+        self.minimum_should_match = minimum_should_match
+
+    def to_plan(self, ctx, segment):
+        must = [q.to_plan(ctx, segment) for q in self.must]
+        filter_ = [q.to_plan(ctx, segment) for q in self.filter]
+        should = [q.to_plan(ctx, segment) for q in self.should]
+        must_not = [q.to_plan(ctx, segment) for q in self.must_not]
+        if self.minimum_should_match is not None:
+            msm = parse_min_should_match(self.minimum_should_match, len(should))
+        elif not self.must and not self.filter:
+            msm = 1 if should else 0
+        else:
+            msm = 0
+        return P.BoolNode(must, filter_, should, must_not, msm, self.boost)
+
+
+class ConstantScoreQueryBuilder(QueryBuilder):
+    name = "constant_score"
+
+    def __init__(self, filter: QueryBuilder, **kw):
+        super().__init__(**kw)
+        self.filter = filter
+
+    def to_plan(self, ctx, segment):
+        return P.ConstantScoreNode(self.filter.to_plan(ctx, segment), self.boost)
+
+
+class DisMaxQueryBuilder(QueryBuilder):
+    name = "dis_max"
+
+    def __init__(self, queries: List[QueryBuilder], tie_breaker: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.queries = queries
+        self.tie_breaker = tie_breaker
+
+    def to_plan(self, ctx, segment):
+        nodes = [q.to_plan(ctx, segment) for q in self.queries]
+        return self._wrap_boost(P.DisMaxNode(nodes, self.tie_breaker))
+
+
+class FunctionScoreQueryBuilder(QueryBuilder):
+    name = "function_score"
+
+    def __init__(self, query: QueryBuilder, functions: List[dict],
+                 boost_mode: str = "multiply", score_mode: str = "multiply", **kw):
+        super().__init__(**kw)
+        self.query = query
+        self.functions = functions
+        self.boost_mode = boost_mode
+        self.score_mode = score_mode
+
+    def to_plan(self, ctx, segment):
+        child = self.query.to_plan(ctx, segment)
+        weight = 1.0
+        factor_columns = []
+        for fn in self.functions:
+            if "weight" in fn and len(fn) == 1:
+                weight *= float(fn["weight"])
+                continue
+            if "field_value_factor" in fn:
+                spec = fn["field_value_factor"]
+                col = segment.numeric_columns.get(spec["field"])
+                factor = float(spec.get("factor", 1.0))
+                missing = float(spec.get("missing", 1.0))
+                modifier = spec.get("modifier", "none")
+                if col is None:
+                    vals = np.full(segment.nd_pad + 1, missing, dtype=np.float32)
+                else:
+                    base = np.where(col.exists, col.first_value, missing)
+                    vals = np.concatenate([base, [missing]]).astype(np.float32)
+                vals = vals * factor
+                if modifier == "log1p":
+                    vals = np.log1p(np.maximum(vals, 0))
+                elif modifier == "ln":
+                    vals = np.log(np.maximum(vals, 1e-9))
+                elif modifier == "sqrt":
+                    vals = np.sqrt(np.maximum(vals, 0))
+                elif modifier == "square":
+                    vals = vals * vals
+                elif modifier == "reciprocal":
+                    vals = 1.0 / np.maximum(vals, 1e-9)
+                factor_columns.append(vals.astype(np.float32))
+                if "weight" in fn:
+                    weight *= float(fn["weight"])
+            elif "random_score" in fn:
+                seed = int(fn["random_score"].get("seed", 0))
+                rng = np.random.RandomState(seed if seed else 42)
+                factor_columns.append(
+                    rng.uniform(0, 1, segment.nd_pad + 1).astype(np.float32)
+                )
+            elif "weight" in fn:
+                weight *= float(fn["weight"])
+            else:
+                raise ParsingException(
+                    f"unsupported function_score function: {sorted(fn)}"
+                )
+        return self._wrap_boost(P.FunctionScoreNode(
+            child, factor_columns, weight, self.boost_mode
+        ))
+
+
+class QueryStringQueryBuilder(QueryBuilder):
+    """Simplified query_string: supports `field:value`, quoted phrases,
+    AND/OR/NOT, +/-, wildcards in terms. (The reference's full Lucene
+    syntax is larger; this covers the common subset. simple_query_string
+    maps here too.)"""
+
+    name = "query_string"
+
+    def __init__(self, query: str, default_field: Optional[str] = None,
+                 fields: Optional[List[str]] = None,
+                 default_operator: str = "or", **kw):
+        super().__init__(**kw)
+        self.query = query
+        self.default_field = default_field
+        self.fields = fields
+        self.default_operator = default_operator.lower()
+
+    def _leaf(self, field: Optional[str], text: str, is_phrase: bool, ctx) -> QueryBuilder:
+        if field is None:
+            fields = self.fields or (
+                [self.default_field] if self.default_field else None
+            )
+            if fields is None:
+                fields = ctx.default_fields() or ["*"]
+            if len(fields) > 1:
+                return MultiMatchQueryBuilder(text, fields)
+            field = fields[0]
+        if is_phrase:
+            return MatchPhraseQueryBuilder(field, text)
+        if "*" in text or "?" in text:
+            return WildcardQueryBuilder(field, text)
+        return MatchQueryBuilder(field, text)
+
+    def to_plan(self, ctx, segment):
+        tokens = re.findall(r'\S*"[^"]*"|\S+', self.query)
+        # first pass: clauses with modifiers; AND marks its neighbors as must
+        clauses = []  # list of [builder, kind] where kind in must/should/must_not
+        i = 0
+        while i < len(tokens):
+            tok = tokens[i]
+            if tok.upper() == "AND":
+                if clauses:
+                    clauses[-1][1] = "must" if clauses[-1][1] == "should" else clauses[-1][1]
+                # mark: next clause must too
+                i += 1
+                if i < len(tokens):
+                    nxt, kind = self._clause(tokens[i], ctx)
+                    if nxt is not None:
+                        clauses.append([nxt, "must" if kind == "should" else kind])
+                    i += 1
+                continue
+            if tok.upper() == "OR":
+                i += 1
+                continue
+            if tok.upper() == "NOT":
+                i += 1
+                if i < len(tokens):
+                    qb, _ = self._clause(tokens[i], ctx)
+                    if qb is not None:
+                        clauses.append([qb, "must_not"])
+                    i += 1
+                continue
+            qb, kind = self._clause(tok, ctx)
+            if qb is not None:
+                clauses.append([qb, kind])
+            i += 1
+        must = [c for c, k in clauses if k == "must"]
+        should = [c for c, k in clauses if k == "should"]
+        must_not = [c for c, k in clauses if k == "must_not"]
+        if self.default_operator == "and" and should:
+            must.extend(should)
+            should = []
+        return BoolQueryBuilder(
+            must=must, should=should, must_not=must_not, boost=self.boost
+        ).to_plan(ctx, segment)
+
+    def _clause(self, tok: str, ctx):
+        """-> (builder or None, kind)."""
+        kind = "should"
+        if tok.startswith("+"):
+            tok, kind = tok[1:], "must"
+        elif tok.startswith("-"):
+            tok, kind = tok[1:], "must_not"
+        field = None
+        if ":" in tok and not tok.startswith('"'):
+            field, tok = tok.split(":", 1)
+            if not tok:
+                return None, kind
+        is_phrase = tok.startswith('"') and tok.endswith('"') and len(tok) > 1
+        text = tok.strip('"')
+        if not text:
+            return None, kind
+        return self._leaf(field, text, is_phrase, ctx), kind
+
+
+class GeoDistanceQueryBuilder(QueryBuilder):
+    name = "geo_distance"
+
+    def __init__(self, field: str, center, distance, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.center = GeoPointFieldType.parse_point(center)
+        self.distance_m = parse_distance(distance)
+
+    def to_plan(self, ctx, segment):
+        col = segment.geo_columns.get(self.field)
+        if col is None:
+            return P.MatchNoneNode()
+        docs = segment.device_column(f"geo.{self.field}.docs", lambda: col.flat_docs)
+        lat = segment.device_column(f"geo.{self.field}.lat", lambda: col.lat)
+        lon = segment.device_column(f"geo.{self.field}.lon", lambda: col.lon)
+        return P.ConstantScoreNode(P.GeoDistanceNode(
+            docs, lat, lon, self.center[0], self.center[1], self.distance_m
+        ), self.boost)
+
+
+class GeoBoundingBoxQueryBuilder(QueryBuilder):
+    name = "geo_bounding_box"
+
+    def __init__(self, field: str, top_left, bottom_right, **kw):
+        super().__init__(**kw)
+        self.field = field
+        tl = GeoPointFieldType.parse_point(top_left)
+        br = GeoPointFieldType.parse_point(bottom_right)
+        self.top, self.left = tl
+        self.bottom, self.right = br
+
+    def to_plan(self, ctx, segment):
+        col = segment.geo_columns.get(self.field)
+        if col is None:
+            return P.MatchNoneNode()
+        docs = segment.device_column(f"geo.{self.field}.docs", lambda: col.flat_docs)
+        lat = segment.device_column(f"geo.{self.field}.lat", lambda: col.lat)
+        lon = segment.device_column(f"geo.{self.field}.lon", lambda: col.lon)
+        return P.ConstantScoreNode(P.GeoBoxNode(
+            docs, lat, lon, self.top, self.left, self.bottom, self.right
+        ), self.boost)
+
+
+class MoreLikeThisQueryBuilder(QueryBuilder):
+    """more_like_this (index/query/MoreLikeThisQueryBuilder): extract the
+    top-idf terms from the liked text/docs and run a disjunction."""
+
+    name = "more_like_this"
+
+    def __init__(self, fields: List[str], like, max_query_terms: int = 25,
+                 min_term_freq: int = 2, minimum_should_match: str = "30%", **kw):
+        super().__init__(**kw)
+        self.fields = fields
+        self.like = like if isinstance(like, list) else [like]
+        self.max_query_terms = max_query_terms
+        self.min_term_freq = min_term_freq
+        self.minimum_should_match = minimum_should_match
+
+    def to_plan(self, ctx, segment):
+        from collections import Counter
+
+        texts: List[str] = []
+        for item in self.like:
+            if isinstance(item, str):
+                texts.append(item)
+            elif isinstance(item, dict) and "_id" in item:
+                local = segment.id_to_doc().get(item["_id"])
+                if local is not None:
+                    src = segment.sources[local]
+                    for f in self.fields:
+                        v = src.get(f)
+                        if isinstance(v, str):
+                            texts.append(v)
+        selected: List[tuple] = []
+        for field in self.fields:
+            ft = ctx.field_type(field)
+            counts: Counter = Counter()
+            for text in texts:
+                if isinstance(ft, TextFieldType):
+                    counts.update(ft.query_terms(text, ctx.analyzers))
+                else:
+                    counts.update(ctx.analyzers.get("standard").analyze(text))
+            doc_count = segment.field_stats.get(field, {}).get("doc_count", 0)
+            for tok, tf in counts.items():
+                if tf < self.min_term_freq and len(texts) > 0 and len(counts) > 10:
+                    continue
+                tid = segment.term_id(field, tok)
+                if tid < 0:
+                    continue
+                idf = bm25_idf(int(segment.term_doc_freq[tid]), doc_count)
+                selected.append((idf, field, tok))
+        selected.sort(reverse=True)
+        selected = selected[: self.max_query_terms]
+        if not selected:
+            return P.MatchNoneNode()
+        msm = parse_min_should_match(self.minimum_should_match, len(selected)) or 1
+        return self._wrap_boost(score_terms_node(
+            segment, [(f, t, 1.0) for _, f, t in selected], msm
+        ))
+
+
+class NestedQueryBuilder(QueryBuilder):
+    """Flattened-nested approximation: the engine indexes nested objects
+    flattened (object mapping), so a nested query degrades to its inner
+    query on the flattened paths. Cross-object match leakage is the known
+    delta vs the reference's block-join (documented limitation)."""
+
+    name = "nested"
+
+    def __init__(self, path: str, query: QueryBuilder, score_mode: str = "avg", **kw):
+        super().__init__(**kw)
+        self.path = path
+        self.query = query
+
+    def to_plan(self, ctx, segment):
+        return self.query.to_plan(ctx, segment)
+
+
+# ---------------------------------------------------------------------------
+# Parsing (JSON -> builders)
+# ---------------------------------------------------------------------------
+
+
+def parse_distance(d) -> float:
+    """'10km', '500m', number (meters) -> meters."""
+    if isinstance(d, (int, float)):
+        return float(d)
+    s = str(d).strip().lower()
+    units = {"km": 1000.0, "m": 1.0, "mi": 1609.344, "yd": 0.9144, "ft": 0.3048,
+             "nmi": 1852.0, "cm": 0.01, "mm": 0.001, "in": 0.0254}
+    for u in sorted(units, key=len, reverse=True):
+        if s.endswith(u):
+            return float(s[: -len(u)]) * units[u]
+    return float(s)
+
+
+def parse_min_should_match(spec, n_clauses: int) -> int:
+    """'2', '30%', '-25%' -> concrete clause count (Queries.calculateMinShouldMatch)."""
+    if spec is None:
+        return 0
+    s = str(spec).strip()
+    if s.endswith("%"):
+        pct = float(s[:-1])
+        if pct < 0:
+            return n_clauses - int(-pct / 100.0 * n_clauses)
+        return int(pct / 100.0 * n_clauses)
+    v = int(s)
+    if v < 0:
+        return max(n_clauses + v, 0)
+    return min(v, n_clauses)
+
+
+def _field_and_params(body: dict, value_key: str):
+    """Handle {"field": "val"} and {"field": {value_key: ..., opts}}."""
+    if len(body) != 1:
+        raise ParsingException(f"query body must reference one field, got {sorted(body)}")
+    field, spec = next(iter(body.items()))
+    if isinstance(spec, dict):
+        params = dict(spec)
+        value = params.pop(value_key, None)
+        return field, value, params
+    return field, spec, {}
+
+
+def parse_query(body) -> QueryBuilder:
+    """Parse the JSON query DSL (the ``"query": {...}`` object)."""
+    if body is None:
+        return MatchAllQueryBuilder()
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingException(
+            "[query] malformed query, expected a single query clause object"
+        )
+    qtype, qbody = next(iter(body.items()))
+
+    if qtype == "match_all":
+        return MatchAllQueryBuilder(boost=float((qbody or {}).get("boost", 1.0)))
+    if qtype == "match_none":
+        return MatchNoneQueryBuilder()
+    if qtype == "match":
+        field, value, params = _field_and_params(qbody, "query")
+        return MatchQueryBuilder(
+            field, value, operator=params.get("operator", "or"),
+            minimum_should_match=params.get("minimum_should_match"),
+            boost=float(params.get("boost", 1.0)),
+        )
+    if qtype == "match_phrase":
+        field, value, params = _field_and_params(qbody, "query")
+        return MatchPhraseQueryBuilder(
+            field, value, slop=int(params.get("slop", 0)),
+            boost=float(params.get("boost", 1.0)),
+        )
+    if qtype == "match_phrase_prefix":
+        field, value, params = _field_and_params(qbody, "query")
+        return MatchPhrasePrefixQueryBuilder(
+            field, value, max_expansions=int(params.get("max_expansions", 50)),
+            boost=float(params.get("boost", 1.0)),
+        )
+    if qtype == "multi_match":
+        return MultiMatchQueryBuilder(
+            qbody.get("query"), qbody.get("fields") or ["*"],
+            type_=qbody.get("type", "best_fields"),
+            operator=qbody.get("operator", "or"),
+            tie_breaker=float(qbody.get("tie_breaker", 0.0)),
+            boost=float(qbody.get("boost", 1.0)),
+        )
+    if qtype == "term":
+        field, value, params = _field_and_params(qbody, "value")
+        return TermQueryBuilder(field, value, boost=float(params.get("boost", 1.0)))
+    if qtype == "terms":
+        body2 = dict(qbody)
+        boost = float(body2.pop("boost", 1.0))
+        if len(body2) != 1:
+            raise ParsingException("[terms] query requires exactly one field")
+        field, values = next(iter(body2.items()))
+        return TermsQueryBuilder(field, values, boost=boost)
+    if qtype == "range":
+        field, _, params = _field_and_params(qbody, "__none__")
+        known = {k: params.get(k) for k in ("gte", "gt", "lte", "lt")}
+        # legacy from/to/include_lower/include_upper
+        if "from" in params:
+            known["gte" if params.get("include_lower", True) else "gt"] = params["from"]
+        if "to" in params:
+            known["lte" if params.get("include_upper", True) else "lt"] = params["to"]
+        return RangeQueryBuilder(field, boost=float(params.get("boost", 1.0)), **known)
+    if qtype == "exists":
+        return ExistsQueryBuilder(qbody["field"], boost=float(qbody.get("boost", 1.0)))
+    if qtype == "ids":
+        return IdsQueryBuilder(qbody.get("values", []))
+    if qtype == "prefix":
+        field, value, params = _field_and_params(qbody, "value")
+        return PrefixQueryBuilder(field, value, boost=float(params.get("boost", 1.0)))
+    if qtype == "wildcard":
+        field, value, params = _field_and_params(qbody, "value")
+        if value is None:
+            value = params.pop("wildcard", None)
+        return WildcardQueryBuilder(field, value, boost=float(params.get("boost", 1.0)))
+    if qtype == "regexp":
+        field, value, params = _field_and_params(qbody, "value")
+        return RegexpQueryBuilder(field, value, boost=float(params.get("boost", 1.0)))
+    if qtype == "fuzzy":
+        field, value, params = _field_and_params(qbody, "value")
+        return FuzzyQueryBuilder(
+            field, value, fuzziness=params.get("fuzziness", "AUTO"),
+            prefix_length=int(params.get("prefix_length", 0)),
+            boost=float(params.get("boost", 1.0)),
+        )
+    if qtype == "bool":
+        def many(key):
+            v = qbody.get(key)
+            if v is None:
+                return []
+            if isinstance(v, list):
+                return [parse_query(q) for q in v]
+            return [parse_query(v)]
+
+        return BoolQueryBuilder(
+            must=many("must"), filter=many("filter"), should=many("should"),
+            must_not=many("must_not"),
+            minimum_should_match=qbody.get("minimum_should_match"),
+            boost=float(qbody.get("boost", 1.0)),
+        )
+    if qtype == "constant_score":
+        return ConstantScoreQueryBuilder(
+            parse_query(qbody["filter"]), boost=float(qbody.get("boost", 1.0))
+        )
+    if qtype == "dis_max":
+        return DisMaxQueryBuilder(
+            [parse_query(q) for q in qbody.get("queries", [])],
+            tie_breaker=float(qbody.get("tie_breaker", 0.0)),
+            boost=float(qbody.get("boost", 1.0)),
+        )
+    if qtype == "function_score":
+        inner = parse_query(qbody.get("query")) if qbody.get("query") else MatchAllQueryBuilder()
+        functions = qbody.get("functions")
+        if functions is None:
+            functions = []
+            for k in ("field_value_factor", "random_score", "script_score", "weight"):
+                if k in qbody:
+                    functions.append({k: qbody[k]})
+        return FunctionScoreQueryBuilder(
+            inner, functions, boost_mode=qbody.get("boost_mode", "multiply"),
+            score_mode=qbody.get("score_mode", "multiply"),
+            boost=float(qbody.get("boost", 1.0)),
+        )
+    if qtype in ("query_string", "simple_query_string"):
+        return QueryStringQueryBuilder(
+            qbody["query"], default_field=qbody.get("default_field"),
+            fields=qbody.get("fields"),
+            default_operator=qbody.get("default_operator", "or"),
+            boost=float(qbody.get("boost", 1.0)),
+        )
+    if qtype == "geo_distance":
+        params = dict(qbody)
+        distance = params.pop("distance")
+        params.pop("distance_type", None)
+        params.pop("validation_method", None)
+        if len(params) != 1:
+            raise ParsingException("[geo_distance] requires exactly one field")
+        field, center = next(iter(params.items()))
+        return GeoDistanceQueryBuilder(field, center, distance)
+    if qtype == "geo_bounding_box":
+        params = dict(qbody)
+        params.pop("validation_method", None)
+        params.pop("type", None)
+        if len(params) != 1:
+            raise ParsingException("[geo_bounding_box] requires exactly one field")
+        field, box = next(iter(params.items()))
+        return GeoBoundingBoxQueryBuilder(field, box["top_left"], box["bottom_right"])
+    if qtype == "more_like_this":
+        return MoreLikeThisQueryBuilder(
+            qbody.get("fields", []), qbody.get("like", []),
+            max_query_terms=int(qbody.get("max_query_terms", 25)),
+            min_term_freq=int(qbody.get("min_term_freq", 2)),
+            minimum_should_match=qbody.get("minimum_should_match", "30%"),
+        )
+    if qtype == "nested":
+        return NestedQueryBuilder(
+            qbody["path"], parse_query(qbody["query"]),
+            score_mode=qbody.get("score_mode", "avg"),
+        )
+    if qtype == "type":
+        return MatchAllQueryBuilder()  # single doc type in 6.x
+    raise ParsingException(f"no [query] registered for [{qtype}]")

@@ -1,0 +1,548 @@
+"""Per-shard search execution + cross-shard merge.
+
+Role model: ``SearchService.executeQueryPhase/executeFetchPhase``
+(search/SearchService.java:284,459), ``QueryPhase`` (collector assembly),
+``FetchPhase`` (+12 sub-phases), and ``SearchPhaseController``
+(sortDocs:156, reducedQueryPhase:408, merge:309).
+
+Shapes:
+- ``ShardSearcher.query(source)`` runs the query phase on one shard:
+  plan -> jitted program per segment -> top-k / sort-key selection ->
+  agg partials; returns a ``ShardQueryResult`` (doc refs only, no
+  _source — the same contract as QuerySearchResult).
+- ``reduce_shard_results`` is the coordinator merge: global top-k across
+  shard results + agg tree already reduced via aggregations.run_aggregations.
+- ``fetch`` materializes hits (_source filtering, docvalue_fields,
+  highlight, sort values).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
+from elasticsearch_tpu.mapper.field_types import TextFieldType
+from elasticsearch_tpu.search import plan as P
+from elasticsearch_tpu.search.aggregations import (
+    SegmentView,
+    parse_aggs,
+    run_aggregations,
+)
+from elasticsearch_tpu.search.query_dsl import (
+    ShardQueryContext,
+    parse_query,
+)
+from elasticsearch_tpu.utils.murmur3 import hash_routing
+
+
+@dataclass
+class DocRef:
+    """A hit before fetch: which shard/segment/local doc + ranking keys."""
+
+    shard_id: int
+    segment_name: str
+    local_doc: int
+    score: float
+    sort_values: Tuple = ()
+
+
+@dataclass
+class ShardQueryResult:
+    shard_id: int
+    total_hits: int
+    refs: List[DocRef]
+    max_score: Optional[float] = None
+    # segment views kept for agg execution at reduce time (single-process)
+    agg_views: List[SegmentView] = field(default_factory=list)
+
+
+class ShardSearcher:
+    """Query-phase execution for one shard."""
+
+    def __init__(self, shard_id: int, engine, mapper_service):
+        self.shard_id = shard_id
+        self.engine = engine
+        self.mapper_service = mapper_service
+        self.ctx = ShardQueryContext(mapper_service)
+        self.query_total = 0
+        self.query_time = 0.0
+        self.fetch_total = 0
+
+    # ------------------------------------------------------------------
+
+    def query(self, source: dict, size_hint: Optional[int] = None) -> ShardQueryResult:
+        t0 = time.monotonic()
+        self.query_total += 1
+        source = source or {}
+        from_ = int(source.get("from", 0) or 0)
+        size = int(source.get("size", 10) if source.get("size") is not None else 10)
+        k = size_hint if size_hint is not None else from_ + size
+        k = max(k, 1)
+        qb = parse_query(source.get("query"))
+        post_qb = parse_query(source["post_filter"]) if source.get("post_filter") else None
+        min_score = source.get("min_score")
+        sort_spec = normalize_sort(source.get("sort"))
+        search_after = source.get("search_after")
+        slice_spec = source.get("slice")
+
+        refs: List[DocRef] = []
+        total = 0
+        max_score = None
+        agg_views: List[SegmentView] = []
+        agg_specs = parse_aggs(source.get("aggs") or source.get("aggregations"))
+
+        for seg in self.engine.searchable_segments():
+            dev = seg.device_arrays()
+            node = qb.to_plan(self.ctx, seg)
+            scores_d, matched_d = P.execute(dev, node)
+            scores = np.asarray(scores_d)
+            matched = np.asarray(matched_d)
+            live1 = np.concatenate([seg.live, np.zeros(1, bool)])
+            matched = matched & live1
+            if min_score is not None:
+                matched = matched & (scores >= float(min_score))
+            if slice_spec is not None:
+                matched = matched & self._slice_mask(seg, slice_spec)
+            if agg_specs:
+                agg_views.append(SegmentView(seg, matched.copy(), self.ctx, scores))
+            if post_qb is not None:
+                _, post_m = P.execute(dev, post_qb.to_plan(self.ctx, seg))
+                matched = matched & np.asarray(post_m)
+            total += int(matched[: seg.num_docs].sum())
+            seg_refs = self._select(seg, scores, matched, sort_spec, search_after, k)
+            refs.extend(seg_refs)
+            if seg_refs and sort_spec is None:
+                m = max(r.score for r in seg_refs)
+                max_score = m if max_score is None else max(max_score, m)
+
+        refs = merge_refs(refs, sort_spec, k)
+        result = ShardQueryResult(self.shard_id, total, refs, max_score, agg_views)
+        self.query_time += time.monotonic() - t0
+        return result
+
+    # ------------------------------------------------------------------
+
+    def _slice_mask(self, seg, slice_spec: dict) -> np.ndarray:
+        """Sliced scroll partitions (search/slice/SliceBuilder): docs
+        partitioned by murmur3(_id) % max == id."""
+        sid = int(slice_spec["id"])
+        smax = int(slice_spec["max"])
+        key = f"slice.{smax}.{sid}"
+        if key not in seg.dev_cache:
+            mask = np.zeros(seg.nd_pad + 1, dtype=bool)
+            for local, doc_id in enumerate(seg.doc_ids):
+                if hash_routing(doc_id) % smax == sid:
+                    mask[local] = True
+            seg.dev_cache[key] = mask
+        return seg.dev_cache[key]
+
+    def _select(self, seg, scores, matched, sort_spec, search_after, k) -> List[DocRef]:
+        import jax.numpy as jnp
+
+        nd = seg.num_docs
+        if sort_spec is None:
+            # relevance: device top-k by score
+            if search_after is not None:
+                cutoff = float(search_after[0])
+                matched = matched & (scores < cutoff)
+            top_scores, top_docs = P_select_topk(scores, matched, k)
+            out = []
+            for s, d in zip(np.asarray(top_scores), np.asarray(top_docs)):
+                if s == -np.inf:
+                    break
+                out.append(DocRef(self.shard_id, seg.name, int(d), float(s), (float(s),)))
+            return out
+
+        # field sort: build primary key vector; select by key; host refine
+        keys, all_key_arrays = self._sort_keys(seg, scores, sort_spec)
+        primary = keys[0]
+        if search_after is not None:
+            matched = matched & _search_after_mask(all_key_arrays, sort_spec, search_after)
+        masked = np.where(matched[: seg.nd_pad] & seg.live, primary, -np.inf)
+        kk = min(k, masked.size)
+        idx = np.argpartition(-masked, kk - 1)[:kk] if kk < masked.size else np.arange(masked.size)
+        cand = [(int(d),) for d in idx if masked[d] != -np.inf]
+        out = []
+        for (d,) in cand:
+            sv = tuple(arr[d] for arr in all_key_arrays)
+            out.append(DocRef(self.shard_id, seg.name, d, float(scores[d]), sv))
+        out.sort(key=lambda r: _ref_sort_key(r, sort_spec))
+        return out[:k]
+
+    def _sort_keys(self, seg, scores, sort_spec):
+        """Returns (oriented primary key array [nd_pad], raw per-field value
+        arrays for sort_values output)."""
+        raw_arrays = []
+        oriented = []
+        for entry in sort_spec:
+            field_name, order, missing = entry
+            if field_name == "_score":
+                raw = scores[: seg.nd_pad].astype(np.float64)
+            elif field_name == "_doc":
+                raw = np.arange(seg.nd_pad, dtype=np.float64)
+            else:
+                col = seg.numeric_columns.get(field_name)
+                if col is not None:
+                    base = col.min_value if order == "asc" else col.max_value
+                    fill = _missing_fill(missing, order)
+                    raw = np.where(col.exists, base, fill)
+                else:
+                    ocol = seg.ordinal_columns.get(field_name) or seg.ordinal_columns.get(
+                        f"{field_name}.keyword"
+                    )
+                    if ocol is None:
+                        fill = _missing_fill(missing, order)
+                        raw = np.full(seg.nd_pad, fill, dtype=np.float64)
+                    else:
+                        fill = _missing_fill(missing, order)
+                        raw = np.where(ocol.exists, ocol.first_ord.astype(np.float64), fill)
+            raw_arrays.append(raw)
+            oriented.append(raw if order == "desc" else -raw)
+        return oriented, raw_arrays
+
+
+def P_select_topk(scores, matched, k):
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.scoring import select_topk
+
+    live1 = jnp.ones(scores.shape, bool)  # matched already includes live
+    return select_topk(jnp.asarray(scores), jnp.asarray(matched), live1, int(k))
+
+
+def _missing_fill(missing, order) -> float:
+    if missing in (None, "_last"):
+        return -np.inf if order == "desc" else np.inf
+    if missing == "_first":
+        return np.inf if order == "desc" else -np.inf
+    return float(missing)
+
+
+def _ref_sort_key(ref: DocRef, sort_spec) -> Tuple:
+    out = []
+    for value, (fname, order, _) in zip(ref.sort_values, sort_spec):
+        v = value
+        out.append(-v if order == "desc" else v)
+    out.append(ref.local_doc)
+    return tuple(out)
+
+
+def _search_after_mask(key_arrays, sort_spec, after_values) -> np.ndarray:
+    """Strict lexicographic 'after' filter over full sort tuples."""
+    n = key_arrays[0].shape[0]
+    gt = np.zeros(n, dtype=bool)
+    eq = np.ones(n, dtype=bool)
+    for arr, (fname, order, _), after in zip(key_arrays, sort_spec, after_values):
+        a = float(after)
+        if order == "desc":
+            gt |= eq & (arr < a)
+        else:
+            gt |= eq & (arr > a)
+        eq &= arr == a
+    mask = np.concatenate([gt, np.zeros(1, dtype=bool)])
+    return mask
+
+
+def normalize_sort(sort_body) -> Optional[List[Tuple[str, str, Any]]]:
+    """-> list of (field, order, missing), or None for relevance."""
+    if sort_body is None:
+        return None
+    if not isinstance(sort_body, list):
+        sort_body = [sort_body]
+    out = []
+    for entry in sort_body:
+        if isinstance(entry, str):
+            if entry == "_score":
+                out.append(("_score", "desc", None))
+            else:
+                out.append((entry, "asc" if entry != "_score" else "desc", None))
+        elif isinstance(entry, dict):
+            ((fname, spec),) = entry.items()
+            if isinstance(spec, str):
+                out.append((fname, spec, None))
+            else:
+                out.append((
+                    fname,
+                    spec.get("order", "desc" if fname == "_score" else "asc"),
+                    spec.get("missing"),
+                ))
+        else:
+            raise ParsingException(f"malformed sort entry {entry!r}")
+    if len(out) == 1 and out[0][0] == "_score":
+        return None  # plain relevance
+    return out
+
+
+def merge_refs(refs: List[DocRef], sort_spec, k: int) -> List[DocRef]:
+    """Coordinator-side top-k merge (SearchPhaseController.sortDocs)."""
+    if sort_spec is None:
+        refs.sort(key=lambda r: (-r.score, r.shard_id, r.local_doc))
+    else:
+        refs.sort(key=lambda r: _ref_sort_key(r, sort_spec) + (r.shard_id,))
+    return refs[:k]
+
+
+# ---------------------------------------------------------------------------
+# Fetch phase
+# ---------------------------------------------------------------------------
+
+
+def filter_source(source: dict, includes: List[str], excludes: List[str]) -> dict:
+    """_source filtering (fetch/subphase/FetchSourceSubPhase semantics):
+    a pattern matching a path or any of its ancestors covers the subtree."""
+
+    def ancestor_match(path: str, patterns: List[str]) -> bool:
+        parts = path.split(".")
+        for i in range(1, len(parts) + 1):
+            prefix = ".".join(parts[:i])
+            if any(fnmatch.fnmatchcase(prefix, p) for p in patterns):
+                return True
+        return False
+
+    def walk(obj: dict, prefix: str) -> dict:
+        out = {}
+        for key, value in obj.items():
+            path = f"{prefix}{key}"
+            if excludes and ancestor_match(path, excludes):
+                continue
+            if isinstance(value, dict):
+                child = walk(value, path + ".")
+                if child:
+                    out[key] = child
+            elif isinstance(value, list) and value and all(
+                isinstance(x, dict) for x in value
+            ):
+                items = [walk(x, path + ".") for x in value]
+                items = [x for x in items if x]
+                if items:
+                    out[key] = items
+            else:
+                if includes and not ancestor_match(path, includes):
+                    continue
+                out[key] = value
+        return out
+
+    return walk(source, "")
+
+
+_HL_PRE = "<em>"
+_HL_POST = "</em>"
+
+
+def highlight_fields(source: dict, mapper_service, query_terms: Dict[str, set],
+                     highlight_body: dict) -> Dict[str, List[str]]:
+    """Plain highlighter (subphase/highlight/PlainHighlighter): re-analyze
+    the stored text, wrap matched tokens, emit best fragments."""
+    out = {}
+    fields_spec = highlight_body.get("fields", {})
+    pre = (highlight_body.get("pre_tags") or [_HL_PRE])[0]
+    post = (highlight_body.get("post_tags") or [_HL_POST])[0]
+    require_match = highlight_body.get("require_field_match", True)
+    all_terms = set().union(*query_terms.values()) if query_terms else set()
+    for fname, fspec in fields_spec.items():
+        fragment_size = int((fspec or {}).get("fragment_size", 100))
+        n_frags = int((fspec or {}).get("number_of_fragments", 5))
+        for resolved in mapper_service.mapper.simple_match_to_fields(fname) or [fname]:
+            value = _source_value(source, resolved)
+            if value is None:
+                continue
+            text = value if isinstance(value, str) else str(value)
+            ft = mapper_service.field_type(resolved)
+            analyzer_name = ft.analyzer if isinstance(ft, TextFieldType) else "keyword"
+            analyzer = mapper_service.analyzers.get(analyzer_name)
+            terms = query_terms.get(resolved, set()) if require_match else all_terms
+            if not terms:
+                continue
+            spans = [
+                (s, e) for tok, s, e in analyzer.analyze_tokens(text) if tok in terms
+            ]
+            if not spans:
+                continue
+            fragments = _build_fragments(text, spans, fragment_size, n_frags, pre, post)
+            if fragments:
+                out[resolved] = fragments
+    return out
+
+
+def _source_value(source: dict, path: str):
+    node = source
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _build_fragments(text, spans, fragment_size, n_frags, pre, post):
+    spans = sorted(spans)
+    fragments = []
+    used = set()
+    for s, e in spans:
+        frag_start = max(0, s - fragment_size // 2)
+        frag_id = frag_start // max(fragment_size, 1)
+        if frag_id in used:
+            continue
+        used.add(frag_id)
+        frag_end = min(len(text), frag_start + fragment_size)
+        in_frag = [(a, b) for a, b in spans if a >= frag_start and b <= frag_end]
+        frag = []
+        pos = frag_start
+        for a, b in in_frag:
+            frag.append(text[pos:a])
+            frag.append(pre + text[a:b] + post)
+            pos = b
+        frag.append(text[pos:frag_end])
+        fragments.append("".join(frag))
+        if len(fragments) >= n_frags:
+            break
+    return fragments
+
+
+def extract_query_terms(qb, ctx, terms: Optional[Dict[str, set]] = None) -> Dict[str, set]:
+    """Collect (field -> tokens) from a builder tree for highlighting."""
+    from elasticsearch_tpu.search import query_dsl as Q
+
+    if terms is None:
+        terms = {}
+
+    def add(field, toks):
+        terms.setdefault(field, set()).update(toks)
+
+    if isinstance(qb, Q.MatchQueryBuilder):
+        ft = ctx.field_type(qb.field)
+        if isinstance(ft, TextFieldType):
+            add(qb.field, ft.query_terms(qb.query, ctx.analyzers))
+        else:
+            add(qb.field, [str(qb.query)])
+    elif isinstance(qb, Q.MatchPhraseQueryBuilder):
+        ft = ctx.field_type(qb.field)
+        if isinstance(ft, TextFieldType):
+            add(qb.field, ft.query_terms(qb.query, ctx.analyzers))
+    elif isinstance(qb, Q.TermQueryBuilder):
+        add(qb.field, [str(qb.value)])
+    elif isinstance(qb, Q.TermsQueryBuilder):
+        add(qb.field, [str(v) for v in qb.values])
+    elif isinstance(qb, Q.MultiMatchQueryBuilder):
+        for f in qb.fields:
+            name = f.split("^")[0]
+            for resolved in ctx.mapper_service.mapper.simple_match_to_fields(name) or [name]:
+                ft = ctx.field_type(resolved)
+                if isinstance(ft, TextFieldType):
+                    add(resolved, ft.query_terms(qb.query, ctx.analyzers))
+    elif isinstance(qb, Q.BoolQueryBuilder):
+        for sub in qb.must + qb.should + qb.filter:
+            extract_query_terms(sub, ctx, terms)
+    elif isinstance(qb, (Q.ConstantScoreQueryBuilder,)):
+        extract_query_terms(qb.filter, ctx, terms)
+    elif isinstance(qb, Q.DisMaxQueryBuilder):
+        for sub in qb.queries:
+            extract_query_terms(sub, ctx, terms)
+    elif isinstance(qb, Q.FunctionScoreQueryBuilder):
+        extract_query_terms(qb.query, ctx, terms)
+    return terms
+
+
+def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
+               index_name: str) -> List[dict]:
+    """Fetch phase: materialize hits from doc refs.
+
+    shards: shard_id -> object with .engine and .mapper_service.
+    """
+    source_body = source_body or {}
+    src_spec = source_body.get("_source", True)
+    includes, excludes, enabled = _parse_source_spec(src_spec)
+    docvalue_fields = source_body.get("docvalue_fields") or []
+    stored_fields = source_body.get("stored_fields")
+    want_version = bool(source_body.get("version", False))
+    highlight_body = source_body.get("highlight")
+    sort_spec = normalize_sort(source_body.get("sort"))
+
+    query_terms: Dict[str, set] = {}
+    hits = []
+    for ref in refs:
+        shard = shards[ref.shard_id]
+        seg = next(
+            (s for s in shard.engine.segments if s.name == ref.segment_name), None
+        )
+        if seg is None:
+            continue
+        d = ref.local_doc
+        hit = {
+            "_index": index_name,
+            "_type": "_doc",
+            "_id": seg.doc_ids[d],
+            "_score": None if sort_spec is not None else ref.score,
+        }
+        if enabled and stored_fields != "_none_":
+            src = seg.sources[d]
+            if includes or excludes:
+                src = filter_source(src, includes, excludes)
+            hit["_source"] = src
+        if want_version:
+            hit["_version"] = int(seg.versions[d])
+        if docvalue_fields:
+            fields_out = {}
+            for fspec in docvalue_fields:
+                fname = fspec if isinstance(fspec, str) else fspec.get("field")
+                col = seg.numeric_columns.get(fname)
+                if col is not None and col.exists[d]:
+                    vals = col.flat_values[: col.count][
+                        col.flat_docs[: col.count] == d
+                    ]
+                    fields_out[fname] = [float(v) for v in vals]
+                else:
+                    ocol = seg.ordinal_columns.get(fname) or seg.ordinal_columns.get(
+                        f"{fname}.keyword"
+                    )
+                    if ocol is not None and ocol.exists[d]:
+                        sel = ocol.flat_docs[: ocol.count] == d
+                        fields_out[fname] = [
+                            ocol.terms[o] for o in ocol.flat_ords[: ocol.count][sel]
+                        ]
+            if fields_out:
+                hit["fields"] = fields_out
+        if sort_spec is not None:
+            hit["sort"] = [
+                v if not np.isinf(v) else None for v in ref.sort_values
+            ]
+        if highlight_body:
+            if not query_terms:
+                qb = parse_query(source_body.get("query"))
+                query_terms = extract_query_terms(
+                    qb, ShardQueryContext(shard.mapper_service)
+                )
+            hl = highlight_fields(
+                seg.sources[d], shard.mapper_service, query_terms, highlight_body
+            )
+            if hl:
+                hit["highlight"] = hl
+        hits.append(hit)
+    return hits
+
+
+def _parse_source_spec(spec):
+    """-> (includes, excludes, enabled)."""
+    if spec is True or spec is None:
+        return [], [], True
+    if spec is False:
+        return [], [], False
+    if isinstance(spec, str):
+        return [spec], [], True
+    if isinstance(spec, list):
+        return list(spec), [], True
+    if isinstance(spec, dict):
+        return (
+            list(spec.get("includes") or spec.get("include") or []),
+            list(spec.get("excludes") or spec.get("exclude") or []),
+            True,
+        )
+    raise ParsingException(f"unsupported _source spec {spec!r}")

@@ -1,0 +1,351 @@
+"""End-to-end search tests: index -> refresh -> query DSL -> hits.
+
+Mirrors the reference's REST-level search semantics (rest-api-spec tests)
+at the IndexService level.
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture()
+def books():
+    idx = IndexService("books", Settings({"index.number_of_shards": 1}))
+    docs = [
+        {"title": "The Quick Brown Fox", "body": "the quick brown fox jumps over the lazy dog",
+         "price": 10, "tag": "animals", "published": "2017-01-15", "in_stock": True},
+        {"title": "Fox Hunting History", "body": "a history of fox hunting in england",
+         "price": 25, "tag": "history", "published": "2016-06-01", "in_stock": False},
+        {"title": "Quick Cooking", "body": "quick and easy recipes for busy people",
+         "price": 15, "tag": "cooking", "published": "2017-11-20", "in_stock": True},
+        {"title": "The Lazy Gardener", "body": "gardening for people who hate gardening",
+         "price": 30, "tag": "hobby", "published": "2015-03-10", "in_stock": True},
+        {"title": "Dog Training", "body": "train your dog quickly with positive methods",
+         "price": 20, "tag": "animals", "published": "2016-12-25", "in_stock": False},
+    ]
+    for i, d in enumerate(docs):
+        idx.index_doc(str(i + 1), d)
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+def hit_ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+class TestMatch:
+    def test_match_basic(self, books):
+        r = books.search({"query": {"match": {"body": "fox"}}})
+        assert set(hit_ids(r)) == {"1", "2"}
+        assert r["hits"]["total"] == 2
+        assert r["hits"]["hits"][0]["_score"] > 0
+        assert r["hits"]["max_score"] == r["hits"]["hits"][0]["_score"]
+
+    def test_match_or_vs_and(self, books):
+        r_or = books.search({"query": {"match": {"body": "quick dog"}}})
+        assert set(hit_ids(r_or)) == {"1", "3", "5"}
+        # standard analyzer does not stem: doc5 has "quickly", not "quick"
+        r_and = books.search({"query": {"match": {"body": {"query": "quick dog", "operator": "and"}}}})
+        assert set(hit_ids(r_and)) == {"1"}
+
+    def test_match_analyzes_query(self, books):
+        r = books.search({"query": {"match": {"body": "FOX!"}}})
+        assert set(hit_ids(r)) == {"1", "2"}
+
+    def test_match_all_and_none(self, books):
+        assert books.search({"query": {"match_all": {}}})["hits"]["total"] == 5
+        assert books.search({"query": {"match_none": {}}})["hits"]["total"] == 0
+        assert books.search({})["hits"]["total"] == 5
+
+    def test_match_on_numeric_field(self, books):
+        r = books.search({"query": {"match": {"price": 25}}})
+        assert hit_ids(r) == ["2"]
+
+    def test_match_phrase(self, books):
+        r = books.search({"query": {"match_phrase": {"body": "quick brown fox"}}})
+        assert hit_ids(r) == ["1"]
+        r2 = books.search({"query": {"match_phrase": {"body": "brown quick"}}})
+        assert r2["hits"]["total"] == 0
+
+    def test_multi_match(self, books):
+        r = books.search({"query": {"multi_match": {
+            "query": "fox", "fields": ["title", "body"]}}})
+        assert set(hit_ids(r)) == {"1", "2"}
+        r2 = books.search({"query": {"multi_match": {
+            "query": "quick", "fields": ["title^3", "body"]}}})
+        # title match boosted: docs 1,3 have quick in title
+        assert set(hit_ids(r2)) >= {"1", "3"}
+
+
+class TestTermLevel:
+    def test_term_keyword(self, books):
+        r = books.search({"query": {"term": {"tag": "animals"}}})
+        assert set(hit_ids(r)) == {"1", "5"}
+
+    def test_terms(self, books):
+        r = books.search({"query": {"terms": {"tag": ["history", "hobby"]}}})
+        assert set(hit_ids(r)) == {"2", "4"}
+
+    def test_term_numeric(self, books):
+        r = books.search({"query": {"term": {"price": 15}}})
+        assert hit_ids(r) == ["3"]
+
+    def test_term_boolean(self, books):
+        r = books.search({"query": {"term": {"in_stock": True}}})
+        assert set(hit_ids(r)) == {"1", "3", "4"}
+
+    def test_range_numeric(self, books):
+        r = books.search({"query": {"range": {"price": {"gte": 15, "lte": 25}}}})
+        assert set(hit_ids(r)) == {"2", "3", "5"}
+        r2 = books.search({"query": {"range": {"price": {"gt": 15, "lt": 25}}}})
+        assert set(hit_ids(r2)) == {"5"}
+
+    def test_range_date(self, books):
+        r = books.search({"query": {"range": {"published": {"gte": "2017-01-01"}}}})
+        assert set(hit_ids(r)) == {"1", "3"}
+
+    def test_exists(self, books):
+        books.index_doc("6", {"title": "no body here"})
+        books.refresh()
+        r = books.search({"query": {"exists": {"field": "body"}}})
+        assert "6" not in hit_ids(r)
+        assert r["hits"]["total"] == 5
+
+    def test_ids(self, books):
+        r = books.search({"query": {"ids": {"values": ["2", "4", "404"]}}})
+        assert set(hit_ids(r)) == {"2", "4"}
+
+    def test_prefix(self, books):
+        r = books.search({"query": {"prefix": {"body": "gard"}}})
+        assert set(hit_ids(r)) == {"4"}
+
+    def test_wildcard(self, books):
+        r = books.search({"query": {"wildcard": {"body": "rec*es"}}})
+        assert hit_ids(r) == ["3"]
+
+    def test_regexp(self, books):
+        r = books.search({"query": {"regexp": {"tag": "h.*y"}}})
+        assert set(hit_ids(r)) == {"2", "4"}
+
+    def test_fuzzy(self, books):
+        r = books.search({"query": {"fuzzy": {"body": "quik"}}})
+        assert set(hit_ids(r)) >= {"3"}
+
+
+class TestBool:
+    def test_bool_must_filter(self, books):
+        r = books.search({"query": {"bool": {
+            "must": [{"match": {"body": "quick"}}],
+            "filter": [{"range": {"price": {"lte": 15}}}],
+        }}})
+        assert set(hit_ids(r)) == {"1", "3"}
+
+    def test_bool_must_not(self, books):
+        r = books.search({"query": {"bool": {
+            "must": [{"match_all": {}}],
+            "must_not": [{"term": {"tag": "animals"}}],
+        }}})
+        assert set(hit_ids(r)) == {"2", "3", "4"}
+
+    def test_bool_should_msm(self, books):
+        r = books.search({"query": {"bool": {
+            "should": [
+                {"match": {"body": "quick"}},
+                {"match": {"body": "dog"}},
+                {"term": {"tag": "cooking"}},
+            ],
+            "minimum_should_match": 2,
+        }}})
+        # doc1: quick+dog; doc3: quick+cooking; doc5: quick(body? 'quickly'->stem?)+dog
+        assert "1" in hit_ids(r) and "3" in hit_ids(r)
+
+    def test_filter_only_scores_zero(self, books):
+        r = books.search({"query": {"bool": {"filter": [{"term": {"tag": "history"}}]}}})
+        assert hit_ids(r) == ["2"]
+        assert r["hits"]["hits"][0]["_score"] == 0.0
+
+    def test_constant_score(self, books):
+        r = books.search({"query": {"constant_score": {
+            "filter": {"term": {"tag": "history"}}, "boost": 3.0}}})
+        assert r["hits"]["hits"][0]["_score"] == 3.0
+
+
+class TestSortPagination:
+    def test_sort_numeric_asc(self, books):
+        r = books.search({"query": {"match_all": {}}, "sort": [{"price": "asc"}]})
+        assert hit_ids(r) == ["1", "3", "5", "2", "4"]
+        assert r["hits"]["hits"][0]["sort"] == [10.0]
+        assert r["hits"]["hits"][0]["_score"] is None
+
+    def test_sort_desc_with_from_size(self, books):
+        r = books.search({
+            "query": {"match_all": {}}, "sort": [{"price": "desc"}],
+            "from": 1, "size": 2,
+        })
+        assert hit_ids(r) == ["2", "5"]
+
+    def test_sort_keyword(self, books):
+        r = books.search({"query": {"match_all": {}}, "sort": [{"tag": "asc"}]})
+        # animals(1,5) < cooking(3) < history(2) < hobby(4)
+        assert hit_ids(r)[:2] == ["1", "5"] or hit_ids(r)[:2] == ["5", "1"]
+        assert hit_ids(r)[2:] == ["3", "2", "4"]
+
+    def test_sort_date(self, books):
+        r = books.search({"query": {"match_all": {}}, "sort": [{"published": "desc"}]})
+        assert hit_ids(r) == ["3", "1", "5", "2", "4"]
+
+    def test_search_after(self, books):
+        r1 = books.search({"query": {"match_all": {}}, "sort": [{"price": "asc"}], "size": 2})
+        after = r1["hits"]["hits"][-1]["sort"]
+        r2 = books.search({
+            "query": {"match_all": {}}, "sort": [{"price": "asc"}],
+            "size": 2, "search_after": after,
+        })
+        assert hit_ids(r2) == ["5", "2"]
+
+    def test_size_zero(self, books):
+        r = books.search({"query": {"match": {"body": "fox"}}, "size": 0})
+        assert r["hits"]["hits"] == []
+        assert r["hits"]["total"] == 2
+
+
+class TestSourceFiltering:
+    def test_source_false(self, books):
+        r = books.search({"query": {"ids": {"values": ["1"]}}, "_source": False})
+        assert "_source" not in r["hits"]["hits"][0]
+
+    def test_source_includes_excludes(self, books):
+        r = books.search({
+            "query": {"ids": {"values": ["1"]}},
+            "_source": {"includes": ["title", "price"]},
+        })
+        assert set(r["hits"]["hits"][0]["_source"]) == {"title", "price"}
+        r2 = books.search({
+            "query": {"ids": {"values": ["1"]}},
+            "_source": {"excludes": ["body", "tag"]},
+        })
+        src = r2["hits"]["hits"][0]["_source"]
+        assert "body" not in src and "title" in src
+
+    def test_docvalue_fields(self, books):
+        r = books.search({
+            "query": {"ids": {"values": ["2"]}},
+            "docvalue_fields": ["price", "tag"],
+        })
+        f = r["hits"]["hits"][0]["fields"]
+        assert f["price"] == [25.0]
+        assert f["tag"] == ["history"]
+
+
+class TestOtherQueries:
+    def test_dis_max(self, books):
+        r = books.search({"query": {"dis_max": {"queries": [
+            {"match": {"title": "fox"}}, {"match": {"body": "fox"}},
+        ]}}})
+        assert set(hit_ids(r)) == {"1", "2"}
+
+    def test_function_score_field_value_factor(self, books):
+        r = books.search({"query": {"function_score": {
+            "query": {"match_all": {}},
+            "field_value_factor": {"field": "price", "factor": 1.0},
+            "boost_mode": "replace",
+        }}})
+        assert hit_ids(r) == ["4", "2", "5", "3", "1"]  # sorted by price
+
+    def test_query_string(self, books):
+        r = books.search({"query": {"query_string": {
+            "query": "body:fox AND tag:history"}}})
+        assert hit_ids(r) == ["2"]
+
+    def test_query_string_default_fields(self, books):
+        r = books.search({"query": {"query_string": {"query": "gardening"}}})
+        assert hit_ids(r) == ["4"]
+
+    def test_more_like_this(self, books):
+        r = books.search({"query": {"more_like_this": {
+            "fields": ["body"], "like": [{"_id": "1"}],
+            "min_term_freq": 1, "minimum_should_match": "1%",
+        }}})
+        assert "5" in hit_ids(r) or "2" in hit_ids(r)  # dog / fox overlap
+
+    def test_unknown_query_rejected(self, books):
+        with pytest.raises(ParsingException):
+            books.search({"query": {"bogus_query": {}}})
+
+    def test_min_score(self, books):
+        r_all = books.search({"query": {"match": {"body": "fox"}}})
+        low = min(h["_score"] for h in r_all["hits"]["hits"])
+        hi = max(h["_score"] for h in r_all["hits"]["hits"])
+        r = books.search({"query": {"match": {"body": "fox"}},
+                          "min_score": (low + hi) / 2})
+        assert r["hits"]["total"] == 1
+
+    def test_post_filter(self, books):
+        r = books.search({
+            "query": {"match": {"body": "quick"}},
+            "post_filter": {"term": {"tag": "cooking"}},
+            "aggs": {"tags": {"terms": {"field": "tag"}}},
+        })
+        assert hit_ids(r) == ["3"]
+        # aggs ignore post_filter (see FilteredSearchIT semantics)
+        agg_tags = {b["key"] for b in r["aggregations"]["tags"]["buckets"]}
+        assert agg_tags == {"animals", "cooking"}
+
+
+class TestHighlight:
+    def test_highlight_basic(self, books):
+        r = books.search({
+            "query": {"match": {"body": "fox"}},
+            "highlight": {"fields": {"body": {}}},
+        })
+        h = r["hits"]["hits"][0]
+        assert "<em>fox</em>" in h["highlight"]["body"][0]
+
+
+class TestMultiShard:
+    def test_results_merge_across_shards(self):
+        idx = IndexService("multi", Settings({"index.number_of_shards": 4}))
+        for i in range(50):
+            idx.index_doc(str(i), {"n": i, "text": "common term here"})
+        idx.refresh()
+        r = idx.search({"query": {"match": {"text": "common"}},
+                        "sort": [{"n": "asc"}], "size": 10})
+        assert hit_ids(r) == [str(i) for i in range(10)]
+        assert r["hits"]["total"] == 50
+        assert r["_shards"]["total"] == 4
+        idx.close()
+
+    def test_scores_comparable_across_shards(self):
+        idx = IndexService("multi2", Settings({"index.number_of_shards": 2}))
+        for i in range(20):
+            idx.index_doc(str(i), {"text": "alpha beta" if i % 2 else "alpha"})
+        idx.refresh()
+        r = idx.search({"query": {"match": {"text": "alpha"}}, "size": 20})
+        assert r["hits"]["total"] == 20
+        # shorter docs (just "alpha") score higher regardless of shard
+        top_half = hit_ids(r)[:10]
+        assert all(int(i) % 2 == 0 for i in top_half)
+        idx.close()
+
+
+class TestUpdateAndGet:
+    def test_update_merge(self, books):
+        books.update_doc("1", {"doc": {"price": 11}})
+        g = books.get_doc("1")
+        assert g.source["price"] == 11
+        assert g.source["title"] == "The Quick Brown Fox"
+
+    def test_update_noop(self, books):
+        r = books.update_doc("1", {"doc": {"price": 10}})
+        assert r["result"] == "noop"
+
+    def test_upsert(self, books):
+        r = books.update_doc("99", {"doc": {"x": 1}, "doc_as_upsert": True})
+        assert r["result"] == "created"
+
+    def test_count(self, books):
+        assert books.count({"query": {"match": {"body": "fox"}}})["count"] == 2
