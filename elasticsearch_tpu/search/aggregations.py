@@ -787,7 +787,7 @@ def _apply_pipeline(spec: AggSpec, out: dict) -> None:
             }
 
 
-_SCRIPT_ALLOWED = set("0123456789.+-*/()% eE")
+_SCRIPT_ALLOWED = set("0123456789.+-*/()% eE<>=! &|")
 
 
 def _eval_bucket_script(script: str, params: Dict[str, Optional[float]]) -> Optional[float]:

@@ -1,0 +1,270 @@
+"""Aggregation tests (ref: search/aggregations — bucket/metric/pipeline)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture(scope="module")
+def sales():
+    idx = IndexService("sales", Settings({"index.number_of_shards": 2}))
+    rows = [
+        ("red", "shirt", 10, "2017-01-05"),
+        ("red", "pants", 20, "2017-01-15"),
+        ("blue", "shirt", 15, "2017-02-03"),
+        ("blue", "shirt", 25, "2017-02-20"),
+        ("green", "hat", 5, "2017-03-01"),
+        ("red", "hat", 8, "2017-03-11"),
+        ("blue", "pants", 30, "2017-03-25"),
+        ("red", "shirt", 12, "2017-04-02"),
+    ]
+    for i, (color, kind, price, date) in enumerate(rows):
+        idx.index_doc(str(i), {
+            "color": color, "kind": kind, "price": price, "sold": date,
+        })
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+def agg(resp, name):
+    return resp["aggregations"][name]
+
+
+class TestMetrics:
+    def test_min_max_sum_avg(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "mn": {"min": {"field": "price"}},
+            "mx": {"max": {"field": "price"}},
+            "sm": {"sum": {"field": "price"}},
+            "av": {"avg": {"field": "price"}},
+            "vc": {"value_count": {"field": "price"}},
+        }})
+        assert agg(r, "mn")["value"] == 5.0
+        assert agg(r, "mx")["value"] == 30.0
+        assert agg(r, "sm")["value"] == 125.0
+        assert agg(r, "av")["value"] == pytest.approx(125 / 8)
+        assert agg(r, "vc")["value"] == 8
+
+    def test_stats_extended(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "s": {"stats": {"field": "price"}},
+            "es": {"extended_stats": {"field": "price"}},
+        }})
+        s = agg(r, "s")
+        assert s["count"] == 8 and s["min"] == 5.0 and s["max"] == 30.0
+        es = agg(r, "es")
+        assert es["variance"] == pytest.approx(
+            sum((x - 125 / 8) ** 2 for x in [10, 20, 15, 25, 5, 8, 30, 12]) / 8
+        )
+
+    def test_metrics_respect_query(self, sales):
+        r = sales.search({"size": 0, "query": {"term": {"color": "red"}},
+                          "aggs": {"sm": {"sum": {"field": "price"}}}})
+        assert agg(r, "sm")["value"] == 50.0  # 10+20+8+12
+
+    def test_cardinality(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"cardinality": {"field": "color"}},
+            "kinds": {"cardinality": {"field": "kind"}},
+        }})
+        assert agg(r, "colors")["value"] == 3
+        assert agg(r, "kinds")["value"] == 3
+
+    def test_percentiles(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "p": {"percentiles": {"field": "price", "percents": [50, 100]}},
+        }})
+        vals = agg(r, "p")["values"]
+        assert vals["100.0"] == 30.0
+        assert 10 <= vals["50.0"] <= 15
+
+    def test_empty_bucket_metrics(self, sales):
+        r = sales.search({"size": 0, "query": {"term": {"color": "nope"}},
+                          "aggs": {"mn": {"min": {"field": "price"}}}})
+        assert agg(r, "mn")["value"] is None
+
+    def test_top_hits(self, sales):
+        r = sales.search({"size": 0, "query": {"match_all": {}}, "aggs": {
+            "by_color": {"terms": {"field": "color"}, "aggs": {
+                "top": {"top_hits": {"size": 1}},
+            }},
+        }})
+        buckets = agg(r, "by_color")["buckets"]
+        for b in buckets:
+            assert len(b["top"]["hits"]["hits"]) == 1
+
+
+class TestBuckets:
+    def test_terms_counts(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"terms": {"field": "color"}},
+        }})
+        got = {b["key"]: b["doc_count"] for b in agg(r, "colors")["buckets"]}
+        assert got == {"red": 4, "blue": 3, "green": 1}
+        # sorted by count desc
+        keys = [b["key"] for b in agg(r, "colors")["buckets"]]
+        assert keys == ["red", "blue", "green"]
+
+    def test_terms_size_and_other(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"terms": {"field": "color", "size": 1}},
+        }})
+        a = agg(r, "colors")
+        assert len(a["buckets"]) == 1
+        assert a["buckets"][0]["key"] == "red"
+        assert a["sum_other_doc_count"] == 4
+
+    def test_terms_order_by_key(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"terms": {"field": "color", "order": {"_key": "asc"}}},
+        }})
+        assert [b["key"] for b in agg(r, "colors")["buckets"]] == ["blue", "green", "red"]
+
+    def test_terms_with_sub_metric(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"terms": {"field": "color"}, "aggs": {
+                "total": {"sum": {"field": "price"}},
+            }},
+        }})
+        got = {b["key"]: b["total"]["value"] for b in agg(r, "colors")["buckets"]}
+        assert got == {"red": 50.0, "blue": 70.0, "green": 5.0}
+
+    def test_nested_terms(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"terms": {"field": "color"}, "aggs": {
+                "kinds": {"terms": {"field": "kind"}},
+            }},
+        }})
+        red = next(b for b in agg(r, "colors")["buckets"] if b["key"] == "red")
+        kinds = {b["key"]: b["doc_count"] for b in red["kinds"]["buckets"]}
+        assert kinds == {"shirt": 2, "pants": 1, "hat": 1}
+
+    def test_histogram(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "prices": {"histogram": {"field": "price", "interval": 10}},
+        }})
+        got = {b["key"]: b["doc_count"] for b in agg(r, "prices")["buckets"]}
+        assert got == {0.0: 2, 10.0: 3, 20.0: 2, 30.0: 1}
+
+    def test_date_histogram_month(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "monthly": {"date_histogram": {"field": "sold", "interval": "month"}},
+        }})
+        buckets = agg(r, "monthly")["buckets"]
+        counts = [b["doc_count"] for b in buckets]
+        assert counts == [2, 2, 3, 1]
+        assert buckets[0]["key_as_string"].startswith("2017-01-01")
+
+    def test_range_agg(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "bands": {"range": {"field": "price", "ranges": [
+                {"to": 10}, {"from": 10, "to": 20}, {"from": 20, "key": "big"},
+            ]}},
+        }})
+        buckets = agg(r, "bands")["buckets"]
+        assert [b["doc_count"] for b in buckets] == [2, 3, 3]
+        assert buckets[2]["key"] == "big"
+
+    def test_filter_agg(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "cheap": {"filter": {"range": {"price": {"lt": 12}}}, "aggs": {
+                "avg_p": {"avg": {"field": "price"}},
+            }},
+        }})
+        a = agg(r, "cheap")
+        assert a["doc_count"] == 3  # 10, 5, 8
+        assert a["avg_p"]["value"] == pytest.approx(23 / 3)
+
+    def test_filters_agg(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "groups": {"filters": {"filters": {
+                "red": {"term": {"color": "red"}},
+                "cheap": {"range": {"price": {"lt": 10}}},
+            }}},
+        }})
+        buckets = agg(r, "groups")["buckets"]
+        assert buckets["red"]["doc_count"] == 4
+        assert buckets["cheap"]["doc_count"] == 2
+
+    def test_global_agg(self, sales):
+        r = sales.search({"size": 0, "query": {"term": {"color": "red"}}, "aggs": {
+            "all": {"global": {}, "aggs": {"n": {"value_count": {"field": "price"}}}},
+            "matched": {"value_count": {"field": "price"}},
+        }})
+        assert agg(r, "all")["doc_count"] == 8
+        assert agg(r, "all")["n"]["value"] == 8
+        assert agg(r, "matched")["value"] == 4
+
+    def test_missing_agg(self, sales):
+        idx = IndexService("m", Settings({"index.number_of_shards": 1}))
+        idx.index_doc("1", {"a": 1, "b": "x"})
+        idx.index_doc("2", {"a": 2})
+        idx.refresh()
+        r = idx.search({"size": 0, "aggs": {"no_b": {"missing": {"field": "b"}}}})
+        assert agg(r, "no_b")["doc_count"] == 1
+        idx.close()
+
+
+class TestPipeline:
+    def test_cumulative_sum_and_derivative(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "monthly": {"date_histogram": {"field": "sold", "interval": "month"},
+                        "aggs": {"total": {"sum": {"field": "price"}}}},
+            "cum": {"cumulative_sum": {"buckets_path": "monthly>total"}},
+            "deriv": {"derivative": {"buckets_path": "monthly>total"}},
+        }})
+        buckets = agg(r, "monthly")["buckets"]
+        totals = [b["total"]["value"] for b in buckets]
+        assert totals == [30.0, 40.0, 43.0, 12.0]
+        cums = [b["cum"]["value"] for b in buckets]
+        assert cums == [30.0, 70.0, 113.0, 125.0]
+        assert "deriv" not in buckets[0]
+        assert buckets[1]["deriv"]["value"] == 10.0
+
+    def test_bucket_stats(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "monthly": {"date_histogram": {"field": "sold", "interval": "month"},
+                        "aggs": {"total": {"sum": {"field": "price"}}}},
+            "best": {"max_bucket": {"buckets_path": "monthly>total"}},
+            "avg_m": {"avg_bucket": {"buckets_path": "monthly>total"}},
+        }})
+        assert agg(r, "best")["value"] == 43.0
+        assert agg(r, "avg_m")["value"] == pytest.approx(125 / 4)
+
+    def test_bucket_script_and_selector(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"terms": {"field": "color"}, "aggs": {
+                "total": {"sum": {"field": "price"}},
+            }},
+            "ratio": {"bucket_script": {
+                "buckets_path": {"t": "colors>total"},
+                "script": "params.t / 125.0",
+            }},
+        }})
+        buckets = agg(r, "colors")["buckets"]
+        red = next(b for b in buckets if b["key"] == "red")
+        assert red["ratio"]["value"] == pytest.approx(50 / 125)
+
+    def test_bucket_selector_drops(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "colors": {"terms": {"field": "color"}, "aggs": {
+                "total": {"sum": {"field": "price"}},
+            }},
+            "keep_big": {"bucket_selector": {
+                "buckets_path": {"t": "colors>total"},
+                "script": "params.t > 40",
+            }},
+        }})
+        keys = {b["key"] for b in agg(r, "colors")["buckets"]}
+        assert keys == {"red", "blue"}
+
+
+class TestNumericTerms:
+    def test_terms_on_numeric(self, sales):
+        r = sales.search({"size": 0, "aggs": {
+            "prices": {"terms": {"field": "price", "size": 20}},
+        }})
+        got = {b["key"]: b["doc_count"] for b in agg(r, "prices")["buckets"]}
+        assert got[10] == 1 and len(got) == 8
