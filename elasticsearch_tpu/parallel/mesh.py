@@ -1,0 +1,56 @@
+"""Device mesh construction for shard placement.
+
+Role model inversion: the reference scales by placing Lucene shards on
+nodes connected by Netty RPC (modules/transport-netty4). On TPU the
+intra-slice "network" is ICI, addressed not by RPC but by compiling
+collectives into the program over a ``jax.sharding.Mesh`` (SURVEY.md §5.8):
+
+- axis "shards": index shards, one (or more) per device — the data-plane
+  scatter/gather of the reference's query phase becomes psum/all_gather
+  over this axis.
+- axis "replicas" (optional 2nd axis): query replicas for throughput —
+  the analog of replica shards serving reads.
+
+Cross-host (DCN) communication stays host-side RPC (cluster/ control
+plane), exactly as the reference separates data plane from cluster state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def shard_mesh(n_shards: Optional[int] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the 'shards' axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_shards is not None:
+        devs = devs[:n_shards]
+    return Mesh(np.asarray(devs), axis_names=("shards",))
+
+
+def shard_replica_mesh(n_shards: int, n_replicas: int,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """2-D mesh: shards x replicas (replicas see the same shard data and
+    split query load)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    need = n_shards * n_replicas
+    if len(devs) < need:
+        raise ValueError(
+            f"need {need} devices for {n_shards}x{n_replicas} mesh, have {len(devs)}"
+        )
+    grid = np.asarray(devs[:need]).reshape(n_shards, n_replicas)
+    return Mesh(grid, axis_names=("shards", "replicas"))
+
+
+def shards_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading dim partitioned across shards."""
+    return NamedSharding(mesh, PartitionSpec("shards"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

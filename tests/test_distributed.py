@@ -1,0 +1,115 @@
+"""Distributed execution tests on the 8-device virtual CPU mesh.
+
+The reference tests multi-node behavior with InternalTestCluster (many
+nodes in one JVM); we test the mesh data plane with many virtual devices in
+one process (SURVEY.md §4.6.3) — the sharding/collective code paths are
+identical to real multi-chip TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.mapper.mapping import MapperService
+from elasticsearch_tpu.parallel.distributed import DistributedSearcher
+from elasticsearch_tpu.parallel.mesh import shard_mesh
+
+import golden
+
+
+def build_sharded_corpus(n_shards, docs_per_shard, seed=0):
+    """Returns (segments, all_docs_tokens, doc_locator)."""
+    rng = np.random.RandomState(seed)
+    vocab = [f"w{i}" for i in range(30)]
+    svc = MapperService(
+        AnalysisRegistry(),
+        {"properties": {"body": {"type": "text", "analyzer": "whitespace"}}},
+    )
+    segments = []
+    all_docs = []
+    locator = []  # global index -> (shard, local)
+    for s in range(n_shards):
+        b = SegmentBuilder(f"shard{s}")
+        for d in range(docs_per_shard):
+            toks = [vocab[rng.randint(len(vocab))] for _ in range(rng.randint(1, 20))]
+            b.add_document(
+                svc.parse_document(f"{s}-{d}", {"body": " ".join(toks)}), d
+            )
+            all_docs.append(toks)
+            locator.append((s, d))
+        segments.append(b.seal())
+    return segments, all_docs, locator
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return shard_mesh(8)
+
+
+class TestDistributedSearch:
+    def test_matches_single_node_golden(self, mesh8):
+        segments, all_docs, locator = build_sharded_corpus(8, 40)
+        searcher = DistributedSearcher(segments, mesh8)
+        terms = ["w0", "w1", "w2"]
+        scores, shards, docs, total = searcher.search("body", terms, k=10)
+
+        # golden: score ALL docs as one corpus — DFS mode makes the
+        # distributed scores identical to a single-shard index
+        ref_scores, ref_matched = golden.score_corpus(all_docs, terms)
+        assert total == len(ref_matched)
+        ref_top = golden.top_k(ref_scores, 10)
+        got = [
+            (int(s_id), int(d), float(sc))
+            for sc, s_id, d in zip(scores, shards, docs)
+            if sc > -np.inf
+        ]
+        assert len(got) == len(ref_top)
+        for (shard_id, local_doc, score), (ref_doc, ref_score) in zip(got, ref_top):
+            assert score == pytest.approx(ref_score, rel=1e-5)
+        # exact same global doc set
+        got_globals = {
+            locator.index((sh, d)) for sh, d, _ in got
+        }
+        assert got_globals == {d for d, _ in ref_top}
+
+    def test_uneven_shards(self, mesh8):
+        # shards of very different sizes stack + score correctly
+        segments, all_docs, locator = build_sharded_corpus(3, 5)
+        big_segments, big_docs, big_loc = build_sharded_corpus(1, 300, seed=9)
+        segments.append(big_segments[0])
+        offset = len(all_docs)
+        all_docs.extend(big_docs)
+        locator.extend((3, d) for _, d in big_loc)
+        searcher = DistributedSearcher(segments, shard_mesh(8))
+        scores, shards, docs, total = searcher.search("body", ["w5"], k=5)
+        ref_scores, ref_matched = golden.score_corpus(all_docs, ["w5"])
+        assert total == len(ref_matched)
+        ref_top = golden.top_k(ref_scores, 5)
+        got_scores = [float(s) for s in scores if s > -np.inf]
+        for got_s, (_, ref_s) in zip(got_scores, ref_top):
+            assert got_s == pytest.approx(ref_s, rel=1e-5)
+
+    def test_program_reuse_across_queries(self, mesh8):
+        segments, _, _ = build_sharded_corpus(8, 20)
+        searcher = DistributedSearcher(segments, mesh8)
+        searcher.search("body", ["w1"], k=5)
+        n_programs = len(searcher._programs)
+        searcher.search("body", ["w2"], k=5)  # same shapes -> same program
+        assert len(searcher._programs) == n_programs
+
+
+class TestMeshHelpers:
+    def test_shard_mesh_axis(self, mesh8):
+        assert mesh8.axis_names == ("shards",)
+        assert mesh8.devices.size == 8
+
+    def test_shard_replica_mesh(self):
+        from elasticsearch_tpu.parallel.mesh import shard_replica_mesh
+
+        m = shard_replica_mesh(4, 2)
+        assert m.axis_names == ("shards", "replicas")
+        assert m.devices.shape == (4, 2)
