@@ -1,0 +1,63 @@
+"""In-process client: the typed facade over the node's APIs.
+
+Role model: ``NodeClient`` (core/.../client/node/NodeClient.java) — same
+process, no HTTP; plus a thin ``RestClient`` for tests exercising the wire
+path. Method names follow the reference's high-level client surface
+(index, get, delete, update, search, bulk, indices.*, cluster.*).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class Client:
+    """Direct (in-process) client — dispatches through the REST controller
+    so request/response shapes match the wire exactly."""
+
+    def __init__(self, node: Node):
+        self.node = node
+        self.controller = RestController(node)
+        node.rest_controller = self.controller
+
+    def perform(self, method: str, path: str, params: Optional[dict] = None,
+                body=None):
+        if body is None:
+            raw = b""
+        elif isinstance(body, (bytes, str)):
+            raw = body.encode() if isinstance(body, str) else body
+        else:
+            raw = json.dumps(body).encode()
+        status, payload = self.controller.dispatch(
+            method, path, {k: str(v) for k, v in (params or {}).items()}, raw
+        )
+        return status, payload
+
+    # --- document ---
+
+    def index(self, index, doc_id, body, **params):
+        if doc_id is None:
+            return self.perform("POST", f"/{index}/_doc", params, body)
+        return self.perform("PUT", f"/{index}/_doc/{doc_id}", params, body)
+
+    def get(self, index, doc_id, **params):
+        return self.perform("GET", f"/{index}/_doc/{doc_id}", params)
+
+    def delete(self, index, doc_id, **params):
+        return self.perform("DELETE", f"/{index}/_doc/{doc_id}", params)
+
+    def update(self, index, doc_id, body, **params):
+        return self.perform("POST", f"/{index}/_update/{doc_id}", params, body)
+
+    def bulk(self, operations: str, **params):
+        return self.perform("POST", "/_bulk", params, operations)
+
+    def search(self, index="_all", body=None, **params):
+        return self.perform("POST", f"/{index}/_search", params, body or {})
+
+    def count(self, index="_all", body=None, **params):
+        return self.perform("POST", f"/{index}/_count", params, body or {})

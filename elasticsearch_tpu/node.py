@@ -1,0 +1,776 @@
+"""Node: the composition root.
+
+Role model: ``Node`` (core/.../node/Node.java:246) — wires settings,
+cluster service, indices service, ingest, snapshots, tasks; plus the
+index-lifecycle parts of ``IndicesService``/``MetaDataCreateIndexService``
+(auto-create, templates, aliases) and the coordination-level APIs
+(bulk, mget, msearch, scroll) that live under action/ in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid as _uuid
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import (
+    ClusterService,
+    ClusterState,
+    DiscoveryNode,
+    IndexMetadata,
+    cluster_health,
+)
+from elasticsearch_tpu.common.errors import (
+    ActionRequestValidationException,
+    IllegalArgumentException,
+    IndexAlreadyExistsException,
+    IndexNotFoundException,
+    InvalidIndexNameException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.common.settings import (
+    CLUSTER_NAME,
+    NODE_NAME,
+    PATH_DATA,
+    Settings,
+    cluster_settings,
+    index_scoped_settings,
+)
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.ingest.pipeline import IngestService
+from elasticsearch_tpu.tasks.task_manager import TaskManager
+from elasticsearch_tpu.version import __version__
+
+_INVALID_INDEX_CHARS = set(' "*\\<>|,/?#')
+
+
+class Node:
+    def __init__(self, settings: Settings = Settings.EMPTY,
+                 data_path: Optional[str] = None):
+        self.settings = settings
+        self.node_id = _uuid.uuid4().hex[:20]
+        self.node_name = NODE_NAME.get(settings)
+        self.cluster_settings = cluster_settings()
+        self.index_scoped_settings = index_scoped_settings()
+        self.data_path = data_path or PATH_DATA.get(settings)
+        self.persistent_path = data_path is not None or "path.data" in settings
+        node = DiscoveryNode(self.node_id, self.node_name, "127.0.0.1:9300")
+        initial = ClusterState(
+            CLUSTER_NAME.get(settings),
+            nodes={self.node_id: node},
+            master_node_id=self.node_id,
+        )
+        self.cluster_service = ClusterService(initial)
+        self.indices: Dict[str, IndexService] = {}
+        self.ingest = IngestService(self)
+        self.tasks = TaskManager(self.node_id)
+        from elasticsearch_tpu.snapshots.service import SnapshotsService
+
+        self.snapshots = SnapshotsService(self)
+        self.scrolls: Dict[str, dict] = {}
+        self._scroll_lock = threading.Lock()
+        self.start_time = time.time()
+        self._closed = False
+        if self.persistent_path:
+            self._recover_indices_from_disk()
+
+    # ------------------------------------------------------------------
+    # Index lifecycle (MetaDataCreateIndexService / MetaDataDeleteIndexService)
+    # ------------------------------------------------------------------
+
+    def _validate_index_name(self, name: str) -> None:
+        if not name or name != name.lower():
+            raise InvalidIndexNameException(name, "must be lowercase")
+        if name.startswith(("_", "-", "+")):
+            raise InvalidIndexNameException(name, "must not start with '_', '-', or '+'")
+        if any(c in _INVALID_INDEX_CHARS for c in name):
+            raise InvalidIndexNameException(name, "must not contain special characters")
+
+    def _index_data_path(self, name: str) -> Optional[str]:
+        if not self.persistent_path:
+            return None
+        return os.path.join(self.data_path, "indices", name)
+
+    def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        self._validate_index_name(name)
+        if name in self.indices or any(
+            name in md.aliases for md in self.cluster_service.state.indices.values()
+        ):
+            raise IndexAlreadyExistsException(name)
+        settings = Settings.from_dict(body.get("settings") or {})
+        mappings = body.get("mappings") or {}
+        if "_doc" in mappings or "doc" in mappings:  # typed mapping form
+            mappings = mappings.get("_doc") or mappings.get("doc")
+        aliases = {a: (spec or {}) for a, spec in (body.get("aliases") or {}).items()}
+
+        # apply matching templates, lowest order first (MetaDataCreateIndexService)
+        templates = sorted(
+            (t for t in self.cluster_service.state.templates.values()
+             if _template_matches(t, name)),
+            key=lambda t: t.get("order", 0),
+        )
+        merged_settings = Settings.EMPTY
+        merged_mappings: dict = {}
+        for t in templates:
+            merged_settings = merged_settings.merged_with(
+                Settings.from_dict(t.get("settings") or {})
+            )
+            t_map = t.get("mappings") or {}
+            if "_doc" in t_map:
+                t_map = t_map["_doc"]
+            _merge_mapping_dicts(merged_mappings, t_map)
+            for a, spec in (t.get("aliases") or {}).items():
+                aliases.setdefault(a, spec or {})
+        merged_settings = merged_settings.merged_with(settings)
+        _merge_mapping_dicts(merged_mappings, mappings)
+
+        self.index_scoped_settings.validate(merged_settings, allow_unknown=True)
+        svc = IndexService(name, merged_settings, merged_mappings,
+                           self._index_data_path(name))
+        self.indices[name] = svc
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            new.indices[name] = IndexMetadata(
+                name, merged_settings, svc.mapping_dict(), aliases,
+                creation_date=svc.creation_date,
+            )
+            return new
+
+        self.cluster_service.submit_state_update_task(f"create-index [{name}]", update)
+        return {"acknowledged": True, "shards_acknowledged": True, "index": name}
+
+    def delete_index(self, expression: str) -> dict:
+        names = self.cluster_service.state.resolve_index_names(expression)
+        for name in names:
+            svc = self.indices.pop(name, None)
+            if svc is not None:
+                svc.close()
+            if self.persistent_path:
+                import shutil
+
+                path = self._index_data_path(name)
+                if path and os.path.exists(path):
+                    shutil.rmtree(path, ignore_errors=True)
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            for name in names:
+                new.indices.pop(name, None)
+            return new
+
+        self.cluster_service.submit_state_update_task(f"delete-index {names}", update)
+        return {"acknowledged": True}
+
+    def close_index(self, expression: str) -> dict:
+        names = self.cluster_service.state.resolve_index_names(expression)
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            for n in names:
+                new.indices[n].state = "close"
+            return new
+
+        self.cluster_service.submit_state_update_task(f"close-index {names}", update)
+        return {"acknowledged": True}
+
+    def open_index(self, expression: str) -> dict:
+        names = self.cluster_service.state.resolve_index_names(expression)
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            for n in names:
+                new.indices[n].state = "open"
+            return new
+
+        self.cluster_service.submit_state_update_task(f"open-index {names}", update)
+        return {"acknowledged": True}
+
+    def _recover_indices_from_disk(self) -> None:
+        """GatewayService analog: restore index metadata + shard data from
+        the data path on startup (gateway/GatewayMetaState.java)."""
+        root = os.path.join(self.data_path, "indices")
+        if not os.path.isdir(root):
+            return
+        import json
+
+        for name in sorted(os.listdir(root)):
+            meta_path = os.path.join(root, name, "_meta.json")
+            if not os.path.exists(meta_path):
+                continue
+            with open(meta_path, encoding="utf-8") as f:
+                meta = json.load(f)
+            settings = Settings(meta.get("settings", {}))
+            svc = IndexService(name, settings, meta.get("mappings"),
+                               self._index_data_path(name))
+            self.indices[name] = svc
+
+            def update(state: ClusterState, name=name, settings=settings,
+                       svc=svc, meta=meta) -> ClusterState:
+                new = state.copy()
+                new.indices[name] = IndexMetadata(
+                    name, settings, svc.mapping_dict(), meta.get("aliases", {}),
+                )
+                return new
+
+            self.cluster_service.submit_state_update_task(f"recover [{name}]", update)
+
+    def _persist_index_meta(self, name: str) -> None:
+        if not self.persistent_path:
+            return
+        import json
+
+        md = self.cluster_service.state.indices.get(name)
+        svc = self.indices.get(name)
+        if md is None or svc is None:
+            return
+        path = self._index_data_path(name)
+        os.makedirs(path, exist_ok=True)
+        tmp = os.path.join(path, "_meta.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({
+                "settings": md.settings.as_dict(),
+                "mappings": svc.mapping_dict(),
+                "aliases": md.aliases,
+            }, f)
+        os.replace(tmp, os.path.join(path, "_meta.json"))
+
+    # ------------------------------------------------------------------
+    # Resolution helpers
+    # ------------------------------------------------------------------
+
+    def index_service(self, name: str, auto_create: bool = False) -> IndexService:
+        state = self.cluster_service.state
+        if name in self.indices:
+            if state.indices.get(name) and state.indices[name].state == "close":
+                raise IllegalArgumentException(f"index [{name}] is closed")
+            return self.indices[name]
+        for idx_name, md in state.indices.items():
+            if name in md.aliases:
+                return self.indices[idx_name]
+        if auto_create:
+            from elasticsearch_tpu.common.settings import ACTION_AUTO_CREATE_INDEX
+
+            if ACTION_AUTO_CREATE_INDEX.get(self.settings):
+                self.create_index(name)
+                return self.indices[name]
+        raise IndexNotFoundException(name)
+
+    def resolve_search_indices(self, expression: str) -> List[IndexService]:
+        names = self.cluster_service.state.resolve_index_names(expression)
+        return [self.indices[n] for n in names
+                if self.cluster_service.state.indices[n].state == "open"]
+
+    # ------------------------------------------------------------------
+    # Document APIs
+    # ------------------------------------------------------------------
+
+    def index_doc(self, index: str, doc_id: Optional[str], source: dict,
+                  routing: Optional[str] = None, refresh=None,
+                  pipeline: Optional[str] = None, **kw) -> dict:
+        svc = self.index_service(index, auto_create=True)
+        if pipeline:
+            source = self.ingest.run_pipeline(pipeline, source, doc_id, index)
+            if source is None:  # dropped by pipeline
+                return {"_index": index, "_id": doc_id, "result": "noop"}
+        if doc_id is None:
+            doc_id = _uuid.uuid4().hex[:20]
+            kw.setdefault("op_type", "create")
+        r = svc.index_doc(doc_id, source, routing, **kw)
+        self._maybe_refresh(svc, refresh)
+        self._maybe_update_mapping_meta(index)
+        return r
+
+    def _maybe_refresh(self, svc: IndexService, refresh) -> None:
+        if refresh in (True, "true", ""):
+            svc.refresh()
+        elif refresh == "wait_for":
+            svc.refresh()  # single-node: immediate refresh == wait_for
+
+    def _maybe_update_mapping_meta(self, index: str) -> None:
+        # dynamic mapping updates flow back into cluster state (the master
+        # round-trip in §3.3 of SURVEY.md)
+        svc = self.indices.get(index)
+        if svc is None:
+            return
+        state = self.cluster_service.state
+        md = state.indices.get(index)
+        if md is not None and md.mappings != svc.mapping_dict():
+            def update(st: ClusterState) -> ClusterState:
+                new = st.copy()
+                new.indices[index].mappings = svc.mapping_dict()
+                new.indices[index].version += 1
+                return new
+
+            self.cluster_service.submit_state_update_task(
+                f"update-mapping [{index}]", update
+            )
+            self._persist_index_meta(index)
+
+    def get_doc(self, index: str, doc_id: str, routing=None) -> dict:
+        svc = self.index_service(index)
+        g = svc.get_doc(doc_id, routing)
+        out = {
+            "_index": svc.name,
+            "_type": "_doc",
+            "_id": doc_id,
+            "found": g.found,
+        }
+        if g.found:
+            out["_version"] = g.version
+            out["_seq_no"] = g.seqno
+            out["_source"] = g.source
+        return out
+
+    def delete_doc(self, index: str, doc_id: str, routing=None, refresh=None, **kw) -> dict:
+        svc = self.index_service(index)
+        r = svc.delete_doc(doc_id, routing, **kw)
+        self._maybe_refresh(svc, refresh)
+        return r
+
+    def update_doc(self, index: str, doc_id: str, body: dict, routing=None,
+                   refresh=None) -> dict:
+        svc = self.index_service(index)
+        r = svc.update_doc(doc_id, body, routing)
+        self._maybe_refresh(svc, refresh)
+        self._maybe_update_mapping_meta(index)
+        return r
+
+    def mget(self, body: dict, default_index: Optional[str] = None) -> dict:
+        docs = []
+        for spec in body.get("docs", []):
+            index = spec.get("_index", default_index)
+            try:
+                docs.append(self.get_doc(index, spec["_id"], spec.get("routing")))
+            except IndexNotFoundException:
+                docs.append({
+                    "_index": index, "_id": spec["_id"],
+                    "error": {"type": "index_not_found_exception"},
+                })
+        return {"docs": docs}
+
+    # ------------------------------------------------------------------
+    # Bulk (action/bulk/TransportBulkAction: group by shard, per-item results)
+    # ------------------------------------------------------------------
+
+    def bulk(self, operations: List[tuple], refresh=None,
+             pipeline: Optional[str] = None) -> dict:
+        """operations: list of (action, meta, source_or_None)."""
+        t0 = time.monotonic()
+        items = []
+        errors = False
+        touched = set()
+        for action, meta, source in operations:
+            index = meta.get("_index")
+            doc_id = meta.get("_id")
+            routing = meta.get("routing") or meta.get("_routing")
+            item_pipeline = meta.get("pipeline", pipeline)
+            try:
+                if action == "index":
+                    r = self.index_doc(index, doc_id, source, routing,
+                                       pipeline=item_pipeline)
+                    status = 201 if r.get("result") == "created" else 200
+                elif action == "create":
+                    r = self.index_doc(index, doc_id, source, routing,
+                                       op_type="create", pipeline=item_pipeline)
+                    status = 201
+                elif action == "update":
+                    r = self.update_doc(index, doc_id, source, routing)
+                    status = 200
+                elif action == "delete":
+                    r = self.delete_doc(index, doc_id, routing)
+                    status = 200 if r.get("found") else 404
+                else:
+                    raise ActionRequestValidationException(
+                        f"Malformed action/metadata line, expected one of "
+                        f"[create, delete, index, update] but found [{action}]"
+                    )
+                touched.add(r.get("_index", index))
+                item = {action: {**{k: v for k, v in r.items() if k != "found"},
+                                 "status": status}}
+            except Exception as e:  # per-item failure (reference behavior)
+                errors = True
+                from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+
+                if isinstance(e, ElasticsearchTpuException):
+                    err = e.to_dict()["error"]
+                    status = e.status_code
+                else:
+                    err = {"type": type(e).__name__, "reason": str(e)}
+                    status = 500
+                item = {action: {
+                    "_index": index, "_id": doc_id, "status": status, "error": err,
+                }}
+            items.append(item)
+        if refresh in (True, "true", "", "wait_for"):
+            for name in touched:
+                if name in self.indices:
+                    self.indices[name].refresh()
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "errors": errors,
+            "items": items,
+        }
+
+    # ------------------------------------------------------------------
+    # Search (+ msearch, scroll)
+    # ------------------------------------------------------------------
+
+    def search(self, expression: str, body: Optional[dict] = None,
+               scroll: Optional[str] = None) -> dict:
+        services = self.resolve_search_indices(expression or "_all")
+        body = body or {}
+        task = self.tasks.register("indices:data/read/search", f"search [{expression}]")
+        try:
+            if len(services) == 1:
+                resp = services[0].search(body)
+            else:
+                resp = self._multi_index_search(services, body)
+        finally:
+            self.tasks.unregister(task)
+        if scroll:
+            resp["_scroll_id"] = self._open_scroll(expression, body, resp, scroll)
+        return resp
+
+    def _multi_index_search(self, services: List[IndexService], body: dict) -> dict:
+        """Cross-index search: fan out, merge like cross-shard merge."""
+        from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggregations
+        from elasticsearch_tpu.search.service import (
+            fetch_hits,
+            merge_refs,
+            normalize_sort,
+        )
+
+        t0 = time.monotonic()
+        from_ = int(body.get("from", 0) or 0)
+        size = int(body.get("size")) if body.get("size") is not None else 10
+        k = from_ + size
+        sort_spec = normalize_sort(body.get("sort"))
+        all_refs = []
+        total = 0
+        max_score = None
+        views = []
+        n_shards = 0
+        per_index = {}
+        for svc in services:
+            for sid in sorted(svc.shards):
+                n_shards += 1
+                res = svc.shards[sid].searcher.query(body, size_hint=max(k, 1))
+                total += res.total_hits
+                if res.max_score is not None:
+                    max_score = (res.max_score if max_score is None
+                                 else max(max_score, res.max_score))
+                for ref in res.refs:
+                    ref.shard_id = (svc.name, ref.shard_id)
+                    all_refs.append(ref)
+                views.extend(res.agg_views)
+            per_index[svc.name] = svc
+        refs = merge_refs(all_refs, sort_spec, max(k, 0))[from_: from_ + size]
+        shard_map = {}
+        for svc in services:
+            for sid, shard in svc.shards.items():
+                shard_map[(svc.name, sid)] = shard
+        hits = []
+        by_index: Dict[str, List] = {}
+        for ref in refs:
+            by_index.setdefault(ref.shard_id[0], []).append(ref)
+        ordered_hits = {}
+        for idx_name, idx_refs in by_index.items():
+            sub_shards = {r.shard_id: shard_map[r.shard_id] for r in idx_refs}
+            for ref, hit in zip(idx_refs, fetch_hits(idx_refs, sub_shards, body, idx_name)):
+                ordered_hits[id(ref)] = hit
+        hits = [ordered_hits[id(r)] for r in refs if id(r) in ordered_hits]
+        resp = {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": n_shards, "successful": n_shards, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": total, "max_score": max_score, "hits": hits},
+        }
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        if agg_specs:
+            resp["aggregations"] = run_aggregations(agg_specs, views)
+        return resp
+
+    def msearch(self, searches: List[tuple]) -> dict:
+        """searches: list of (header, body)."""
+        responses = []
+        for header, body in searches:
+            try:
+                responses.append(self.search(header.get("index", "_all"), body))
+            except Exception as e:
+                from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+
+                if isinstance(e, ElasticsearchTpuException):
+                    responses.append(e.to_dict())
+                else:
+                    responses.append({"error": {"type": type(e).__name__,
+                                                "reason": str(e)}, "status": 500})
+        return {"responses": responses}
+
+    # --- scroll: cursor over a point-in-time sorted result (search/internal/
+    # ScrollContext). Implemented as stored search_after state (exact for
+    # static indices; NRT changes between pages are visible, a documented
+    # delta vs the reference's snapshot readers). ---
+
+    def _open_scroll(self, expression: str, body: dict, first_resp: dict,
+                     keep_alive: str) -> str:
+        from elasticsearch_tpu.common.units import parse_time_value
+
+        scroll_id = _uuid.uuid4().hex
+        ttl = parse_time_value(keep_alive or "5m", "scroll")
+        body = dict(body)
+        if "sort" not in body:
+            body["sort"] = [{"_doc": "asc"}]
+        with self._scroll_lock:
+            self.scrolls[scroll_id] = {
+                "expression": expression,
+                "body": body,
+                "expire_at": time.time() + ttl,
+                "last_hits": first_resp["hits"]["hits"],
+            }
+        return scroll_id
+
+    def scroll(self, scroll_id: str, keep_alive: Optional[str] = None) -> dict:
+        from elasticsearch_tpu.common.units import parse_time_value
+
+        with self._scroll_lock:
+            ctx = self.scrolls.get(scroll_id)
+            if ctx is None or ctx["expire_at"] < time.time():
+                self.scrolls.pop(scroll_id, None)
+                raise ResourceNotFoundException(f"No search context found for id [{scroll_id}]")
+        last_hits = ctx["last_hits"]
+        if not last_hits:
+            resp = {"_scroll_id": scroll_id, "hits": {"total": 0, "hits": []},
+                    "timed_out": False, "took": 0}
+            return resp
+        body = dict(ctx["body"])
+        last_sort = last_hits[-1].get("sort")
+        if last_sort is None:
+            # relevance-sorted scroll: cursor on score
+            body["search_after"] = [last_hits[-1]["_score"]]
+        else:
+            body["search_after"] = last_sort
+        body.pop("from", None)
+        resp = self.search(ctx["expression"], body)
+        with self._scroll_lock:
+            if scroll_id in self.scrolls:
+                self.scrolls[scroll_id]["last_hits"] = resp["hits"]["hits"]
+                if keep_alive:
+                    self.scrolls[scroll_id]["expire_at"] = (
+                        time.time() + parse_time_value(keep_alive, "scroll")
+                    )
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def clear_scroll(self, scroll_ids: List[str]) -> dict:
+        n = 0
+        with self._scroll_lock:
+            if scroll_ids == ["_all"]:
+                n = len(self.scrolls)
+                self.scrolls.clear()
+            else:
+                for sid in scroll_ids:
+                    if self.scrolls.pop(sid, None) is not None:
+                        n += 1
+        return {"succeeded": True, "num_freed": n}
+
+    # ------------------------------------------------------------------
+    # Admin / cluster APIs
+    # ------------------------------------------------------------------
+
+    def health(self) -> dict:
+        return cluster_health(self.cluster_service.state, self.indices)
+
+    def cluster_stats(self) -> dict:
+        state = self.cluster_service.state
+        total_docs = sum(svc.num_docs for svc in self.indices.values())
+        return {
+            "cluster_name": state.cluster_name,
+            "status": self.health()["status"],
+            "indices": {
+                "count": len(self.indices),
+                "docs": {"count": total_docs},
+                "shards": {
+                    "total": sum(s.num_shards for s in self.indices.values()),
+                },
+            },
+            "nodes": {
+                "count": {"total": 1, "data": 1, "master": 1, "ingest": 1},
+                "versions": [__version__],
+            },
+        }
+
+    def node_info(self) -> dict:
+        return {
+            "cluster_name": self.cluster_service.state.cluster_name,
+            "nodes": {
+                self.node_id: {
+                    "name": self.node_name,
+                    "version": __version__,
+                    "roles": ["master", "data", "ingest"],
+                    "settings": self.settings.as_nested_dict(),
+                }
+            },
+        }
+
+    def node_stats(self) -> dict:
+        indices_stats = {}
+        for name, svc in self.indices.items():
+            indices_stats[name] = svc.stats()["total"]
+        return {
+            "cluster_name": self.cluster_service.state.cluster_name,
+            "nodes": {
+                self.node_id: {
+                    "name": self.node_name,
+                    "indices": {
+                        "docs": {"count": sum(s.num_docs for s in self.indices.values())},
+                    },
+                    "jvm": {"uptime_in_millis": int((time.time() - self.start_time) * 1000)},
+                    "process": {"open_file_descriptors": -1},
+                }
+            },
+        }
+
+    def put_template(self, name: str, body: dict) -> dict:
+        body = dict(body)
+        body.setdefault("index_patterns", body.pop("template", None) or [])
+        if isinstance(body["index_patterns"], str):
+            body["index_patterns"] = [body["index_patterns"]]
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            new.templates[name] = body
+            return new
+
+        self.cluster_service.submit_state_update_task(f"put-template [{name}]", update)
+        return {"acknowledged": True}
+
+    def delete_template(self, name: str) -> dict:
+        if name not in self.cluster_service.state.templates:
+            raise ResourceNotFoundException(
+                f"index_template [{name}] missing"
+            )
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            new.templates.pop(name, None)
+            return new
+
+        self.cluster_service.submit_state_update_task(f"delete-template [{name}]", update)
+        return {"acknowledged": True}
+
+    def update_aliases(self, actions: List[dict]) -> dict:
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            for action in actions:
+                ((verb, spec),) = action.items()
+                indices = spec.get("indices") or [spec.get("index")]
+                aliases = spec.get("aliases") or [spec.get("alias")]
+                for idx_expr in indices:
+                    for idx in new.resolve_index_names(idx_expr):
+                        for alias in aliases:
+                            if verb == "add":
+                                meta = {k: spec[k] for k in ("filter", "routing")
+                                        if k in spec}
+                                new.indices[idx].aliases[alias] = meta
+                            elif verb == "remove":
+                                new.indices[idx].aliases.pop(alias, None)
+                            else:
+                                raise IllegalArgumentException(
+                                    f"[aliases] unknown action [{verb}]"
+                                )
+            return new
+
+        self.cluster_service.submit_state_update_task("update-aliases", update)
+        return {"acknowledged": True}
+
+    def put_cluster_settings(self, body: dict) -> dict:
+        persistent = Settings.from_dict(body.get("persistent") or {})
+        transient = Settings.from_dict(body.get("transient") or {})
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            old_merged = state.persistent_settings.merged_with(state.transient_settings)
+            new.persistent_settings = state.persistent_settings.merged_with(persistent)
+            new.transient_settings = state.transient_settings.merged_with(transient)
+            merged = new.persistent_settings.merged_with(new.transient_settings)
+            self.cluster_settings.apply_settings(old_merged, merged)
+            return new
+
+        self.cluster_service.submit_state_update_task("update-settings", update)
+        state = self.cluster_service.state
+        return {
+            "acknowledged": True,
+            "persistent": state.persistent_settings.as_nested_dict(),
+            "transient": state.transient_settings.as_nested_dict(),
+        }
+
+    def update_index_settings(self, expression: str, body: dict) -> dict:
+        flat = Settings.from_dict(body.get("settings", body) or {})
+        normalized = Settings({
+            (k if k.startswith("index.") else f"index.{k}"): v
+            for k, v in flat.as_dict().items()
+        })
+        self.index_scoped_settings.validate_dynamic_update(normalized)
+        names = self.cluster_service.state.resolve_index_names(expression)
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            for n in names:
+                md = new.indices[n]
+                md.settings = md.settings.merged_with(normalized)
+                md.version += 1
+            return new
+
+        self.cluster_service.submit_state_update_task("update-index-settings", update)
+        for n in names:
+            svc = self.indices[n]
+            svc.settings = svc.settings.merged_with(normalized)
+            self._persist_index_meta(n)
+        return {"acknowledged": True}
+
+    def put_stored_script(self, script_id: str, body: dict) -> dict:
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            new.stored_scripts[script_id] = body.get("script", body)
+            return new
+
+        self.cluster_service.submit_state_update_task(f"put-script [{script_id}]", update)
+        return {"acknowledged": True}
+
+    def get_stored_script(self, script_id: str) -> dict:
+        script = self.cluster_service.state.stored_scripts.get(script_id)
+        if script is None:
+            raise ResourceNotFoundException(f"unable to find script [{script_id}]")
+        return {"_id": script_id, "found": True, "script": script}
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for name in list(self.indices):
+            if self.persistent_path:
+                self._persist_index_meta(name)
+                self.indices[name].flush()
+            self.indices[name].close()
+
+
+def _template_matches(template: dict, index_name: str) -> bool:
+    import fnmatch
+
+    patterns = template.get("index_patterns") or []
+    if isinstance(patterns, str):
+        patterns = [patterns]
+    return any(fnmatch.fnmatchcase(index_name, p) for p in patterns)
+
+
+def _merge_mapping_dicts(base: dict, incoming: dict) -> None:
+    for k, v in incoming.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _merge_mapping_dicts(base[k], v)
+        else:
+            base[k] = v

@@ -1,0 +1,147 @@
+"""REST controller: route registry + dispatch.
+
+Role model: ``RestController`` (core/.../rest/RestController.java:65,
+dispatchRequest:168) + ``BaseRestHandler``. Routes use the same
+path-template syntax as the reference's handlers; handlers receive
+(node, params, body) and return (status, payload). Errors map to status
+codes through the exception taxonomy (common/errors.py), serialized in the
+reference's {"error": {...}, "status": N} shape.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    ParsingException,
+)
+
+Handler = Callable[..., Tuple[int, Any]]
+
+
+class RestRequest:
+    def __init__(self, method: str, path: str, params: Dict[str, str],
+                 body: Optional[bytes]):
+        self.method = method
+        self.path = path
+        self.params = params  # query params + path params merged
+        self.raw_body = body or b""
+
+    def json_body(self, default=None):
+        if not self.raw_body.strip():
+            return default
+        try:
+            return json.loads(self.raw_body)
+        except json.JSONDecodeError as e:
+            raise ParsingException(f"request body is not valid JSON: {e}") from e
+
+    def ndjson_lines(self) -> List[dict]:
+        out = []
+        for line in self.raw_body.split(b"\n"):
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise ParsingException(
+                        f"Malformed content, found invalid json line: {e}"
+                    ) from e
+        return out
+
+    def param(self, name: str, default=None):
+        return self.params.get(name, default)
+
+    def bool_param(self, name: str, default=False) -> bool:
+        v = self.params.get(name)
+        if v is None:
+            return default
+        return v in ("", "true", True)
+
+
+class Route:
+    _PARAM_RE = re.compile(r"\{(\w+)\}")
+
+    def __init__(self, method: str, pattern: str, handler: Handler):
+        self.method = method
+        self.pattern = pattern
+        self.handler = handler
+        regex = "^"
+        for part in pattern.strip("/").split("/"):
+            m = self._PARAM_RE.fullmatch(part)
+            if m:
+                if m.group(1) == "index":
+                    # index names/aliases cannot start with '_' — keeps API
+                    # endpoints from being swallowed by /{index} routes
+                    regex += f"/(?P<{m.group(1)}>[^_/][^/]*)"
+                else:
+                    regex += f"/(?P<{m.group(1)}>[^/]+)"
+            else:
+                regex += "/" + re.escape(part)
+        regex += "$"
+        self.regex = re.compile(regex)
+        # literal segments score higher for route priority
+        self.specificity = sum(
+            1 for p in pattern.strip("/").split("/") if not self._PARAM_RE.fullmatch(p)
+        )
+
+    def match(self, path: str) -> Optional[Dict[str, str]]:
+        m = self.regex.match("/" + path.strip("/"))
+        if m is None:
+            return None
+        return m.groupdict()
+
+
+class RestController:
+    def __init__(self, node):
+        self.node = node
+        self.routes: List[Route] = []
+        from elasticsearch_tpu.rest import handlers
+
+        handlers.register_all(self)
+
+    def register(self, method: str, pattern: str, handler: Handler) -> None:
+        self.routes.append(Route(method, pattern, handler))
+        self.routes.sort(key=lambda r: -r.specificity)
+
+    def dispatch(self, method: str, path: str, query: Dict[str, str],
+                 body: Optional[bytes]) -> Tuple[int, Any]:
+        from urllib.parse import unquote
+
+        path = unquote(path.split("?")[0])
+        method_routes = [r for r in self.routes if r.method == method]
+        for route in method_routes:
+            path_params = route.match(path)
+            if path_params is not None:
+                params = dict(query)
+                params.update(path_params)
+                req = RestRequest(method, path, params, body)
+                try:
+                    return route.handler(self.node, req)
+                except ElasticsearchTpuException as e:
+                    return e.status_code, e.to_dict()
+                except Exception as e:  # uncaught -> 500, reference behavior
+                    return 500, {
+                        "error": {"type": type(e).__name__, "reason": str(e)},
+                        "status": 500,
+                    }
+        # path matched under another method -> 405
+        for route in self.routes:
+            if route.method != method and route.match(path) is not None:
+                allowed = sorted({
+                    r.method for r in self.routes if r.match(path) is not None
+                })
+                return 405, {
+                    "error": f"Incorrect HTTP method for uri [{path}] and method "
+                             f"[{method}], allowed: {allowed}",
+                    "status": 405,
+                }
+        return 400, {
+            "error": {
+                "type": "illegal_argument_exception",
+                "reason": f"no handler found for uri [{path}] and method [{method}]",
+            },
+            "status": 400,
+        }

@@ -1,0 +1,906 @@
+"""All REST handlers (the reference registers 105 in ActionModule:332).
+
+Grouped like the reference: document CRUD, search family, index admin,
+cluster admin, cat API, ingest, snapshots, tasks, scripts. Handlers are
+(node, request) -> (status, payload). The cat API returns text tables
+(rest/action/cat/RestTable) unless ?format=json.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    ActionRequestValidationException,
+    IllegalArgumentException,
+)
+from elasticsearch_tpu.version import __version__
+
+
+def register_all(c) -> None:
+    r = c.register
+    # --- root ---
+    r("GET", "/", _root)
+    r("HEAD", "/", lambda n, q: (200, {}))
+
+    # --- document CRUD ---
+    r("PUT", "/{index}/_doc/{id}", _index_doc)
+    r("POST", "/{index}/_doc/{id}", _index_doc)
+    r("POST", "/{index}/_doc", _index_doc_auto_id)
+    r("GET", "/{index}/_doc/{id}", _get_doc)
+    r("HEAD", "/{index}/_doc/{id}", _head_doc)
+    r("DELETE", "/{index}/_doc/{id}", _delete_doc)
+    r("POST", "/{index}/_update/{id}", _update_doc)
+    r("GET", "/{index}/_source/{id}", _get_source)
+    # 6.x typed forms
+    r("PUT", "/{index}/{type}/{id}", _index_doc)
+    r("POST", "/{index}/{type}/{id}", _index_doc)
+    r("GET", "/{index}/{type}/{id}", _get_doc)
+    r("DELETE", "/{index}/{type}/{id}", _delete_doc)
+    r("POST", "/{index}/{type}/{id}/_update", _update_doc)
+    r("POST", "/_mget", _mget)
+    r("POST", "/{index}/_mget", _mget)
+    r("GET", "/_mget", _mget)
+
+    # --- bulk ---
+    r("POST", "/_bulk", _bulk)
+    r("PUT", "/_bulk", _bulk)
+    r("POST", "/{index}/_bulk", _bulk)
+
+    # --- search family ---
+    r("GET", "/_search", _search)
+    r("POST", "/_search", _search)
+    r("GET", "/{index}/_search", _search)
+    r("POST", "/{index}/_search", _search)
+    r("POST", "/_search/scroll", _scroll)
+    r("GET", "/_search/scroll", _scroll)
+    r("DELETE", "/_search/scroll", _clear_scroll)
+    r("POST", "/_msearch", _msearch)
+    r("GET", "/_msearch", _msearch)
+    r("POST", "/{index}/_msearch", _msearch)
+    r("GET", "/_count", _count)
+    r("POST", "/_count", _count)
+    r("GET", "/{index}/_count", _count)
+    r("POST", "/{index}/_count", _count)
+    r("GET", "/{index}/_validate/query", _validate_query)
+    r("POST", "/{index}/_validate/query", _validate_query)
+    r("GET", "/_field_caps", _field_caps)
+    r("POST", "/_field_caps", _field_caps)
+    r("GET", "/{index}/_field_caps", _field_caps)
+    r("POST", "/{index}/_field_caps", _field_caps)
+    r("GET", "/{index}/_explain/{id}", _explain)
+    r("POST", "/{index}/_explain/{id}", _explain)
+
+    # --- reindex family ---
+    r("POST", "/_reindex", _reindex)
+    r("POST", "/{index}/_update_by_query", _update_by_query)
+    r("POST", "/{index}/_delete_by_query", _delete_by_query)
+
+    # --- index admin ---
+    r("PUT", "/{index}", _create_index)
+    r("DELETE", "/{index}", _delete_index)
+    r("GET", "/{index}", _get_index)
+    r("HEAD", "/{index}", _head_index)
+    r("POST", "/{index}/_open", lambda n, q: (200, n.open_index(q.param("index"))))
+    r("POST", "/{index}/_close", lambda n, q: (200, n.close_index(q.param("index"))))
+    r("POST", "/{index}/_refresh", _refresh)
+    r("GET", "/{index}/_refresh", _refresh)
+    r("POST", "/_refresh", _refresh)
+    r("POST", "/{index}/_flush", _flush)
+    r("GET", "/{index}/_flush", _flush)
+    r("POST", "/_flush", _flush)
+    r("POST", "/{index}/_forcemerge", _forcemerge)
+    r("POST", "/_forcemerge", _forcemerge)
+    r("GET", "/{index}/_stats", _index_stats)
+    r("GET", "/_stats", _index_stats)
+    r("GET", "/{index}/_segments", _segments)
+    r("PUT", "/{index}/_mapping", _put_mapping)
+    r("PUT", "/{index}/_mapping/{type}", _put_mapping)
+    r("POST", "/{index}/_mapping", _put_mapping)
+    r("GET", "/{index}/_mapping", _get_mapping)
+    r("GET", "/_mapping", _get_mapping)
+    r("GET", "/{index}/_mapping/{type}", _get_mapping)
+    r("PUT", "/{index}/_settings", _put_index_settings)
+    r("PUT", "/_settings", _put_index_settings)
+    r("GET", "/{index}/_settings", _get_index_settings)
+    r("GET", "/_settings", _get_index_settings)
+    r("GET", "/_analyze", _analyze)
+    r("POST", "/_analyze", _analyze)
+    r("GET", "/{index}/_analyze", _analyze)
+    r("POST", "/{index}/_analyze", _analyze)
+    r("POST", "/_aliases", _update_aliases)
+    r("GET", "/_alias", _get_alias)
+    r("GET", "/_alias/{name}", _get_alias)
+    r("GET", "/{index}/_alias", _get_alias)
+    r("GET", "/{index}/_alias/{name}", _get_alias)
+    r("PUT", "/{index}/_alias/{name}", _put_alias)
+    r("DELETE", "/{index}/_alias/{name}", _delete_alias)
+    r("HEAD", "/_alias/{name}", _head_alias)
+    r("PUT", "/_template/{name}", _put_template)
+    r("GET", "/_template", _get_template)
+    r("GET", "/_template/{name}", _get_template)
+    r("DELETE", "/_template/{name}", _delete_template)
+    r("HEAD", "/_template/{name}", _head_template)
+    r("POST", "/{index}/_cache/clear", _clear_cache)
+    r("POST", "/_cache/clear", _clear_cache)
+
+    # --- cluster admin ---
+    r("GET", "/_cluster/health", lambda n, q: (200, n.health()))
+    r("GET", "/_cluster/health/{index}", lambda n, q: (200, n.health()))
+    r("GET", "/_cluster/state", _cluster_state)
+    r("GET", "/_cluster/state/{metrics}", _cluster_state)
+    r("GET", "/_cluster/stats", lambda n, q: (200, n.cluster_stats()))
+    r("GET", "/_cluster/settings", _get_cluster_settings)
+    r("PUT", "/_cluster/settings", lambda n, q: (200, n.put_cluster_settings(q.json_body({}))))
+    r("POST", "/_cluster/reroute", lambda n, q: (200, {"acknowledged": True,
+                                                       "state": n.cluster_service.state.to_dict()}))
+    r("GET", "/_cluster/allocation/explain", _allocation_explain)
+    r("GET", "/_nodes", lambda n, q: (200, n.node_info()))
+    r("GET", "/_nodes/stats", lambda n, q: (200, n.node_stats()))
+    r("GET", "/_nodes/{node_id}", lambda n, q: (200, n.node_info()))
+    r("GET", "/_nodes/{node_id}/stats", lambda n, q: (200, n.node_stats()))
+    r("GET", "/_remote/info", lambda n, q: (200, {}))
+
+    # --- tasks ---
+    r("GET", "/_tasks", lambda n, q: (200, n.tasks.list_tasks(q.param("actions"))))
+    r("GET", "/_tasks/{task_id}", _get_task)
+    r("POST", "/_tasks/{task_id}/_cancel", _cancel_task)
+
+    # --- scripts ---
+    r("PUT", "/_scripts/{id}", lambda n, q: (200, n.put_stored_script(
+        q.param("id"), q.json_body({}))))
+    r("GET", "/_scripts/{id}", lambda n, q: (200, n.get_stored_script(q.param("id"))))
+    r("DELETE", "/_scripts/{id}", _delete_script)
+
+    # --- ingest ---
+    r("PUT", "/_ingest/pipeline/{id}", lambda n, q: (200, n.ingest.put_pipeline(
+        q.param("id"), q.json_body({}))))
+    r("GET", "/_ingest/pipeline", lambda n, q: (200, n.ingest.get_pipeline()))
+    r("GET", "/_ingest/pipeline/{id}", lambda n, q: (200, n.ingest.get_pipeline(q.param("id"))))
+    r("DELETE", "/_ingest/pipeline/{id}", lambda n, q: (200, n.ingest.delete_pipeline(q.param("id"))))
+    r("POST", "/_ingest/pipeline/_simulate", lambda n, q: (200, n.ingest.simulate(q.json_body({}))))
+    r("GET", "/_ingest/pipeline/_simulate", lambda n, q: (200, n.ingest.simulate(q.json_body({}))))
+    r("POST", "/_ingest/pipeline/{id}/_simulate", _simulate_pipeline_by_id)
+
+    # --- snapshots ---
+    r("PUT", "/_snapshot/{repo}", lambda n, q: (200, n.snapshots.put_repository(
+        q.param("repo"), q.json_body({}))))
+    r("POST", "/_snapshot/{repo}", lambda n, q: (200, n.snapshots.put_repository(
+        q.param("repo"), q.json_body({}))))
+    r("GET", "/_snapshot", lambda n, q: (200, n.snapshots.get_repository()))
+    r("GET", "/_snapshot/{repo}", lambda n, q: (200, n.snapshots.get_repository(q.param("repo"))))
+    r("DELETE", "/_snapshot/{repo}", lambda n, q: (200, n.snapshots.delete_repository(q.param("repo"))))
+    r("PUT", "/_snapshot/{repo}/{snapshot}", lambda n, q: (200, n.snapshots.create_snapshot(
+        q.param("repo"), q.param("snapshot"), q.json_body({}))))
+    r("GET", "/_snapshot/{repo}/{snapshot}", lambda n, q: (200, n.snapshots.get_snapshot(
+        q.param("repo"), q.param("snapshot"))))
+    r("DELETE", "/_snapshot/{repo}/{snapshot}", lambda n, q: (200, n.snapshots.delete_snapshot(
+        q.param("repo"), q.param("snapshot"))))
+    r("POST", "/_snapshot/{repo}/{snapshot}/_restore", lambda n, q: (200, n.snapshots.restore_snapshot(
+        q.param("repo"), q.param("snapshot"), q.json_body({}))))
+
+    # --- cat API (rest/action/cat/, 22 handlers in the reference) ---
+    r("GET", "/_cat", _cat_help)
+    r("GET", "/_cat/indices", _cat_indices)
+    r("GET", "/_cat/indices/{index}", _cat_indices)
+    r("GET", "/_cat/health", _cat_health)
+    r("GET", "/_cat/nodes", _cat_nodes)
+    r("GET", "/_cat/shards", _cat_shards)
+    r("GET", "/_cat/shards/{index}", _cat_shards)
+    r("GET", "/_cat/count", _cat_count)
+    r("GET", "/_cat/count/{index}", _cat_count)
+    r("GET", "/_cat/aliases", _cat_aliases)
+    r("GET", "/_cat/templates", _cat_templates)
+    r("GET", "/_cat/master", _cat_master)
+    r("GET", "/_cat/segments", _cat_segments)
+    r("GET", "/_cat/plugins", lambda n, q: _cat_table(q, [], ["name", "component", "version"]))
+    r("GET", "/_cat/tasks", _cat_tasks)
+    r("GET", "/_cat/pending_tasks", lambda n, q: _cat_table(
+        q, [], ["insertOrder", "timeInQueue", "priority", "source"]))
+    r("GET", "/_cat/allocation", _cat_allocation)
+    r("GET", "/_cat/recovery", _cat_recovery)
+    r("GET", "/_cat/thread_pool", _cat_thread_pool)
+    r("GET", "/_cat/fielddata", lambda n, q: _cat_table(q, [], ["node", "field", "size"]))
+    r("GET", "/_cat/nodeattrs", lambda n, q: _cat_table(q, [], ["node", "attr", "value"]))
+    r("GET", "/_cat/repositories", _cat_repositories)
+    r("GET", "/_cat/snapshots/{repo}", _cat_snapshots)
+
+
+# ---------------------------------------------------------------------------
+# Root / info
+# ---------------------------------------------------------------------------
+
+
+def _root(node, req):
+    return 200, {
+        "name": node.node_name,
+        "cluster_name": node.cluster_service.state.cluster_name,
+        "cluster_uuid": node.node_id,
+        "version": {
+            "number": __version__,
+            "lucene_version": "tpu-block-packed-1",
+            "build_flavor": "tpu",
+        },
+        "tagline": "You Know, for Search (on TPUs)",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Document CRUD
+# ---------------------------------------------------------------------------
+
+
+def _index_doc(node, req):
+    body = req.json_body()
+    if body is None:
+        raise ActionRequestValidationException("Validation Failed: 1: source is missing;")
+    kw = {}
+    if req.param("version") is not None:
+        kw["version"] = int(req.param("version"))
+        kw["version_type"] = req.param("version_type", "internal")
+    if req.param("op_type") == "create":
+        kw["op_type"] = "create"
+    r = node.index_doc(req.param("index"), req.param("id"), body,
+                       routing=req.param("routing"), refresh=req.param("refresh"),
+                       pipeline=req.param("pipeline"), **kw)
+    return (201 if r.get("result") == "created" else 200), r
+
+
+def _index_doc_auto_id(node, req):
+    body = req.json_body()
+    if body is None:
+        raise ActionRequestValidationException("Validation Failed: 1: source is missing;")
+    r = node.index_doc(req.param("index"), None, body,
+                       routing=req.param("routing"), refresh=req.param("refresh"),
+                       pipeline=req.param("pipeline"))
+    return 201, r
+
+
+def _get_doc(node, req):
+    r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
+    return (200 if r["found"] else 404), r
+
+
+def _head_doc(node, req):
+    r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
+    return (200 if r["found"] else 404), {}
+
+
+def _get_source(node, req):
+    r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
+    if not r["found"]:
+        return 404, {}
+    return 200, r["_source"]
+
+
+def _delete_doc(node, req):
+    r = node.delete_doc(req.param("index"), req.param("id"),
+                        routing=req.param("routing"), refresh=req.param("refresh"))
+    return (200 if r.get("found") else 404), r
+
+
+def _update_doc(node, req):
+    r = node.update_doc(req.param("index"), req.param("id"), req.json_body({}),
+                        routing=req.param("routing"), refresh=req.param("refresh"))
+    return 200, r
+
+
+def _mget(node, req):
+    return 200, node.mget(req.json_body({}), req.param("index"))
+
+
+def _bulk(node, req):
+    lines = req.ndjson_lines()
+    default_index = req.param("index")
+    ops = []
+    i = 0
+    while i < len(lines):
+        action_line = lines[i]
+        ((action, meta),) = action_line.items() if action_line else (("index", {}),)
+        meta = dict(meta or {})
+        meta.setdefault("_index", default_index)
+        i += 1
+        if action in ("index", "create", "update"):
+            if i >= len(lines):
+                raise ActionRequestValidationException(
+                    "Validation Failed: 1: no requests added;"
+                )
+            ops.append((action, meta, lines[i]))
+            i += 1
+        else:
+            ops.append((action, meta, None))
+    resp = node.bulk(ops, refresh=req.param("refresh"), pipeline=req.param("pipeline"))
+    return 200, resp
+
+
+# ---------------------------------------------------------------------------
+# Search family
+# ---------------------------------------------------------------------------
+
+
+def _search_body(req):
+    body = req.json_body({}) or {}
+    # URI search: ?q=...&size=...&from=...&sort=f:asc
+    q = req.param("q")
+    if q is not None:
+        body["query"] = {"query_string": {"query": q}}
+    for p in ("size", "from"):
+        if req.param(p) is not None:
+            body[p] = int(req.param(p))
+    if req.param("sort") is not None:
+        sort = []
+        for part in req.param("sort").split(","):
+            if ":" in part:
+                f, o = part.split(":", 1)
+                sort.append({f: o})
+            else:
+                sort.append(part)
+        body["sort"] = sort
+    if req.param("_source") is not None:
+        v = req.param("_source")
+        body["_source"] = False if v == "false" else (True if v == "true" else v.split(","))
+    return body
+
+
+def _search(node, req):
+    body = _search_body(req)
+    return 200, node.search(req.param("index", "_all"), body, scroll=req.param("scroll"))
+
+
+def _scroll(node, req):
+    body = req.json_body({}) or {}
+    scroll_id = body.get("scroll_id") or req.param("scroll_id")
+    return 200, node.scroll(scroll_id, body.get("scroll") or req.param("scroll"))
+
+
+def _clear_scroll(node, req):
+    body = req.json_body({}) or {}
+    ids = body.get("scroll_id") or ["_all"]
+    if isinstance(ids, str):
+        ids = [ids]
+    return 200, node.clear_scroll(ids)
+
+
+def _msearch(node, req):
+    lines = req.ndjson_lines()
+    searches = []
+    i = 0
+    while i + 1 <= len(lines):
+        header = lines[i] if isinstance(lines[i], dict) else {}
+        body = lines[i + 1] if i + 1 < len(lines) else {}
+        header.setdefault("index", req.param("index", "_all"))
+        searches.append((header, body))
+        i += 2
+    return 200, node.msearch(searches)
+
+
+def _count(node, req):
+    body = _search_body(req)
+    body["size"] = 0
+    resp = node.search(req.param("index", "_all"), body)
+    return 200, {"count": resp["hits"]["total"], "_shards": resp["_shards"]}
+
+
+def _validate_query(node, req):
+    from elasticsearch_tpu.search.query_dsl import parse_query
+
+    body = req.json_body({}) or {}
+    try:
+        parse_query(body.get("query"))
+        return 200, {"valid": True, "_shards": {"total": 1, "successful": 1, "failed": 0}}
+    except Exception as e:
+        resp = {"valid": False, "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if req.bool_param("explain"):
+            resp["explanations"] = [{"index": req.param("index"), "valid": False,
+                                     "error": str(e)}]
+        return 200, resp
+
+
+def _field_caps(node, req):
+    from elasticsearch_tpu.mapper.field_types import NUMERIC_TYPES
+
+    fields_param = req.param("fields") or (req.json_body({}) or {}).get("fields", "*")
+    if isinstance(fields_param, str):
+        fields_param = fields_param.split(",")
+    out = {}
+    for svc in node.resolve_search_indices(req.param("index", "_all")):
+        for pattern in fields_param:
+            for fname in svc.mapper_service.mapper.simple_match_to_fields(pattern):
+                ft = svc.mapper_service.field_type(fname)
+                t = ft.type_name
+                entry = out.setdefault(fname, {}).setdefault(t, {
+                    "type": t,
+                    "searchable": bool(ft.index),
+                    "aggregatable": bool(ft.doc_values) or t == "text" and ft.fielddata,
+                })
+    return 200, {"fields": out}
+
+
+def _explain(node, req):
+    body = req.json_body({}) or {}
+    svc = node.index_service(req.param("index"))
+    doc_id = req.param("id")
+    q = dict(body)
+    q["query"] = {"bool": {"must": [body.get("query", {"match_all": {}})],
+                           "filter": [{"ids": {"values": [doc_id]}}]}}
+    q["size"] = 1
+    resp = svc.search(q)
+    matched = resp["hits"]["total"] > 0
+    score = resp["hits"]["hits"][0]["_score"] if matched else 0.0
+    return 200, {
+        "_index": svc.name,
+        "_id": doc_id,
+        "matched": matched,
+        "explanation": {
+            "value": score,
+            "description": "BM25 score via TPU scatter-add scorer (sum of term contributions)",
+            "details": [],
+        },
+    }
+
+
+def _reindex(node, req):
+    from elasticsearch_tpu.index.reindex import reindex
+
+    return 200, reindex(node, req.json_body({}))
+
+
+def _update_by_query(node, req):
+    from elasticsearch_tpu.index.reindex import update_by_query
+
+    return 200, update_by_query(node, req.param("index"), req.json_body({}))
+
+
+def _delete_by_query(node, req):
+    from elasticsearch_tpu.index.reindex import delete_by_query
+
+    return 200, delete_by_query(node, req.param("index"), req.json_body({}))
+
+
+# ---------------------------------------------------------------------------
+# Index admin
+# ---------------------------------------------------------------------------
+
+
+def _create_index(node, req):
+    return 200, node.create_index(req.param("index"), req.json_body({}))
+
+
+def _delete_index(node, req):
+    return 200, node.delete_index(req.param("index"))
+
+
+def _get_index(node, req):
+    state = node.cluster_service.state
+    out = {}
+    for name in state.resolve_index_names(req.param("index")):
+        md = state.indices[name]
+        out[name] = md.to_dict()
+    return 200, out
+
+
+def _head_index(node, req):
+    state = node.cluster_service.state
+    try:
+        state.resolve_index_names(req.param("index"))
+        return 200, {}
+    except Exception:
+        return 404, {}
+
+
+def _refresh(node, req):
+    names = node.cluster_service.state.resolve_index_names(req.param("index", "_all"))
+    for name in names:
+        node.indices[name].refresh()
+    n = sum(node.indices[x].num_shards for x in names)
+    return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+
+def _flush(node, req):
+    names = node.cluster_service.state.resolve_index_names(req.param("index", "_all"))
+    for name in names:
+        node.indices[name].flush()
+    n = sum(node.indices[x].num_shards for x in names)
+    return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+
+def _forcemerge(node, req):
+    names = node.cluster_service.state.resolve_index_names(req.param("index", "_all"))
+    for name in names:
+        node.indices[name].force_merge()
+    n = sum(node.indices[x].num_shards for x in names)
+    return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+
+def _index_stats(node, req):
+    names = node.cluster_service.state.resolve_index_names(req.param("index", "_all"))
+    indices = {name: node.indices[name].stats() for name in names
+               if name in node.indices}
+    totals = {
+        "docs": {"count": sum(s["total"]["docs"]["count"] for s in indices.values())},
+    }
+    return 200, {"_all": {"total": totals}, "indices": indices}
+
+
+def _segments(node, req):
+    svc = node.index_service(req.param("index"))
+    shards = {}
+    for sid, shard in svc.shards.items():
+        shards[str(sid)] = [{
+            "segments": {s.name: s.stats() for s in shard.engine.segments},
+        }]
+    return 200, {"indices": {svc.name: {"shards": shards}}}
+
+
+def _put_mapping(node, req):
+    svc = node.index_service(req.param("index"))
+    body = req.json_body({}) or {}
+    if "properties" not in body and len(body) == 1:
+        body = next(iter(body.values()))  # typed form {"_doc": {...}}
+    svc.put_mapping(body)
+    node._maybe_update_mapping_meta(svc.name)
+    return 200, {"acknowledged": True}
+
+
+def _get_mapping(node, req):
+    state = node.cluster_service.state
+    out = {}
+    for name in state.resolve_index_names(req.param("index", "_all")):
+        svc = node.indices[name]
+        out[name] = {"mappings": {"_doc": svc.mapping_dict()}}
+    return 200, out
+
+
+def _put_index_settings(node, req):
+    return 200, node.update_index_settings(req.param("index", "_all"),
+                                           req.json_body({}) or {})
+
+
+def _get_index_settings(node, req):
+    state = node.cluster_service.state
+    out = {}
+    for name in state.resolve_index_names(req.param("index", "_all")):
+        md = state.indices[name]
+        settings = md.settings.as_nested_dict()
+        idx_settings = settings.setdefault("index", {})
+        idx_settings.setdefault("number_of_shards", str(md.num_shards))
+        idx_settings.setdefault("number_of_replicas", str(md.num_replicas))
+        idx_settings.setdefault("uuid", node.indices[name].uuid if name in node.indices else name)
+        out[name] = {"settings": settings}
+    return 200, out
+
+
+def _analyze(node, req):
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+
+    body = req.json_body({}) or {}
+    text = body.get("text") or req.param("text")
+    if text is None:
+        raise ActionRequestValidationException("Validation Failed: 1: text is missing;")
+    texts = text if isinstance(text, list) else [text]
+    index = req.param("index")
+    if index is not None:
+        registry = node.index_service(index).analyzers
+    else:
+        registry = AnalysisRegistry()
+    analyzer_name = body.get("analyzer") or req.param("analyzer")
+    field = body.get("field")
+    if analyzer_name is None and field is not None and index is not None:
+        ft = node.index_service(index).mapper_service.field_type(field)
+        analyzer_name = getattr(ft, "analyzer", None) or "standard"
+    analyzer = registry.get(analyzer_name or "standard")
+    tokens = []
+    for t in texts:
+        for pos, (tok, start, end) in enumerate(analyzer.analyze_tokens(t)):
+            tokens.append({
+                "token": tok,
+                "start_offset": start,
+                "end_offset": end,
+                "type": "<ALPHANUM>",
+                "position": pos,
+            })
+    return 200, {"tokens": tokens}
+
+
+def _update_aliases(node, req):
+    body = req.json_body({}) or {}
+    return 200, node.update_aliases(body.get("actions", []))
+
+
+def _get_alias(node, req):
+    state = node.cluster_service.state
+    name_filter = req.param("name")
+    out = {}
+    for idx in state.resolve_index_names(req.param("index", "_all")):
+        aliases = state.indices[idx].aliases
+        if name_filter:
+            import fnmatch
+
+            aliases = {a: v for a, v in aliases.items()
+                       if fnmatch.fnmatchcase(a, name_filter)}
+            if not aliases:
+                continue
+        out[idx] = {"aliases": aliases}
+    if name_filter and not out:
+        return 404, {"error": f"alias [{name_filter}] missing", "status": 404}
+    return 200, out
+
+
+def _put_alias(node, req):
+    spec = req.json_body({}) or {}
+    return 200, node.update_aliases([{"add": {
+        "index": req.param("index"), "alias": req.param("name"), **spec}}])
+
+
+def _delete_alias(node, req):
+    return 200, node.update_aliases([{"remove": {
+        "index": req.param("index"), "alias": req.param("name")}}])
+
+
+def _head_alias(node, req):
+    state = node.cluster_service.state
+    for md in state.indices.values():
+        if req.param("name") in md.aliases:
+            return 200, {}
+    return 404, {}
+
+
+def _put_template(node, req):
+    return 200, node.put_template(req.param("name"), req.json_body({}) or {})
+
+
+def _get_template(node, req):
+    import fnmatch
+
+    templates = node.cluster_service.state.templates
+    name = req.param("name")
+    if name:
+        matched = {k: v for k, v in templates.items() if fnmatch.fnmatchcase(k, name)}
+        if not matched:
+            return 404, {"error": f"index_template [{name}] missing", "status": 404}
+        return 200, matched
+    return 200, dict(templates)
+
+
+def _delete_template(node, req):
+    return 200, node.delete_template(req.param("name"))
+
+
+def _head_template(node, req):
+    return (200 if req.param("name") in node.cluster_service.state.templates else 404), {}
+
+
+def _clear_cache(node, req):
+    for svc in node.resolve_search_indices(req.param("index", "_all")):
+        for shard in svc.shards.values():
+            for seg in shard.engine.segments:
+                seg.dev_cache.clear()
+    return 200, {"_shards": {"total": 0, "successful": 0, "failed": 0}}
+
+
+# ---------------------------------------------------------------------------
+# Cluster admin
+# ---------------------------------------------------------------------------
+
+
+def _cluster_state(node, req):
+    return 200, node.cluster_service.state.to_dict()
+
+
+def _get_cluster_settings(node, req):
+    state = node.cluster_service.state
+    return 200, {
+        "persistent": state.persistent_settings.as_nested_dict(),
+        "transient": state.transient_settings.as_nested_dict(),
+    }
+
+
+def _allocation_explain(node, req):
+    return 200, {
+        "note": "single-node cluster: all primaries allocated locally",
+        "can_allocate": "yes",
+    }
+
+
+def _get_task(node, req):
+    task = node.tasks.get(req.param("task_id"))
+    return 200, {"completed": False, "task": task.to_dict()}
+
+
+def _cancel_task(node, req):
+    task = node.tasks.cancel(req.param("task_id"))
+    return 200, {"nodes": {node.node_id: {"tasks": {task.id_string: task.to_dict()}}}}
+
+
+def _delete_script(node, req):
+    node.get_stored_script(req.param("id"))  # 404 if missing
+
+    def update(state):
+        new = state.copy()
+        new.stored_scripts.pop(req.param("id"), None)
+        return new
+
+    node.cluster_service.submit_state_update_task("delete-script", update)
+    return 200, {"acknowledged": True}
+
+
+def _simulate_pipeline_by_id(node, req):
+    body = req.json_body({}) or {}
+    body["id"] = req.param("id")
+    return 200, node.ingest.simulate(body)
+
+
+# ---------------------------------------------------------------------------
+# cat API
+# ---------------------------------------------------------------------------
+
+
+def _cat_table(req, rows: List[List], headers: List[str]) -> Tuple[int, object]:
+    if req.param("format") == "json":
+        return 200, [dict(zip(headers, row)) for row in rows]
+    verbose = req.bool_param("v")
+    cols = [[str(c) for c in row] for row in rows]
+    if verbose:
+        cols = [headers] + cols
+    if not cols:
+        return 200, ""
+    widths = [max(len(r[i]) for r in cols) for i in range(len(headers))]
+    lines = [" ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+             for row in cols]
+    return 200, "\n".join(lines) + "\n"
+
+
+def _cat_help(node, req):
+    paths = sorted({r.pattern for r in node.rest_controller.routes
+                    if r.pattern.startswith("/_cat")})
+    return 200, "\n".join(f"{p}" for p in paths) + "\n"
+
+
+def _cat_indices(node, req):
+    state = node.cluster_service.state
+    rows = []
+    names = state.resolve_index_names(req.param("index", "_all"))
+    for name in names:
+        md = state.indices[name]
+        svc = node.indices.get(name)
+        health = "green" if md.num_replicas == 0 else "yellow"
+        rows.append([
+            health, md.state, name, svc.uuid if svc else "-",
+            md.num_shards, md.num_replicas,
+            svc.num_docs if svc else 0, 0,
+            f"{(sum(s.stats()['segments']['memory_in_bytes'] for s in svc.shards.values()) if svc else 0)}b",
+            "0b",
+        ])
+    return _cat_table(req, rows, [
+        "health", "status", "index", "uuid", "pri", "rep", "docs.count",
+        "docs.deleted", "store.size", "pri.store.size",
+    ])
+
+
+def _cat_health(node, req):
+    h = node.health()
+    rows = [[int(time.time()), time.strftime("%H:%M:%S"), h["cluster_name"],
+             h["status"], h["number_of_nodes"], h["number_of_data_nodes"],
+             h["active_shards"], h["active_primary_shards"],
+             h["relocating_shards"], h["initializing_shards"],
+             h["unassigned_shards"], "-",
+             f"{h['active_shards_percent_as_number']:.1f}%"]]
+    return _cat_table(req, rows, [
+        "epoch", "timestamp", "cluster", "status", "node.total", "node.data",
+        "shards", "pri", "relo", "init", "unassign", "pending_tasks",
+        "active_shards_percent",
+    ])
+
+
+def _cat_nodes(node, req):
+    rows = [["127.0.0.1", 0, 0, "mdi", "*", node.node_name]]
+    return _cat_table(req, rows, ["ip", "heap.percent", "cpu", "node.role",
+                                  "master", "name"])
+
+
+def _cat_shards(node, req):
+    state = node.cluster_service.state
+    rows = []
+    for name in state.resolve_index_names(req.param("index", "_all")):
+        svc = node.indices.get(name)
+        if svc is None:
+            continue
+        for sid, shard in svc.shards.items():
+            rows.append([name, sid, "p", shard.state, shard.num_docs,
+                         "127.0.0.1", node.node_name])
+    return _cat_table(req, rows, ["index", "shard", "prirep", "state", "docs",
+                                  "ip", "node"])
+
+
+def _cat_count(node, req):
+    total = sum(
+        node.indices[n].num_docs
+        for n in node.cluster_service.state.resolve_index_names(req.param("index", "_all"))
+        if n in node.indices
+    )
+    rows = [[int(time.time()), time.strftime("%H:%M:%S"), total]]
+    return _cat_table(req, rows, ["epoch", "timestamp", "count"])
+
+
+def _cat_aliases(node, req):
+    rows = []
+    for name, md in node.cluster_service.state.indices.items():
+        for alias in md.aliases:
+            rows.append([alias, name, "-", "-", "-"])
+    return _cat_table(req, rows, ["alias", "index", "filter", "routing.index",
+                                  "routing.search"])
+
+
+def _cat_templates(node, req):
+    rows = []
+    for name, t in node.cluster_service.state.templates.items():
+        rows.append([name, str(t.get("index_patterns", [])), t.get("order", 0), "-"])
+    return _cat_table(req, rows, ["name", "index_patterns", "order", "version"])
+
+
+def _cat_master(node, req):
+    rows = [[node.node_id, "127.0.0.1", "127.0.0.1", node.node_name]]
+    return _cat_table(req, rows, ["id", "host", "ip", "node"])
+
+
+def _cat_segments(node, req):
+    rows = []
+    for name, svc in node.indices.items():
+        for sid, shard in svc.shards.items():
+            for seg in shard.engine.segments:
+                st = seg.stats()
+                rows.append([name, sid, "p", seg.name, st["num_docs"],
+                             st["deleted_docs"], f"{st['memory_in_bytes']}b", "true"])
+    return _cat_table(req, rows, ["index", "shard", "prirep", "segment",
+                                  "docs.count", "docs.deleted", "size",
+                                  "searchable"])
+
+
+def _cat_tasks(node, req):
+    listing = node.tasks.list_tasks()
+    rows = []
+    for nid, data in listing["nodes"].items():
+        for tid, t in data["tasks"].items():
+            rows.append([t["action"], tid, "-", t["type"],
+                         t["start_time_in_millis"], t["running_time_in_nanos"]])
+    return _cat_table(req, rows, ["action", "task_id", "parent_task_id", "type",
+                                  "start_time", "running_time"])
+
+
+def _cat_allocation(node, req):
+    n_shards = sum(s.num_shards for s in node.indices.values())
+    rows = [[n_shards, "0b", "0b", "-", "-", "127.0.0.1", "127.0.0.1",
+             node.node_name]]
+    return _cat_table(req, rows, ["shards", "disk.indices", "disk.used",
+                                  "disk.avail", "disk.percent", "host", "ip", "node"])
+
+
+def _cat_recovery(node, req):
+    rows = []
+    for name, svc in node.indices.items():
+        for sid, shard in svc.shards.items():
+            rows.append([name, sid, "0ms", "store", "done", "-", "-", "100%"])
+    return _cat_table(req, rows, ["index", "shard", "time", "type", "stage",
+                                  "source_node", "target_node", "files_percent"])
+
+
+def _cat_thread_pool(node, req):
+    rows = [[node.node_name, pool, 0, 0, 0]
+            for pool in ("bulk", "search", "get", "index", "management")]
+    return _cat_table(req, rows, ["node_name", "name", "active", "queue", "rejected"])
+
+
+def _cat_repositories(node, req):
+    rows = [[name, body.get("type", "fs")]
+            for name, body in node.cluster_service.state.repositories.items()]
+    return _cat_table(req, rows, ["id", "type"])
+
+
+def _cat_snapshots(node, req):
+    snaps = node.snapshots.get_snapshot(req.param("repo"))["snapshots"]
+    rows = [[s["snapshot"], s["state"],
+             s.get("start_time_in_millis", 0), s.get("end_time_in_millis", 0),
+             len(s["indices"])] for s in snaps]
+    return _cat_table(req, rows, ["id", "status", "start_epoch", "end_epoch",
+                                  "indices"])

@@ -1,0 +1,81 @@
+"""HTTP server: the port-9200 front door.
+
+Role model: ``Netty4HttpServerTransport`` (modules/transport-netty4/).
+The reference's event-loop server maps to a threading HTTP server here —
+the HTTP layer is control-plane I/O, never the perf path (queries spend
+their time in compiled TPU programs; SURVEY.md §7.1). Content negotiation:
+JSON bodies in/out; cat API emits text/plain.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class _Handler(BaseHTTPRequestHandler):
+    controller: RestController = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    def _handle(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        query = dict(parse_qsl(parsed.query, keep_blank_values=True))
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        status, payload = self.controller.dispatch(method, parsed.path, query, body)
+        if isinstance(payload, str):
+            data = payload.encode("utf-8")
+            ctype = "text/plain; charset=UTF-8"
+        else:
+            pretty = "pretty" in query
+            data = json.dumps(payload, indent=2 if pretty else None,
+                              default=str).encode("utf-8")
+            ctype = "application/json; charset=UTF-8"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if method != "HEAD":
+            self.wfile.write(data)
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_PUT(self):
+        self._handle("PUT")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+    def do_HEAD(self):
+        self._handle("HEAD")
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+class HttpServer:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 9200):
+        self.node = node
+        self.controller = RestController(node)
+        node.rest_controller = self.controller
+        handler = type("BoundHandler", (_Handler,), {"controller": self.controller})
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,245 @@
+"""Snapshot / restore to blob repositories.
+
+Role model: ``SnapshotsService``/``SnapshotShardsService``/``RestoreService``
+(core/.../snapshots/) over the ``Repository`` SPI
+(core/.../repositories/blobstore/BlobStoreRepository.java): incremental
+segment-file copy into a repository + a snapshot manifest; restore
+re-creates indices from the manifest.
+
+TPU framing (SURVEY.md §5.4): segments are immutable files, so a snapshot
+is manifest + file hardcopy with dedup by (segment name, checksum); HBM is
+never the source of truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.common.settings import Settings
+
+
+class SnapshotState:
+    SUCCESS = "SUCCESS"
+    IN_PROGRESS = "IN_PROGRESS"
+    FAILED = "FAILED"
+
+
+class FsRepository:
+    """Shared-filesystem blob repository (core/.../repositories/fs)."""
+
+    def __init__(self, name: str, settings: dict):
+        self.name = name
+        location = settings.get("location")
+        if not location:
+            raise IllegalArgumentException("[fs] repository requires [location] setting")
+        self.location = location
+        os.makedirs(location, exist_ok=True)
+
+    def snapshot_path(self, snapshot: str) -> str:
+        return os.path.join(self.location, "snapshots", snapshot)
+
+    def list_snapshots(self) -> List[str]:
+        root = os.path.join(self.location, "snapshots")
+        if not os.path.isdir(root):
+            return []
+        return sorted(
+            d for d in os.listdir(root)
+            if os.path.exists(os.path.join(root, d, "manifest.json"))
+        )
+
+    def read_manifest(self, snapshot: str) -> dict:
+        path = os.path.join(self.snapshot_path(snapshot), "manifest.json")
+        if not os.path.exists(path):
+            raise ResourceNotFoundException(f"[{self.name}:{snapshot}] snapshot does not exist")
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+
+class SnapshotsService:
+    def __init__(self, node):
+        self.node = node
+        self.repositories: Dict[str, FsRepository] = {}
+
+    # --- repositories ---
+
+    def put_repository(self, name: str, body: dict) -> dict:
+        rtype = body.get("type")
+        if rtype != "fs":
+            raise IllegalArgumentException(
+                f"repository type [{rtype}] does not exist (supported: fs; "
+                "url/s3/azure/gcs arrive with their cloud plugins)"
+            )
+        repo = FsRepository(name, body.get("settings") or {})
+        self.repositories[name] = repo
+
+        def update(state):
+            new = state.copy()
+            new.repositories[name] = body
+            return new
+
+        self.node.cluster_service.submit_state_update_task(f"put-repo [{name}]", update)
+        return {"acknowledged": True}
+
+    def get_repository(self, name: Optional[str] = None) -> dict:
+        repos = self.node.cluster_service.state.repositories
+        if name in (None, "_all", "*"):
+            return dict(repos)
+        if name not in repos:
+            raise ResourceNotFoundException(f"[{name}] missing")
+        return {name: repos[name]}
+
+    def delete_repository(self, name: str) -> dict:
+        if name not in self.repositories:
+            raise ResourceNotFoundException(f"[{name}] missing")
+        self.repositories.pop(name)
+
+        def update(state):
+            new = state.copy()
+            new.repositories.pop(name, None)
+            return new
+
+        self.node.cluster_service.submit_state_update_task(f"delete-repo [{name}]", update)
+        return {"acknowledged": True}
+
+    def _repo(self, name: str) -> FsRepository:
+        repo = self.repositories.get(name)
+        if repo is None:
+            raise ResourceNotFoundException(f"[{name}] missing")
+        return repo
+
+    # --- snapshot ---
+
+    def create_snapshot(self, repo_name: str, snapshot: str,
+                        body: Optional[dict] = None) -> dict:
+        repo = self._repo(repo_name)
+        body = body or {}
+        if snapshot in repo.list_snapshots():
+            raise ResourceAlreadyExistsException(
+                f"[{repo_name}:{snapshot}] snapshot with the same name already exists"
+            )
+        indices_expr = body.get("indices", "_all")
+        names = self.node.cluster_service.state.resolve_index_names(indices_expr)
+        snap_dir = repo.snapshot_path(snapshot)
+        os.makedirs(snap_dir, exist_ok=True)
+        manifest = {
+            "snapshot": snapshot,
+            "state": SnapshotState.IN_PROGRESS,
+            "start_time_in_millis": int(time.time() * 1000),
+            "indices": {},
+        }
+        shards_total = 0
+        for name in names:
+            svc = self.node.indices[name]
+            svc.flush()  # durable commit before copying (the reference
+            # snapshots from a Lucene commit the same way)
+            md = self.node.cluster_service.state.indices[name]
+            idx_dir = os.path.join(snap_dir, "indices", name)
+            shard_info = {}
+            for sid, shard in svc.shards.items():
+                shards_total += 1
+                src = shard.engine.store.directory
+                dst = os.path.join(idx_dir, str(sid))
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+                shard_info[str(sid)] = {"segments": len(shard.engine.segments)}
+            manifest["indices"][name] = {
+                "settings": md.settings.as_dict(),
+                "mappings": svc.mapping_dict(),
+                "aliases": md.aliases,
+                "shards": shard_info,
+            }
+        manifest["state"] = SnapshotState.SUCCESS
+        manifest["end_time_in_millis"] = int(time.time() * 1000)
+        with open(os.path.join(snap_dir, "manifest.json"), "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        return {"snapshot": {
+            "snapshot": snapshot,
+            "uuid": snapshot,
+            "state": manifest["state"],
+            "indices": list(manifest["indices"].keys()),
+            "shards": {"total": shards_total, "failed": 0,
+                       "successful": shards_total},
+        }}
+
+    def get_snapshot(self, repo_name: str, snapshot: Optional[str] = None) -> dict:
+        repo = self._repo(repo_name)
+        if snapshot in (None, "_all", "*"):
+            names = repo.list_snapshots()
+        else:
+            names = [snapshot]
+        out = []
+        for s in names:
+            m = repo.read_manifest(s)
+            out.append({
+                "snapshot": s,
+                "state": m["state"],
+                "indices": list(m["indices"].keys()),
+                "start_time_in_millis": m.get("start_time_in_millis"),
+                "end_time_in_millis": m.get("end_time_in_millis"),
+            })
+        return {"snapshots": out}
+
+    def delete_snapshot(self, repo_name: str, snapshot: str) -> dict:
+        repo = self._repo(repo_name)
+        path = repo.snapshot_path(snapshot)
+        if not os.path.exists(path):
+            raise ResourceNotFoundException(f"[{repo_name}:{snapshot}] snapshot does not exist")
+        shutil.rmtree(path)
+        return {"acknowledged": True}
+
+    # --- restore ---
+
+    def restore_snapshot(self, repo_name: str, snapshot: str,
+                         body: Optional[dict] = None) -> dict:
+        repo = self._repo(repo_name)
+        body = body or {}
+        manifest = repo.read_manifest(snapshot)
+        indices_expr = body.get("indices")
+        rename_pattern = body.get("rename_pattern")
+        rename_replacement = body.get("rename_replacement")
+        restored = []
+        for name, info in manifest["indices"].items():
+            if indices_expr and name not in str(indices_expr).split(","):
+                continue
+            target = name
+            if rename_pattern and rename_replacement is not None:
+                import re
+
+                target = re.sub(rename_pattern, rename_replacement, name)
+            if target in self.node.indices:
+                raise ResourceAlreadyExistsException(
+                    f"cannot restore index [{target}] because an open index with "
+                    "same name already exists"
+                )
+            self.node.create_index(target, {
+                "settings": Settings(info["settings"]).as_nested_dict(),
+                "mappings": info["mappings"],
+                "aliases": info.get("aliases", {}),
+            })
+            svc = self.node.indices[target]
+            snap_idx_dir = os.path.join(repo.snapshot_path(snapshot), "indices", name)
+            for sid, shard in svc.shards.items():
+                src = os.path.join(snap_idx_dir, str(sid))
+                if not os.path.exists(src):
+                    continue
+                dst = shard.engine.store.directory
+                shutil.rmtree(dst, ignore_errors=True)
+                shutil.copytree(src, dst)
+                shard.engine.segments = []
+                shard.engine.version_map = {}
+                shard.recover_from_store()
+            restored.append(target)
+        return {"snapshot": {
+            "snapshot": snapshot,
+            "indices": restored,
+            "shards": {"total": len(restored), "failed": 0,
+                       "successful": len(restored)},
+        }}

@@ -1,0 +1,514 @@
+"""REST API conformance tests, modeled on the reference's YAML REST suites
+(rest-api-spec/src/main/resources/rest-api-spec/test/): do -> match steps
+against a live node, here through the in-process client (wire-identical
+request/response shapes)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.client import Client
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def client():
+    node = Node(Settings({"cluster.name": "test-cluster"}))
+    c = Client(node)
+    yield c
+    node.close()
+
+
+def ok(resp):
+    status, payload = resp
+    assert status in (200, 201), payload
+    return payload
+
+
+class TestRoot:
+    def test_root(self, client):
+        r = ok(client.perform("GET", "/"))
+        assert r["cluster_name"] == "test-cluster"
+        assert "version" in r and "tagline" in r
+
+    def test_unknown_route(self, client):
+        status, payload = client.perform("GET", "/_bogus_endpoint")
+        assert status == 400
+        assert "no handler found" in str(payload)
+
+
+class TestDocumentCrud:
+    def test_index_get_delete(self, client):
+        status, r = client.index("idx", "1", {"title": "hello"})
+        assert status == 201 and r["result"] == "created"
+        assert r["_seq_no"] == 0 and r["_version"] == 1
+        r = ok(client.get("idx", "1"))
+        assert r["found"] and r["_source"] == {"title": "hello"}
+        status, r = client.index("idx", "1", {"title": "hello2"})
+        assert status == 200 and r["result"] == "updated" and r["_version"] == 2
+        status, r = client.delete("idx", "1")
+        assert status == 200 and r["result"] == "deleted"
+        status, r = client.get("idx", "1")
+        assert status == 404 and not r["found"]
+
+    def test_auto_id(self, client):
+        status, r = client.index("idx", None, {"a": 1})
+        assert status == 201
+        assert len(r["_id"]) >= 10
+
+    def test_op_type_create_conflict(self, client):
+        client.index("idx", "1", {"a": 1})
+        status, r = client.perform("PUT", "/idx/_doc/1", {"op_type": "create"}, {"a": 2})
+        assert status == 409
+
+    def test_get_source(self, client):
+        client.index("idx", "1", {"a": 1})
+        r = ok(client.perform("GET", "/idx/_source/1"))
+        assert r == {"a": 1}
+
+    def test_update(self, client):
+        client.index("idx", "1", {"a": 1, "b": 2})
+        r = ok(client.update("idx", "1", {"doc": {"b": 3}}))
+        assert r["_version"] == 2
+        assert ok(client.get("idx", "1"))["_source"] == {"a": 1, "b": 3}
+
+    def test_update_missing_doc_404(self, client):
+        client.index("idx", "1", {"a": 1})
+        status, r = client.update("idx", "missing", {"doc": {"b": 3}})
+        assert status == 404
+
+    def test_mget(self, client):
+        client.index("idx", "1", {"a": 1})
+        client.index("idx", "2", {"a": 2})
+        r = ok(client.perform("POST", "/_mget", body={"docs": [
+            {"_index": "idx", "_id": "1"},
+            {"_index": "idx", "_id": "404"},
+        ]}))
+        assert r["docs"][0]["found"] and not r["docs"][1]["found"]
+
+    def test_typed_route_compat(self, client):
+        status, r = client.perform("PUT", "/idx/doc/1", body={"a": 1})
+        assert status == 201
+        status, r = client.perform("GET", "/idx/doc/1")
+        assert status == 200 and r["found"]
+
+
+class TestBulk:
+    def test_bulk_ndjson(self, client):
+        lines = "\n".join([
+            json.dumps({"index": {"_index": "idx", "_id": "1"}}),
+            json.dumps({"f": "one"}),
+            json.dumps({"create": {"_index": "idx", "_id": "2"}}),
+            json.dumps({"f": "two"}),
+            json.dumps({"delete": {"_index": "idx", "_id": "404"}}),
+            json.dumps({"update": {"_index": "idx", "_id": "1"}}),
+            json.dumps({"doc": {"g": 9}}),
+        ]) + "\n"
+        r = ok(client.bulk(lines, refresh="true"))
+        assert not r["errors"] or r["items"][2]["delete"]["status"] == 404
+        assert r["items"][0]["index"]["status"] == 201
+        assert r["items"][1]["create"]["status"] == 201
+        assert r["items"][3]["update"]["status"] == 200
+        status, sr = client.search("idx", {"query": {"match_all": {}}})
+        assert sr["hits"]["total"] == 2
+
+    def test_bulk_item_error_isolated(self, client):
+        client.index("idx", "1", {"a": 1})
+        lines = "\n".join([
+            json.dumps({"create": {"_index": "idx", "_id": "1"}}),  # conflict
+            json.dumps({"a": 2}),
+            json.dumps({"index": {"_index": "idx", "_id": "2"}}),
+            json.dumps({"a": 3}),
+        ]) + "\n"
+        r = ok(client.bulk(lines))
+        assert r["errors"]
+        assert r["items"][0]["create"]["status"] == 409
+        assert r["items"][1]["index"]["status"] == 201
+
+
+class TestSearchApi:
+    def _seed(self, client):
+        for i, color in enumerate(["red", "blue", "red", "green"]):
+            client.index("things", str(i), {"color": color, "n": i,
+                                            "text": f"item number {i}"})
+        client.perform("POST", "/things/_refresh")
+
+    def test_search_and_count(self, client):
+        self._seed(client)
+        r = ok(client.search("things", {"query": {"term": {"color": "red"}}}))
+        assert r["hits"]["total"] == 2
+        r = ok(client.count("things", {"query": {"term": {"color": "red"}}}))
+        assert r["count"] == 2
+
+    def test_uri_search(self, client):
+        self._seed(client)
+        status, r = client.perform("GET", "/things/_search",
+                                   {"q": "color:red", "size": "1"})
+        assert r["hits"]["total"] == 2 and len(r["hits"]["hits"]) == 1
+
+    def test_msearch(self, client):
+        self._seed(client)
+        body = "\n".join([
+            json.dumps({"index": "things"}),
+            json.dumps({"query": {"term": {"color": "red"}}}),
+            json.dumps({}),
+            json.dumps({"query": {"match_all": {}}, "size": 0}),
+        ]) + "\n"
+        r = ok(client.perform("POST", "/_msearch", body=body))
+        assert r["responses"][0]["hits"]["total"] == 2
+        assert r["responses"][1]["hits"]["total"] == 4
+
+    def test_scroll(self, client):
+        self._seed(client)
+        status, r1 = client.perform("POST", "/things/_search", {"scroll": "1m"},
+                                    {"size": 2, "sort": [{"n": "asc"}],
+                                     "query": {"match_all": {}}})
+        sid = r1["_scroll_id"]
+        ids1 = [h["_id"] for h in r1["hits"]["hits"]]
+        status, r2 = client.perform("POST", "/_search/scroll", body={"scroll_id": sid})
+        ids2 = [h["_id"] for h in r2["hits"]["hits"]]
+        assert ids1 == ["0", "1"] and ids2 == ["2", "3"]
+        status, r3 = client.perform("POST", "/_search/scroll", body={"scroll_id": sid})
+        assert r3["hits"]["hits"] == []
+        r = ok(client.perform("DELETE", "/_search/scroll", body={"scroll_id": sid}))
+        assert r["num_freed"] == 1
+        status, _ = client.perform("POST", "/_search/scroll", body={"scroll_id": sid})
+        assert status == 404
+
+    def test_validate_query(self, client):
+        self._seed(client)
+        r = ok(client.perform("POST", "/things/_validate/query",
+                              body={"query": {"term": {"color": "red"}}}))
+        assert r["valid"]
+        r = ok(client.perform("POST", "/things/_validate/query",
+                              body={"query": {"bogus": {}}}))
+        assert not r["valid"]
+
+    def test_field_caps(self, client):
+        self._seed(client)
+        r = ok(client.perform("GET", "/things/_field_caps", {"fields": "*"}))
+        assert r["fields"]["n"]["long"]["aggregatable"]
+        assert r["fields"]["text"]["text"]["searchable"]
+
+    def test_explain(self, client):
+        self._seed(client)
+        r = ok(client.perform("GET", "/things/_explain/0",
+                              body={"query": {"term": {"color": "red"}}}))
+        assert r["matched"]
+        r = ok(client.perform("GET", "/things/_explain/1",
+                              body={"query": {"term": {"color": "red"}}}))
+        assert not r["matched"]
+
+
+class TestIndexAdmin:
+    def test_create_with_mapping_and_settings(self, client):
+        r = ok(client.perform("PUT", "/library", body={
+            "settings": {"index": {"number_of_shards": 2}},
+            "mappings": {"properties": {"title": {"type": "text"}}},
+            "aliases": {"books": {}},
+        }))
+        assert r["acknowledged"]
+        r = ok(client.perform("GET", "/library"))
+        assert r["library"]["settings"]["index"]["number_of_shards"] == 2
+        assert "title" in r["library"]["mappings"]["_doc"]["properties"]
+        # search via alias
+        client.index("books", "1", {"title": "via alias"})
+        client.perform("POST", "/library/_refresh")
+        status, sr = client.search("books", {"query": {"match": {"title": "alias"}}})
+        assert sr["hits"]["total"] == 1
+
+    def test_create_duplicate_fails(self, client):
+        ok(client.perform("PUT", "/idx"))
+        status, r = client.perform("PUT", "/idx")
+        assert status == 400
+        assert r["error"]["type"] == "index_already_exists_exception"
+
+    def test_invalid_name(self, client):
+        status, r = client.perform("PUT", "/_badname")
+        assert status == 400
+
+    def test_delete_index(self, client):
+        ok(client.perform("PUT", "/idx"))
+        ok(client.perform("DELETE", "/idx"))
+        status, _ = client.perform("GET", "/idx")
+        assert status == 404
+
+    def test_exists_head(self, client):
+        ok(client.perform("PUT", "/idx"))
+        assert client.perform("HEAD", "/idx")[0] == 200
+        assert client.perform("HEAD", "/nope")[0] == 404
+
+    def test_open_close(self, client):
+        client.index("idx", "1", {"a": 1})
+        ok(client.perform("POST", "/idx/_close"))
+        status, r = client.search("idx", {})
+        assert status == 404 or r.get("hits", {}).get("total", 1) == 0
+        ok(client.perform("POST", "/idx/_open"))
+        client.perform("POST", "/idx/_refresh")
+        status, r = client.search("idx", {})
+        assert r["hits"]["total"] == 1
+
+    def test_put_get_mapping(self, client):
+        ok(client.perform("PUT", "/idx"))
+        ok(client.perform("PUT", "/idx/_mapping",
+                          body={"properties": {"age": {"type": "integer"}}}))
+        r = ok(client.perform("GET", "/idx/_mapping"))
+        assert r["idx"]["mappings"]["_doc"]["properties"]["age"]["type"] == "integer"
+
+    def test_mapping_conflict_rejected(self, client):
+        ok(client.perform("PUT", "/idx", body={
+            "mappings": {"properties": {"age": {"type": "integer"}}}}))
+        status, r = client.perform("PUT", "/idx/_mapping",
+                                   body={"properties": {"age": {"type": "keyword"}}})
+        assert status == 400
+
+    def test_index_settings_dynamic_update(self, client):
+        ok(client.perform("PUT", "/idx"))
+        ok(client.perform("PUT", "/idx/_settings",
+                          body={"index": {"refresh_interval": "30s"}}))
+        r = ok(client.perform("GET", "/idx/_settings"))
+        assert r["idx"]["settings"]["index"]["refresh_interval"] == "30s"
+        status, _ = client.perform("PUT", "/idx/_settings",
+                                   body={"index": {"number_of_shards": 9}})
+        assert status == 400  # not dynamic
+
+    def test_analyze(self, client):
+        r = ok(client.perform("POST", "/_analyze",
+                              body={"analyzer": "standard", "text": "Quick Fox!"}))
+        assert [t["token"] for t in r["tokens"]] == ["quick", "fox"]
+
+    def test_aliases_actions(self, client):
+        ok(client.perform("PUT", "/idx1"))
+        ok(client.perform("PUT", "/idx2"))
+        ok(client.perform("POST", "/_aliases", body={"actions": [
+            {"add": {"index": "idx1", "alias": "both"}},
+            {"add": {"index": "idx2", "alias": "both"}},
+        ]}))
+        r = ok(client.perform("GET", "/_alias/both"))
+        assert set(r) == {"idx1", "idx2"}
+        ok(client.perform("POST", "/_aliases", body={"actions": [
+            {"remove": {"index": "idx1", "alias": "both"}},
+        ]}))
+        r = ok(client.perform("GET", "/_alias/both"))
+        assert set(r) == {"idx2"}
+
+    def test_templates(self, client):
+        ok(client.perform("PUT", "/_template/logs", body={
+            "index_patterns": ["logs-*"],
+            "settings": {"index": {"number_of_shards": 2}},
+            "mappings": {"properties": {"@timestamp": {"type": "date"}}},
+        }))
+        client.index("logs-2017.01", "1", {"@timestamp": "2017-01-01", "msg": "x"})
+        r = ok(client.perform("GET", "/logs-2017.01"))
+        assert r["logs-2017.01"]["settings"]["index"]["number_of_shards"] == 2
+        props = r["logs-2017.01"]["mappings"]["_doc"]["properties"]
+        assert props["@timestamp"]["type"] == "date"
+        assert client.perform("HEAD", "/_template/logs")[0] == 200
+        ok(client.perform("DELETE", "/_template/logs"))
+        assert client.perform("HEAD", "/_template/logs")[0] == 404
+
+    def test_stats_and_segments(self, client):
+        client.index("idx", "1", {"a": 1}, refresh="true")
+        r = ok(client.perform("GET", "/idx/_stats"))
+        assert r["indices"]["idx"]["total"]["docs"]["count"] == 1
+        r = ok(client.perform("GET", "/idx/_segments"))
+        assert r["indices"]["idx"]["shards"]
+
+    def test_forcemerge(self, client):
+        for i in range(3):
+            client.index("idx", str(i), {"a": i}, refresh="true")
+        ok(client.perform("POST", "/idx/_forcemerge"))
+        r = ok(client.perform("GET", "/idx/_segments"))
+        shards = r["indices"]["idx"]["shards"]
+        total_segs = sum(len(s[0]["segments"]) for s in shards.values())
+        assert total_segs == 1
+
+
+class TestClusterApi:
+    def test_health(self, client):
+        client.index("idx", "1", {"a": 1})
+        r = ok(client.perform("GET", "/_cluster/health"))
+        assert r["status"] in ("green", "yellow")
+        assert r["number_of_nodes"] == 1
+
+    def test_health_green_with_zero_replicas(self, client):
+        ok(client.perform("PUT", "/idx", body={
+            "settings": {"index": {"number_of_replicas": 0}}}))
+        r = ok(client.perform("GET", "/_cluster/health"))
+        assert r["status"] == "green"
+
+    def test_cluster_state_and_stats(self, client):
+        client.index("idx", "1", {"a": 1})
+        r = ok(client.perform("GET", "/_cluster/state"))
+        assert "idx" in r["metadata"]["indices"]
+        r = ok(client.perform("GET", "/_cluster/stats"))
+        assert r["indices"]["count"] == 1
+
+    def test_cluster_settings(self, client):
+        r = ok(client.perform("PUT", "/_cluster/settings", body={
+            "persistent": {"search.max_buckets": 1000}}))
+        assert r["persistent"]["search"]["max_buckets"] == 1000
+        r = ok(client.perform("GET", "/_cluster/settings"))
+        assert r["persistent"]["search"]["max_buckets"] == 1000
+
+    def test_nodes(self, client):
+        r = ok(client.perform("GET", "/_nodes"))
+        assert len(r["nodes"]) == 1
+        r = ok(client.perform("GET", "/_nodes/stats"))
+        assert len(r["nodes"]) == 1
+
+    def test_scripts_crud(self, client):
+        ok(client.perform("PUT", "/_scripts/myscript", body={
+            "script": {"lang": "painless", "source": "params.x * 2"}}))
+        r = ok(client.perform("GET", "/_scripts/myscript"))
+        assert r["found"] and r["script"]["source"] == "params.x * 2"
+        ok(client.perform("DELETE", "/_scripts/myscript"))
+        assert client.perform("GET", "/_scripts/myscript")[0] == 404
+
+
+class TestCatApi:
+    def test_cat_indices_text_and_json(self, client):
+        client.index("idx", "1", {"a": 1}, refresh="true")
+        status, text = client.perform("GET", "/_cat/indices", {"v": ""})
+        assert "idx" in text and "docs.count" in text
+        status, rows = client.perform("GET", "/_cat/indices", {"format": "json"})
+        assert rows[0]["index"] == "idx"
+        assert rows[0]["docs.count"] == 1
+
+    def test_cat_health_and_nodes(self, client):
+        status, text = client.perform("GET", "/_cat/health")
+        assert "green" in text or "yellow" in text
+        status, text = client.perform("GET", "/_cat/nodes")
+        assert "127.0.0.1" in text
+
+    def test_cat_shards_count(self, client):
+        client.index("idx", "1", {"a": 1}, refresh="true")
+        status, text = client.perform("GET", "/_cat/shards")
+        assert "idx" in text
+        status, text = client.perform("GET", "/_cat/count")
+        assert text.strip().endswith("1")
+
+
+class TestIngestApi:
+    def test_pipeline_crud_and_apply(self, client):
+        ok(client.perform("PUT", "/_ingest/pipeline/p1", body={
+            "processors": [
+                {"set": {"field": "env", "value": "prod"}},
+                {"uppercase": {"field": "code"}},
+            ],
+        }))
+        r = ok(client.perform("GET", "/_ingest/pipeline/p1"))
+        assert "p1" in r
+        status, _ = client.perform("PUT", "/idx/_doc/1", {"pipeline": "p1"},
+                                   {"code": "abc"})
+        assert status == 201
+        r = ok(client.get("idx", "1"))
+        assert r["_source"] == {"code": "ABC", "env": "prod"}
+        ok(client.perform("DELETE", "/_ingest/pipeline/p1"))
+        assert client.perform("GET", "/_ingest/pipeline/p1")[0] == 404
+
+    def test_simulate(self, client):
+        r = ok(client.perform("POST", "/_ingest/pipeline/_simulate", body={
+            "pipeline": {"processors": [{"rename": {
+                "field": "a", "target_field": "b"}}]},
+            "docs": [{"_source": {"a": 1}}],
+        }))
+        assert r["docs"][0]["doc"]["_source"] == {"b": 1}
+
+    def test_grok(self, client):
+        r = ok(client.perform("POST", "/_ingest/pipeline/_simulate", body={
+            "pipeline": {"processors": [{"grok": {
+                "field": "msg",
+                "patterns": ["%{IP:client} %{WORD:method} %{NUMBER:bytes:int}"],
+            }}]},
+            "docs": [{"_source": {"msg": "10.0.0.1 GET 1234"}}],
+        }))
+        src = r["docs"][0]["doc"]["_source"]
+        assert src["client"] == "10.0.0.1" and src["method"] == "GET"
+
+
+class TestReindexApi:
+    def test_reindex(self, client):
+        for i in range(5):
+            client.index("src", str(i), {"n": i}, refresh="true")
+        r = ok(client.perform("POST", "/_reindex", body={
+            "source": {"index": "src", "query": {"range": {"n": {"gte": 2}}}},
+            "dest": {"index": "dst"},
+        }))
+        assert r["created"] == 3
+        status, sr = client.search("dst", {})
+        assert sr["hits"]["total"] == 3
+
+    def test_delete_by_query(self, client):
+        for i in range(5):
+            client.index("idx", str(i), {"n": i}, refresh="true")
+        r = ok(client.perform("POST", "/idx/_delete_by_query", body={
+            "query": {"range": {"n": {"lt": 2}}}}))
+        assert r["deleted"] == 2
+        status, sr = client.search("idx", {})
+        assert sr["hits"]["total"] == 3
+
+    def test_update_by_query(self, client):
+        for i in range(3):
+            client.index("idx", str(i), {"n": i}, refresh="true")
+        r = ok(client.perform("POST", "/idx/_update_by_query", body={}))
+        assert r["updated"] == 3
+
+
+class TestSnapshotApi:
+    def test_snapshot_restore_cycle(self, client, tmp_path):
+        for i in range(4):
+            client.index("idx", str(i), {"n": i}, refresh="true")
+        ok(client.perform("PUT", "/_snapshot/backup", body={
+            "type": "fs", "settings": {"location": str(tmp_path / "repo")}}))
+        r = ok(client.perform("PUT", "/_snapshot/backup/snap1", body={
+            "indices": "idx"}))
+        assert r["snapshot"]["state"] == "SUCCESS"
+        r = ok(client.perform("GET", "/_snapshot/backup/snap1"))
+        assert r["snapshots"][0]["indices"] == ["idx"]
+        # restore under a new name
+        r = ok(client.perform("POST", "/_snapshot/backup/snap1/_restore", body={
+            "indices": "idx", "rename_pattern": "idx", "rename_replacement": "idx_restored",
+        }))
+        assert r["snapshot"]["indices"] == ["idx_restored"]
+        status, sr = client.search("idx_restored", {})
+        assert sr["hits"]["total"] == 4
+        # cat + delete
+        status, text = client.perform("GET", "/_cat/snapshots/backup")
+        assert "snap1" in text
+        ok(client.perform("DELETE", "/_snapshot/backup/snap1"))
+        status, _ = client.perform("GET", "/_snapshot/backup/snap1")
+        assert status == 404
+
+
+class TestHttpServer:
+    def test_live_http(self):
+        import urllib.request
+
+        from elasticsearch_tpu.rest.http_server import HttpServer
+
+        node = Node(Settings({"cluster.name": "http-test"}))
+        server = HttpServer(node, port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(f"{base}/") as resp:
+                root = json.loads(resp.read())
+            assert root["cluster_name"] == "http-test"
+            req = urllib.request.Request(
+                f"{base}/idx/_doc/1?refresh=true", data=b'{"a": 1}',
+                headers={"Content-Type": "application/json"}, method="PUT",
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 201
+            req = urllib.request.Request(
+                f"{base}/idx/_search", data=b'{"query": {"match_all": {}}}',
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req) as resp:
+                sr = json.loads(resp.read())
+            assert sr["hits"]["total"] == 1
+        finally:
+            server.stop()
+            node.close()
