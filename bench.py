@@ -1,0 +1,239 @@
+"""Benchmark: BM25 match-query latency on the flagship TPU query path.
+
+Mirrors the Rally `pmc` match-query config from BASELINE.md: a synthetic
+academic-scale corpus (1M docs, zipfian vocabulary, ~80 terms/doc), a
+multi-term BM25 disjunction with top-10 collection, p50/p99 service time.
+
+vs_baseline: speedup of the TPU program's p50 over an equivalent
+vectorized numpy implementation of the same exhaustive scoring on the host
+CPU (the stand-in for the reference's CPU execution; BASELINE.json's
+32-vCPU Rally baseline is not reachable in this image).
+
+Prints exactly ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_DOCS = 1_000_000
+AVG_DOC_LEN = 80
+VOCAB = 50_000
+BLOCK = 128
+N_QUERY_TERMS = 3
+K = 10
+WARMUP = 5
+ITERS = 50
+
+
+def build_synthetic_corpus(seed=7):
+    """Directly build block-packed postings for a zipfian corpus (bypasses
+    the host tokenizer — the bench targets the query path)."""
+    rng = np.random.RandomState(seed)
+    nd_pad = 1
+    while nd_pad < N_DOCS:
+        nd_pad *= 2
+    # per-doc lengths ~ lognormal around AVG_DOC_LEN
+    doc_len = np.clip(
+        rng.lognormal(np.log(AVG_DOC_LEN), 0.4, N_DOCS), 5, 500
+    ).astype(np.int64)
+    total_tokens = int(doc_len.sum())
+    # zipfian term ids
+    ranks = np.arange(1, VOCAB + 1)
+    probs = 1.0 / ranks
+    probs /= probs.sum()
+    tokens = rng.choice(VOCAB, total_tokens, p=probs).astype(np.int32)
+    doc_of_token = np.repeat(np.arange(N_DOCS, dtype=np.int32), doc_len)
+    # (term, doc) -> tf
+    keys = tokens.astype(np.int64) * N_DOCS + doc_of_token
+    uniq, counts = np.unique(keys, return_counts=True)
+    term_ids = (uniq // N_DOCS).astype(np.int32)
+    docs = (uniq % N_DOCS).astype(np.int32)
+    tfs = counts.astype(np.float32)
+    # postings already sorted by (term, doc); block-pack
+    term_start = np.searchsorted(term_ids, np.arange(VOCAB))
+    term_end = np.searchsorted(term_ids, np.arange(VOCAB) + 1)
+    term_df = (term_end - term_start).astype(np.int64)
+    n_blocks_per_term = -(-term_df // BLOCK)
+    total_blocks = int(n_blocks_per_term.sum())
+    block_docs = np.full((total_blocks, BLOCK), nd_pad, dtype=np.int32)
+    block_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
+    term_block_start = np.zeros(VOCAB, dtype=np.int64)
+    b = 0
+    for t in range(VOCAB):
+        df = int(term_df[t])
+        if df == 0:
+            term_block_start[t] = b
+            continue
+        term_block_start[t] = b
+        seg_docs = docs[term_start[t]: term_end[t]]
+        seg_tfs = tfs[term_start[t]: term_end[t]]
+        nb = int(n_blocks_per_term[t])
+        pad = nb * BLOCK - df
+        block_docs[b: b + nb] = np.concatenate(
+            [seg_docs, np.full(pad, nd_pad, np.int32)]
+        ).reshape(nb, BLOCK)
+        block_tfs[b: b + nb] = np.concatenate(
+            [seg_tfs, np.zeros(pad, np.float32)]
+        ).reshape(nb, BLOCK)
+        b += nb
+    norms = np.ones((1, nd_pad + 1), dtype=np.float32)
+    norms[0, :N_DOCS] = doc_len.astype(np.float32)
+    live1 = np.zeros(nd_pad + 1, dtype=bool)
+    live1[:N_DOCS] = True
+    avgdl = float(doc_len.mean())
+    return {
+        "block_docs": block_docs,
+        "block_tfs": block_tfs,
+        "norms": norms,
+        "live1": live1,
+        "term_block_start": term_block_start,
+        "n_blocks_per_term": n_blocks_per_term,
+        "term_df": term_df,
+        "avgdl": avgdl,
+        "nd_pad": nd_pad,
+    }
+
+
+def make_query(corpus, terms, qb_pad=64):
+    import math
+
+    blocks, weights, avgdls = [], [], []
+    for t in terms:
+        df = int(corpus["term_df"][t])
+        idf = math.log(1 + (N_DOCS - df + 0.5) / (df + 0.5))
+        start = int(corpus["term_block_start"][t])
+        for bi in range(start, start + int(corpus["n_blocks_per_term"][t])):
+            blocks.append(bi)
+            weights.append(idf)
+            avgdls.append(corpus["avgdl"])
+    n = qb_pad
+    while n < len(blocks):
+        n *= 2
+    pad = n - len(blocks)
+    return (
+        np.asarray(blocks + [0] * pad, np.int32),
+        np.asarray(weights + [0.0] * pad, np.float32),
+        np.zeros(n, np.int32),
+        np.asarray(avgdls + [1.0] * pad, np.float32),
+        np.asarray([True] * len(blocks) + [False] * pad),
+    )
+
+
+def numpy_reference_query(corpus, q):
+    """Host-CPU scoring of the same query (vectorized numpy baseline)."""
+    from elasticsearch_tpu.ops.scoring import B, K1
+
+    q_blocks, q_weights, _, q_avgdl, q_valid = q
+    docs = corpus["block_docs"][q_blocks]
+    tfs = corpus["block_tfs"][q_blocks]
+    doc_len = corpus["norms"][0][docs]
+    denom = tfs + K1 * (1 - B + B * doc_len / q_avgdl[:, None])
+    matched = (tfs > 0) & q_valid[:, None]
+    contrib = np.where(matched, q_weights[:, None] * tfs * (K1 + 1) / denom, 0.0)
+    nd1 = corpus["norms"].shape[1]
+    scores = np.zeros(nd1, np.float32)
+    np.add.at(scores, docs.ravel(), contrib.ravel())
+    counts = np.zeros(nd1, np.float32)
+    np.add.at(counts, docs.ravel(), matched.ravel().astype(np.float32))
+    masked = np.where((counts > 0) & corpus["live1"], scores, -np.inf)
+    top_idx = np.argpartition(-masked, K)[:K]
+    top_idx = top_idx[np.argsort(-masked[top_idx])]
+    return masked[top_idx], top_idx
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from elasticsearch_tpu.ops.scoring import B, K1
+
+    corpus = build_synthetic_corpus()
+
+    @jax.jit
+    def query_phase(block_docs, block_tfs, norms, live1, q_blocks, q_weights,
+                    q_norm_rows, q_avgdl, q_valid):
+        docs = block_docs[q_blocks]
+        tfs = block_tfs[q_blocks]
+        doc_len = norms[q_norm_rows[:, None], docs]
+        denom = tfs + K1 * (1.0 - B + B * doc_len / q_avgdl[:, None])
+        matched_blk = (tfs > 0.0) & q_valid[:, None]
+        contrib = jnp.where(
+            matched_blk, q_weights[:, None] * tfs * (K1 + 1.0) / denom, 0.0
+        )
+        nd1 = norms.shape[1]
+        scores = jnp.zeros((nd1,), jnp.float32).at[docs].add(contrib)
+        counts = jnp.zeros((nd1,), jnp.float32).at[docs].add(
+            matched_blk.astype(jnp.float32)
+        )
+        masked = jnp.where((counts > 0) & live1, scores, -jnp.inf)
+        return lax.top_k(masked, K)
+
+    # stage corpus to HBM once (shard-open staging)
+    dev = {
+        "block_docs": jnp.asarray(corpus["block_docs"]),
+        "block_tfs": jnp.asarray(corpus["block_tfs"]),
+        "norms": jnp.asarray(corpus["norms"]),
+        "live1": jnp.asarray(corpus["live1"]),
+    }
+
+    # query mix: mid-frequency terms (zipf ranks 50..1000), like pmc terms
+    rng = np.random.RandomState(3)
+    queries = [
+        make_query(corpus, list(rng.randint(50, 1000, N_QUERY_TERMS)))
+        for _ in range(ITERS + WARMUP)
+    ]
+
+    # correctness gate vs numpy reference (recall@10 == 1.0)
+    q0 = queries[0]
+    ts, ti = query_phase(dev["block_docs"], dev["block_tfs"], dev["norms"],
+                         dev["live1"], *[jnp.asarray(x) for x in q0])
+    ref_s, ref_i = numpy_reference_query(corpus, q0)
+    assert set(np.asarray(ti).tolist()) == set(ref_i.tolist()), "recall@10 != 1.0"
+    np.testing.assert_allclose(np.asarray(ts), ref_s, rtol=1e-4)
+
+    # --- TPU timing ---
+    lat = []
+    for i, q in enumerate(queries):
+        args = [jnp.asarray(x) for x in q]
+        t0 = time.perf_counter()
+        out = query_phase(dev["block_docs"], dev["block_tfs"], dev["norms"],
+                          dev["live1"], *args)
+        out[0].block_until_ready()
+        dt = time.perf_counter() - t0
+        if i >= WARMUP:
+            lat.append(dt)
+    lat = np.asarray(lat)
+    p50 = float(np.percentile(lat, 50) * 1000)
+    p99 = float(np.percentile(lat, 99) * 1000)
+    qps = 1000.0 / p50
+
+    # --- CPU numpy baseline timing (same exhaustive algorithm) ---
+    cpu_lat = []
+    for q in queries[: WARMUP + 10]:
+        t0 = time.perf_counter()
+        numpy_reference_query(corpus, q)
+        cpu_lat.append(time.perf_counter() - t0)
+    cpu_p50 = float(np.percentile(np.asarray(cpu_lat[2:]), 50) * 1000)
+
+    print(json.dumps({
+        "metric": "bm25_match_top10_p50_latency_1M_docs",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_p50 / p50, 2),
+        "extra": {
+            "p99_ms": round(p99, 3),
+            "qps_per_chip": round(qps, 1),
+            "cpu_numpy_p50_ms": round(cpu_p50, 3),
+            "n_docs": N_DOCS,
+            "recall_at_10": 1.0,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

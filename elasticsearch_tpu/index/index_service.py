@@ -143,9 +143,16 @@ class IndexService:
         for r in shard_results:
             if r.max_score is not None:
                 max_score = r.max_score if max_score is None else max(max_score, r.max_score)
-        refs = merge_refs(
-            [ref for r in shard_results for ref in r.refs], sort_spec, max(k, 0)
-        )
+        collapse_field = (body.get("collapse") or {}).get("field")
+        merge_k = max(k, 0)
+        if collapse_field:
+            merge_k = 0  # keep all candidates; collapsing shrinks the list
+        all_refs = [ref for r in shard_results for ref in r.refs]
+        refs = merge_refs(all_refs, sort_spec, merge_k or len(all_refs))
+        if collapse_field:
+            from elasticsearch_tpu.search.service import collapse_refs
+
+            refs = collapse_refs(refs, collapse_field, self.shards)[: max(k, 0)]
         refs_window = refs[from_: from_ + size] if size >= 0 else refs[from_:]
 
         aggregations = None
@@ -175,6 +182,10 @@ class IndexService:
             resp["_shards"]["failures"] = failures
         if aggregations is not None:
             resp["aggregations"] = aggregations
+        if body.get("profile"):
+            resp["profile"] = {"shards": [
+                s for r in shard_results for s in (r.profile or [])
+            ]}
         return resp
 
     def count(self, body: Optional[dict] = None) -> dict:

@@ -53,6 +53,7 @@ class DocRef:
     local_doc: int
     score: float
     sort_values: Tuple = ()
+    collapse_value: Any = None
 
 
 @dataclass
@@ -63,6 +64,8 @@ class ShardQueryResult:
     max_score: Optional[float] = None
     # segment views kept for agg execution at reduce time (single-process)
     agg_views: List[SegmentView] = field(default_factory=list)
+    # per-segment timing breakdowns when "profile": true
+    profile: Optional[List[dict]] = None
 
 
 class ShardSearcher:
@@ -93,41 +96,111 @@ class ShardSearcher:
         sort_spec = normalize_sort(source.get("sort"))
         search_after = source.get("search_after")
         slice_spec = source.get("slice")
+        rescore_specs = _normalize_rescore(source.get("rescore"))
+        profile = bool(source.get("profile", False))
+        k_select = k
+        if rescore_specs:
+            k_select = max(k, max(r["window_size"] for r in rescore_specs))
 
         refs: List[DocRef] = []
         total = 0
         max_score = None
         agg_views: List[SegmentView] = []
         agg_specs = parse_aggs(source.get("aggs") or source.get("aggregations"))
+        profile_shards = []
 
         for seg in self.engine.searchable_segments():
+            t_seg = time.monotonic()
             dev = seg.device_arrays()
             node = qb.to_plan(self.ctx, seg)
+            t_build = time.monotonic()
             scores_d, matched_d = P.execute(dev, node)
             scores = np.asarray(scores_d)
             matched = np.asarray(matched_d)
+            t_exec = time.monotonic()
             live1 = np.concatenate([seg.live, np.zeros(1, bool)])
             matched = matched & live1
             if min_score is not None:
                 matched = matched & (scores >= float(min_score))
             if slice_spec is not None:
                 matched = matched & self._slice_mask(seg, slice_spec)
-            if agg_specs:
+            if agg_views is not None and agg_specs:
                 agg_views.append(SegmentView(seg, matched.copy(), self.ctx, scores))
             if post_qb is not None:
                 _, post_m = P.execute(dev, post_qb.to_plan(self.ctx, seg))
                 matched = matched & np.asarray(post_m)
             total += int(matched[: seg.num_docs].sum())
-            seg_refs = self._select(seg, scores, matched, sort_spec, search_after, k)
+            seg_refs = self._select(seg, scores, matched, sort_spec, search_after,
+                                    k_select)
+            if rescore_specs and sort_spec is None:
+                seg_refs = self._rescore(seg, dev, seg_refs, rescore_specs)
             refs.extend(seg_refs)
             if seg_refs and sort_spec is None:
                 m = max(r.score for r in seg_refs)
                 max_score = m if max_score is None else max(max_score, m)
+            if profile:
+                t_end = time.monotonic()
+                profile_shards.append({
+                    "id": f"[{self.shard_id}][{seg.name}]",
+                    "searches": [{
+                        "query": [{
+                            "type": type(node).__name__,
+                            "description": str(source.get("query", {"match_all": {}})),
+                            "time_in_nanos": int((t_exec - t_build) * 1e9),
+                            "breakdown": {
+                                "build_plan": int((t_build - t_seg) * 1e9),
+                                "execute_program": int((t_exec - t_build) * 1e9),
+                                "select_topk": int((t_end - t_exec) * 1e9),
+                            },
+                        }],
+                        "collector": [{
+                            "name": "TopKSelector",
+                            "reason": "search_top_hits",
+                            "time_in_nanos": int((t_end - t_exec) * 1e9),
+                        }],
+                    }],
+                })
 
-        refs = merge_refs(refs, sort_spec, k)
+        refs = merge_refs(refs, sort_spec, k_select if rescore_specs else k)
+        if rescore_specs and sort_spec is None:
+            refs.sort(key=lambda r: (-r.score, r.local_doc))
+            refs = refs[:k]
+            if refs:
+                max_score = refs[0].score
         result = ShardQueryResult(self.shard_id, total, refs, max_score, agg_views)
+        if profile:
+            result.profile = profile_shards
         self.query_time += time.monotonic() - t0
         return result
+
+    def _rescore(self, seg, dev, seg_refs: List[DocRef],
+                 rescore_specs: List[dict]) -> List[DocRef]:
+        """QueryRescorer (search/rescore/QueryRescorer.java): re-rank the
+        top-window hits by combining the original score with the rescore
+        query's score. Window applies per shard, like the reference."""
+        for spec in rescore_specs:
+            window = spec["window_size"]
+            rqb = parse_query(spec["rescore_query"])
+            r_scores = np.asarray(P.execute(dev, rqb.to_plan(self.ctx, seg))[0])
+            qw, rqw = spec["query_weight"], spec["rescore_query_weight"]
+            mode = spec["score_mode"]
+            for ref in seg_refs[:window]:
+                rs = float(r_scores[ref.local_doc])
+                base = ref.score * qw
+                resc = rs * rqw
+                if mode == "total":
+                    ref.score = base + resc
+                elif mode == "multiply":
+                    ref.score = base * rs if rs else base
+                elif mode == "avg":
+                    ref.score = (base + resc) / 2.0
+                elif mode == "max":
+                    ref.score = max(base, resc)
+                elif mode == "min":
+                    ref.score = min(base, resc)
+                ref.sort_values = (ref.score,)
+        seg_refs.sort(key=lambda r: (-r.score, r.local_doc))
+        return seg_refs
 
     # ------------------------------------------------------------------
 
@@ -250,6 +323,52 @@ def _search_after_mask(key_arrays, sort_spec, after_values) -> np.ndarray:
         eq &= arr == a
     mask = np.concatenate([gt, np.zeros(1, dtype=bool)])
     return mask
+
+
+def _normalize_rescore(body) -> List[dict]:
+    """rescore body -> list of {window_size, rescore_query, weights, mode}."""
+    if body is None:
+        return []
+    specs = body if isinstance(body, list) else [body]
+    out = []
+    for spec in specs:
+        q = spec.get("query") or {}
+        out.append({
+            "window_size": int(spec.get("window_size", 10)),
+            "rescore_query": q.get("rescore_query"),
+            "query_weight": float(q.get("query_weight", 1.0)),
+            "rescore_query_weight": float(q.get("rescore_query_weight", 1.0)),
+            "score_mode": q.get("score_mode", "total"),
+        })
+    return out
+
+
+def collapse_refs(refs: List["DocRef"], field_name: str, shards: Dict) -> List["DocRef"]:
+    """Field collapsing (search/collapse/CollapseContext): keep the best hit
+    per distinct field value, preserving result order."""
+    seen = set()
+    out = []
+    for ref in refs:
+        shard = shards[ref.shard_id]
+        seg = next((s for s in shard.engine.segments if s.name == ref.segment_name), None)
+        if seg is None:
+            continue
+        value = None
+        col = seg.numeric_columns.get(field_name)
+        if col is not None and col.exists[ref.local_doc]:
+            value = float(col.first_value[ref.local_doc])
+        else:
+            ocol = seg.ordinal_columns.get(field_name) or seg.ordinal_columns.get(
+                f"{field_name}.keyword"
+            )
+            if ocol is not None and ocol.exists[ref.local_doc]:
+                value = ocol.terms[ocol.first_ord[ref.local_doc]]
+        if value in seen:
+            continue
+        seen.add(value)
+        ref.collapse_value = value
+        out.append(ref)
+    return out
 
 
 def normalize_sort(sort_body) -> Optional[List[Tuple[str, str, Any]]]:
@@ -465,6 +584,17 @@ def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
     want_version = bool(source_body.get("version", False))
     highlight_body = source_body.get("highlight")
     sort_spec = normalize_sort(source_body.get("sort"))
+    script_fields = source_body.get("script_fields") or {}
+    compiled_scripts = {}
+    if script_fields:
+        from elasticsearch_tpu.script.expression import compile_script
+
+        for fname, spec in script_fields.items():
+            sc = spec.get("script", spec)
+            compiled_scripts[fname] = (
+                compile_script(sc),
+                (sc.get("params") if isinstance(sc, dict) else None) or {},
+            )
 
     query_terms: Dict[str, set] = {}
     hits = []
@@ -510,6 +640,13 @@ def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
                         ]
             if fields_out:
                 hit["fields"] = fields_out
+        if compiled_scripts:
+            from elasticsearch_tpu.script.expression import doc_values_for
+
+            fields_out = hit.setdefault("fields", {})
+            for fname, (script, sparams) in compiled_scripts.items():
+                dv = doc_values_for(seg, d, script.doc_fields)
+                fields_out[fname] = [script.execute(dv, sparams, ref.score or 0.0)]
         if sort_spec is not None:
             hit["sort"] = [
                 v if not np.isinf(v) else None for v in ref.sort_values

@@ -1,0 +1,133 @@
+"""Rescore, collapse, script_fields, profile, slice (north-star configs)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture()
+def idx():
+    svc = IndexService("f", Settings({"index.number_of_shards": 1}))
+    docs = [
+        {"body": "alpha beta", "popularity": 1},
+        {"body": "alpha", "popularity": 100},
+        {"body": "alpha beta gamma", "popularity": 10},
+        {"body": "beta", "popularity": 50},
+    ]
+    for i, d in enumerate(docs):
+        svc.index_doc(str(i), d)
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+def ids(r):
+    return [h["_id"] for h in r["hits"]["hits"]]
+
+
+class TestRescore:
+    def test_rescore_total(self, idx):
+        # base: match alpha; rescore: boost docs matching beta
+        r = idx.search({
+            "query": {"match": {"body": "alpha"}},
+            "rescore": {
+                "window_size": 10,
+                "query": {
+                    "rescore_query": {"match": {"body": "beta"}},
+                    "query_weight": 1.0,
+                    "rescore_query_weight": 10.0,
+                },
+            },
+        })
+        got = ids(r)
+        # all alpha docs still present; beta-matching alpha docs ranked first
+        assert set(got) == {"0", "1", "2"}
+        assert set(got[:2]) == {"0", "2"}
+
+    def test_rescore_function_score_window(self, idx):
+        # north-star config 4: function_score-style rescoring over top window
+        r = idx.search({
+            "query": {"match": {"body": "alpha"}},
+            "rescore": {
+                "window_size": 2,
+                "query": {
+                    "rescore_query": {"function_score": {
+                        "query": {"match_all": {}},
+                        "field_value_factor": {"field": "popularity", "factor": 1.0},
+                        "boost_mode": "replace",
+                    }},
+                    "query_weight": 0.0,
+                    "rescore_query_weight": 1.0,
+                },
+            },
+        })
+        # only the top-2 by BM25 got rescored by popularity
+        assert len(ids(r)) == 3
+
+
+class TestCollapse:
+    def test_collapse_keeps_best_per_group(self):
+        svc = IndexService("c", Settings({"index.number_of_shards": 2}))
+        rows = [("g1", 1), ("g1", 9), ("g2", 5), ("g2", 3), ("g3", 7)]
+        for i, (g, n) in enumerate(rows):
+            svc.index_doc(str(i), {"group": g, "n": n, "t": "x"})
+        svc.refresh()
+        r = svc.search({
+            "query": {"match": {"t": "x"}},
+            "collapse": {"field": "group"},
+            "sort": [{"n": "desc"}],
+        })
+        assert ids(r) == ["1", "4", "2"]  # best n per group: 9(g1), 7(g3), 5(g2)
+        svc.close()
+
+
+class TestScriptFields:
+    def test_script_field_arithmetic(self, idx):
+        r = idx.search({
+            "query": {"ids": {"values": ["1"]}},
+            "script_fields": {
+                "pop2": {"script": {"source": "doc['popularity'].value * 2"}},
+                "with_params": {"script": {
+                    "source": "doc['popularity'].value + params.bonus",
+                    "params": {"bonus": 5},
+                }},
+            },
+        })
+        f = r["hits"]["hits"][0]["fields"]
+        assert f["pop2"] == [200.0]
+        assert f["with_params"] == [105.0]
+
+    def test_script_rejects_non_numeric(self, idx):
+        from elasticsearch_tpu.common.errors import ParsingException
+
+        with pytest.raises(ParsingException):
+            idx.search({
+                "query": {"match_all": {}},
+                "script_fields": {"bad": {"script": {"source": "__import__('os')"}}},
+            })
+
+
+class TestProfile:
+    def test_profile_breakdown_present(self, idx):
+        r = idx.search({"query": {"match": {"body": "alpha"}}, "profile": True})
+        shards = r["profile"]["shards"]
+        assert shards
+        q = shards[0]["searches"][0]["query"][0]
+        assert q["time_in_nanos"] >= 0
+        assert "execute_program" in q["breakdown"]
+
+
+class TestSlice:
+    def test_sliced_scan_partitions(self, idx):
+        seen = set()
+        for sid in range(3):
+            r = idx.search({
+                "query": {"match_all": {}},
+                "slice": {"id": sid, "max": 3},
+                "size": 10,
+            })
+            got = set(ids(r))
+            assert not (seen & got)  # disjoint
+            seen |= got
+        assert seen == {"0", "1", "2", "3"}
