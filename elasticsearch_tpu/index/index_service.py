@@ -186,6 +186,12 @@ class IndexService:
             resp["profile"] = {"shards": [
                 s for r in shard_results for s in (r.profile or [])
             ]}
+        if body.get("suggest"):
+            from elasticsearch_tpu.search.suggest import run_suggest
+
+            resp["suggest"] = run_suggest(
+                body["suggest"], self.shards, self.mapper_service
+            )
         return resp
 
     def count(self, body: Optional[dict] = None) -> dict:

@@ -425,9 +425,39 @@ class GeoPointFieldType(FieldType):
         return (lat, lon)
 
 
+class CompletionFieldType(FieldType):
+    """completion: autocomplete inputs (index/mapper/CompletionFieldMapper;
+    Lucene stores an FST — here inputs land in the field's sorted ordinal
+    column, weights in a parallel '<field>#weight' numeric column)."""
+
+    type_name = "completion"
+    ordinal_doc_values = True
+
+    def parse_completion(self, value):
+        """-> (inputs: [str], weight: float)."""
+        if isinstance(value, str):
+            return [value], 1.0
+        if isinstance(value, list):
+            return [str(v) for v in value], 1.0
+        if isinstance(value, dict):
+            inputs = value.get("input", [])
+            inputs = [inputs] if isinstance(inputs, str) else [str(v) for v in inputs]
+            return inputs, float(value.get("weight", 1.0))
+        raise MapperParsingException(
+            f"failed to parse completion field [{self.name}] value [{value!r}]"
+        )
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return None
+
+
 FIELD_TYPES = {
     t.type_name: t
     for t in [
+        CompletionFieldType,
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, ScaledFloatFieldType, DateFieldType,

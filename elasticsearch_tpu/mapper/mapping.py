@@ -219,6 +219,13 @@ class DocumentMapper:
         if isinstance(ft, GeoPointFieldType):
             out.geo_values.setdefault(ft.name, []).append(ft.parse_point(v))
             return
+        from elasticsearch_tpu.mapper.field_types import CompletionFieldType
+
+        if isinstance(ft, CompletionFieldType):
+            inputs, weight = ft.parse_completion(v)
+            out.string_values.setdefault(ft.name, []).extend(inputs)
+            out.numeric_values.setdefault(f"{ft.name}#weight", []).append(weight)
+            return
         if ft.index:
             terms = ft.index_terms(v, self.analyzers)
             if terms:

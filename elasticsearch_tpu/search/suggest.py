@@ -1,0 +1,214 @@
+"""Suggesters: term (edit distance), phrase (candidate rescoring),
+completion (prefix index).
+
+Role model: search/suggest/ in the reference — ``TermSuggester``
+(DirectSpellChecker over the terms dict), ``PhraseSuggester`` (n-gram LM +
+candidate generation), ``CompletionSuggester`` (FST with weights;
+completion inputs here live in a sorted host-side list per segment, the
+pointer-chasing structure that stays off-device per SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import ParsingException
+from elasticsearch_tpu.search.query_dsl import _levenshtein_leq
+
+
+def _edit_distance(a: str, b: str, cap: int = 3) -> int:
+    for k in range(cap + 1):
+        if _levenshtein_leq(a, b, k):
+            return k
+    return cap + 1
+
+
+def _field_term_freqs(segments, field: str) -> Dict[str, int]:
+    freqs: Dict[str, int] = {}
+    for seg in segments:
+        for token, tid in seg.terms_for_field(field):
+            freqs[token] = freqs.get(token, 0) + int(seg.term_doc_freq[tid])
+    return freqs
+
+
+def term_suggest(segments, field: str, text: str, analyzer,
+                 max_edits: int = 2, size: int = 5,
+                 min_word_length: int = 4, prefix_length: int = 1) -> List[dict]:
+    """Per-token spelling candidates ranked by (distance, -freq)."""
+    freqs = _field_term_freqs(segments, field)
+    out = []
+    for token, start, end in analyzer.analyze_tokens(text):
+        options: List[Tuple[int, int, str]] = []
+        exists = token in freqs
+        for cand, freq in freqs.items():
+            if cand == token:
+                continue
+            if len(token) >= min_word_length and prefix_length and \
+                    cand[:prefix_length] != token[:prefix_length]:
+                continue
+            if abs(len(cand) - len(token)) > max_edits:
+                continue
+            d = _edit_distance(token, cand, max_edits)
+            if d <= max_edits:
+                options.append((d, -freq, cand))
+        options.sort()
+        out.append({
+            "text": token,
+            "offset": start,
+            "length": end - start,
+            "options": [] if exists else [
+                {"text": c, "score": round(1.0 - d / (max_edits + 1), 3), "freq": -nf}
+                for d, nf, c in options[:size]
+            ],
+        })
+    return out
+
+
+def phrase_suggest(segments, field: str, text: str, analyzer,
+                   size: int = 5, max_errors: float = 1.0) -> List[dict]:
+    """Whole-phrase correction: per-token candidates (incl. the token
+    itself), best combinations scored by a unigram LM over the corpus
+    (the reference defaults to a bigram LM; unigram is the documented
+    round-1 model)."""
+    freqs = _field_term_freqs(segments, field)
+    total = sum(freqs.values()) or 1
+    tokens = [t for t, _, _ in analyzer.analyze_tokens(text)]
+    if not tokens:
+        return []
+    per_token: List[List[Tuple[str, float]]] = []
+    for tok in tokens:
+        cands: List[Tuple[str, float]] = []
+        if tok in freqs:
+            cands.append((tok, freqs[tok] / total))
+        for cand, freq in freqs.items():
+            if cand != tok and _levenshtein_leq(cand, tok, 1):
+                cands.append((cand, freq / total * 0.5))  # error discount
+        if not cands:
+            cands.append((tok, 1e-9))
+        cands.sort(key=lambda cf: -cf[1])
+        per_token.append(cands[:4])
+
+    # beam over combinations, bounded error count
+    max_err = int(max_errors) if max_errors >= 1 else max(1, int(max_errors * len(tokens)))
+    beams: List[Tuple[float, List[str], int]] = [(1.0, [], 0)]
+    for i, cands in enumerate(per_token):
+        nxt = []
+        for score, words, errs in beams:
+            for cand, p in cands:
+                e = errs + (cand != tokens[i])
+                if e > max_err:
+                    continue
+                nxt.append((score * p, words + [cand], e))
+        nxt.sort(key=lambda b: -b[0])
+        beams = nxt[:16]
+    options = []
+    seen = set()
+    for score, words, errs in beams:
+        phrase = " ".join(words)
+        if phrase in seen or errs == 0:
+            continue
+        seen.add(phrase)
+        options.append({"text": phrase, "score": round(score, 9)})
+        if len(options) >= size:
+            break
+    return [{
+        "text": text,
+        "offset": 0,
+        "length": len(text),
+        "options": options,
+    }]
+
+
+def completion_suggest(segments, field: str, prefix: str, size: int = 5,
+                       skip_duplicates: bool = False) -> List[dict]:
+    """Prefix completion over indexed completion inputs.
+
+    Inputs are stored as the field's ordinal column (sorted — the FST
+    analog); weights come from a parallel '<field>#weight' numeric column
+    when present."""
+    options = []
+    seen = set()
+    for seg in segments:
+        col = seg.ordinal_columns.get(field)
+        if col is None:
+            continue
+        wcol = seg.numeric_columns.get(f"{field}#weight")
+        lo = bisect.bisect_left(col.terms, prefix)
+        hi = bisect.bisect_left(col.terms, prefix + "￿")
+        for o in range(lo, hi):
+            term = col.terms[o]
+            # find docs holding this ordinal (host scan of CSR)
+            sel = col.flat_ords[: col.count] == o
+            for local in col.flat_docs[: col.count][sel]:
+                if not seg.live[local]:
+                    continue
+                weight = 1.0
+                if wcol is not None and wcol.exists[local]:
+                    weight = float(wcol.first_value[local])
+                if skip_duplicates and term in seen:
+                    continue
+                seen.add(term)
+                options.append({
+                    "text": term,
+                    "_id": seg.doc_ids[local],
+                    "_score": weight,
+                    "_source": seg.sources[local],
+                })
+    options.sort(key=lambda opt: (-opt["_score"], opt["text"]))
+    return [{
+        "text": prefix,
+        "offset": 0,
+        "length": len(prefix),
+        "options": options[:size],
+    }]
+
+
+def run_suggest(suggest_body: dict, shards, mapper_service) -> dict:
+    """Execute the ``"suggest"`` section (SuggestPhase)."""
+    out = {}
+    global_text = suggest_body.get("text")
+    segments = [
+        seg for shard in shards.values()
+        for seg in shard.engine.searchable_segments()
+    ]
+    for name, spec in suggest_body.items():
+        if name == "text":
+            continue
+        text = spec.get("text") or spec.get("prefix") or global_text
+        if "term" in spec:
+            cfg = spec["term"]
+            field = cfg["field"]
+            analyzer = mapper_service.analyzers.get(
+                getattr(mapper_service.field_type(field), "analyzer", None) or "standard"
+            )
+            out[name] = term_suggest(
+                segments, field, text, analyzer,
+                max_edits=int(cfg.get("max_edits", 2)),
+                size=int(cfg.get("size", 5)),
+                min_word_length=int(cfg.get("min_word_length", 4)),
+                prefix_length=int(cfg.get("prefix_length", 1)),
+            )
+        elif "phrase" in spec:
+            cfg = spec["phrase"]
+            field = cfg["field"]
+            analyzer = mapper_service.analyzers.get(
+                getattr(mapper_service.field_type(field), "analyzer", None) or "standard"
+            )
+            out[name] = phrase_suggest(
+                segments, field, text, analyzer,
+                size=int(cfg.get("size", 5)),
+                max_errors=float(cfg.get("max_errors", 1.0)),
+            )
+        elif "completion" in spec:
+            cfg = spec["completion"]
+            out[name] = completion_suggest(
+                segments, cfg["field"], text,
+                size=int(cfg.get("size", 5)),
+                skip_duplicates=bool(cfg.get("skip_duplicates", False)),
+            )
+        else:
+            raise ParsingException(
+                f"suggestion [{name}] must specify one of [term, phrase, completion]"
+            )
+    return out

@@ -1,0 +1,86 @@
+"""Suggester tests (ref: search/suggest — term, phrase, completion)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture()
+def idx():
+    svc = IndexService("s", Settings({"index.number_of_shards": 1}), {
+        "properties": {
+            "body": {"type": "text"},
+            "suggest": {"type": "completion"},
+        }
+    })
+    docs = [
+        {"body": "the quick brown fox", "suggest": {"input": ["quick fox"], "weight": 10}},
+        {"body": "quick silver lining", "suggest": {"input": ["quick silver", "silver"], "weight": 5}},
+        {"body": "brown bears fishing", "suggest": "brown bears"},
+        {"body": "the quick brown dog"},
+    ]
+    for i, d in enumerate(docs):
+        svc.index_doc(str(i), d)
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+class TestTermSuggester:
+    def test_misspelling_corrected(self, idx):
+        r = idx.search({"size": 0, "suggest": {
+            "fix": {"text": "quik browm", "term": {"field": "body"}},
+        }})
+        sug = r["suggest"]["fix"]
+        assert sug[0]["text"] == "quik"
+        assert sug[0]["options"][0]["text"] == "quick"
+        assert sug[1]["options"][0]["text"] == "brown"
+
+    def test_correct_word_no_options(self, idx):
+        r = idx.search({"size": 0, "suggest": {
+            "fix": {"text": "quick", "term": {"field": "body"}},
+        }})
+        assert r["suggest"]["fix"][0]["options"] == []
+
+    def test_freq_ranking(self, idx):
+        # "quick" (3 docs) should outrank rarer same-distance candidates
+        r = idx.search({"size": 0, "suggest": {
+            "fix": {"text": "quickk", "term": {"field": "body"}},
+        }})
+        opts = r["suggest"]["fix"][0]["options"]
+        assert opts[0]["text"] == "quick"
+        assert opts[0]["freq"] == 3
+
+
+class TestPhraseSuggester:
+    def test_phrase_correction(self, idx):
+        r = idx.search({"size": 0, "suggest": {
+            "p": {"text": "quik brown", "phrase": {"field": "body"}},
+        }})
+        options = r["suggest"]["p"][0]["options"]
+        assert options
+        assert options[0]["text"] == "quick brown"
+
+
+class TestCompletionSuggester:
+    def test_prefix_completion_weight_order(self, idx):
+        r = idx.search({"size": 0, "suggest": {
+            "ac": {"prefix": "quick", "completion": {"field": "suggest"}},
+        }})
+        opts = r["suggest"]["ac"][0]["options"]
+        texts = [o["text"] for o in opts]
+        assert texts == ["quick fox", "quick silver"]  # weight 10 > 5
+        assert opts[0]["_id"] == "0"
+
+    def test_no_match(self, idx):
+        r = idx.search({"size": 0, "suggest": {
+            "ac": {"prefix": "zzz", "completion": {"field": "suggest"}},
+        }})
+        assert r["suggest"]["ac"][0]["options"] == []
+
+    def test_multiple_inputs(self, idx):
+        r = idx.search({"size": 0, "suggest": {
+            "ac": {"prefix": "sil", "completion": {"field": "suggest"}},
+        }})
+        assert [o["text"] for o in r["suggest"]["ac"][0]["options"]] == ["silver"]
