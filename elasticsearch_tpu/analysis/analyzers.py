@@ -283,9 +283,22 @@ class Analyzer:
             text = str(text)
         for cf in self.char_filters:
             text = cf(text)
-        tokens = self.tokenizer(text)
-        for f in self.token_filters:
-            tokens = f(tokens)
+        tokens = None
+        # native fast path: standard tokenizer + leading lowercase filter is
+        # the dominant indexing combination (C++ does both in one pass)
+        if (self.tokenizer is standard_tokenizer and self.token_filters
+                and self.token_filters[0] is lowercase_filter):
+            from elasticsearch_tpu.utils import native
+
+            fast = native.standard_tokenize_fast(text)
+            if fast is not None:
+                tokens = fast
+                for f in self.token_filters[1:]:
+                    tokens = f(tokens)
+        if tokens is None:
+            tokens = self.tokenizer(text)
+            for f in self.token_filters:
+                tokens = f(tokens)
         return [tok for tok in tokens if tok[0]]
 
 

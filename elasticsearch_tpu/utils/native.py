@@ -1,0 +1,131 @@
+"""ctypes bindings to the native analysis library (native/analysis.cpp).
+
+Loads ``native/libestpu_native.so``; builds it with make/g++ on first use
+if the toolchain is available. Every entry point has a pure-Python
+fallback, and the native fast paths are ASCII-exact replicas (verified in
+tests/test_native.py), so behavior is identical either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libestpu_native.so")
+
+_lib = None
+_load_attempted = False
+
+
+def _try_load():
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH) and os.path.exists(
+        os.path.join(_NATIVE_DIR, "Makefile")
+    ):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True,
+                capture_output=True, timeout=120,
+            )
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.standard_tokenize_ascii.restype = ctypes.c_int
+    lib.standard_tokenize_ascii.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+    ]
+    lib.whitespace_tokenize.restype = ctypes.c_int
+    lib.whitespace_tokenize.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+    ]
+    lib.murmur3_32.restype = ctypes.c_int32
+    lib.murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32]
+    lib.shard_ids_batch.restype = None
+    lib.shard_ids_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _try_load() is not None
+
+
+_MAX_TOKENS = 65536
+
+
+def standard_tokenize_fast(text: str) -> Optional[List[Tuple[str, int, int]]]:
+    """Lowercased \\w+ tokens with offsets, or None if the native path
+    can't handle the input (non-ASCII) / isn't available."""
+    lib = _try_load()
+    if lib is None:
+        return None
+    raw = text.encode("utf-8", errors="surrogatepass")
+    if len(raw) != len(text):  # non-ASCII
+        return None
+    out = ctypes.create_string_buffer(len(raw) or 1)
+    starts = (ctypes.c_int32 * _MAX_TOKENS)()
+    ends = (ctypes.c_int32 * _MAX_TOKENS)()
+    n = lib.standard_tokenize_ascii(raw, len(raw), out, starts, ends, _MAX_TOKENS)
+    if n < 0:
+        return None
+    lowered = out.raw[: len(raw)].decode("ascii", errors="replace")
+    return [(lowered[starts[i]: ends[i]], starts[i], ends[i]) for i in range(n)]
+
+
+def whitespace_tokenize_fast(text: str) -> Optional[List[Tuple[str, int, int]]]:
+    lib = _try_load()
+    if lib is None:
+        return None
+    raw = text.encode("utf-8")
+    if len(raw) != len(text):
+        return None  # byte offsets would diverge from str offsets
+    starts = (ctypes.c_int32 * _MAX_TOKENS)()
+    ends = (ctypes.c_int32 * _MAX_TOKENS)()
+    n = lib.whitespace_tokenize(raw, len(raw), starts, ends, _MAX_TOKENS)
+    return [(text[starts[i]: ends[i]], starts[i], ends[i]) for i in range(n)]
+
+
+def murmur3_32_fast(data: bytes, seed: int = 0) -> Optional[int]:
+    lib = _try_load()
+    if lib is None:
+        return None
+    return int(lib.murmur3_32(data, len(data), seed))
+
+
+def shard_ids_batch(routings: List[str], num_shards: int) -> Optional[np.ndarray]:
+    """Vectorized doc->shard routing for bulk indexing."""
+    lib = _try_load()
+    if lib is None:
+        return None
+    encoded = [r.encode("utf-8") for r in routings]
+    buf = b"".join(encoded)
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int32)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    out = np.zeros(len(encoded), dtype=np.int32)
+    lib.shard_ids_batch(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(encoded), num_shards,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out

@@ -1,0 +1,135 @@
+// Native analysis kernels for the host-side indexing path.
+//
+// Role: the reference's indexing hot loop runs in JIT-compiled Java inside
+// Lucene (analyzer chains, term hashing). Here the write path is host-side
+// (SURVEY.md §7.1: "the write path stays host-side (CPU: tokenize -> segment
+// build -> WAL)"), so the tokenizer/hash inner loops are C++, bound via
+// ctypes (utils/native.py) with a pure-Python fallback for parity testing.
+//
+// Fast paths are ASCII-exact replicas of the Python implementations; any
+// input needing Unicode word-break semantics returns -1 and the caller
+// falls back to Python (same result either way — tested in
+// tests/test_native.py).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// standard_tokenize_ascii: \w+ runs, lowercased in place into `out`.
+// Token i spans out[starts[i]:ends[i]). Returns token count, or -1 if the
+// text contains non-ASCII bytes (caller must use the Unicode path).
+// ---------------------------------------------------------------------------
+int standard_tokenize_ascii(const char *text, int len, char *out,
+                            int32_t *starts, int32_t *ends, int max_tokens) {
+    int n = 0;
+    int i = 0;
+    while (i < len) {
+        unsigned char c = (unsigned char)text[i];
+        if (c >= 0x80) return -1;  // Unicode: fall back to Python re
+        bool word = (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+                    (c >= '0' && c <= '9') || c == '_';
+        if (!word) { out[i] = (char)c; i++; continue; }
+        if (n >= max_tokens) return n;
+        int start = i;
+        while (i < len) {
+            unsigned char d = (unsigned char)text[i];
+            if (d >= 0x80) return -1;
+            bool w = (d >= 'a' && d <= 'z') || (d >= 'A' && d <= 'Z') ||
+                     (d >= '0' && d <= '9') || d == '_';
+            if (!w) break;
+            out[i] = (d >= 'A' && d <= 'Z') ? (char)(d + 32) : (char)d;
+            i++;
+        }
+        starts[n] = start;
+        ends[n] = i;
+        n++;
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// whitespace_tokenize: \S+ runs (byte-exact for any input — UTF-8 bytes
+// >= 0x80 are never ASCII whitespace).
+// ---------------------------------------------------------------------------
+int whitespace_tokenize(const char *text, int len, int32_t *starts,
+                        int32_t *ends, int max_tokens) {
+    int n = 0;
+    int i = 0;
+    while (i < len) {
+        unsigned char c = (unsigned char)text[i];
+        if (c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+            c == '\v') { i++; continue; }
+        if (n >= max_tokens) return n;
+        int start = i;
+        while (i < len) {
+            unsigned char d = (unsigned char)text[i];
+            if (d == ' ' || d == '\t' || d == '\n' || d == '\r' || d == '\f' ||
+                d == '\v') break;
+            i++;
+        }
+        starts[n] = start;
+        ends[n] = i;
+        n++;
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// MurmurHash3 x86_32 — identical to utils/murmur3.py (doc routing).
+// ---------------------------------------------------------------------------
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16; h *= 0x85ebca6b;
+    h ^= h >> 13; h *= 0xc2b2ae35;
+    h ^= h >> 16;
+    return h;
+}
+
+int32_t murmur3_32(const char *data, int len, uint32_t seed) {
+    const uint32_t c1 = 0xcc9e2d51, c2 = 0x1b873593;
+    uint32_t h1 = seed;
+    const int nblocks = len / 4;
+    for (int i = 0; i < nblocks; i++) {
+        uint32_t k1;
+        memcpy(&k1, data + i * 4, 4);
+        k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2;
+        h1 ^= k1; h1 = rotl32(h1, 13); h1 = h1 * 5 + 0xe6546b64;
+    }
+    const unsigned char *tail = (const unsigned char *)(data + nblocks * 4);
+    uint32_t k1 = 0;
+    switch (len & 3) {
+        case 3: k1 ^= tail[2] << 16; [[fallthrough]];
+        case 2: k1 ^= tail[1] << 8;  [[fallthrough]];
+        case 1: k1 ^= tail[0];
+                k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2; h1 ^= k1;
+    }
+    h1 ^= (uint32_t)len;
+    return (int32_t)fmix32(h1);
+}
+
+// batch variant: flat utf-8 buffer + offsets, one hash per string
+void murmur3_batch(const char *buf, const int32_t *offsets, int n,
+                   int32_t *out, uint32_t seed) {
+    for (int i = 0; i < n; i++) {
+        out[i] = murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+    }
+}
+
+// shard routing: floorMod(hash, num_shards) per string
+void shard_ids_batch(const char *buf, const int32_t *offsets, int n,
+                     int32_t num_shards, int32_t *out) {
+    for (int i = 0; i < n; i++) {
+        int32_t h = murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i], 0);
+        int32_t m = h % num_shards;
+        out[i] = m < 0 ? m + num_shards : m;  // Python floor-mod parity
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+"""Native C++ analysis library: parity with the pure-Python implementations.
+
+The reference leans on JIT-compiled Java for these loops (Lucene analyzer
+chains, Murmur3HashFunction); our native path must be byte-identical to
+the Python fallback (which is the behavioral spec)."""
+
+import random
+import string
+
+import pytest
+
+from elasticsearch_tpu.analysis.analyzers import (
+    Analyzer,
+    lowercase_filter,
+    standard_tokenizer,
+    whitespace_tokenizer,
+)
+from elasticsearch_tpu.utils import native
+from elasticsearch_tpu.utils.murmur3 import murmur3_32, shard_id_for
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+class TestTokenizerParity:
+    CASES = [
+        "The Quick Brown Fox! 42 times_over",
+        "",
+        "    leading and trailing   ",
+        "punct,only;here: (and) [brackets]",
+        "a",
+        "x" * 5000,
+        "tabs\tand\nnewlines\r\nmixed",
+        "under_scores_and_123_numbers",
+    ]
+
+    def test_standard_matches_python(self):
+        for text in self.CASES:
+            fast = native.standard_tokenize_fast(text)
+            assert fast is not None
+            ref = lowercase_filter(standard_tokenizer(text))
+            assert fast == ref, f"mismatch on {text!r}"
+
+    def test_non_ascii_falls_back(self):
+        assert native.standard_tokenize_fast("héllo wörld") is None
+
+    def test_whitespace_matches_python(self):
+        for text in self.CASES:
+            fast = native.whitespace_tokenize_fast(text)
+            assert fast == whitespace_tokenizer(text)
+
+    def test_random_ascii_fuzz(self):
+        rng = random.Random(11)
+        alphabet = string.ascii_letters + string.digits + " .,;-_!()\t\n"
+        for _ in range(200):
+            text = "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 200)))
+            assert native.standard_tokenize_fast(text) == lowercase_filter(
+                standard_tokenizer(text)
+            )
+
+    def test_analyzer_integration_uses_fast_path(self):
+        an = Analyzer("standard", standard_tokenizer, [lowercase_filter])
+        assert an.analyze("Fast Path HERE") == ["fast", "path", "here"]
+        # unicode text still correct via fallback
+        assert an.analyze("héllo wörld") == ["héllo", "wörld"]
+
+
+class TestMurmurParity:
+    def test_hash_parity(self):
+        rng = random.Random(5)
+        for _ in range(300):
+            data = bytes(rng.randrange(256) for _ in range(rng.randint(0, 40)))
+            assert native.murmur3_32_fast(data) == murmur3_32(data)
+
+    def test_shard_ids_batch(self):
+        ids = [f"doc-{i}" for i in range(500)]
+        out = native.shard_ids_batch(ids, 7)
+        assert out is not None
+        for i, doc_id in enumerate(ids):
+            assert out[i] == shard_id_for(doc_id, 7)
