@@ -425,6 +425,23 @@ class GeoPointFieldType(FieldType):
         return (lat, lon)
 
 
+class PercolatorFieldType(FieldType):
+    """percolator: stores a query DSL object for inverse search
+    (modules/percolator — PercolatorFieldMapper). The query lives in
+    _source; matching is done by the percolate query executing stored
+    queries against an in-memory one-doc index (the reference additionally
+    pre-filters via extracted terms; round-1 evaluates all stored queries)."""
+
+    type_name = "percolator"
+    has_doc_values = False
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return None
+
+
 class CompletionFieldType(FieldType):
     """completion: autocomplete inputs (index/mapper/CompletionFieldMapper;
     Lucene stores an FST — here inputs land in the field's sorted ordinal
@@ -458,6 +475,7 @@ FIELD_TYPES = {
     t.type_name: t
     for t in [
         CompletionFieldType,
+        PercolatorFieldType,
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, ScaledFloatFieldType, DateFieldType,

@@ -733,6 +733,159 @@ class Node:
             self._persist_index_meta(n)
         return {"acknowledged": True}
 
+    def termvectors(self, index: str, doc_id: str,
+                    fields: Optional[List[str]] = None) -> dict:
+        """_termvectors (action/termvectors/TransportTermVectorsAction):
+        per-field terms with freq + positions for one doc."""
+        svc = self.index_service(index)
+        shard = svc.shards[svc._route(doc_id)]
+        shard.refresh()
+        term_vectors: Dict[str, dict] = {}
+        found = False
+        for seg in shard.engine.searchable_segments():
+            local = seg.id_to_doc().get(doc_id)
+            if local is None or not seg.live[local]:
+                continue
+            found = True
+            by_field: Dict[str, dict] = {}
+            for tid, per_doc in seg.positions.items():
+                if local not in per_doc:
+                    continue
+                key = seg.term_keys[tid]
+                fname, token = key.split("\x1f", 1)
+                if fields and fname not in fields:
+                    continue
+                f = by_field.setdefault(fname, {"terms": {}})
+                f["terms"][token] = {
+                    "term_freq": int(len(per_doc[local])),
+                    "doc_freq": int(seg.term_doc_freq[tid]),
+                    "tokens": [{"position": int(p)} for p in per_doc[local]],
+                }
+            for fname, f in by_field.items():
+                st = seg.field_stats.get(fname, {})
+                f["field_statistics"] = {
+                    "sum_ttf": st.get("sum_ttf", 0),
+                    "doc_count": st.get("doc_count", 0),
+                }
+                term_vectors[fname] = f
+            break
+        return {
+            "_index": svc.name,
+            "_id": doc_id,
+            "found": found,
+            "term_vectors": term_vectors,
+        }
+
+    def rollover(self, alias: str, body: Optional[dict] = None) -> dict:
+        """_rollover (action/admin/indices/rollover): when conditions are
+        met, create the next index in the series and move the write alias."""
+        body = body or {}
+        state = self.cluster_service.state
+        sources = [n for n, md in state.indices.items() if alias in md.aliases]
+        if len(sources) != 1:
+            raise IllegalArgumentException(
+                f"source alias [{alias}] must point to exactly one index, "
+                f"found {sources}"
+            )
+        source = sources[0]
+        import re as _re
+
+        m = _re.search(r"-(\d+)$", source)
+        if body.get("new_index"):
+            target = body["new_index"]
+        elif m:
+            n = int(m.group(1)) + 1
+            target = f"{source[:m.start()]}-{n:06d}"
+        else:
+            target = f"{source}-000002"
+        svc = self.indices[source]
+        conditions = body.get("conditions") or {}
+        results = {}
+        met = not conditions
+        from elasticsearch_tpu.common.units import parse_byte_size, parse_time_value
+
+        if "max_docs" in conditions:
+            ok = svc.num_docs >= int(conditions["max_docs"])
+            results["[max_docs: {}]".format(conditions["max_docs"])] = ok
+            met = met or ok
+        if "max_age" in conditions:
+            age = time.time() - svc.creation_date / 1000.0
+            ok = age >= parse_time_value(conditions["max_age"], "max_age")
+            results["[max_age: {}]".format(conditions["max_age"])] = ok
+            met = met or ok
+        if "max_size" in conditions:
+            size = sum(s.stats()["segments"]["memory_in_bytes"]
+                       for s in svc.shards.values())
+            ok = size >= parse_byte_size(conditions["max_size"], "max_size")
+            results["[max_size: {}]".format(conditions["max_size"])] = ok
+            met = met or ok
+        resp = {
+            "old_index": source,
+            "new_index": target,
+            "rolled_over": False,
+            "dry_run": bool(body.get("dry_run", False)),
+            "conditions": results,
+            "acknowledged": False,
+            "shards_acknowledged": False,
+        }
+        if not met or body.get("dry_run"):
+            return resp
+        create_body = {k: v for k, v in body.items()
+                       if k in ("settings", "mappings", "aliases")}
+        self.create_index(target, create_body)
+        self.update_aliases([
+            {"remove": {"index": source, "alias": alias}},
+            {"add": {"index": target, "alias": alias}},
+        ])
+        resp.update({"rolled_over": True, "acknowledged": True,
+                     "shards_acknowledged": True})
+        return resp
+
+    def shrink_index(self, source: str, target: str,
+                     body: Optional[dict] = None) -> dict:
+        """_shrink (action/admin/indices/shrink): re-partition into fewer
+        shards. The reference hard-links segment files; we re-route docs
+        (offline repartition, same semantics: SURVEY.md §5.7)."""
+        body = body or {}
+        svc = self.index_service(source)
+        settings = dict((body.get("settings") or {}))
+        target_shards = int(
+            Settings.from_dict(settings).get("index.number_of_shards", 1)
+        )
+        if svc.num_shards % target_shards != 0:
+            raise IllegalArgumentException(
+                f"the number of source shards [{svc.num_shards}] must be a "
+                f"multiple of [{target_shards}]"
+            )
+        svc.refresh()
+        self.create_index(target, {
+            "settings": settings,
+            "mappings": svc.mapping_dict(),
+            "aliases": body.get("aliases") or {},
+        })
+        tgt = self.indices[target]
+        for shard in svc.shards.values():
+            for seg in shard.engine.searchable_segments():
+                for local in range(seg.num_docs):
+                    if seg.live[local]:
+                        tgt.index_doc(seg.doc_ids[local], seg.sources[local],
+                                      seg.routings[local])
+        tgt.refresh()
+        return {"acknowledged": True, "shards_acknowledged": True, "index": target}
+
+    def hot_threads(self) -> str:
+        """_nodes/hot_threads (monitor/jvm/HotThreads): stack dump of live
+        threads."""
+        import sys
+        import traceback
+
+        out = [f"::: {{{self.node_name}}}{{{self.node_id}}}"]
+        for tid, frame in sys._current_frames().items():
+            out.append(f"\n   thread id [{tid}]:")
+            out.extend("     " + line for line in
+                       traceback.format_stack(frame, limit=8))
+        return "\n".join(out)
+
     def put_stored_script(self, script_id: str, body: dict) -> dict:
         def update(state: ClusterState) -> ClusterState:
             new = state.copy()

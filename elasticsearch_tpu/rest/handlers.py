@@ -72,6 +72,23 @@ def register_all(c) -> None:
     r("GET", "/{index}/_explain/{id}", _explain)
     r("POST", "/{index}/_explain/{id}", _explain)
 
+    # --- templates / termvectors / rollover / shrink / hot_threads ---
+    r("GET", "/_search/template", _search_template)
+    r("POST", "/_search/template", _search_template)
+    r("GET", "/{index}/_search/template", _search_template)
+    r("POST", "/{index}/_search/template", _search_template)
+    r("GET", "/_render/template", _render_template)
+    r("POST", "/_render/template", _render_template)
+    r("GET", "/{index}/_termvectors/{id}", _termvectors)
+    r("POST", "/{index}/_termvectors/{id}", _termvectors)
+    r("GET", "/{index}/{type}/{id}/_termvectors", _termvectors)
+    r("POST", "/{index}/_rollover", _rollover)
+    r("POST", "/{index}/_rollover/{new_index}", _rollover)
+    r("POST", "/{index}/_shrink/{target}", _shrink)
+    r("PUT", "/{index}/_shrink/{target}", _shrink)
+    r("GET", "/_nodes/hot_threads", lambda n, q: (200, n.hot_threads()))
+    r("GET", "/_nodes/{node_id}/hot_threads", lambda n, q: (200, n.hot_threads()))
+
     # --- reindex family ---
     r("POST", "/_reindex", _reindex)
     r("POST", "/{index}/_update_by_query", _update_by_query)
@@ -438,6 +455,42 @@ def _explain(node, req):
             "details": [],
         },
     }
+
+
+def _search_template(node, req):
+    from elasticsearch_tpu.search.templates import resolve_template
+
+    body = req.json_body({}) or {}
+    rendered = resolve_template(node, body)
+    return 200, node.search(req.param("index", "_all"), rendered)
+
+
+def _render_template(node, req):
+    from elasticsearch_tpu.search.templates import resolve_template
+
+    return 200, {"template_output": resolve_template(node, req.json_body({}) or {})}
+
+
+def _termvectors(node, req):
+    body = req.json_body({}) or {}
+    fields = body.get("fields") or (
+        req.param("fields").split(",") if req.param("fields") else None
+    )
+    return 200, node.termvectors(req.param("index"), req.param("id"), fields)
+
+
+def _rollover(node, req):
+    body = req.json_body({}) or {}
+    if req.param("new_index"):
+        body["new_index"] = req.param("new_index")
+    if req.bool_param("dry_run"):
+        body["dry_run"] = True
+    return 200, node.rollover(req.param("index"), body)
+
+
+def _shrink(node, req):
+    return 200, node.shrink_index(req.param("index"), req.param("target"),
+                                  req.json_body({}))
 
 
 def _reindex(node, req):

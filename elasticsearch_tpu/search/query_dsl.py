@@ -939,6 +939,60 @@ class MoreLikeThisQueryBuilder(QueryBuilder):
         ))
 
 
+class PercolateQueryBuilder(QueryBuilder):
+    """Inverse search (modules/percolator — PercolateQueryBuilder:86): find
+    stored queries (percolator-typed fields) matching a candidate document.
+    The candidate is indexed into a one-doc in-memory segment; every stored
+    query is planned against it and matched queries' docs become hits."""
+
+    name = "percolate"
+
+    def __init__(self, field: str, document: dict, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.document = document
+
+    def to_plan(self, ctx, segment):
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+
+        # one-doc memory index of the candidate, parsed with a scratch
+        # mapper (dynamic mapping) so stored queries see typed fields
+        from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+        from elasticsearch_tpu.mapper.mapping import MapperService
+
+        scratch = MapperService(AnalysisRegistry(),
+                                ctx.mapper_service.mapping_dict())
+        builder = SegmentBuilder("_percolate")
+        builder.add_document(scratch.parse_document("_candidate", self.document), 0)
+        temp_seg = builder.seal()
+        temp_ctx = ShardQueryContext(scratch)
+        temp_dev = temp_seg.device_arrays()
+
+        from elasticsearch_tpu.search import plan as PL
+
+        matching = []
+        for local in range(segment.num_docs):
+            if not segment.live[local]:
+                continue
+            stored = segment.sources[local].get(self.field)
+            if not isinstance(stored, dict):
+                continue
+            try:
+                qb = parse_query(stored)
+                node = qb.to_plan(temp_ctx, temp_seg)
+                _, m = PL.execute(temp_dev, node)
+                if bool(np.asarray(m)[0]):
+                    matching.append(local)
+            except Exception:
+                continue  # malformed stored query never matches
+        if not matching:
+            return P.MatchNoneNode()
+        mask = np.zeros(segment.nd_pad + 1, dtype=bool)
+        for d in matching:
+            mask[d] = True
+        return P.ConstantScoreNode(P.DenseMaskNode(mask, "percolate"), self.boost)
+
+
 class NestedQueryBuilder(QueryBuilder):
     """Flattened-nested approximation: the engine indexes nested objects
     flattened (object mapping), so a nested query degrades to its inner
@@ -1153,6 +1207,11 @@ def parse_query(body) -> QueryBuilder:
             min_term_freq=int(qbody.get("min_term_freq", 2)),
             minimum_should_match=qbody.get("minimum_should_match", "30%"),
         )
+    if qtype == "percolate":
+        doc = qbody.get("document")
+        if doc is None and "documents" in qbody:
+            doc = qbody["documents"][0]
+        return PercolateQueryBuilder(qbody["field"], doc or {})
     if qtype == "nested":
         return NestedQueryBuilder(
             qbody["path"], parse_query(qbody["query"]),

@@ -1,0 +1,70 @@
+"""Search templates: mustache-rendered search bodies.
+
+Role model: ``modules/lang-mustache`` — ``TransportSearchTemplateAction``
+(render {{params}} into a search source, then run it) and the _render API.
+Supports {{var}}, {{#toJson}}var{{/toJson}}, {{var}}{{^var}}default
+fallbacks are approximated with {{var}} only (the common subset).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+from elasticsearch_tpu.common.errors import (
+    ParsingException,
+    ResourceNotFoundException,
+)
+
+_TOJSON_RE = re.compile(r"\{\{#toJson\}\}(\w+)\{\{/toJson\}\}")
+_VAR_RE = re.compile(r"\{\{([\w.]+)\}\}")
+
+
+def render_template(source, params: Optional[dict]) -> dict:
+    params = params or {}
+    if isinstance(source, dict):
+        template = json.dumps(source)
+    else:
+        template = str(source)
+
+    def tojson(m):
+        name = m.group(1)
+        return json.dumps(params.get(name))
+
+    template = _TOJSON_RE.sub(tojson, template)
+
+    def sub(m):
+        path = m.group(1)
+        node = params
+        for part in path.split("."):
+            if isinstance(node, dict) and part in node:
+                node = node[part]
+            else:
+                return ""
+        if isinstance(node, str):
+            return node
+        return json.dumps(node)
+
+    rendered = _VAR_RE.sub(sub, template)
+    try:
+        return json.loads(rendered)
+    except json.JSONDecodeError as e:
+        raise ParsingException(
+            f"rendered search template is not valid JSON: {e}: {rendered[:200]}"
+        ) from e
+
+
+def resolve_template(node, body: dict):
+    """-> (rendered_body, params) from inline or stored template."""
+    params = body.get("params") or {}
+    if "source" in body or "inline" in body:
+        return render_template(body.get("source") or body.get("inline"), params)
+    if "id" in body:
+        stored = node.cluster_service.state.stored_scripts.get(body["id"])
+        if stored is None:
+            raise ResourceNotFoundException(
+                f"unable to find script [{body['id']}]"
+            )
+        return render_template(stored.get("source") or stored.get("inline"), params)
+    raise ParsingException("search template requires [source] or [id]")

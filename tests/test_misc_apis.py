@@ -1,0 +1,141 @@
+"""Search templates, termvectors, rollover, shrink, percolate, hot_threads."""
+
+import pytest
+
+from elasticsearch_tpu.client import Client
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def client():
+    node = Node(Settings.EMPTY)
+    c = Client(node)
+    yield c
+    node.close()
+
+
+def ok(resp):
+    status, payload = resp
+    assert status in (200, 201), payload
+    return payload
+
+
+class TestSearchTemplates:
+    def test_inline_template(self, client):
+        client.index("idx", "1", {"color": "red"}, refresh="true")
+        client.index("idx", "2", {"color": "blue"}, refresh="true")
+        r = ok(client.perform("POST", "/idx/_search/template", body={
+            "source": {"query": {"term": {"color": "{{c}}"}}},
+            "params": {"c": "red"},
+        }))
+        assert r["hits"]["total"] == 1
+
+    def test_stored_template(self, client):
+        client.index("idx", "1", {"n": 5}, refresh="true")
+        ok(client.perform("PUT", "/_scripts/tmpl1", body={
+            "script": {"lang": "mustache",
+                       "source": '{"query": {"range": {"n": {"gte": {{min}}}}}}'},
+        }))
+        r = ok(client.perform("POST", "/idx/_search/template", body={
+            "id": "tmpl1", "params": {"min": 3},
+        }))
+        assert r["hits"]["total"] == 1
+
+    def test_render(self, client):
+        r = ok(client.perform("POST", "/_render/template", body={
+            "source": {"query": {"match": {"f": "{{v}}"}}},
+            "params": {"v": "x y"},
+        }))
+        assert r["template_output"] == {"query": {"match": {"f": "x y"}}}
+
+    def test_tojson(self, client):
+        r = ok(client.perform("POST", "/_render/template", body={
+            "source": '{"query": {"terms": {"tag": {{#toJson}}tags{{/toJson}}}}}',
+            "params": {"tags": ["a", "b"]},
+        }))
+        assert r["template_output"]["query"]["terms"]["tag"] == ["a", "b"]
+
+
+class TestTermvectors:
+    def test_termvectors(self, client):
+        client.index("idx", "1", {"body": "quick quick fox"}, refresh="true")
+        r = ok(client.perform("GET", "/idx/_termvectors/1"))
+        assert r["found"]
+        terms = r["term_vectors"]["body"]["terms"]
+        assert terms["quick"]["term_freq"] == 2
+        assert [t["position"] for t in terms["quick"]["tokens"]] == [0, 1]
+        assert terms["fox"]["doc_freq"] == 1
+
+    def test_missing_doc(self, client):
+        client.index("idx", "1", {"a": "x"}, refresh="true")
+        r = ok(client.perform("GET", "/idx/_termvectors/404"))
+        assert not r["found"]
+
+
+class TestRollover:
+    def test_rollover_by_docs(self, client):
+        ok(client.perform("PUT", "/logs-000001", body={"aliases": {"logs": {}}}))
+        for i in range(3):
+            client.index("logs", str(i), {"n": i}, refresh="true")
+        # condition not met
+        r = ok(client.perform("POST", "/logs/_rollover", body={
+            "conditions": {"max_docs": 100}}))
+        assert not r["rolled_over"]
+        # condition met
+        r = ok(client.perform("POST", "/logs/_rollover", body={
+            "conditions": {"max_docs": 2}}))
+        assert r["rolled_over"]
+        assert r["new_index"] == "logs-000002"
+        # alias moved: writes go to the new index
+        client.index("logs", "x", {"n": 9}, refresh="true")
+        status, sr = client.search("logs-000002", {})
+        assert sr["hits"]["total"] == 1
+
+    def test_dry_run(self, client):
+        ok(client.perform("PUT", "/logs-000001", body={"aliases": {"logs": {}}}))
+        r = ok(client.perform("POST", "/logs/_rollover", {"dry_run": ""},
+                              {"conditions": {"max_docs": 0}}))
+        assert not r["rolled_over"] and r["dry_run"]
+
+
+class TestShrink:
+    def test_shrink_to_one_shard(self, client):
+        ok(client.perform("PUT", "/big", body={
+            "settings": {"index": {"number_of_shards": 4}}}))
+        for i in range(20):
+            client.index("big", str(i), {"n": i})
+        client.perform("POST", "/big/_refresh")
+        r = ok(client.perform("POST", "/big/_shrink/small", body={
+            "settings": {"index": {"number_of_shards": 1}}}))
+        assert r["acknowledged"]
+        status, sr = client.search("small", {"size": 0})
+        assert sr["hits"]["total"] == 20
+        assert sr["_shards"]["total"] == 1
+
+
+class TestPercolate:
+    def test_percolate_matches_stored_queries(self, client):
+        ok(client.perform("PUT", "/queries", body={
+            "mappings": {"properties": {
+                "query": {"type": "percolator"},
+                "body": {"type": "text"},
+            }},
+        }))
+        client.index("queries", "q1", {"query": {"match": {"body": "fox"}}})
+        client.index("queries", "q2", {"query": {"match": {"body": "turtle"}}})
+        client.index("queries", "q3", {"query": {"range": {"price": {"gte": 100}}}})
+        client.perform("POST", "/queries/_refresh")
+        status, r = client.search("queries", {"query": {"percolate": {
+            "field": "query",
+            "document": {"body": "a quick fox jumped", "price": 150},
+        }}})
+        got = {h["_id"] for h in r["hits"]["hits"]}
+        assert got == {"q1", "q3"}
+
+
+class TestHotThreads:
+    def test_hot_threads_dump(self, client):
+        status, text = client.perform("GET", "/_nodes/hot_threads")
+        assert status == 200
+        assert "thread id" in text
