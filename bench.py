@@ -158,18 +158,18 @@ def main():
                     q_norm_rows, q_avgdl, q_valid):
         docs = block_docs[q_blocks]
         tfs = block_tfs[q_blocks]
-        doc_len = norms[q_norm_rows[:, None], docs]
+        nd1 = norms.shape[1]
+        flat_idx = (q_norm_rows[:, None] * nd1 + docs).ravel()
+        doc_len = norms.ravel()[flat_idx].reshape(docs.shape)
         denom = tfs + K1 * (1.0 - B + B * doc_len / q_avgdl[:, None])
         matched_blk = (tfs > 0.0) & q_valid[:, None]
         contrib = jnp.where(
             matched_blk, q_weights[:, None] * tfs * (K1 + 1.0) / denom, 0.0
         )
-        nd1 = norms.shape[1]
+        # single scatter: BM25 contributions are strictly positive, so
+        # scores > 0 is exactly "matched" for a disjunction
         scores = jnp.zeros((nd1,), jnp.float32).at[docs].add(contrib)
-        counts = jnp.zeros((nd1,), jnp.float32).at[docs].add(
-            matched_blk.astype(jnp.float32)
-        )
-        masked = jnp.where((counts > 0) & live1, scores, -jnp.inf)
+        masked = jnp.where((scores > 0) & live1, scores, -jnp.inf)
         return lax.top_k(masked, K)
 
     # stage corpus to HBM once (shard-open staging)
@@ -265,8 +265,8 @@ def main():
             "blocking_p50_ms_incl_tunnel_rtt": round(blocking_p50, 3),
             "n_docs": N_DOCS,
             "recall_at_10": 1.0,
-            "method": "pipelined batches of 10 (amortized device time); "
-                      "single fixed-shape compiled program",
+            "method": "chained back-to-back execution (amortized device "
+                      "service time); single fixed-shape compiled program",
         },
     }))
 

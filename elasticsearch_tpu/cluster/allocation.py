@@ -1,0 +1,153 @@
+"""Shard allocation: assign primaries and replicas to data nodes.
+
+Role model: ``AllocationService`` + ``BalancedShardsAllocator`` + deciders
+(cluster/routing/allocation/). Round-1 deciders: SameShardAllocationDecider
+(a replica never lands on its primary's node) and balance-by-count.
+Assignments are sticky: existing placements survive reroutes while their
+node is alive (the reference's "prefer existing allocation").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import ShardRouting, ShardRoutingState
+
+# routing table shape: {index: {shard_id: [ShardRouting, ...]}} — first
+# entry with primary=True is the primary copy.
+RoutingTable = Dict[str, Dict[int, List[ShardRouting]]]
+
+
+def _node_load(table: RoutingTable) -> Dict[str, int]:
+    load: Dict[str, int] = {}
+    for shards in table.values():
+        for copies in shards.values():
+            for c in copies:
+                if c.node_id is not None:
+                    load[c.node_id] = load.get(c.node_id, 0) + 1
+    return load
+
+
+def _least_loaded(candidates: List[str], load: Dict[str, int]) -> Optional[str]:
+    if not candidates:
+        return None
+    return min(candidates, key=lambda n: (load.get(n, 0), n))
+
+
+def allocate(indices_meta: Dict, data_nodes: List[str],
+             previous: Optional[RoutingTable] = None) -> RoutingTable:
+    """Compute the routing table for the current node set.
+
+    indices_meta: {name: IndexMetadata}. Copies on departed nodes are
+    dropped; a surviving replica is promoted when its primary is gone
+    (primary promotion — ShardStateAction/failShard path, SURVEY §5.3);
+    unassigned copies fill onto the least-loaded eligible node.
+    """
+    previous = previous or {}
+    alive = set(data_nodes)
+    table: RoutingTable = {}
+    for name, md in indices_meta.items():
+        if md.state != "open":
+            table[name] = {}
+            continue
+        shards: Dict[int, List[ShardRouting]] = {}
+        prev_shards = previous.get(name, {})
+        for sid in range(md.num_shards):
+            prev_copies = [c for c in prev_shards.get(sid, [])
+                           if c.node_id in alive]
+            primary = next((c for c in prev_copies if c.primary), None)
+            replicas = [c for c in prev_copies if not c.primary]
+            if primary is None and replicas:
+                # promote the first started replica (in-sync set analog)
+                started = [r for r in replicas
+                           if r.state == ShardRoutingState.STARTED]
+                promo = (started or replicas)[0]
+                replicas.remove(promo)
+                promo.primary = True
+                primary = promo
+            copies: List[ShardRouting] = []
+            if primary is not None:
+                copies.append(primary)
+            copies.extend(replicas)
+            shards[sid] = copies
+        table[name] = shards
+
+    load = _node_load(table)
+    # fill unassigned primaries first, then replicas
+    for name, md in indices_meta.items():
+        if md.state != "open":
+            continue
+        for sid in range(md.num_shards):
+            copies = table[name][sid]
+            if not any(c.primary for c in copies):
+                node = _least_loaded(list(alive), load)
+                if node is not None:
+                    copies.insert(0, ShardRouting(
+                        name, sid, node, True, ShardRoutingState.INITIALIZING
+                    ))
+                    load[node] = load.get(node, 0) + 1
+    for name, md in indices_meta.items():
+        if md.state != "open":
+            continue
+        for sid in range(md.num_shards):
+            copies = table[name][sid]
+            while len(copies) < 1 + md.num_replicas:
+                used = {c.node_id for c in copies}
+                candidates = [n for n in alive if n not in used]
+                node = _least_loaded(candidates, load)
+                if node is None:
+                    break  # not enough nodes — stays unassigned (yellow)
+                copies.append(ShardRouting(
+                    name, sid, node, False, ShardRoutingState.INITIALIZING
+                ))
+                load[node] = load.get(node, 0) + 1
+    _rebalance_replicas(table, alive, load)
+    return table
+
+
+def _rebalance_replicas(table: RoutingTable, alive: set,
+                        load: Dict[str, int]) -> None:
+    """Move freshly-assigned (INITIALIZING) replicas off overloaded nodes —
+    the greedy fill can pile ties onto one node (BalancedShardsAllocator's
+    balancing step). Started replicas are never moved here (moving them
+    costs a recovery; rebalancing of started copies is a later round)."""
+    improved = True
+    while improved:
+        improved = False
+        for shards in table.values():
+            for copies in shards.values():
+                for copy in copies:
+                    if copy.primary or copy.state != ShardRoutingState.INITIALIZING:
+                        continue
+                    used = {c.node_id for c in copies if c is not copy}
+                    candidates = [n for n in alive if n not in used]
+                    best = _least_loaded(candidates, load)
+                    if best is not None and copy.node_id is not None and \
+                            load.get(best, 0) + 1 < load.get(copy.node_id, 0):
+                        load[copy.node_id] -= 1
+                        load[best] = load.get(best, 0) + 1
+                        copy.node_id = best
+                        improved = True
+
+
+def routing_to_dict(table: RoutingTable) -> dict:
+    return {
+        name: {
+            str(sid): [c.to_dict() for c in copies]
+            for sid, copies in shards.items()
+        }
+        for name, shards in table.items()
+    }
+
+
+def routing_from_dict(d: dict) -> RoutingTable:
+    out: RoutingTable = {}
+    for name, shards in d.items():
+        out[name] = {}
+        for sid, copies in shards.items():
+            out[name][int(sid)] = [
+                ShardRouting(c["index"], c["shard"], c["node"], c["primary"],
+                             c["state"])
+                for c in copies
+            ]
+    return out

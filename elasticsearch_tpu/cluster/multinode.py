@@ -1,0 +1,625 @@
+"""Multi-node cluster: membership, state publish, replication, recovery.
+
+Role models (SURVEY §3.3–3.5, §5.3):
+- membership/publish: ``ZenDiscovery`` + ``PublishClusterStateAction`` —
+  simplified to single-master-by-lowest-id (ElectMasterService's sort) with
+  direct state publish (the two-phase commit degenerates in-process;
+  quorum arrives with real DCN in a later round, per SURVEY §7.3 "start
+  single-master, defer election").
+- writes: ``TransportReplicationAction``/``ReplicationOperation`` — primary
+  assigns seqno, forwards to in-sync replicas, failing replicas are
+  reported to the master (fail-shard) and dropped from the routing table.
+- recovery: ``RecoverySourceHandler`` — ops-based: the primary streams its
+  live docs as seqno-stamped ops (phase2 replay); the replica indexes them
+  and is marked STARTED.
+- failover: master detects a departed node (transport failure / explicit
+  leave), reroutes: surviving replica promoted to primary with a bumped
+  primary term.
+
+Each ClusterNode hosts only the shards routed to it. A coordinator-side
+search fans out per shard copy and merges — hits are fully materialized at
+the shard (query+fetch combined; the reference's two-phase fetch is an
+optimization this path adds later).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.cluster.allocation import (
+    RoutingTable,
+    allocate,
+    routing_from_dict,
+    routing_to_dict,
+)
+from elasticsearch_tpu.cluster.state import (
+    IndexMetadata,
+    ShardRouting,
+    ShardRoutingState,
+)
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    IndexNotFoundException,
+    NodeNotConnectedException,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.shard import IndexShard
+from elasticsearch_tpu.mapper.mapping import MapperService
+from elasticsearch_tpu.transport.local import TransportHub, TransportService
+from elasticsearch_tpu.utils.murmur3 import shard_id_for
+
+ACTION_PUBLISH = "internal:cluster/coordination/publish_state"
+ACTION_JOIN = "internal:discovery/zen/join"
+ACTION_SHARD_FAILED = "internal:cluster/shard/failure"
+ACTION_SHARD_STARTED = "internal:cluster/shard/started"
+ACTION_WRITE_PRIMARY = "indices:data/write/bulk[s][p]"
+ACTION_WRITE_REPLICA = "indices:data/write/bulk[s][r]"
+ACTION_GET = "indices:data/read/get[s]"
+ACTION_QUERY = "indices:data/read/search[phase/query+fetch]"
+ACTION_REFRESH = "indices:admin/refresh[s]"
+ACTION_RECOVER = "internal:index/shard/recovery/start_recovery"
+
+
+class ClusterNode:
+    """One node of the in-process cluster (a real Node analog hosting only
+    its allocated shards)."""
+
+    def __init__(self, name: str, hub: TransportHub, master_eligible: bool = True,
+                 data: bool = True):
+        self.name = name
+        self.node_id = name  # stable, human-readable ids make tests clear
+        self.master_eligible = master_eligible
+        self.data = data
+        self.transport = TransportService(self.node_id, hub)
+        self.hub = hub
+        # cluster-state copy (every node holds the latest published state)
+        self.state_version = 0
+        self.indices_meta: Dict[str, IndexMetadata] = {}
+        self.routing: RoutingTable = {}
+        self.known_nodes: List[str] = []
+        self.master_id: Optional[str] = None
+        # local shards: (index, shard_id) -> IndexShard
+        self.shards: Dict[Tuple[str, int], IndexShard] = {}
+        self.mappers: Dict[str, MapperService] = {}
+        self._lock = threading.RLock()
+        self._register_handlers()
+
+    # ------------------------------------------------------------------
+
+    def _register_handlers(self) -> None:
+        t = self.transport
+        t.register_handler(ACTION_PUBLISH, self._on_publish)
+        t.register_handler(ACTION_JOIN, self._on_join)
+        t.register_handler(ACTION_SHARD_FAILED, self._on_shard_failed)
+        t.register_handler(ACTION_SHARD_STARTED, self._on_shard_started)
+        t.register_handler(ACTION_WRITE_PRIMARY, self._on_write_primary)
+        t.register_handler(ACTION_WRITE_REPLICA, self._on_write_replica)
+        t.register_handler(ACTION_GET, self._on_get)
+        t.register_handler(ACTION_QUERY, self._on_query)
+        t.register_handler(ACTION_REFRESH, self._on_refresh)
+        t.register_handler(ACTION_RECOVER, self._on_start_recovery)
+
+    @property
+    def is_master(self) -> bool:
+        return self.master_id == self.node_id
+
+    # ------------------------------------------------------------------
+    # Master-side: membership + state updates
+    # ------------------------------------------------------------------
+
+    def bootstrap_cluster(self) -> None:
+        """First node: elect self."""
+        with self._lock:
+            self.master_id = self.node_id
+            self.known_nodes = [self.node_id]
+            self.state_version = 1
+
+    def join(self, seed_node: str) -> None:
+        """Join via any known node (UnicastZenPing seed analog)."""
+        resp = self.transport.send_request(seed_node, ACTION_JOIN, {
+            "node": self.node_id,
+            "master_eligible": self.master_eligible,
+            "data": self.data,
+        })
+        if resp.get("master") != seed_node:
+            # redirected to the actual master
+            self.transport.send_request(resp["master"], ACTION_JOIN, {
+                "node": self.node_id,
+                "master_eligible": self.master_eligible,
+                "data": self.data,
+            })
+
+    def _on_join(self, payload, src) -> dict:
+        with self._lock:
+            if not self.is_master:
+                return {"master": self.master_id}
+            node = payload["node"]
+            if node not in self.known_nodes:
+                self.known_nodes.append(node)
+            self._master_reroute_and_publish()
+            return {"master": self.node_id}
+
+    def node_left(self, departed: str) -> None:
+        """Master-side removal (fault detection outcome or explicit leave)."""
+        with self._lock:
+            if not self.is_master:
+                raise IllegalArgumentException("node_left must run on the master")
+            if departed in self.known_nodes:
+                self.known_nodes.remove(departed)
+            self._master_reroute_and_publish()
+
+    def check_nodes(self) -> List[str]:
+        """Fault detection (NodesFaultDetection): master pings all nodes;
+        unreachable ones are removed. Returns departed node ids."""
+        departed = []
+        with self._lock:
+            if not self.is_master:
+                return []
+            for node in list(self.known_nodes):
+                if node == self.node_id:
+                    continue
+                try:
+                    self.transport.send_request(node, ACTION_PUBLISH, None)
+                except NodeNotConnectedException:
+                    departed.append(node)
+        for node in departed:
+            self.node_left(node)
+        return departed
+
+    def create_index(self, name: str, settings: Optional[dict] = None,
+                     mappings: Optional[dict] = None) -> dict:
+        with self._lock:
+            if not self.is_master:
+                raise IllegalArgumentException(
+                    "create_index must be sent to the master"
+                )
+            if name in self.indices_meta:
+                from elasticsearch_tpu.common.errors import IndexAlreadyExistsException
+
+                raise IndexAlreadyExistsException(name)
+            md = IndexMetadata(
+                name, Settings.from_dict(settings or {}), mappings or {"properties": {}},
+                creation_date=int(time.time() * 1000),
+            )
+            self.indices_meta[name] = md
+            self._master_reroute_and_publish()
+            return {"acknowledged": True, "index": name}
+
+    def delete_index(self, name: str) -> dict:
+        with self._lock:
+            if not self.is_master:
+                raise IllegalArgumentException("delete_index must run on master")
+            if name not in self.indices_meta:
+                raise IndexNotFoundException(name)
+            del self.indices_meta[name]
+            self.routing.pop(name, None)
+            self._master_reroute_and_publish()
+            return {"acknowledged": True}
+
+    def _master_reroute_and_publish(self) -> None:
+        data_nodes = [n for n in self.known_nodes]  # all nodes are data nodes here
+        self.routing = allocate(self.indices_meta, data_nodes, self.routing)
+        self.state_version += 1
+        state = self._state_dict()
+        for node in list(self.known_nodes):
+            if node == self.node_id:
+                continue
+            try:
+                self.transport.send_request(node, ACTION_PUBLISH, state)
+            except NodeNotConnectedException:
+                pass  # fault detection will remove it
+        self._apply_state(state)
+
+    def _state_dict(self) -> dict:
+        return {
+            "version": self.state_version,
+            "master": self.master_id,
+            "nodes": list(self.known_nodes),
+            "indices": {
+                name: {
+                    "settings": md.settings.as_dict(),
+                    "mappings": md.mappings,
+                    "state": md.state,
+                }
+                for name, md in self.indices_meta.items()
+            },
+            "routing": routing_to_dict(self.routing),
+        }
+
+    # ------------------------------------------------------------------
+    # Applier side (IndicesClusterStateService.applyClusterState analog)
+    # ------------------------------------------------------------------
+
+    def _on_publish(self, payload, src) -> dict:
+        if payload is None:
+            return {"ok": True}  # ping
+        self._apply_state(payload)
+        return {"ok": True, "version": payload["version"]}
+
+    def _apply_state(self, state: dict) -> None:
+        with self._lock:
+            if state["version"] < self.state_version and state["master"] == self.master_id:
+                return  # stale
+            self.state_version = state["version"]
+            self.master_id = state["master"]
+            self.known_nodes = list(state["nodes"])
+            self.indices_meta = {
+                name: IndexMetadata(
+                    name, Settings(info["settings"]), info["mappings"],
+                    state=info.get("state", "open"),
+                )
+                for name, info in state["indices"].items()
+            }
+            self.routing = routing_from_dict(state["routing"])
+            self._reconcile_shards()
+
+    def _mapper_for(self, index: str) -> MapperService:
+        if index not in self.mappers:
+            md = self.indices_meta[index]
+            self.mappers[index] = MapperService(
+                AnalysisRegistry(md.settings), md.mappings
+            )
+        return self.mappers[index]
+
+    def _reconcile_shards(self) -> None:
+        """Create/remove/promote local shards to match the routing table
+        (IndicesClusterStateService: createOrUpdateShards/removeShards)."""
+        wanted: Dict[Tuple[str, int], ShardRouting] = {}
+        for index, shards in self.routing.items():
+            for sid, copies in shards.items():
+                for copy in copies:
+                    if copy.node_id == self.node_id:
+                        wanted[(index, sid)] = copy
+        # remove shards no longer ours
+        for key in list(self.shards):
+            if key not in wanted or key[0] not in self.indices_meta:
+                self.shards.pop(key).close()
+        # create / update
+        for (index, sid), copy in wanted.items():
+            shard = self.shards.get((index, sid))
+            if shard is None:
+                shard = IndexShard(index, sid, self._mapper_for(index),
+                                   primary=copy.primary)
+                shard.start_fresh()
+                self.shards[(index, sid)] = shard
+                if copy.state == ShardRoutingState.INITIALIZING:
+                    if copy.primary:
+                        # fresh primary starts empty and reports started
+                        self._report_started(index, sid)
+                    else:
+                        self._recover_replica(index, sid)
+            else:
+                if copy.primary and not shard.primary:
+                    # replica promoted: bump primary term (fencing)
+                    shard.primary = True
+                    shard.primary_term += 1
+                elif copy.state == ShardRoutingState.INITIALIZING and not copy.primary:
+                    self._recover_replica(index, sid)
+
+    def _primary_node(self, index: str, sid: int) -> Optional[str]:
+        for copy in self.routing.get(index, {}).get(sid, []):
+            if copy.primary:
+                return copy.node_id
+        return None
+
+    # ------------------------------------------------------------------
+    # Recovery (ops-based peer recovery, §3.5)
+    # ------------------------------------------------------------------
+
+    def _recover_replica(self, index: str, sid: int) -> None:
+        primary_node = self._primary_node(index, sid)
+        if primary_node is None or primary_node == self.node_id:
+            return
+        try:
+            resp = self.transport.send_request(primary_node, ACTION_RECOVER, {
+                "index": index, "shard": sid, "target": self.node_id,
+            })
+        except (NodeNotConnectedException, ElasticsearchTpuException):
+            return  # next reroute retries
+        shard = self.shards[(index, sid)]
+        for op in resp["ops"]:
+            if op["op"] == "index":
+                shard.engine.index(
+                    op["id"], op["source"], op.get("routing"),
+                    seqno=op["seq_no"], add_to_translog=True,
+                )
+                shard.engine.version_map[op["id"]].version = op["version"]
+        shard.refresh()
+        self._report_started(index, sid)
+
+    def _on_start_recovery(self, payload, src) -> dict:
+        """Primary side: stream live docs as seqno-stamped ops (phase2)."""
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        if shard is None or not shard.primary:
+            raise ElasticsearchTpuException(
+                f"recovery source is not the primary for "
+                f"[{payload['index']}][{payload['shard']}]"
+            )
+        shard.refresh()
+        ops = []
+        for seg in shard.engine.searchable_segments():
+            for local in range(seg.num_docs):
+                if seg.live[local]:
+                    ops.append({
+                        "op": "index",
+                        "id": seg.doc_ids[local],
+                        "source": seg.sources[local],
+                        "routing": seg.routings[local],
+                        "seq_no": int(seg.seqnos[local]),
+                        "version": int(seg.versions[local]),
+                    })
+        return {"ops": ops, "max_seq_no": shard.engine.max_seqno}
+
+    def _report_started(self, index: str, sid: int) -> None:
+        try:
+            self.transport.send_request(self.master_id, ACTION_SHARD_STARTED, {
+                "index": index, "shard": sid, "node": self.node_id,
+            })
+        except NodeNotConnectedException:
+            pass
+
+    def _on_shard_started(self, payload, src) -> dict:
+        with self._lock:
+            if not self.is_master:
+                return {"ok": False}
+            for copy in self.routing.get(payload["index"], {}).get(payload["shard"], []):
+                if copy.node_id == payload["node"]:
+                    copy.state = ShardRoutingState.STARTED
+            self.state_version += 1
+            state = self._state_dict()
+        for node in list(self.known_nodes):
+            if node != self.node_id:
+                try:
+                    self.transport.send_request(node, ACTION_PUBLISH, state)
+                except NodeNotConnectedException:
+                    pass
+        self._apply_state(state)
+        return {"ok": True}
+
+    def _on_shard_failed(self, payload, src) -> dict:
+        """Primary reports a failed replica copy; master drops it from the
+        routing table and reroutes (ShardStateAction.shardFailed)."""
+        with self._lock:
+            if not self.is_master:
+                return {"ok": False}
+            copies = self.routing.get(payload["index"], {}).get(payload["shard"], [])
+            self.routing[payload["index"]][payload["shard"]] = [
+                c for c in copies if c.node_id != payload["node"]
+            ]
+            self._master_reroute_and_publish()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # Write path (ReplicationOperation, §3.3)
+    # ------------------------------------------------------------------
+
+    def _on_write_primary(self, payload, src) -> dict:
+        index, sid = payload["index"], payload["shard"]
+        shard = self.shards.get((index, sid))
+        if shard is None or not shard.primary:
+            raise ElasticsearchTpuException(
+                f"[{index}][{sid}] primary is not allocated on [{self.node_id}]"
+            )
+        if payload["op"] == "index":
+            result = shard.index_doc(payload["id"], payload["source"],
+                                     payload.get("routing"))
+        else:
+            result = shard.delete_doc(payload["id"])
+        # fan out to replicas with the primary-assigned seqno + version
+        replica_payload = dict(payload)
+        replica_payload["seq_no"] = result["_seq_no"]
+        replica_payload["version"] = result["_version"]
+        replica_payload["primary_term"] = shard.primary_term
+        acks = 1
+        for copy in self.routing.get(index, {}).get(sid, []):
+            if copy.primary or copy.state != ShardRoutingState.STARTED:
+                continue
+            try:
+                self.transport.send_request(copy.node_id, ACTION_WRITE_REPLICA,
+                                            replica_payload)
+                acks += 1
+            except (NodeNotConnectedException, ElasticsearchTpuException):
+                # fail the copy on the master and continue (§5.3)
+                try:
+                    self.transport.send_request(self.master_id, ACTION_SHARD_FAILED, {
+                        "index": index, "shard": sid, "node": copy.node_id,
+                    })
+                except NodeNotConnectedException:
+                    pass
+        result["_shards"] = {"total": len(self.routing.get(index, {}).get(sid, [])),
+                             "successful": acks, "failed": 0}
+        return result
+
+    def _on_write_replica(self, payload, src) -> dict:
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        if shard is None:
+            raise ElasticsearchTpuException(
+                f"replica shard [{payload['index']}][{payload['shard']}] not "
+                f"allocated on [{self.node_id}]"
+            )
+        if payload.get("primary_term", 1) < shard.primary_term:
+            # stale primary (fencing, IndexShardOperationPermits analog)
+            raise ElasticsearchTpuException("operation primary term is too old")
+        if payload["op"] == "index":
+            shard.engine.index(payload["id"], payload["source"],
+                               payload.get("routing"), seqno=payload["seq_no"])
+            shard.engine.version_map[payload["id"]].version = payload["version"]
+        else:
+            shard.engine.delete(payload["id"], seqno=payload["seq_no"])
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # Read path
+    # ------------------------------------------------------------------
+
+    def _on_get(self, payload, src) -> dict:
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        if shard is None:
+            raise ElasticsearchTpuException("shard not allocated here")
+        g = shard.get_doc(payload["id"])
+        return {
+            "found": g.found,
+            "_id": payload["id"],
+            "_source": g.source,
+            "_version": g.version,
+            "_seq_no": g.seqno,
+        }
+
+    def _on_query(self, payload, src) -> dict:
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        if shard is None:
+            raise ElasticsearchTpuException("shard not allocated here")
+        body = payload["body"] or {}
+        from elasticsearch_tpu.search.service import fetch_hits
+
+        result = shard.searcher.query(body, size_hint=payload.get("k", 10))
+        hits = fetch_hits(result.refs, {shard.shard_id: shard}, body,
+                          payload["index"])
+        for ref, hit in zip(result.refs, hits):
+            hit["_sort_tuple"] = list(ref.sort_values)
+        return {
+            "total": result.total_hits,
+            "max_score": result.max_score,
+            "hits": hits,
+        }
+
+    def _on_refresh(self, payload, src) -> dict:
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        if shard is not None:
+            shard.refresh()
+        return {"ok": True}
+
+    def close(self) -> None:
+        for shard in self.shards.values():
+            shard.close()
+        self.transport.close()
+
+
+class ClusterClient:
+    """Coordinator-side API over the cluster (any node can coordinate —
+    here the client picks routes directly from its node's state copy)."""
+
+    def __init__(self, node: ClusterNode):
+        self.node = node
+
+    def _routing_entry(self, index: str, doc_id: str,
+                       routing: Optional[str]) -> Tuple[int, str]:
+        md = self.node.indices_meta.get(index)
+        if md is None:
+            raise IndexNotFoundException(index)
+        sid = shard_id_for(routing if routing is not None else doc_id,
+                           md.num_shards)
+        primary = self.node._primary_node(index, sid)
+        if primary is None:
+            raise ElasticsearchTpuException(
+                f"primary shard [{index}][{sid}] is unassigned"
+            )
+        return sid, primary
+
+    def index(self, index: str, doc_id: str, source: dict,
+              routing: Optional[str] = None) -> dict:
+        sid, primary = self._routing_entry(index, doc_id, routing)
+        return self.node.transport.send_request(primary, ACTION_WRITE_PRIMARY, {
+            "op": "index", "index": index, "shard": sid, "id": doc_id,
+            "source": source, "routing": routing,
+        })
+
+    def delete(self, index: str, doc_id: str) -> dict:
+        sid, primary = self._routing_entry(index, doc_id, None)
+        return self.node.transport.send_request(primary, ACTION_WRITE_PRIMARY, {
+            "op": "delete", "index": index, "shard": sid, "id": doc_id,
+        })
+
+    def get(self, index: str, doc_id: str, prefer_replica: bool = False) -> dict:
+        md = self.node.indices_meta.get(index)
+        if md is None:
+            raise IndexNotFoundException(index)
+        sid = shard_id_for(doc_id, md.num_shards)
+        copies = [c for c in self.node.routing[index][sid]
+                  if c.state == ShardRoutingState.STARTED]
+        if prefer_replica:
+            copies.sort(key=lambda c: c.primary)
+        else:
+            copies.sort(key=lambda c: not c.primary)
+        for copy in copies:
+            try:
+                return self.node.transport.send_request(copy.node_id, ACTION_GET, {
+                    "index": index, "shard": sid, "id": doc_id,
+                })
+            except NodeNotConnectedException:
+                continue
+        raise ElasticsearchTpuException(f"no available copy for [{index}][{sid}]")
+
+    def refresh(self, index: str) -> None:
+        for sid, copies in self.node.routing.get(index, {}).items():
+            for copy in copies:
+                try:
+                    self.node.transport.send_request(copy.node_id, ACTION_REFRESH, {
+                        "index": index, "shard": sid,
+                    })
+                except NodeNotConnectedException:
+                    pass
+
+    def search(self, index: str, body: Optional[dict] = None) -> dict:
+        """Scatter-gather across one STARTED copy per shard (§3.2)."""
+        body = body or {}
+        md = self.node.indices_meta.get(index)
+        if md is None:
+            raise IndexNotFoundException(index)
+        from_ = int(body.get("from", 0) or 0)
+        size = int(body.get("size", 10) if body.get("size") is not None else 10)
+        k = from_ + size
+        total = 0
+        max_score = None
+        all_hits = []
+        shard_count = 0
+        failures = []
+        for sid, copies in sorted(self.node.routing.get(index, {}).items()):
+            started = [c for c in copies if c.state == ShardRoutingState.STARTED]
+            started.sort(key=lambda c: not c.primary)
+            shard_count += 1
+            resp = None
+            for copy in started:  # adaptive copy selection: fail over
+                try:
+                    resp = self.node.transport.send_request(
+                        copy.node_id, ACTION_QUERY,
+                        {"index": index, "shard": sid, "body": body, "k": max(k, 1)},
+                    )
+                    break
+                except NodeNotConnectedException:
+                    continue
+            if resp is None:
+                failures.append({"shard": sid, "index": index,
+                                 "reason": "no available shard copy"})
+                continue
+            total += resp["total"]
+            if resp["max_score"] is not None:
+                max_score = (resp["max_score"] if max_score is None
+                             else max(max_score, resp["max_score"]))
+            all_hits.extend(resp["hits"])
+        sort_present = body.get("sort") is not None
+        if sort_present:
+            all_hits.sort(key=lambda h: tuple(h.get("_sort_tuple", [])))
+        else:
+            all_hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        for h in all_hits:
+            h.pop("_sort_tuple", None)
+        resp = {
+            "took": 0,
+            "timed_out": False,
+            "_shards": {"total": shard_count,
+                        "successful": shard_count - len(failures),
+                        "failed": len(failures)},
+            "hits": {
+                "total": total,
+                "max_score": max_score,
+                "hits": all_hits[from_: from_ + size],
+            },
+        }
+        if failures:
+            resp["_shards"]["failures"] = failures
+        return resp

@@ -58,12 +58,15 @@ def score_term_blocks(
     """
     docs = block_docs[q_blocks]  # [QB, BLOCK]
     tfs = block_tfs[q_blocks]  # [QB, BLOCK]
-    doc_len = norms[q_norm_rows[:, None], docs]  # [QB, BLOCK]
+    nd1 = norms.shape[1]
+    # flat 1-D gather — 2-D advanced indexing lowers to a slower general
+    # gather on TPU (measured ~1.6x on the whole query program)
+    flat_idx = (q_norm_rows[:, None] * nd1 + docs).ravel()
+    doc_len = norms.ravel()[flat_idx].reshape(docs.shape)
     denom = tfs + k1 * (1.0 - b + b * doc_len / q_avgdl[:, None])
     contrib = q_weights[:, None] * tfs * (k1 + 1.0) / denom
     matched = (tfs > 0.0) & q_valid[:, None]
     contrib = jnp.where(matched, contrib, 0.0)
-    nd1 = norms.shape[1]
     scores = jnp.zeros((nd1,), jnp.float32).at[docs].add(
         contrib, mode="drop", unique_indices=False
     )

@@ -180,7 +180,9 @@ def build_distributed_search(mesh: Mesh, k: int, with_histogram: bool = False,
         # ---- local scoring (the per-shard hot loop) ----
         docs = block_docs[q_blocks]
         tfs = block_tfs[q_blocks]
-        doc_len = norms[q_norm_rows[:, None], docs]
+        nd1_ = norms.shape[1]
+        flat_idx = (q_norm_rows[:, None] * nd1_ + docs).ravel()
+        doc_len = norms.ravel()[flat_idx].reshape(docs.shape)
         del q_avgdl  # local avgdl replaced by the DFS-global value
         denom = tfs + K1 * (1.0 - B + B * doc_len / g_avgdl)
         matched_blk = (tfs > 0.0) & q_valid[:, None]

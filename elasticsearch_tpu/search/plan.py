@@ -90,9 +90,16 @@ class ScoreTermsNode(PlanNode):
         self.q_valid = q_valid
         self.min_match = np.float32(min_match)
         self.k1, self.b = k1, b
+        # single-scatter fast path: only when "matched == score > 0" holds,
+        # i.e. plain disjunction AND every live weight strictly positive
+        # (a boost of 0 would make a matching doc score 0)
+        self._fast = bool(min_match <= 1) and bool(
+            (np.asarray(q_weights)[np.asarray(q_valid)] > 0).all()
+        )
 
     def key(self):
-        return f"terms[{len(self.q_blocks)},{self.k1},{self.b}]"
+        # the fast path changes the traced program -> part of the key
+        return f"terms[{len(self.q_blocks)},{self.k1},{self.b},{self._fast}]"
 
     def arrays(self):
         return [self.q_blocks, self.q_weights, self.q_norm_rows, self.q_avgdl,
@@ -102,11 +109,20 @@ class ScoreTermsNode(PlanNode):
         q_blocks, q_weights, q_norm_rows, q_avgdl, q_valid, min_match = ctx.take(6)
         docs = ctx.seg["block_docs"][q_blocks]
         tfs = ctx.seg["block_tfs"][q_blocks]
-        doc_len = ctx.seg["norms"][q_norm_rows[:, None], docs]
+        # flat 1-D gather (2-D advanced indexing lowers to a slower general
+        # gather on TPU)
+        norms = ctx.seg["norms"]
+        nd1 = norms.shape[1]
+        flat_idx = (q_norm_rows[:, None] * nd1 + docs).ravel()
+        doc_len = norms.ravel()[flat_idx].reshape(docs.shape)
         denom = tfs + self.k1 * (1.0 - self.b + self.b * doc_len / q_avgdl[:, None])
         matched = (tfs > 0.0) & q_valid[:, None]
         contrib = jnp.where(matched, q_weights[:, None] * tfs * (self.k1 + 1.0) / denom, 0.0)
         scores = ctx.zeros_f().at[docs].add(contrib)
+        if self._fast:
+            # BM25 contributions are strictly positive, so scores > 0 is
+            # exactly "any term matched" — saves the second scatter
+            return scores, scores > 0.0
         counts = ctx.zeros_f().at[docs].add(matched.astype(jnp.float32))
         return scores, counts >= min_match
 

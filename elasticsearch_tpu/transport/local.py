@@ -1,0 +1,127 @@
+"""In-process node-to-node transport with fault injection.
+
+Role model: ``TransportService``/``TcpTransport`` (core/.../transport/) for
+the request/handler contract, and the test framework's
+``MockTransportService`` + ``NetworkDisruption``
+(test/framework/.../test/transport/MockTransportService.java:91,
+disruption/NetworkDisruption.java:49) for programmable faults. The
+reference's production data plane is Netty sockets; ours is ICI
+collectives inside compiled programs (parallel/distributed.py) — this
+transport carries the *control plane* (cluster state publish, shard-level
+requests between hosts) and is the seam where a gRPC/DCN implementation
+slots in for real multi-host deployments.
+
+Requests are synchronous in-process calls; payloads are JSON-able dicts
+(enforced in strict mode) so the handler contract stays wire-serializable.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    NodeNotConnectedException,
+)
+
+
+class RemoteActionException(ElasticsearchTpuException):
+    """Wraps a failure raised by a remote handler."""
+
+    status_code = 500
+
+
+class TransportHub:
+    """The shared 'network': node registry + disruption rules."""
+
+    def __init__(self, strict_serialization: bool = False):
+        self._nodes: Dict[str, "TransportService"] = {}
+        self._disconnected: Set[Tuple[str, str]] = set()
+        self._delays: Dict[Tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+        self.strict_serialization = strict_serialization
+        self.requests_log: list = []  # (src, dst, action) — CapturingTransport
+
+    def register(self, service: "TransportService") -> None:
+        with self._lock:
+            self._nodes[service.node_id] = service
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def nodes(self) -> Dict[str, "TransportService"]:
+        with self._lock:
+            return dict(self._nodes)
+
+    # --- disruption schemes (NetworkDisruption behaviors) ---
+
+    def disconnect(self, a: str, b: Optional[str] = None) -> None:
+        """Break a<->b, or isolate `a` from everyone."""
+        with self._lock:
+            targets = [b] if b else [n for n in self._nodes if n != a]
+            for t in targets:
+                self._disconnected.add((a, t))
+                self._disconnected.add((t, a))
+
+    def heal(self, a: Optional[str] = None) -> None:
+        with self._lock:
+            if a is None:
+                self._disconnected.clear()
+                self._delays.clear()
+            else:
+                self._disconnected = {
+                    (x, y) for x, y in self._disconnected if a not in (x, y)
+                }
+
+    def add_delay(self, a: str, b: str, seconds: float) -> None:
+        with self._lock:
+            self._delays[(a, b)] = seconds
+
+    def deliver(self, src: str, dst: str, action: str, payload: Any) -> Any:
+        with self._lock:
+            if (src, dst) in self._disconnected:
+                raise NodeNotConnectedException(
+                    f"[{dst}] disconnected from [{src}]"
+                )
+            service = self._nodes.get(dst)
+            delay = self._delays.get((src, dst), 0.0)
+            self.requests_log.append((src, dst, action))
+        if service is None:
+            raise NodeNotConnectedException(f"node [{dst}] is not in the cluster")
+        if delay:
+            time.sleep(delay)
+        if self.strict_serialization:
+            payload = json.loads(json.dumps(payload))
+        return service.handle(action, payload, src)
+
+
+class TransportService:
+    def __init__(self, node_id: str, hub: TransportHub):
+        self.node_id = node_id
+        self.hub = hub
+        self._handlers: Dict[str, Callable[[Any, str], Any]] = {}
+        hub.register(self)
+
+    def register_handler(self, action: str, handler: Callable[[Any, str], Any]) -> None:
+        """handler(payload, source_node_id) -> response."""
+        self._handlers[action] = handler
+
+    def handle(self, action: str, payload: Any, src: str) -> Any:
+        handler = self._handlers.get(action)
+        if handler is None:
+            raise RemoteActionException(
+                f"node [{self.node_id}] has no handler for action [{action}]"
+            )
+        return handler(payload, src)
+
+    def send_request(self, target: str, action: str, payload: Any) -> Any:
+        if target == self.node_id:
+            return self.handle(action, payload, self.node_id)
+        return self.hub.deliver(self.node_id, target, action, payload)
+
+    def close(self) -> None:
+        self.hub.unregister(self.node_id)
