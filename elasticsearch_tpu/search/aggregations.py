@@ -32,9 +32,11 @@ from elasticsearch_tpu.ops import aggs as agg_ops
 # ---------------------------------------------------------------------------
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
-                "filter", "filters", "global", "missing"}
+                "filter", "filters", "global", "missing", "significant_terms",
+                "sampler", "adjacency_matrix", "geohash_grid"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "stats", "extended_stats",
-                "value_count", "cardinality", "percentiles", "top_hits"}
+                "value_count", "cardinality", "percentiles", "top_hits",
+                "geo_bounds", "geo_centroid", "matrix_stats"}
 PIPELINE_TYPES = {"derivative", "cumulative_sum", "moving_avg", "avg_bucket",
                   "sum_bucket", "min_bucket", "max_bucket", "stats_bucket",
                   "bucket_script", "bucket_selector", "bucket_sort", "serial_diff"}
@@ -100,7 +102,49 @@ def _resolve_ordinal_field(segment, field: str):
     if col is not None:
         return col
     # terms on "myfield" where mapping used text + .keyword multi-field
-    return segment.ordinal_columns.get(f"{field}.keyword")
+    col = segment.ordinal_columns.get(f"{field}.keyword")
+    if col is not None:
+        return col
+    return _text_fielddata(segment, field)
+
+
+def _text_fielddata(segment, field: str):
+    """Build (and cache) an ordinal view of a text field from its postings
+    — the reference's heap-loaded text fielddata (index/fielddata/), built
+    lazily at first aggregation. (The reference gates this behind
+    fielddata=true; we build it implicitly — documented delta.)"""
+    cache_key = f"fielddata.{field}"
+    if cache_key in segment.dev_cache:
+        return segment.dev_cache[cache_key]
+    terms = segment.terms_for_field(field)
+    if not terms:
+        return None
+    from elasticsearch_tpu.index.segment import OrdinalColumn, next_pow2
+
+    token_list = [t for t, _ in terms]
+    pairs = []  # (doc, ord)
+    for ordinal, (_, tid) in enumerate(terms):
+        start = int(segment.term_block_start[tid])
+        count = int(segment.term_block_count[tid])
+        block = segment.block_docs[start: start + count].ravel()
+        for doc in block[block < segment.nd_pad]:
+            pairs.append((int(doc), ordinal))
+    pairs.sort()
+    n_vals = len(pairs)
+    cap = next_pow2(max(n_vals, 1))
+    flat_docs = np.full(cap, segment.nd_pad, dtype=np.int32)
+    flat_ords = np.zeros(cap, dtype=np.int32)
+    first_ord = np.full(segment.nd_pad, -1, dtype=np.int32)
+    exists = np.zeros(segment.nd_pad, dtype=bool)
+    for i, (doc, o) in enumerate(pairs):
+        flat_docs[i] = doc
+        flat_ords[i] = o
+        if first_ord[doc] < 0:
+            first_ord[doc] = o
+        exists[doc] = True
+    col = OrdinalColumn(token_list, flat_ords, flat_docs, first_ord, exists, n_vals)
+    segment.dev_cache[cache_key] = col
+    return col
 
 
 def compute_partial(spec: AggSpec, view: SegmentView) -> dict:
@@ -379,7 +423,77 @@ def _partial_missing(spec, view):
     return {"doc_count": int(sub_mask[: seg.nd_pad].sum()), "_mask": sub_mask}
 
 
+# --- geo metrics ---
+
+
+def _geo_values(spec, view):
+    seg = view.segment
+    col = seg.geo_columns.get(spec.body["field"])
+    if col is None or col.count == 0:
+        import numpy as _np
+
+        return _np.empty(0, _np.float32), _np.empty(0, _np.float32)
+    sel = view.mask[col.flat_docs[: col.count]]
+    return col.lat[: col.count][sel], col.lon[: col.count][sel]
+
+
+def _partial_geo_bounds(spec, view):
+    lat, lon = _geo_values(spec, view)
+    if lat.size == 0:
+        return {"top": None}
+    return {
+        "top": float(lat.max()), "bottom": float(lat.min()),
+        "left": float(lon.min()), "right": float(lon.max()),
+    }
+
+
+def _partial_geo_centroid(spec, view):
+    lat, lon = _geo_values(spec, view)
+    return {"count": int(lat.size), "lat_sum": float(lat.sum()),
+            "lon_sum": float(lon.sum())}
+
+
+def _partial_geohash_grid(spec, view):
+    from elasticsearch_tpu.utils.geohash import encode
+
+    precision = int(spec.body.get("precision", 5))
+    lat, lon = _geo_values(spec, view)
+    counts: Dict[str, int] = {}
+    for la, lo in zip(lat.tolist(), lon.tolist()):
+        h = encode(la, lo, precision)
+        counts[h] = counts.get(h, 0) + 1
+    return {"counts": counts}
+
+
+def _partial_matrix_stats(spec, view):
+    """matrix_stats (modules/aggs-matrix-stats): per-field-pair covariance/
+    correlation over docs having all fields."""
+    fields = spec.body["fields"]
+    seg = view.segment
+    cols = []
+    for f in fields:
+        col = _resolve_value_field(seg, f)
+        if col is None:
+            return {"n": 0, "fields": fields}
+        cols.append(col)
+    sel = view.mask[: seg.nd_pad].copy()
+    for col in cols:
+        sel &= col.exists
+    data = np.stack([np.where(sel, c.first_value, 0.0) for c in cols])
+    n = int(sel.sum())
+    if n == 0:
+        return {"n": 0, "fields": fields}
+    # sufficient statistics (associative across segments)
+    sums = data.sum(axis=1)
+    prods = data @ data.T
+    return {"n": n, "fields": fields, "sums": sums, "prods": prods}
+
+
 _PARTIAL_FNS: Dict[str, Callable] = {
+    "geo_bounds": _partial_geo_bounds,
+    "geo_centroid": _partial_geo_centroid,
+    "geohash_grid": _partial_geohash_grid,
+    "matrix_stats": _partial_matrix_stats,
     "min": _partial_stats, "max": _partial_stats, "sum": _partial_stats,
     "avg": _partial_stats, "stats": _partial_stats, "extended_stats": _partial_stats,
     "value_count": _partial_stats,
@@ -474,6 +588,47 @@ def _finalize_metric(spec: AggSpec, partials: List[dict]) -> dict:
             "total": len(all_hits),
             "hits": all_hits[:size],
         }}
+    if t == "geo_bounds":
+        tops = [p for p in partials if p.get("top") is not None]
+        if not tops:
+            return {"bounds": None}
+        return {"bounds": {
+            "top_left": {"lat": max(p["top"] for p in tops),
+                         "lon": min(p["left"] for p in tops)},
+            "bottom_right": {"lat": min(p["bottom"] for p in tops),
+                             "lon": max(p["right"] for p in tops)},
+        }}
+    if t == "geo_centroid":
+        count = sum(p["count"] for p in partials)
+        if count == 0:
+            return {"count": 0, "location": None}
+        return {"count": count, "location": {
+            "lat": sum(p["lat_sum"] for p in partials) / count,
+            "lon": sum(p["lon_sum"] for p in partials) / count,
+        }}
+    if t == "matrix_stats":
+        live = [p for p in partials if p.get("n")]
+        if not live:
+            return {"doc_count": 0, "fields": []}
+        fields = live[0]["fields"]
+        n = sum(p["n"] for p in live)
+        sums = sum(p["sums"] for p in live)
+        prods = sum(p["prods"] for p in live)
+        means = sums / n
+        cov = prods / n - np.outer(means, means)
+        std = np.sqrt(np.clip(np.diag(cov), 1e-30, None))
+        corr = cov / np.outer(std, std)
+        out_fields = []
+        for i, f in enumerate(fields):
+            out_fields.append({
+                "name": f,
+                "count": n,
+                "mean": float(means[i]),
+                "variance": float(cov[i, i]),
+                "covariance": {g: float(cov[i, j]) for j, g in enumerate(fields)},
+                "correlation": {g: float(corr[i, j]) for j, g in enumerate(fields)},
+            })
+        return {"doc_count": n, "fields": out_fields}
     raise ParsingException(f"cannot finalize metric [{t}]")
 
 
@@ -586,6 +741,109 @@ def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
                 b.update(run_aggregations(spec.subs, empty_views))
             buckets.append(b)
         return {"buckets": buckets}
+
+    if spec.type == "significant_terms":
+        # foreground (matched) vs background (all live) term counts; JLH
+        # score as in bucket/significant/heuristics/JLHScore.java
+        fg_partials = [compute_partial(AggSpec(spec.name, "terms", spec.body, []), v)
+                       for v in views]
+        bg_views = [v.with_mask(np.concatenate([v.segment.live,
+                                                np.zeros(1, bool)]))
+                    for v in views]
+        bg_partials = [compute_partial(AggSpec(spec.name, "terms", spec.body, []), v)
+                       for v in bg_views]
+        fg: Dict = {}
+        bg: Dict = {}
+        for p in fg_partials:
+            for k, c in p["counts"].items():
+                fg[k] = fg.get(k, 0) + c
+        for p in bg_partials:
+            for k, c in p["counts"].items():
+                bg[k] = bg.get(k, 0) + c
+        fg_total = sum(int(v.mask[: v.segment.nd_pad].sum()) for v in views)
+        bg_total = sum(v.segment.live_doc_count for v in views)
+        size = int(spec.body.get("size", 10))
+        min_doc_count = int(spec.body.get("min_doc_count", 3))
+        scored = []
+        for key, fg_count in fg.items():
+            if fg_count < min_doc_count or fg_total == 0 or bg_total == 0:
+                continue
+            fg_rate = fg_count / fg_total
+            bg_rate = bg.get(key, fg_count) / bg_total
+            if fg_rate <= bg_rate:
+                continue
+            score = (fg_rate - bg_rate) * (fg_rate / max(bg_rate, 1e-12))
+            scored.append((score, key, fg_count, bg.get(key, fg_count)))
+        scored.sort(reverse=True)
+        buckets = []
+        for score, key, fg_count, bg_count in scored[:size]:
+            b = {"key": key, "doc_count": fg_count, "score": score,
+                 "bg_count": bg_count}
+            if spec.subs:
+                sub_views = [
+                    v.with_mask(_term_bucket_mask(v, spec.body["field"], key))
+                    for v in views
+                ]
+                b.update(run_aggregations(spec.subs, sub_views))
+            buckets.append(b)
+        return {"doc_count": fg_total, "bg_count": bg_total, "buckets": buckets}
+
+    if spec.type == "sampler":
+        # first shard_size matched docs per segment (bucket/sampler)
+        shard_size = int(spec.body.get("shard_size", 100))
+        sub_views = []
+        total = 0
+        for v in views:
+            idx = np.nonzero(v.mask[: v.segment.nd_pad])[0][:shard_size]
+            mask = np.zeros_like(v.mask)
+            mask[idx] = True
+            total += int(idx.size)
+            sub_views.append(v.with_mask(mask))
+        out = {"doc_count": total}
+        if spec.subs:
+            out.update(run_aggregations(spec.subs, sub_views))
+        return out
+
+    if spec.type == "adjacency_matrix":
+        filters = spec.body["filters"]
+        keys = list(filters.keys())
+        # per-filter masks per view
+        masks: Dict[str, List[np.ndarray]] = {}
+        for key in keys:
+            partials = [
+                _partial_filter(AggSpec(key, "filter", filters[key], []), v)
+                for v in views
+            ]
+            masks[key] = [p["_mask"] for p in partials]
+        buckets = []
+        sep = spec.body.get("separator", "&")
+        for i, a in enumerate(keys):
+            for j in range(i, len(keys)):
+                b_key = keys[j]
+                name = a if i == j else f"{a}{sep}{b_key}"
+                count = 0
+                combined_views = []
+                for vi, v in enumerate(views):
+                    m = masks[a][vi] & masks[b_key][vi]
+                    count += int(m[: v.segment.nd_pad].sum())
+                    combined_views.append(v.with_mask(m))
+                if count == 0:
+                    continue
+                bucket = {"key": name, "doc_count": count}
+                if spec.subs:
+                    bucket.update(run_aggregations(spec.subs, combined_views))
+                buckets.append(bucket)
+        return {"buckets": buckets}
+
+    if spec.type == "geohash_grid":
+        partials = [compute_partial(spec, v) for v in views]
+        merged = {}
+        for p in partials:
+            for k, c in p["counts"].items():
+                merged[k] = merged.get(k, 0) + c
+        size = int(spec.body.get("size", 10000))
+        items = sorted(merged.items(), key=lambda kv: (-kv[1], kv[0]))[:size]
+        return {"buckets": [{"key": k, "doc_count": c} for k, c in items]}
 
     if spec.type in ("range", "date_range"):
         is_date = spec.type == "date_range"

@@ -1,0 +1,134 @@
+"""Extended aggs: significant_terms, sampler, adjacency_matrix, geo aggs,
+matrix_stats."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture(scope="module")
+def idx():
+    svc = IndexService("ext", Settings({"index.number_of_shards": 1}), {
+        "properties": {
+            "loc": {"type": "geo_point"},
+            "topic": {"type": "keyword"},
+            "body": {"type": "text"},
+        }
+    })
+    docs = [
+        # crime-related docs mention "theft" disproportionately
+        {"body": "report of theft downtown", "topic": "crime",
+         "loc": {"lat": 40.0, "lon": -74.0}, "x": 1.0, "y": 2.0},
+        {"body": "theft at the market", "topic": "crime",
+         "loc": {"lat": 40.1, "lon": -74.1}, "x": 2.0, "y": 4.1},
+        {"body": "theft suspect arrested", "topic": "crime",
+         "loc": {"lat": 40.2, "lon": -74.2}, "x": 3.0, "y": 5.9},
+        {"body": "local bakery opens doors", "topic": "news",
+         "loc": {"lat": 50.0, "lon": 8.0}, "x": 4.0, "y": 8.2},
+        {"body": "city council votes on budget", "topic": "news",
+         "loc": {"lat": 50.1, "lon": 8.1}, "x": 5.0, "y": 9.8},
+        {"body": "weather sunny all week", "topic": "news",
+         "loc": {"lat": 50.2, "lon": 8.2}, "x": 6.0, "y": 12.1},
+    ]
+    for i, d in enumerate(docs):
+        svc.index_doc(str(i), d)
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+def agg(r, name):
+    return r["aggregations"][name]
+
+
+class TestSignificantTerms:
+    def test_significant_terms_finds_theft(self, idx):
+        r = idx.search({"size": 0, "query": {"term": {"topic": "crime"}},
+                        "aggs": {"sig": {"significant_terms": {
+                            "field": "body", "min_doc_count": 2}}}})
+        # "theft" appears in 3/3 foreground docs but 3/6 background
+        keys = [b["key"] for b in agg(r, "sig")["buckets"]]
+        assert "theft" in keys
+        # generic terms ("the") must not outrank it
+        top = agg(r, "sig")["buckets"][0]
+        assert top["key"] == "theft"
+        assert top["doc_count"] == 3
+
+    def test_significant_terms_on_text_uses_terms(self, idx):
+        # terms resolution falls back through text -> term dict
+        r = idx.search({"size": 0, "query": {"term": {"topic": "news"}},
+                        "aggs": {"sig": {"significant_terms": {
+                            "field": "topic", "min_doc_count": 1}}}})
+        keys = [b["key"] for b in agg(r, "sig")["buckets"]]
+        assert keys == ["news"]
+
+
+class TestSampler:
+    def test_sampler_limits_docs(self, idx):
+        r = idx.search({"size": 0, "aggs": {"sample": {
+            "sampler": {"shard_size": 2},
+            "aggs": {"n": {"value_count": {"field": "x"}}},
+        }}})
+        assert agg(r, "sample")["doc_count"] == 2
+        assert agg(r, "sample")["n"]["value"] == 2
+
+
+class TestAdjacencyMatrix:
+    def test_pairwise_intersections(self, idx):
+        r = idx.search({"size": 0, "aggs": {"adj": {"adjacency_matrix": {
+            "filters": {
+                "crime": {"term": {"topic": "crime"}},
+                "theft": {"match": {"body": "theft"}},
+                "north": {"range": {"x": {"lte": 3}}},
+            }}}}})
+        got = {b["key"]: b["doc_count"] for b in agg(r, "adj")["buckets"]}
+        assert got["crime"] == 3
+        assert got["crime&theft"] == 3
+        assert got["crime&north"] == 3
+        assert got["theft&north"] == 3
+        assert "news" not in got
+
+
+class TestGeoAggs:
+    def test_geo_bounds(self, idx):
+        r = idx.search({"size": 0, "aggs": {"b": {"geo_bounds": {"field": "loc"}}}})
+        bounds = agg(r, "b")["bounds"]
+        assert bounds["top_left"]["lat"] == pytest.approx(50.2)
+        assert bounds["top_left"]["lon"] == pytest.approx(-74.2)
+        assert bounds["bottom_right"]["lat"] == pytest.approx(40.0)
+        assert bounds["bottom_right"]["lon"] == pytest.approx(8.2)
+
+    def test_geo_centroid(self, idx):
+        r = idx.search({"size": 0, "query": {"term": {"topic": "crime"}},
+                        "aggs": {"c": {"geo_centroid": {"field": "loc"}}}})
+        c = agg(r, "c")
+        assert c["count"] == 3
+        assert c["location"]["lat"] == pytest.approx(40.1, abs=1e-4)
+
+    def test_geohash_grid(self, idx):
+        r = idx.search({"size": 0, "aggs": {"g": {"geohash_grid": {
+            "field": "loc", "precision": 2}}}})
+        buckets = {b["key"]: b["doc_count"] for b in agg(r, "g")["buckets"]}
+        assert sum(buckets.values()) == 6
+        assert len(buckets) == 2  # NJ cluster vs Frankfurt cluster
+
+    def test_geohash_roundtrip(self):
+        from elasticsearch_tpu.utils.geohash import decode, encode
+
+        h = encode(48.8566, 2.3522, 7)
+        lat, lon = decode(h)
+        assert lat == pytest.approx(48.8566, abs=0.01)
+        assert lon == pytest.approx(2.3522, abs=0.01)
+
+
+class TestMatrixStats:
+    def test_correlation(self, idx):
+        r = idx.search({"size": 0, "aggs": {"m": {"matrix_stats": {
+            "fields": ["x", "y"]}}}})
+        m = agg(r, "m")
+        assert m["doc_count"] == 6
+        fx = next(f for f in m["fields"] if f["name"] == "x")
+        # y ~ 2x + noise: correlation near 1
+        assert fx["correlation"]["y"] > 0.99
+        assert fx["mean"] == pytest.approx(3.5)
