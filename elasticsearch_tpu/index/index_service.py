@@ -43,11 +43,15 @@ class IndexService:
         self.mapper_service = MapperService(self.analyzers, mapping)
         self.data_path = data_path
         durability = INDEX_TRANSLOG_DURABILITY.get(settings)
+        slowlog_warn = settings.get_time("index.search.slowlog.threshold.query.warn")
+        slowlog_info = settings.get_time("index.search.slowlog.threshold.query.info")
         self.shards: Dict[int, IndexShard] = {}
         for sid in range(self.num_shards):
             shard_path = os.path.join(data_path, str(sid)) if data_path else None
             shard = IndexShard(name, sid, self.mapper_service, shard_path,
-                               durability=durability)
+                               durability=durability,
+                               slowlog_warn_s=slowlog_warn,
+                               slowlog_info_s=slowlog_info)
             if shard_path and shard.engine.store.read_commit() is not None:
                 shard.recover_from_store()
             elif shard_path and os.path.exists(

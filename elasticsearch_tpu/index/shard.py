@@ -32,7 +32,8 @@ class ShardState:
 class IndexShard:
     def __init__(self, index_name: str, shard_id: int, mapper_service,
                  data_path: Optional[str] = None, primary: bool = True,
-                 durability: str = Translog.DURABILITY_REQUEST):
+                 durability: str = Translog.DURABILITY_REQUEST,
+                 slowlog_warn_s=None, slowlog_info_s=None):
         self.index_name = index_name
         self.shard_id = shard_id
         self.mapper_service = mapper_service
@@ -53,7 +54,10 @@ class IndexShard:
             f"{index_name}[{shard_id}]", mapper_service, translog, store,
             segment_prefix=f"{index_name}_{shard_id}_seg",
         )
-        self.searcher = ShardSearcher(shard_id, self.engine, mapper_service)
+        self.searcher = ShardSearcher(
+            shard_id, self.engine, mapper_service,
+            slowlog_warn_s=slowlog_warn_s, slowlog_info_s=slowlog_info_s,
+        )
         self._lock = threading.RLock()
 
     # ------------------------------------------------------------------

@@ -68,10 +68,17 @@ class ShardQueryResult:
     profile: Optional[List[dict]] = None
 
 
+import logging
+
+_slow_logger = logging.getLogger("elasticsearch_tpu.index.search.slowlog")
+
+
 class ShardSearcher:
     """Query-phase execution for one shard."""
 
-    def __init__(self, shard_id: int, engine, mapper_service):
+    def __init__(self, shard_id: int, engine, mapper_service,
+                 slowlog_warn_s: Optional[float] = None,
+                 slowlog_info_s: Optional[float] = None):
         self.shard_id = shard_id
         self.engine = engine
         self.mapper_service = mapper_service
@@ -79,6 +86,21 @@ class ShardSearcher:
         self.query_total = 0
         self.query_time = 0.0
         self.fetch_total = 0
+        # search slow log (index/SearchSlowLog.java): per-shard thresholds
+        self.slowlog_warn_s = slowlog_warn_s
+        self.slowlog_info_s = slowlog_info_s
+
+    def _maybe_slowlog(self, took_s: float, source: dict) -> None:
+        if self.slowlog_warn_s is not None and took_s >= self.slowlog_warn_s:
+            _slow_logger.warning(
+                "took[%dms], shard[%s], source[%s]",
+                int(took_s * 1000), self.shard_id, str(source)[:512],
+            )
+        elif self.slowlog_info_s is not None and took_s >= self.slowlog_info_s:
+            _slow_logger.info(
+                "took[%dms], shard[%s], source[%s]",
+                int(took_s * 1000), self.shard_id, str(source)[:512],
+            )
 
     # ------------------------------------------------------------------
 
@@ -167,10 +189,17 @@ class ShardSearcher:
             refs = refs[:k]
             if refs:
                 max_score = refs[0].score
+        terminate_after = source.get("terminate_after")
+        if terminate_after:
+            # exhaustive execution cannot stop mid-scan; cap the reported
+            # total (the observable contract of terminate_after)
+            total = min(total, int(terminate_after))
         result = ShardQueryResult(self.shard_id, total, refs, max_score, agg_views)
         if profile:
             result.profile = profile_shards
-        self.query_time += time.monotonic() - t0
+        took = time.monotonic() - t0
+        self.query_time += took
+        self._maybe_slowlog(took, source)
         return result
 
     def _rescore(self, seg, dev, seg_refs: List[DocRef],
