@@ -184,6 +184,10 @@ class IndexService:
         }
         if failures:
             resp["_shards"]["failures"] = failures
+        if any(r.terminated_early is not None for r in shard_results):
+            resp["terminated_early"] = any(
+                bool(r.terminated_early) for r in shard_results
+            )
         if aggregations is not None:
             resp["aggregations"] = aggregations
         if body.get("profile"):

@@ -319,6 +319,16 @@ class SegmentBuilder:
             self.geo_values.setdefault(field_name, []).extend(
                 (doc, lat, lon) for lat, lon in pts
             )
+        for field_name, pairs in getattr(parsed, "range_values", {}).items():
+            # two parallel numeric columns stay aligned: both appended once
+            # per value, in the same order (stable doc sort in seal())
+            self.field_docs.setdefault(field_name, set()).add(doc)
+            self.numeric_values.setdefault(f"{field_name}#lo", []).extend(
+                (doc, lo) for lo, _ in pairs
+            )
+            self.numeric_values.setdefault(f"{field_name}#hi", []).extend(
+                (doc, hi) for _, hi in pairs
+            )
         return doc
 
     # ------------------------------------------------------------------

@@ -425,6 +425,199 @@ class GeoPointFieldType(FieldType):
         return (lat, lon)
 
 
+class RangeFieldType(FieldType):
+    """Range family (index/mapper/RangeFieldMapper.java:73 — RangeType enum
+    :435): a value is a {gte/gt/lte/lt} pair. Lucene stores these as
+    RangeField BKD points; here each value becomes an aligned (lo, hi) pair
+    in two parallel CSR numeric columns (`<field>#lo`, `<field>#hi`) so
+    intersects/contains/within relations are elementwise comparisons."""
+
+    has_doc_values = True
+    # the scalar type used to parse each bound
+    value_parser: str = "double"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.coerce = bool(self.params.get("coerce", True))
+
+    def _bound(self, v):
+        raise NotImplementedError
+
+    # exclusive-bound adjustment step (1.0 for int-like, ulp for floats)
+    def _next_up(self, v: float) -> float:
+        return math.nextafter(v, math.inf)
+
+    def _next_down(self, v: float) -> float:
+        return math.nextafter(v, -math.inf)
+
+    def parse_range(self, value) -> tuple:
+        """-> (lo, hi) inclusive float bounds."""
+        if not isinstance(value, dict):
+            raise MapperParsingException(
+                f"error parsing field [{self.name}], expected an object but got "
+                f"[{value!r}]"
+            )
+        lo, hi = -math.inf, math.inf
+        for k, v in value.items():
+            if k == "gte":
+                lo = self._bound(v)
+            elif k == "gt":
+                lo = self._next_up(self._bound(v))
+            elif k == "lte":
+                hi = self._bound(v)
+            elif k == "lt":
+                hi = self._next_down(self._bound(v))
+            else:
+                raise MapperParsingException(
+                    f"error parsing field [{self.name}], unknown range parameter [{k}]"
+                )
+        return lo, hi
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return None
+
+    def numeric_for_query(self, value):
+        return self._bound(value)
+
+
+class IntegerRangeFieldType(RangeFieldType):
+    type_name = "integer_range"
+
+    def _bound(self, v):
+        return float(int(float(v)))
+
+    def _next_up(self, v):
+        return v + 1.0
+
+    def _next_down(self, v):
+        return v - 1.0
+
+
+class LongRangeFieldType(IntegerRangeFieldType):
+    type_name = "long_range"
+
+
+class FloatRangeFieldType(RangeFieldType):
+    type_name = "float_range"
+
+    def _bound(self, v):
+        return float(v)
+
+
+class DoubleRangeFieldType(FloatRangeFieldType):
+    type_name = "double_range"
+
+
+class DateRangeFieldType(RangeFieldType):
+    type_name = "date_range"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        fmt = self.params.get("format")
+        self.formats = fmt.split("||") if isinstance(fmt, str) else None
+
+    def _bound(self, v):
+        return float(parse_date(v, self.formats))
+
+    def _next_up(self, v):  # +1ms, like the reference's DATE range type
+        return v + 1.0
+
+    def _next_down(self, v):
+        return v - 1.0
+
+
+class IpRangeFieldType(RangeFieldType):
+    type_name = "ip_range"
+
+    def _bound(self, v):
+        return float(parse_ip(v))
+
+    def _next_up(self, v):
+        return v + 1.0
+
+    def _next_down(self, v):
+        return v - 1.0
+
+    def parse_range(self, value):
+        # CIDR shorthand: "10.0.0.0/8"
+        if isinstance(value, str) and "/" in value:
+            net = ipaddress.ip_network(value, strict=False)
+            lo = net.network_address
+            hi = net.broadcast_address
+            if isinstance(lo, ipaddress.IPv4Address):
+                lo = ipaddress.IPv6Address(f"::ffff:{lo}")
+                hi = ipaddress.IPv6Address(f"::ffff:{hi}")
+            return float(int(lo)), float(int(hi))
+        return super().parse_range(value)
+
+
+class TokenCountFieldType(NumberFieldType):
+    """token_count (index/mapper/TokenCountFieldMapper): analyzes the text
+    and indexes the token count as a numeric doc value. Subclasses the
+    numeric family so term/range queries run against the column."""
+
+    type_name = "token_count"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.analyzer = self.params.get("analyzer", "standard")
+
+    def doc_value(self, value):  # replaced by count_tokens at parse time
+        return None
+
+    def count_tokens(self, value, analyzers) -> float:
+        # counts emitted tokens; the analysis chain does not track position
+        # increments, so enable_position_increments is not supported
+        return float(len(analyzers.get(self.analyzer).analyze(str(value))))
+
+
+class BinaryFieldType(FieldType):
+    """binary (index/mapper/BinaryFieldMapper): base64 payload, not
+    searchable; doc values keep the base64 string (ordinal column)."""
+
+    type_name = "binary"
+    indexable = False
+    has_doc_values = False  # like the reference: doc_values default false
+    ordinal_doc_values = True
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        if not self.doc_values:
+            return None
+        s = str(value)
+        import base64 as _b64
+
+        try:
+            _b64.b64decode(s, validate=True)
+        except Exception:
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}]: invalid base64"
+            ) from None
+        return s
+
+
+class Murmur3FieldType(NumberFieldType):
+    """murmur3 (plugins/mapper-murmur3 — Murmur3FieldMapper): stores the
+    murmur3 hash of the value as a numeric doc value, so cardinality aggs
+    skip hashing at query time."""
+
+    type_name = "murmur3"
+
+    def doc_value(self, value):
+        from elasticsearch_tpu.utils.murmur3 import murmur3_32
+
+        # murmur3_32 already returns a signed Java-int-style value
+        return float(murmur3_32(str(value).encode("utf-8")))
+
+
 class PercolatorFieldType(FieldType):
     """percolator: stores a query DSL object for inverse search
     (modules/percolator — PercolatorFieldMapper). The query lives in
@@ -480,6 +673,9 @@ FIELD_TYPES = {
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, ScaledFloatFieldType, DateFieldType,
         BooleanFieldType, IpFieldType, GeoPointFieldType,
+        IntegerRangeFieldType, LongRangeFieldType, FloatRangeFieldType,
+        DoubleRangeFieldType, DateRangeFieldType, IpRangeFieldType,
+        TokenCountFieldType, BinaryFieldType, Murmur3FieldType,
     ]
 }
 

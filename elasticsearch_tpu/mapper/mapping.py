@@ -45,6 +45,8 @@ class ParsedDocument:
     string_values: Dict[str, List[str]] = field(default_factory=dict)
     # geo points: field -> list[(lat, lon)]
     geo_values: Dict[str, List[Tuple[float, float]]] = field(default_factory=dict)
+    # range fields: field -> list[(lo, hi)] inclusive float bounds
+    range_values: Dict[str, List[Tuple[float, float]]] = field(default_factory=dict)
     # fields present (for exists query — the reference's _field_names field)
     field_names: List[str] = field(default_factory=list)
     # dynamic mapping update produced while parsing, or None
@@ -104,7 +106,7 @@ class DocumentMapper:
             out.mapping_update = {"properties": new_props}
         out.field_names = sorted(
             set(out.terms) | set(out.numeric_values) | set(out.string_values)
-            | set(out.geo_values)
+            | set(out.geo_values) | set(out.range_values)
         )
         return out
 
@@ -219,8 +221,20 @@ class DocumentMapper:
         if isinstance(ft, GeoPointFieldType):
             out.geo_values.setdefault(ft.name, []).append(ft.parse_point(v))
             return
-        from elasticsearch_tpu.mapper.field_types import CompletionFieldType
+        from elasticsearch_tpu.mapper.field_types import (
+            CompletionFieldType,
+            RangeFieldType,
+            TokenCountFieldType,
+        )
 
+        if isinstance(ft, RangeFieldType):
+            out.range_values.setdefault(ft.name, []).append(ft.parse_range(v))
+            return
+        if isinstance(ft, TokenCountFieldType):
+            out.numeric_values.setdefault(ft.name, []).append(
+                ft.count_tokens(v, self.analyzers)
+            )
+            return
         if isinstance(ft, CompletionFieldType):
             inputs, weight = ft.parse_completion(v)
             out.string_values.setdefault(ft.name, []).extend(inputs)

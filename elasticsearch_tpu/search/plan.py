@@ -256,6 +256,37 @@ class OrdRangeNode(PlanNode):
         return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(cond)
 
 
+class RangePairNode(PlanNode):
+    """Query against a range *field* (index/mapper/RangeFieldMapper.java
+    relation semantics): doc values are (lo, hi) pairs in aligned CSR
+    columns; the relation picks the predicate vs the query interval."""
+
+    def __init__(self, flat_docs, lo_vals, hi_vals, q_lo: float, q_hi: float,
+                 relation: str = "intersects"):
+        self.flat_docs = flat_docs
+        self.lo_vals = lo_vals
+        self.hi_vals = hi_vals
+        self.q_lo = np.float64(q_lo)
+        self.q_hi = np.float64(q_hi)
+        self.relation = relation
+
+    def key(self):
+        return f"rpair[{len(self.flat_docs)},{self.relation}]"
+
+    def arrays(self):
+        return [self.flat_docs, self.lo_vals, self.hi_vals, self.q_lo, self.q_hi]
+
+    def emit(self, ctx):
+        flat_docs, lo_vals, hi_vals, q_lo, q_hi = ctx.take(5)
+        if self.relation == "within":
+            cond = (lo_vals >= q_lo) & (hi_vals <= q_hi)
+        elif self.relation == "contains":
+            cond = (lo_vals <= q_lo) & (hi_vals >= q_hi)
+        else:  # intersects (default)
+            cond = (lo_vals <= q_hi) & (hi_vals >= q_lo)
+        return ctx.zeros_f(), ctx.zeros_b().at[flat_docs].max(cond)
+
+
 class DenseMaskNode(PlanNode):
     """A precomputed [nd1] bool mask (exists query, ids query)."""
 

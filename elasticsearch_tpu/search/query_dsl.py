@@ -367,6 +367,13 @@ class TermQueryBuilder(QueryBuilder):
 
     def to_plan(self, ctx, segment):
         ft = ctx.field_type(self.field)
+        from elasticsearch_tpu.mapper.field_types import RangeFieldType
+
+        if isinstance(ft, RangeFieldType):
+            # point-containment: the stored range must contain the term
+            v = ft.numeric_for_query(self.value)
+            return _range_pair_node(segment, self.field, v, v, "intersects",
+                                    self.boost)
         if isinstance(ft, NumberFieldType) or isinstance(ft, DateFieldType):
             csr = _numeric_csr(segment, self.field)
             if csr is None:
@@ -439,17 +446,47 @@ class TermsQueryBuilder(QueryBuilder):
         return P.ConstantScoreNode(node, self.boost)
 
 
+def _range_pair_node(segment, field, q_lo, q_hi, relation, boost) -> P.PlanNode:
+    """Build a RangePairNode against a range field's aligned #lo/#hi columns."""
+    lo_col = segment.numeric_columns.get(f"{field}#lo")
+    hi_col = segment.numeric_columns.get(f"{field}#hi")
+    if lo_col is None or hi_col is None:
+        return P.MatchNoneNode()
+    docs = segment.device_column(f"num.{field}#lo.docs", lambda: lo_col.flat_docs)
+    lo_vals = segment.device_column(f"num.{field}#lo.vals", lambda: lo_col.flat_values)
+    hi_vals = segment.device_column(f"num.{field}#hi.vals", lambda: hi_col.flat_values)
+    return P.ConstantScoreNode(
+        P.RangePairNode(docs, lo_vals, hi_vals, q_lo, q_hi, relation), boost
+    )
+
+
 class RangeQueryBuilder(QueryBuilder):
     name = "range"
 
     def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None,
-                 format: Optional[str] = None, **kw):
+                 format: Optional[str] = None, relation: str = "intersects", **kw):
         super().__init__(**kw)
         self.field = field
         self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
+        self.relation = str(relation).lower()
+        if self.relation not in ("intersects", "within", "contains"):
+            raise ParsingException(
+                f"[range] query does not support relation [{relation}]"
+            )
 
     def to_plan(self, ctx, segment):
         ft = ctx.field_type(self.field)
+        from elasticsearch_tpu.mapper.field_types import RangeFieldType
+
+        if isinstance(ft, RangeFieldType):
+            spec = {}
+            for k, v in (("gte", self.gte), ("gt", self.gt),
+                         ("lte", self.lte), ("lt", self.lt)):
+                if v is not None:
+                    spec[k] = v
+            q_lo, q_hi = ft.parse_range(spec)
+            return _range_pair_node(segment, self.field, q_lo, q_hi,
+                                    self.relation, self.boost)
         if isinstance(ft, (NumberFieldType, DateFieldType, BooleanFieldType, IpFieldType)) or (
             ft is None and segment.numeric_columns.get(self.field) is not None
         ):
@@ -1115,7 +1152,10 @@ def parse_query(body) -> QueryBuilder:
             known["gte" if params.get("include_lower", True) else "gt"] = params["from"]
         if "to" in params:
             known["lte" if params.get("include_upper", True) else "lt"] = params["to"]
-        return RangeQueryBuilder(field, boost=float(params.get("boost", 1.0)), **known)
+        return RangeQueryBuilder(
+            field, boost=float(params.get("boost", 1.0)),
+            relation=params.get("relation", "intersects"), **known,
+        )
     if qtype == "exists":
         return ExistsQueryBuilder(qbody["field"], boost=float(qbody.get("boost", 1.0)))
     if qtype == "ids":

@@ -66,6 +66,8 @@ class ShardQueryResult:
     agg_views: List[SegmentView] = field(default_factory=list)
     # per-segment timing breakdowns when "profile": true
     profile: Optional[List[dict]] = None
+    # set (true/false) only when terminate_after was requested
+    terminated_early: Optional[bool] = None
 
 
 import logging
@@ -86,9 +88,16 @@ class ShardSearcher:
         self.query_total = 0
         self.query_time = 0.0
         self.fetch_total = 0
-        # search slow log (index/SearchSlowLog.java): per-shard thresholds
-        self.slowlog_warn_s = slowlog_warn_s
-        self.slowlog_info_s = slowlog_info_s
+        # search slow log (index/SearchSlowLog.java): per-shard thresholds;
+        # negative = disabled (the "-1" sentinel)
+        self.slowlog_warn_s = (
+            slowlog_warn_s if slowlog_warn_s is not None and slowlog_warn_s >= 0
+            else None
+        )
+        self.slowlog_info_s = (
+            slowlog_info_s if slowlog_info_s is not None and slowlog_info_s >= 0
+            else None
+        )
 
     def _maybe_slowlog(self, took_s: float, source: dict) -> None:
         if self.slowlog_warn_s is not None and took_s >= self.slowlog_warn_s:
@@ -190,11 +199,14 @@ class ShardSearcher:
             if refs:
                 max_score = refs[0].score
         terminate_after = source.get("terminate_after")
+        terminated_early = None
         if terminate_after:
             # exhaustive execution cannot stop mid-scan; cap the reported
-            # total (the observable contract of terminate_after)
+            # total + set terminated_early (the observable contract)
+            terminated_early = total >= int(terminate_after)
             total = min(total, int(terminate_after))
-        result = ShardQueryResult(self.shard_id, total, refs, max_score, agg_views)
+        result = ShardQueryResult(self.shard_id, total, refs, max_score, agg_views,
+                                  terminated_early=terminated_early)
         if profile:
             result.profile = profile_shards
         took = time.monotonic() - t0

@@ -1,0 +1,147 @@
+"""Extended field types: range family, token_count, binary, murmur3.
+
+Ref: index/mapper/RangeFieldMapper.java (relations intersects/contains/
+within), TokenCountFieldMapper, BinaryFieldMapper, plugins/mapper-murmur3.
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import MapperParsingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+def hit_ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+@pytest.fixture()
+def ranges_idx():
+    idx = IndexService("ranges", Settings({"index.number_of_shards": 1}))
+    idx.put_mapping({
+        "properties": {
+            "age_range": {"type": "integer_range"},
+            "temp": {"type": "float_range"},
+            "window": {"type": "date_range"},
+            "net": {"type": "ip_range"},
+        }
+    })
+    idx.index_doc("1", {"age_range": {"gte": 10, "lte": 20}})
+    idx.index_doc("2", {"age_range": {"gt": 20, "lt": 30}})  # (20,30) -> [21,29]
+    idx.index_doc("3", {"age_range": {"gte": 5, "lte": 50}})
+    idx.index_doc("4", {"temp": {"gte": 1.5, "lte": 2.5}})
+    idx.index_doc("5", {"window": {"gte": "2017-01-01", "lte": "2017-06-30"}})
+    idx.index_doc("6", {"net": "10.0.0.0/8"})
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+class TestRangeFields:
+    def test_term_point_containment(self, ranges_idx):
+        resp = ranges_idx.search({"query": {"term": {"age_range": 15}}})
+        assert hit_ids(resp) == ["1", "3"]
+        resp = ranges_idx.search({"query": {"term": {"age_range": 25}}})
+        assert hit_ids(resp) == ["2", "3"]
+
+    def test_exclusive_bounds(self, ranges_idx):
+        # doc 2 is (20,30): 20 itself excluded
+        resp = ranges_idx.search({"query": {"term": {"age_range": 20}}})
+        assert hit_ids(resp) == ["1", "3"]
+
+    def test_range_intersects_default(self, ranges_idx):
+        resp = ranges_idx.search(
+            {"query": {"range": {"age_range": {"gte": 18, "lte": 22}}}})
+        assert hit_ids(resp) == ["1", "2", "3"]
+
+    def test_range_within(self, ranges_idx):
+        resp = ranges_idx.search({"query": {"range": {
+            "age_range": {"gte": 9, "lte": 35, "relation": "within"}}}})
+        assert hit_ids(resp) == ["1", "2"]
+
+    def test_range_contains(self, ranges_idx):
+        resp = ranges_idx.search({"query": {"range": {
+            "age_range": {"gte": 12, "lte": 18, "relation": "contains"}}}})
+        assert hit_ids(resp) == ["1", "3"]
+
+    def test_float_range(self, ranges_idx):
+        resp = ranges_idx.search({"query": {"term": {"temp": 2.0}}})
+        assert hit_ids(resp) == ["4"]
+        resp = ranges_idx.search({"query": {"term": {"temp": 3.0}}})
+        assert hit_ids(resp) == []
+
+    def test_date_range(self, ranges_idx):
+        resp = ranges_idx.search({"query": {"term": {"window": "2017-03-01"}}})
+        assert hit_ids(resp) == ["5"]
+        resp = ranges_idx.search({"query": {"range": {
+            "window": {"gte": "2017-06-01", "lte": "2017-12-31"}}}})
+        assert hit_ids(resp) == ["5"]
+
+    def test_ip_range_cidr(self, ranges_idx):
+        resp = ranges_idx.search({"query": {"term": {"net": "10.1.2.3"}}})
+        assert hit_ids(resp) == ["6"]
+        resp = ranges_idx.search({"query": {"term": {"net": "11.0.0.1"}}})
+        assert hit_ids(resp) == []
+
+    def test_exists_on_range(self, ranges_idx):
+        resp = ranges_idx.search({"query": {"exists": {"field": "age_range"}}})
+        assert hit_ids(resp) == ["1", "2", "3"]
+
+    def test_malformed_range_rejected(self, ranges_idx):
+        with pytest.raises(MapperParsingException):
+            ranges_idx.index_doc("x", {"age_range": {"bogus": 1}})
+        with pytest.raises(MapperParsingException):
+            ranges_idx.index_doc("y", {"age_range": 17})
+
+
+class TestTokenCount:
+    def test_token_count_subfield(self):
+        idx = IndexService("tc", Settings({"index.number_of_shards": 1}))
+        idx.put_mapping({"properties": {"name": {
+            "type": "text",
+            "fields": {"length": {"type": "token_count", "analyzer": "standard"}},
+        }}})
+        idx.index_doc("1", {"name": "John Smith"})
+        idx.index_doc("2", {"name": "Rachel Alice Williams"})
+        idx.refresh()
+        resp = idx.search({"query": {"term": {"name.length": 3}}})
+        assert hit_ids(resp) == ["2"]
+        resp = idx.search({"query": {"range": {"name.length": {"lte": 2}}}})
+        assert hit_ids(resp) == ["1"]
+        idx.close()
+
+
+class TestBinary:
+    def test_binary_stored_not_searchable(self):
+        idx = IndexService("bin", Settings({"index.number_of_shards": 1}))
+        idx.put_mapping({"properties": {"blob": {"type": "binary"}}})
+        idx.index_doc("1", {"blob": "U29tZSBiaW5hcnkgYmxvYg=="})
+        idx.refresh()
+        resp = idx.search({"query": {"match_all": {}}})
+        assert resp["hits"]["hits"][0]["_source"]["blob"] == "U29tZSBiaW5hcnkgYmxvYg=="
+        idx.close()
+
+    def test_binary_invalid_base64(self):
+        idx = IndexService("bin2", Settings({"index.number_of_shards": 1}))
+        idx.put_mapping({"properties": {"blob": {"type": "binary", "doc_values": True}}})
+        with pytest.raises(MapperParsingException):
+            idx.index_doc("1", {"blob": "not!!base64&&"})
+        idx.close()
+
+
+class TestMurmur3:
+    def test_murmur3_cardinality(self):
+        idx = IndexService("m3", Settings({"index.number_of_shards": 1}))
+        idx.put_mapping({"properties": {"tag": {
+            "type": "keyword",
+            "fields": {"hash": {"type": "murmur3"}},
+        }}})
+        for i, tag in enumerate(["a", "b", "a", "c", "b", "a"]):
+            idx.index_doc(str(i), {"tag": tag})
+        idx.refresh()
+        resp = idx.search({
+            "size": 0,
+            "aggs": {"distinct": {"cardinality": {"field": "tag.hash"}}},
+        })
+        assert resp["aggregations"]["distinct"]["value"] == 3
+        idx.close()
