@@ -16,7 +16,10 @@ import time
 from typing import Dict, List, Optional
 
 from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
-from elasticsearch_tpu.common.errors import DocumentMissingException
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingException,
+    IllegalArgumentException,
+)
 from elasticsearch_tpu.common.settings import (
     INDEX_NUMBER_OF_REPLICAS,
     INDEX_NUMBER_OF_SHARDS,
@@ -72,8 +75,39 @@ class IndexService:
 
     def index_doc(self, doc_id: str, source: dict, routing: Optional[str] = None,
                   **kw) -> dict:
+        routing = self._check_join_routing(doc_id, source, routing)
         shard = self.shards[self._route(doc_id, routing)]
         return shard.index_doc(doc_id, source, routing, **kw)
+
+    def _check_join_routing(self, doc_id: str, source: dict,
+                            routing: Optional[str]) -> Optional[str]:
+        """Child docs of a join field MUST be colocated with their parent
+        (modules/parent-join: RoutingMissingException when a child is
+        indexed without routing). On multi-shard indices a missing routing
+        is an error; we follow the reference and additionally default the
+        routing to the parent id, which is always correct."""
+        from elasticsearch_tpu.mapper.field_types import join_field_of
+
+        jf = join_field_of(self.mapper_service)
+        if jf is None:
+            return routing
+        value = source.get(jf.name)
+        if not isinstance(value, (str, dict)):
+            return routing
+        try:
+            name, parent = jf.parse_join(value)
+        except Exception:
+            return routing  # parse errors surface in the mapper with context
+        if parent is None:
+            return routing
+        if routing is None:
+            if self.num_shards > 1:
+                raise IllegalArgumentException(
+                    f"[routing] is missing for join field [{jf.name}]: child "
+                    f"document [{doc_id}] must be routed to its parent's shard"
+                )
+            routing = parent
+        return routing
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None):
         shard = self.shards[self._route(doc_id, routing)]

@@ -535,11 +535,10 @@ class IpRangeFieldType(RangeFieldType):
     def _bound(self, v):
         return float(parse_ip(v))
 
-    def _next_up(self, v):
-        return v + 1.0
-
-    def _next_down(self, v):
-        return v - 1.0
+    # exclusive bounds step by one float64 ulp (the base-class default):
+    # a +1 integer step is below ulp at IPv6 magnitudes (~2^128), which
+    # would silently turn gt/lt into gte/lte; one ulp correctly excludes
+    # the (float64-rounded) stored bound itself.
 
     def parse_range(self, value):
         # CIDR shorthand: "10.0.0.0/8"
@@ -618,6 +617,73 @@ class Murmur3FieldType(NumberFieldType):
         return float(murmur3_32(str(value).encode("utf-8")))
 
 
+class JoinFieldType(FieldType):
+    """join (modules/parent-join — ParentJoinFieldMapper): one relation
+    field per index declaring parent->child relations. A doc's value is
+    either the relation name (parent) or {"name": ..., "parent": id}
+    (child). The relation name lands in the field's ordinal column + the
+    inverted index; the parent id in a parallel '<field>#parent' ordinal
+    column (standing in for Lucene's per-relation join doc-values field).
+
+    Parent/child joins require same-shard colocation: children must be
+    indexed with routing = parent id (enforced at the write path)."""
+
+    type_name = "join"
+    ordinal_doc_values = True
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        rel = self.params.get("relations") or {}
+        # parent -> [children]
+        self.relations: dict = {
+            p: (c if isinstance(c, list) else [c]) for p, c in rel.items()
+        }
+        self._parent_of = {
+            c: p for p, cs in self.relations.items() for c in cs
+        }
+
+    def parent_of(self, child_name: str) -> Optional[str]:
+        return self._parent_of.get(child_name)
+
+    def is_parent(self, name: str) -> bool:
+        return name in self.relations
+
+    def valid_relation(self, name: str) -> bool:
+        return name in self.relations or name in self._parent_of
+
+    def parse_join(self, value) -> tuple:
+        """-> (relation_name, parent_id or None)."""
+        if isinstance(value, str):
+            name, parent = value, None
+        elif isinstance(value, dict):
+            name = value.get("name")
+            parent = value.get("parent")
+        else:
+            raise MapperParsingException(
+                f"failed to parse join field [{self.name}] value [{value!r}]"
+            )
+        if not self.valid_relation(name):
+            raise MapperParsingException(
+                f"unknown join name [{name}] for field [{self.name}]"
+            )
+        if name in self._parent_of and parent is None:
+            raise MapperParsingException(
+                f"[parent] is missing for join field [{self.name}]"
+            )
+        if name in self.relations and name not in self._parent_of and parent is not None:
+            raise MapperParsingException(
+                f"[parent] is specified but the join name [{name}] is a parent"
+            )
+        return str(name), (str(parent) if parent is not None else None)
+
+    def index_terms(self, value, analyzers):
+        name, _ = self.parse_join(value)
+        return [name]
+
+    def doc_value(self, value):
+        return None  # handled specially in DocumentMapper._index_single
+
+
 class PercolatorFieldType(FieldType):
     """percolator: stores a query DSL object for inverse search
     (modules/percolator — PercolatorFieldMapper). The query lives in
@@ -676,8 +742,18 @@ FIELD_TYPES = {
         IntegerRangeFieldType, LongRangeFieldType, FloatRangeFieldType,
         DoubleRangeFieldType, DateRangeFieldType, IpRangeFieldType,
         TokenCountFieldType, BinaryFieldType, Murmur3FieldType,
+        JoinFieldType,
     ]
 }
+
+
+def join_field_of(mapper_service) -> Optional["JoinFieldType"]:
+    """The index's single join field, if mapped (ParentJoinFieldMapper
+    enforces at most one per index)."""
+    for ft in mapper_service.mapper.fields.values():
+        if isinstance(ft, JoinFieldType):
+            return ft
+    return None
 
 
 def create_field_type(name: str, params: dict) -> FieldType:

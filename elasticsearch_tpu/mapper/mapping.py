@@ -223,10 +223,18 @@ class DocumentMapper:
             return
         from elasticsearch_tpu.mapper.field_types import (
             CompletionFieldType,
+            JoinFieldType,
             RangeFieldType,
             TokenCountFieldType,
         )
 
+        if isinstance(ft, JoinFieldType):
+            name, parent = ft.parse_join(v)
+            out.terms.setdefault(ft.name, []).append(name)
+            out.string_values.setdefault(ft.name, []).append(name)
+            if parent is not None:
+                out.string_values.setdefault(f"{ft.name}#parent", []).append(parent)
+            return
         if isinstance(ft, RangeFieldType):
             out.range_values.setdefault(ft.name, []).append(ft.parse_range(v))
             return

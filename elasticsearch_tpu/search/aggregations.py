@@ -33,7 +33,7 @@ from elasticsearch_tpu.ops import aggs as agg_ops
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing", "significant_terms",
-                "sampler", "adjacency_matrix", "geohash_grid"}
+                "sampler", "adjacency_matrix", "geohash_grid", "children"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "stats", "extended_stats",
                 "value_count", "cardinality", "percentiles", "top_hits",
                 "geo_bounds", "geo_centroid", "matrix_stats"}
@@ -741,6 +741,50 @@ def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
                 b.update(run_aggregations(spec.subs, empty_views))
             buckets.append(b)
         return {"buckets": buckets}
+
+    if spec.type == "children":
+        # children agg (modules/parent-join — ChildrenAggregationBuilder):
+        # flips the doc context from matched parents to their children of
+        # the given join type (cross-segment: children may live in any
+        # segment of the shard)
+        from elasticsearch_tpu.mapper.field_types import join_field_of
+
+        child_type = spec.body["type"]
+        jf = None
+        for v in views:
+            if v.shard_ctx is not None:
+                jf = join_field_of(v.shard_ctx.mapper_service)
+                if jf is not None:
+                    break
+        parent_ids = set()
+        if jf is not None:
+            for v in views:
+                seg = v.segment
+                for local in np.nonzero(v.mask[: seg.nd_pad])[0]:
+                    parent_ids.add(seg.doc_ids[int(local)])
+        sub_views = []
+        total = 0
+        for v in views:
+            seg = v.segment
+            mask = np.zeros_like(v.mask)
+            if jf is not None:
+                col = seg.ordinal_columns.get(jf.name)
+                pcol = seg.ordinal_columns.get(f"{jf.name}#parent")
+                if col is not None and pcol is not None:
+                    child_ord = col.ord_of(child_type)
+                    if child_ord >= 0:
+                        is_child = (col.first_ord == child_ord) & pcol.exists
+                        for local in np.nonzero(
+                                is_child & seg.live[: seg.nd_pad])[0]:
+                            pid = pcol.terms[pcol.first_ord[int(local)]]
+                            if pid in parent_ids:
+                                mask[int(local)] = True
+            total += int(mask[: seg.nd_pad].sum())
+            sub_views.append(v.with_mask(mask))
+        result = {"doc_count": total}
+        if spec.subs:
+            result.update(run_aggregations(spec.subs, sub_views))
+        return result
 
     if spec.type == "significant_terms":
         # foreground (matched) vs background (all live) term counts; JLH

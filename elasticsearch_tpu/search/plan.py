@@ -305,6 +305,26 @@ class DenseMaskNode(PlanNode):
         return ctx.zeros_f(), mask
 
 
+class DenseScoreNode(PlanNode):
+    """Precomputed dense [nd1] scores + match mask (join queries: scores
+    aggregated host-side from the other side of the relation)."""
+
+    def __init__(self, scores, mask, label: str = "join"):
+        self.scores = scores
+        self.mask = mask
+        self.label = label
+
+    def key(self):
+        return f"densescore[{len(self.mask)}]"
+
+    def arrays(self):
+        return [self.scores, self.mask]
+
+    def emit(self, ctx):
+        scores, mask = ctx.take(2)
+        return jnp.where(mask, scores, 0.0).astype(jnp.float32), mask
+
+
 class GeoDistanceNode(PlanNode):
     def __init__(self, flat_docs, lat, lon, center_lat, center_lon, radius_m):
         self.flat_docs = flat_docs

@@ -41,14 +41,25 @@ MAX_EXPANSIONS = 1024
 
 
 class ShardQueryContext:
-    """Per-shard query context (≙ QueryShardContext): mapper + analyzers."""
+    """Per-shard query context (≙ QueryShardContext): mapper + analyzers +
+    (optionally) the engine, for queries that join across segments of the
+    shard (has_child/has_parent — the reference resolves these through
+    shard-wide global ordinals)."""
 
-    def __init__(self, mapper_service):
+    def __init__(self, mapper_service, engine=None):
         self.mapper_service = mapper_service
         self.analyzers = mapper_service.analyzers
+        self.engine = engine
 
     def field_type(self, name: str):
         return self.mapper_service.field_type(name)
+
+    def all_segments(self, fallback_segment) -> List:
+        """Every searchable segment of the shard (falls back to the one
+        segment in contexts without an engine, e.g. percolation)."""
+        if self.engine is not None:
+            return list(self.engine.searchable_segments())
+        return [fallback_segment]
 
     def default_fields(self) -> List[str]:
         # all text fields (the reference's `_all` is deprecated in 6.0; we
@@ -1030,6 +1041,199 @@ class PercolateQueryBuilder(QueryBuilder):
         return P.ConstantScoreNode(P.DenseMaskNode(mask, "percolate"), self.boost)
 
 
+def _require_join_field(ctx):
+    from elasticsearch_tpu.mapper.field_types import join_field_of
+
+    jf = join_field_of(ctx.mapper_service)
+    if jf is None:
+        raise QueryShardException(
+            "no [join] field declared in the mapping of this index"
+        )
+    return jf
+
+
+def _matched_by_relation(ctx, segment, query: QueryBuilder, jf,
+                         relation_name: str):
+    """Run `query` over every segment of the shard, restricted to docs of
+    the given join relation. Yields (segment, local_doc, score)."""
+    for seg2 in ctx.all_segments(segment):
+        col = seg2.ordinal_columns.get(jf.name)
+        if col is None:
+            continue
+        rel_ord = col.ord_of(relation_name)
+        if rel_ord < 0:
+            continue
+        node = query.to_plan(ctx, seg2)
+        scores_d, matched_d = P.execute(seg2.device_arrays(), node)
+        scores = np.asarray(scores_d)
+        matched = np.asarray(matched_d)[: seg2.nd_pad]
+        sel = matched & seg2.live[: seg2.nd_pad] & (col.first_ord == rel_ord)
+        for local in np.nonzero(sel)[0]:
+            yield seg2, int(local), float(scores[local])
+
+
+def _combine_child_scores(scores: List[float], mode: str) -> float:
+    if mode == "min":
+        return min(scores)
+    if mode == "max":
+        return max(scores)
+    if mode == "sum":
+        return sum(scores)
+    if mode == "avg":
+        return sum(scores) / len(scores)
+    return 1.0  # none: constant
+
+
+class HasChildQueryBuilder(QueryBuilder):
+    """has_child (modules/parent-join — HasChildQueryBuilder:62): match
+    parent docs having >=min_children..<=max_children children of `type`
+    matching the inner query; child scores fold into the parent per
+    score_mode. The reference joins via shard-global ordinals; here child
+    hits map to parent _ids host-side and scatter into a dense parent
+    score column."""
+
+    name = "has_child"
+
+    def __init__(self, type_: str, query: QueryBuilder, score_mode: str = "none",
+                 min_children: int = 1, max_children: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.type = type_
+        self.query = query
+        self.score_mode = score_mode
+        self.min_children = max(int(min_children), 1)
+        self.max_children = int(max_children) if max_children else None
+        self._cached_parent_scores: Optional[Dict[str, List[float]]] = None
+
+    def _parent_scores(self, ctx, segment, jf) -> Dict[str, List[float]]:
+        """Child-side pass, computed ONCE per query execution (builders are
+        parsed fresh per request; to_plan runs per segment — memoizing here
+        avoids O(segments^2) inner-query executions)."""
+        if self._cached_parent_scores is None:
+            parent_scores: Dict[str, List[float]] = {}
+            for seg2, local, score in _matched_by_relation(
+                    ctx, segment, self.query, jf, self.type):
+                pcol = seg2.ordinal_columns.get(f"{jf.name}#parent")
+                if pcol is None or not pcol.exists[local]:
+                    continue
+                pid = pcol.terms[pcol.first_ord[local]]
+                parent_scores.setdefault(pid, []).append(score)
+            self._cached_parent_scores = parent_scores
+        return self._cached_parent_scores
+
+    def to_plan(self, ctx, segment):
+        jf = _require_join_field(ctx)
+        parent_name = jf.parent_of(self.type)
+        if parent_name is None:
+            raise QueryShardException(
+                f"[has_child] join relation [{self.type}] is not a child"
+            )
+        parent_scores = self._parent_scores(ctx, segment, jf)
+
+        col = segment.ordinal_columns.get(jf.name)
+        parent_ord = col.ord_of(parent_name) if col is not None else -1
+        if parent_ord < 0:
+            return P.MatchNoneNode()
+        id_map = segment.id_to_doc()
+        nd1 = segment.nd_pad + 1
+        mask = np.zeros(nd1, dtype=bool)
+        sc = np.zeros(nd1, dtype=np.float32)
+        for pid, ss in parent_scores.items():
+            if len(ss) < self.min_children:
+                continue
+            if self.max_children is not None and len(ss) > self.max_children:
+                continue
+            local = id_map.get(pid)
+            if local is None or col.first_ord[local] != parent_ord:
+                continue
+            mask[local] = True
+            sc[local] = _combine_child_scores(ss, self.score_mode)
+        if not mask.any():
+            return P.MatchNoneNode()
+        return self._wrap_boost(P.DenseScoreNode(sc, mask, "has_child"))
+
+
+class HasParentQueryBuilder(QueryBuilder):
+    """has_parent (modules/parent-join — HasParentQueryBuilder): match
+    child docs whose parent matches the inner query; score=true copies the
+    parent's score onto each child."""
+
+    name = "has_parent"
+
+    def __init__(self, parent_type: str, query: QueryBuilder,
+                 score: bool = False, **kw):
+        super().__init__(**kw)
+        self.parent_type = parent_type
+        self.query = query
+        self.score = bool(score)
+        self._cached_parent_score: Optional[Dict[str, float]] = None
+
+    def to_plan(self, ctx, segment):
+        jf = _require_join_field(ctx)
+        if not jf.is_parent(self.parent_type):
+            raise QueryShardException(
+                f"[has_parent] join relation [{self.parent_type}] is not a parent"
+            )
+        if self._cached_parent_score is None:
+            parent_score: Dict[str, float] = {}
+            for seg2, local, score in _matched_by_relation(
+                    ctx, segment, self.query, jf, self.parent_type):
+                parent_score[seg2.doc_ids[local]] = score
+            self._cached_parent_score = parent_score
+        parent_score = self._cached_parent_score
+
+        if not parent_score:
+            return P.MatchNoneNode()
+        pcol = segment.ordinal_columns.get(f"{jf.name}#parent")
+        col = segment.ordinal_columns.get(jf.name)
+        if pcol is None or col is None:
+            return P.MatchNoneNode()
+        child_names = set(jf.relations.get(self.parent_type, []))
+        child_ords = {col.ord_of(c) for c in child_names} - {-1}
+        nd1 = segment.nd_pad + 1
+        mask = np.zeros(nd1, dtype=bool)
+        sc = np.zeros(nd1, dtype=np.float32)
+        for local in range(segment.num_docs):
+            if not segment.live[local] or col.first_ord[local] not in child_ords:
+                continue
+            if not pcol.exists[local]:
+                continue
+            pid = pcol.terms[pcol.first_ord[local]]
+            if pid in parent_score:
+                mask[local] = True
+                sc[local] = parent_score[pid] if self.score else 1.0
+        if not mask.any():
+            return P.MatchNoneNode()
+        return self._wrap_boost(P.DenseScoreNode(sc, mask, "has_parent"))
+
+
+class ParentIdQueryBuilder(QueryBuilder):
+    """parent_id (modules/parent-join — ParentIdQueryBuilder): children of
+    `type` whose parent is exactly `id`."""
+
+    name = "parent_id"
+
+    def __init__(self, type_: str, id_: str, **kw):
+        super().__init__(**kw)
+        self.type = type_
+        self.id = str(id_)
+
+    def to_plan(self, ctx, segment):
+        jf = _require_join_field(ctx)
+        col = segment.ordinal_columns.get(jf.name)
+        pcol = segment.ordinal_columns.get(f"{jf.name}#parent")
+        if col is None or pcol is None:
+            return P.MatchNoneNode()
+        child_ord = col.ord_of(self.type)
+        pid_ord = pcol.ord_of(self.id)
+        if child_ord < 0 or pid_ord < 0:
+            return P.MatchNoneNode()
+        mask = np.zeros(segment.nd_pad + 1, dtype=bool)
+        sel = ((col.first_ord == child_ord) & pcol.exists
+               & (pcol.first_ord == pid_ord) & segment.live[: segment.nd_pad])
+        mask[: segment.nd_pad] = sel
+        return P.ConstantScoreNode(P.DenseMaskNode(mask, "parent_id"), self.boost)
+
+
 class NestedQueryBuilder(QueryBuilder):
     """Flattened-nested approximation: the engine indexes nested objects
     flattened (object mapping), so a nested query degrades to its inner
@@ -1252,6 +1456,24 @@ def parse_query(body) -> QueryBuilder:
         if doc is None and "documents" in qbody:
             doc = qbody["documents"][0]
         return PercolateQueryBuilder(qbody["field"], doc or {})
+    if qtype == "has_child":
+        return HasChildQueryBuilder(
+            qbody["type"], parse_query(qbody.get("query")),
+            score_mode=qbody.get("score_mode", "none"),
+            min_children=int(qbody.get("min_children", 1) or 1),
+            max_children=qbody.get("max_children"),
+            boost=float(qbody.get("boost", 1.0)),
+        )
+    if qtype == "has_parent":
+        return HasParentQueryBuilder(
+            qbody["parent_type"], parse_query(qbody.get("query")),
+            score=bool(qbody.get("score", False)),
+            boost=float(qbody.get("boost", 1.0)),
+        )
+    if qtype == "parent_id":
+        return ParentIdQueryBuilder(
+            qbody["type"], qbody["id"], boost=float(qbody.get("boost", 1.0)),
+        )
     if qtype == "nested":
         return NestedQueryBuilder(
             qbody["path"], parse_query(qbody["query"]),

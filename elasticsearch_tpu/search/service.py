@@ -84,7 +84,7 @@ class ShardSearcher:
         self.shard_id = shard_id
         self.engine = engine
         self.mapper_service = mapper_service
-        self.ctx = ShardQueryContext(mapper_service)
+        self.ctx = ShardQueryContext(mapper_service, engine=engine)
         self.query_total = 0
         self.query_time = 0.0
         self.fetch_total = 0

@@ -1,0 +1,189 @@
+"""Parent-join module: join field, has_child/has_parent/parent_id queries,
+children agg (ref: modules/parent-join — ParentJoinFieldMapper,
+HasChildQueryBuilder:62, HasParentQueryBuilder, ChildrenAggregationBuilder)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import MapperParsingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+def hit_ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+@pytest.fixture()
+def qa():
+    """question/answer corpus: q1 has 2 answers, q2 has 1, q3 has none."""
+    idx = IndexService("qa", Settings({"index.number_of_shards": 1}))
+    idx.put_mapping({"properties": {
+        "my_join": {"type": "join", "relations": {"question": "answer"}},
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "votes": {"type": "long"},
+    }})
+    idx.index_doc("q1", {"my_join": "question", "title": "how to train a dog"})
+    idx.index_doc("q2", {"my_join": "question", "title": "how to cook rice"})
+    idx.index_doc("q3", {"my_join": "question", "title": "unanswered question"})
+    idx.index_doc("a1", {"my_join": {"name": "answer", "parent": "q1"},
+                         "body": "use positive reinforcement", "votes": 5})
+    idx.index_doc("a2", {"my_join": {"name": "answer", "parent": "q1"},
+                         "body": "daily training with treats", "votes": 2})
+    idx.index_doc("a3", {"my_join": {"name": "answer", "parent": "q2"},
+                         "body": "use a rice cooker", "votes": 9})
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+class TestJoinField:
+    def test_term_query_on_relation(self, qa):
+        resp = qa.search({"query": {"term": {"my_join": "question"}}})
+        assert hit_ids(resp) == ["q1", "q2", "q3"]
+        resp = qa.search({"query": {"term": {"my_join": "answer"}}})
+        assert hit_ids(resp) == ["a1", "a2", "a3"]
+
+    def test_child_requires_parent(self, qa):
+        with pytest.raises(MapperParsingException):
+            qa.index_doc("bad", {"my_join": "answer"})
+
+    def test_unknown_relation_rejected(self, qa):
+        with pytest.raises(MapperParsingException):
+            qa.index_doc("bad", {"my_join": "comment"})
+
+    def test_parent_with_parent_param_rejected(self, qa):
+        with pytest.raises(MapperParsingException):
+            qa.index_doc("bad", {"my_join": {"name": "question", "parent": "q1"}})
+
+
+class TestHasChild:
+    def test_basic(self, qa):
+        resp = qa.search({"query": {"has_child": {
+            "type": "answer", "query": {"match": {"body": "training"}}}}})
+        assert hit_ids(resp) == ["q1"]
+
+    def test_all_children(self, qa):
+        resp = qa.search({"query": {"has_child": {
+            "type": "answer", "query": {"match_all": {}}}}})
+        assert hit_ids(resp) == ["q1", "q2"]  # q3 has no answers
+
+    def test_min_children(self, qa):
+        resp = qa.search({"query": {"has_child": {
+            "type": "answer", "query": {"match_all": {}}, "min_children": 2}}})
+        assert hit_ids(resp) == ["q1"]
+
+    def test_max_children(self, qa):
+        resp = qa.search({"query": {"has_child": {
+            "type": "answer", "query": {"match_all": {}}, "max_children": 1}}})
+        assert hit_ids(resp) == ["q2"]
+
+    def test_score_mode_sum(self, qa):
+        resp = qa.search({"query": {"has_child": {
+            "type": "answer",
+            "query": {"function_score": {
+                "query": {"match_all": {}},
+                "field_value_factor": {"field": "votes"},
+                "boost_mode": "replace"}},
+            "score_mode": "sum"}}})
+        by_id = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+        assert by_id["q1"] == pytest.approx(7.0)  # 5 + 2
+        assert by_id["q2"] == pytest.approx(9.0)
+        assert resp["hits"]["hits"][0]["_id"] == "q2"
+
+    def test_score_mode_max_min_avg(self, qa):
+        for mode, expected_q1 in (("max", 5.0), ("min", 2.0), ("avg", 3.5)):
+            resp = qa.search({"query": {"has_child": {
+                "type": "answer",
+                "query": {"function_score": {
+                    "query": {"match_all": {}},
+                    "field_value_factor": {"field": "votes"},
+                    "boost_mode": "replace"}},
+                "score_mode": mode}}})
+            by_id = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+            assert by_id["q1"] == pytest.approx(expected_q1), mode
+
+
+class TestHasParent:
+    def test_basic(self, qa):
+        resp = qa.search({"query": {"has_parent": {
+            "parent_type": "question", "query": {"match": {"title": "dog"}}}}})
+        assert hit_ids(resp) == ["a1", "a2"]
+
+    def test_score_true(self, qa):
+        resp = qa.search({"query": {"has_parent": {
+            "parent_type": "question", "query": {"match": {"title": "dog"}},
+            "score": True}}})
+        scores = [h["_score"] for h in resp["hits"]["hits"]]
+        assert all(s > 0 for s in scores)
+        assert scores[0] == scores[1]  # both children get the parent's score
+
+
+class TestParentId:
+    def test_parent_id(self, qa):
+        resp = qa.search({"query": {"parent_id": {"type": "answer", "id": "q1"}}})
+        assert hit_ids(resp) == ["a1", "a2"]
+        resp = qa.search({"query": {"parent_id": {"type": "answer", "id": "q3"}}})
+        assert hit_ids(resp) == []
+
+
+class TestChildrenAgg:
+    def test_children_agg(self, qa):
+        resp = qa.search({
+            "size": 0,
+            "query": {"match": {"title": "dog"}},
+            "aggs": {"answers": {
+                "children": {"type": "answer"},
+                "aggs": {"total_votes": {"sum": {"field": "votes"}}},
+            }},
+        })
+        agg = resp["aggregations"]["answers"]
+        assert agg["doc_count"] == 2
+        assert agg["total_votes"]["value"] == pytest.approx(7.0)
+
+    def test_children_under_terms(self, qa):
+        resp = qa.search({
+            "size": 0,
+            "aggs": {"questions": {
+                "terms": {"field": "my_join"},
+                "aggs": {"kids": {"children": {"type": "answer"}}},
+            }},
+        })
+        buckets = {b["key"]: b for b in
+                   resp["aggregations"]["questions"]["buckets"]}
+        assert buckets["question"]["kids"]["doc_count"] == 3
+
+    def test_multishard_child_requires_routing(self):
+        """RoutingMissingException parity: on multi-shard indices a child
+        without routing is rejected; with routing=parent it joins."""
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+        idx = IndexService("qa3", Settings({"index.number_of_shards": 3}))
+        idx.put_mapping({"properties": {
+            "j": {"type": "join", "relations": {"p": "c"}}}})
+        idx.index_doc("p1", {"j": "p"})
+        with pytest.raises(IllegalArgumentException):
+            idx.index_doc("c1", {"j": {"name": "c", "parent": "p1"}})
+        idx.index_doc("c1", {"j": {"name": "c", "parent": "p1"}}, routing="p1")
+        idx.refresh()
+        resp = idx.search({"query": {"has_child": {
+            "type": "c", "query": {"match_all": {}}}}})
+        assert hit_ids(resp) == ["p1"]
+        idx.close()
+
+    def test_cross_segment_join(self):
+        """Parent and child in different segments (separate refreshes)."""
+        idx = IndexService("qa2", Settings({"index.number_of_shards": 1}))
+        idx.put_mapping({"properties": {
+            "j": {"type": "join", "relations": {"p": "c"}}}})
+        idx.index_doc("p1", {"j": "p"})
+        idx.refresh()  # segment 1: parent
+        idx.index_doc("c1", {"j": {"name": "c", "parent": "p1"}})
+        idx.refresh()  # segment 2: child
+        resp = idx.search({"query": {"has_child": {
+            "type": "c", "query": {"match_all": {}}}}})
+        assert hit_ids(resp) == ["p1"]
+        resp = idx.search({"query": {"has_parent": {
+            "parent_type": "p", "query": {"match_all": {}}}}})
+        assert hit_ids(resp) == ["c1"]
+        idx.close()
