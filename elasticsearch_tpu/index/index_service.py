@@ -124,10 +124,11 @@ class IndexService:
         shard = self.shards[self._route(doc_id, routing)]
         existing = shard.get_doc(doc_id)
         if not existing.found:
+            # upserts go through index_doc so join-routing checks apply
             if body.get("doc_as_upsert") and "doc" in body:
-                return shard.index_doc(doc_id, body["doc"], routing)
+                return self.index_doc(doc_id, body["doc"], routing)
             if "upsert" in body:
-                return shard.index_doc(doc_id, body["upsert"], routing)
+                return self.index_doc(doc_id, body["upsert"], routing)
             raise DocumentMissingException(self.name, doc_id)
         if "doc" in body:
             merged = _deep_merge(dict(existing.source), body["doc"])
@@ -136,7 +137,7 @@ class IndexService:
                     "_index": self.name, "_id": doc_id,
                     "_version": existing.version, "result": "noop",
                 }
-            return shard.index_doc(doc_id, merged, routing)
+            return self.index_doc(doc_id, merged, routing)
         raise DocumentMissingException(self.name, doc_id)
 
     def refresh(self) -> None:

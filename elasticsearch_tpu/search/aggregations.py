@@ -762,23 +762,18 @@ def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
                 seg = v.segment
                 for local in np.nonzero(v.mask[: seg.nd_pad])[0]:
                     parent_ids.add(seg.doc_ids[int(local)])
+        from elasticsearch_tpu.search.query_dsl import join_children
+
         sub_views = []
         total = 0
         for v in views:
             seg = v.segment
             mask = np.zeros_like(v.mask)
             if jf is not None:
-                col = seg.ordinal_columns.get(jf.name)
-                pcol = seg.ordinal_columns.get(f"{jf.name}#parent")
-                if col is not None and pcol is not None:
-                    child_ord = col.ord_of(child_type)
-                    if child_ord >= 0:
-                        is_child = (col.first_ord == child_ord) & pcol.exists
-                        for local in np.nonzero(
-                                is_child & seg.live[: seg.nd_pad])[0]:
-                            pid = pcol.terms[pcol.first_ord[int(local)]]
-                            if pid in parent_ids:
-                                mask[int(local)] = True
+                locals_, pids = join_children(seg, jf.name, [child_type])
+                for local, pid in zip(locals_, pids):
+                    if pid in parent_ids:
+                        mask[int(local)] = True
             total += int(mask[: seg.nd_pad].sum())
             sub_views.append(v.with_mask(mask))
         result = {"doc_count": total}

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import fnmatch
 import re
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -1052,6 +1052,43 @@ def _require_join_field(ctx):
     return jf
 
 
+def join_columns(segment, join_field: str):
+    """(relation ordinal column, parent-id ordinal column) or None — the
+    single place that knows the '<field>#parent' encoding."""
+    col = segment.ordinal_columns.get(join_field)
+    pcol = segment.ordinal_columns.get(f"{join_field}#parent")
+    if col is None or pcol is None:
+        return None
+    return col, pcol
+
+
+def join_children(segment, join_field: str, child_names) -> Tuple[np.ndarray, List[str]]:
+    """Vectorized child-doc selection: live docs whose relation is one of
+    child_names and that carry a parent id. -> (local docs, parent ids)."""
+    cols = join_columns(segment, join_field)
+    if cols is None:
+        return np.empty(0, dtype=np.int64), []
+    col, pcol = cols
+    child_ords = [o for o in (col.ord_of(c) for c in child_names) if o >= 0]
+    if not child_ords:
+        return np.empty(0, dtype=np.int64), []
+    sel = (np.isin(col.first_ord, child_ords) & pcol.exists
+           & segment.live[: segment.nd_pad])
+    locals_ = np.nonzero(sel)[0]
+    pids = [pcol.terms[pcol.first_ord[int(d)]] for d in locals_]
+    return locals_, pids
+
+
+def parent_id_of(segment, join_field: str, local: int) -> Optional[str]:
+    cols = join_columns(segment, join_field)
+    if cols is None:
+        return None
+    _, pcol = cols
+    if not pcol.exists[local]:
+        return None
+    return pcol.terms[pcol.first_ord[local]]
+
+
 def _matched_by_relation(ctx, segment, query: QueryBuilder, jf,
                          relation_name: str):
     """Run `query` over every segment of the shard, restricted to docs of
@@ -1099,6 +1136,10 @@ class HasChildQueryBuilder(QueryBuilder):
         super().__init__(**kw)
         self.type = type_
         self.query = query
+        if score_mode not in ("none", "min", "max", "sum", "avg"):
+            raise ParsingException(
+                f"[has_child] query does not support [score_mode] = [{score_mode}]"
+            )
         self.score_mode = score_mode
         self.min_children = max(int(min_children), 1)
         self.max_children = int(max_children) if max_children else None
@@ -1112,11 +1153,9 @@ class HasChildQueryBuilder(QueryBuilder):
             parent_scores: Dict[str, List[float]] = {}
             for seg2, local, score in _matched_by_relation(
                     ctx, segment, self.query, jf, self.type):
-                pcol = seg2.ordinal_columns.get(f"{jf.name}#parent")
-                if pcol is None or not pcol.exists[local]:
-                    continue
-                pid = pcol.terms[pcol.first_ord[local]]
-                parent_scores.setdefault(pid, []).append(score)
+                pid = parent_id_of(seg2, jf.name, local)
+                if pid is not None:
+                    parent_scores.setdefault(pid, []).append(score)
             self._cached_parent_scores = parent_scores
         return self._cached_parent_scores
 
@@ -1183,24 +1222,15 @@ class HasParentQueryBuilder(QueryBuilder):
 
         if not parent_score:
             return P.MatchNoneNode()
-        pcol = segment.ordinal_columns.get(f"{jf.name}#parent")
-        col = segment.ordinal_columns.get(jf.name)
-        if pcol is None or col is None:
-            return P.MatchNoneNode()
-        child_names = set(jf.relations.get(self.parent_type, []))
-        child_ords = {col.ord_of(c) for c in child_names} - {-1}
+        child_names = jf.relations.get(self.parent_type, [])
+        locals_, pids = join_children(segment, jf.name, child_names)
         nd1 = segment.nd_pad + 1
         mask = np.zeros(nd1, dtype=bool)
         sc = np.zeros(nd1, dtype=np.float32)
-        for local in range(segment.num_docs):
-            if not segment.live[local] or col.first_ord[local] not in child_ords:
-                continue
-            if not pcol.exists[local]:
-                continue
-            pid = pcol.terms[pcol.first_ord[local]]
+        for local, pid in zip(locals_, pids):
             if pid in parent_score:
-                mask[local] = True
-                sc[local] = parent_score[pid] if self.score else 1.0
+                mask[int(local)] = True
+                sc[int(local)] = parent_score[pid] if self.score else 1.0
         if not mask.any():
             return P.MatchNoneNode()
         return self._wrap_boost(P.DenseScoreNode(sc, mask, "has_parent"))
@@ -1481,4 +1511,8 @@ def parse_query(body) -> QueryBuilder:
         )
     if qtype == "type":
         return MatchAllQueryBuilder()  # single doc type in 6.x
+    from elasticsearch_tpu.search.spans import SPAN_TYPES, parse_span_query
+
+    if qtype in SPAN_TYPES:
+        return parse_span_query(body)
     raise ParsingException(f"no [query] registered for [{qtype}]")

@@ -1,0 +1,138 @@
+"""Span query family (ref: index/query/Span*QueryBuilder)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+def hit_ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+@pytest.fixture()
+def idx():
+    idx = IndexService("spans", Settings({"index.number_of_shards": 1}))
+    docs = {
+        "1": "the quick brown fox jumps over the lazy dog",
+        "2": "the brown quick fox sleeps",
+        "3": "quick thinking saved the brown bear",
+        "4": "a fox and a dog",
+    }
+    for i, text in docs.items():
+        idx.index_doc(i, {"body": text})
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+class TestSpanTerm:
+    def test_span_term(self, idx):
+        resp = idx.search({"query": {"span_term": {"body": "fox"}}})
+        assert hit_ids(resp) == ["1", "2", "4"]
+
+    def test_span_term_scores_like_term(self, idx):
+        resp = idx.search({"query": {"span_term": {"body": "fox"}}})
+        assert all(h["_score"] > 0 for h in resp["hits"]["hits"])
+
+
+class TestSpanNear:
+    def test_in_order_adjacent(self, idx):
+        resp = idx.search({"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "brown"}}],
+            "slop": 0, "in_order": True}}})
+        assert hit_ids(resp) == ["1"]
+
+    def test_unordered(self, idx):
+        resp = idx.search({"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "brown"}}],
+            "slop": 0, "in_order": False}}})
+        assert hit_ids(resp) == ["1", "2"]
+
+    def test_slop(self, idx):
+        # doc 3: "quick thinking saved the brown" — gap of 3
+        resp = idx.search({"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "brown"}}],
+            "slop": 3, "in_order": True}}})
+        assert hit_ids(resp) == ["1", "3"]
+
+
+class TestSpanFirst:
+    def test_span_first(self, idx):
+        # "quick" within the first 2 positions: doc 3 (pos 0); doc 1 has pos 1
+        resp = idx.search({"query": {"span_first": {
+            "match": {"span_term": {"body": "quick"}}, "end": 2}}})
+        assert hit_ids(resp) == ["1", "3"]
+        resp = idx.search({"query": {"span_first": {
+            "match": {"span_term": {"body": "quick"}}, "end": 1}}})
+        assert hit_ids(resp) == ["3"]
+
+
+class TestSpanOrNot:
+    def test_span_or(self, idx):
+        resp = idx.search({"query": {"span_or": {
+            "clauses": [{"span_term": {"body": "bear"}},
+                        {"span_term": {"body": "dog"}}]}}})
+        assert hit_ids(resp) == ["1", "3", "4"]
+
+    def test_span_not(self, idx):
+        # fox not immediately preceded by brown: doc2 "quick fox" wait —
+        # doc1 "brown fox", doc2 "quick fox", doc4 "a fox"
+        resp = idx.search({"query": {"span_not": {
+            "include": {"span_term": {"body": "fox"}},
+            "exclude": {"span_term": {"body": "brown"}},
+            "pre": 1}}})
+        assert hit_ids(resp) == ["2", "4"]
+
+
+class TestSpanContainingWithin:
+    def test_span_containing(self, idx):
+        big = {"span_near": {"clauses": [{"span_term": {"body": "quick"}},
+                                         {"span_term": {"body": "fox"}}],
+                             "slop": 1, "in_order": True}}
+        resp = idx.search({"query": {"span_containing": {
+            "little": {"span_term": {"body": "brown"}}, "big": big}}})
+        assert hit_ids(resp) == ["1"]
+
+    def test_span_within(self, idx):
+        big = {"span_near": {"clauses": [{"span_term": {"body": "quick"}},
+                                         {"span_term": {"body": "fox"}}],
+                             "slop": 1, "in_order": True}}
+        resp = idx.search({"query": {"span_within": {
+            "little": {"span_term": {"body": "brown"}}, "big": big}}})
+        assert hit_ids(resp) == ["1"]
+
+
+class TestSpanMulti:
+    def test_span_multi_prefix(self, idx):
+        resp = idx.search({"query": {"span_near": {
+            "clauses": [
+                {"span_multi": {"match": {"prefix": {"body": "qui"}}}},
+                {"span_term": {"body": "brown"}},
+            ], "slop": 0, "in_order": True}}})
+        assert hit_ids(resp) == ["1"]
+
+    def test_span_multi_rejects_match(self, idx):
+        with pytest.raises(ParsingException):
+            idx.search({"query": {"span_multi": {
+                "match": {"match": {"body": "quick"}}}}})
+
+
+class TestSpanCompose:
+    def test_span_inside_bool(self, idx):
+        resp = idx.search({"query": {"bool": {
+            "must": [{"span_near": {
+                "clauses": [{"span_term": {"body": "quick"}},
+                            {"span_term": {"body": "brown"}}],
+                "slop": 0, "in_order": True}}],
+            "must_not": [{"term": {"body": "bear"}}]}}})
+        assert hit_ids(resp) == ["1"]
+
+    def test_non_span_in_clauses_rejected(self, idx):
+        with pytest.raises(ParsingException):
+            idx.search({"query": {"span_near": {
+                "clauses": [{"term": {"body": "quick"}}], "slop": 0}}})
