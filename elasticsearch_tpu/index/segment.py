@@ -90,6 +90,23 @@ class OrdinalColumn:
 
 
 @dataclass
+class NestedContext:
+    """A nested path's sub-segment + the join to parent docs.
+
+    The reference interleaves nested child docs into the parent's Lucene
+    block and joins with ToParentBlockJoinQuery (modules/parent-join uses
+    the same machinery). The TPU-native inversion: nested objects form a
+    separate dense table with an explicit ``parent_of`` pointer column;
+    the child→parent join is a scatter (segment-sum) by parent id — a
+    single vectorized pass instead of per-doc block walking.
+    """
+
+    segment: "Segment"  # rows = nested objects; columns keyed by full path
+    parent_of: np.ndarray  # [n_objs] int32 local doc in the enclosing segment
+    offset_of: np.ndarray  # [n_objs] int32 index within the parent's array
+
+
+@dataclass
 class GeoColumn:
     lat: np.ndarray  # [n_vals] float32
     lon: np.ndarray  # [n_vals] float32
@@ -131,6 +148,7 @@ class Segment:
         geo_columns: Dict[str, GeoColumn],
         exists_masks: Dict[str, np.ndarray],
         positions: Optional[Dict[int, dict]] = None,
+        nested: Optional[Dict[str, NestedContext]] = None,
     ):
         self.name = name
         self.num_docs = num_docs
@@ -158,6 +176,8 @@ class Segment:
         self.exists_masks = exists_masks  # field -> [nd_pad] bool
         # term_id -> {local_doc: np.ndarray positions} for phrase queries
         self.positions = positions or {}
+        # nested path -> NestedContext (sub-segment + parent pointers)
+        self.nested = nested or {}
         # tombstones for deleted docs (set by the engine on update/delete)
         self.live = np.ones(self.nd_pad, dtype=bool)
         self.live[num_docs:] = False
@@ -178,7 +198,18 @@ class Segment:
         return self._id_to_doc
 
     def delete_doc(self, local_doc: int) -> None:
-        self.live[local_doc] = False
+        self.delete_docs(np.asarray([local_doc], dtype=np.int64))
+
+    def delete_docs(self, locals_: np.ndarray) -> None:
+        if locals_.size == 0:
+            return
+        self.live[locals_] = False
+        for nctx in self.nested.values():
+            # nested objects die with their parent (Lucene deletes the
+            # whole block); keeps the sub-segment's live masks consistent
+            # recursively, one restage per level
+            objs = np.nonzero(np.isin(nctx.parent_of, locals_))[0]
+            nctx.segment.delete_docs(objs)
         if self._device is not None:  # restage only the live mask
             import jax.numpy as jnp
 
@@ -280,6 +311,9 @@ class SegmentBuilder:
         self.string_values: Dict[str, List[Tuple[int, str]]] = {}
         self.geo_values: Dict[str, List[Tuple[int, float, float]]] = {}
         self.field_docs: Dict[str, set] = {}
+        # nested path -> {"builder": SegmentBuilder, "parent_of": [...],
+        #                 "offset_of": [...]}
+        self.nested_builders: Dict[str, dict] = {}
 
     @property
     def num_docs(self) -> int:
@@ -329,7 +363,33 @@ class SegmentBuilder:
             self.numeric_values.setdefault(f"{field_name}#hi", []).extend(
                 (doc, hi) for _, hi in pairs
             )
+        self._add_nested(getattr(parsed, "nested", None) or {}, doc)
         return doc
+
+    def _add_nested(self, nested: dict, root_doc: int) -> None:
+        """Flatten nested (and nested-in-nested) sub-documents into
+        per-path builders joined to the root doc."""
+        for path, subdocs in nested.items():
+            entry = self.nested_builders.setdefault(
+                path,
+                {"builder": SegmentBuilder(f"{self.name}#{path}"),
+                 "parent_of": [], "offset_of": [],
+                 "_per_parent": {}},
+            )
+            for sub in subdocs:
+                offset = entry["_per_parent"].get(root_doc, 0)
+                entry["_per_parent"][root_doc] = offset + 1
+                # the sub-builder keeps the inner nested docs too (via its
+                # own add_document recursion): relative joins for
+                # nested-in-nested queries/aggs...
+                inner = getattr(sub, "nested", None)
+                entry["builder"].add_document(sub, seqno=-1)
+                entry["parent_of"].append(root_doc)
+                entry["offset_of"].append(offset)
+                # ...while ALSO flattening them to the root doc, so a
+                # root-level nested path "a.b" query/agg works directly
+                if inner:
+                    self._add_nested(inner, root_doc)
 
     # ------------------------------------------------------------------
 
@@ -466,6 +526,15 @@ class SegmentBuilder:
                 doc: np.asarray(pos, dtype=np.int32) for doc, pos in per_doc.items()
             }
 
+        # --- nested sub-segments ---
+        nested: Dict[str, NestedContext] = {}
+        for path, entry in self.nested_builders.items():
+            nested[path] = NestedContext(
+                segment=entry["builder"].seal(),
+                parent_of=np.asarray(entry["parent_of"], dtype=np.int32),
+                offset_of=np.asarray(entry["offset_of"], dtype=np.int32),
+            )
+
         return Segment(
             name=self.name,
             num_docs=nd,
@@ -488,4 +557,5 @@ class SegmentBuilder:
             geo_columns=geo_columns,
             exists_masks=exists_masks,
             positions=positions,
+            nested=nested,
         )

@@ -23,6 +23,7 @@ import numpy as np
 from elasticsearch_tpu.common.errors import ElasticsearchTpuException
 from elasticsearch_tpu.index.segment import (
     GeoColumn,
+    NestedContext,
     NumericColumn,
     OrdinalColumn,
     Segment,
@@ -51,8 +52,8 @@ class Store:
         for seg in segments:
             if not os.path.exists(self._seg_dir(seg.name)):
                 self.write_segment(seg)
-            # always refresh the live (tombstone) mask — cheap
-            np.save(os.path.join(self._seg_dir(seg.name), "live.npy"), seg.live)
+            # always refresh the live (tombstone) masks — cheap
+            self._refresh_live(seg, self._seg_dir(seg.name))
         commit = {
             "segments": [s.name for s in segments],
             "max_seq_no": int(max_seqno),
@@ -72,6 +73,11 @@ class Store:
 
                 shutil.rmtree(p, ignore_errors=True)
 
+    def _refresh_live(self, seg: Segment, d: str) -> None:
+        np.save(os.path.join(d, "live.npy"), seg.live)
+        for i, (_path, nctx) in enumerate(sorted(seg.nested.items())):
+            self._refresh_live(nctx.segment, os.path.join(d, "nested", str(i)))
+
     def read_commit(self) -> Optional[dict]:
         try:
             with open(self._commit_path(), encoding="utf-8") as f:
@@ -88,7 +94,9 @@ class Store:
     # ------------------------------------------------------------------
 
     def write_segment(self, seg: Segment) -> None:
-        d = self._seg_dir(seg.name)
+        self._write_segment_dir(seg, self._seg_dir(seg.name))
+
+    def _write_segment_dir(self, seg: Segment, d: str) -> None:
         os.makedirs(d, exist_ok=True)
         arrays = {
             "term_block_start": seg.term_block_start,
@@ -151,35 +159,61 @@ class Store:
                  for tid, per_doc in seg.positions.items()},
                 f,
             )
+        # nested sub-segments: one sub-directory per path, recursively
+        if seg.nested:
+            nd = os.path.join(d, "nested")
+            os.makedirs(nd, exist_ok=True)
+            index = {}
+            for i, (path, nctx) in enumerate(sorted(seg.nested.items())):
+                sub = os.path.join(nd, str(i))
+                self._write_segment_dir(nctx.segment, sub)
+                np.save(os.path.join(sub, "parent_of.npy"), nctx.parent_of)
+                np.save(os.path.join(sub, "offset_of.npy"), nctx.offset_of)
+                # re-checksum: the join arrays must be covered too
+                self._write_checksums(sub)
+                index[str(i)] = path
+            with open(os.path.join(nd, "index.json"), "w", encoding="utf-8") as f:
+                json.dump(index, f)
         self._write_checksums(d)
 
     def _write_checksums(self, d: str) -> None:
         sums = {}
-        for fn in ("arrays.npz", "meta.json", "sources.jsonl", "positions.json"):
-            with open(os.path.join(d, fn), "rb") as f:
+        for fn in ("arrays.npz", "meta.json", "sources.jsonl", "positions.json",
+                   "parent_of.npy", "offset_of.npy",
+                   os.path.join("nested", "index.json")):
+            p = os.path.join(d, fn)
+            if not os.path.exists(p):
+                continue
+            with open(p, "rb") as f:
                 sums[fn] = hashlib.sha256(f.read()).hexdigest()
         with open(os.path.join(d, "checksums.json"), "w", encoding="utf-8") as f:
             json.dump(sums, f)
 
     def verify_checksums(self, name: str) -> None:
-        d = self._seg_dir(name)
+        self._verify_checksums_dir(self._seg_dir(name))
+
+    def _verify_checksums_dir(self, d: str) -> None:
         try:
             with open(os.path.join(d, "checksums.json"), encoding="utf-8") as f:
                 sums = json.load(f)
         except FileNotFoundError:
-            raise CorruptIndexException(f"segment [{name}] missing checksums") from None
+            raise CorruptIndexException(
+                f"segment [{os.path.basename(d)}] missing checksums"
+            ) from None
         for fn, expected in sums.items():
             with open(os.path.join(d, fn), "rb") as f:
                 actual = hashlib.sha256(f.read()).hexdigest()
             if actual != expected:
                 raise CorruptIndexException(
-                    f"checksum failed for [{name}/{fn}] (stored={expected[:12]}, "
-                    f"actual={actual[:12]})"
+                    f"checksum failed for [{os.path.basename(d)}/{fn}] "
+                    f"(stored={expected[:12]}, actual={actual[:12]})"
                 )
 
     def read_segment(self, name: str) -> Segment:
-        d = self._seg_dir(name)
-        self.verify_checksums(name)
+        return self._read_segment_dir(self._seg_dir(name))
+
+    def _read_segment_dir(self, d: str) -> Segment:
+        self._verify_checksums_dir(d)
         with open(os.path.join(d, "meta.json"), encoding="utf-8") as f:
             meta = json.load(f)
         data = np.load(os.path.join(d, "arrays.npz"))
@@ -258,4 +292,15 @@ class Store:
         live_path = os.path.join(d, "live.npy")
         if os.path.exists(live_path):
             seg.live = np.load(live_path)
+        nested_index = os.path.join(d, "nested", "index.json")
+        if os.path.exists(nested_index):
+            with open(nested_index, encoding="utf-8") as f:
+                index = json.load(f)
+            for i, path in index.items():
+                sub = os.path.join(d, "nested", i)
+                seg.nested[path] = NestedContext(
+                    segment=self._read_segment_dir(sub),
+                    parent_of=np.load(os.path.join(sub, "parent_of.npy")),
+                    offset_of=np.load(os.path.join(sub, "offset_of.npy")),
+                )
         return seg

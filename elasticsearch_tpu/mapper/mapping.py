@@ -51,6 +51,11 @@ class ParsedDocument:
     field_names: List[str] = field(default_factory=list)
     # dynamic mapping update produced while parsing, or None
     mapping_update: Optional[dict] = None
+    # nested path -> sub-documents (one per nested object, in source order).
+    # The reference indexes these as separate Lucene docs in the same block
+    # (DocumentParser nested handling); here they become rows of a per-path
+    # nested sub-segment joined to the parent by an explicit pointer column.
+    nested: Dict[str, List["ParsedDocument"]] = field(default_factory=dict)
 
 
 class DocumentMapper:
@@ -63,6 +68,8 @@ class DocumentMapper:
         self.total_fields_limit = total_fields_limit
         self.fields: Dict[str, FieldType] = {}
         self._object_paths: set = set()
+        # nested object paths ("type": "nested") -> their mapping params
+        self.nested_paths: Dict[str, dict] = {}
         self._compile("", mapping.get("properties", {}))
         if len(self.fields) > total_fields_limit:
             raise IllegalArgumentException(
@@ -72,6 +79,11 @@ class DocumentMapper:
     def _compile(self, prefix: str, properties: dict) -> None:
         for name, params in properties.items():
             path = f"{prefix}{name}"
+            if params.get("type") == "nested":
+                self._object_paths.add(path)
+                self.nested_paths[path] = params
+                self._compile(path + ".", params.get("properties", {}))
+                continue
             if "properties" in params and "type" not in params:
                 self._object_paths.add(path)
                 self._compile(path + ".", params["properties"])
@@ -121,6 +133,9 @@ class DocumentMapper:
             path = f"{prefix}{key}"
             if value is None:
                 self._index_null(path, out)
+                continue
+            if path in self.nested_paths:
+                self._parse_nested(path, key, value, out, props, new_props, dynamic)
                 continue
             ft = self.fields.get(path)
             if ft is None and path in self._object_paths and not isinstance(value, dict):
@@ -175,6 +190,47 @@ class DocumentMapper:
                         kw_path, {"type": "keyword", "ignore_above": 256}
                     )
             self._index_value(ft, value, out)
+
+    def _parse_nested(self, path: str, key: str, value: Any, out: ParsedDocument,
+                      props: dict, new_props: dict, dynamic: str) -> None:
+        """Each object under a nested path becomes its own sub-document
+        (the reference's block-join child docs, DocumentParser nested
+        handling); fields are keyed by full path within the sub-doc."""
+        objs = value if isinstance(value, list) else [value]
+        sub_props = props.get(key, {}).get("properties", {})
+        params_n = self.nested_paths[path]
+        sub_new = (
+            new_props.setdefault(key, {"type": "nested", "properties": {}})["properties"]
+            if dynamic == "true" else {}
+        )
+        for obj in objs:
+            if obj is None:
+                continue  # the reference skips null array elements
+            if not isinstance(obj, dict):
+                raise MapperParsingException(
+                    f"object mapping for [{path}] tried to parse field [{key}] as "
+                    "object, but found a concrete value"
+                )
+            sub = ParsedDocument(doc_id=out.doc_id, source=obj, routing=None)
+            self._parse_object(path + ".", obj, sub, sub_props, sub_new, dynamic)
+            sub.field_names = sorted(
+                set(sub.terms) | set(sub.numeric_values) | set(sub.string_values)
+                | set(sub.geo_values) | set(sub.range_values)
+            )
+            out.nested.setdefault(path, []).append(sub)
+            if params_n.get("include_in_parent") or params_n.get("include_in_root"):
+                # copy the object's flat fields onto the enclosing doc —
+                # but NOT its inner nested docs, which `sub` already
+                # carries (they would double-index otherwise)
+                inc = ParsedDocument(doc_id=out.doc_id, source=obj, routing=None)
+                self._parse_object(path + ".", obj, inc, sub_props,
+                                   sub_new if dynamic == "true" else {}, dynamic)
+                for store in ("terms", "numeric_values", "string_values",
+                              "geo_values", "range_values"):
+                    for f, vals in getattr(inc, store).items():
+                        getattr(out, store).setdefault(f, []).extend(vals)
+        if dynamic == "true" and not sub_new:
+            new_props.pop(key, None)
 
     def _dynamic_type_for(self, sample: Any) -> dict:
         """Dynamic mapping rules (DocumentParser.createBuilderFromFieldType)."""

@@ -33,7 +33,8 @@ from elasticsearch_tpu.ops import aggs as agg_ops
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing", "significant_terms",
-                "sampler", "adjacency_matrix", "geohash_grid", "children"}
+                "sampler", "adjacency_matrix", "geohash_grid", "children",
+                "nested", "reverse_nested"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "stats", "extended_stats",
                 "value_count", "cardinality", "percentiles", "top_hits",
                 "geo_bounds", "geo_centroid", "matrix_stats"}
@@ -79,14 +80,20 @@ class SegmentView:
     """One segment + the matched mask for the current (sub-)aggregation."""
 
     def __init__(self, segment, mask: np.ndarray, shard_ctx=None,
-                 scores: Optional[np.ndarray] = None):
+                 scores: Optional[np.ndarray] = None, nested_ctx=None,
+                 root_view: Optional["SegmentView"] = None):
         self.segment = segment
         self.mask = mask  # np bool [nd1], already includes live
         self.shard_ctx = shard_ctx  # ShardQueryContext for filter aggs
         self.scores = scores  # np f32 [nd1] (top_hits)
+        # set when this view ranges over a nested sub-segment: the join
+        # back to the enclosing docs (for reverse_nested)
+        self.nested_ctx = nested_ctx
+        self.root_view = root_view
 
     def with_mask(self, mask: np.ndarray) -> "SegmentView":
-        return SegmentView(self.segment, mask, self.shard_ctx, self.scores)
+        return SegmentView(self.segment, mask, self.shard_ctx, self.scores,
+                           self.nested_ctx, self.root_view)
 
 
 def _resolve_value_field(segment, field: str):
@@ -741,6 +748,69 @@ def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
                 b.update(run_aggregations(spec.subs, empty_views))
             buckets.append(b)
         return {"buckets": buckets}
+
+    if spec.type == "nested":
+        # nested agg (search/aggregations/bucket/nested/NestedAggregator):
+        # flips the doc context from matched parents to their nested
+        # objects at `path`; sub-aggs read the sub-segment's columns
+        # (keyed by full field path)
+        path = spec.body.get("path")
+        sub_views = []
+        doc_count = 0
+        for v in views:
+            nctx = v.segment.nested.get(path)
+            if nctx is None or nctx.segment.num_docs == 0:
+                continue
+            n = nctx.parent_of.shape[0]
+            nseg = nctx.segment
+            m = np.zeros(nseg.nd_pad + 1, dtype=bool)
+            m[:n] = v.mask[nctx.parent_of] & nseg.live[:n]
+            doc_count += int(m.sum())
+            sub_views.append(SegmentView(nseg, m, v.shard_ctx,
+                                         nested_ctx=nctx, root_view=v))
+        result = {"doc_count": doc_count}
+        if spec.subs:
+            result.update(run_aggregations(spec.subs, sub_views))
+        return result
+
+    if spec.type == "reverse_nested":
+        # reverse_nested (bucket/nested/ReverseNestedAggregator): joins
+        # back from nested objects to the enclosing root docs (optionally
+        # re-descending into another nested `path`)
+        target_path = spec.body.get("path")
+        sub_views = []
+        doc_count = 0
+        for v in views:
+            nctx, rv = v.nested_ctx, v.root_view
+            if nctx is None or rv is None:
+                raise ParsingException(
+                    "Reverse nested aggregation must be nested in a nested "
+                    "aggregation"
+                )
+            n = nctx.parent_of.shape[0]
+            rm = np.zeros(rv.segment.nd_pad + 1, dtype=bool)
+            objs = np.nonzero(v.mask[:n])[0]
+            rm[nctx.parent_of[objs]] = True
+            rm[: rv.segment.nd_pad] &= rv.segment.live
+            if target_path is None:
+                doc_count += int(rm.sum())
+                sub_views.append(SegmentView(rv.segment, rm, rv.shard_ctx,
+                                             rv.scores))
+            else:
+                tctx = rv.segment.nested.get(target_path)
+                if tctx is None or tctx.segment.num_docs == 0:
+                    continue
+                tn = tctx.parent_of.shape[0]
+                tseg = tctx.segment
+                tm = np.zeros(tseg.nd_pad + 1, dtype=bool)
+                tm[:tn] = rm[tctx.parent_of] & tseg.live[:tn]
+                doc_count += int(tm.sum())
+                sub_views.append(SegmentView(tseg, tm, rv.shard_ctx,
+                                             nested_ctx=tctx, root_view=rv))
+        result = {"doc_count": doc_count}
+        if spec.subs:
+            result.update(run_aggregations(spec.subs, sub_views))
+        return result
 
     if spec.type == "children":
         # children agg (modules/parent-join — ChildrenAggregationBuilder):

@@ -1132,7 +1132,8 @@ class HasChildQueryBuilder(QueryBuilder):
     name = "has_child"
 
     def __init__(self, type_: str, query: QueryBuilder, score_mode: str = "none",
-                 min_children: int = 1, max_children: Optional[int] = None, **kw):
+                 min_children: int = 1, max_children: Optional[int] = None,
+                 inner_hits: Optional[dict] = None, **kw):
         super().__init__(**kw)
         self.type = type_
         self.query = query
@@ -1143,21 +1144,47 @@ class HasChildQueryBuilder(QueryBuilder):
         self.score_mode = score_mode
         self.min_children = max(int(min_children), 1)
         self.max_children = int(max_children) if max_children else None
-        self._cached_parent_scores: Optional[Dict[str, List[float]]] = None
+        self.inner_hits = inner_hits
+        # pid -> list of (score, child segment, child local doc)
+        self._cached_child_hits: Optional[Dict[str, List[tuple]]] = None
 
-    def _parent_scores(self, ctx, segment, jf) -> Dict[str, List[float]]:
+    def _child_hits(self, ctx, segment, jf) -> Dict[str, List[tuple]]:
         """Child-side pass, computed ONCE per query execution (builders are
         parsed fresh per request; to_plan runs per segment — memoizing here
         avoids O(segments^2) inner-query executions)."""
-        if self._cached_parent_scores is None:
-            parent_scores: Dict[str, List[float]] = {}
+        if self._cached_child_hits is None:
+            child_hits: Dict[str, List[tuple]] = {}
             for seg2, local, score in _matched_by_relation(
                     ctx, segment, self.query, jf, self.type):
                 pid = parent_id_of(seg2, jf.name, local)
                 if pid is not None:
-                    parent_scores.setdefault(pid, []).append(score)
-            self._cached_parent_scores = parent_scores
-        return self._cached_parent_scores
+                    child_hits.setdefault(pid, []).append((score, seg2, local))
+            self._cached_child_hits = child_hits
+        return self._cached_child_hits
+
+    def inner_hits_for(self, ctx, segment, local_doc: int, index_name: str):
+        """Matching child docs of one parent hit."""
+        spec = self.inner_hits if isinstance(self.inner_hits, dict) else {}
+        jf = _require_join_field(ctx)
+        entries = self._child_hits(ctx, segment, jf).get(
+            segment.doc_ids[local_doc], [])
+        entries = sorted(entries, key=lambda e: (-e[0], e[2]))
+        name = spec.get("name", self.type)
+        frm = int(spec.get("from", 0) or 0)
+        size = int(spec.get("size", 3) if spec.get("size") is not None else 3)
+        hits = [
+            {
+                "_index": index_name,
+                "_type": "_doc",
+                "_id": seg2.doc_ids[loc],
+                "_score": float(score),
+                "_source": seg2.sources[loc],
+            }
+            for score, seg2, loc in entries[frm:frm + size]
+        ]
+        max_score = float(entries[0][0]) if entries else None
+        return name, {"hits": {"total": len(entries), "max_score": max_score,
+                               "hits": hits}}
 
     def to_plan(self, ctx, segment):
         jf = _require_join_field(ctx)
@@ -1166,7 +1193,7 @@ class HasChildQueryBuilder(QueryBuilder):
             raise QueryShardException(
                 f"[has_child] join relation [{self.type}] is not a child"
             )
-        parent_scores = self._parent_scores(ctx, segment, jf)
+        child_hits = self._child_hits(ctx, segment, jf)
 
         col = segment.ordinal_columns.get(jf.name)
         parent_ord = col.ord_of(parent_name) if col is not None else -1
@@ -1176,7 +1203,8 @@ class HasChildQueryBuilder(QueryBuilder):
         nd1 = segment.nd_pad + 1
         mask = np.zeros(nd1, dtype=bool)
         sc = np.zeros(nd1, dtype=np.float32)
-        for pid, ss in parent_scores.items():
+        for pid, entries in child_hits.items():
+            ss = [e[0] for e in entries]
             if len(ss) < self.min_children:
                 continue
             if self.max_children is not None and len(ss) > self.max_children:
@@ -1199,12 +1227,42 @@ class HasParentQueryBuilder(QueryBuilder):
     name = "has_parent"
 
     def __init__(self, parent_type: str, query: QueryBuilder,
-                 score: bool = False, **kw):
+                 score: bool = False, inner_hits: Optional[dict] = None, **kw):
         super().__init__(**kw)
         self.parent_type = parent_type
         self.query = query
         self.score = bool(score)
-        self._cached_parent_score: Optional[Dict[str, float]] = None
+        self.inner_hits = inner_hits
+        # pid -> (score, parent segment, parent local doc)
+        self._cached_parent_hits: Optional[Dict[str, tuple]] = None
+
+    def _parent_hits(self, ctx, segment, jf) -> Dict[str, tuple]:
+        if self._cached_parent_hits is None:
+            parent_hits: Dict[str, tuple] = {}
+            for seg2, local, score in _matched_by_relation(
+                    ctx, segment, self.query, jf, self.parent_type):
+                parent_hits[seg2.doc_ids[local]] = (score, seg2, local)
+            self._cached_parent_hits = parent_hits
+        return self._cached_parent_hits
+
+    def inner_hits_for(self, ctx, segment, local_doc: int, index_name: str):
+        """The matched parent of one child hit."""
+        spec = self.inner_hits if isinstance(self.inner_hits, dict) else {}
+        jf = _require_join_field(ctx)
+        name = spec.get("name", self.parent_type)
+        pid = parent_id_of(segment, jf.name, local_doc)
+        entry = self._parent_hits(ctx, segment, jf).get(pid) if pid else None
+        if entry is None:
+            return name, {"hits": {"total": 0, "max_score": None, "hits": []}}
+        score, seg2, loc = entry
+        hits = [{
+            "_index": index_name,
+            "_type": "_doc",
+            "_id": seg2.doc_ids[loc],
+            "_score": float(score),
+            "_source": seg2.sources[loc],
+        }]
+        return name, {"hits": {"total": 1, "max_score": float(score), "hits": hits}}
 
     def to_plan(self, ctx, segment):
         jf = _require_join_field(ctx)
@@ -1212,15 +1270,8 @@ class HasParentQueryBuilder(QueryBuilder):
             raise QueryShardException(
                 f"[has_parent] join relation [{self.parent_type}] is not a parent"
             )
-        if self._cached_parent_score is None:
-            parent_score: Dict[str, float] = {}
-            for seg2, local, score in _matched_by_relation(
-                    ctx, segment, self.query, jf, self.parent_type):
-                parent_score[seg2.doc_ids[local]] = score
-            self._cached_parent_score = parent_score
-        parent_score = self._cached_parent_score
-
-        if not parent_score:
+        parent_hits = self._parent_hits(ctx, segment, jf)
+        if not parent_hits:
             return P.MatchNoneNode()
         child_names = jf.relations.get(self.parent_type, [])
         locals_, pids = join_children(segment, jf.name, child_names)
@@ -1228,9 +1279,9 @@ class HasParentQueryBuilder(QueryBuilder):
         mask = np.zeros(nd1, dtype=bool)
         sc = np.zeros(nd1, dtype=np.float32)
         for local, pid in zip(locals_, pids):
-            if pid in parent_score:
+            if pid in parent_hits:
                 mask[int(local)] = True
-                sc[int(local)] = parent_score[pid] if self.score else 1.0
+                sc[int(local)] = parent_hits[pid][0] if self.score else 1.0
         if not mask.any():
             return P.MatchNoneNode()
         return self._wrap_boost(P.DenseScoreNode(sc, mask, "has_parent"))
@@ -1265,20 +1316,150 @@ class ParentIdQueryBuilder(QueryBuilder):
 
 
 class NestedQueryBuilder(QueryBuilder):
-    """Flattened-nested approximation: the engine indexes nested objects
-    flattened (object mapping), so a nested query degrades to its inner
-    query on the flattened paths. Cross-object match leakage is the known
-    delta vs the reference's block-join (documented limitation)."""
+    """nested (index/query/NestedQueryBuilder.java): run the inner query
+    over the nested objects of `path` and join matches to parent docs.
+
+    The reference delegates to Lucene's ToParentBlockJoinQuery (child docs
+    interleaved in the parent's block). TPU inversion: nested objects are a
+    separate dense sub-segment with a ``parent_of`` pointer column
+    (index/segment.py NestedContext); the child→parent join is a scatter
+    by parent id — no cross-object match leakage (a bool must over two
+    nested fields only matches when one *object* satisfies both)."""
 
     name = "nested"
 
-    def __init__(self, path: str, query: QueryBuilder, score_mode: str = "avg", **kw):
+    def __init__(self, path: str, query: QueryBuilder, score_mode: str = "avg",
+                 ignore_unmapped: bool = False, inner_hits: Optional[dict] = None,
+                 **kw):
         super().__init__(**kw)
         self.path = path
         self.query = query
+        if score_mode not in ("none", "min", "max", "sum", "avg"):
+            raise ParsingException(
+                f"[nested] query does not support [score_mode] = [{score_mode}]"
+            )
+        self.score_mode = score_mode
+        self.ignore_unmapped = bool(ignore_unmapped)
+        self.inner_hits = inner_hits
+        self._cache: Dict[str, tuple] = {}
+
+    def _nested_matches(self, ctx, segment):
+        """Inner-query pass over the path's sub-segment (once per segment
+        per request): -> (NestedContext, matched bool[n_objs], scores) or
+        None when the segment has no objects at the path."""
+        if segment.name in self._cache:
+            return self._cache[segment.name]
+        nctx = segment.nested.get(self.path)
+        if nctx is None or nctx.segment.num_docs == 0:
+            self._cache[segment.name] = None
+            return None
+        nseg = nctx.segment
+        node = self.query.to_plan(ShardQueryContext(ctx.mapper_service), nseg)
+        scores_d, matched_d = P.execute(nseg.device_arrays(), node)
+        n = nctx.parent_of.shape[0]
+        scores = np.asarray(scores_d)[:n]
+        matched = np.asarray(matched_d)[:n] & nseg.live[:n]
+        # objects die with their parent
+        matched = matched & segment.live[nctx.parent_of]
+        out = (nctx, matched, scores)
+        self._cache[segment.name] = out
+        return out
 
     def to_plan(self, ctx, segment):
-        return self.query.to_plan(ctx, segment)
+        if self.path not in ctx.mapper_service.mapper.nested_paths:
+            if self.ignore_unmapped:
+                return P.MatchNoneNode()
+            raise QueryShardException(
+                f"[nested] failed to find nested object under path [{self.path}]"
+            )
+        res = self._nested_matches(ctx, segment)
+        if res is None:
+            return P.MatchNoneNode()
+        nctx, matched, scores = res
+        objs = np.nonzero(matched)[0]
+        if objs.size == 0:
+            return P.MatchNoneNode()
+        parents = nctx.parent_of[objs]
+        nd1 = segment.nd_pad + 1
+        mask = np.zeros(nd1, dtype=bool)
+        mask[parents] = True
+        sc = np.zeros(nd1, dtype=np.float32)
+        obj_scores = scores[objs].astype(np.float32)
+        if self.score_mode == "sum":
+            np.add.at(sc, parents, obj_scores)
+        elif self.score_mode == "avg":
+            counts = np.zeros(nd1, dtype=np.float32)
+            np.add.at(sc, parents, obj_scores)
+            np.add.at(counts, parents, 1.0)
+            sc = np.where(counts > 0, sc / np.maximum(counts, 1.0), 0.0)
+        elif self.score_mode == "min":
+            sc[:] = np.inf
+            np.minimum.at(sc, parents, obj_scores)
+            sc = np.where(mask, sc, 0.0).astype(np.float32)
+        elif self.score_mode == "max":
+            sc[:] = -np.inf
+            np.maximum.at(sc, parents, obj_scores)
+            sc = np.where(mask, sc, 0.0).astype(np.float32)
+        # "none": parents score 0 (ToParentBlockJoinQuery ScoreMode.None)
+        return self._wrap_boost(P.DenseScoreNode(sc.astype(np.float32), mask, "nested"))
+
+    def inner_hits_for(self, ctx, segment, local_doc: int, index_name: str):
+        """Matched nested objects of one parent hit, as an inner-hits
+        entry (search/fetch/subphase/InnerHitsFetchSubPhase)."""
+        spec = self.inner_hits if isinstance(self.inner_hits, dict) else {}
+        res = self._nested_matches(ctx, segment) \
+            if self.path in ctx.mapper_service.mapper.nested_paths else None
+        name = spec.get("name", self.path)
+        if res is None:
+            return name, {"hits": {"total": 0, "max_score": None, "hits": []}}
+        nctx, matched, scores = res
+        objs = np.nonzero(matched & (nctx.parent_of == local_doc))[0]
+        order = sorted(objs, key=lambda o: (-scores[o], nctx.offset_of[o]))
+        total = len(order)
+        frm = int(spec.get("from", 0) or 0)
+        size = int(spec.get("size", 3) if spec.get("size") is not None else 3)
+        sel = order[frm:frm + size]
+        hits = [
+            {
+                "_index": index_name,
+                "_type": "_doc",
+                "_id": segment.doc_ids[local_doc],
+                "_nested": {"field": self.path, "offset": int(nctx.offset_of[o])},
+                "_score": float(scores[o]),
+                "_source": nctx.segment.sources[o],
+            }
+            for o in sel
+        ]
+        max_score = float(scores[order[0]]) if order else None
+        return name, {"hits": {"total": total, "max_score": max_score, "hits": hits}}
+
+
+def sub_queries(qb: QueryBuilder) -> List[QueryBuilder]:
+    """Immediate child builders of a compound query (for tree walks)."""
+    if isinstance(qb, BoolQueryBuilder):
+        return [*qb.must, *qb.filter, *qb.should, *qb.must_not]
+    if isinstance(qb, ConstantScoreQueryBuilder):
+        return [qb.filter]
+    if isinstance(qb, DisMaxQueryBuilder):
+        return list(qb.queries)
+    if isinstance(qb, (FunctionScoreQueryBuilder, NestedQueryBuilder,
+                       HasChildQueryBuilder, HasParentQueryBuilder)):
+        return [qb.query]
+    return []
+
+
+def collect_inner_hits(qb: Optional[QueryBuilder]) -> List[QueryBuilder]:
+    """Builders carrying an inner_hits spec anywhere in the query tree
+    (the reference registers InnerHitContextBuilders during rewrite —
+    index/query/InnerHitContextBuilder)."""
+    if qb is None:
+        return []
+    out = []
+    if getattr(qb, "inner_hits", None) is not None and hasattr(qb, "inner_hits_for"):
+        out.append(qb)
+    for child in sub_queries(qb):
+        out.extend(collect_inner_hits(child))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1492,12 +1673,14 @@ def parse_query(body) -> QueryBuilder:
             score_mode=qbody.get("score_mode", "none"),
             min_children=int(qbody.get("min_children", 1) or 1),
             max_children=qbody.get("max_children"),
+            inner_hits=qbody.get("inner_hits"),
             boost=float(qbody.get("boost", 1.0)),
         )
     if qtype == "has_parent":
         return HasParentQueryBuilder(
             qbody["parent_type"], parse_query(qbody.get("query")),
             score=bool(qbody.get("score", False)),
+            inner_hits=qbody.get("inner_hits"),
             boost=float(qbody.get("boost", 1.0)),
         )
     if qtype == "parent_id":
@@ -1508,6 +1691,9 @@ def parse_query(body) -> QueryBuilder:
         return NestedQueryBuilder(
             qbody["path"], parse_query(qbody["query"]),
             score_mode=qbody.get("score_mode", "avg"),
+            ignore_unmapped=bool(qbody.get("ignore_unmapped", False)),
+            inner_hits=qbody.get("inner_hits"),
+            boost=float(qbody.get("boost", 1.0)),
         )
     if qtype == "type":
         return MatchAllQueryBuilder()  # single doc type in 6.x

@@ -39,6 +39,7 @@ from elasticsearch_tpu.search.aggregations import (
 )
 from elasticsearch_tpu.search.query_dsl import (
     ShardQueryContext,
+    collect_inner_hits,
     parse_query,
 )
 from elasticsearch_tpu.utils.murmur3 import hash_routing
@@ -305,10 +306,14 @@ class ShardSearcher:
                 raw = np.arange(seg.nd_pad, dtype=np.float64)
             else:
                 col = seg.numeric_columns.get(field_name)
+                nested_raw = (None if col is not None
+                              else _nested_sort_values(seg, field_name, order, missing))
                 if col is not None:
                     base = col.min_value if order == "asc" else col.max_value
                     fill = _missing_fill(missing, order)
                     raw = np.where(col.exists, base, fill)
+                elif nested_raw is not None:
+                    raw = nested_raw
                 else:
                     ocol = seg.ordinal_columns.get(field_name) or seg.ordinal_columns.get(
                         f"{field_name}.keyword"
@@ -322,6 +327,34 @@ class ShardSearcher:
             raw_arrays.append(raw)
             oriented.append(raw if order == "desc" else -raw)
         return oriented, raw_arrays
+
+
+def _nested_sort_values(seg, field_name: str, order: str, missing):
+    """Sort key for a field that lives under a nested path: reduce each
+    parent's nested-object values with min (asc) / max (desc) — the
+    reference's nested sort with the default mode (FieldSortBuilder
+    nested handling). The nested path is auto-detected from the field
+    prefix (the 6.x `nested_path` spec is accepted and implied)."""
+    for path, nctx in seg.nested.items():
+        if not field_name.startswith(path + "."):
+            continue
+        ncol = nctx.segment.numeric_columns.get(field_name)
+        if ncol is None:
+            return None
+        n = nctx.parent_of.shape[0]
+        fill = _missing_fill(missing, order)
+        vals = (ncol.min_value if order == "asc" else ncol.max_value)[:n]
+        sel = ncol.exists[:n] & nctx.segment.live[:n]
+        out = np.full(seg.nd_pad, np.inf if order == "asc" else -np.inf,
+                      dtype=np.float64)
+        if order == "asc":
+            np.minimum.at(out, nctx.parent_of[sel], vals[sel])
+        else:
+            np.maximum.at(out, nctx.parent_of[sel], vals[sel])
+        has = np.zeros(seg.nd_pad, dtype=bool)
+        has[nctx.parent_of[sel]] = True
+        return np.where(has, out, fill)
+    return None
 
 
 def P_select_topk(scores, matched, k):
@@ -638,6 +671,15 @@ def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
             )
 
     query_terms: Dict[str, set] = {}
+    # probe the query ONCE for inner_hits; if none, skip the per-shard
+    # builder setup entirely (the common case)
+    has_inner_hits = bool(
+        source_body.get("query")
+        and collect_inner_hits(parse_query(source_body["query"]))
+    )
+    # per-shard builders (memoized): the child/nested pass runs once per
+    # shard per request, not once per hit
+    inner_hits_cache: Dict[int, Tuple] = {}
     hits = []
     for ref in refs:
         shard = shards[ref.shard_id]
@@ -703,6 +745,18 @@ def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
             )
             if hl:
                 hit["highlight"] = hl
+        if has_inner_hits:
+            if ref.shard_id not in inner_hits_cache:
+                ih_ctx = ShardQueryContext(shard.mapper_service, engine=shard.engine)
+                ih_builders = collect_inner_hits(parse_query(source_body["query"]))
+                inner_hits_cache[ref.shard_id] = (ih_ctx, ih_builders)
+            ih_ctx, ih_builders = inner_hits_cache[ref.shard_id]
+            ih_out = {}
+            for b in ih_builders:
+                name, payload = b.inner_hits_for(ih_ctx, seg, d, index_name)
+                ih_out[name] = payload
+            if ih_out:
+                hit["inner_hits"] = ih_out
         hits.append(hit)
     return hits
 

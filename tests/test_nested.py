@@ -1,0 +1,472 @@
+"""Nested documents: block-join query semantics, nested/reverse_nested
+aggs, inner_hits, nested sort, persistence (ref: index/mapper nested
+handling in DocumentParser, index/query/NestedQueryBuilder.java,
+search/aggregations/bucket/nested/, search/fetch/subphase/InnerHitsFetchSubPhase)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+def hit_ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+@pytest.fixture()
+def users(tmp_path):
+    """The canonical nested example: user objects with first/last names."""
+    idx = IndexService("users", Settings({"index.number_of_shards": 1}),
+                       data_path=str(tmp_path / "users"))
+    idx.put_mapping({"properties": {
+        "group": {"type": "keyword"},
+        "user": {
+            "type": "nested",
+            "properties": {
+                "first": {"type": "text"},
+                "last": {"type": "text",
+                         "fields": {"keyword": {"type": "keyword"}}},
+                "age": {"type": "long"},
+            },
+        },
+    }})
+    idx.index_doc("1", {
+        "group": "fans",
+        "user": [
+            {"first": "John", "last": "Smith", "age": 34},
+            {"first": "Alice", "last": "White", "age": 28},
+        ],
+    })
+    idx.index_doc("2", {
+        "group": "fans",
+        "user": [
+            {"first": "John", "last": "White", "age": 46},
+        ],
+    })
+    idx.index_doc("3", {"group": "owners"})
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+class TestNestedQuery:
+    def test_no_cross_object_leakage(self, users):
+        """The defining nested semantic: must clauses matching across
+        DIFFERENT objects do not match the parent (the pre-block-join
+        flattened behavior would return doc 1)."""
+        q = {"query": {"nested": {"path": "user", "query": {"bool": {"must": [
+            {"match": {"user.first": "john"}},
+            {"match": {"user.last": "white"}},
+        ]}}}}}
+        resp = users.search(q)
+        assert hit_ids(resp) == ["2"]
+
+    def test_same_object_match(self, users):
+        q = {"query": {"nested": {"path": "user", "query": {"bool": {"must": [
+            {"match": {"user.first": "john"}},
+            {"match": {"user.last": "smith"}},
+        ]}}}}}
+        assert hit_ids(users.search(q)) == ["1"]
+
+    def test_single_clause_matches_any_object(self, users):
+        q = {"query": {"nested": {"path": "user",
+                                  "query": {"match": {"user.first": "john"}}}}}
+        assert hit_ids(users.search(q)) == ["1", "2"]
+
+    def test_range_on_nested_numeric(self, users):
+        q = {"query": {"nested": {"path": "user",
+                                  "query": {"range": {"user.age": {"gte": 40}}}}}}
+        assert hit_ids(users.search(q)) == ["2"]
+
+    def test_score_modes(self, users):
+        base = {"path": "user", "query": {"match": {"user.first": "john"}}}
+        scores = {}
+        for mode in ("avg", "sum", "min", "max", "none"):
+            resp = users.search(
+                {"query": {"nested": dict(base, score_mode=mode)}})
+            scores[mode] = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+        # one matching object per parent here: avg == sum == min == max
+        assert scores["avg"]["1"] == pytest.approx(scores["sum"]["1"])
+        assert scores["min"]["2"] == pytest.approx(scores["max"]["2"])
+        assert scores["none"]["1"] == 0.0
+
+    def test_sum_vs_max_multi_object(self, users, tmp_path):
+        idx = IndexService("m", Settings({"index.number_of_shards": 1}),
+                           data_path=str(tmp_path / "m"))
+        idx.put_mapping({"properties": {"c": {
+            "type": "nested", "properties": {"t": {"type": "text"}}}}})
+        idx.index_doc("x", {"c": [{"t": "apple"}, {"t": "apple"}]})
+        idx.refresh()
+        q = lambda m: {"query": {"nested": {
+            "path": "c", "query": {"match": {"c.t": "apple"}}, "score_mode": m}}}
+        s_sum = idx.search(q("sum"))["hits"]["hits"][0]["_score"]
+        s_max = idx.search(q("max"))["hits"]["hits"][0]["_score"]
+        s_avg = idx.search(q("avg"))["hits"]["hits"][0]["_score"]
+        assert s_sum == pytest.approx(2 * s_max)
+        assert s_avg == pytest.approx(s_max)
+        idx.close()
+
+    def test_unmapped_path_raises(self, users):
+        with pytest.raises(ElasticsearchTpuException):
+            users.search({"query": {"nested": {
+                "path": "nope", "query": {"match_all": {}}}}})
+
+    def test_ignore_unmapped(self, users):
+        resp = users.search({"query": {"nested": {
+            "path": "nope", "query": {"match_all": {}},
+            "ignore_unmapped": True}}})
+        assert resp["hits"]["total"] == 0
+
+    def test_nested_fields_not_searchable_at_root(self, users):
+        """Nested object fields are separate docs: a root-level match on
+        the nested field path finds nothing (reference behavior)."""
+        resp = users.search({"query": {"match": {"user.first": "john"}}})
+        assert resp["hits"]["total"] == 0
+
+    def test_in_bool_with_root_filter(self, users):
+        q = {"query": {"bool": {
+            "must": [{"nested": {"path": "user",
+                                 "query": {"match": {"user.first": "john"}}}}],
+            "filter": [{"term": {"group": "fans"}}],
+        }}}
+        assert hit_ids(users.search(q)) == ["1", "2"]
+
+    def test_delete_parent_removes_nested(self, users):
+        users.delete_doc("2")
+        users.refresh()
+        q = {"query": {"nested": {"path": "user", "query": {"bool": {"must": [
+            {"match": {"user.first": "john"}},
+            {"match": {"user.last": "white"}},
+        ]}}}}}
+        assert hit_ids(users.search(q)) == []
+
+
+class TestInnerHits:
+    def test_nested_inner_hits(self, users):
+        q = {"query": {"nested": {
+            "path": "user",
+            "query": {"match": {"user.first": "john"}},
+            "inner_hits": {},
+        }}}
+        resp = users.search(q)
+        by_id = {h["_id"]: h for h in resp["hits"]["hits"]}
+        ih = by_id["1"]["inner_hits"]["user"]["hits"]
+        assert ih["total"] == 1
+        assert ih["hits"][0]["_nested"] == {"field": "user", "offset": 0}
+        assert ih["hits"][0]["_source"]["first"] == "John"
+
+    def test_inner_hits_size_and_name(self, users):
+        q = {"query": {"nested": {
+            "path": "user",
+            "query": {"match_all": {}},
+            "inner_hits": {"name": "members", "size": 1},
+        }}}
+        resp = users.search(q)
+        by_id = {h["_id"]: h for h in resp["hits"]["hits"]}
+        ih = by_id["1"]["inner_hits"]["members"]["hits"]
+        assert ih["total"] == 2
+        assert len(ih["hits"]) == 1
+
+    def test_has_child_inner_hits(self, tmp_path):
+        idx = IndexService("qa", Settings({"index.number_of_shards": 1}),
+                           data_path=str(tmp_path / "qa"))
+        idx.put_mapping({"properties": {
+            "j": {"type": "join", "relations": {"q": "a"}},
+            "body": {"type": "text"},
+        }})
+        idx.index_doc("q1", {"j": "q"})
+        idx.index_doc("a1", {"j": {"name": "a", "parent": "q1"}, "body": "good answer"})
+        idx.index_doc("a2", {"j": {"name": "a", "parent": "q1"}, "body": "bad reply"})
+        idx.refresh()
+        resp = idx.search({"query": {"has_child": {
+            "type": "a", "query": {"match": {"body": "answer"}},
+            "inner_hits": {}}}})
+        assert hit_ids(resp) == ["q1"]
+        ih = resp["hits"]["hits"][0]["inner_hits"]["a"]["hits"]
+        assert ih["total"] == 1
+        assert ih["hits"][0]["_id"] == "a1"
+        idx.close()
+
+    def test_has_parent_inner_hits(self, tmp_path):
+        idx = IndexService("qa2", Settings({"index.number_of_shards": 1}),
+                           data_path=str(tmp_path / "qa2"))
+        idx.put_mapping({"properties": {
+            "j": {"type": "join", "relations": {"q": "a"}},
+            "title": {"type": "text"},
+        }})
+        idx.index_doc("q1", {"j": "q", "title": "trains"})
+        idx.index_doc("a1", {"j": {"name": "a", "parent": "q1"}})
+        idx.refresh()
+        resp = idx.search({"query": {"has_parent": {
+            "parent_type": "q", "query": {"match": {"title": "trains"}},
+            "inner_hits": {}}}})
+        assert hit_ids(resp) == ["a1"]
+        ih = resp["hits"]["hits"][0]["inner_hits"]["q"]["hits"]
+        assert ih["hits"][0]["_id"] == "q1"
+        idx.close()
+
+
+class TestNestedAggs:
+    def test_nested_agg_counts_objects(self, users):
+        resp = users.search({"size": 0, "aggs": {
+            "u": {"nested": {"path": "user"},
+                  "aggs": {"min_age": {"min": {"field": "user.age"}}}}}})
+        agg = resp["aggregations"]["u"]
+        assert agg["doc_count"] == 3  # 3 nested objects across 2 docs
+        assert agg["min_age"]["value"] == 28.0
+
+    def test_nested_agg_respects_query(self, users):
+        resp = users.search({
+            "size": 0,
+            "query": {"term": {"group": "fans"}},
+            "aggs": {"u": {"nested": {"path": "user"},
+                           "aggs": {"avg_age": {"avg": {"field": "user.age"}}}}},
+        })
+        agg = resp["aggregations"]["u"]
+        assert agg["doc_count"] == 3
+        assert agg["avg_age"]["value"] == pytest.approx((34 + 28 + 46) / 3)
+
+    def test_reverse_nested(self, users):
+        resp = users.search({"size": 0, "aggs": {"u": {
+            "nested": {"path": "user"},
+            "aggs": {"johns": {
+                "filter": {"match": {"user.first": "john"}},
+                "aggs": {"back": {
+                    "reverse_nested": {},
+                    "aggs": {"groups": {"terms": {"field": "group"}}},
+                }},
+            }},
+        }}})
+        johns = resp["aggregations"]["u"]["johns"]
+        assert johns["doc_count"] == 2  # two john objects
+        back = johns["back"]
+        assert back["doc_count"] == 2  # two parent docs
+        buckets = {b["key"]: b["doc_count"] for b in back["groups"]["buckets"]}
+        assert buckets == {"fans": 2}
+
+    def test_reverse_nested_outside_nested_fails(self, users):
+        with pytest.raises(ElasticsearchTpuException):
+            users.search({"size": 0, "aggs": {
+                "bad": {"reverse_nested": {}, "aggs": {}}}})
+
+    def test_nested_terms_agg(self, users):
+        resp = users.search({"size": 0, "aggs": {"u": {
+            "nested": {"path": "user"},
+            "aggs": {"lasts": {"terms": {"field": "user.last.keyword"}}},
+        }}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in resp["aggregations"]["u"]["lasts"]["buckets"]}
+        assert buckets == {"White": 2, "Smith": 1}
+
+
+class TestNestedSort:
+    def test_sort_asc_by_nested_min(self, users):
+        resp = users.search({
+            "query": {"nested": {"path": "user",
+                                 "query": {"exists": {"field": "user.age"}}}},
+            "sort": [{"user.age": {"order": "asc"}}],
+        })
+        ids = [h["_id"] for h in resp["hits"]["hits"]]
+        assert ids == ["1", "2"]  # min ages 28 vs 46
+
+    def test_sort_desc_by_nested_max(self, users):
+        resp = users.search({
+            "query": {"nested": {"path": "user",
+                                 "query": {"exists": {"field": "user.age"}}}},
+            "sort": [{"user.age": {"order": "desc"}}],
+        })
+        ids = [h["_id"] for h in resp["hits"]["hits"]]
+        assert ids == ["2", "1"]  # max ages 46 vs 34
+
+
+class TestNestedPersistence:
+    def test_flush_and_reopen(self, tmp_path):
+        path = str(tmp_path / "p")
+        idx = IndexService("p", Settings({"index.number_of_shards": 1}),
+                           data_path=path)
+        idx.put_mapping({"properties": {"c": {
+            "type": "nested",
+            "properties": {"t": {"type": "text"}, "n": {"type": "long"}}}}})
+        idx.index_doc("1", {"c": [{"t": "alpha", "n": 1}, {"t": "beta", "n": 2}]})
+        idx.index_doc("2", {"c": [{"t": "alpha beta", "n": 3}]})
+        idx.refresh()
+        idx.flush()
+        idx.close()
+
+        idx2 = IndexService("p", Settings({"index.number_of_shards": 1}),
+                            data_path=path)
+        idx2.put_mapping({"properties": {"c": {
+            "type": "nested",
+            "properties": {"t": {"type": "text"}, "n": {"type": "long"}}}}})
+        q = {"query": {"nested": {"path": "c", "query": {"bool": {"must": [
+            {"match": {"c.t": "alpha"}}, {"match": {"c.t": "beta"}},
+        ]}}}}}
+        assert hit_ids(idx2.search(q)) == ["2"]
+        resp = idx2.search({"size": 0, "aggs": {"cc": {
+            "nested": {"path": "c"},
+            "aggs": {"s": {"sum": {"field": "c.n"}}}}}})
+        assert resp["aggregations"]["cc"]["s"]["value"] == 6.0
+        idx2.close()
+
+    def test_force_merge_preserves_nested(self, users):
+        users.index_doc("4", {"group": "fans",
+                              "user": [{"first": "Zoe", "last": "Smith", "age": 20}]})
+        users.refresh()
+        users.force_merge()
+        q = {"query": {"nested": {"path": "user", "query": {"bool": {"must": [
+            {"match": {"user.first": "zoe"}},
+            {"match": {"user.last": "smith"}},
+        ]}}}}}
+        assert hit_ids(users.search(q)) == ["4"]
+
+
+@pytest.fixture()
+def deep(tmp_path):
+    """Two-level nesting: driver -> vehicle (the reference's multi-level
+    nested example)."""
+    idx = IndexService("deep", Settings({"index.number_of_shards": 1}),
+                       data_path=str(tmp_path / "deep"))
+    idx.put_mapping({"properties": {"driver": {
+        "type": "nested",
+        "properties": {
+            "last_name": {"type": "text"},
+            "vehicle": {
+                "type": "nested",
+                "properties": {
+                    "make": {"type": "text"},
+                    "model": {"type": "text"},
+                },
+            },
+        },
+    }}})
+    idx.index_doc("1", {"driver": {
+        "last_name": "McQueen",
+        "vehicle": [{"make": "Powell", "model": "Canyonero"},
+                    {"make": "Miller", "model": "Meteor"}],
+    }})
+    idx.index_doc("2", {"driver": {
+        "last_name": "Hudson",
+        "vehicle": [{"make": "Mifune", "model": "Mach Five"},
+                    {"make": "Miller", "model": "Meteor"}],
+    }})
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+class TestNestedInNested:
+    def test_query_two_levels(self, deep):
+        q = {"query": {"nested": {"path": "driver", "query": {"nested": {
+            "path": "driver.vehicle",
+            "query": {"bool": {"must": [
+                {"match": {"driver.vehicle.make": "powell"}},
+                {"match": {"driver.vehicle.model": "canyonero"}},
+            ]}},
+        }}}}}
+        assert hit_ids(deep.search(q)) == ["1"]
+
+    def test_query_inner_path_directly(self, deep):
+        q = {"query": {"nested": {
+            "path": "driver.vehicle",
+            "query": {"match": {"driver.vehicle.make": "mifune"}},
+        }}}
+        assert hit_ids(deep.search(q)) == ["2"]
+
+    def test_nested_agg_in_nested_agg(self, deep):
+        resp = deep.search({"size": 0, "aggs": {"d": {
+            "nested": {"path": "driver"},
+            "aggs": {"v": {"nested": {"path": "driver.vehicle"}}},
+        }}})
+        assert resp["aggregations"]["d"]["doc_count"] == 2
+        assert resp["aggregations"]["d"]["v"]["doc_count"] == 4
+
+    def test_root_level_inner_path_agg(self, deep):
+        resp = deep.search({"size": 0, "aggs": {"v": {
+            "nested": {"path": "driver.vehicle"}}}})
+        assert resp["aggregations"]["v"]["doc_count"] == 4
+
+
+class TestNestedParsing:
+    def test_null_array_element_skipped(self, tmp_path):
+        idx = IndexService("n", Settings({"index.number_of_shards": 1}),
+                           data_path=str(tmp_path / "n"))
+        idx.put_mapping({"properties": {"c": {
+            "type": "nested", "properties": {"t": {"type": "text"}}}}})
+        idx.index_doc("1", {"c": [None, {"t": "kept"}]})
+        idx.refresh()
+        assert hit_ids(idx.search({"query": {"nested": {
+            "path": "c", "query": {"match": {"c.t": "kept"}}}}})) == ["1"]
+        resp = idx.search({"size": 0, "aggs": {"cc": {"nested": {"path": "c"}}}})
+        assert resp["aggregations"]["cc"]["doc_count"] == 1
+        idx.close()
+
+    def test_include_in_parent_no_double_count_inner(self, tmp_path):
+        idx = IndexService("i", Settings({"index.number_of_shards": 1}),
+                           data_path=str(tmp_path / "i"))
+        idx.put_mapping({"properties": {"a": {
+            "type": "nested", "include_in_parent": True,
+            "properties": {
+                "x": {"type": "text"},
+                "b": {"type": "nested", "properties": {"y": {"type": "text"}}},
+            }}}})
+        idx.index_doc("1", {"a": [{"x": "v", "b": [{"y": "w"}]}]})
+        idx.refresh()
+        resp = idx.search({"size": 0, "aggs": {"bb": {
+            "nested": {"path": "a.b"}}}})
+        assert resp["aggregations"]["bb"]["doc_count"] == 1
+        q = {"query": {"nested": {"path": "a.b",
+                                  "query": {"match": {"a.b.y": "w"}},
+                                  "score_mode": "sum", "inner_hits": {}}}}
+        resp = idx.search(q)
+        ih = resp["hits"]["hits"][0]["inner_hits"]["a.b"]["hits"]
+        assert ih["total"] == 1
+        idx.close()
+
+
+class TestNestedCorruptionDetection:
+    def test_parent_of_corruption_detected(self, tmp_path):
+        import glob
+        import os
+
+        from elasticsearch_tpu.index.store import CorruptIndexException
+
+        path = str(tmp_path / "c")
+        idx = IndexService("c", Settings({"index.number_of_shards": 1}),
+                           data_path=path)
+        idx.put_mapping({"properties": {"c": {
+            "type": "nested", "properties": {"t": {"type": "text"}}}}})
+        idx.index_doc("1", {"c": [{"t": "alpha"}]})
+        idx.refresh()
+        idx.flush()
+        idx.close()
+
+        (target,) = glob.glob(os.path.join(path, "**", "parent_of.npy"),
+                              recursive=True)
+        with open(target, "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            byte = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([byte[0] ^ 0xFF]))
+
+        with pytest.raises(CorruptIndexException):
+            IndexService("c", Settings({"index.number_of_shards": 1}),
+                         data_path=path)
+
+
+class TestIncludeInRoot:
+    def test_include_in_root_copies_fields(self, tmp_path):
+        idx = IndexService("r", Settings({"index.number_of_shards": 1}),
+                           data_path=str(tmp_path / "r"))
+        idx.put_mapping({"properties": {"c": {
+            "type": "nested", "include_in_root": True,
+            "properties": {"t": {"type": "text"}}}}})
+        idx.index_doc("1", {"c": [{"t": "hello"}]})
+        idx.refresh()
+        # root-level query now matches (flattened copy)...
+        assert hit_ids(idx.search({"query": {"match": {"c.t": "hello"}}})) == ["1"]
+        # ...and nested semantics still hold
+        assert hit_ids(idx.search({"query": {"nested": {
+            "path": "c", "query": {"match": {"c.t": "hello"}}}}})) == ["1"]
+        idx.close()
