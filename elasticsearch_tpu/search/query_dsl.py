@@ -929,6 +929,95 @@ class GeoBoundingBoxQueryBuilder(QueryBuilder):
         ), self.boost)
 
 
+class GeoPolygonQueryBuilder(QueryBuilder):
+    """geo_polygon (index/query/GeoPolygonQueryBuilder.java): docs whose
+    point lies inside the polygon. Host-side vectorized ray casting over
+    the geo column (a doc matches if ANY of its points is inside)."""
+
+    name = "geo_polygon"
+
+    def __init__(self, field: str, points, **kw):
+        super().__init__(**kw)
+        self.field = field
+        if not points or len(points) < 3:
+            raise ParsingException(
+                "too few points defined for geo_polygon query"
+            )
+        self.points = [GeoPointFieldType.parse_point(p) for p in points]
+
+    def to_plan(self, ctx, segment):
+        col = segment.geo_columns.get(self.field)
+        if col is None:
+            return P.MatchNoneNode()
+        n = col.count
+        lat = col.lat[:n].astype(np.float64)
+        lon = col.lon[:n].astype(np.float64)
+        inside = np.zeros(n, dtype=bool)
+        # ray casting: count edge crossings of a horizontal ray (vectorized
+        # over all points per edge)
+        pts = self.points + [self.points[0]]
+        for (lat1, lon1), (lat2, lon2) in zip(pts[:-1], pts[1:]):
+            cond = (lat1 > lat) != (lat2 > lat)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                x = (lon2 - lon1) * (lat - lat1) / (lat2 - lat1) + lon1
+            inside ^= cond & (lon < x)
+        mask = np.zeros(segment.nd_pad + 1, dtype=bool)
+        docs = col.flat_docs[:n][inside]
+        mask[docs] = True
+        mask[segment.nd_pad] = False
+        return P.ConstantScoreNode(P.DenseMaskNode(mask, "geo_polygon"), self.boost)
+
+
+class ScriptQueryBuilder(QueryBuilder):
+    """script query (index/query/ScriptQueryBuilder.java): filter docs by
+    a numeric expression over doc values. The reference compiles Painless
+    per doc; here the expression evaluates ONCE over whole-segment
+    columns (script/expression.py execute_columns)."""
+
+    name = "script"
+
+    def __init__(self, script_spec, **kw):
+        super().__init__(**kw)
+        from elasticsearch_tpu.script.expression import compile_script
+
+        self.script = compile_script(script_spec)
+        self.params = (script_spec.get("params") or {}
+                       if isinstance(script_spec, dict) else {})
+
+    def to_plan(self, ctx, segment):
+        nd = segment.nd_pad
+        columns = {}
+        for f in self.script.doc_fields:
+            col = segment.numeric_columns.get(f)
+            if col is not None:
+                columns[f] = np.where(col.exists, col.first_value, 0.0)
+                lens = np.bincount(col.flat_docs[: col.count], minlength=nd + 1)
+                columns[f + "#len"] = lens[:nd].astype(np.float64)
+                continue
+            ocol = segment.ordinal_columns.get(f) or segment.ordinal_columns.get(
+                f"{f}.keyword"
+            )
+            if ocol is not None:
+                columns[f] = np.where(ocol.exists,
+                                      ocol.first_ord.astype(np.float64), 0.0)
+                columns[f + "#len"] = ocol.exists.astype(np.float64)
+            else:
+                # absent field: bind zero COLUMNS (not scalars) so the
+                # expression stays in array arithmetic on every segment
+                columns[f] = np.zeros(nd, dtype=np.float64)
+                columns[f + "#len"] = np.zeros(nd, dtype=np.float64)
+        result = self.script.execute_columns(columns, self.params)
+        if result is None:
+            return P.MatchNoneNode()
+        result = np.asarray(result)
+        mask = np.zeros(nd + 1, dtype=bool)
+        if result.ndim == 0:  # constant expression
+            mask[:nd] = bool(result)
+        else:
+            mask[:nd] = np.nan_to_num(result[:nd]) != 0
+        return P.ConstantScoreNode(P.DenseMaskNode(mask, "script"), self.boost)
+
+
 class MoreLikeThisQueryBuilder(QueryBuilder):
     """more_like_this (index/query/MoreLikeThisQueryBuilder): extract the
     top-idf terms from the liked text/docs and run a disjunction."""
@@ -1655,6 +1744,17 @@ def parse_query(body) -> QueryBuilder:
             raise ParsingException("[geo_bounding_box] requires exactly one field")
         field, box = next(iter(params.items()))
         return GeoBoundingBoxQueryBuilder(field, box["top_left"], box["bottom_right"])
+    if qtype == "geo_polygon":
+        params = dict(qbody)
+        params.pop("validation_method", None)
+        if len(params) != 1:
+            raise ParsingException("[geo_polygon] requires exactly one field")
+        field, spec = next(iter(params.items()))
+        return GeoPolygonQueryBuilder(field, spec.get("points") or [])
+    if qtype == "script":
+        return ScriptQueryBuilder(
+            qbody.get("script", qbody), boost=float(qbody.get("boost", 1.0))
+        )
     if qtype == "more_like_this":
         return MoreLikeThisQueryBuilder(
             qbody.get("fields", []), qbody.get("like", []),

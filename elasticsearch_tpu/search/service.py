@@ -304,6 +304,8 @@ class ShardSearcher:
                 raw = scores[: seg.nd_pad].astype(np.float64)
             elif field_name == "_doc":
                 raw = np.arange(seg.nd_pad, dtype=np.float64)
+            elif field_name == "_geo_distance":
+                raw = _geo_distance_sort_values(seg, missing)
             else:
                 col = seg.numeric_columns.get(field_name)
                 nested_raw = (None if col is not None
@@ -325,8 +327,49 @@ class ShardSearcher:
                         fill = _missing_fill(missing, order)
                         raw = np.where(ocol.exists, ocol.first_ord.astype(np.float64), fill)
             raw_arrays.append(raw)
-            oriented.append(raw if order == "desc" else -raw)
+            # clamp ±inf (missing-value fills) to large finite sentinels:
+            # -inf in the oriented key is reserved for "not matched", and a
+            # missing-value doc in an asc sort must still be selectable
+            oriented.append(np.clip(raw if order == "desc" else -raw,
+                                    -1e300, 1e300))
         return oriented, raw_arrays
+
+
+def _geo_distance_sort_values(seg, spec: dict) -> np.ndarray:
+    """Per-doc haversine distance to the reference point(s), multi-values
+    reduced per `mode` (GeoDistanceSortBuilder semantics, arc distance);
+    over multiple reference points the min distance per value is used;
+    docs without the field sort last (+inf)."""
+    col = seg.geo_columns.get(spec["field"])
+    mode = spec.get("mode", "min")
+    out = np.full(seg.nd_pad, np.inf, dtype=np.float64)
+    if col is not None:
+        n = col.count
+        lat = np.radians(col.lat[:n].astype(np.float64))
+        lon = np.radians(col.lon[:n].astype(np.float64))
+        # per stored value: min distance over the reference points
+        per_val = np.full(n, np.inf, dtype=np.float64)
+        for plat, plon in spec["points"]:
+            plat_r, plon_r = np.radians(plat), np.radians(plon)
+            a = (np.sin((lat - plat_r) / 2.0) ** 2
+                 + np.cos(lat) * np.cos(plat_r) * np.sin((lon - plon_r) / 2.0) ** 2)
+            d = 2.0 * 6371008.7714 * np.arcsin(np.sqrt(np.clip(a, 0.0, 1.0)))
+            per_val = np.minimum(per_val, d)
+        docs = col.flat_docs[:n]
+        if mode == "min":
+            np.minimum.at(out, docs, per_val)
+        elif mode == "max":
+            neg = np.full(seg.nd_pad, -np.inf, dtype=np.float64)
+            np.maximum.at(neg, docs, per_val)
+            out = np.where(np.isfinite(neg), neg, np.inf)
+        else:  # sum / avg
+            tot = np.zeros(seg.nd_pad, dtype=np.float64)
+            cnt = np.zeros(seg.nd_pad, dtype=np.float64)
+            np.add.at(tot, docs, per_val)
+            np.add.at(cnt, docs, 1.0)
+            vals = tot / np.maximum(cnt, 1.0) if mode == "avg" else tot
+            out = np.where(cnt > 0, vals, np.inf)
+    return out / float(spec["unit_m"])
 
 
 def _nested_sort_values(seg, field_name: str, order: str, missing):
@@ -389,7 +432,9 @@ def _search_after_mask(key_arrays, sort_spec, after_values) -> np.ndarray:
     gt = np.zeros(n, dtype=bool)
     eq = np.ones(n, dtype=bool)
     for arr, (fname, order, _), after in zip(key_arrays, sort_spec, after_values):
-        a = float(after)
+        # a null cursor value is a missing-value doc's sort key (fetch
+        # serializes the inf fill as null): map back to the fill
+        a = (np.inf if order == "asc" else -np.inf) if after is None else float(after)
         if order == "desc":
             gt |= eq & (arr < a)
         else:
@@ -460,7 +505,38 @@ def normalize_sort(sort_body) -> Optional[List[Tuple[str, str, Any]]]:
                 out.append((entry, "asc" if entry != "_score" else "desc", None))
         elif isinstance(entry, dict):
             ((fname, spec),) = entry.items()
-            if isinstance(spec, str):
+            if fname == "_geo_distance":
+                # geo-distance sort (search/sort/GeoDistanceSortBuilder):
+                # the geo spec rides in the missing slot of the tuple
+                from elasticsearch_tpu.mapper.field_types import GeoPointFieldType
+                from elasticsearch_tpu.search.query_dsl import parse_distance
+
+                params = dict(spec)
+                order = params.pop("order", "asc")
+                unit = params.pop("unit", "m")
+                # multi-valued reduce mode: the reference defaults to MIN
+                # for asc, MAX for desc (GeoDistanceSortBuilder.build)
+                mode = params.pop("mode", "min" if order == "asc" else "max")
+                if mode not in ("min", "max", "sum", "avg"):
+                    raise ParsingException(
+                        f"Unsupported sort mode [{mode}] for [_geo_distance]")
+                for k in ("distance_type", "validation_method",
+                          "ignore_unmapped", "nested_path", "nested"):
+                    params.pop(k, None)
+                if len(params) != 1:
+                    raise ParsingException(
+                        "[_geo_distance] sort requires exactly one field")
+                ((gfield, pts),) = params.items()
+                if not isinstance(pts, list) or (
+                        pts and isinstance(pts[0], (int, float))):
+                    pts = [pts]
+                out.append(("_geo_distance", order, {
+                    "field": gfield,
+                    "points": [GeoPointFieldType.parse_point(p) for p in pts],
+                    "unit_m": parse_distance(f"1{unit}"),
+                    "mode": mode,
+                }))
+            elif isinstance(spec, str):
                 out.append((fname, spec, None))
             else:
                 out.append((

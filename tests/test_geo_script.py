@@ -1,0 +1,183 @@
+"""geo_polygon query, geo_distance sort, script query (ref:
+index/query/GeoPolygonQueryBuilder.java, search/sort/GeoDistanceSortBuilder.java,
+index/query/ScriptQueryBuilder.java)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+def hit_ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+@pytest.fixture()
+def cities(tmp_path):
+    idx = IndexService("cities", Settings({"index.number_of_shards": 1}),
+                       data_path=str(tmp_path / "cities"))
+    idx.put_mapping({"properties": {
+        "name": {"type": "keyword"},
+        "location": {"type": "geo_point"},
+        "population": {"type": "long"},
+        "area": {"type": "double"},
+    }})
+    # Amsterdam, Utrecht, Antwerp (roughly)
+    idx.index_doc("ams", {"name": "Amsterdam", "population": 850000, "area": 219.0,
+                          "location": {"lat": 52.37, "lon": 4.90}})
+    idx.index_doc("utr", {"name": "Utrecht", "population": 350000, "area": 99.0,
+                          "location": {"lat": 52.09, "lon": 5.12}})
+    idx.index_doc("ant", {"name": "Antwerp", "population": 520000, "area": 204.0,
+                          "location": {"lat": 51.22, "lon": 4.40}})
+    idx.index_doc("noloc", {"name": "Nowhere", "population": 10, "area": 1.0})
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+class TestGeoPolygon:
+    def test_polygon_contains(self, cities):
+        # triangle around the Netherlands (excludes Antwerp)
+        resp = cities.search({"query": {"geo_polygon": {"location": {"points": [
+            {"lat": 53.6, "lon": 3.5},
+            {"lat": 53.6, "lon": 7.2},
+            {"lat": 51.6, "lon": 5.3},
+        ]}}}})
+        assert sorted(hit_ids(resp)) == ["ams", "utr"]
+
+    def test_polygon_lon_lat_arrays(self, cities):
+        # GeoJSON [lon, lat] point arrays
+        resp = cities.search({"query": {"geo_polygon": {"location": {"points": [
+            [3.5, 53.6], [7.2, 53.6], [5.3, 51.6],
+        ]}}}})
+        assert sorted(hit_ids(resp)) == ["ams", "utr"]
+
+    def test_too_few_points(self, cities):
+        with pytest.raises(ElasticsearchTpuException):
+            cities.search({"query": {"geo_polygon": {"location": {"points": [
+                {"lat": 1, "lon": 1}, {"lat": 2, "lon": 2}]}}}})
+
+
+class TestGeoDistanceSort:
+    def test_sort_by_distance_from_amsterdam(self, cities):
+        resp = cities.search({
+            "query": {"exists": {"field": "location"}},
+            "sort": [{"_geo_distance": {
+                "location": {"lat": 52.37, "lon": 4.90},
+                "order": "asc", "unit": "km"}}],
+        })
+        assert hit_ids(resp) == ["ams", "utr", "ant"]
+        sorts = [h["sort"][0] for h in resp["hits"]["hits"]]
+        assert sorts[0] == pytest.approx(0.0, abs=1e-3)  # f32 coords ~0.1m
+        assert 30 < sorts[1] < 40       # Utrecht ~35 km
+        assert 120 < sorts[2] < 140     # Antwerp ~130 km
+
+    def test_missing_location_sorts_last(self, cities):
+        resp = cities.search({"sort": [{"_geo_distance": {
+            "location": [4.90, 52.37], "order": "asc", "unit": "km"}}]})
+        assert hit_ids(resp)[-1] == "noloc"
+
+    def test_multi_point_min(self, cities):
+        # min distance to either Amsterdam or Antwerp centers
+        resp = cities.search({
+            "query": {"exists": {"field": "location"}},
+            "sort": [{"_geo_distance": {
+                "location": [{"lat": 52.37, "lon": 4.90},
+                             {"lat": 51.22, "lon": 4.40}],
+                "order": "asc", "unit": "m"}}],
+        })
+        by_id = {h["_id"]: h["sort"][0] for h in resp["hits"]["hits"]}
+        assert by_id["ams"] == pytest.approx(0.0, abs=1.0)  # f32 coords ~0.1m
+        assert by_id["ant"] == pytest.approx(0.0, abs=1.0)
+
+
+class TestGeoSortModes:
+    @pytest.fixture()
+    def multi(self, tmp_path):
+        idx = IndexService("multi", Settings({"index.number_of_shards": 1}),
+                           data_path=str(tmp_path / "multi"))
+        idx.put_mapping({"properties": {"loc": {"type": "geo_point"}}})
+        # doc 'near_far': one point ~111km north, one ~1110km north of origin
+        idx.index_doc("near_far", {"loc": [{"lat": 1.0, "lon": 0.0},
+                                           {"lat": 10.0, "lon": 0.0}]})
+        idx.index_doc("mid", {"loc": {"lat": 5.0, "lon": 0.0}})
+        idx.refresh()
+        yield idx
+        idx.close()
+
+    def test_desc_defaults_to_max(self, multi):
+        resp = multi.search({"sort": [{"_geo_distance": {
+            "loc": {"lat": 0.0, "lon": 0.0}, "order": "desc", "unit": "km"}}]})
+        ids = hit_ids(resp)
+        assert ids == ["near_far", "mid"]  # max(111, 1110) > 556
+        assert resp["hits"]["hits"][0]["sort"][0] > 1000
+
+    def test_explicit_mode_min(self, multi):
+        resp = multi.search({"sort": [{"_geo_distance": {
+            "loc": {"lat": 0.0, "lon": 0.0}, "order": "desc", "unit": "km",
+            "mode": "min"}}]})
+        assert hit_ids(resp) == ["mid", "near_far"]  # min(111,1110)=111 < 556
+
+    def test_mode_avg(self, multi):
+        resp = multi.search({"sort": [{"_geo_distance": {
+            "loc": {"lat": 0.0, "lon": 0.0}, "order": "asc", "unit": "km",
+            "mode": "avg"}}]})
+        by_id = {h["_id"]: h["sort"][0] for h in resp["hits"]["hits"]}
+        assert by_id["near_far"] == pytest.approx((111.2 + 1111.95) / 2, rel=0.02)
+
+
+class TestSearchAfterNullSort:
+    def test_null_cursor_pages_past_missing(self, cities):
+        # page 1: missing-location doc serializes sort value as null
+        resp = cities.search({"sort": [{"_geo_distance": {
+            "location": [4.90, 52.37], "order": "asc", "unit": "km"}}], "size": 3})
+        assert hit_ids(resp) == ["ams", "utr", "ant"]
+        last = resp["hits"]["hits"][-1]["sort"]
+        resp2 = cities.search({
+            "sort": [{"_geo_distance": {
+                "location": [4.90, 52.37], "order": "asc", "unit": "km"}}],
+            "search_after": last, "size": 3})
+        assert hit_ids(resp2) == ["noloc"]
+        assert resp2["hits"]["hits"][0]["sort"] == [None]
+        # a null cursor value must not 500 — it maps back to the inf fill
+        resp3 = cities.search({
+            "sort": [{"_geo_distance": {
+                "location": [4.90, 52.37], "order": "asc", "unit": "km"}}],
+            "search_after": [None], "size": 3})
+        assert hit_ids(resp3) == []
+
+
+class TestScriptQuery:
+    def test_density_filter(self, cities):
+        # population density > 3000/km^2: ams ~3881, utr ~3535, ant ~2549
+        resp = cities.search({"query": {"script": {"script": {
+            "source": "doc['population'].value / doc['area'].value > 3000"}}}})
+        assert sorted(hit_ids(resp)) == ["ams", "utr"]
+
+    def test_with_params(self, cities):
+        resp = cities.search({"query": {"script": {"script": {
+            "source": "doc['population'].value > params.threshold",
+            "params": {"threshold": 500000}}}}})
+        assert sorted(hit_ids(resp)) == ["ams", "ant"]
+
+    def test_in_bool_filter(self, cities):
+        resp = cities.search({"query": {"bool": {
+            "must": [{"term": {"name": "Utrecht"}}],
+            "filter": [{"script": {"script": "doc['area'].value < 100"}}],
+        }}})
+        assert hit_ids(resp) == ["utr"]
+
+    def test_division_by_missing_field_no_error(self, cities):
+        # a field absent from the whole segment binds zero COLUMNS, so the
+        # expression stays in array arithmetic: 1/0.0 -> inf (Java double
+        # semantics, matching lang-expression), never a ZeroDivisionError
+        # 500 — inf > 0 is true for every doc
+        resp = cities.search({"query": {"script": {"script": {
+            "source": "1 / doc['absent'].value > 0"}}}})
+        assert len(hit_ids(resp)) == 4
+
+    def test_rejects_arbitrary_code(self, cities):
+        with pytest.raises(ElasticsearchTpuException):
+            cities.search({"query": {"script": {"script": {
+                "source": "__import__('os').system('id')"}}}})
