@@ -135,6 +135,36 @@ def compile_script(script_spec) -> CompiledScript:
     return CompiledScript(src)
 
 
+def segment_columns(segment, doc_fields) -> Dict[str, "object"]:
+    """Whole-segment column bindings for execute_columns: for each doc
+    field, the first-value column under `f` and the per-doc value count
+    under `f#len`; absent fields bind zero columns so expressions stay in
+    array arithmetic on every segment."""
+    import numpy as np
+
+    nd = segment.nd_pad
+    columns: Dict[str, object] = {}
+    for f in doc_fields:
+        col = segment.numeric_columns.get(f)
+        if col is not None:
+            columns[f] = np.where(col.exists, col.first_value, 0.0)
+            lens = np.bincount(col.flat_docs[: col.count], minlength=nd + 1)
+            columns[f + "#len"] = lens[:nd].astype(np.float64)
+            continue
+        ocol = segment.ordinal_columns.get(f) or segment.ordinal_columns.get(
+            f"{f}.keyword"
+        )
+        if ocol is not None:
+            columns[f] = np.where(ocol.exists,
+                                  ocol.first_ord.astype(np.float64), 0.0)
+            lens = np.bincount(ocol.flat_docs[: ocol.count], minlength=nd + 1)
+            columns[f + "#len"] = lens[:nd].astype(np.float64)
+        else:
+            columns[f] = np.zeros(nd, dtype=np.float64)
+            columns[f + "#len"] = np.zeros(nd, dtype=np.float64)
+    return columns
+
+
 def doc_values_for(segment, local_doc: int, fields) -> Dict[str, float]:
     out: Dict[str, float] = {}
     for f in fields:

@@ -33,8 +33,9 @@ from elasticsearch_tpu.ops import aggs as agg_ops
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing", "significant_terms",
-                "sampler", "adjacency_matrix", "geohash_grid", "children",
-                "nested", "reverse_nested"}
+                "sampler", "diversified_sampler", "adjacency_matrix",
+                "geohash_grid", "children", "nested", "reverse_nested",
+                "scripted_metric"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "stats", "extended_stats",
                 "value_count", "cardinality", "percentiles", "top_hits",
                 "geo_bounds", "geo_centroid", "matrix_stats"}
@@ -654,6 +655,38 @@ def run_aggregations(specs: List[AggSpec], views: List[SegmentView]) -> dict:
 
 
 def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
+    """Runs one agg; pipeline sub-aggs ("parent pipelines" — moving_avg /
+    derivative / cumulative_sum / serial_diff / bucket_script / bucket_sort
+    embedded INSIDE a bucket agg, the reference's canonical placement) are
+    stripped first and applied across the finished buckets."""
+    embedded = [s for s in (spec.subs or []) if s.type in PIPELINE_TYPES]
+    if embedded:
+        spec = AggSpec(spec.name, spec.type, spec.body,
+                       [s for s in spec.subs if s.type not in PIPELINE_TYPES])
+    result = _run_one_inner(spec, views)
+    for p in embedded:
+        _apply_embedded_pipeline(p, result)
+    return result
+
+
+def _apply_embedded_pipeline(spec: AggSpec, result: dict) -> None:
+    """Apply a parent pipeline to its enclosing agg's reduced buckets by
+    wrapping them as a synthetic sibling path."""
+    wrapped = {"_b": result}
+    body = dict(spec.body)
+    if isinstance(body.get("buckets_path"), str):
+        body["buckets_path"] = "_b>" + body["buckets_path"]
+    elif isinstance(body.get("buckets_path"), dict):
+        body["buckets_path"] = {k: "_b>" + v
+                                for k, v in body["buckets_path"].items()}
+    elif spec.type == "bucket_sort":
+        pass  # sorts the parent's buckets; no path needed
+    _apply_pipeline(AggSpec(spec.name, spec.type, body, spec.subs), wrapped)
+    if spec.name in wrapped:  # sibling-output pipelines (avg_bucket family)
+        result[spec.name] = wrapped[spec.name]
+
+
+def _run_one_inner(spec: AggSpec, views: List[SegmentView]) -> dict:
     if spec.type in METRIC_TYPES:
         partials = [compute_partial(spec, v) for v in views]
         return _finalize_metric(spec, partials)
@@ -897,13 +930,44 @@ def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
             buckets.append(b)
         return {"doc_count": fg_total, "bg_count": bg_total, "buckets": buckets}
 
-    if spec.type == "sampler":
-        # first shard_size matched docs per segment (bucket/sampler)
+    if spec.type in ("sampler", "diversified_sampler"):
+        # top-scoring shard_size matched docs per segment (bucket/sampler
+        # SamplerAggregator, DiversifiedAggregatorFactory); diversified
+        # additionally caps docs per distinct value of `field`
         shard_size = int(spec.body.get("shard_size", 100))
+        max_per_value = int(spec.body.get("max_docs_per_value", 1))
+        div_field = spec.body.get("field") if spec.type == "diversified_sampler" \
+            else None
         sub_views = []
         total = 0
         for v in views:
-            idx = np.nonzero(v.mask[: v.segment.nd_pad])[0][:shard_size]
+            cand = np.nonzero(v.mask[: v.segment.nd_pad])[0]
+            if v.scores is not None and cand.size:
+                cand = cand[np.argsort(-v.scores[cand], kind="stable")]
+            if div_field is not None and cand.size:
+                col = _resolve_ordinal_field(v.segment, div_field)
+                ncol = (v.segment.numeric_columns.get(div_field)
+                        if col is None else None)
+                per_value: Dict = {}
+                kept = []
+                for d in cand:
+                    if col is not None and col.exists[d]:
+                        key = int(col.first_ord[d])
+                    elif ncol is not None and ncol.exists[d]:
+                        key = float(ncol.first_value[d])
+                    else:
+                        key = None  # undiversified docs are not capped
+                    if key is not None:
+                        seen = per_value.get(key, 0)
+                        if seen >= max_per_value:
+                            continue
+                        per_value[key] = seen + 1
+                    kept.append(d)
+                    if len(kept) >= shard_size:
+                        break
+                idx = np.asarray(kept, dtype=np.int64)
+            else:
+                idx = cand[:shard_size]
             mask = np.zeros_like(v.mask)
             mask[idx] = True
             total += int(idx.size)
@@ -912,6 +976,39 @@ def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:
         if spec.subs:
             out.update(run_aggregations(spec.subs, sub_views))
         return out
+
+    if spec.type == "scripted_metric":
+        # scripted_metric (metrics/scripted/): restricted to numeric
+        # expressions (script/expression.py) — map_script computes a
+        # per-doc value (vectorized over columns), partials sum per
+        # segment, reduce_script (over `states` via params._agg) folds the
+        # shard partials; painless-style stateful scripts are out of scope
+        from elasticsearch_tpu.script.expression import (
+            compile_script,
+            segment_columns,
+        )
+
+        map_spec = spec.body.get("map_script")
+        if map_spec is None:
+            raise ParsingException("[scripted_metric] requires [map_script]")
+        script = compile_script(map_spec)
+        params = dict(spec.body.get("params") or {})
+        partials = []
+        for v in views:
+            seg = v.segment
+            nd = seg.nd_pad
+            vals = script.execute_columns(segment_columns(seg, script.doc_fields),
+                                          params)
+            if vals is None:  # scalar division-by-zero contract
+                continue
+            vals = np.broadcast_to(np.asarray(vals, dtype=np.float64), (nd,))
+            partials.append(float(np.where(v.mask[:nd], vals[:nd], 0.0).sum()))
+        total = float(sum(partials))
+        reduce_spec = spec.body.get("reduce_script")
+        if reduce_spec is not None:
+            rscript = compile_script(reduce_spec)
+            total = rscript.execute({}, {**params, "_agg": total})
+        return {"value": total}
 
     if spec.type == "adjacency_matrix":
         filters = spec.body["filters"]
@@ -1075,6 +1172,9 @@ def _buckets_path_values(out: dict, path: str) -> List[Optional[float]]:
         node = b
         ok = True
         for p in parts[1:]:
+            if p == "_count":
+                node = b["doc_count"]
+                continue
             metric = p.split(".")
             node = node.get(metric[0])
             if node is None:
@@ -1128,12 +1228,20 @@ def _apply_pipeline(spec: AggSpec, out: dict) -> None:
             b[spec.name] = {"value": acc}
     elif t == "moving_avg":
         window = int(spec.body.get("window", 5))
+        model = spec.body.get("model", "simple")
+        settings = spec.body.get("settings") or {}
         for i, b in enumerate(buckets):
             if i == 0:
                 continue
             w = [v for v in values[max(0, i - window): i] if v is not None]
             if w:
-                b[spec.name] = {"value": sum(w) / len(w)}
+                b[spec.name] = {"value": _movavg_model(w, model, settings)}
+        predict = int(spec.body.get("predict", 0))
+        # predictions append real buckets — only meaningful for list-
+        # shaped bucket aggs (histogram family)
+        if predict > 0 and buckets and isinstance(out[parent]["buckets"], list):
+            _movavg_predict(spec, buckets, values, window, model, settings,
+                            predict)
     elif t in ("avg_bucket", "sum_bucket", "min_bucket", "max_bucket", "stats_bucket"):
         vals = [v for v in values if v is not None]
         if t == "avg_bucket":
@@ -1152,6 +1260,97 @@ def _apply_pipeline(spec: AggSpec, out: dict) -> None:
                 "avg": sum(vals) / len(vals) if vals else None,
                 "sum": sum(vals),
             }
+
+
+def _movavg_model(w: List[float], model: str, settings: dict,
+                  predict_steps: int = 0):
+    """Moving-average models (pipeline/movavg/models/ — SimpleModel,
+    LinearModel, EwmaModel, HoltLinearModel, HoltWintersModel). With
+    predict_steps > 0 returns a list of forecasts instead of the
+    one-step smoothed value."""
+    n = len(w)
+    if model == "simple":
+        v = sum(w) / n
+        return [v] * predict_steps if predict_steps else v
+    if model == "linear":
+        num = sum((i + 1) * x for i, x in enumerate(w))
+        den = n * (n + 1) / 2.0
+        v = num / den
+        return [v] * predict_steps if predict_steps else v
+    alpha = float(settings.get("alpha", 0.3))
+    if model == "ewma":
+        s = w[0]
+        for x in w[1:]:
+            s = alpha * x + (1 - alpha) * s
+        return [s] * predict_steps if predict_steps else s
+    beta = float(settings.get("beta", 0.1))
+    if model == "holt":
+        s, prev_s = w[0], w[0]
+        trend = (w[1] - w[0]) if n > 1 else 0.0
+        for x in w[1:]:
+            prev_s = s
+            s = alpha * x + (1 - alpha) * (s + trend)
+            trend = beta * (s - prev_s) + (1 - beta) * trend
+        if predict_steps:
+            return [s + (k + 1) * trend for k in range(predict_steps)]
+        return s + trend
+    if model == "holt_winters":
+        gamma = float(settings.get("gamma", 0.3))
+        period = int(settings.get("period", 1))
+        mult = settings.get("type", "add") == "mult"
+        if n < 2 * period:
+            # not enough data to seed seasonality: degrade to holt
+            return _movavg_model(w, "holt", settings, predict_steps)
+        pad = float(settings.get("padding", 1e-10)) if mult else 0.0
+        vals = [x + pad for x in w]
+        # seed level/trend/seasonal from the first two periods
+        s = sum(vals[:period]) / period
+        trend = (sum(vals[period:2 * period]) - sum(vals[:period])) / (period ** 2)
+        season = ([vals[i] / s for i in range(period)] if mult
+                  else [vals[i] - s for i in range(period)])
+        for i in range(period, n):
+            x = vals[i]
+            prev_s = s
+            si = season[i % period]
+            if mult:
+                s = alpha * (x / max(si, 1e-12)) + (1 - alpha) * (s + trend)
+            else:
+                s = alpha * (x - si) + (1 - alpha) * (s + trend)
+            trend = beta * (s - prev_s) + (1 - beta) * trend
+            season[i % period] = (gamma * (x / max(s, 1e-12)) + (1 - gamma) * si
+                                  if mult else gamma * (x - s) + (1 - gamma) * si)
+        def forecast(k):
+            si = season[(n + k) % period]
+            base = s + (k + 1) * trend
+            return base * si if mult else base + si
+        if predict_steps:
+            return [forecast(k) for k in range(predict_steps)]
+        return forecast(0)
+    raise ParsingException(f"Unknown MovAvg model [{model}]")
+
+
+def _movavg_predict(spec: AggSpec, buckets: List[dict], values: List,
+                    window: int, model: str, settings: dict,
+                    predict: int) -> None:
+    """Append `predict` forecast buckets past the series end (MovAvg
+    predictions; keys extend at the trailing key interval when numeric)."""
+    w = [v for v in values[max(0, len(values) - window):] if v is not None]
+    if not w:
+        return
+    forecasts = _movavg_model(w, model, settings, predict_steps=predict)
+    keys = [b.get("key") for b in buckets]
+    interval = None
+    if (len(keys) >= 2 and isinstance(keys[-1], (int, float))
+            and isinstance(keys[-2], (int, float))):
+        interval = keys[-1] - keys[-2]
+    is_date = bool(buckets and "key_as_string" in buckets[-1])
+    for k, fv in enumerate(forecasts):
+        nb = {"doc_count": 0, spec.name: {"value": fv}}
+        if interval is not None:
+            nb["key"] = keys[-1] + (k + 1) * interval
+            if is_date:
+                nb["key_as_string"] = format_epoch_millis(int(nb["key"]))
+        buckets.append(nb)
 
 
 _SCRIPT_ALLOWED = set("0123456789.+-*/()% eE<>=! &|")

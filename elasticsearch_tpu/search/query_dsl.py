@@ -985,27 +985,10 @@ class ScriptQueryBuilder(QueryBuilder):
                        if isinstance(script_spec, dict) else {})
 
     def to_plan(self, ctx, segment):
+        from elasticsearch_tpu.script.expression import segment_columns
+
         nd = segment.nd_pad
-        columns = {}
-        for f in self.script.doc_fields:
-            col = segment.numeric_columns.get(f)
-            if col is not None:
-                columns[f] = np.where(col.exists, col.first_value, 0.0)
-                lens = np.bincount(col.flat_docs[: col.count], minlength=nd + 1)
-                columns[f + "#len"] = lens[:nd].astype(np.float64)
-                continue
-            ocol = segment.ordinal_columns.get(f) or segment.ordinal_columns.get(
-                f"{f}.keyword"
-            )
-            if ocol is not None:
-                columns[f] = np.where(ocol.exists,
-                                      ocol.first_ord.astype(np.float64), 0.0)
-                columns[f + "#len"] = ocol.exists.astype(np.float64)
-            else:
-                # absent field: bind zero COLUMNS (not scalars) so the
-                # expression stays in array arithmetic on every segment
-                columns[f] = np.zeros(nd, dtype=np.float64)
-                columns[f + "#len"] = np.zeros(nd, dtype=np.float64)
+        columns = segment_columns(segment, self.script.doc_fields)
         result = self.script.execute_columns(columns, self.params)
         if result is None:
             return P.MatchNoneNode()

@@ -1,0 +1,178 @@
+"""scripted_metric, diversified_sampler, moving_avg models (ref:
+search/aggregations/metrics/scripted/, bucket/sampler/DiversifiedAggregatorFactory,
+pipeline/movavg/models/ — Simple/Linear/Ewma/HoltLinear/HoltWinters)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+def agg(resp, name):
+    return resp["aggregations"][name]
+
+
+@pytest.fixture(scope="module")
+def series():
+    """Monthly histogram series with values 1..6 plus a diversity field."""
+    idx = IndexService("series", Settings({"index.number_of_shards": 1}))
+    for i in range(6):
+        idx.index_doc(str(i), {
+            "t": i * 10,
+            "v": float(i + 1),
+            "author": "a" if i < 4 else "b",
+            "body": "common words here",
+        })
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+class TestScriptedMetric:
+    def test_sum_expression(self, series):
+        r = series.search({"size": 0, "aggs": {"m": {"scripted_metric": {
+            "map_script": "doc['v'].value * 2"}}}})
+        assert agg(r, "m")["value"] == pytest.approx(2 * (1 + 2 + 3 + 4 + 5 + 6))
+
+    def test_with_params_and_reduce(self, series):
+        r = series.search({"size": 0, "aggs": {"m": {"scripted_metric": {
+            "map_script": "doc['v'].value * params.factor",
+            "reduce_script": "params._agg / 3",
+            "params": {"factor": 3},
+        }}}})
+        assert agg(r, "m")["value"] == pytest.approx(21.0)
+
+    def test_doc_length(self, series):
+        r = series.search({"size": 0, "aggs": {"m": {"scripted_metric": {
+            "map_script": "doc['v'].length"}}}})
+        assert agg(r, "m")["value"] == pytest.approx(6.0)  # one value per doc
+
+    def test_scalar_division_by_zero_not_nan(self, series):
+        r = series.search({"size": 0, "aggs": {"m": {"scripted_metric": {
+            "map_script": "params.a / params.b",
+            "params": {"a": 1, "b": 0}}}}})
+        v = agg(r, "m")["value"]
+        assert v == 0.0  # skipped segments, never NaN in the response
+
+    def test_respects_query(self, series):
+        r = series.search({"size": 0,
+                           "query": {"range": {"v": {"gte": 5}}},
+                           "aggs": {"m": {"scripted_metric": {
+                               "map_script": "doc['v'].value"}}}})
+        assert agg(r, "m")["value"] == pytest.approx(11.0)
+
+
+class TestDiversifiedSampler:
+    def test_caps_per_value(self, series):
+        r = series.search({"size": 0, "aggs": {"s": {
+            "diversified_sampler": {"field": "author", "shard_size": 10,
+                                    "max_docs_per_value": 1},
+            "aggs": {"n": {"value_count": {"field": "v"}}},
+        }}})
+        # one doc per distinct author value
+        assert agg(r, "s")["doc_count"] == 2
+        assert agg(r, "s")["n"]["value"] == 2
+
+    def test_max_two_per_value(self, series):
+        r = series.search({"size": 0, "aggs": {"s": {
+            "diversified_sampler": {"field": "author", "shard_size": 10,
+                                    "max_docs_per_value": 2},
+        }}})
+        assert agg(r, "s")["doc_count"] == 4  # 2 of "a" + 2 of "b"
+
+    def test_sampler_takes_top_scoring(self, series):
+        r = series.search({"size": 0,
+                           "query": {"match": {"body": "common"}},
+                           "aggs": {"s": {"sampler": {"shard_size": 3}}}})
+        assert agg(r, "s")["doc_count"] == 3
+
+
+def _histo_with_movavg(series, model, settings=None, predict=0, window=3):
+    body = {"buckets_path": "s", "window": window, "model": model}
+    if settings:
+        body["settings"] = settings
+    if predict:
+        body["predict"] = predict
+    return series.search({"size": 0, "aggs": {"h": {
+        "histogram": {"field": "t", "interval": 10},
+        "aggs": {"s": {"sum": {"field": "v"}},
+                 "ma": {"moving_avg": body}},
+    }}})
+
+
+class TestMovingAvgModels:
+    def test_simple(self, series):
+        r = _histo_with_movavg(series, "simple")
+        buckets = agg(r, "h")["buckets"]
+        # bucket i holds mean of the previous <=3 values
+        assert buckets[1]["ma"]["value"] == pytest.approx(1.0)
+        assert buckets[3]["ma"]["value"] == pytest.approx(2.0)
+        assert buckets[5]["ma"]["value"] == pytest.approx(4.0)
+
+    def test_linear_weights_recent_higher(self, series):
+        r = _histo_with_movavg(series, "linear")
+        buckets = agg(r, "h")["buckets"]
+        # window [2,3,4] -> (2*1+3*2+4*3)/6 = 20/6
+        assert buckets[4]["ma"]["value"] == pytest.approx(20 / 6)
+
+    def test_ewma(self, series):
+        r = _histo_with_movavg(series, "ewma", settings={"alpha": 0.5})
+        buckets = agg(r, "h")["buckets"]
+        # window [2,3,4]: s=2 -> 0.5*3+0.5*2=2.5 -> 0.5*4+0.5*2.5=3.25
+        assert buckets[4]["ma"]["value"] == pytest.approx(3.25)
+
+    def test_holt_tracks_trend(self, series):
+        r = _histo_with_movavg(series, "holt",
+                               settings={"alpha": 0.8, "beta": 0.5})
+        buckets = agg(r, "h")["buckets"]
+        # the series is a clean +1 trend: holt must beat simple at the end
+        assert buckets[5]["ma"]["value"] > 4.0
+
+    def test_holt_winters_seasonal(self):
+        idx = IndexService("hw", Settings({"index.number_of_shards": 1}))
+        # period-2 seasonal series: 10, 2, 10, 2, ...
+        vals = [10.0, 2.0] * 4
+        for i, v in enumerate(vals):
+            idx.index_doc(str(i), {"t": i * 10, "v": v})
+        idx.refresh()
+        r = idx.search({"size": 0, "aggs": {"h": {
+            "histogram": {"field": "t", "interval": 10},
+            "aggs": {"s": {"sum": {"field": "v"}},
+                     "ma": {"moving_avg": {
+                         "buckets_path": "s", "window": 8,
+                         "model": "holt_winters",
+                         "settings": {"period": 2, "alpha": 0.3, "beta": 0.1,
+                                      "gamma": 0.3}}}},
+        }}})
+        buckets = agg(r, "h")["buckets"]
+        # the seasonal model locks onto the period-2 cycle exactly:
+        # bucket 6 is the high phase (10), bucket 7 the low phase (2)
+        assert buckets[6]["ma"]["value"] == pytest.approx(10.0, abs=0.1)
+        assert buckets[7]["ma"]["value"] == pytest.approx(2.0, abs=0.1)
+        idx.close()
+
+    def test_predict_date_histogram_key_as_string(self):
+        idx = IndexService("dh", Settings({"index.number_of_shards": 1}))
+        for i, d in enumerate(["2017-01-01", "2017-02-01", "2017-03-01"]):
+            idx.index_doc(str(i), {"sold": d, "v": float(i + 1)})
+        idx.refresh()
+        r = idx.search({"size": 0, "aggs": {"h": {
+            "date_histogram": {"field": "sold", "interval": "month"},
+            "aggs": {"s": {"sum": {"field": "v"}},
+                     "ma": {"moving_avg": {"buckets_path": "s", "window": 3,
+                                           "predict": 1}}},
+        }}})
+        buckets = agg(r, "h")["buckets"]
+        assert all("key_as_string" in b for b in buckets)
+        idx.close()
+
+    def test_predict_appends_buckets(self, series):
+        r = _histo_with_movavg(series, "holt",
+                               settings={"alpha": 0.8, "beta": 0.5}, predict=2)
+        buckets = agg(r, "h")["buckets"]
+        assert len(buckets) == 8  # 6 real + 2 predicted
+        assert buckets[6]["doc_count"] == 0
+        assert buckets[6]["key"] == pytest.approx(60.0)
+        assert buckets[7]["key"] == pytest.approx(70.0)
+        # +1 trend continues upward
+        assert buckets[7]["ma"]["value"] > buckets[6]["ma"]["value"]
