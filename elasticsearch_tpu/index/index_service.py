@@ -43,7 +43,10 @@ class IndexService:
         self.num_shards = INDEX_NUMBER_OF_SHARDS.get(settings)
         self.num_replicas = INDEX_NUMBER_OF_REPLICAS.get(settings)
         self.analyzers = AnalysisRegistry(settings)
-        self.mapper_service = MapperService(self.analyzers, mapping)
+        from elasticsearch_tpu.index.similarity import SimilarityService
+        self.mapper_service = MapperService(
+            self.analyzers, mapping,
+            similarity_service=SimilarityService(settings))
         self.data_path = data_path
         durability = INDEX_TRANSLOG_DURABILITY.get(settings)
         slowlog_warn = settings.get_time("index.search.slowlog.threshold.query.warn")

@@ -232,6 +232,20 @@ class Segment:
         hi = bisect.bisect_left(self.term_keys, prefix + "￿")
         return [(self.term_keys[i][len(prefix):], i) for i in range(lo, hi)]
 
+    def term_ttf(self, tid: int) -> int:
+        """Total term frequency (sum of tfs over the term's postings) —
+        collection stat for DFR/IB/LM similarities and DFS. Computed lazily
+        from the packed tf blocks and cached."""
+        cache = getattr(self, "_ttf_cache", None)
+        if cache is None:
+            cache = self._ttf_cache = {}
+        hit = cache.get(tid)
+        if hit is None:
+            start = int(self.term_block_start[tid])
+            cnt = int(self.term_block_count[tid])
+            hit = cache[tid] = int(self.block_tfs[start:start + cnt].sum())
+        return hit
+
     def field_avgdl(self, field_name: str) -> float:
         st = self.field_stats.get(field_name)
         if not st or st["doc_count"] == 0:

@@ -185,6 +185,8 @@ class TextFieldType(FieldType):
         self.analyzer = self.params.get("analyzer", "standard")
         self.search_analyzer = self.params.get("search_analyzer", self.analyzer)
         self.fielddata = bool(self.params.get("fielddata", False))
+        # per-field similarity name (index/similarity/SimilarityService.java)
+        self.similarity_name = self.params.get("similarity")
 
     def index_terms(self, value, analyzers):
         return analyzers.get(self.analyzer).analyze(str(value))

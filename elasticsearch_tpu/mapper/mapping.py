@@ -333,11 +333,25 @@ class MapperService:
     """
 
     def __init__(self, analyzers: AnalysisRegistry, mapping: Optional[dict] = None,
-                 total_fields_limit: int = 1000):
+                 total_fields_limit: int = 1000, similarity_service=None):
         self.analyzers = analyzers
         self.total_fields_limit = total_fields_limit
+        if similarity_service is None:
+            from elasticsearch_tpu.index.similarity import SimilarityService
+            similarity_service = SimilarityService()
+        self.similarity_service = similarity_service
         self._mapping = copy.deepcopy(mapping) if mapping else {"properties": {}}
         self._mapper = DocumentMapper(self._mapping, analyzers, total_fields_limit)
+        self._validate_similarities()
+
+    def _validate_similarities(self) -> None:
+        """Reject unknown similarity names at mapping time, like the
+        reference (MapperService resolves them via SimilarityService when
+        building the field type, failing the mapping update)."""
+        for name, ft in self._mapper.fields.items():
+            sim_name = getattr(ft, "similarity_name", None)
+            if sim_name is not None:
+                self.similarity_service.get(sim_name)  # raises on unknown
 
     @property
     def mapper(self) -> DocumentMapper:
@@ -366,6 +380,7 @@ class MapperService:
         # recompile validates the merged tree
         self._mapper = DocumentMapper(merged, self.analyzers, self.total_fields_limit)
         self._mapping = merged
+        self._validate_similarities()
 
     def _merge_props(self, base: dict, incoming: dict, prefix: str) -> None:
         for name, params in incoming.items():

@@ -78,35 +78,58 @@ class EmitCtx:
 
 
 class ScoreTermsNode(PlanNode):
-    """Weighted disjunction of term posting blocks with BM25 scoring and a
-    minimum-distinct-match threshold (match/term/multi_match leaves)."""
+    """Weighted disjunction of term posting blocks with per-lane similarity
+    scoring (BM25 default) and a minimum-distinct-match threshold
+    (match/term/multi_match leaves).
+
+    Each posting-block lane carries its similarity's host-folded constants
+    (weight + p1..p3, see index/similarity.py); the traced formula set is
+    selected statically by the node's distinct ``kinds`` tuple, so a plain
+    BM25 query compiles exactly the BM25 arithmetic."""
 
     def __init__(self, q_blocks, q_weights, q_norm_rows, q_avgdl, q_valid,
-                 min_match, k1: float = K1, b: float = B):
+                 min_match, k1: float = K1, b: float = B,
+                 q_p1=None, q_p2=None, q_p3=None, q_kinds=None,
+                 kinds: tuple = ("bm25",)):
+        from elasticsearch_tpu.index.similarity import STRICTLY_POSITIVE_KINDS
+
+        n = len(q_blocks)
         self.q_blocks = q_blocks
         self.q_weights = q_weights
         self.q_norm_rows = q_norm_rows
         self.q_avgdl = q_avgdl
         self.q_valid = q_valid
         self.min_match = np.float32(min_match)
-        self.k1, self.b = k1, b
+        # default lane params reproduce classic BM25(k1, b)
+        self.q_p1 = q_p1 if q_p1 is not None else np.full(n, k1, np.float32)
+        self.q_p2 = q_p2 if q_p2 is not None else np.full(n, b, np.float32)
+        self.q_p3 = q_p3 if q_p3 is not None else np.zeros(n, np.float32)
+        self.q_kinds = q_kinds if q_kinds is not None else np.zeros(n, np.int32)
+        self.kinds = tuple(kinds)
         # single-scatter fast path: only when "matched == score > 0" holds,
         # i.e. plain disjunction AND every live weight strictly positive
-        # (a boost of 0 would make a matching doc score 0)
-        self._fast = bool(min_match <= 1) and bool(
-            (np.asarray(q_weights)[np.asarray(q_valid)] > 0).all()
+        # (a boost of 0 would make a matching doc score 0) AND every
+        # similarity in play yields strictly positive contributions
+        self._fast = (
+            bool(min_match <= 1)
+            and bool((np.asarray(q_weights)[np.asarray(q_valid)] > 0).all())
+            and all(k in STRICTLY_POSITIVE_KINDS for k in self.kinds)
         )
 
     def key(self):
-        # the fast path changes the traced program -> part of the key
-        return f"terms[{len(self.q_blocks)},{self.k1},{self.b},{self._fast}]"
+        # the fast path + similarity set change the traced program
+        return f"terms[{len(self.q_blocks)},{','.join(self.kinds)},{self._fast}]"
 
     def arrays(self):
         return [self.q_blocks, self.q_weights, self.q_norm_rows, self.q_avgdl,
-                self.q_valid, self.min_match]
+                self.q_valid, self.min_match, self.q_p1, self.q_p2, self.q_p3,
+                self.q_kinds]
 
     def emit(self, ctx):
-        q_blocks, q_weights, q_norm_rows, q_avgdl, q_valid, min_match = ctx.take(6)
+        from elasticsearch_tpu.index.similarity import emit_contrib
+
+        (q_blocks, q_weights, q_norm_rows, q_avgdl, q_valid, min_match,
+         q_p1, q_p2, q_p3, q_kinds) = ctx.take(10)
         docs = ctx.seg["block_docs"][q_blocks]
         tfs = ctx.seg["block_tfs"][q_blocks]
         # flat 1-D gather (2-D advanced indexing lowers to a slower general
@@ -115,12 +138,23 @@ class ScoreTermsNode(PlanNode):
         nd1 = norms.shape[1]
         flat_idx = (q_norm_rows[:, None] * nd1 + docs).ravel()
         doc_len = norms.ravel()[flat_idx].reshape(docs.shape)
-        denom = tfs + self.k1 * (1.0 - self.b + self.b * doc_len / q_avgdl[:, None])
         matched = (tfs > 0.0) & q_valid[:, None]
-        contrib = jnp.where(matched, q_weights[:, None] * tfs * (self.k1 + 1.0) / denom, 0.0)
+        w = q_weights[:, None]
+        avgdl = q_avgdl[:, None]
+        p1, p2, p3 = q_p1[:, None], q_p2[:, None], q_p3[:, None]
+        if len(self.kinds) == 1:
+            contrib = emit_contrib(self.kinds[0], tfs, doc_len, w, avgdl,
+                                   p1, p2, p3)
+        else:
+            contrib = jnp.zeros_like(tfs)
+            for i, kind in enumerate(self.kinds):
+                lane = (q_kinds == i)[:, None]
+                val = emit_contrib(kind, tfs, doc_len, w, avgdl, p1, p2, p3)
+                contrib = contrib + jnp.where(lane, val, 0.0)
+        contrib = jnp.where(matched, contrib, 0.0)
         scores = ctx.zeros_f().at[docs].add(contrib)
         if self._fast:
-            # BM25 contributions are strictly positive, so scores > 0 is
+            # contributions are strictly positive, so scores > 0 is
             # exactly "any term matched" — saves the second scatter
             return scores, scores > 0.0
         counts = ctx.zeros_f().at[docs].add(matched.astype(jnp.float32))

@@ -39,6 +39,12 @@ from elasticsearch_tpu.search import plan as P
 # default max_expansions for multi-term queries (MultiTermQuery rewrites)
 MAX_EXPANSIONS = 1024
 
+# single source of the default BM25 constants for ctx-less callers
+from elasticsearch_tpu.index.similarity import BM25Similarity  # noqa: E402
+from elasticsearch_tpu.ops.scoring import B as _BM25_B, K1 as _BM25_K1  # noqa: E402
+
+_DEFAULT_BM25 = BM25Similarity(k1=_BM25_K1, b=_BM25_B)
+
 
 class ShardQueryContext:
     """Per-shard query context (≙ QueryShardContext): mapper + analyzers +
@@ -53,6 +59,15 @@ class ShardQueryContext:
 
     def field_type(self, name: str):
         return self.mapper_service.field_type(name)
+
+    def similarity(self, field: str):
+        """The similarity bound to a field (mapping ``similarity`` param,
+        else the index default — SimilarityService.java semantics)."""
+        svc = getattr(self.mapper_service, "similarity_service", None)
+        if svc is None:
+            return None
+        ft = self.mapper_service.field_type(field)
+        return svc.get(getattr(ft, "similarity_name", None))
 
     def all_segments(self, fallback_segment) -> List:
         """Every searchable segment of the shard (falls back to the one
@@ -78,43 +93,72 @@ def _pad_pow2(lst, pad_value, min_len=8, dtype=None):
     return np.asarray(arr, dtype=dtype)
 
 
-def term_blocks_arrays(segment, weighted_terms):
+def term_blocks_arrays(segment, weighted_terms, ctx=None):
     """weighted_terms: list of (field, token, boost). Builds the gather
-    arrays for ScoreTermsNode; returns None if no term exists in segment."""
+    arrays for ScoreTermsNode. When ``ctx`` is given, each field's mapped
+    similarity folds its per-term constants into the lane params
+    (index/similarity.py); without it, classic BM25 defaults apply."""
     blocks, weights, rows, avgdls = [], [], [], []
+    p1s, p2s, p3s, kind_ids = [], [], [], []
+    kinds: List[str] = []
     n_terms_present = 0
     for field, token, boost in weighted_terms:
         tid = segment.term_id(field, token)
         if tid < 0:
             continue
         n_terms_present += 1
-        doc_count = segment.field_stats.get(field, {}).get("doc_count", 0)
-        idf = bm25_idf(int(segment.term_doc_freq[tid]), doc_count)
+        st = segment.field_stats.get(field, {})
+        doc_count = st.get("doc_count", 0)
         row = segment.field_norm_idx.get(field, 0)
         avgdl = segment.field_avgdl(field)
+        sim = (ctx.similarity(field) if ctx is not None else None) or _DEFAULT_BM25
+        kind, w, p1, p2, p3 = sim.lane_params({
+            "df": int(segment.term_doc_freq[tid]),
+            # total term freq costs an O(postings) host pass — only the
+            # DFR/IB/LM family reads it
+            "ttf": segment.term_ttf(tid) if sim.needs_ttf else 0,
+            "doc_count": doc_count,
+            "sum_ttf": st.get("sum_ttf", 0),
+            "avgdl": avgdl,
+            "boost": boost,
+        })
+        if kind not in kinds:
+            kinds.append(kind)
+        kid = kinds.index(kind)
         start = int(segment.term_block_start[tid])
         for bi in range(start, start + int(segment.term_block_count[tid])):
             blocks.append(bi)
-            weights.append(idf * boost)
+            weights.append(w)
             rows.append(row)
             avgdls.append(avgdl)
+            p1s.append(p1)
+            p2s.append(p2)
+            p3s.append(p3)
+            kind_ids.append(kid)
     return {
         "q_blocks": _pad_pow2(blocks, 0, dtype=np.int32),
         "q_weights": _pad_pow2(weights, 0.0, dtype=np.float32),
         "q_norm_rows": _pad_pow2(rows, 0, dtype=np.int32),
         "q_avgdl": _pad_pow2(avgdls, 1.0, dtype=np.float32),
         "q_valid": _pad_pow2([True] * len(blocks), False, dtype=bool),
+        "q_p1": _pad_pow2(p1s, 1.0, dtype=np.float32),
+        "q_p2": _pad_pow2(p2s, 1.0, dtype=np.float32),
+        "q_p3": _pad_pow2(p3s, 0.0, dtype=np.float32),
+        "q_kinds": _pad_pow2(kind_ids, 0, dtype=np.int32),
+        "kinds": tuple(kinds) if kinds else ("bm25",),
         "n_present": n_terms_present,
     }
 
 
-def score_terms_node(segment, weighted_terms, min_match=1) -> P.PlanNode:
-    arrs = term_blocks_arrays(segment, weighted_terms)
+def score_terms_node(segment, weighted_terms, min_match=1, ctx=None) -> P.PlanNode:
+    arrs = term_blocks_arrays(segment, weighted_terms, ctx=ctx)
     if arrs["n_present"] == 0 or min_match > arrs["n_present"]:
         return P.MatchNoneNode()
     return P.ScoreTermsNode(
         arrs["q_blocks"], arrs["q_weights"], arrs["q_norm_rows"],
         arrs["q_avgdl"], arrs["q_valid"], min_match,
+        q_p1=arrs["q_p1"], q_p2=arrs["q_p2"], q_p3=arrs["q_p3"],
+        q_kinds=arrs["q_kinds"], kinds=arrs["kinds"],
     )
 
 
@@ -210,7 +254,7 @@ class MatchQueryBuilder(QueryBuilder):
         else:
             min_match = parse_min_should_match(self.minimum_should_match, len(terms)) or 1
         node = score_terms_node(
-            segment, [(self.field, t, 1.0) for t in terms], min_match
+            segment, [(self.field, t, 1.0) for t in terms], min_match, ctx=ctx
         )
         return self._wrap_boost(node)
 
@@ -314,7 +358,8 @@ class MatchPhrasePrefixQueryBuilder(QueryBuilder):
             if not expansions:
                 return P.MatchNoneNode()
             return score_terms_node(
-                segment, [(self.field, t, self.boost) for t in expansions], 1
+                segment, [(self.field, t, self.boost) for t in expansions], 1,
+                ctx=ctx,
             )
         subs = []
         for exp in expansions:
@@ -409,7 +454,8 @@ class TermQueryBuilder(QueryBuilder):
         token = (ft.term_for_query(self.value, ctx.analyzers)
                  if ft is not None and not isinstance(ft, TextFieldType)
                  else str(self.value))
-        node = score_terms_node(segment, [(self.field, token, self.boost)], 1)
+        node = score_terms_node(segment, [(self.field, token, self.boost)], 1,
+                                ctx=ctx)
         return node
 
 
@@ -452,7 +498,7 @@ class TermsQueryBuilder(QueryBuilder):
             for v in self.values
         ]
         node = score_terms_node(
-            segment, [(self.field, t, self.boost) for t in tokens], 1
+            segment, [(self.field, t, self.boost) for t in tokens], 1, ctx=ctx
         )
         return P.ConstantScoreNode(node, self.boost)
 
@@ -601,7 +647,7 @@ class MultiTermExpandingBuilder(QueryBuilder):
         if not expansions:
             return P.MatchNoneNode()
         node = score_terms_node(
-            segment, [(self.field, t, 1.0) for t in expansions], 1
+            segment, [(self.field, t, 1.0) for t in expansions], 1, ctx=ctx
         )
         return P.ConstantScoreNode(node, self.boost)
 
@@ -1055,7 +1101,7 @@ class MoreLikeThisQueryBuilder(QueryBuilder):
             return P.MatchNoneNode()
         msm = parse_min_should_match(self.minimum_should_match, len(selected)) or 1
         return self._wrap_boost(score_terms_node(
-            segment, [(f, t, 1.0) for _, f, t in selected], msm
+            segment, [(f, t, 1.0) for _, f, t in selected], msm, ctx=ctx
         ))
 
 

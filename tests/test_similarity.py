@@ -1,0 +1,225 @@
+"""Per-field similarity tests.
+
+Mirrors the reference's similarity module (index/similarity/
+SimilarityService.java + *Provider.java): BM25 default, classic, boolean,
+DFR, IB, LM-Dirichlet, LM-Jelinek-Mercer; custom similarities from index
+settings bound to fields via the mapping ``similarity`` parameter.
+"""
+
+import math
+
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.index.similarity import (
+    BM25Similarity,
+    BooleanSimilarity,
+    ClassicSimilarity,
+    DFRSimilarity,
+    IBSimilarity,
+    LMDirichletSimilarity,
+    LMJelinekMercerSimilarity,
+    SimilarityService,
+)
+
+DOCS = [
+    "fox fox fox jumps",
+    "fox jumps over the lazy dog near the river bank in the morning light",
+    "dog sleeps",
+    "quick brown fox",
+]
+
+
+def make_index(field_params=None, settings=None):
+    props = {"body": {"type": "text", "analyzer": "whitespace"}}
+    if field_params:
+        props["body"].update(field_params)
+    idx = IndexService(
+        "sim", Settings(dict({"index.number_of_shards": 1}, **(settings or {}))),
+        mapping={"properties": props},
+    )
+    for i, d in enumerate(DOCS):
+        idx.index_doc(str(i + 1), {"body": d})
+    idx.refresh()
+    return idx
+
+
+def scores(idx, query="fox"):
+    r = idx.search({"query": {"match": {"body": query}}})
+    return {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+
+
+class TestSimilarityService:
+    def test_builtins(self):
+        svc = SimilarityService()
+        assert isinstance(svc.get("BM25"), BM25Similarity)
+        assert isinstance(svc.get("classic"), ClassicSimilarity)
+        assert isinstance(svc.get("boolean"), BooleanSimilarity)
+        assert isinstance(svc.get(None), BM25Similarity)  # default
+
+    def test_custom_from_settings(self):
+        svc = SimilarityService(Settings({
+            "index.similarity.my_bm25.type": "BM25",
+            "index.similarity.my_bm25.k1": 1.8,
+            "index.similarity.my_bm25.b": 0.3,
+            "index.similarity.my_dfr.type": "DFR",
+            "index.similarity.my_dfr.basic_model": "if",
+            "index.similarity.my_dfr.after_effect": "b",
+            "index.similarity.my_dfr.normalization": "h1",
+            "index.similarity.my_ib.type": "IB",
+            "index.similarity.my_ib.distribution": "spl",
+            "index.similarity.my_ib.lambda": "ttf",
+            "index.similarity.my_lmd.type": "LMDirichlet",
+            "index.similarity.my_lmd.mu": 500,
+            "index.similarity.my_lmj.type": "LMJelinekMercer",
+            "index.similarity.my_lmj.lambda": 0.7,
+        }))
+        bm = svc.get("my_bm25")
+        assert (bm.k1, bm.b) == (1.8, 0.3)
+        dfr = svc.get("my_dfr")
+        assert (dfr.basic_model, dfr.after_effect, dfr.normalization) == ("if", "b", "h1")
+        assert svc.get("my_ib").distribution == "spl"
+        assert svc.get("my_lmd").mu == 500.0
+        assert svc.get("my_lmj").lam == 0.7
+
+    def test_default_override(self):
+        svc = SimilarityService(Settings({
+            "index.similarity.default.type": "boolean"}))
+        assert isinstance(svc.get(None), BooleanSimilarity)
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(IllegalArgumentException):
+            SimilarityService(Settings({"index.similarity.x.type": "nope"}))
+
+    def test_unknown_name_rejected(self):
+        with pytest.raises(IllegalArgumentException):
+            SimilarityService().get("missing")
+
+    def test_unknown_field_similarity_rejected_at_mapping_time(self):
+        with pytest.raises(IllegalArgumentException):
+            make_index({"similarity": "typo_name"})
+
+    def test_bad_dfr_params_rejected(self):
+        with pytest.raises(IllegalArgumentException):
+            DFRSimilarity(basic_model="zz")
+        with pytest.raises(IllegalArgumentException):
+            IBSimilarity(distribution="zz")
+        with pytest.raises(IllegalArgumentException):
+            LMJelinekMercerSimilarity(lam=0.0)
+
+
+class TestEndToEnd:
+    def test_boolean_similarity_flat_scores(self):
+        idx = make_index({"similarity": "boolean"})
+        s = scores(idx)
+        # boolean: every match scores exactly the boost (1.0)
+        assert set(s) == {"1", "2", "4"}
+        for v in s.values():
+            assert v == pytest.approx(1.0)
+        idx.close()
+
+    def test_classic_similarity_values(self):
+        idx = make_index({"similarity": "classic"})
+        s = scores(idx)
+        # ClassicSimilarity: idf^2 * sqrt(tf) / sqrt(dl)
+        idf = 1.0 + math.log((4 + 1.0) / (3 + 1.0))
+        assert s["1"] == pytest.approx(idf * idf * math.sqrt(3) / math.sqrt(4), rel=1e-5)
+        assert s["4"] == pytest.approx(idf * idf * 1.0 / math.sqrt(3), rel=1e-5)
+        idx.close()
+
+    def test_bm25_custom_params(self):
+        # b=0 removes length normalization: doc2 (long) ties doc4 (short)
+        idx = make_index(
+            {"similarity": "len_blind"},
+            {"index.similarity.len_blind.type": "BM25",
+             "index.similarity.len_blind.b": 0.0},
+        )
+        s = scores(idx)
+        assert s["2"] == pytest.approx(s["4"], rel=1e-5)
+        assert s["1"] > s["2"]  # tf=3 still wins
+        idx.close()
+
+    def test_lm_dirichlet_ranking(self):
+        idx = make_index(
+            {"similarity": "lmd"},
+            {"index.similarity.lmd.type": "LMDirichlet",
+             "index.similarity.lmd.mu": 100},
+        )
+        s = scores(idx)
+        # highest tf/dl ratio wins under the language model
+        assert set(s) <= {"1", "2", "4"}
+        assert max(s, key=s.get) == "1"
+        # scores are clamped at >= 0 (Lucene LMSimilarity behavior)
+        assert all(v >= 0 for v in s.values())
+        idx.close()
+
+    def test_lm_jelinek_mercer_ranking(self):
+        idx = make_index(
+            {"similarity": "lmj"},
+            {"index.similarity.lmj.type": "LMJelinekMercer",
+             "index.similarity.lmj.lambda": 0.5},
+        )
+        s = scores(idx)
+        assert max(s, key=s.get) == "1"
+        assert s["4"] > s["2"]  # shorter doc, same tf
+        idx.close()
+
+    def test_dfr_and_ib_rank_sensibly(self):
+        for params in (
+            {"index.similarity.alt.type": "DFR",
+             "index.similarity.alt.basic_model": "g",
+             "index.similarity.alt.after_effect": "l",
+             "index.similarity.alt.normalization": "h2"},
+            {"index.similarity.alt.type": "IB",
+             "index.similarity.alt.distribution": "ll",
+             "index.similarity.alt.lambda": "df",
+             "index.similarity.alt.normalization": "h2"},
+        ):
+            idx = make_index({"similarity": "alt"}, params)
+            s = scores(idx)
+            assert set(s) == {"1", "2", "4"}
+            assert max(s, key=s.get) == "1"
+            assert all(v >= 0 for v in s.values())
+            idx.close()
+
+    def test_default_similarity_override_applies_without_mapping(self):
+        idx = make_index(
+            None, {"index.similarity.default.type": "boolean"})
+        s = scores(idx)
+        assert all(v == pytest.approx(1.0) for v in s.values())
+        idx.close()
+
+    def test_mixed_similarities_multi_match(self):
+        # one field BM25, one boolean — both contribute in one program
+        idx = IndexService(
+            "mix", Settings({"index.number_of_shards": 1}),
+            mapping={"properties": {
+                "a": {"type": "text", "analyzer": "whitespace"},
+                "b": {"type": "text", "analyzer": "whitespace",
+                      "similarity": "boolean"},
+            }},
+        )
+        idx.index_doc("1", {"a": "fox", "b": "fox"})
+        idx.index_doc("2", {"a": "fox", "b": "cat"})
+        idx.refresh()
+        r = idx.search({"query": {"multi_match": {
+            "query": "fox", "fields": ["a", "b"], "type": "most_fields"}}})
+        s = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert set(s) == {"1", "2"}
+        # doc1 gets the boolean field's flat 1.0 on top of the BM25 score
+        assert s["1"] == pytest.approx(s["2"] + 1.0, rel=1e-5)
+        idx.close()
+
+    def test_bm25_unchanged_by_default(self):
+        # regression guard: default scoring stays exact Lucene BM25
+        idx = make_index()
+        s = scores(idx)
+        n, df = 4, 3
+        idf = math.log(1 + (n - df + 0.5) / (df + 0.5))
+        avgdl = (4 + 14 + 2 + 3) / 4.0
+        tf, dl = 3.0, 4.0
+        expected = idf * tf * 2.2 / (tf + 1.2 * (1 - 0.75 + 0.75 * dl / avgdl))
+        assert s["1"] == pytest.approx(expected, rel=1e-4)
+        idx.close()
