@@ -51,13 +51,15 @@ class GetResult:
 
 class Engine:
     def __init__(self, shard_id, mapper_service, translog: Translog,
-                 store=None, segment_prefix: str = "seg"):
+                 store=None, segment_prefix: str = "seg", index_sort=None):
         self.shard_id = shard_id
         self.mapper_service = mapper_service
         self.translog = translog
         self.store = store  # index.store.Store or None (transient shard)
         self._segment_prefix = segment_prefix
         self._segment_counter = 0
+        # index.sort.* spec — every sealed segment is doc-permuted by it
+        self.index_sort = index_sort
         self.segments: List[Segment] = []
         self.buffer = self._new_builder()
         self._buffer_deletes: set = set()
@@ -77,7 +79,8 @@ class Engine:
 
     def _new_builder(self) -> SegmentBuilder:
         self._segment_counter += 1
-        return SegmentBuilder(f"{self._segment_prefix}_{self._segment_counter}")
+        return SegmentBuilder(f"{self._segment_prefix}_{self._segment_counter}",
+                              index_sort=self.index_sort)
 
     def _next_seqno(self) -> int:
         self._seqno += 1
@@ -240,11 +243,17 @@ class Engine:
             if self.buffer.num_docs == 0:
                 return False
             seg = self.buffer.seal()
+            # index sorting permutes docs at seal; pre-seal local ids held
+            # by the version map / buffered deletes must translate
+            remap = self.buffer.seal_doc_remap
             for local_doc in self._buffer_deletes:
-                seg.delete_doc(local_doc)
+                seg.delete_doc(int(remap[local_doc]) if remap is not None
+                               else local_doc)
             for doc_id, entry in self.version_map.items():
                 if entry.segment is None:
                     entry.segment = seg.name
+                    if remap is not None:
+                        entry.local_doc = int(remap[entry.local_doc])
             self.segments.append(seg)
             self.buffer = self._new_builder()
             self._buffer_deletes = set()
@@ -294,6 +303,11 @@ class Engine:
                 local = builder.add_document(parsed, seqno, version)
                 self.version_map[doc_id] = VersionEntry(version, seqno, builder.name, local)
             merged = builder.seal()
+            remap = builder.seal_doc_remap
+            if remap is not None:
+                for entry in self.version_map.values():
+                    if entry.segment == builder.name:
+                        entry.local_doc = int(remap[entry.local_doc])
             self.segments = [merged] if merged.num_docs else []
 
     def recover_from_translog(self) -> int:

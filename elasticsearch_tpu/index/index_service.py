@@ -48,6 +48,8 @@ class IndexService:
             self.analyzers, mapping,
             similarity_service=SimilarityService(settings))
         self.data_path = data_path
+        from elasticsearch_tpu.index.index_sort import parse_index_sort
+        self.index_sort = parse_index_sort(settings, self.mapper_service)
         durability = INDEX_TRANSLOG_DURABILITY.get(settings)
         slowlog_warn = settings.get_time("index.search.slowlog.threshold.query.warn")
         slowlog_info = settings.get_time("index.search.slowlog.threshold.query.info")
@@ -57,7 +59,8 @@ class IndexService:
             shard = IndexShard(name, sid, self.mapper_service, shard_path,
                                durability=durability,
                                slowlog_warn_s=slowlog_warn,
-                               slowlog_info_s=slowlog_info)
+                               slowlog_info_s=slowlog_info,
+                               index_sort=self.index_sort)
             if shard_path and shard.engine.store.read_commit() is not None:
                 shard.recover_from_store()
             elif shard_path and os.path.exists(

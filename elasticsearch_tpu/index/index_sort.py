@@ -1,0 +1,165 @@
+"""Index sorting: segments store documents pre-sorted by configured keys.
+
+Role model: ``IndexSortConfig`` (reference:
+core/src/main/java/org/elasticsearch/index/IndexSortConfig.java) — the
+``index.sort.field/order/missing/mode`` settings validated at index
+creation, plus the sorted-index early-termination hook in
+``QueryPhase.execute`` (search/query/QueryPhase.java:107): when a query
+sorts by a prefix of the index sort, collection stops after k hits.
+
+TPU mapping: the sort permutation is applied once at segment seal (host
+side), so doc order *is* sort order in every packed array. The query path
+then selects the first k matching docs in doc order — no sort-key
+orientation or top-k pass — and reports ``terminated_early`` like the
+reference. Unlike the reference (which stops counting), the exhaustive
+dense-mask execution gets the exact total for free, so totals stay
+accurate.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+# (field, order, missing, mode)
+SortSpec = List[Tuple[str, str, str, str]]
+
+_SORTABLE_TYPES = {
+    "long", "integer", "short", "byte", "double", "float", "half_float",
+    "scaled_float", "date", "boolean", "keyword", "ip",
+}
+
+
+def parse_index_sort(settings, mapper_service) -> Optional[SortSpec]:
+    """Parse + validate ``index.sort.*`` settings against the mapping.
+
+    Raises IllegalArgumentException for unknown fields or unsortable field
+    types (IndexSortConfig.java: "unknown index sort field" /
+    "docvalues not found for index sort field").
+    """
+    fields = settings.get_list("index.sort.field")
+    if not fields:
+        return None
+    orders = settings.get_list("index.sort.order") or []
+    missings = settings.get_list("index.sort.missing") or []
+    modes = settings.get_list("index.sort.mode") or []
+
+    def nth(lst, i, default):
+        if not lst:
+            return default
+        if len(lst) == 1:
+            return lst[0]
+        if i >= len(lst):
+            raise IllegalArgumentException(
+                f"index.sort option lists must match index.sort.field length "
+                f"({len(fields)})")
+        return lst[i]
+
+    spec: SortSpec = []
+    for i, field in enumerate(fields):
+        order = str(nth(orders, i, "asc")).lower()
+        if order not in ("asc", "desc"):
+            raise IllegalArgumentException(f"Illegal sort order: {order}")
+        missing = str(nth(missings, i, "_last"))
+        if missing not in ("_last", "_first"):
+            raise IllegalArgumentException(
+                f"Illegal missing value: {missing}, must be one of [_last, _first]")
+        mode = str(nth(modes, i, "min" if order == "asc" else "max")).lower()
+        if mode not in ("min", "max"):
+            raise IllegalArgumentException(
+                f"Illegal sort mode: {mode}, must be one of [min, max]")
+        ft = mapper_service.field_type(field)
+        if ft is None:
+            raise IllegalArgumentException(f"unknown index sort field:[{field}]")
+        if ft.type_name not in _SORTABLE_TYPES:
+            raise IllegalArgumentException(
+                f"invalid index sort field:[{field}] of type [{ft.type_name}] "
+                "(index sorting requires doc values)")
+        if not getattr(ft, "doc_values", True):
+            raise IllegalArgumentException(
+                f"docvalues not found for index sort field:[{field}]")
+        spec.append((field, order, missing, mode))
+    return spec
+
+
+_NUMERIC_SORT_TYPES = _SORTABLE_TYPES - {"keyword", "ip"}
+
+
+def _query_key_mode(mapper_service, field: str, order: str) -> str:
+    """The multi-value reduction the *query* sort path applies
+    (service.py _sort_keys): numeric fields use min for asc / max for
+    desc; ordinal (keyword/ip) keys always use the first (min) ordinal."""
+    ft = mapper_service.field_type(field) if mapper_service else None
+    if ft is not None and ft.type_name in _NUMERIC_SORT_TYPES:
+        return "min" if order == "asc" else "max"
+    return "min"
+
+
+def query_sort_matches_index_sort(query_sort, index_sort: Optional[SortSpec],
+                                  mapper_service=None) -> bool:
+    """True when the query's sort is a prefix of the index sort — the
+    early-termination eligibility check (QueryPhase.java:107
+    canEarlyTerminate, which requires full SortField equality).
+
+    Field + order must match; the query's missing placement must agree
+    with the index sort's (custom numeric missing values disqualify); and
+    the index sort's multi-value mode must equal the reduction the query
+    sort path applies, else segment doc order can disagree with the
+    cross-segment merge keys on multi-valued docs.
+    """
+    if not index_sort or not query_sort:
+        return False
+    if len(query_sort) > len(index_sort):
+        return False
+    for (qf, qorder, qmissing), (sf, sorder, smissing, smode) in zip(
+            query_sort, index_sort):
+        if qf != sf or qorder != sorder:
+            return False
+        q_missing = qmissing if qmissing is not None else "_last"
+        if q_missing != smissing:
+            return False
+        if smode != _query_key_mode(mapper_service, sf, sorder):
+            return False
+    return True
+
+
+def index_sort_permutation(builder, spec: SortSpec) -> Optional[np.ndarray]:
+    """Compute the doc permutation (new order -> old doc) for a sealed
+    builder. Stable: equal keys keep insertion (seqno) order."""
+    n = builder.num_docs
+    if n <= 1:
+        return None
+    lex_keys = []
+    for field, order, missing, mode in reversed(spec):  # lexsort: last = primary
+        fill = np.inf if missing == "_last" else -np.inf
+        vals = np.full(n, np.nan, np.float64)
+        have = np.zeros(n, bool)
+        numeric = builder.numeric_values.get(field)
+        if numeric is not None:
+            for doc, v in numeric:
+                v = float(v)
+                if not have[doc]:
+                    vals[doc] = v
+                    have[doc] = True
+                else:
+                    vals[doc] = min(vals[doc], v) if mode == "min" else max(vals[doc], v)
+        else:
+            strings = builder.string_values.get(field) or []
+            # rank strings so the float lexsort key preserves their order
+            per_doc: dict = {}
+            for doc, s in strings:
+                cur = per_doc.get(doc)
+                if cur is None:
+                    per_doc[doc] = s
+                else:
+                    per_doc[doc] = min(cur, s) if mode == "min" else max(cur, s)
+            rank = {s: i for i, s in enumerate(sorted(set(per_doc.values())))}
+            for doc, s in per_doc.items():
+                vals[doc] = float(rank[s])
+                have[doc] = True
+        oriented = np.where(have, -vals if order == "desc" else vals, fill)
+        lex_keys.append(oriented)
+    return np.lexsort(lex_keys)

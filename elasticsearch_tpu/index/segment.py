@@ -308,8 +308,11 @@ class SegmentBuilder:
     sort terms, block-pack postings, build columns.
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, index_sort=None):
         self.name = name
+        # index.sort.* spec [(field, order, missing, mode)] — applied as a
+        # doc permutation at seal() (IndexSortConfig.java semantics)
+        self.index_sort = index_sort
         self.doc_ids: List[str] = []
         self.sources: List[dict] = []
         self.routings: List[Optional[str]] = []
@@ -407,7 +410,62 @@ class SegmentBuilder:
 
     # ------------------------------------------------------------------
 
+    def _remap_docs(self, perm: np.ndarray) -> np.ndarray:
+        """Reorder documents by ``perm`` (new position -> old doc),
+        rewriting every doc-id reference so doc order becomes sort order.
+        Returns the old->new map (callers holding pre-seal local doc ids —
+        version map, buffered deletes — must translate through it)."""
+        inv = np.empty(len(perm), np.int64)
+        inv[perm] = np.arange(len(perm))  # old doc -> new doc
+
+        def reorder(lst):
+            return [lst[p] for p in perm]
+
+        self.doc_ids = reorder(self.doc_ids)
+        self.sources = reorder(self.sources)
+        self.routings = reorder(self.routings)
+        self.seqnos = reorder(self.seqnos)
+        self.versions = reorder(self.versions)
+        self.postings = {
+            k: sorted((int(inv[d]), tf) for d, tf in plist)
+            for k, plist in self.postings.items()
+        }
+        self.positions = {
+            k: {int(inv[d]): pos for d, pos in per_doc.items()}
+            for k, per_doc in self.positions.items()
+        }
+        self.field_lengths = {
+            f: {int(inv[d]): ln for d, ln in per_doc.items()}
+            for f, per_doc in self.field_lengths.items()
+        }
+        # stable doc sort keeps multi-value order (and #lo/#hi alignment)
+        for store in (self.numeric_values, self.string_values):
+            for f, vals in store.items():
+                store[f] = sorted(
+                    ((int(inv[d]),) + tuple(rest) for d, *rest in vals),
+                    key=lambda t: t[0],
+                )
+        for f, vals in self.geo_values.items():
+            self.geo_values[f] = sorted(
+                ((int(inv[d]), lat, lon) for d, lat, lon in vals),
+                key=lambda t: t[0],
+            )
+        self.field_docs = {
+            f: {int(inv[d]) for d in docs} for f, docs in self.field_docs.items()
+        }
+        for entry in self.nested_builders.values():
+            entry["parent_of"] = [int(inv[d]) for d in entry["parent_of"]]
+        return inv
+
     def seal(self) -> Segment:
+        # old->new doc map when an index sort permuted this segment
+        self.seal_doc_remap = None
+        if self.index_sort and self.num_docs > 1:
+            from elasticsearch_tpu.index.index_sort import index_sort_permutation
+
+            perm = index_sort_permutation(self, self.index_sort)
+            if perm is not None:
+                self.seal_doc_remap = self._remap_docs(perm)
         nd = self.num_docs
         nd_pad = next_pow2(max(nd, 1))
         term_keys = sorted(self.postings.keys())

@@ -33,7 +33,7 @@ class IndexShard:
     def __init__(self, index_name: str, shard_id: int, mapper_service,
                  data_path: Optional[str] = None, primary: bool = True,
                  durability: str = Translog.DURABILITY_REQUEST,
-                 slowlog_warn_s=None, slowlog_info_s=None):
+                 slowlog_warn_s=None, slowlog_info_s=None, index_sort=None):
         self.index_name = index_name
         self.shard_id = shard_id
         self.mapper_service = mapper_service
@@ -53,6 +53,7 @@ class IndexShard:
         self.engine = Engine(
             f"{index_name}[{shard_id}]", mapper_service, translog, store,
             segment_prefix=f"{index_name}_{shard_id}_seg",
+            index_sort=index_sort,
         )
         self.searcher = ShardSearcher(
             shard_id, self.engine, mapper_service,

@@ -162,31 +162,42 @@ class ScoreTermsNode(PlanNode):
 
 
 class PhraseScoreNode(PlanNode):
-    """Pre-verified phrase matches (host position intersection) scored with
-    BM25 over the phrase frequency — MatchPhraseQuery semantics. docs/freqs
-    are [K]-padded (doc = nd1-1 sentinel, freq = 0)."""
+    """Pre-verified phrase matches (host position intersection) scored by
+    the field's similarity over the phrase frequency — MatchPhraseQuery
+    semantics. docs/freqs are [K]-padded (doc = nd1-1 sentinel, freq = 0)."""
 
     def __init__(self, docs, freqs, weight, norm_row, avgdl,
-                 k1: float = K1, b: float = B):
+                 k1: float = K1, b: float = B, kind: str = "bm25",
+                 p1=None, p2=None, p3=0.0):
         self.docs = docs
         self.freqs = freqs
         self.weight = np.float32(weight)
         self.norm_row = int(norm_row)
         self.avgdl = np.float32(avgdl)
-        self.k1, self.b = k1, b
+        self.kind = kind
+        # default params reproduce classic BM25(k1, b)
+        self.p1 = np.float32(k1 if p1 is None else p1)
+        self.p2 = np.float32(b if p2 is None else p2)
+        self.p3 = np.float32(p3)
 
     def key(self):
-        return f"phrase[{len(self.docs)},{self.norm_row},{self.k1},{self.b}]"
+        return f"phrase[{len(self.docs)},{self.norm_row},{self.kind}]"
 
     def arrays(self):
-        return [self.docs, self.freqs, self.weight, self.avgdl]
+        return [self.docs, self.freqs, self.weight, self.avgdl,
+                self.p1, self.p2, self.p3]
 
     def emit(self, ctx):
-        docs, freqs, weight, avgdl = ctx.take(4)
+        from elasticsearch_tpu.index.similarity import emit_contrib
+
+        docs, freqs, weight, avgdl, p1, p2, p3 = ctx.take(7)
         doc_len = ctx.seg["norms"][self.norm_row][docs]
-        denom = freqs + self.k1 * (1.0 - self.b + self.b * doc_len / avgdl)
         matched_v = freqs > 0
-        contrib = jnp.where(matched_v, weight * freqs * (self.k1 + 1.0) / denom, 0.0)
+        contrib = jnp.where(
+            matched_v,
+            emit_contrib(self.kind, freqs, doc_len, weight, avgdl, p1, p2, p3),
+            0.0,
+        )
         scores = ctx.zeros_f().at[docs].add(contrib)
         matched = ctx.zeros_b().at[docs].max(matched_v)
         return scores, matched

@@ -295,12 +295,27 @@ class MatchPhraseQueryBuilder(QueryBuilder):
                 freqs.append(float(freq))
         if not docs:
             return P.MatchNoneNode()
-        # phrase weight: sum of term idfs (Lucene PhraseQuery uses combined
-        # term stats similarly)
-        doc_count = segment.field_stats.get(self.field, {}).get("doc_count", 0)
-        weight = sum(
-            bm25_idf(int(segment.term_doc_freq[t]), doc_count) for t in tids
-        ) * self.boost
+        # phrase weight under the field's similarity: sum of per-term
+        # weights (Lucene PhraseQuery combines term stats similarly); the
+        # non-weight lane params come from the rarest term (approximation
+        # for the stat-dependent DFR/IB/LM params)
+        st = segment.field_stats.get(self.field, {})
+        doc_count = st.get("doc_count", 0)
+        sim = (ctx.similarity(self.field) if ctx is not None else None) or _DEFAULT_BM25
+        lanes = [
+            sim.lane_params({
+                "df": int(segment.term_doc_freq[t]),
+                "ttf": segment.term_ttf(t) if sim.needs_ttf else 0,
+                "doc_count": doc_count,
+                "sum_ttf": st.get("sum_ttf", 0),
+                "avgdl": segment.field_avgdl(self.field),
+                "boost": 1.0,
+            })
+            for t in tids
+        ]
+        kind = lanes[0][0]
+        weight = sum(l[1] for l in lanes) * self.boost
+        _, _, p1, p2, p3 = max(lanes, key=lambda l: l[1])
         sentinel = segment.nd_pad
         return P.PhraseScoreNode(
             _pad_pow2(docs, sentinel, dtype=np.int32),
@@ -308,6 +323,7 @@ class MatchPhraseQueryBuilder(QueryBuilder):
             weight,
             segment.field_norm_idx.get(self.field, 0),
             segment.field_avgdl(self.field),
+            kind=kind, p1=p1, p2=p2, p3=p3,
         )
 
 

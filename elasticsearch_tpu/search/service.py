@@ -134,6 +134,19 @@ class ShardSearcher:
         if rescore_specs:
             k_select = max(k, max(r["window_size"] for r in rescore_specs))
 
+        # sorted-index early termination (QueryPhase.java:107): when the
+        # query sort is a prefix of the index sort, segment doc order IS
+        # sort order — select the first k matching docs instead of a
+        # keyed top-k pass
+        from elasticsearch_tpu.index.index_sort import query_sort_matches_index_sort
+
+        index_sorted = (
+            search_after is None
+            and query_sort_matches_index_sort(
+                sort_spec, getattr(self.engine, "index_sort", None),
+                mapper_service=self.mapper_service)
+        )
+
         refs: List[DocRef] = []
         total = 0
         max_score = None
@@ -163,7 +176,7 @@ class ShardSearcher:
                 matched = matched & np.asarray(post_m)
             total += int(matched[: seg.num_docs].sum())
             seg_refs = self._select(seg, scores, matched, sort_spec, search_after,
-                                    k_select)
+                                    k_select, index_sorted=index_sorted)
             if rescore_specs and sort_spec is None:
                 seg_refs = self._rescore(seg, dev, seg_refs, rescore_specs)
             refs.extend(seg_refs)
@@ -206,6 +219,12 @@ class ShardSearcher:
             # total + set terminated_early (the observable contract)
             terminated_early = total >= int(terminate_after)
             total = min(total, int(terminate_after))
+        elif index_sorted and total > k:
+            # index-sort early termination: collection stopped after k
+            # docs per segment. Unlike the reference, the dense-mask
+            # execution knows the exact total for free, so it stays
+            # accurate while terminated_early is reported.
+            terminated_early = True
         result = ShardQueryResult(self.shard_id, total, refs, max_score, agg_views,
                                   terminated_early=terminated_early)
         if profile:
@@ -260,10 +279,22 @@ class ShardSearcher:
             seg.dev_cache[key] = mask
         return seg.dev_cache[key]
 
-    def _select(self, seg, scores, matched, sort_spec, search_after, k) -> List[DocRef]:
+    def _select(self, seg, scores, matched, sort_spec, search_after, k,
+                index_sorted: bool = False) -> List[DocRef]:
         import jax.numpy as jnp
 
         nd = seg.num_docs
+        if index_sorted and sort_spec is not None:
+            # doc order is sort order: take the first k matching docs;
+            # sort values still materialize for the cross-segment merge
+            live_matched = matched[: seg.nd_pad] & seg.live
+            idx = np.flatnonzero(live_matched)[:k]
+            _, all_key_arrays = self._sort_keys(seg, scores, sort_spec)
+            return [
+                DocRef(self.shard_id, seg.name, int(d), float(scores[d]),
+                       tuple(arr[d] for arr in all_key_arrays))
+                for d in idx
+            ]
         if sort_spec is None:
             # relevance: device top-k by score
             if search_after is not None:

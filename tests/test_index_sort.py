@@ -1,0 +1,208 @@
+"""Index sorting + sorted-index early termination.
+
+Mirrors IndexSortConfig (core/.../index/IndexSortConfig.java) and the
+early-termination hook in QueryPhase.execute (search/query/QueryPhase.java:107).
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+def make_index(sort_settings, mapping=None, shards=1):
+    base = {"index.number_of_shards": shards}
+    base.update(sort_settings)
+    return IndexService(
+        "sorted", Settings(base),
+        mapping=mapping or {"properties": {
+            "rank": {"type": "long"},
+            "name": {"type": "keyword"},
+            "body": {"type": "text"},
+        }},
+    )
+
+
+class TestValidation:
+    def test_unknown_field_rejected(self):
+        with pytest.raises(IllegalArgumentException, match="unknown index sort field"):
+            make_index({"index.sort.field": ["nope"]})
+
+    def test_text_field_rejected(self):
+        with pytest.raises(IllegalArgumentException, match="invalid index sort field"):
+            make_index({"index.sort.field": ["body"]})
+
+    def test_bad_order_rejected(self):
+        with pytest.raises(IllegalArgumentException, match="Illegal sort order"):
+            make_index({"index.sort.field": ["rank"],
+                        "index.sort.order": ["sideways"]})
+
+    def test_bad_missing_rejected(self):
+        with pytest.raises(IllegalArgumentException, match="Illegal missing value"):
+            make_index({"index.sort.field": ["rank"],
+                        "index.sort.missing": ["zero"]})
+
+
+class TestSortedSegments:
+    def test_docs_stored_in_sort_order(self):
+        idx = make_index({"index.sort.field": ["rank"]})
+        for doc_id, rank in [("a", 30), ("b", 10), ("c", 20)]:
+            idx.index_doc(doc_id, {"rank": rank, "name": doc_id})
+        idx.refresh()
+        seg = idx.shards[0].engine.segments[0]
+        assert seg.doc_ids == ["b", "c", "a"]
+        idx.close()
+
+    def test_desc_and_secondary_key(self):
+        idx = make_index({
+            "index.sort.field": ["rank", "name"],
+            "index.sort.order": ["desc", "asc"],
+        })
+        for doc_id, rank in [("x", 1), ("y", 2), ("z", 2)]:
+            idx.index_doc(doc_id, {"rank": rank, "name": doc_id})
+        idx.refresh()
+        seg = idx.shards[0].engine.segments[0]
+        assert seg.doc_ids == ["y", "z", "x"]
+        idx.close()
+
+    def test_keyword_sort_with_missing_last(self):
+        idx = make_index({"index.sort.field": ["name"]})
+        idx.index_doc("1", {"name": "beta", "rank": 1})
+        idx.index_doc("2", {"rank": 2})  # missing name -> last
+        idx.index_doc("3", {"name": "alpha", "rank": 3})
+        idx.refresh()
+        seg = idx.shards[0].engine.segments[0]
+        assert seg.doc_ids == ["3", "1", "2"]
+        idx.close()
+
+    def test_get_update_delete_survive_permutation(self):
+        idx = make_index({"index.sort.field": ["rank"]})
+        idx.index_doc("a", {"rank": 5, "name": "first"})
+        idx.index_doc("b", {"rank": 1, "name": "second"})
+        idx.index_doc("c", {"rank": 3, "name": "third"})
+        idx.delete_doc("c")
+        idx.refresh()
+        # realtime get goes through the version map's (remapped) local ids
+        g = idx.get_doc("a")
+        assert g.found and g.source["name"] == "first"
+        assert idx.get_doc("c").found is False
+        r = idx.search({"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 2
+        # update after refresh still targets the right doc
+        idx.index_doc("a", {"rank": 5, "name": "updated"})
+        idx.refresh()
+        assert idx.get_doc("a").source["name"] == "updated"
+        assert idx.search({"query": {"match_all": {}}})["hits"]["total"] == 2
+        idx.close()
+
+    def test_force_merge_keeps_sort(self):
+        idx = make_index({"index.sort.field": ["rank"]})
+        idx.index_doc("a", {"rank": 9})
+        idx.refresh()
+        idx.index_doc("b", {"rank": 2})
+        idx.refresh()
+        idx.shards[0].engine.force_merge()
+        seg = idx.shards[0].engine.segments[0]
+        assert seg.doc_ids == ["b", "a"]
+        assert idx.get_doc("a").found
+        idx.close()
+
+
+class TestEarlyTermination:
+    def test_sorted_query_terminates_early(self):
+        idx = make_index({"index.sort.field": ["rank"]})
+        for i in range(20):
+            idx.index_doc(str(i), {"rank": (i * 7) % 20, "name": f"n{i}"})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 5,
+                        "sort": [{"rank": "asc"}]})
+        ranks = [h["sort"][0] for h in r["hits"]["hits"]]
+        assert ranks == sorted(ranks) and len(ranks) == 5
+        assert ranks == [0, 1, 2, 3, 4]
+        # exact totals stay (dense execution), but the early-stop contract
+        # is reported like the reference
+        assert r["hits"]["total"] == 20
+        assert r.get("terminated_early") is True
+        idx.close()
+
+    def test_prefix_of_index_sort_qualifies(self):
+        idx = make_index({
+            "index.sort.field": ["rank", "name"],
+            "index.sort.order": ["desc", "asc"],
+        })
+        for i in range(10):
+            idx.index_doc(str(i), {"rank": i, "name": f"n{i}"})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 3,
+                        "sort": [{"rank": "desc"}]})
+        assert [h["sort"][0] for h in r["hits"]["hits"]] == [9, 8, 7]
+        assert r.get("terminated_early") is True
+        idx.close()
+
+    def test_mismatched_sort_not_early_terminated(self):
+        idx = make_index({"index.sort.field": ["rank"]})
+        for i in range(10):
+            idx.index_doc(str(i), {"rank": i, "name": f"n{i}"})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 3,
+                        "sort": [{"rank": "desc"}]})  # opposite order
+        assert [h["sort"][0] for h in r["hits"]["hits"]] == [9, 8, 7]
+        assert r.get("terminated_early") is None
+        idx.close()
+
+    def test_small_result_not_marked_terminated(self):
+        idx = make_index({"index.sort.field": ["rank"]})
+        idx.index_doc("1", {"rank": 1})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 10,
+                        "sort": [{"rank": "asc"}]})
+        assert r.get("terminated_early") is None
+        idx.close()
+
+    def test_doc_values_disabled_rejected(self):
+        with pytest.raises(IllegalArgumentException, match="docvalues not found"):
+            make_index({"index.sort.field": ["rank"]},
+                       mapping={"properties": {
+                           "rank": {"type": "long", "doc_values": False}}})
+
+    def test_missing_mismatch_not_early_terminated(self):
+        # query missing=_first disagrees with the index sort's _last —
+        # early termination would pick the wrong first-k docs
+        idx = make_index({"index.sort.field": ["rank"]})
+        idx.index_doc("a", {"rank": 10})
+        idx.index_doc("b", {"name": "no-rank"})
+        idx.index_doc("c", {"rank": 20})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 2,
+                        "sort": [{"rank": {"order": "asc", "missing": "_first"}}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["b", "a"]
+        assert r.get("terminated_early") is None
+        idx.close()
+
+    def test_keyword_desc_multivalue_not_early_terminated(self):
+        # default desc mode (max) disagrees with the query's ordinal key
+        # (first/min value): segment order can't serve the first-k cut
+        idx = make_index({"index.sort.field": ["name"],
+                          "index.sort.order": ["desc"]})
+        idx.index_doc("d1", {"name": ["a", "z"]})
+        idx.index_doc("d2", {"name": "m"})
+        idx.index_doc("d3", {"name": "b"})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 2,
+                        "sort": [{"name": "desc"}]})
+        assert r.get("terminated_early") is None
+        idx.close()
+
+    def test_multi_segment_results_merge_correctly(self):
+        idx = make_index({"index.sort.field": ["rank"]})
+        for i, rank in enumerate([5, 3, 9]):
+            idx.index_doc(f"a{i}", {"rank": rank})
+        idx.refresh()
+        for i, rank in enumerate([4, 1, 8]):
+            idx.index_doc(f"b{i}", {"rank": rank})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 4,
+                        "sort": [{"rank": "asc"}]})
+        assert [h["sort"][0] for h in r["hits"]["hits"]] == [1, 3, 4, 5]
+        idx.close()

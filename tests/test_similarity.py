@@ -212,6 +212,23 @@ class TestEndToEnd:
         assert s["1"] == pytest.approx(s["2"] + 1.0, rel=1e-5)
         idx.close()
 
+    def test_phrase_respects_field_similarity(self):
+        idx = IndexService(
+            "ph", Settings({"index.number_of_shards": 1}),
+            mapping={"properties": {
+                "b": {"type": "text", "analyzer": "whitespace",
+                      "similarity": "boolean"}}},
+        )
+        idx.index_doc("1", {"b": "quick brown fox"})
+        idx.index_doc("2", {"b": "quick brown dog and quick brown cat"})
+        idx.refresh()
+        r = idx.search({"query": {"match_phrase": {"b": "quick brown"}}})
+        s = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        # boolean similarity: flat scores, no tf/length effect
+        assert set(s) == {"1", "2"}
+        assert s["1"] == pytest.approx(s["2"], rel=1e-6)
+        idx.close()
+
     def test_bm25_unchanged_by_default(self):
         # regression guard: default scoring stays exact Lucene BM25
         idx = make_index()
