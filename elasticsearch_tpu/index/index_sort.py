@@ -74,6 +74,11 @@ def parse_index_sort(settings, mapper_service) -> Optional[SortSpec]:
         ft = mapper_service.field_type(field)
         if ft is None:
             raise IllegalArgumentException(f"unknown index sort field:[{field}]")
+        nested_paths = getattr(mapper_service.mapper, "nested_paths", {})
+        if any(field == p or field.startswith(p + ".") for p in nested_paths):
+            raise IllegalArgumentException(
+                "index sorting on a field inside a nested object is not "
+                f"supported: [{field}]")
         if ft.type_name not in _SORTABLE_TYPES:
             raise IllegalArgumentException(
                 f"invalid index sort field:[{field}] of type [{ft.type_name}] "

@@ -542,8 +542,10 @@ class SegmentBuilder:
         ordinal_columns = {}
         for f, pairs in self.string_values.items():
             # dedupe (doc, value): SortedSetDocValues semantics — a doc holds
-            # each distinct value once (terms agg counts rely on this)
-            pairs = sorted(set(pairs), key=lambda p: p[0])
+            # each distinct value once, in value order (first_ord must be
+            # the doc's MIN ordinal: sort keys + early termination rely on
+            # it being deterministic)
+            pairs = sorted(set(pairs))
             terms = sorted({v for _, v in pairs})
             ord_map = {t: i for i, t in enumerate(terms)}
             n_vals = len(pairs)

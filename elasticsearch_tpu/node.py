@@ -73,6 +73,13 @@ class Node:
         self._scroll_lock = threading.Lock()
         self.start_time = time.time()
         self._closed = False
+        from elasticsearch_tpu.transport.remote_cluster import (
+            RemoteClusterService,
+            register_node,
+        )
+
+        register_node(self)
+        self.remote_clusters = RemoteClusterService(self, settings)
         if self.persistent_path:
             self._recover_indices_from_disk()
 
@@ -421,22 +428,66 @@ class Node:
 
     def search(self, expression: str, body: Optional[dict] = None,
                scroll: Optional[str] = None) -> dict:
-        services = self.resolve_search_indices(expression or "_all")
+        pairs, clusters = self._resolve_search_groups(expression or "_all")
         body = body or {}
         task = self.tasks.register("indices:data/read/search", f"search [{expression}]")
         try:
-            if len(services) == 1:
-                resp = services[0].search(body)
+            if len(pairs) == 1 and pairs[0][0] == "" and clusters is None:
+                resp = pairs[0][1].search(body)
             else:
-                resp = self._multi_index_search(services, body)
+                resp = self._multi_index_search(pairs, body)
+                if clusters is not None:
+                    resp["_clusters"] = clusters
         finally:
             self.tasks.unregister(task)
         if scroll:
             resp["_scroll_id"] = self._open_scroll(expression, body, resp, scroll)
         return resp
 
-    def _multi_index_search(self, services: List[IndexService], body: dict) -> dict:
-        """Cross-index search: fan out, merge like cross-shard merge."""
+    def _resolve_search_groups(self, expression: str):
+        """Split ``alias:index`` cross-cluster groups (TransportSearchAction
+        resolving remote indices via RemoteClusterService, reference
+        action/search/TransportSearchAction.java:177). Returns
+        ([(display_prefix, IndexService)], _clusters dict or None)."""
+        from elasticsearch_tpu.common.errors import NodeNotConnectedException
+
+        groups = self.remote_clusters.group_indices(expression)
+        pairs = []
+        n_remote = sum(1 for alias, _ in groups if alias is not None)
+        if n_remote == 0:
+            return [("", svc) for svc in
+                    self.resolve_search_indices(expression)], None
+        skipped = 0
+        has_local = False
+        for alias, expr in groups:
+            if alias is None:
+                has_local = True
+                pairs.extend(("", svc)
+                             for svc in self.resolve_search_indices(expr))
+                continue
+            rnode, skip_unavailable = self.remote_clusters.get_remote(alias)
+            if rnode is None:
+                if skip_unavailable:
+                    skipped += 1
+                    continue
+                raise NodeNotConnectedException(
+                    f"unable to connect to remote cluster [{alias}]")
+            try:
+                pairs.extend((f"{alias}:", svc)
+                             for svc in rnode.resolve_search_indices(expr))
+            except IndexNotFoundException:
+                if skip_unavailable:
+                    skipped += 1
+                    continue
+                raise
+        total = n_remote + (1 if has_local else 0)
+        return pairs, {"total": total, "successful": total - skipped,
+                       "skipped": skipped}
+
+    def _multi_index_search(self, pairs: List[tuple], body: dict) -> dict:
+        """Cross-index search: fan out, merge like cross-shard merge.
+        ``pairs`` are (display_prefix, IndexService) — the prefix carries
+        the remote-cluster alias into hit ``_index`` values (CCS)."""
         from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggregations
         from elasticsearch_tpu.search.service import (
             fetch_hits,
@@ -454,8 +505,8 @@ class Node:
         max_score = None
         views = []
         n_shards = 0
-        per_index = {}
-        for svc in services:
+        for prefix, svc in pairs:
+            display = f"{prefix}{svc.name}"
             for sid in sorted(svc.shards):
                 n_shards += 1
                 res = svc.shards[sid].searcher.query(body, size_hint=max(k, 1))
@@ -464,15 +515,14 @@ class Node:
                     max_score = (res.max_score if max_score is None
                                  else max(max_score, res.max_score))
                 for ref in res.refs:
-                    ref.shard_id = (svc.name, ref.shard_id)
+                    ref.shard_id = (display, ref.shard_id)
                     all_refs.append(ref)
                 views.extend(res.agg_views)
-            per_index[svc.name] = svc
         refs = merge_refs(all_refs, sort_spec, max(k, 0))[from_: from_ + size]
         shard_map = {}
-        for svc in services:
+        for prefix, svc in pairs:
             for sid, shard in svc.shards.items():
-                shard_map[(svc.name, sid)] = shard
+                shard_map[(f"{prefix}{svc.name}", sid)] = shard
         hits = []
         by_index: Dict[str, List] = {}
         for ref in refs:
@@ -703,6 +753,9 @@ class Node:
 
         self.cluster_service.submit_state_update_task("update-settings", update)
         state = self.cluster_service.state
+        # dynamic remote-cluster registration (search.remote.<alias>.seeds)
+        self.remote_clusters.apply_settings(
+            state.persistent_settings.merged_with(state.transient_settings))
         return {
             "acknowledged": True,
             "persistent": state.persistent_settings.as_nested_dict(),
@@ -905,6 +958,9 @@ class Node:
         if self._closed:
             return
         self._closed = True
+        from elasticsearch_tpu.transport.remote_cluster import unregister_node
+
+        unregister_node(self)
         for name in list(self.indices):
             if self.persistent_path:
                 self._persist_index_meta(name)

@@ -157,7 +157,7 @@ def register_all(c) -> None:
     r("GET", "/_nodes/stats", lambda n, q: (200, n.node_stats()))
     r("GET", "/_nodes/{node_id}", lambda n, q: (200, n.node_info()))
     r("GET", "/_nodes/{node_id}/stats", lambda n, q: (200, n.node_stats()))
-    r("GET", "/_remote/info", lambda n, q: (200, {}))
+    r("GET", "/_remote/info", lambda n, q: (200, n.remote_clusters.info()))
 
     # --- tasks ---
     r("GET", "/_tasks", lambda n, q: (200, n.tasks.list_tasks(q.param("actions"))))
@@ -421,7 +421,9 @@ def _field_caps(node, req):
     if isinstance(fields_param, str):
         fields_param = fields_param.split(",")
     out = {}
-    for svc in node.resolve_search_indices(req.param("index", "_all")):
+    # cross-cluster field caps: alias:index groups resolve on the remote
+    pairs, _ = node._resolve_search_groups(req.param("index", "_all"))
+    for _prefix, svc in pairs:
         for pattern in fields_param:
             for fname in svc.mapper_service.mapper.simple_match_to_fields(pattern):
                 ft = svc.mapper_service.field_type(fname)

@@ -1,0 +1,155 @@
+"""Cross-cluster search: remote cluster registry + index-expression split.
+
+Role model: ``RemoteClusterService`` (reference:
+core/src/main/java/org/elasticsearch/transport/RemoteClusterService.java:60)
+— remote clusters declared via ``search.remote.<alias>.seeds`` settings,
+``alias:index`` expressions in search/msearch/field_caps, per-alias
+``skip_unavailable``, and the ``_remote/info`` API. The reference relays
+shard-level requests through gateway nodes (``TransportActionProxy``); in
+this single-process framework the relay is a direct handle to the remote
+``Node``, so remote shards join the coordinator's shard-level merge
+exactly like local ones (true cross-cluster aggregation reduce).
+
+Seeds resolve through a process-level node registry (every ``Node``
+registers by node_name), the in-process stand-in for DNS + transport
+handshake.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+# process-level registry: node_name -> Node (the "network")
+_NODE_REGISTRY: Dict[str, object] = {}
+_LOCK = threading.Lock()
+
+REMOTE_CLUSTERS_SEEDS_PREFIX = "search.remote."
+
+
+def register_node(node) -> None:
+    with _LOCK:
+        _NODE_REGISTRY[node.node_name] = node
+
+
+def unregister_node(node) -> None:
+    with _LOCK:
+        if _NODE_REGISTRY.get(node.node_name) is node:
+            _NODE_REGISTRY.pop(node.node_name, None)
+
+
+class RemoteClusterService:
+    """Per-node registry of remote clusters."""
+
+    def __init__(self, node, settings=None):
+        self._node = node
+        # alias -> (remote Node | None, seeds, skip_unavailable)
+        self._remotes: Dict[str, dict] = {}
+        if settings is not None:
+            self.apply_settings(settings)
+
+    # -- configuration ------------------------------------------------
+
+    def apply_settings(self, settings) -> None:
+        """Parse ``search.remote.<alias>.seeds`` / ``.skip_unavailable``
+        (dynamic: re-applied on cluster-settings updates; empty seeds
+        remove the alias, like the reference)."""
+        aliases = {}
+        for key in settings.keys():
+            if not key.startswith(REMOTE_CLUSTERS_SEEDS_PREFIX):
+                continue
+            rest = key[len(REMOTE_CLUSTERS_SEEDS_PREFIX):]
+            alias, _, param = rest.partition(".")
+            if alias and param:
+                aliases.setdefault(alias, {})[param] = settings.get(key)
+        for alias, cfg in aliases.items():
+            if "seeds" in cfg:
+                seeds = cfg["seeds"]
+                if isinstance(seeds, str):
+                    seeds = [s for s in seeds.split(",") if s]
+                if not seeds:
+                    self._remotes.pop(alias, None)
+                    continue
+                entry = self._remotes.setdefault(
+                    alias, {"node": None, "seeds": [], "skip_unavailable": False})
+                if entry["seeds"] != list(seeds):
+                    entry["node"] = None  # re-resolve after a seed change
+                    entry["seeds"] = list(seeds)
+            if "skip_unavailable" in cfg and alias in self._remotes:
+                self._remotes[alias]["skip_unavailable"] = (
+                    str(cfg["skip_unavailable"]).lower() == "true")
+
+    def attach(self, alias: str, remote_node, skip_unavailable: bool = False) -> None:
+        """Programmatic registration (a resolved connection)."""
+        self._remotes[alias] = {
+            "node": remote_node,
+            "seeds": [getattr(remote_node, "node_name", alias)],
+            "skip_unavailable": skip_unavailable,
+        }
+
+    def remove(self, alias: str) -> None:
+        self._remotes.pop(alias, None)
+
+    # -- resolution ---------------------------------------------------
+
+    def is_remote_cluster_registered(self, alias: str) -> bool:
+        return alias in self._remotes
+
+    def _connect(self, alias: str):
+        entry = self._remotes[alias]
+        if entry["node"] is not None and not getattr(entry["node"], "_closed", False):
+            return entry["node"]
+        # resolve seeds through the process registry (re-resolve every
+        # call: the sniffed-gateway refresh analog)
+        with _LOCK:
+            for seed in entry["seeds"]:
+                name = seed.split(":")[0]  # accept "name" or "name:port"
+                node = _NODE_REGISTRY.get(name)
+                if node is not None and not getattr(node, "_closed", False):
+                    entry["node"] = node
+                    return node
+        return None
+
+    def get_remote(self, alias: str):
+        """-> (remote Node or None, skip_unavailable)."""
+        if alias not in self._remotes:
+            raise IllegalArgumentException(f"no such remote cluster: [{alias}]")
+        return self._connect(alias), self._remotes[alias]["skip_unavailable"]
+
+    def group_indices(self, expression: str) -> List[Tuple[Optional[str], str]]:
+        """Split a comma-separated index expression into (cluster_alias,
+        sub_expression) groups; alias None = local. ``alias:idx`` only
+        routes remotely when the alias is a registered remote cluster
+        (RemoteClusterService.groupClusterIndices semantics — unregistered
+        prefixes stay local index names)."""
+        groups: Dict[Optional[str], List[str]] = {}
+        for part in (expression or "_all").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                alias, _, idx = part.partition(":")
+                if alias in self._remotes:
+                    groups.setdefault(alias, []).append(idx or "_all")
+                    continue
+            groups.setdefault(None, []).append(part)
+        return [(alias, ",".join(parts)) for alias, parts in groups.items()]
+
+    # -- info API -----------------------------------------------------
+
+    def info(self) -> dict:
+        """GET /_remote/info (RemoteInfo / TransportRemoteInfoAction)."""
+        out = {}
+        for alias, entry in self._remotes.items():
+            node = self._connect(alias)
+            out[alias] = {
+                "seeds": entry["seeds"],
+                "connected": node is not None,
+                "num_nodes_connected": 1 if node is not None else 0,
+                "max_connections_per_cluster": 3,
+                "initial_connect_timeout": "30s",
+                "skip_unavailable": entry["skip_unavailable"],
+            }
+        return out

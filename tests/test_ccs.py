@@ -1,0 +1,154 @@
+"""Cross-cluster search (RemoteClusterService).
+
+Mirrors the reference's CCS: remote clusters from
+``search.remote.<alias>.seeds``, ``alias:index`` expressions, hit
+``_index`` prefixed with the alias, ``_clusters`` response section,
+``skip_unavailable``, and the ``_remote/info`` API
+(core/.../transport/RemoteClusterService.java:60).
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    NodeNotConnectedException,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def clusters():
+    local = Node(Settings({"cluster.name": "local", "node.name": "local-node"}))
+    remote = Node(Settings({"cluster.name": "remote", "node.name": "remote-node"}))
+    local.create_index("logs", {"mappings": {"properties": {
+        "msg": {"type": "text"}, "level": {"type": "keyword"}}}})
+    remote.create_index("logs", {"mappings": {"properties": {
+        "msg": {"type": "text"}, "level": {"type": "keyword"}}}})
+    local.index_doc("logs", "l1", {"msg": "disk error on host", "level": "error"})
+    local.index_doc("logs", "l2", {"msg": "all fine", "level": "info"})
+    remote.index_doc("logs", "r1", {"msg": "remote disk error", "level": "error"})
+    remote.index_doc("logs", "r2", {"msg": "remote warning", "level": "warn"})
+    for n in (local, remote):
+        for svc in n.indices.values():
+            svc.refresh()
+    local.remote_clusters.attach("other", remote)
+    yield local, remote
+    local.close()
+    remote.close()
+
+
+class TestCCS:
+    def test_remote_only_search(self, clusters):
+        local, _ = clusters
+        r = local.search("other:logs", {"query": {"match": {"msg": "disk"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["r1"]
+        assert r["hits"]["hits"][0]["_index"] == "other:logs"
+        assert r["_clusters"] == {"total": 1, "successful": 1, "skipped": 0}
+
+    def test_mixed_local_and_remote(self, clusters):
+        local, _ = clusters
+        r = local.search("logs,other:logs",
+                         {"query": {"match": {"msg": "disk error"}}})
+        indices = {h["_index"] for h in r["hits"]["hits"]}
+        assert indices == {"logs", "other:logs"}
+        assert r["hits"]["total"] == 2
+        assert r["_clusters"]["total"] == 2
+
+    def test_aggs_merge_across_clusters(self, clusters):
+        local, _ = clusters
+        r = local.search("logs,other:logs", {
+            "size": 0,
+            "aggs": {"levels": {"terms": {"field": "level"}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["levels"]["buckets"]}
+        assert buckets == {"error": 2, "info": 1, "warn": 1}
+
+    def test_unregistered_alias_is_local_index_name(self, clusters):
+        local, _ = clusters
+        from elasticsearch_tpu.common.errors import IndexNotFoundException
+
+        with pytest.raises(IndexNotFoundException):
+            local.search("nosuch:logs", {"query": {"match_all": {}}})
+
+    def test_unavailable_remote_errors_without_skip(self, clusters):
+        local, remote = clusters
+        remote.close()
+        with pytest.raises(NodeNotConnectedException):
+            local.search("other:logs", {"query": {"match_all": {}}})
+
+    def test_skip_unavailable(self, clusters):
+        local, remote = clusters
+        local.remote_clusters.attach("other", remote, skip_unavailable=True)
+        remote.close()
+        r = local.search("logs,other:logs", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 2  # local only
+        assert r["_clusters"] == {"total": 2, "successful": 1, "skipped": 1}
+
+    def test_remote_info(self, clusters):
+        local, _ = clusters
+        info = local.remote_clusters.info()
+        assert info["other"]["connected"] is True
+        assert info["other"]["num_nodes_connected"] == 1
+        assert info["other"]["skip_unavailable"] is False
+
+    def test_unknown_alias_rejected(self, clusters):
+        local, _ = clusters
+        with pytest.raises(IllegalArgumentException):
+            local.remote_clusters.get_remote("nope")
+
+    def test_msearch_cross_cluster(self, clusters):
+        local, _ = clusters
+        r = local.msearch([
+            ({"index": "other:logs"}, {"query": {"match_all": {}}}),
+            ({"index": "logs"}, {"query": {"match_all": {}}}),
+        ])
+        assert r["responses"][0]["hits"]["total"] == 2
+        assert r["responses"][1]["hits"]["total"] == 2
+
+
+class TestSettingsDriven:
+    def test_seeds_resolve_by_node_name(self):
+        a = Node(Settings({"node.name": "node-a"}))
+        b = Node(Settings({
+            "node.name": "node-b",
+            "search.remote.cluster_a.seeds": ["node-a:9300"],
+            "search.remote.cluster_a.skip_unavailable": "true",
+        }))
+        a.create_index("data")
+        a.index_doc("data", "1", {"v": 1})
+        a.indices["data"].refresh()
+        r = b.search("cluster_a:data", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 1
+        info = b.remote_clusters.info()
+        assert info["cluster_a"]["skip_unavailable"] is True
+        a.close()
+        b.close()
+
+    def test_dynamic_registration_via_cluster_settings(self):
+        a = Node(Settings({"node.name": "dyn-a"}))
+        b = Node(Settings({"node.name": "dyn-b"}))
+        a.create_index("data")
+        a.index_doc("data", "1", {"v": 1})
+        a.indices["data"].refresh()
+        b.put_cluster_settings({"persistent": {
+            "search.remote.peer.seeds": "dyn-a"}})
+        r = b.search("peer:data", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 1
+        # re-pointing the seeds drops the cached connection
+        c = Node(Settings({"node.name": "dyn-c"}))
+        c.create_index("data")
+        c.index_doc("data", "1", {"v": 2})
+        c.index_doc("data", "2", {"v": 3})
+        c.indices["data"].refresh()
+        b.put_cluster_settings({"persistent": {
+            "search.remote.peer.seeds": "dyn-c"}})
+        r = b.search("peer:data", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 2
+        # empty seeds remove the alias
+        b.put_cluster_settings({"persistent": {
+            "search.remote.peer.seeds": ""}})
+        assert not b.remote_clusters.is_remote_cluster_registered("peer")
+        a.close()
+        b.close()
+        c.close()

@@ -33,6 +33,13 @@ class TestValidation:
         with pytest.raises(IllegalArgumentException, match="invalid index sort field"):
             make_index({"index.sort.field": ["body"]})
 
+    def test_nested_field_rejected(self):
+        with pytest.raises(IllegalArgumentException, match="nested"):
+            make_index({"index.sort.field": ["user.age"]},
+                       mapping={"properties": {"user": {
+                           "type": "nested",
+                           "properties": {"age": {"type": "long"}}}}})
+
     def test_bad_order_rejected(self):
         with pytest.raises(IllegalArgumentException, match="Illegal sort order"):
             make_index({"index.sort.field": ["rank"],
@@ -192,6 +199,20 @@ class TestEarlyTermination:
         r = idx.search({"query": {"match_all": {}}, "size": 2,
                         "sort": [{"name": "desc"}]})
         assert r.get("terminated_early") is None
+        idx.close()
+
+    def test_keyword_asc_multivalue_uses_min_value(self):
+        # first_ord must be the doc's MIN ordinal deterministically, so
+        # segment order (mode min) agrees with the query's merge keys
+        idx = make_index({"index.sort.field": ["name"]})
+        idx.index_doc("d1", {"name": ["z", "a"]})
+        idx.index_doc("d2", {"name": "b"})
+        idx.index_doc("d3", {"name": "c"})
+        idx.refresh()
+        r = idx.search({"query": {"match_all": {}}, "size": 2,
+                        "sort": [{"name": "asc"}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["d1", "d2"]
+        assert r.get("terminated_early") is True
         idx.close()
 
     def test_multi_segment_results_merge_correctly(self):
