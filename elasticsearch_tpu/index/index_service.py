@@ -188,7 +188,8 @@ class IndexService:
         for r in shard_results:
             if r.max_score is not None:
                 max_score = r.max_score if max_score is None else max(max_score, r.max_score)
-        collapse_field = (body.get("collapse") or {}).get("field")
+        collapse_body = body.get("collapse") or {}
+        collapse_field = collapse_body.get("field")
         merge_k = max(k, 0)
         if collapse_field:
             merge_k = 0  # keep all candidates; collapsing shrinks the list
@@ -207,6 +208,11 @@ class IndexService:
             aggregations = run_aggregations(agg_specs, views)
 
         hits = fetch_hits(refs_window, self.shards, body, self.name)
+        if collapse_field:
+            from elasticsearch_tpu.search.service import expand_collapsed_hits
+
+            expand_collapsed_hits(hits, refs_window, collapse_body, body,
+                                  self.search)
         took = int((time.monotonic() - t0) * 1000)
         resp = {
             "took": took,

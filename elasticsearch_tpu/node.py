@@ -430,6 +430,9 @@ class Node:
                scroll: Optional[str] = None) -> dict:
         pairs, clusters = self._resolve_search_groups(expression or "_all")
         body = body or {}
+        if scroll and body.get("collapse"):
+            raise IllegalArgumentException(
+                "cannot use `collapse` in a scroll context")
         task = self.tasks.register("indices:data/read/search", f"search [{expression}]")
         try:
             if len(pairs) == 1 and pairs[0][0] == "" and clusters is None:
@@ -500,6 +503,10 @@ class Node:
         size = int(body.get("size")) if body.get("size") is not None else 10
         k = from_ + size
         sort_spec = normalize_sort(body.get("sort"))
+        from elasticsearch_tpu.search.service import validate_collapse
+
+        collapse_body = body.get("collapse") or {}
+        collapse_field = validate_collapse(body)
         all_refs = []
         total = 0
         max_score = None
@@ -518,11 +525,18 @@ class Node:
                     ref.shard_id = (display, ref.shard_id)
                     all_refs.append(ref)
                 views.extend(res.agg_views)
-        refs = merge_refs(all_refs, sort_spec, max(k, 0))[from_: from_ + size]
         shard_map = {}
         for prefix, svc in pairs:
             for sid, shard in svc.shards.items():
                 shard_map[(f"{prefix}{svc.name}", sid)] = shard
+        if collapse_field:
+            from elasticsearch_tpu.search.service import collapse_refs
+
+            refs = merge_refs(all_refs, sort_spec, len(all_refs))
+            refs = collapse_refs(refs, collapse_field, shard_map)
+            refs = refs[from_: from_ + size]
+        else:
+            refs = merge_refs(all_refs, sort_spec, max(k, 0))[from_: from_ + size]
         hits = []
         by_index: Dict[str, List] = {}
         for ref in refs:
@@ -533,6 +547,13 @@ class Node:
             for ref, hit in zip(idx_refs, fetch_hits(idx_refs, sub_shards, body, idx_name)):
                 ordered_hits[id(ref)] = hit
         hits = [ordered_hits[id(r)] for r in refs if id(r) in ordered_hits]
+        if collapse_field:
+            from elasticsearch_tpu.search.service import expand_collapsed_hits
+
+            # ExpandSearchPhase across all clusters/indices of the request
+            expand_collapsed_hits(
+                hits, refs, collapse_body, body,
+                lambda sub: self._multi_index_search(pairs, sub))
         resp = {
             "took": int((time.monotonic() - t0) * 1000),
             "timed_out": False,

@@ -127,6 +127,10 @@ class ShardSearcher:
         min_score = source.get("min_score")
         sort_spec = normalize_sort(source.get("sort"))
         search_after = source.get("search_after")
+        # shard-level collapse (CollapsingTopDocsCollector analog): every
+        # group's shard-best must survive to the coordinator, so selection
+        # is uncapped and collapsed to k groups per shard
+        collapse_field = validate_collapse(source)
         slice_spec = source.get("slice")
         rescore_specs = _normalize_rescore(source.get("rescore"))
         profile = bool(source.get("profile", False))
@@ -175,8 +179,13 @@ class ShardSearcher:
                 _, post_m = P.execute(dev, post_qb.to_plan(self.ctx, seg))
                 matched = matched & np.asarray(post_m)
             total += int(matched[: seg.num_docs].sum())
-            seg_refs = self._select(seg, scores, matched, sort_spec, search_after,
-                                    k_select, index_sorted=index_sorted)
+            if collapse_field:
+                seg_refs = self._select_all(seg, scores, matched, sort_spec,
+                                            search_after)
+            else:
+                seg_refs = self._select(seg, scores, matched, sort_spec,
+                                        search_after, k_select,
+                                        index_sorted=index_sorted)
             if rescore_specs and sort_spec is None:
                 seg_refs = self._rescore(seg, dev, seg_refs, rescore_specs)
             refs.extend(seg_refs)
@@ -206,7 +215,11 @@ class ShardSearcher:
                     }],
                 })
 
-        refs = merge_refs(refs, sort_spec, k_select if rescore_specs else k)
+        if collapse_field:
+            refs = merge_refs(refs, sort_spec, len(refs))
+            refs = collapse_refs(refs, collapse_field, {self.shard_id: self})[:k]
+        else:
+            refs = merge_refs(refs, sort_spec, k_select if rescore_specs else k)
         if rescore_specs and sort_spec is None:
             refs.sort(key=lambda r: (-r.score, r.local_doc))
             refs = refs[:k]
@@ -278,6 +291,31 @@ class ShardSearcher:
                     mask[local] = True
             seg.dev_cache[key] = mask
         return seg.dev_cache[key]
+
+    def _select_all(self, seg, scores, matched, sort_spec,
+                    search_after) -> List[DocRef]:
+        """Uncapped selection of every matching doc, ordered by the
+        request's sort — the collapse path needs the full candidate set so
+        no group's best doc is cut by a top-k window."""
+        live_matched = matched[: seg.nd_pad] & seg.live
+        if sort_spec is None:
+            if search_after is not None:
+                live_matched = live_matched & (scores[: seg.nd_pad]
+                                               < float(search_after[0]))
+            idx = np.flatnonzero(live_matched)
+            out = [DocRef(self.shard_id, seg.name, int(d), float(scores[d]),
+                          (float(scores[d]),)) for d in idx]
+            out.sort(key=lambda r: (-r.score, r.local_doc))
+            return out
+        keys, all_key_arrays = self._sort_keys(seg, scores, sort_spec)
+        if search_after is not None:
+            live_matched = live_matched & _search_after_mask(
+                all_key_arrays, sort_spec, search_after)[: seg.nd_pad]
+        idx = np.flatnonzero(live_matched)
+        out = [DocRef(self.shard_id, seg.name, int(d), float(scores[d]),
+                      tuple(arr[d] for arr in all_key_arrays)) for d in idx]
+        out.sort(key=lambda r: _ref_sort_key(r, sort_spec))
+        return out
 
     def _select(self, seg, scores, matched, sort_spec, search_after, k,
                 index_sorted: bool = False) -> List[DocRef]:
@@ -519,6 +557,67 @@ def collapse_refs(refs: List["DocRef"], field_name: str, shards: Dict) -> List["
         ref.collapse_value = value
         out.append(ref)
     return out
+
+
+def expand_collapsed_hits(hits: List[dict], refs: List["DocRef"],
+                          collapse_body: dict, body: dict, search_fn) -> None:
+    """ExpandSearchPhase (action/search/ExpandSearchPhase.java:44): attach
+    the collapse value to each hit's fields and, when the collapse declares
+    inner_hits, run one group sub-search (original query AND group-value
+    filter) per top hit per spec via ``search_fn(sub_body) -> response``."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+    field = collapse_body["field"]
+    specs = collapse_body.get("inner_hits")
+    if isinstance(specs, dict):
+        specs = [specs]
+    if specs:
+        names = [spec.get("name", field) for spec in specs]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise IllegalArgumentException(
+                f"[inner_hits] already contains an entry for key [{dupes.pop()}]")
+    orig_query = body.get("query") or {"match_all": {}}
+    for hit, ref in zip(hits, refs):
+        value = ref.collapse_value
+        hit.setdefault("fields", {})[field] = [value]
+        if not specs:
+            continue
+        if value is None:
+            group_filter = {"bool": {"must_not": [{"exists": {"field": field}}]}}
+        else:
+            group_filter = {"term": {field: value}}
+        for spec in specs:
+            name = spec.get("name", field)
+            sub = {
+                "query": {"bool": {"must": [orig_query],
+                                   "filter": [group_filter]}},
+                "from": int(spec.get("from", 0)),
+                # InnerHitBuilder default size = 3
+                "size": int(spec.get("size", 3)),
+            }
+            for key in ("sort", "_source", "docvalue_fields", "script_fields",
+                        "stored_fields", "version", "highlight"):
+                if key in spec:
+                    sub[key] = spec[key]
+            hit.setdefault("inner_hits", {})[name] = {
+                "hits": search_fn(sub)["hits"]}
+
+
+def validate_collapse(body: dict) -> Optional[str]:
+    """Body-shape validation for collapse, run BEFORE shard execution
+    (SearchService createContext checks). Returns the collapse field or
+    None."""
+    from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+    collapse_field = (body.get("collapse") or {}).get("field")
+    if collapse_field and body.get("search_after") is not None:
+        raise IllegalArgumentException(
+            "cannot use `collapse` in conjunction with `search_after`")
+    if collapse_field and body.get("rescore"):
+        raise IllegalArgumentException(
+            "cannot use `collapse` in conjunction with `rescore`")
+    return collapse_field
 
 
 def normalize_sort(sort_body) -> Optional[List[Tuple[str, str, Any]]]:

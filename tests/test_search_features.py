@@ -81,6 +81,131 @@ class TestCollapse:
         assert ids(r) == ["1", "4", "2"]  # best n per group: 9(g1), 7(g3), 5(g2)
         svc.close()
 
+    def test_collapse_inner_hits_expansion(self):
+        svc = IndexService("c2", Settings({"index.number_of_shards": 2}))
+        rows = [("g1", 1), ("g1", 9), ("g1", 4), ("g2", 5), ("g2", 3)]
+        for i, (g, n) in enumerate(rows):
+            svc.index_doc(str(i), {"group": g, "n": n, "t": "x"})
+        svc.refresh()
+        r = svc.search({
+            "query": {"match": {"t": "x"}},
+            "collapse": {
+                "field": "group",
+                "inner_hits": {"name": "group_docs", "size": 2,
+                               "sort": [{"n": "desc"}]},
+            },
+            "sort": [{"n": "desc"}],
+        })
+        hits = r["hits"]["hits"]
+        assert [h["_id"] for h in hits] == ["1", "3"]
+        # collapse value rides in fields
+        assert hits[0]["fields"]["group"] == ["g1"]
+        ih = hits[0]["inner_hits"]["group_docs"]["hits"]
+        assert ih["total"] == 3  # whole g1 group
+        assert [h["_id"] for h in ih["hits"]] == ["1", "2"]  # top-2 by n
+        ih2 = hits[1]["inner_hits"]["group_docs"]["hits"]
+        assert ih2["total"] == 2
+        assert [h["_id"] for h in ih2["hits"]] == ["3", "4"]
+        svc.close()
+
+    def test_collapse_multiple_inner_hits_and_missing_group(self):
+        svc = IndexService("c3", Settings({"index.number_of_shards": 1}))
+        svc.index_doc("a", {"group": "g1", "n": 2, "t": "x"})
+        svc.index_doc("b", {"n": 8, "t": "x"})  # missing group
+        svc.index_doc("c", {"n": 6, "t": "x"})  # missing group
+        svc.refresh()
+        r = svc.search({
+            "query": {"match": {"t": "x"}},
+            "collapse": {"field": "group", "inner_hits": [
+                {"name": "most", "size": 1, "sort": [{"n": "desc"}]},
+                {"name": "least", "size": 1, "sort": [{"n": "asc"}]},
+            ]},
+            "sort": [{"n": "desc"}],
+        })
+        hits = r["hits"]["hits"]
+        assert [h["_id"] for h in hits] == ["b", "a"]  # null group best=b
+        null_group = hits[0]
+        assert null_group["fields"]["group"] == [None]
+        assert [h["_id"] for h in
+                null_group["inner_hits"]["most"]["hits"]["hits"]] == ["b"]
+        assert [h["_id"] for h in
+                null_group["inner_hits"]["least"]["hits"]["hits"]] == ["c"]
+        svc.close()
+
+    def test_collapse_sees_groups_beyond_topk_window(self):
+        # 20 high-scoring g1 docs must not evict g2's best from the
+        # shard's candidate set (shard-level collapse is uncapped)
+        svc = IndexService("c6", Settings({"index.number_of_shards": 1}))
+        for i in range(20):
+            svc.index_doc(f"a{i}", {"group": "g1", "n": 20 - i, "t": "x"})
+        for i in range(10):
+            svc.index_doc(f"b{i}", {"group": "g2", "n": -i, "t": "x"})
+        svc.refresh()
+        r = svc.search({"query": {"match": {"t": "x"}},
+                        "collapse": {"field": "group"},
+                        "sort": [{"n": "desc"}], "size": 10})
+        groups = [h["fields"]["group"][0] for h in r["hits"]["hits"]]
+        assert groups == ["g1", "g2"]
+        svc.close()
+
+    def test_collapse_duplicate_inner_hits_names_rejected(self):
+        import pytest
+
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+        svc = IndexService("c7", Settings({"index.number_of_shards": 1}))
+        svc.index_doc("a", {"group": "g"})
+        svc.refresh()
+        with pytest.raises(IllegalArgumentException, match="inner_hits"):
+            svc.search({"collapse": {"field": "group", "inner_hits": [
+                {"size": 1}, {"size": 2}]}})
+        svc.close()
+
+    def test_collapse_rejected_with_search_after_and_scroll(self):
+        import pytest
+
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+        from elasticsearch_tpu.node import Node
+
+        svc = IndexService("c4", Settings({"index.number_of_shards": 1}))
+        svc.index_doc("a", {"group": "g", "n": 1})
+        svc.refresh()
+        with pytest.raises(IllegalArgumentException):
+            svc.search({"collapse": {"field": "group"},
+                        "sort": [{"n": "asc"}], "search_after": [0]})
+        svc.close()
+        node = Node()
+        node.create_index("c5")
+        node.index_doc("c5", "1", {"group": "g"})
+        with pytest.raises(IllegalArgumentException):
+            node.search("c5", {"collapse": {"field": "group"}}, scroll="1m")
+        node.close()
+
+    def test_collapse_across_indices(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        for idx in ("i1", "i2"):
+            node.create_index(idx)
+        node.index_doc("i1", "a", {"group": "g1", "n": 9})
+        node.index_doc("i2", "b", {"group": "g1", "n": 5})
+        node.index_doc("i2", "c", {"group": "g2", "n": 7})
+        for svc in node.indices.values():
+            svc.refresh()
+        r = node.search("i1,i2", {
+            "query": {"match_all": {}},
+            "collapse": {"field": "group",
+                         "inner_hits": {"name": "g", "size": 5,
+                                        "sort": [{"n": "desc"}]}},
+            "sort": [{"n": "desc"}],
+        })
+        hits = r["hits"]["hits"]
+        assert [h["_id"] for h in hits] == ["a", "c"]
+        # inner hits span both indices
+        g1 = hits[0]["inner_hits"]["g"]["hits"]
+        assert {h["_id"] for h in g1["hits"]} == {"a", "b"}
+        node.close()
+
 
 class TestScriptFields:
     def test_script_field_arithmetic(self, idx):
