@@ -149,6 +149,7 @@ class Segment:
         exists_masks: Dict[str, np.ndarray],
         positions: Optional[Dict[int, dict]] = None,
         nested: Optional[Dict[str, NestedContext]] = None,
+        shapes: Optional[Dict[str, Dict[int, list]]] = None,
     ):
         self.name = name
         self.num_docs = num_docs
@@ -178,6 +179,10 @@ class Segment:
         self.positions = positions or {}
         # nested path -> NestedContext (sub-segment + parent pointers)
         self.nested = nested or {}
+        # geo_shape field -> {doc: [raw GeoJSON/WKT]}; geometry objects +
+        # bbox tables build lazily (shape_column)
+        self.shapes = shapes or {}
+        self._shape_cols: Dict[str, dict] = {}
         # tombstones for deleted docs (set by the engine on update/delete)
         self.live = np.ones(self.nd_pad, dtype=bool)
         self.live[num_docs:] = False
@@ -245,6 +250,30 @@ class Segment:
             cnt = int(self.term_block_count[tid])
             hit = cache[tid] = int(self.block_tfs[start:start + cnt].sum())
         return hit
+
+    def shape_column(self, field_name: str) -> Optional[dict]:
+        """Lazy geo_shape column: parsed geometry per doc + dense bbox
+        table [nd_pad, 4] (min_lon, min_lat, max_lon, max_lat) for the
+        vectorized prefilter. None if the field has no shapes here."""
+        per_doc = self.shapes.get(field_name)
+        if not per_doc:
+            return None
+        col = self._shape_cols.get(field_name)
+        if col is None:
+            from elasticsearch_tpu.utils.geometry import parse_shape
+
+            geoms = {doc: [parse_shape(v) for v in vals]
+                     for doc, vals in per_doc.items()}
+            bbox = np.full((self.nd_pad, 4), np.nan, np.float64)
+            exists = np.zeros(self.nd_pad, bool)
+            for doc, gs in geoms.items():
+                bs = [g.bbox() for g in gs]
+                bbox[doc] = (min(b[0] for b in bs), min(b[1] for b in bs),
+                             max(b[2] for b in bs), max(b[3] for b in bs))
+                exists[doc] = True
+            col = self._shape_cols[field_name] = {
+                "geoms": geoms, "bbox": bbox, "exists": exists}
+        return col
 
     def field_avgdl(self, field_name: str) -> float:
         st = self.field_stats.get(field_name)
@@ -327,6 +356,8 @@ class SegmentBuilder:
         self.numeric_values: Dict[str, List[Tuple[int, float]]] = {}
         self.string_values: Dict[str, List[Tuple[int, str]]] = {}
         self.geo_values: Dict[str, List[Tuple[int, float, float]]] = {}
+        # geo_shape field -> {doc: [raw GeoJSON/WKT values]}
+        self.shape_values: Dict[str, Dict[int, list]] = {}
         self.field_docs: Dict[str, set] = {}
         # nested path -> {"builder": SegmentBuilder, "parent_of": [...],
         #                 "offset_of": [...]}
@@ -370,6 +401,10 @@ class SegmentBuilder:
             self.geo_values.setdefault(field_name, []).extend(
                 (doc, lat, lon) for lat, lon in pts
             )
+        for field_name, vals in getattr(parsed, "shape_values", {}).items():
+            self.field_docs.setdefault(field_name, set()).add(doc)
+            self.shape_values.setdefault(field_name, {}).setdefault(
+                doc, []).extend(vals)
         for field_name, pairs in getattr(parsed, "range_values", {}).items():
             # two parallel numeric columns stay aligned: both appended once
             # per value, in the same order (stable doc sort in seal())
@@ -452,6 +487,10 @@ class SegmentBuilder:
             )
         self.field_docs = {
             f: {int(inv[d]) for d in docs} for f, docs in self.field_docs.items()
+        }
+        self.shape_values = {
+            f: {int(inv[d]): vals for d, vals in per_doc.items()}
+            for f, per_doc in self.shape_values.items()
         }
         for entry in self.nested_builders.values():
             entry["parent_of"] = [int(inv[d]) for d in entry["parent_of"]]
@@ -632,4 +671,5 @@ class SegmentBuilder:
             exists_masks=exists_masks,
             positions=positions,
             nested=nested,
+            shapes={f: dict(per_doc) for f, per_doc in self.shape_values.items()},
         )

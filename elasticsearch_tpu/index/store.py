@@ -146,6 +146,10 @@ class Store:
             "geo_fields": {f: c.count for f, c in seg.geo_columns.items()},
             "doc_ids": seg.doc_ids,
             "routings": seg.routings,
+            # geo_shape sidecar: raw GeoJSON/WKT per doc (geometry rebuilt
+            # lazily at query time)
+            "shapes": {f: {str(doc): vals for doc, vals in per_doc.items()}
+                       for f, per_doc in seg.shapes.items()},
         }
         with open(os.path.join(d, "meta.json"), "w", encoding="utf-8") as f:
             json.dump(meta, f)
@@ -288,6 +292,8 @@ class Store:
             geo_columns=geo_columns,
             exists_masks=exists_masks,
             positions=positions,
+            shapes={f: {int(doc): vals for doc, vals in per_doc.items()}
+                    for f, per_doc in (meta.get("shapes") or {}).items()},
         )
         live_path = os.path.join(d, "live.npy")
         if os.path.exists(live_path):

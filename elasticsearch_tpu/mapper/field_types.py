@@ -732,9 +732,34 @@ class CompletionFieldType(FieldType):
         return None
 
 
+class GeoShapeFieldType(FieldType):
+    """geo_shape: GeoJSON/WKT geometries kept host-side per doc with a
+    dense bbox table for vectorized prefiltering (reference:
+    index/mapper/GeoShapeFieldMapper.java over Lucene spatial prefix
+    trees; see utils/geometry.py for the TPU-side design)."""
+
+    type_name = "geo_shape"
+    has_doc_values = False
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return None
+
+    def parse_shape_value(self, value):
+        """Validate at index time; the raw GeoJSON dict / WKT string is
+        stored and geometry objects build lazily at query time."""
+        from elasticsearch_tpu.utils.geometry import parse_shape
+
+        parse_shape(value)  # raises MapperParsingException on bad input
+        return value
+
+
 FIELD_TYPES = {
     t.type_name: t
     for t in [
+        GeoShapeFieldType,
         CompletionFieldType,
         PercolatorFieldType,
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,

@@ -45,6 +45,8 @@ class ParsedDocument:
     string_values: Dict[str, List[str]] = field(default_factory=dict)
     # geo points: field -> list[(lat, lon)]
     geo_values: Dict[str, List[Tuple[float, float]]] = field(default_factory=dict)
+    # geo shapes: field -> list of raw GeoJSON dicts / WKT strings
+    shape_values: Dict[str, List[Any]] = field(default_factory=dict)
     # range fields: field -> list[(lo, hi)] inclusive float bounds
     range_values: Dict[str, List[Tuple[float, float]]] = field(default_factory=dict)
     # fields present (for exists query — the reference's _field_names field)
@@ -119,6 +121,7 @@ class DocumentMapper:
         out.field_names = sorted(
             set(out.terms) | set(out.numeric_values) | set(out.string_values)
             | set(out.geo_values) | set(out.range_values)
+            | set(out.shape_values)
         )
         return out
 
@@ -216,6 +219,7 @@ class DocumentMapper:
             sub.field_names = sorted(
                 set(sub.terms) | set(sub.numeric_values) | set(sub.string_values)
                 | set(sub.geo_values) | set(sub.range_values)
+                | set(sub.shape_values)
             )
             out.nested.setdefault(path, []).append(sub)
             if params_n.get("include_in_parent") or params_n.get("include_in_root"):
@@ -226,7 +230,7 @@ class DocumentMapper:
                 self._parse_object(path + ".", obj, inc, sub_props,
                                    sub_new if dynamic == "true" else {}, dynamic)
                 for store in ("terms", "numeric_values", "string_values",
-                              "geo_values", "range_values"):
+                              "geo_values", "range_values", "shape_values"):
                     for f, vals in getattr(inc, store).items():
                         getattr(out, store).setdefault(f, []).extend(vals)
         if dynamic == "true" and not sub_new:
@@ -276,6 +280,12 @@ class DocumentMapper:
     def _index_single(self, ft: FieldType, v: Any, out: ParsedDocument) -> None:
         if isinstance(ft, GeoPointFieldType):
             out.geo_values.setdefault(ft.name, []).append(ft.parse_point(v))
+            return
+        from elasticsearch_tpu.mapper.field_types import GeoShapeFieldType
+
+        if isinstance(ft, GeoShapeFieldType):
+            out.shape_values.setdefault(ft.name, []).append(
+                ft.parse_shape_value(v))
             return
         from elasticsearch_tpu.mapper.field_types import (
             CompletionFieldType,

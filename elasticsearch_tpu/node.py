@@ -430,6 +430,7 @@ class Node:
                scroll: Optional[str] = None) -> dict:
         pairs, clusters = self._resolve_search_groups(expression or "_all")
         body = body or {}
+        body = self._rewrite_indexed_shapes(body)
         if scroll and body.get("collapse"):
             raise IllegalArgumentException(
                 "cannot use `collapse` in a scroll context")
@@ -486,6 +487,54 @@ class Node:
         total = n_remote + (1 if has_local else 0)
         return pairs, {"total": total, "successful": total - skipped,
                        "skipped": skipped}
+
+    def _rewrite_indexed_shapes(self, body: dict) -> dict:
+        """Coordinator rewrite (GeoShapeQueryBuilder's Rewriteable): fetch
+        each geo_shape query's ``indexed_shape`` reference document and
+        inline its shape before shard execution."""
+        import json as _json
+
+        if "indexed_shape" not in _json.dumps(body.get("query") or {}):
+            return body
+        import copy as _copy
+
+        from elasticsearch_tpu.common.errors import ResourceNotFoundException
+
+        body = _copy.deepcopy(body)
+
+        def walk(obj):
+            if isinstance(obj, dict):
+                gs = obj.get("geo_shape")
+                if isinstance(gs, dict):
+                    for fname, spec in gs.items():
+                        if isinstance(spec, dict) and "indexed_shape" in spec:
+                            ref = spec.pop("indexed_shape")
+                            if not isinstance(ref, dict) or "index" not in ref \
+                                    or "id" not in ref:
+                                raise IllegalArgumentException(
+                                    "[indexed_shape] requires index and id")
+                            g = self.get_doc(ref["index"], ref["id"])
+                            if not g.get("found"):
+                                raise ResourceNotFoundException(
+                                    f"indexed document [{ref['index']}/"
+                                    f"{ref['id']}] not found")
+                            val = g["_source"]
+                            path = str(ref.get("path", "shape"))
+                            for part in path.split("."):
+                                if not isinstance(val, dict) or part not in val:
+                                    raise IllegalArgumentException(
+                                        f"field [{path}] not found in indexed "
+                                        f"document [{ref['index']}/{ref['id']}]")
+                                val = val[part]
+                            spec["shape"] = val
+                for v in obj.values():
+                    walk(v)
+            elif isinstance(obj, list):
+                for v in obj:
+                    walk(v)
+
+        walk(body.get("query"))
+        return body
 
     def _multi_index_search(self, pairs: List[tuple], body: dict) -> dict:
         """Cross-index search: fan out, merge like cross-shard merge.

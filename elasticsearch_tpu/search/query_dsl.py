@@ -1121,6 +1121,84 @@ class MoreLikeThisQueryBuilder(QueryBuilder):
         ))
 
 
+class GeoShapeQueryBuilder(QueryBuilder):
+    """geo_shape query (index/query/GeoShapeQueryBuilder.java): relate the
+    query shape to each doc's indexed shapes — INTERSECTS (default),
+    DISJOINT, WITHIN, CONTAINS. Vectorized bbox prefilter over the
+    segment's dense bbox table, exact planar predicates on candidates
+    (utils/geometry.py). ``indexed_shape`` references are resolved by a
+    coordinator rewrite before shard execution (node.py)."""
+
+    name = "geo_shape"
+
+    def __init__(self, field: str, shape=None, relation: str = "intersects",
+                 ignore_unmapped: bool = False, **kw):
+        from elasticsearch_tpu.utils.geometry import parse_shape
+
+        super().__init__(**kw)
+        self.field = field
+        self.shape = shape
+        self.relation = str(relation).lower()
+        self.ignore_unmapped = ignore_unmapped
+        if self.relation not in ("intersects", "disjoint", "within", "contains"):
+            raise ParsingException(
+                f"Unknown geo_shape relation [{relation}]")
+        if shape is None:
+            raise ParsingException(
+                "[geo_shape] requires a shape or indexed_shape")
+        self._geom = parse_shape(shape)  # parse once per query, not per segment
+
+    def to_plan(self, ctx, segment):
+        from elasticsearch_tpu.mapper.field_types import GeoShapeFieldType
+
+        ft = ctx.field_type(self.field)
+        if not isinstance(ft, GeoShapeFieldType):
+            if self.ignore_unmapped:
+                return P.MatchNoneNode()
+            raise QueryShardException(
+                f"failed to find geo_shape field [{self.field}]")
+        col = segment.shape_column(self.field)
+        nd1 = segment.nd_pad + 1
+        mask = np.zeros(nd1, dtype=bool)
+        if col is not None:
+            q = self._geom
+            qb = q.bbox()
+            bbox, exists = col["bbox"], col["exists"]
+            with np.errstate(invalid="ignore"):
+                overlap = exists & ~(
+                    (bbox[:, 0] > qb[2]) | (qb[0] > bbox[:, 2])
+                    | (bbox[:, 1] > qb[3]) | (qb[1] > bbox[:, 3])
+                )
+            if self.relation == "disjoint":
+                # all docs with the field are candidates; non-overlapping
+                # bboxes are immediately disjoint
+                mask[: segment.nd_pad] = exists & ~overlap
+                candidates = np.flatnonzero(overlap)
+            elif self.relation == "contains":
+                # a containing shape's own bbox covers the query bbox, so
+                # the doc's combined bbox does too — safe prefilter
+                with np.errstate(invalid="ignore"):
+                    covers = exists & (
+                        (bbox[:, 0] <= qb[0]) & (bbox[:, 1] <= qb[1])
+                        & (bbox[:, 2] >= qb[2]) & (bbox[:, 3] >= qb[3])
+                    )
+                candidates = np.flatnonzero(covers)
+            else:
+                # intersects AND within use the overlap prefilter: within
+                # matches if ANY doc shape sits inside the query shape, and
+                # the doc's combined multi-shape bbox may exceed the query
+                # bbox even when one shape qualifies
+                candidates = np.flatnonzero(overlap)
+            for doc in candidates:
+                gs = col["geoms"][int(doc)]
+                if self.relation == "disjoint":
+                    mask[doc] = not any(g.intersects(q) for g in gs)
+                else:
+                    mask[doc] = any(g.relate(q, self.relation) for g in gs)
+        return P.ConstantScoreNode(
+            P.DenseMaskNode(mask, label=f"geo_shape.{self.field}"), self.boost)
+
+
 class PercolateQueryBuilder(QueryBuilder):
     """Inverse search (modules/percolator — PercolateQueryBuilder:86): find
     stored queries (percolator-typed fields) matching a candidate document.
@@ -1789,6 +1867,21 @@ def parse_query(body) -> QueryBuilder:
             raise ParsingException("[geo_bounding_box] requires exactly one field")
         field, box = next(iter(params.items()))
         return GeoBoundingBoxQueryBuilder(field, box["top_left"], box["bottom_right"])
+    if qtype == "geo_shape":
+        params = dict(qbody)
+        ignore_unmapped = bool(params.pop("ignore_unmapped", False))
+        boost = float(params.pop("boost", 1.0))
+        if len(params) != 1:
+            raise ParsingException("[geo_shape] requires exactly one field")
+        field, spec = next(iter(params.items()))
+        if "indexed_shape" in spec:
+            raise ParsingException(
+                "[geo_shape] indexed_shape must be resolved by the "
+                "coordinator rewrite before shard execution")
+        return GeoShapeQueryBuilder(
+            field, shape=spec.get("shape"),
+            relation=spec.get("relation", "intersects"),
+            ignore_unmapped=ignore_unmapped, boost=boost)
     if qtype == "geo_polygon":
         params = dict(qbody)
         params.pop("validation_method", None)

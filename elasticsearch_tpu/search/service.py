@@ -180,8 +180,7 @@ class ShardSearcher:
                 matched = matched & np.asarray(post_m)
             total += int(matched[: seg.num_docs].sum())
             if collapse_field:
-                seg_refs = self._select_all(seg, scores, matched, sort_spec,
-                                            search_after)
+                seg_refs = self._select_all(seg, scores, matched, sort_spec)
             else:
                 seg_refs = self._select(seg, scores, matched, sort_spec,
                                         search_after, k_select,
@@ -292,26 +291,19 @@ class ShardSearcher:
             seg.dev_cache[key] = mask
         return seg.dev_cache[key]
 
-    def _select_all(self, seg, scores, matched, sort_spec,
-                    search_after) -> List[DocRef]:
+    def _select_all(self, seg, scores, matched, sort_spec) -> List[DocRef]:
         """Uncapped selection of every matching doc, ordered by the
         request's sort — the collapse path needs the full candidate set so
-        no group's best doc is cut by a top-k window."""
+        no group's best doc is cut by a top-k window. (search_after is
+        rejected with collapse upstream, so no cursor masking here.)"""
         live_matched = matched[: seg.nd_pad] & seg.live
+        idx = np.flatnonzero(live_matched)
         if sort_spec is None:
-            if search_after is not None:
-                live_matched = live_matched & (scores[: seg.nd_pad]
-                                               < float(search_after[0]))
-            idx = np.flatnonzero(live_matched)
             out = [DocRef(self.shard_id, seg.name, int(d), float(scores[d]),
                           (float(scores[d]),)) for d in idx]
             out.sort(key=lambda r: (-r.score, r.local_doc))
             return out
         keys, all_key_arrays = self._sort_keys(seg, scores, sort_spec)
-        if search_after is not None:
-            live_matched = live_matched & _search_after_mask(
-                all_key_arrays, sort_spec, search_after)[: seg.nd_pad]
-        idx = np.flatnonzero(live_matched)
         out = [DocRef(self.shard_id, seg.name, int(d), float(scores[d]),
                       tuple(arr[d] for arr in all_key_arrays)) for d in idx]
         out.sort(key=lambda r: _ref_sort_key(r, sort_spec))

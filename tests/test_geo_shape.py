@@ -1,0 +1,238 @@
+"""geo_shape field type + query.
+
+Mirrors the reference's geo_shape support: GeoJSON + WKT shape parsing
+(common/geo/builders, GeoWKTParser), the geo_shape query with
+INTERSECTS / DISJOINT / WITHIN / CONTAINS relations
+(index/query/GeoShapeQueryBuilder.java), and pre-indexed shape
+references resolved by coordinator rewrite.
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    MapperParsingException,
+    QueryShardException,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.utils import geometry as G
+
+
+class TestGeometry:
+    def test_point_in_polygon(self):
+        sq = G.Polygon([(0, 0), (10, 0), (10, 10), (0, 10), (0, 0)])
+        assert sq.contains_point((5, 5))
+        assert sq.contains_point((0, 5))  # boundary counts
+        assert not sq.contains_point((11, 5))
+
+    def test_polygon_with_hole(self):
+        donut = G.Polygon(
+            [(0, 0), (10, 0), (10, 10), (0, 10), (0, 0)],
+            holes=[[(4, 4), (6, 4), (6, 6), (4, 6), (4, 4)]],
+        )
+        assert donut.contains_point((1, 1))
+        assert not donut.contains_point((5, 5))  # in the hole
+
+    def test_relations(self):
+        a = G.Polygon([(0, 0), (4, 0), (4, 4), (0, 4), (0, 0)])
+        b = G.Polygon([(1, 1), (2, 1), (2, 2), (1, 2), (1, 1)])
+        c = G.Polygon([(10, 10), (12, 10), (12, 12), (10, 12), (10, 10)])
+        assert b.within(a) and a.contains(b)
+        assert a.intersects(b) and not a.intersects(c)
+        assert a.disjoint(c)
+        line = G.LineString([(-1, 2), (5, 2)])
+        assert line.intersects(a)
+        assert not line.within(a)  # endpoints stick out
+
+    def test_wkt_roundtrip(self):
+        p = G.parse_wkt("POINT (30 10)")
+        assert (p.lon, p.lat) == (30.0, 10.0)
+        poly = G.parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+        assert poly.contains_point((5, 5))
+        mp = G.parse_wkt("MULTIPOLYGON (((0 0, 2 0, 2 2, 0 2, 0 0)), ((5 5, 7 5, 7 7, 5 7, 5 5)))")
+        assert mp.contains_point((1, 1)) and mp.contains_point((6, 6))
+        env = G.parse_wkt("ENVELOPE (0, 10, 10, 0)")
+        assert env.contains_point((5, 5))
+
+    def test_geojson_parse_errors(self):
+        with pytest.raises(MapperParsingException):
+            G.parse_geojson({"type": "blob", "coordinates": []})
+        with pytest.raises(MapperParsingException):
+            G.parse_geojson({"type": "polygon",
+                             "coordinates": [[[0, 0], [1, 1], [0, 0]]]})
+        with pytest.raises(MapperParsingException):
+            G.parse_geojson({"no": "type"})
+
+    def test_point_to_point_and_point_on_line_intersect(self):
+        p = G.Point(5, 5)
+        assert p.intersects(G.Point(5, 5))
+        assert not p.intersects(G.Point(5, 6))
+        line = G.LineString([(0, 5), (10, 5)])
+        assert p.intersects(line) and line.intersects(p)
+        assert not G.Point(5, 6).intersects(line)
+
+    def test_circle_approximation(self):
+        c = G.circle((0.0, 0.0), 111_000)  # ~1 degree radius
+        assert c.contains_point((0.0, 0.9))
+        assert not c.contains_point((0.0, 1.2))
+
+
+@pytest.fixture()
+def places():
+    idx = IndexService(
+        "places", Settings({"index.number_of_shards": 1}),
+        mapping={"properties": {"area": {"type": "geo_shape"},
+                                "name": {"type": "keyword"}}},
+    )
+    idx.index_doc("sq_small", {"name": "small", "area": {
+        "type": "polygon",
+        "coordinates": [[[1, 1], [2, 1], [2, 2], [1, 2], [1, 1]]]}})
+    idx.index_doc("sq_big", {"name": "big", "area": {
+        "type": "polygon",
+        "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]]}})
+    idx.index_doc("far_pt", {"name": "far", "area": {
+        "type": "point", "coordinates": [50, 50]}})
+    idx.index_doc("line", {"name": "line", "area": "LINESTRING (0 5, 20 5)"})
+    idx.refresh()
+    yield idx
+    idx.close()
+
+
+def hit_ids(r):
+    return {h["_id"] for h in r["hits"]["hits"]}
+
+
+class TestGeoShapeQuery:
+    QUERY_SQUARE = {"type": "envelope", "coordinates": [[0.5, 3.5], [3.5, 0.5]]}
+
+    def test_intersects_default(self, places):
+        r = places.search({"query": {"geo_shape": {"area": {
+            "shape": self.QUERY_SQUARE}}}})
+        assert hit_ids(r) == {"sq_small", "sq_big"}
+
+    def test_within(self, places):
+        r = places.search({"query": {"geo_shape": {"area": {
+            "shape": {"type": "envelope", "coordinates": [[0, 10], [10, 0]]},
+            "relation": "within"}}}})
+        assert hit_ids(r) == {"sq_small", "sq_big"}
+        r = places.search({"query": {"geo_shape": {"area": {
+            "shape": self.QUERY_SQUARE, "relation": "within"}}}})
+        assert hit_ids(r) == {"sq_small"}
+
+    def test_contains(self, places):
+        r = places.search({"query": {"geo_shape": {"area": {
+            "shape": {"type": "point", "coordinates": [1.5, 1.5]},
+            "relation": "contains"}}}})
+        assert hit_ids(r) == {"sq_small", "sq_big"}
+
+    def test_disjoint(self, places):
+        r = places.search({"query": {"geo_shape": {"area": {
+            "shape": self.QUERY_SQUARE, "relation": "disjoint"}}}})
+        assert hit_ids(r) == {"far_pt", "line"}
+
+    def test_wkt_query_shape(self, places):
+        r = places.search({"query": {"geo_shape": {"area": {
+            "shape": "POLYGON ((45 45, 55 45, 55 55, 45 55, 45 45))"}}}})
+        assert hit_ids(r) == {"far_pt"}
+
+    def test_unmapped_field(self, places):
+        with pytest.raises(QueryShardException):
+            places.search({"query": {"geo_shape": {"nope": {
+                "shape": self.QUERY_SQUARE}}}})
+        r = places.search({"query": {"geo_shape": {
+            "nope": {"shape": self.QUERY_SQUARE},
+            "ignore_unmapped": True}}})
+        assert r["hits"]["total"] == 0
+
+    def test_within_multivalue_combined_bbox(self, places):
+        # doc with one shape inside + one far away must still match WITHIN
+        places.index_doc("multi", {"area": [
+            {"type": "point", "coordinates": [1.5, 1.5]},
+            {"type": "point", "coordinates": [80, 80]},
+        ]})
+        places.refresh()
+        r = places.search({"query": {"geo_shape": {"area": {
+            "shape": self.QUERY_SQUARE, "relation": "within"}}}})
+        assert "multi" in hit_ids(r)
+
+    def test_query_without_shape_rejected(self, places):
+        from elasticsearch_tpu.common.errors import ParsingException
+
+        with pytest.raises(ParsingException):
+            places.search({"query": {"geo_shape": {"area": {
+                "relation": "within"}}}})
+
+    def test_bad_shape_value_rejected_at_index_time(self, places):
+        with pytest.raises(MapperParsingException):
+            places.index_doc("bad", {"area": {"type": "polygon",
+                                              "coordinates": [[[0, 0]]]}})
+
+    def test_bool_filter_combination(self, places):
+        r = places.search({"query": {"bool": {
+            "must": [{"match_all": {}}],
+            "filter": [{"geo_shape": {"area": {"shape": self.QUERY_SQUARE}}},
+                       {"term": {"name": "big"}}]}}})
+        assert hit_ids(r) == {"sq_big"}
+
+
+class TestIndexedShape:
+    def test_indexed_shape_rewrite(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("shapes", {"mappings": {"properties": {
+            "footprint": {"type": "geo_shape"}}}})
+        node.create_index("places", {"mappings": {"properties": {
+            "area": {"type": "geo_shape"}}}})
+        node.index_doc("shapes", "zone", {"footprint": {
+            "type": "envelope", "coordinates": [[0, 10], [10, 0]]}})
+        node.index_doc("places", "inside", {"area": {
+            "type": "point", "coordinates": [5, 5]}})
+        node.index_doc("places", "outside", {"area": {
+            "type": "point", "coordinates": [50, 50]}})
+        for svc in node.indices.values():
+            svc.refresh()
+        r = node.search("places", {"query": {"geo_shape": {"area": {
+            "indexed_shape": {"index": "shapes", "id": "zone",
+                              "path": "footprint"},
+            "relation": "within"}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"inside"}
+        node.close()
+
+    def test_missing_indexed_shape_errors(self):
+        from elasticsearch_tpu.common.errors import ResourceNotFoundException
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("places", {"mappings": {"properties": {
+            "area": {"type": "geo_shape"}}}})
+        node.index_doc("places", "x", {"area": {"type": "point",
+                                                "coordinates": [1, 1]}})
+        node.indices["places"].refresh()
+        with pytest.raises(ResourceNotFoundException):
+            node.search("places", {"query": {"geo_shape": {"area": {
+                "indexed_shape": {"index": "places", "id": "nope"}}}}})
+        node.close()
+
+
+class TestPersistence:
+    def test_shapes_survive_flush_and_reload(self, tmp_data_dir):
+        import os
+
+        path = os.path.join(tmp_data_dir, "geo")
+        idx = IndexService("geo", Settings({"index.number_of_shards": 1}),
+                           mapping={"properties": {
+                               "area": {"type": "geo_shape"}}},
+                           data_path=path)
+        idx.index_doc("a", {"area": {"type": "point", "coordinates": [5, 5]}})
+        idx.refresh()
+        idx.flush()
+        idx.close()
+        idx2 = IndexService("geo", Settings({"index.number_of_shards": 1}),
+                            mapping={"properties": {
+                                "area": {"type": "geo_shape"}}},
+                            data_path=path)
+        r = idx2.search({"query": {"geo_shape": {"area": {
+            "shape": {"type": "envelope", "coordinates": [[0, 10], [10, 0]]}}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"a"}
+        idx2.close()
