@@ -260,6 +260,13 @@ CHAR_FILTERS: Dict[str, Callable] = {
     "html_strip": html_strip_char_filter,
 }
 
+# AnalysisPlugin extension points (filled by PluginsService): merged into
+# every new AnalysisRegistry ahead of settings-defined custom components
+EXTRA_ANALYZERS: Dict[str, "Analyzer"] = {}
+EXTRA_TOKENIZERS: Dict[str, Callable] = {}
+EXTRA_TOKEN_FILTERS: Dict[str, Callable] = {}
+EXTRA_CHAR_FILTERS: Dict[str, Callable] = {}
+
 # ---------------------------------------------------------------------------
 # Analyzer = char_filters + tokenizer + filters
 # ---------------------------------------------------------------------------
@@ -338,6 +345,11 @@ class AnalysisRegistry:
         self._tokenizers = dict(TOKENIZERS)
         self._filters = dict(TOKEN_FILTERS)
         self._char_filters = dict(CHAR_FILTERS)
+        # AnalysisPlugin extension points (plugins/__init__.py)
+        self.analyzers.update(EXTRA_ANALYZERS)
+        self._tokenizers.update(EXTRA_TOKENIZERS)
+        self._filters.update(EXTRA_TOKEN_FILTERS)
+        self._char_filters.update(EXTRA_CHAR_FILTERS)
         self._build_custom()
 
     def _component_names(self, kind: str) -> List[str]:

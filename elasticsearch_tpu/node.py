@@ -48,7 +48,8 @@ _INVALID_INDEX_CHARS = set(' "*\\<>|,/?#')
 
 class Node:
     def __init__(self, settings: Settings = Settings.EMPTY,
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None,
+                 plugins: Optional[list] = None):
         self.settings = settings
         self.node_id = _uuid.uuid4().hex[:20]
         self.node_name = NODE_NAME.get(settings)
@@ -80,6 +81,10 @@ class Node:
 
         register_node(self)
         self.remote_clusters = RemoteClusterService(self, settings)
+        from elasticsearch_tpu.plugins import PluginsService
+
+        self.plugins_service = PluginsService(self, settings, plugins)
+        self.plugins_service.on_node_start()
         if self.persistent_path:
             self._recover_indices_from_disk()
 
@@ -733,6 +738,7 @@ class Node:
                     "version": __version__,
                     "roles": ["master", "data", "ingest"],
                     "settings": self.settings.as_nested_dict(),
+                    "plugins": self.plugins_service.info(),
                 }
             },
         }
@@ -1031,6 +1037,7 @@ class Node:
         from elasticsearch_tpu.transport.remote_cluster import unregister_node
 
         unregister_node(self)
+        self.plugins_service.close()
         for name in list(self.indices):
             if self.persistent_path:
                 self._persist_index_meta(name)

@@ -1,0 +1,181 @@
+"""Plugin SPI + plugin discovery/installation.
+
+Role model: the reference's extension system (core/.../plugins/) —
+``Plugin`` base class plus per-area SPIs (``SearchPlugin``,
+``AnalysisPlugin``, ``MapperPlugin``, ``IngestPlugin``, ``ScriptPlugin``,
+``ActionPlugin``, ``RepositoryPlugin``…) discovered by ``PluginsService``
+(plugins/PluginsService.java:68) and wired into every layer through the
+``Node`` constructor (node/Node.java:246-455).
+
+Here a plugin is a Python class subclassing :class:`Plugin`; the hook
+methods mirror the reference SPIs. ``PluginsService`` loads plugin classes
+passed to ``Node(plugins=[...])`` or named in the ``node.plugins`` setting
+as ``"module.path:ClassName"`` strings (the classpath-discovery analog)
+and installs their registrations into the framework's registries.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+
+class Plugin:
+    """Base plugin. Subclass and override the hooks you need; every hook
+    matches a reference SPI (named in the docstring)."""
+
+    name: str = "unnamed"
+    description: str = ""
+    version: str = "1.0.0"
+
+    # -- SearchPlugin ---------------------------------------------------
+    def get_queries(self) -> Dict[str, Callable]:
+        """{query_name: parser(qbody) -> QueryBuilder}
+        (SearchPlugin.getQueries)."""
+        return {}
+
+    def get_aggregations(self) -> Dict[str, Callable]:
+        """{agg_type: run(spec, views) -> result dict}
+        (SearchPlugin.getAggregations). ``spec`` is AggSpec, ``views`` the
+        matched SegmentViews; the function owns compute AND reduce."""
+        return {}
+
+    # -- MapperPlugin ---------------------------------------------------
+    def get_field_types(self) -> List[type]:
+        """FieldType subclasses (MapperPlugin.getMappers)."""
+        return []
+
+    # -- AnalysisPlugin -------------------------------------------------
+    def get_analyzers(self) -> Dict[str, object]:
+        """{name: Analyzer} (AnalysisPlugin.getAnalyzers)."""
+        return {}
+
+    def get_tokenizers(self) -> Dict[str, Callable]:
+        return {}
+
+    def get_token_filters(self) -> Dict[str, Callable]:
+        return {}
+
+    def get_char_filters(self) -> Dict[str, Callable]:
+        return {}
+
+    # -- IngestPlugin ---------------------------------------------------
+    def get_processors(self) -> Dict[str, Callable]:
+        """{type: fn(config, doc) -> None} (IngestPlugin.getProcessors)."""
+        return {}
+
+    # -- ScriptPlugin ---------------------------------------------------
+    def get_script_engines(self) -> Dict[str, Callable]:
+        """{lang: compile(source) -> CompiledScript-like}
+        (ScriptPlugin.getScriptEngine)."""
+        return {}
+
+    # -- ActionPlugin ---------------------------------------------------
+    def get_rest_handlers(self) -> List[Tuple[str, str, Callable]]:
+        """[(method, path_pattern, handler(node, req) -> (status, body))]
+        (ActionPlugin.getRestHandlers)."""
+        return []
+
+    # -- RepositoryPlugin -----------------------------------------------
+    def get_repositories(self) -> Dict[str, Callable]:
+        """{type: factory(name, settings_dict, node) -> repository}
+        (RepositoryPlugin.getRepositories)."""
+        return {}
+
+    # -- lifecycle ------------------------------------------------------
+    def on_node_start(self, node) -> None:
+        """Called after the node wires its services (createComponents)."""
+
+
+def _load_plugin_class(spec: str) -> type:
+    module_name, _, cls_name = spec.partition(":")
+    if not cls_name:
+        raise IllegalArgumentException(
+            f"plugin [{spec}] must be 'module.path:ClassName'")
+    try:
+        module = importlib.import_module(module_name)
+        cls = getattr(module, cls_name)
+    except (ImportError, AttributeError) as e:
+        raise IllegalArgumentException(
+            f"Could not load plugin descriptor [{spec}]: {e}") from e
+    return cls
+
+
+class PluginsService:
+    """Loads + installs plugins into the framework registries
+    (PluginsService.java:68; registration mirrors Node ctor wiring)."""
+
+    def __init__(self, node, settings=None, plugins: Optional[list] = None):
+        self._node = node
+        self.plugins: List[Plugin] = []
+        for p in plugins or []:
+            self.plugins.append(p() if isinstance(p, type) else p)
+        for spec in (settings.get_list("node.plugins") if settings else None) or []:
+            self.plugins.append(_load_plugin_class(str(spec))())
+        self._installed: List[Tuple] = []  # (registry_dict, key) for removal
+        self.rest_handlers: List[Tuple[str, str, Callable]] = []
+        try:
+            for p in self.plugins:
+                self._install(p)
+        except Exception:
+            # roll back partial registrations: module-global registries
+            # must not leak a failed node's extensions
+            self.close()
+            raise
+
+    def _put(self, registry: dict, key: str, value, what: str) -> None:
+        if key in registry:
+            raise IllegalArgumentException(
+                f"{what} [{key}] already registered, cannot register plugin twice")
+        registry[key] = value
+        self._installed.append((registry, key))
+
+    def _install(self, p: Plugin) -> None:
+        from elasticsearch_tpu.analysis import analyzers as A
+        from elasticsearch_tpu.ingest.pipeline import PROCESSORS
+        from elasticsearch_tpu.mapper.field_types import FIELD_TYPES
+        from elasticsearch_tpu.script.expression import CUSTOM_SCRIPT_ENGINES
+        from elasticsearch_tpu.search.aggregations import CUSTOM_AGGS
+        from elasticsearch_tpu.search.query_dsl import CUSTOM_QUERY_PARSERS
+
+        for qname, parser in p.get_queries().items():
+            self._put(CUSTOM_QUERY_PARSERS, qname, parser, "query")
+        for aname, fn in p.get_aggregations().items():
+            self._put(CUSTOM_AGGS, aname, fn, "aggregation")
+        for ft_cls in p.get_field_types():
+            self._put(FIELD_TYPES, ft_cls.type_name, ft_cls, "mapper type")
+        for name, a in p.get_analyzers().items():
+            self._put(A.EXTRA_ANALYZERS, name, a, "analyzer")
+        for name, t in p.get_tokenizers().items():
+            self._put(A.EXTRA_TOKENIZERS, name, t, "tokenizer")
+        for name, f in p.get_token_filters().items():
+            self._put(A.EXTRA_TOKEN_FILTERS, name, f, "token_filter")
+        for name, c in p.get_char_filters().items():
+            self._put(A.EXTRA_CHAR_FILTERS, name, c, "char_filter")
+        for ptype, fn in p.get_processors().items():
+            self._put(PROCESSORS, ptype, fn, "processor")
+        for lang, engine in p.get_script_engines().items():
+            self._put(CUSTOM_SCRIPT_ENGINES, lang, engine, "script engine")
+        for rtype, factory in p.get_repositories().items():
+            self._put(self._node.snapshots.repository_types, rtype, factory,
+                      "repository type")
+        self.rest_handlers.extend(p.get_rest_handlers())
+
+    def on_node_start(self) -> None:
+        for p in self.plugins:
+            p.on_node_start(self._node)
+
+    def close(self) -> None:
+        """Uninstall registrations (JVM unload analog; keeps module-global
+        registries clean across in-process nodes, e.g. tests)."""
+        for registry, key in self._installed:
+            registry.pop(key, None)
+        self._installed = []
+
+    def info(self) -> List[dict]:
+        return [{"name": p.name, "version": p.version,
+                 "description": p.description,
+                 "classname": type(p).__module__ + ":" + type(p).__name__}
+                for p in self.plugins]

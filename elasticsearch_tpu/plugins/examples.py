@@ -1,0 +1,116 @@
+"""Example plugin exercising every SPI hook.
+
+Role model: the reference's example plugins (plugins/jvm-example,
+plugins/examples/*) — small, self-contained demonstrations of each
+extension point, doubling as SPI conformance fixtures for tests.
+"""
+
+from __future__ import annotations
+
+from elasticsearch_tpu.plugins import Plugin
+
+
+class ExamplePlugin(Plugin):
+    """Registers one extension per SPI:
+
+    - query ``term_prefix``: constant-score prefix match (a thin parser
+      over the built-in prefix builder)
+    - agg ``doc_count_times``: doc count scaled by a factor
+    - field type ``reversed_keyword``: keyword stored reversed
+    - analyzer component ``reverse`` token filter
+    - ingest processor ``add_tag``
+    - script engine ``upper`` (uppercases a source field)
+    - REST handler ``GET /_example/ping``
+    - repository type ``memory``
+    """
+
+    name = "example-plugin"
+    description = "exercises every plugin SPI"
+    version = "1.0.0"
+
+    def get_queries(self):
+        def parse_term_prefix(qbody):
+            from elasticsearch_tpu.search.query_dsl import PrefixQueryBuilder
+
+            ((field, value),) = qbody.items()
+            if isinstance(value, dict):
+                return PrefixQueryBuilder(field, value["value"],
+                                          boost=float(value.get("boost", 1.0)))
+            return PrefixQueryBuilder(field, value)
+
+        return {"term_prefix": parse_term_prefix}
+
+    def get_aggregations(self):
+        def run_doc_count_times(spec, views):
+            factor = float(spec.body.get("factor", 1.0))
+            import numpy as np
+
+            total = sum(int(np.asarray(v.mask).sum()) for v in views)
+            return {"value": total * factor}
+
+        return {"doc_count_times": run_doc_count_times}
+
+    def get_field_types(self):
+        from elasticsearch_tpu.mapper.field_types import KeywordFieldType
+
+        class ReversedKeywordFieldType(KeywordFieldType):
+            type_name = "reversed_keyword"
+
+            def index_terms(self, value, analyzers):
+                return [t[::-1] for t in
+                        super().index_terms(value, analyzers)]
+
+            def doc_value(self, value):
+                return str(value)[::-1]
+
+            def term_for_query(self, value, analyzers):
+                return str(value)[::-1]
+
+        return [ReversedKeywordFieldType]
+
+    def get_token_filters(self):
+        # token filters transform (text, start, end) tuples
+        return {"reverse_example":
+                lambda tokens: [(t[::-1], s, e) for t, s, e in tokens]}
+
+    def get_processors(self):
+        def add_tag(config, doc):
+            tags = doc.source.setdefault(config.get("field", "tags"), [])
+            tags.append(config.get("tag", "example"))
+
+        return {"add_tag": add_tag}
+
+    def get_script_engines(self):
+        class TwiceScript:
+            """Compiled-script contract: ``doc_fields`` lists the doc-value
+            columns to bind; ``execute(doc_values, params, score)``."""
+
+            def __init__(self, source):
+                self.source = source
+                self.doc_fields = [source]
+
+            def execute(self, doc_values, params=None, score=0.0):
+                return doc_values.get(self.source, 0.0) * 2
+
+        return {"twice": TwiceScript}
+
+    def get_rest_handlers(self):
+        def ping(node, req):
+            return 200, {"pong": True, "node": node.node_name}
+
+        return [("GET", "/_example/ping", ping)]
+
+    def get_repositories(self):
+        class MemoryRepository:
+            """In-process blob map (test double for cloud repositories)."""
+
+            def __init__(self, name, settings, node):
+                self.name = name
+                self.settings = settings
+                self.blobs = {}
+
+        return {"memory": lambda name, settings, node:
+                MemoryRepository(name, settings, node)}
+
+    def on_node_start(self, node):
+        self.started_on = node.node_name

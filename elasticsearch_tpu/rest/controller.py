@@ -101,6 +101,11 @@ class RestController:
         from elasticsearch_tpu.rest import handlers
 
         handlers.register_all(self)
+        # ActionPlugin.getRestHandlers: plugin-provided endpoints
+        svc = getattr(node, "plugins_service", None)
+        if svc is not None:
+            for method, pattern, handler in svc.rest_handlers:
+                self.register(method, pattern, handler)
 
     def register(self, method: str, pattern: str, handler: Handler) -> None:
         self.routes.append(Route(method, pattern, handler))

@@ -211,7 +211,11 @@ def register_all(c) -> None:
     r("GET", "/_cat/templates", _cat_templates)
     r("GET", "/_cat/master", _cat_master)
     r("GET", "/_cat/segments", _cat_segments)
-    r("GET", "/_cat/plugins", lambda n, q: _cat_table(q, [], ["name", "component", "version"]))
+    r("GET", "/_cat/plugins", lambda n, q: _cat_table(
+        q,
+        [[n.node_name, p["name"], p["version"]]
+         for p in n.plugins_service.info()],
+        ["name", "component", "version"]))
     r("GET", "/_cat/tasks", _cat_tasks)
     r("GET", "/_cat/pending_tasks", lambda n, q: _cat_table(
         q, [], ["insertOrder", "timeInQueue", "priority", "source"]))

@@ -124,14 +124,26 @@ class CompiledScript:
             ) from e
 
 
+# ScriptPlugin extension point: {lang: compile(source) -> CompiledScript-like}
+CUSTOM_SCRIPT_ENGINES: dict = {}
+
+
 def compile_script(script_spec) -> CompiledScript:
     """Accepts the reference's script spec shapes: a string, or
-    {"source"|"inline": ..., "params": {...}} (params bound at execute)."""
+    {"source"|"inline": ..., "lang": ..., "params": {...}} (params bound
+    at execute). Non-default langs dispatch to plugin script engines
+    (ScriptService.compile — script/ScriptService.java:223)."""
     if isinstance(script_spec, str):
         return CompiledScript(script_spec)
     src = script_spec.get("source") or script_spec.get("inline")
     if src is None:
         raise ParsingException("script requires [source]")
+    lang = script_spec.get("lang")
+    if lang is not None and lang not in ("painless", "expression"):
+        engine = CUSTOM_SCRIPT_ENGINES.get(lang)
+        if engine is None:
+            raise ParsingException(f"script_lang not supported [{lang}]")
+        return engine(src)
     return CompiledScript(src)
 
 

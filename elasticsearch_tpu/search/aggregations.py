@@ -43,6 +43,10 @@ PIPELINE_TYPES = {"derivative", "cumulative_sum", "moving_avg", "avg_bucket",
                   "sum_bucket", "min_bucket", "max_bucket", "stats_bucket",
                   "bucket_script", "bucket_selector", "bucket_sort", "serial_diff"}
 
+# SearchPlugin.getAggregations extension point:
+# {agg_type: run(spec, views) -> result dict} — owns compute AND reduce
+CUSTOM_AGGS: Dict[str, object] = {}
+
 
 class AggSpec:
     def __init__(self, name: str, agg_type: str, body: dict, subs: List["AggSpec"]):
@@ -64,7 +68,8 @@ def parse_aggs(aggs_body: Optional[dict]) -> List[AggSpec]:
                 f"Expected exactly one aggregation type for [{name}], found {types}"
             )
         t = types[0]
-        if t not in BUCKET_TYPES | METRIC_TYPES | PIPELINE_TYPES:
+        if t not in BUCKET_TYPES | METRIC_TYPES | PIPELINE_TYPES \
+                and t not in CUSTOM_AGGS:
             raise ParsingException(f"Unknown aggregation type [{t}] for [{name}]")
         specs.append(AggSpec(name, t, spec[t], parse_aggs(sub_body)))
     return specs
@@ -687,6 +692,9 @@ def _apply_embedded_pipeline(spec: AggSpec, result: dict) -> None:
 
 
 def _run_one_inner(spec: AggSpec, views: List[SegmentView]) -> dict:
+    custom = CUSTOM_AGGS.get(spec.type)
+    if custom is not None:
+        return custom(spec, views)
     if spec.type in METRIC_TYPES:
         partials = [compute_partial(spec, v) for v in views]
         return _finalize_metric(spec, partials)

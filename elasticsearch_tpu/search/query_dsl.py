@@ -39,6 +39,9 @@ from elasticsearch_tpu.search import plan as P
 # default max_expansions for multi-term queries (MultiTermQuery rewrites)
 MAX_EXPANSIONS = 1024
 
+# SearchPlugin.getQueries extension point: {query_name: parser(qbody)}
+CUSTOM_QUERY_PARSERS: Dict[str, "object"] = {}
+
 # single source of the default BM25 constants for ctx-less callers
 from elasticsearch_tpu.index.similarity import BM25Similarity  # noqa: E402
 from elasticsearch_tpu.ops.scoring import B as _BM25_B, K1 as _BM25_K1  # noqa: E402
@@ -1680,16 +1683,11 @@ def collect_inner_hits(qb: Optional[QueryBuilder]) -> List[QueryBuilder]:
 
 
 def parse_distance(d) -> float:
-    """'10km', '500m', number (meters) -> meters."""
-    if isinstance(d, (int, float)):
-        return float(d)
-    s = str(d).strip().lower()
-    units = {"km": 1000.0, "m": 1.0, "mi": 1609.344, "yd": 0.9144, "ft": 0.3048,
-             "nmi": 1852.0, "cm": 0.01, "mm": 0.001, "in": 0.0254}
-    for u in sorted(units, key=len, reverse=True):
-        if s.endswith(u):
-            return float(s[: -len(u)]) * units[u]
-    return float(s)
+    """'10km', '500m', number (meters) -> meters. One unit table for
+    geo_distance queries/sorts and geo_shape circle radii."""
+    from elasticsearch_tpu.utils.geometry import _parse_radius
+
+    return _parse_radius(d)
 
 
 def parse_min_should_match(spec, n_clauses: int) -> int:
@@ -1939,4 +1937,7 @@ def parse_query(body) -> QueryBuilder:
 
     if qtype in SPAN_TYPES:
         return parse_span_query(body)
+    custom = CUSTOM_QUERY_PARSERS.get(qtype)
+    if custom is not None:
+        return custom(qbody)
     raise ParsingException(f"no [query] registered for [{qtype}]")

@@ -68,17 +68,25 @@ class SnapshotsService:
     def __init__(self, node):
         self.node = node
         self.repositories: Dict[str, FsRepository] = {}
+        # RepositoryPlugin extension point: {type: factory(name, settings,
+        # node)} — fs is built-in, cloud types arrive via plugins
+        self.repository_types: Dict[str, object] = {}
 
     # --- repositories ---
 
     def put_repository(self, name: str, body: dict) -> dict:
         rtype = body.get("type")
-        if rtype != "fs":
+        if rtype == "fs":
+            repo = FsRepository(name, body.get("settings") or {})
+        elif rtype in self.repository_types:
+            repo = self.repository_types[rtype](
+                name, body.get("settings") or {}, self.node)
+        else:
             raise IllegalArgumentException(
-                f"repository type [{rtype}] does not exist (supported: fs; "
+                f"repository type [{rtype}] does not exist (supported: fs"
+                f"{''.join(', ' + t for t in sorted(self.repository_types))}; "
                 "url/s3/azure/gcs arrive with their cloud plugins)"
             )
-        repo = FsRepository(name, body.get("settings") or {})
         self.repositories[name] = repo
 
         def update(state):

@@ -404,7 +404,10 @@ def parse_geojson(obj: dict) -> Shape:
         if t == "envelope":
             return envelope(coords[0], coords[1])
         if t == "circle":
-            return circle(coords, _parse_radius(obj.get("radius", "0m")))
+            if "radius" not in obj:
+                raise MapperParsingException(
+                    "circle geo_shape requires a [radius]")
+            return circle(coords, _parse_radius(obj["radius"]))
         if t == "geometrycollection":
             return GeometryCollection(
                 [parse_geojson(g) for g in obj.get("geometries", [])])

@@ -1,0 +1,158 @@
+"""Plugin SPI tests.
+
+Mirrors the reference's extension system (core/.../plugins/): Plugin base
++ per-area SPIs discovered by PluginsService and wired through the Node.
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugins import Plugin, PluginsService
+from elasticsearch_tpu.plugins.examples import ExamplePlugin
+
+
+@pytest.fixture()
+def node():
+    n = Node(plugins=[ExamplePlugin])
+    yield n
+    n.close()
+
+
+class TestPluginsService:
+    def test_loads_from_settings_classpath(self):
+        n = Node(Settings({"node.plugins":
+                           ["elasticsearch_tpu.plugins.examples:ExamplePlugin"]}))
+        assert [p["name"] for p in n.plugins_service.info()] == ["example-plugin"]
+        n.close()
+
+    def test_bad_classpath_rejected(self):
+        with pytest.raises(IllegalArgumentException):
+            Node(Settings({"node.plugins": ["no.such.module:Nope"]}))
+
+    def test_duplicate_registration_rejected(self):
+        n = Node(plugins=[ExamplePlugin])
+        try:
+            with pytest.raises(IllegalArgumentException, match="already registered"):
+                PluginsService(n, None, [ExamplePlugin])
+        finally:
+            n.close()
+
+    def test_failed_install_rolls_back(self):
+        from elasticsearch_tpu.search.query_dsl import CUSTOM_QUERY_PARSERS
+
+        class Broken(Plugin):
+            name = "broken"
+
+            def get_queries(self):
+                return {"term_prefix": lambda b: None}
+
+            def get_processors(self):
+                raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            Node(plugins=[Broken])
+        # the partial query registration must not leak
+        assert "term_prefix" not in CUSTOM_QUERY_PARSERS
+
+    def test_close_uninstalls(self):
+        from elasticsearch_tpu.search.query_dsl import CUSTOM_QUERY_PARSERS
+
+        n = Node(plugins=[ExamplePlugin])
+        assert "term_prefix" in CUSTOM_QUERY_PARSERS
+        n.close()
+        assert "term_prefix" not in CUSTOM_QUERY_PARSERS
+
+    def test_on_node_start_called(self, node):
+        assert node.plugins_service.plugins[0].started_on == node.node_name
+
+
+class TestSPIHooks:
+    def test_custom_query(self, node):
+        node.create_index("idx")
+        node.index_doc("idx", "1", {"name": "elastic"})
+        node.index_doc("idx", "2", {"name": "plastic"})
+        node.indices["idx"].refresh()
+        r = node.search("idx", {"query": {"term_prefix": {"name": "ela"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_custom_aggregation(self, node):
+        node.create_index("idx")
+        for i in range(4):
+            node.index_doc("idx", str(i), {"v": i})
+        node.indices["idx"].refresh()
+        r = node.search("idx", {"size": 0, "aggs": {
+            "scaled": {"doc_count_times": {"factor": 2.5}}}})
+        assert r["aggregations"]["scaled"]["value"] == 10.0
+
+    def test_custom_field_type(self, node):
+        node.create_index("idx", {"mappings": {"properties": {
+            "code": {"type": "reversed_keyword"}}}})
+        node.index_doc("idx", "1", {"code": "abc"})
+        node.indices["idx"].refresh()
+        r = node.search("idx", {"query": {"term": {"code": "abc"}}})
+        assert r["hits"]["total"] == 1
+        r = node.search("idx", {"size": 0, "aggs": {
+            "codes": {"terms": {"field": "code"}}}})
+        assert r["aggregations"]["codes"]["buckets"][0]["key"] == "cba"
+
+    def test_custom_token_filter_in_custom_analyzer(self, node):
+        node.create_index("idx", {
+            "settings": {"index.analysis.analyzer.rev.type": "custom",
+                         "index.analysis.analyzer.rev.tokenizer": "standard",
+                         "index.analysis.analyzer.rev.filter": ["reverse_example"]},
+            "mappings": {"properties": {
+                "t": {"type": "text", "analyzer": "rev"}}}})
+        node.index_doc("idx", "1", {"t": "hello"})
+        node.indices["idx"].refresh()
+        r = node.search("idx", {"query": {"term": {"t": "olleh"}}})
+        assert r["hits"]["total"] == 1
+
+    def test_custom_ingest_processor(self, node):
+        node.ingest.put_pipeline("tagger", {
+            "processors": [{"add_tag": {"tag": "seen"}}]})
+        node.index_doc("idx2", "1", {"msg": "x"}, pipeline="tagger")
+        g = node.get_doc("idx2", "1")
+        assert g["_source"]["tags"] == ["seen"]
+
+    def test_custom_script_engine(self, node):
+        node.create_index("idx")
+        node.index_doc("idx", "1", {"n": 21})
+        node.indices["idx"].refresh()
+        r = node.search("idx", {"query": {"match_all": {}}, "script_fields": {
+            "doubled": {"script": {"lang": "twice", "source": "n"}}}})
+        assert r["hits"]["hits"][0]["fields"]["doubled"] == [42.0]
+
+    def test_custom_rest_handler(self, node):
+        from elasticsearch_tpu.rest.controller import RestController
+
+        controller = RestController(node)
+        status, body = controller.dispatch("GET", "/_example/ping", {}, None)
+        assert status == 200 and body["pong"] is True
+
+    def test_custom_repository_type(self, node):
+        r = node.snapshots.put_repository("mem", {"type": "memory",
+                                                  "settings": {}})
+        assert r["acknowledged"] is True
+        assert node.snapshots.repositories["mem"].blobs == {}
+        with pytest.raises(IllegalArgumentException):
+            node.snapshots.put_repository("bad", {"type": "nope"})
+
+    def test_cat_plugins_and_node_info(self, node):
+        info = node.node_info()
+        plugins = info["nodes"][node.node_id]["plugins"]
+        assert plugins[0]["name"] == "example-plugin"
+
+
+class TestPluginIsolation:
+    def test_unknown_query_still_rejected_without_plugin(self):
+        from elasticsearch_tpu.common.errors import ParsingException
+
+        n = Node()
+        n.create_index("idx")
+        n.index_doc("idx", "1", {"a": 1})
+        n.indices["idx"].refresh()
+        with pytest.raises(ParsingException):
+            n.search("idx", {"query": {"term_prefix": {"a": "x"}}})
+        n.close()
