@@ -62,6 +62,7 @@ ACTION_GET = "indices:data/read/get[s]"
 ACTION_QUERY = "indices:data/read/search[phase/query+fetch]"
 ACTION_REFRESH = "indices:admin/refresh[s]"
 ACTION_RECOVER = "internal:index/shard/recovery/start_recovery"
+ACTION_RECOVERY_FINALIZE = "internal:index/shard/recovery/finalize"
 
 
 class ClusterNode:
@@ -102,6 +103,7 @@ class ClusterNode:
         t.register_handler(ACTION_QUERY, self._on_query)
         t.register_handler(ACTION_REFRESH, self._on_refresh)
         t.register_handler(ACTION_RECOVER, self._on_start_recovery)
+        t.register_handler(ACTION_RECOVERY_FINALIZE, self._on_recovery_finalize)
 
     @property
     def is_master(self) -> bool:
@@ -285,6 +287,10 @@ class ClusterNode:
                 shard = IndexShard(index, sid, self._mapper_for(index),
                                    primary=copy.primary)
                 shard.start_fresh()
+                if copy.primary:
+                    from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
+
+                    shard.checkpoints = GlobalCheckpointTracker(self.node_id)
                 self.shards[(index, sid)] = shard
                 if copy.state == ShardRoutingState.INITIALIZING:
                     if copy.primary:
@@ -294,11 +300,32 @@ class ClusterNode:
                         self._recover_replica(index, sid)
             else:
                 if copy.primary and not shard.primary:
-                    # replica promoted: bump primary term (fencing)
+                    # replica promoted: bump primary term (fencing) and
+                    # seed a tracker from the routing table's started
+                    # copies (reference: in-sync allocation ids from
+                    # IndexMetaData) — their checkpoints are unknown (-1)
+                    # until the next write ack, keeping the global
+                    # checkpoint conservative
                     shard.primary = True
                     shard.primary_term += 1
+                    from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
+
+                    tracker = GlobalCheckpointTracker(self.node_id)
+                    tracker.update_local_checkpoint(
+                        self.node_id, shard.engine.local_checkpoint)
+                    for other in self.routing.get(index, {}).get(sid, []):
+                        if (other.node_id != self.node_id
+                                and other.state == ShardRoutingState.STARTED):
+                            tracker.mark_in_sync(other.node_id, -1)
+                    shard.checkpoints = tracker
                 elif copy.state == ShardRoutingState.INITIALIZING and not copy.primary:
                     self._recover_replica(index, sid)
+            # prune tracker membership to the current routing copies: a
+            # departed replica must not pin the global checkpoint
+            tracker = getattr(shard, "checkpoints", None)
+            if tracker is not None:
+                tracker.prune({c.node_id
+                               for c in self.routing.get(index, {}).get(sid, [])})
 
     def _primary_node(self, index: str, sid: int) -> Optional[str]:
         for copy in self.routing.get(index, {}).get(sid, []):
@@ -329,6 +356,15 @@ class ClusterNode:
                 )
                 shard.engine.version_map[op["id"]].version = op["version"]
         shard.refresh()
+        # confirm the replay to the primary (recovery finalize) so it can
+        # mark this copy in-sync at a checkpoint we actually hold
+        try:
+            self.transport.send_request(primary_node, ACTION_RECOVERY_FINALIZE, {
+                "index": index, "shard": sid,
+                "local_checkpoint": shard.engine.local_checkpoint,
+            })
+        except (NodeNotConnectedException, ElasticsearchTpuException):
+            return  # primary unreachable: stay INITIALIZING, retry later
         self._report_started(index, sid)
 
     def _on_start_recovery(self, payload, src) -> dict:
@@ -352,7 +388,22 @@ class ClusterNode:
                         "seq_no": int(seg.seqnos[local]),
                         "version": int(seg.versions[local]),
                     })
+        # the target is tracked (not yet in-sync) until it confirms the
+        # replay via the finalize RPC (_on_recovery_finalize)
+        tracker = getattr(shard, "checkpoints", None)
+        if tracker is not None:
+            tracker.initiate_tracking(src)
         return {"ops": ops, "max_seq_no": shard.engine.max_seqno}
+
+    def _on_recovery_finalize(self, payload, src) -> dict:
+        """Primary side: the target applied the streamed ops — mark it
+        in-sync at its confirmed local checkpoint
+        (RecoverySourceHandler finalize -> markAllocationIdAsInSync)."""
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        tracker = getattr(shard, "checkpoints", None) if shard else None
+        if tracker is not None:
+            tracker.mark_in_sync(src, payload["local_checkpoint"])
+        return {"ok": True}
 
     def _report_started(self, index: str, sid: int) -> None:
         try:
@@ -404,32 +455,56 @@ class ClusterNode:
             raise ElasticsearchTpuException(
                 f"[{index}][{sid}] primary is not allocated on [{self.node_id}]"
             )
+        copies = self.routing.get(index, {}).get(sid, [])
+        wfas = payload.get("wait_for_active_shards")
+        if wfas is not None:
+            from elasticsearch_tpu.index.seqno import check_active_shards
+
+            active = sum(1 for c in copies
+                         if c.state == ShardRoutingState.STARTED)
+            check_active_shards(wfas, active, len(copies), f"[{index}][{sid}]")
         if payload["op"] == "index":
             result = shard.index_doc(payload["id"], payload["source"],
                                      payload.get("routing"))
         else:
             result = shard.delete_doc(payload["id"])
-        # fan out to replicas with the primary-assigned seqno + version
+        # track the primary's own checkpoint, then fan out to replicas with
+        # the primary-assigned seqno/version + the current global checkpoint
+        # (piggybacked like the reference's replication requests)
+        tracker = getattr(shard, "checkpoints", None)
+        if tracker is not None:
+            tracker.update_local_checkpoint(self.node_id,
+                                            shard.engine.local_checkpoint)
         replica_payload = dict(payload)
         replica_payload["seq_no"] = result["_seq_no"]
         replica_payload["version"] = result["_version"]
         replica_payload["primary_term"] = shard.primary_term
+        replica_payload["global_checkpoint"] = (
+            tracker.global_checkpoint if tracker is not None else -1)
         acks = 1
         for copy in self.routing.get(index, {}).get(sid, []):
             if copy.primary or copy.state != ShardRoutingState.STARTED:
                 continue
             try:
-                self.transport.send_request(copy.node_id, ACTION_WRITE_REPLICA,
-                                            replica_payload)
+                ack = self.transport.send_request(
+                    copy.node_id, ACTION_WRITE_REPLICA, replica_payload)
                 acks += 1
+                if tracker is not None:
+                    tracker.update_local_checkpoint(
+                        copy.node_id, ack.get("local_checkpoint", -1))
             except (NodeNotConnectedException, ElasticsearchTpuException):
-                # fail the copy on the master and continue (§5.3)
+                # fail the copy on the master and continue (§5.3); the
+                # in-sync set shrinks so the global checkpoint advances
+                if tracker is not None:
+                    tracker.remove(copy.node_id)
                 try:
                     self.transport.send_request(self.master_id, ACTION_SHARD_FAILED, {
                         "index": index, "shard": sid, "node": copy.node_id,
                     })
                 except NodeNotConnectedException:
                     pass
+        if tracker is not None:
+            shard.engine.global_checkpoint = tracker.global_checkpoint
         result["_shards"] = {"total": len(self.routing.get(index, {}).get(sid, [])),
                              "successful": acks, "failed": 0}
         return result
@@ -450,7 +525,12 @@ class ClusterNode:
             shard.engine.version_map[payload["id"]].version = payload["version"]
         else:
             shard.engine.delete(payload["id"], seqno=payload["seq_no"])
-        return {"ok": True}
+        # learn the primary's global checkpoint; report our local one back
+        shard.engine.global_checkpoint = max(
+            shard.engine.global_checkpoint,
+            payload.get("global_checkpoint", -1))
+        return {"ok": True,
+                "local_checkpoint": shard.engine.local_checkpoint}
 
     # ------------------------------------------------------------------
     # Read path
@@ -521,11 +601,13 @@ class ClusterClient:
         return sid, primary
 
     def index(self, index: str, doc_id: str, source: dict,
-              routing: Optional[str] = None) -> dict:
+              routing: Optional[str] = None,
+              wait_for_active_shards=None) -> dict:
         sid, primary = self._routing_entry(index, doc_id, routing)
         return self.node.transport.send_request(primary, ACTION_WRITE_PRIMARY, {
             "op": "index", "index": index, "shard": sid, "id": doc_id,
             "source": source, "routing": routing,
+            "wait_for_active_shards": wait_for_active_shards,
         })
 
     def delete(self, index: str, doc_id: str) -> dict:

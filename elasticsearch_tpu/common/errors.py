@@ -91,6 +91,12 @@ class ActionRequestValidationException(ElasticsearchTpuException):
     status_code = 400
 
 
+class UnavailableShardsException(ElasticsearchTpuException):
+    """wait_for_active_shards not met (action/UnavailableShardsException)."""
+
+    status_code = 503
+
+
 class ResourceNotFoundException(ElasticsearchTpuException):
     status_code = 404
 

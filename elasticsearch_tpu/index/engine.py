@@ -67,6 +67,10 @@ class Engine:
         self.version_map: Dict[str, VersionEntry] = {}
         self._seqno = -1  # last assigned
         self._local_checkpoint = -1
+        # global checkpoint: on replicas, learned from the primary
+        # (piggybacked on replication ops); on a primary the shard's
+        # GlobalCheckpointTracker is the source of truth
+        self.global_checkpoint = -1
         self._lock = threading.RLock()
         self.refresh_count = 0
         self.flush_count = 0

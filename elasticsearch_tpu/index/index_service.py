@@ -70,6 +70,34 @@ class IndexService:
             else:
                 shard.start_fresh()
             self.shards[sid] = shard
+        # periodic NRT refresh (index.refresh_interval, default 1s; -1
+        # disables — IndexService#getRefreshInterval + refresh scheduling)
+        iv = settings.get_time("index.refresh_interval")
+        self.refresh_interval = 1.0 if iv is None else iv
+        self._refresh_stop = None
+        if self.refresh_interval and self.refresh_interval > 0:
+            import threading
+
+            self._refresh_stop = threading.Event()
+
+            import logging
+
+            logger = logging.getLogger("elasticsearch_tpu.index.refresh")
+
+            def _refresh_loop():
+                while not self._refresh_stop.wait(self.refresh_interval):
+                    for s in list(self.shards.values()):
+                        try:
+                            s.refresh()
+                        except Exception:
+                            # a closing shard can race the timer; anything
+                            # else must be visible to the operator
+                            logger.warning(
+                                "[%s][%s] scheduled refresh failed",
+                                name, s.shard_id, exc_info=True)
+
+            threading.Thread(target=_refresh_loop, daemon=True,
+                             name=f"refresh[{name}]").start()
 
     # ------------------------------------------------------------------
     # Routing + document ops
@@ -294,6 +322,8 @@ class IndexService:
         self.mapper_service.merge(mapping)
 
     def close(self) -> None:
+        if self._refresh_stop is not None:
+            self._refresh_stop.set()
         for shard in self.shards.values():
             shard.close()
 

@@ -1,0 +1,95 @@
+"""Sequence-number machinery: global checkpoint tracking.
+
+Role model: ``GlobalCheckpointTracker`` (reference:
+core/src/main/java/org/elasticsearch/index/seqno/GlobalCheckpointTracker.java:51)
+— the primary tracks every in-sync copy's local checkpoint (highest seqno
+below which all ops are processed); the global checkpoint is the minimum
+over the in-sync set and fences ops-based recovery + translog trimming.
+Local checkpoints are contiguous by construction here (single-writer
+engine), matching ``LocalCheckpointTracker``'s invariant.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+UNASSIGNED_SEQ_NO = -2
+NO_OPS_PERFORMED = -1
+
+
+class GlobalCheckpointTracker:
+    """Primary-side tracker of per-copy local checkpoints."""
+
+    def __init__(self, primary_id: str):
+        self.primary_id = primary_id
+        # copy id (node/allocation id) -> last reported local checkpoint
+        self.local_checkpoints: Dict[str, int] = {primary_id: NO_OPS_PERFORMED}
+        self.in_sync: set = {primary_id}
+
+    def initiate_tracking(self, copy_id: str) -> None:
+        """A recovering copy is tracked but not yet in-sync (its
+        checkpoint cannot hold back the global checkpoint)."""
+        self.local_checkpoints.setdefault(copy_id, NO_OPS_PERFORMED)
+
+    def mark_in_sync(self, copy_id: str, local_checkpoint: int) -> None:
+        """Recovery finalize: the copy caught up to the primary
+        (RecoverySourceHandler finalize -> markAllocationIdAsInSync)."""
+        self.local_checkpoints[copy_id] = local_checkpoint
+        self.in_sync.add(copy_id)
+
+    def update_local_checkpoint(self, copy_id: str, checkpoint: int) -> None:
+        prev = self.local_checkpoints.get(copy_id, NO_OPS_PERFORMED)
+        self.local_checkpoints[copy_id] = max(prev, checkpoint)
+
+    def remove(self, copy_id: str) -> None:
+        """Copy failed/left: it no longer holds back the global checkpoint
+        (in-sync set shrink, IndexMetaData in-sync allocation update)."""
+        if copy_id != self.primary_id:
+            self.local_checkpoints.pop(copy_id, None)
+            self.in_sync.discard(copy_id)
+
+    @property
+    def global_checkpoint(self) -> int:
+        """min local checkpoint over the in-sync set."""
+        vals = [self.local_checkpoints.get(c, NO_OPS_PERFORMED)
+                for c in self.in_sync]
+        return min(vals) if vals else NO_OPS_PERFORMED
+
+    def prune(self, valid_copy_ids) -> None:
+        """Drop tracked copies no longer in the routing table (the
+        reference recomputes membership from IndexMetaData's in-sync
+        allocation ids on every cluster-state change) — a departed copy
+        must not pin the global checkpoint forever."""
+        for copy_id in list(self.local_checkpoints):
+            if copy_id != self.primary_id and copy_id not in valid_copy_ids:
+                self.remove(copy_id)
+
+    def stats(self) -> dict:
+        return {
+            "global_checkpoint": self.global_checkpoint,
+            "in_sync": sorted(self.in_sync),
+            "local_checkpoints": dict(self.local_checkpoints),
+        }
+
+
+def check_active_shards(wanted, active: int, total_copies: int,
+                        label: str) -> None:
+    """Shared wait_for_active_shards gate (ActiveShardsObserver): resolves
+    'all'/int and raises UnavailableShardsException when unmet."""
+    from elasticsearch_tpu.common.errors import (
+        IllegalArgumentException,
+        UnavailableShardsException,
+    )
+
+    if wanted == "all":
+        required = total_copies
+    else:
+        try:
+            required = int(wanted)
+        except (TypeError, ValueError):
+            raise IllegalArgumentException(
+                f"cannot parse wait_for_active_shards[{wanted}]") from None
+    if active < required:
+        raise UnavailableShardsException(
+            f"{label} Not enough active copies to meet shard count of "
+            f"[{wanted}] (have {active}, needed {required})")

@@ -137,6 +137,24 @@ class IndexShard:
     def num_docs(self) -> int:
         return self.engine.num_docs
 
+    def seq_no_stats(self) -> dict:
+        """max_seq_no / local_checkpoint / global_checkpoint
+        (SeqNoStats in the reference). A single-copy primary's global
+        checkpoint IS its local checkpoint; with replication the primary's
+        GlobalCheckpointTracker (``self.checkpoints``) owns it."""
+        tracker = getattr(self, "checkpoints", None)
+        if tracker is not None:
+            gcp = tracker.global_checkpoint
+        elif self.primary:
+            gcp = self.engine.local_checkpoint
+        else:
+            gcp = self.engine.global_checkpoint
+        return {
+            "max_seq_no": self.engine.max_seqno,
+            "local_checkpoint": self.engine.local_checkpoint,
+            "global_checkpoint": gcp,
+        }
+
     def stats(self) -> dict:
         s = self.engine.stats()
         s["search"] = {
@@ -148,6 +166,7 @@ class IndexShard:
             "state": self.state,
             "primary": self.primary,
         }
+        s["seq_no"] = self.seq_no_stats()
         return s
 
     def close(self) -> None:

@@ -282,8 +282,11 @@ class Node:
 
     def index_doc(self, index: str, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, refresh=None,
-                  pipeline: Optional[str] = None, **kw) -> dict:
+                  pipeline: Optional[str] = None,
+                  wait_for_active_shards=None, **kw) -> dict:
         svc = self.index_service(index, auto_create=True)
+        if wait_for_active_shards is not None:
+            self._check_active_shards(svc, wait_for_active_shards)
         if pipeline:
             source = self.ingest.run_pipeline(pipeline, source, doc_id, index)
             if source is None:  # dropped by pipeline
@@ -296,11 +299,38 @@ class Node:
         self._maybe_update_mapping_meta(index)
         return r
 
+    def _check_active_shards(self, svc: IndexService, wanted) -> None:
+        """wait_for_active_shards gate (ActiveShardsObserver +
+        TransportWriteAction): on this single-node topology the active
+        count per shard is 1 (the started primary; replicas are
+        unassigned), so a larger requirement fails like the reference's
+        UnavailableShardsException timeout."""
+        from elasticsearch_tpu.index.seqno import check_active_shards
+
+        check_active_shards(wanted, 1, 1 + svc.num_replicas, f"[{svc.name}]")
+
     def _maybe_refresh(self, svc: IndexService, refresh) -> None:
         if refresh in (True, "true", ""):
             svc.refresh()
         elif refresh == "wait_for":
-            svc.refresh()  # single-node: immediate refresh == wait_for
+            # refresh=wait_for (RefreshListeners): block until the periodic
+            # refresh makes the write visible; force one when the scheduler
+            # is disabled (the listener-cap forced refresh analog)
+            if not svc.refresh_interval or svc.refresh_interval <= 0:
+                svc.refresh()
+                return
+            import threading
+
+            events = []
+            for shard in svc.shards.values():
+                ev = threading.Event()
+                shard.engine.add_refresh_listener(ev.set)
+                events.append(ev)
+            deadline = svc.refresh_interval * 2 + 0.5
+            for ev in events:
+                if not ev.wait(deadline):
+                    svc.refresh()
+                    break
 
     def _maybe_update_mapping_meta(self, index: str) -> None:
         # dynamic mapping updates flow back into cluster state (the master

@@ -264,7 +264,9 @@ def _index_doc(node, req):
         kw["op_type"] = "create"
     r = node.index_doc(req.param("index"), req.param("id"), body,
                        routing=req.param("routing"), refresh=req.param("refresh"),
-                       pipeline=req.param("pipeline"), **kw)
+                       pipeline=req.param("pipeline"),
+                       wait_for_active_shards=req.param("wait_for_active_shards"),
+                       **kw)
     return (201 if r.get("result") == "created" else 200), r
 
 
@@ -274,7 +276,8 @@ def _index_doc_auto_id(node, req):
         raise ActionRequestValidationException("Validation Failed: 1: source is missing;")
     r = node.index_doc(req.param("index"), None, body,
                        routing=req.param("routing"), refresh=req.param("refresh"),
-                       pipeline=req.param("pipeline"))
+                       pipeline=req.param("pipeline"),
+                       wait_for_active_shards=req.param("wait_for_active_shards"))
     return 201, r
 
 

@@ -1,0 +1,244 @@
+"""Sequence numbers: global checkpoints, wait_for_active_shards,
+refresh=wait_for.
+
+Mirrors GlobalCheckpointTracker (index/seqno/GlobalCheckpointTracker.java:51),
+ActiveShardsObserver/wait_for_active_shards, and RefreshListeners
+(refresh=wait_for via the periodic index.refresh_interval scheduler).
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.multinode import ClusterClient, ClusterNode
+from elasticsearch_tpu.common.errors import UnavailableShardsException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
+from elasticsearch_tpu.transport.local import TransportHub
+
+
+def start_cluster(n_nodes=3):
+    hub = TransportHub(strict_serialization=True)
+    nodes = [ClusterNode(f"node-{i}", hub) for i in range(n_nodes)]
+    nodes[0].bootstrap_cluster()
+    for node in nodes[1:]:
+        node.join("node-0")
+    return hub, nodes
+
+
+@pytest.fixture()
+def cluster():
+    hub, nodes = start_cluster(3)
+    yield hub, nodes
+    for n in nodes:
+        n.close()
+
+
+class TestTracker:
+    def test_global_is_min_over_in_sync(self):
+        t = GlobalCheckpointTracker("p")
+        t.update_local_checkpoint("p", 5)
+        assert t.global_checkpoint == 5
+        t.initiate_tracking("r1")  # recovering: does not hold back
+        assert t.global_checkpoint == 5
+        t.mark_in_sync("r1", 3)
+        assert t.global_checkpoint == 3
+        t.update_local_checkpoint("r1", 5)
+        assert t.global_checkpoint == 5
+        t.update_local_checkpoint("r1", 4)  # never goes backwards
+        assert t.global_checkpoint == 5
+
+    def test_remove_advances(self):
+        t = GlobalCheckpointTracker("p")
+        t.update_local_checkpoint("p", 9)
+        t.mark_in_sync("r1", 2)
+        assert t.global_checkpoint == 2
+        t.remove("r1")
+        assert t.global_checkpoint == 9
+        t.remove("p")  # primary is never removed
+        assert t.global_checkpoint == 9
+
+
+class TestClusterCheckpoints:
+    def test_checkpoints_flow_primary_to_replica(self, cluster):
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 1}})
+        client = ClusterClient(nodes[0])
+        for i in range(5):
+            client.index("idx", str(i), {"n": i})
+        # find primary + replica shards
+        primary = replica = None
+        for n in nodes:
+            s = n.shards.get(("idx", 0))
+            if s is None:
+                continue
+            if s.primary:
+                primary = s
+            else:
+                replica = s
+        assert primary is not None and replica is not None
+        stats = primary.seq_no_stats()
+        # all 5 ops acked by the replica: global checkpoint is complete
+        assert stats["max_seq_no"] == 4
+        assert stats["global_checkpoint"] == 4
+        # replica learned a recent global checkpoint (piggybacked pre-op,
+        # so it may trail by one op)
+        assert replica.engine.global_checkpoint >= 3
+
+    def test_replica_failure_advances_global_checkpoint(self, cluster):
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 1}})
+        client = ClusterClient(nodes[0])
+        client.index("idx", "a", {"n": 1})
+        primary_node = None
+        replica_node = None
+        for n in nodes:
+            s = n.shards.get(("idx", 0))
+            if s is not None and s.primary:
+                primary_node = n
+            elif s is not None:
+                replica_node = n
+        # cut the replica off; the next write fails the copy and shrinks
+        # the in-sync set
+        hub.disconnect(primary_node.node_id, replica_node.node_id)
+        client.index("idx", "b", {"n": 2})
+        stats = primary_node.shards[("idx", 0)].seq_no_stats()
+        assert stats["global_checkpoint"] == stats["local_checkpoint"] == 1
+
+    def test_wait_for_active_shards_gate(self, cluster):
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 1}})
+        client = ClusterClient(nodes[0])
+        client.index("idx", "a", {"n": 1}, wait_for_active_shards=2)  # ok
+        # replica gone: requirement of 2 no longer met
+        replica_node = next(
+            n for n in nodes
+            if n.shards.get(("idx", 0)) is not None
+            and not n.shards[("idx", 0)].primary)
+        primary_node = next(
+            n for n in nodes
+            if n.shards.get(("idx", 0)) is not None
+            and n.shards[("idx", 0)].primary)
+        hub.disconnect(primary_node.node_id, replica_node.node_id)
+        client.index("idx", "b", {"n": 2})  # fails the copy
+        with pytest.raises(Exception) as ei:
+            client.index("idx", "c", {"n": 3}, wait_for_active_shards=2)
+        assert "Not enough active copies" in str(ei.value)
+        # 1 is still satisfiable
+        client.index("idx", "d", {"n": 4}, wait_for_active_shards=1)
+
+
+class TestTrackerLifecycle:
+    def test_departed_replica_pruned_from_in_sync(self, cluster):
+        # a replica that leaves the routing table must not pin the
+        # global checkpoint forever
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 1}})
+        client = ClusterClient(nodes[0])
+        client.index("idx", "a", {"n": 1})
+        primary_node = next(n for n in nodes
+                            if n.shards.get(("idx", 0)) is not None
+                            and n.shards[("idx", 0)].primary)
+        replica_node = next(n for n in nodes
+                            if n.shards.get(("idx", 0)) is not None
+                            and not n.shards[("idx", 0)].primary)
+        tracker = primary_node.shards[("idx", 0)].checkpoints
+        assert replica_node.node_id in tracker.in_sync
+        # node leaves the cluster: master reroutes, routing drops the copy
+        hub.disconnect(replica_node.node_id)
+        nodes[0].node_left(replica_node.node_id)
+        assert replica_node.node_id not in tracker.in_sync
+        stats = primary_node.shards[("idx", 0)].seq_no_stats()
+        assert stats["global_checkpoint"] == stats["local_checkpoint"]
+
+    def test_bad_wait_for_active_shards_is_400(self, cluster):
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 0}})
+        client = ClusterClient(nodes[0])
+        with pytest.raises(Exception) as ei:
+            client.index("idx", "a", {"n": 1},
+                         wait_for_active_shards="majority")
+        assert "cannot parse wait_for_active_shards" in str(ei.value)
+
+
+class TestSingleNode:
+    def test_seq_no_stats_in_shard_stats(self):
+        idx = IndexService("s", Settings({"index.number_of_shards": 1,
+                                          "index.refresh_interval": "-1"}))
+        for i in range(3):
+            idx.index_doc(str(i), {"n": i})
+        s = idx.shards[0].stats()["seq_no"]
+        assert s["max_seq_no"] == 2
+        assert s["local_checkpoint"] == 2
+        assert s["global_checkpoint"] == 2  # single copy: global == local
+        idx.close()
+
+    def test_wait_for_active_shards_single_node(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("idx", {"settings": {
+            "index.number_of_replicas": 1}})
+        node.index_doc("idx", "1", {"a": 1}, wait_for_active_shards=1)
+        with pytest.raises(UnavailableShardsException):
+            node.index_doc("idx", "2", {"a": 2}, wait_for_active_shards=2)
+        with pytest.raises(UnavailableShardsException):
+            node.index_doc("idx", "3", {"a": 3}, wait_for_active_shards="all")
+        node.close()
+
+
+class TestRefreshScheduling:
+    def test_periodic_refresh_makes_docs_visible(self):
+        idx = IndexService("r", Settings({
+            "index.number_of_shards": 1,
+            "index.refresh_interval": "100ms"}))
+        idx.index_doc("1", {"a": 1})
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if idx.search({"query": {"match_all": {}}})["hits"]["total"] == 1:
+                break
+            time.sleep(0.05)
+        assert idx.search({"query": {"match_all": {}}})["hits"]["total"] == 1
+        idx.close()
+
+    def test_refresh_interval_disabled(self):
+        idx = IndexService("r2", Settings({
+            "index.number_of_shards": 1,
+            "index.refresh_interval": "-1"}))
+        idx.index_doc("1", {"a": 1})
+        time.sleep(0.3)
+        assert idx.search({"query": {"match_all": {}}})["hits"]["total"] == 0
+        idx.refresh()
+        assert idx.search({"query": {"match_all": {}}})["hits"]["total"] == 1
+        idx.close()
+
+    def test_refresh_wait_for(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("idx", {"settings": {
+            "index.refresh_interval": "150ms"}})
+        t0 = time.time()
+        node.index_doc("idx", "1", {"a": 1}, refresh="wait_for")
+        # the write is visible the moment index_doc returns
+        assert node.search("idx", {"query": {"match_all": {}}})["hits"]["total"] == 1
+        assert time.time() - t0 < 5.0
+        node.close()
+
+    def test_refresh_wait_for_with_disabled_interval_forces(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("idx", {"settings": {
+            "index.refresh_interval": "-1"}})
+        node.index_doc("idx", "1", {"a": 1}, refresh="wait_for")
+        assert node.search("idx", {"query": {"match_all": {}}})["hits"]["total"] == 1
+        node.close()
