@@ -357,14 +357,30 @@ class ClusterNode:
                 shard.engine.version_map[op["id"]].version = op["version"]
         shard.refresh()
         # confirm the replay to the primary (recovery finalize) so it can
-        # mark this copy in-sync at a checkpoint we actually hold
-        try:
-            self.transport.send_request(primary_node, ACTION_RECOVERY_FINALIZE, {
-                "index": index, "shard": sid,
-                "local_checkpoint": shard.engine.local_checkpoint,
-            })
-        except (NodeNotConnectedException, ElasticsearchTpuException):
-            return  # primary unreachable: stay INITIALIZING, retry later
+        # mark this copy in-sync at a checkpoint we actually hold; the
+        # response carries the ops written since the stream snapshot
+        fin = None
+        for _attempt in range(3):  # brief transient faults retry inline
+            try:
+                fin = self.transport.send_request(
+                    primary_node, ACTION_RECOVERY_FINALIZE, {
+                        "index": index, "shard": sid,
+                        "local_checkpoint": shard.engine.local_checkpoint,
+                    })
+                break
+            except (NodeNotConnectedException, ElasticsearchTpuException):
+                time.sleep(0.02)
+        if fin is None:
+            return  # primary unreachable: stay INITIALIZING; the next
+            # cluster-state publish or master health check re-runs recovery
+        for op in fin.get("ops", []):
+            shard.engine.index(
+                op["id"], op["source"], op.get("routing"),
+                seqno=op["seq_no"], add_to_translog=True,
+            )
+            shard.engine.version_map[op["id"]].version = op["version"]
+        if fin.get("ops"):
+            shard.refresh()
         self._report_started(index, sid)
 
     def _on_start_recovery(self, payload, src) -> dict:
@@ -376,10 +392,21 @@ class ClusterNode:
                 f"[{payload['index']}][{payload['shard']}]"
             )
         shard.refresh()
+        ops = self._collect_ops(shard)
+        # the target is tracked (not yet in-sync) until it confirms the
+        # replay via the finalize RPC (_on_recovery_finalize)
+        tracker = getattr(shard, "checkpoints", None)
+        if tracker is not None:
+            tracker.initiate_tracking(src)
+        return {"ops": ops, "max_seq_no": shard.engine.max_seqno}
+
+    @staticmethod
+    def _collect_ops(shard, above_seqno: int = -1) -> list:
+        """Live docs as seqno-stamped index ops (> above_seqno)."""
         ops = []
         for seg in shard.engine.searchable_segments():
             for local in range(seg.num_docs):
-                if seg.live[local]:
+                if seg.live[local] and int(seg.seqnos[local]) > above_seqno:
                     ops.append({
                         "op": "index",
                         "id": seg.doc_ids[local],
@@ -388,22 +415,27 @@ class ClusterNode:
                         "seq_no": int(seg.seqnos[local]),
                         "version": int(seg.versions[local]),
                     })
-        # the target is tracked (not yet in-sync) until it confirms the
-        # replay via the finalize RPC (_on_recovery_finalize)
-        tracker = getattr(shard, "checkpoints", None)
-        if tracker is not None:
-            tracker.initiate_tracking(src)
-        return {"ops": ops, "max_seq_no": shard.engine.max_seqno}
+        return ops
 
     def _on_recovery_finalize(self, payload, src) -> dict:
-        """Primary side: the target applied the streamed ops — mark it
-        in-sync at its confirmed local checkpoint
-        (RecoverySourceHandler finalize -> markAllocationIdAsInSync)."""
+        """Primary side: the target applied the streamed ops — return the
+        delta written since the stream snapshot, then mark the copy
+        in-sync (RecoverySourceHandler finalize ->
+        markAllocationIdAsInSync). From in-sync on, the write fan-out
+        covers the copy even before the master publishes STARTED, so no
+        op can fall into the finalize->STARTED window."""
         shard = self.shards.get((payload["index"], payload["shard"]))
         tracker = getattr(shard, "checkpoints", None) if shard else None
+        delta = []
+        if shard is not None:
+            shard.refresh()
+            delta = self._collect_ops(shard,
+                                      above_seqno=payload["local_checkpoint"])
         if tracker is not None:
-            tracker.mark_in_sync(src, payload["local_checkpoint"])
-        return {"ok": True}
+            new_ckpt = max(payload["local_checkpoint"],
+                           *( [op["seq_no"] for op in delta] or [-1] ))
+            tracker.mark_in_sync(src, new_ckpt)
+        return {"ok": True, "ops": delta}
 
     def _report_started(self, index: str, sid: int) -> None:
         try:
@@ -483,7 +515,14 @@ class ClusterNode:
             tracker.global_checkpoint if tracker is not None else -1)
         acks = 1
         for copy in self.routing.get(index, {}).get(sid, []):
-            if copy.primary or copy.state != ShardRoutingState.STARTED:
+            if copy.primary:
+                continue
+            # replication group = STARTED copies + copies already marked
+            # in-sync by recovery finalize (the master may not have
+            # published STARTED yet; skipping them would lose the ops
+            # written in that window)
+            in_sync = tracker is not None and copy.node_id in tracker.in_sync
+            if copy.state != ShardRoutingState.STARTED and not in_sync:
                 continue
             try:
                 ack = self.transport.send_request(

@@ -1,0 +1,52 @@
+"""Deprecation logging with response-header propagation.
+
+Role model: ``DeprecationLogger`` (reference:
+core/src/main/java/org/elasticsearch/common/logging/DeprecationLogger.java)
+— deprecated-usage warnings are (a) logged once per process per unique
+message (dedup) and (b) attached to the current HTTP response as RFC-7234
+``Warning`` headers (code 299) via a request-scoped collector (the
+reference threads this through ``ThreadContext`` response headers).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List
+
+_logger = logging.getLogger("elasticsearch_tpu.deprecation")
+_seen: set = set()
+_seen_lock = threading.Lock()
+_tls = threading.local()
+
+
+def begin_request() -> None:
+    """Reset the current thread's warning collector (called by the REST
+    dispatcher at the start of each request)."""
+    _tls.warnings = []
+
+
+def collect_warnings() -> List[str]:
+    """Drain the warnings recorded during the current request."""
+    out = list(getattr(_tls, "warnings", []))
+    _tls.warnings = []
+    return out
+
+
+def warning_header_value(message: str) -> str:
+    """RFC 7234 warn-code 299 header value (DeprecationLogger.formatWarning)."""
+    return f'299 elasticsearch_tpu "{message}"'
+
+
+class DeprecationLogger:
+    def __init__(self, name: str = "deprecation"):
+        self._name = name
+
+    def deprecated(self, message: str) -> None:
+        with _seen_lock:
+            if message not in _seen:
+                _seen.add(message)
+                _logger.warning("[%s] %s", self._name, message)
+        warnings = getattr(_tls, "warnings", None)
+        if warnings is not None and message not in warnings:
+            warnings.append(message)

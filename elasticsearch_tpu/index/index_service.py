@@ -53,6 +53,11 @@ class IndexService:
         durability = INDEX_TRANSLOG_DURABILITY.get(settings)
         slowlog_warn = settings.get_time("index.search.slowlog.threshold.query.warn")
         slowlog_info = settings.get_time("index.search.slowlog.threshold.query.info")
+        idx_slow_warn = settings.get_time(
+            "index.indexing.slowlog.threshold.index.warn")
+        idx_slow_info = settings.get_time(
+            "index.indexing.slowlog.threshold.index.info")
+        idx_slow_source = settings.get_int("index.indexing.slowlog.source", 1000)
         self.shards: Dict[int, IndexShard] = {}
         for sid in range(self.num_shards):
             shard_path = os.path.join(data_path, str(sid)) if data_path else None
@@ -60,7 +65,10 @@ class IndexService:
                                durability=durability,
                                slowlog_warn_s=slowlog_warn,
                                slowlog_info_s=slowlog_info,
-                               index_sort=self.index_sort)
+                               index_sort=self.index_sort,
+                               indexing_slowlog_warn_s=idx_slow_warn,
+                               indexing_slowlog_info_s=idx_slow_info,
+                               indexing_slowlog_source_chars=idx_slow_source)
             if shard_path and shard.engine.store.read_commit() is not None:
                 shard.recover_from_store()
             elif shard_path and os.path.exists(

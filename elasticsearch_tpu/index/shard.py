@@ -20,6 +20,11 @@ from elasticsearch_tpu.index.store import Store
 from elasticsearch_tpu.index.translog import Translog, TranslogOp
 from elasticsearch_tpu.search.service import ShardSearcher
 
+import logging
+
+_indexing_slow_logger = logging.getLogger(
+    "elasticsearch_tpu.index.indexing.slowlog")
+
 
 class ShardState:
     CREATED = "CREATED"
@@ -33,13 +38,26 @@ class IndexShard:
     def __init__(self, index_name: str, shard_id: int, mapper_service,
                  data_path: Optional[str] = None, primary: bool = True,
                  durability: str = Translog.DURABILITY_REQUEST,
-                 slowlog_warn_s=None, slowlog_info_s=None, index_sort=None):
+                 slowlog_warn_s=None, slowlog_info_s=None, index_sort=None,
+                 indexing_slowlog_warn_s=None, indexing_slowlog_info_s=None,
+                 indexing_slowlog_source_chars: int = 1000):
         self.index_name = index_name
         self.shard_id = shard_id
         self.mapper_service = mapper_service
         self.primary = primary
         self.primary_term = 1
         self.state = ShardState.CREATED
+        # primary-side GlobalCheckpointTracker (set by the replication
+        # layer when replicas exist; None = single copy)
+        self.checkpoints = None
+        # indexing slow log (IndexingSlowLog.java); negative = disabled
+        self.indexing_slowlog_warn_s = (
+            indexing_slowlog_warn_s if indexing_slowlog_warn_s is not None
+            and indexing_slowlog_warn_s >= 0 else None)
+        self.indexing_slowlog_info_s = (
+            indexing_slowlog_info_s if indexing_slowlog_info_s is not None
+            and indexing_slowlog_info_s >= 0 else None)
+        self.indexing_slowlog_source_chars = indexing_slowlog_source_chars
         if data_path:
             os.makedirs(data_path, exist_ok=True)
             translog = Translog(os.path.join(data_path, "translog"), durability)
@@ -96,12 +114,31 @@ class IndexShard:
                   version: Optional[int] = None, version_type: str = "internal",
                   op_type: str = "index", seqno: Optional[int] = None) -> dict:
         self._ensure_started()
+        t0 = time.monotonic()
         r = self.engine.index(doc_id, source, routing, version, version_type,
                               op_type, seqno)
+        self._maybe_indexing_slowlog(time.monotonic() - t0, doc_id, source)
         r["_index"] = self.index_name
         r["_shard"] = self.shard_id
         r["_primary_term"] = self.primary_term
         return r
+
+    def _maybe_indexing_slowlog(self, took_s: float, doc_id: str,
+                                source: dict) -> None:
+        """Indexing slow log (index/IndexingSlowLog.java): per-index
+        warn/info thresholds, source truncated to
+        index.indexing.slowlog.source chars."""
+        warn = self.indexing_slowlog_warn_s
+        info = self.indexing_slowlog_info_s
+        level = None
+        if warn is not None and took_s >= warn:
+            level = _indexing_slow_logger.warning
+        elif info is not None and took_s >= info:
+            level = _indexing_slow_logger.info
+        if level is not None:
+            level("took[%dms], shard[[%s][%s]], id[%s], source[%s]",
+                  int(took_s * 1000), self.index_name, self.shard_id,
+                  doc_id, str(source)[: self.indexing_slowlog_source_chars])
 
     def delete_doc(self, doc_id: str, version: Optional[int] = None,
                    seqno: Optional[int] = None) -> dict:
@@ -142,7 +179,7 @@ class IndexShard:
         (SeqNoStats in the reference). A single-copy primary's global
         checkpoint IS its local checkpoint; with replication the primary's
         GlobalCheckpointTracker (``self.checkpoints``) owns it."""
-        tracker = getattr(self, "checkpoints", None)
+        tracker = self.checkpoints
         if tracker is not None:
             gcp = tracker.global_checkpoint
         elif self.primary:

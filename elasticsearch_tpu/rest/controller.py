@@ -115,6 +115,10 @@ class RestController:
                  body: Optional[bytes]) -> Tuple[int, Any]:
         from urllib.parse import unquote
 
+        from elasticsearch_tpu.common.deprecation import begin_request
+
+        begin_request()  # per-request Warning-header collector
+
         path = unquote(path.split("?")[0])
         method_routes = [r for r in self.routes if r.method == method]
         for route in method_routes:

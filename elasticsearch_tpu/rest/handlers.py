@@ -252,7 +252,27 @@ def _root(node, req):
 # ---------------------------------------------------------------------------
 
 
+_DEPRECATION = None
+
+
+def _typed_api_warning(req) -> None:
+    """Custom type names in document API paths are deprecated
+    (6.x single-type enforcement, DeprecationLogger usage in
+    RestIndexAction et al.)."""
+    global _DEPRECATION
+    t = req.param("type")
+    if t is not None and t != "_doc":
+        if _DEPRECATION is None:
+            from elasticsearch_tpu.common.deprecation import DeprecationLogger
+
+            _DEPRECATION = DeprecationLogger("rest.typed_api")
+        _DEPRECATION.deprecated(
+            "specifying a custom type in document API paths is deprecated; "
+            "use /{index}/_doc/{id} instead")
+
+
 def _index_doc(node, req):
+    _typed_api_warning(req)
     body = req.json_body()
     if body is None:
         raise ActionRequestValidationException("Validation Failed: 1: source is missing;")
@@ -282,6 +302,7 @@ def _index_doc_auto_id(node, req):
 
 
 def _get_doc(node, req):
+    _typed_api_warning(req)
     r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
     return (200 if r["found"] else 404), r
 

@@ -28,6 +28,12 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
         status, payload = self.controller.dispatch(method, parsed.path, query, body)
+        from elasticsearch_tpu.common.deprecation import (
+            collect_warnings,
+            warning_header_value,
+        )
+
+        warnings = collect_warnings()
         if isinstance(payload, str):
             data = payload.encode("utf-8")
             ctype = "text/plain; charset=UTF-8"
@@ -39,6 +45,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for w in warnings:
+            self.send_header("Warning", warning_header_value(w))
         self.end_headers()
         if method != "HEAD":
             self.wfile.write(data)

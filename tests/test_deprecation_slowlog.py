@@ -1,0 +1,106 @@
+"""Deprecation logger (Warning response headers + dedup) and the
+indexing slow log.
+
+Mirrors DeprecationLogger (common/logging/DeprecationLogger.java) and
+IndexingSlowLog (index/IndexingSlowLog.java).
+"""
+
+import logging
+
+import pytest
+
+from elasticsearch_tpu.common import deprecation as dep
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+class TestDeprecationLogger:
+    def test_collects_into_request_scope(self):
+        dep.begin_request()
+        logger = dep.DeprecationLogger("test")
+        logger.deprecated("thing A is deprecated")
+        logger.deprecated("thing A is deprecated")  # request-level dedup
+        logger.deprecated("thing B is deprecated")
+        warnings = dep.collect_warnings()
+        assert warnings == ["thing A is deprecated", "thing B is deprecated"]
+        # drained: a second collect is empty
+        assert dep.collect_warnings() == []
+
+    def test_process_level_log_dedup(self, caplog):
+        dep.begin_request()
+        logger = dep.DeprecationLogger("test")
+        with caplog.at_level(logging.WARNING,
+                             logger="elasticsearch_tpu.deprecation"):
+            logger.deprecated("only logged once xyz")
+            logger.deprecated("only logged once xyz")
+        assert sum("only logged once xyz" in r.message
+                   for r in caplog.records) <= 1
+
+    def test_warning_header_format(self):
+        v = dep.warning_header_value("msg here")
+        assert v.startswith('299 ') and '"msg here"' in v
+
+    def test_typed_api_emits_warning(self):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.controller import RestController
+
+        node = Node()
+        node.create_index("idx")
+        controller = RestController(node)
+        import json
+
+        status, _ = controller.dispatch(
+            "PUT", "/idx/tweet/1", {}, json.dumps({"a": 1}).encode())
+        assert status in (200, 201)
+        warnings = dep.collect_warnings()
+        assert any("custom type" in w for w in warnings)
+        # the canonical _doc path emits nothing
+        controller.dispatch("PUT", "/idx/_doc/2", {},
+                            json.dumps({"a": 2}).encode())
+        assert dep.collect_warnings() == []
+        node.close()
+
+
+class TestIndexingSlowLog:
+    def test_slow_index_logged(self, caplog):
+        idx = IndexService("slow", Settings({
+            "index.number_of_shards": 1,
+            "index.refresh_interval": "-1",
+            # 0s threshold: every indexing op is "slow"
+            "index.indexing.slowlog.threshold.index.warn": "0s",
+            "index.indexing.slowlog.source": 10,
+        }))
+        with caplog.at_level(
+                logging.WARNING,
+                logger="elasticsearch_tpu.index.indexing.slowlog"):
+            idx.index_doc("1", {"text": "x" * 100})
+        recs = [r for r in caplog.records
+                if r.name == "elasticsearch_tpu.index.indexing.slowlog"]
+        assert len(recs) == 1
+        msg = recs[0].getMessage()
+        assert "took[" in msg and "id[1]" in msg
+        # source truncated to 10 chars
+        src = msg.split("source[", 1)[1]
+        assert len(src) <= 12
+        idx.close()
+
+    def test_disabled_by_default(self, caplog):
+        idx = IndexService("quiet", Settings({
+            "index.number_of_shards": 1,
+            "index.refresh_interval": "-1"}))
+        with caplog.at_level(logging.INFO):
+            idx.index_doc("1", {"a": 1})
+        assert not [r for r in caplog.records
+                    if r.name == "elasticsearch_tpu.index.indexing.slowlog"]
+        idx.close()
+
+    def test_negative_threshold_disables(self, caplog):
+        idx = IndexService("neg", Settings({
+            "index.number_of_shards": 1,
+            "index.refresh_interval": "-1",
+            "index.indexing.slowlog.threshold.index.warn": "-1"}))
+        with caplog.at_level(logging.WARNING):
+            idx.index_doc("1", {"a": 1})
+        assert not [r for r in caplog.records
+                    if r.name == "elasticsearch_tpu.index.indexing.slowlog"]
+        idx.close()

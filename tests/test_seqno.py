@@ -156,6 +156,32 @@ class TestTrackerLifecycle:
         stats = primary_node.shards[("idx", 0)].seq_no_stats()
         assert stats["global_checkpoint"] == stats["local_checkpoint"]
 
+    def test_finalize_returns_delta_and_marks_in_sync(self, cluster):
+        # ops written between the recovery stream snapshot and finalize
+        # must reach the target via the finalize delta, and from in-sync
+        # on the copy joins the write fan-out even before STARTED
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 0}})
+        client = ClusterClient(nodes[0])
+        client.index("idx", "a", {"n": 1})
+        primary_node = next(n for n in nodes
+                            if n.shards.get(("idx", 0)) is not None)
+        # simulate a recovery stream to a fake target
+        resp = primary_node._on_start_recovery(
+            {"index": "idx", "shard": 0, "target": "fake"}, "fake")
+        streamed = {op["id"] for op in resp["ops"]}
+        assert streamed == {"a"}
+        tracker = primary_node.shards[("idx", 0)].checkpoints
+        assert tracker is not None and "fake" not in tracker.in_sync
+        # a write lands in the stream->finalize window
+        client.index("idx", "b", {"n": 2})
+        fin = primary_node._on_recovery_finalize(
+            {"index": "idx", "shard": 0,
+             "local_checkpoint": resp["max_seq_no"]}, "fake")
+        assert {op["id"] for op in fin["ops"]} == {"b"}
+        assert "fake" in tracker.in_sync
+
     def test_bad_wait_for_active_shards_is_400(self, cluster):
         from elasticsearch_tpu.common.errors import IllegalArgumentException
 
