@@ -1,10 +1,15 @@
 """Shard allocation: assign primaries and replicas to data nodes.
 
 Role model: ``AllocationService`` + ``BalancedShardsAllocator`` + deciders
-(cluster/routing/allocation/). Round-1 deciders: SameShardAllocationDecider
-(a replica never lands on its primary's node) and balance-by-count.
-Assignments are sticky: existing placements survive reroutes while their
-node is alive (the reference's "prefer existing allocation").
+(cluster/routing/allocation/). Deciders implemented:
+SameShardAllocationDecider (a replica never lands on its primary's node),
+balance-by-count, ``DiskThresholdDecider`` (low watermark blocks new
+allocations, high watermark moves replicas off — fed by per-node disk
+usage, the ``ClusterInfoService``/``DiskThresholdMonitor`` analog), and
+``AwarenessAllocationDecider`` (spread copies across configured node
+attribute values, e.g. zones). Assignments are sticky: existing placements
+survive reroutes while their node is alive (the reference's "prefer
+existing allocation").
 """
 
 from __future__ import annotations
@@ -34,17 +39,73 @@ def _least_loaded(candidates: List[str], load: Dict[str, int]) -> Optional[str]:
     return min(candidates, key=lambda n: (load.get(n, 0), n))
 
 
+# DiskThresholdDecider defaults (cluster.routing.allocation.disk.watermark.*)
+WATERMARK_LOW = 0.85
+WATERMARK_HIGH = 0.90
+
+
+def _pick_node(candidates: List[str], load: Dict[str, int],
+               existing_copies: List[ShardRouting],
+               node_info: Optional[Dict[str, dict]],
+               awareness_attributes: Optional[List[str]],
+               watermark_low: float) -> Optional[str]:
+    """Decider chain for one unassigned copy: disk low-watermark filter,
+    awareness-attribute preference, then least-loaded."""
+    if node_info:
+        ok = [n for n in candidates
+              if (node_info.get(n, {}).get("disk") or 0.0) < watermark_low]
+        if ok:
+            candidates = ok  # else: ignore the watermark rather than leave
+            # the copy unassigned? No — the reference leaves it unassigned.
+        else:
+            return None
+    if not candidates:
+        return None
+    if awareness_attributes and node_info:
+        def attr_penalty(n: str) -> int:
+            # count existing copies sharing any awareness value with n
+            my = node_info.get(n, {}).get("attrs") or {}
+            penalty = 0
+            for attr in awareness_attributes:
+                mine = my.get(attr)
+                if mine is None:
+                    continue
+                for c in existing_copies:
+                    other = (node_info.get(c.node_id, {}).get("attrs") or {})
+                    if other.get(attr) == mine:
+                        penalty += 1
+            return penalty
+
+        penalties = {n: attr_penalty(n) for n in candidates}
+        best_penalty = min(penalties.values())
+        candidates = [n for n in candidates if penalties[n] == best_penalty]
+    return _least_loaded(candidates, load)
+
+
 def allocate(indices_meta: Dict, data_nodes: List[str],
-             previous: Optional[RoutingTable] = None) -> RoutingTable:
+             previous: Optional[RoutingTable] = None,
+             node_info: Optional[Dict[str, dict]] = None,
+             awareness_attributes: Optional[List[str]] = None,
+             watermark_low: float = WATERMARK_LOW,
+             watermark_high: float = WATERMARK_HIGH) -> RoutingTable:
     """Compute the routing table for the current node set.
 
     indices_meta: {name: IndexMetadata}. Copies on departed nodes are
     dropped; a surviving replica is promoted when its primary is gone
     (primary promotion — ShardStateAction/failShard path, SURVEY §5.3);
     unassigned copies fill onto the least-loaded eligible node.
+    node_info: {node_id: {"attrs": {...}, "disk": used_fraction}} — feeds
+    the disk-threshold + awareness deciders.
     """
     previous = previous or {}
     alive = set(data_nodes)
+    # DiskThresholdMonitor: nodes above the high watermark shed replicas —
+    # but only onto an eligible target (a healthy in-sync copy is never
+    # discarded without a replacement)
+    hot = set()
+    if node_info:
+        hot = {n for n in alive
+               if (node_info.get(n, {}).get("disk") or 0.0) >= watermark_high}
     table: RoutingTable = {}
     for name, md in indices_meta.items():
         if md.state != "open":
@@ -80,7 +141,8 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
         for sid in range(md.num_shards):
             copies = table[name][sid]
             if not any(c.primary for c in copies):
-                node = _least_loaded(list(alive), load)
+                node = _pick_node(list(alive), load, copies, node_info,
+                                  awareness_attributes, watermark_low)
                 if node is not None:
                     copies.insert(0, ShardRouting(
                         name, sid, node, True, ShardRoutingState.INITIALIZING
@@ -94,19 +156,50 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
             while len(copies) < 1 + md.num_replicas:
                 used = {c.node_id for c in copies}
                 candidates = [n for n in alive if n not in used]
-                node = _least_loaded(candidates, load)
+                node = _pick_node(candidates, load, copies, node_info,
+                                  awareness_attributes, watermark_low)
                 if node is None:
                     break  # not enough nodes — stays unassigned (yellow)
                 copies.append(ShardRouting(
                     name, sid, node, False, ShardRoutingState.INITIALIZING
                 ))
                 load[node] = load.get(node, 0) + 1
-    _rebalance_replicas(table, alive, load)
+    if hot:
+        _relocate_hot_replicas(table, alive, load, node_info,
+                               awareness_attributes, watermark_low, hot)
+    _rebalance_replicas(table, alive, load, node_info, awareness_attributes,
+                        watermark_low)
     return table
 
 
+def _relocate_hot_replicas(table: RoutingTable, alive: set,
+                           load: Dict[str, int], node_info, awareness,
+                           watermark_low: float, hot: set) -> None:
+    """Move replicas off high-watermark nodes when (and only when) a
+    target under the low watermark exists; a moved copy restarts as
+    INITIALIZING (relocation = recovery onto the target)."""
+    for shards in table.values():
+        for copies in shards.values():
+            for copy in copies:
+                if copy.primary or copy.node_id not in hot:
+                    continue
+                used = {c.node_id for c in copies if c is not copy}
+                candidates = [n for n in alive if n not in used]
+                target = _pick_node(candidates, load,
+                                    [c for c in copies if c is not copy],
+                                    node_info, awareness, watermark_low)
+                if target is not None and target != copy.node_id:
+                    load[copy.node_id] = load.get(copy.node_id, 1) - 1
+                    load[target] = load.get(target, 0) + 1
+                    copy.node_id = target
+                    copy.state = ShardRoutingState.INITIALIZING
+
+
 def _rebalance_replicas(table: RoutingTable, alive: set,
-                        load: Dict[str, int]) -> None:
+                        load: Dict[str, int],
+                        node_info: Optional[Dict[str, dict]] = None,
+                        awareness_attributes: Optional[List[str]] = None,
+                        watermark_low: float = WATERMARK_LOW) -> None:
     """Move freshly-assigned (INITIALIZING) replicas off overloaded nodes —
     the greedy fill can pile ties onto one node (BalancedShardsAllocator's
     balancing step). Started replicas are never moved here (moving them
@@ -121,7 +214,10 @@ def _rebalance_replicas(table: RoutingTable, alive: set,
                         continue
                     used = {c.node_id for c in copies if c is not copy}
                     candidates = [n for n in alive if n not in used]
-                    best = _least_loaded(candidates, load)
+                    best = _pick_node(candidates, load,
+                                      [c for c in copies if c is not copy],
+                                      node_info, awareness_attributes,
+                                      watermark_low)
                     if best is not None and copy.node_id is not None and \
                             load.get(best, 0) + 1 < load.get(copy.node_id, 0):
                         load[copy.node_id] -= 1

@@ -70,11 +70,22 @@ class ClusterNode:
     its allocated shards)."""
 
     def __init__(self, name: str, hub: TransportHub, master_eligible: bool = True,
-                 data: bool = True):
+                 data: bool = True, attrs: Optional[Dict[str, str]] = None,
+                 awareness_attributes: Optional[List[str]] = None):
         self.name = name
         self.node_id = name  # stable, human-readable ids make tests clear
         self.master_eligible = master_eligible
         self.data = data
+        # node attributes (node.attr.* — awareness zones etc.) + simulated
+        # disk usage fraction (ClusterInfoService/FsProbe analog; tests set
+        # it and call reroute)
+        self.attrs = dict(attrs or {})
+        self.disk_used_fraction = 0.0
+        # master-side: configured awareness attributes
+        # (cluster.routing.allocation.awareness.attributes)
+        self.awareness_attributes = list(awareness_attributes or [])
+        # master-side: per-node info collected from joins
+        self.node_info_map: Dict[str, dict] = {}
         self.transport = TransportService(self.node_id, hub)
         self.hub = hub
         # cluster-state copy (every node holds the latest published state)
@@ -118,22 +129,23 @@ class ClusterNode:
         with self._lock:
             self.master_id = self.node_id
             self.known_nodes = [self.node_id]
+            self.node_info_map[self.node_id] = {
+                "attrs": self.attrs, "disk": self.disk_used_fraction}
             self.state_version = 1
 
     def join(self, seed_node: str) -> None:
         """Join via any known node (UnicastZenPing seed analog)."""
-        resp = self.transport.send_request(seed_node, ACTION_JOIN, {
+        payload = {
             "node": self.node_id,
             "master_eligible": self.master_eligible,
             "data": self.data,
-        })
+            "attrs": self.attrs,
+            "disk": self.disk_used_fraction,
+        }
+        resp = self.transport.send_request(seed_node, ACTION_JOIN, payload)
         if resp.get("master") != seed_node:
             # redirected to the actual master
-            self.transport.send_request(resp["master"], ACTION_JOIN, {
-                "node": self.node_id,
-                "master_eligible": self.master_eligible,
-                "data": self.data,
-            })
+            self.transport.send_request(resp["master"], ACTION_JOIN, payload)
 
     def _on_join(self, payload, src) -> dict:
         with self._lock:
@@ -142,6 +154,10 @@ class ClusterNode:
             node = payload["node"]
             if node not in self.known_nodes:
                 self.known_nodes.append(node)
+            self.node_info_map[node] = {
+                "attrs": payload.get("attrs") or {},
+                "disk": payload.get("disk") or 0.0,
+            }
             self._master_reroute_and_publish()
             return {"master": self.node_id}
 
@@ -202,9 +218,28 @@ class ClusterNode:
             self._master_reroute_and_publish()
             return {"acknowledged": True}
 
+    def update_node_disk(self, node_id: str, used_fraction: float) -> None:
+        """Master-side disk-usage report (DiskThresholdMonitor input);
+        callers follow with a reroute to act on watermark crossings."""
+        with self._lock:
+            if not self.is_master:
+                raise IllegalArgumentException(
+                    "update_node_disk must run on the master")
+            info = self.node_info_map.setdefault(
+                node_id, {"attrs": {}, "disk": 0.0})
+            info["disk"] = used_fraction
+
+    def reroute(self) -> None:
+        """Explicit reroute (POST /_cluster/reroute analog)."""
+        with self._lock:
+            self._master_reroute_and_publish()
+
     def _master_reroute_and_publish(self) -> None:
         data_nodes = [n for n in self.known_nodes]  # all nodes are data nodes here
-        self.routing = allocate(self.indices_meta, data_nodes, self.routing)
+        self.routing = allocate(
+            self.indices_meta, data_nodes, self.routing,
+            node_info=self.node_info_map,
+            awareness_attributes=self.awareness_attributes or None)
         self.state_version += 1
         state = self._state_dict()
         for node in list(self.known_nodes):
@@ -374,11 +409,14 @@ class ClusterNode:
             return  # primary unreachable: stay INITIALIZING; the next
             # cluster-state publish or master health check re-runs recovery
         for op in fin.get("ops", []):
-            shard.engine.index(
-                op["id"], op["source"], op.get("routing"),
-                seqno=op["seq_no"], add_to_translog=True,
-            )
-            shard.engine.version_map[op["id"]].version = op["version"]
+            if op["op"] == "delete":
+                shard.engine.delete(op["id"], seqno=op["seq_no"])
+            else:
+                shard.engine.index(
+                    op["id"], op["source"], op.get("routing"),
+                    seqno=op["seq_no"], add_to_translog=True,
+                )
+                shard.engine.version_map[op["id"]].version = op["version"]
         if fin.get("ops"):
             shard.refresh()
         self._report_started(index, sid)
@@ -402,7 +440,10 @@ class ClusterNode:
 
     @staticmethod
     def _collect_ops(shard, above_seqno: int = -1) -> list:
-        """Live docs as seqno-stamped index ops (> above_seqno)."""
+        """Live docs as seqno-stamped index ops (> above_seqno). For delta
+        collection (above_seqno >= 0) deletes executed since the snapshot
+        are included too — the target may hold the doc from the snapshot
+        and must not keep it after being marked in-sync."""
         ops = []
         for seg in shard.engine.searchable_segments():
             for local in range(seg.num_docs):
@@ -415,6 +456,13 @@ class ClusterNode:
                         "seq_no": int(seg.seqnos[local]),
                         "version": int(seg.versions[local]),
                     })
+        if above_seqno >= 0:
+            for doc_id, entry in shard.engine.version_map.items():
+                if getattr(entry, "deleted", False) and entry.seqno > above_seqno:
+                    ops.append({"op": "delete", "id": doc_id,
+                                "seq_no": int(entry.seqno),
+                                "version": int(entry.version)})
+        ops.sort(key=lambda op: op["seq_no"])
         return ops
 
     def _on_recovery_finalize(self, payload, src) -> dict:
@@ -424,18 +472,21 @@ class ClusterNode:
         markAllocationIdAsInSync). From in-sync on, the write fan-out
         covers the copy even before the master publishes STARTED, so no
         op can fall into the finalize->STARTED window."""
-        shard = self.shards.get((payload["index"], payload["shard"]))
-        tracker = getattr(shard, "checkpoints", None) if shard else None
-        delta = []
-        if shard is not None:
-            shard.refresh()
-            delta = self._collect_ops(shard,
-                                      above_seqno=payload["local_checkpoint"])
-        if tracker is not None:
-            new_ckpt = max(payload["local_checkpoint"],
-                           *( [op["seq_no"] for op in delta] or [-1] ))
-            tracker.mark_in_sync(src, new_ckpt)
-        return {"ok": True, "ops": delta}
+        with self._lock:  # serialize vs _on_write_primary: no op may land
+            # between the delta snapshot and the in-sync mark
+            shard = self.shards.get((payload["index"], payload["shard"]))
+            tracker = getattr(shard, "checkpoints", None) if shard else None
+            delta = []
+            if shard is not None:
+                shard.refresh()
+                delta = self._collect_ops(
+                    shard, above_seqno=payload["local_checkpoint"])
+            if tracker is not None:
+                # credit only what the target confirmed; the delta is
+                # applied after this RPC returns and the next write ack
+                # advances the checkpoint
+                tracker.mark_in_sync(src, payload["local_checkpoint"])
+            return {"ok": True, "ops": delta}
 
     def _report_started(self, index: str, sid: int) -> None:
         try:
@@ -481,6 +532,10 @@ class ClusterNode:
     # ------------------------------------------------------------------
 
     def _on_write_primary(self, payload, src) -> dict:
+        with self._lock:  # pairs with _on_recovery_finalize serialization
+            return self._write_primary_locked(payload, src)
+
+    def _write_primary_locked(self, payload, src) -> dict:
         index, sid = payload["index"], payload["shard"]
         shard = self.shards.get((index, sid))
         if shard is None or not shard.primary:
@@ -624,6 +679,26 @@ class ClusterClient:
 
     def __init__(self, node: ClusterNode):
         self.node = node
+        # adaptive replica selection: rank copies by observed latency
+        # (node/ResponseCollectorService.java)
+        from elasticsearch_tpu.cluster.response_collector import (
+            ResponseCollectorService,
+        )
+
+        self.response_collector = ResponseCollectorService()
+
+    def _timed_request(self, node_id: str, action: str, payload):
+        self.response_collector.on_send(node_id)
+        t0 = time.monotonic()
+        try:
+            resp = self.node.transport.send_request(node_id, action, payload)
+            # record SUCCESSFUL responses only: a dead node's instant
+            # connection error must not earn it the best rank
+            self.response_collector.add_response_time(
+                node_id, time.monotonic() - t0)
+            return resp
+        finally:
+            self.response_collector.on_complete(node_id)
 
     def _routing_entry(self, index: str, doc_id: str,
                        routing: Optional[str]) -> Tuple[int, str]:
@@ -665,10 +740,12 @@ class ClusterClient:
         if prefer_replica:
             copies.sort(key=lambda c: c.primary)
         else:
-            copies.sort(key=lambda c: not c.primary)
+            # adaptive replica selection: best-ranked copy first, primary
+            # breaking ties
+            copies = self.response_collector.order_copies(copies)
         for copy in copies:
             try:
-                return self.node.transport.send_request(copy.node_id, ACTION_GET, {
+                return self._timed_request(copy.node_id, ACTION_GET, {
                     "index": index, "shard": sid, "id": doc_id,
                 })
             except NodeNotConnectedException:
@@ -701,12 +778,14 @@ class ClusterClient:
         failures = []
         for sid, copies in sorted(self.node.routing.get(index, {}).items()):
             started = [c for c in copies if c.state == ShardRoutingState.STARTED]
-            started.sort(key=lambda c: not c.primary)
+            # adaptive replica selection orders copies; failover walks the
+            # ranked list
+            started = self.response_collector.order_copies(started)
             shard_count += 1
             resp = None
-            for copy in started:  # adaptive copy selection: fail over
+            for copy in started:
                 try:
-                    resp = self.node.transport.send_request(
+                    resp = self._timed_request(
                         copy.node_id, ACTION_QUERY,
                         {"index": index, "shard": sid, "body": body, "k": max(k, 1)},
                     )

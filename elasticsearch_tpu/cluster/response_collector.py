@@ -1,0 +1,59 @@
+"""Adaptive replica selection: rank shard copies by observed performance.
+
+Role model: ``ResponseCollectorService`` (reference:
+core/src/main/java/org/elasticsearch/node/ResponseCollectorService.java) —
+the coordinator keeps an EWMA of each node's response time (and queue
+size) and ranks copies so reads route to the historically fastest copy
+instead of always primary-first (the C3 algorithm, simplified here to the
+latency term: queue sizes don't exist in the in-process transport).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+ALPHA = 0.3  # EWMA smoothing (reference: QueueResizingEsThreadPoolExecutor)
+
+
+class ResponseCollectorService:
+    def __init__(self):
+        self._ewma: Dict[str, float] = {}
+        self._outstanding: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add_response_time(self, node_id: str, seconds: float) -> None:
+        with self._lock:
+            prev = self._ewma.get(node_id)
+            self._ewma[node_id] = (seconds if prev is None
+                                   else ALPHA * seconds + (1 - ALPHA) * prev)
+
+    def on_send(self, node_id: str) -> None:
+        with self._lock:
+            self._outstanding[node_id] = self._outstanding.get(node_id, 0) + 1
+
+    def on_complete(self, node_id: str) -> None:
+        with self._lock:
+            n = self._outstanding.get(node_id, 1)
+            self._outstanding[node_id] = max(0, n - 1)
+
+    def rank(self, node_id: str) -> float:
+        """Lower is better. Unknown nodes rank best so they get probed
+        (the reference seeds unknown nodes optimistically)."""
+        with self._lock:
+            ewma = self._ewma.get(node_id, 0.0)
+            return ewma * (1.0 + self._outstanding.get(node_id, 0))
+
+    def stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: {"avg_response_time_ns": int(v * 1e9),
+                        "outstanding": self._outstanding.get(n, 0)}
+                    for n, v in self._ewma.items()}
+
+    def order_copies(self, copies: List, tiebreak_primary_first: bool = True) -> List:
+        """Order shard copies by rank; ties keep primary first (stable)."""
+        return sorted(copies, key=lambda c: (
+            self.rank(c.node_id),
+            (not c.primary) if tiebreak_primary_first else 0,
+            c.node_id,
+        ))

@@ -320,12 +320,14 @@ def _get_source(node, req):
 
 
 def _delete_doc(node, req):
+    _typed_api_warning(req)
     r = node.delete_doc(req.param("index"), req.param("id"),
                         routing=req.param("routing"), refresh=req.param("refresh"))
     return (200 if r.get("found") else 404), r
 
 
 def _update_doc(node, req):
+    _typed_api_warning(req)
     r = node.update_doc(req.param("index"), req.param("id"), req.json_body({}),
                         routing=req.param("routing"), refresh=req.param("refresh"))
     return 200, r
@@ -502,6 +504,7 @@ def _render_template(node, req):
 
 
 def _termvectors(node, req):
+    _typed_api_warning(req)
     body = req.json_body({}) or {}
     fields = body.get("fields") or (
         req.param("fields").split(",") if req.param("fields") else None

@@ -1,0 +1,167 @@
+"""Allocation deciders (disk watermarks, awareness) + adaptive replica
+selection.
+
+Mirrors DiskThresholdDecider/DiskThresholdMonitor, the
+AwarenessAllocationDecider (cluster/routing/allocation/decider/) and
+ResponseCollectorService (node/ResponseCollectorService.java).
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import allocate
+from elasticsearch_tpu.cluster.multinode import ClusterClient, ClusterNode
+from elasticsearch_tpu.cluster.response_collector import ResponseCollectorService
+from elasticsearch_tpu.cluster.state import IndexMetadata, ShardRoutingState
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.transport.local import TransportHub
+
+
+def meta(shards=2, replicas=1):
+    return IndexMetadata("idx", Settings({
+        "index.number_of_shards": shards,
+        "index.number_of_replicas": replicas}), {})
+
+
+def nodes_of(table):
+    return {c.node_id for shards in table.values()
+            for copies in shards.values() for c in copies}
+
+
+class TestDiskThreshold:
+    def test_low_watermark_blocks_new_allocations(self):
+        info = {"n1": {"attrs": {}, "disk": 0.2},
+                "n2": {"attrs": {}, "disk": 0.88}}  # over low watermark
+        table = allocate({"idx": meta(shards=4, replicas=0)}, ["n1", "n2"],
+                         node_info=info)
+        assert nodes_of(table) == {"n1"}
+
+    def test_no_eligible_node_leaves_unassigned(self):
+        info = {"n1": {"attrs": {}, "disk": 0.95}}
+        table = allocate({"idx": meta(shards=1, replicas=0)}, ["n1"],
+                         node_info=info)
+        assert table["idx"][0] == []  # unassigned (red) rather than on a
+        # node past the watermark
+
+    def test_high_watermark_moves_replicas_off(self):
+        info = {"n1": {"attrs": {}, "disk": 0.1},
+                "n2": {"attrs": {}, "disk": 0.1},
+                "n3": {"attrs": {}, "disk": 0.1}}
+        table = allocate({"idx": meta(shards=1, replicas=1)},
+                         ["n1", "n2", "n3"], node_info=info)
+        replica = next(c for c in table["idx"][0] if not c.primary)
+        orig_replica_node = replica.node_id
+        orig_primary_node = next(
+            c for c in table["idx"][0] if c.primary).node_id
+        # the replica's node fills up past the high watermark
+        info[orig_replica_node]["disk"] = 0.95
+        table2 = allocate({"idx": meta(shards=1, replicas=1)},
+                          ["n1", "n2", "n3"], previous=table, node_info=info)
+        new_replica = next(c for c in table2["idx"][0] if not c.primary)
+        assert new_replica.node_id != orig_replica_node
+        # the primary stays put (only replicas relocate on high watermark)
+        primary = next(c for c in table2["idx"][0] if c.primary)
+        assert primary.node_id == orig_primary_node
+
+
+class TestDiskThresholdNoTarget:
+    def test_replica_kept_when_no_eligible_target(self):
+        # a healthy replica is never discarded without a replacement
+        info = {"n1": {"attrs": {}, "disk": 0.1},
+                "n2": {"attrs": {}, "disk": 0.1}}
+        table = allocate({"idx": meta(shards=1, replicas=1)}, ["n1", "n2"],
+                         node_info=info)
+        replica = next(c for c in table["idx"][0] if not c.primary)
+        info[replica.node_id]["disk"] = 0.95  # over high, nowhere to go
+        table2 = allocate({"idx": meta(shards=1, replicas=1)}, ["n1", "n2"],
+                          previous=table, node_info=info)
+        survivors = [c for c in table2["idx"][0] if not c.primary]
+        assert len(survivors) == 1
+        assert survivors[0].node_id == replica.node_id
+
+
+class TestAwareness:
+    def test_copies_spread_across_zones(self):
+        info = {
+            "a1": {"attrs": {"zone": "a"}, "disk": 0.0},
+            "a2": {"attrs": {"zone": "a"}, "disk": 0.0},
+            "b1": {"attrs": {"zone": "b"}, "disk": 0.0},
+            "b2": {"attrs": {"zone": "b"}, "disk": 0.0},
+        }
+        table = allocate({"idx": meta(shards=4, replicas=1)},
+                         list(info), node_info=info,
+                         awareness_attributes=["zone"])
+        for sid, copies in table["idx"].items():
+            zones = {info[c.node_id]["attrs"]["zone"] for c in copies}
+            assert zones == {"a", "b"}, f"shard {sid} not zone-spread"
+
+    def test_awareness_in_cluster(self):
+        hub = TransportHub(strict_serialization=True)
+        nodes = [
+            ClusterNode("za-1", hub, attrs={"zone": "a"},
+                        awareness_attributes=["zone"]),
+            ClusterNode("za-2", hub, attrs={"zone": "a"}),
+            ClusterNode("zb-1", hub, attrs={"zone": "b"}),
+        ]
+        nodes[0].bootstrap_cluster()
+        for n in nodes[1:]:
+            n.join("za-1")
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 2,
+                                                "number_of_replicas": 1}})
+        for sid, copies in nodes[0].routing["idx"].items():
+            zones = {nodes[0].node_info_map[c.node_id]["attrs"]["zone"]
+                     for c in copies}
+            assert zones == {"a", "b"}
+        for n in nodes:
+            n.close()
+
+
+class TestAdaptiveReplicaSelection:
+    def test_collector_ranks_by_ewma(self):
+        rc = ResponseCollectorService()
+        rc.add_response_time("fast", 0.001)
+        rc.add_response_time("slow", 0.5)
+        assert rc.rank("fast") < rc.rank("slow")
+        assert rc.rank("unknown") == 0.0  # unknown nodes get probed
+        # EWMA adapts: slow node speeds up
+        for _ in range(30):
+            rc.add_response_time("slow", 0.0001)
+        assert rc.rank("slow") < 0.01
+
+    def test_order_copies_prefers_faster_node(self):
+        from elasticsearch_tpu.cluster.state import ShardRouting
+
+        rc = ResponseCollectorService()
+        rc.add_response_time("n1", 0.5)
+        rc.add_response_time("n2", 0.001)
+        copies = [
+            ShardRouting("i", 0, "n1", True, ShardRoutingState.STARTED),
+            ShardRouting("i", 0, "n2", False, ShardRoutingState.STARTED),
+        ]
+        ordered = rc.order_copies(copies)
+        assert ordered[0].node_id == "n2"  # replica preferred: faster
+
+    def test_search_routes_away_from_slow_copy(self):
+        hub = TransportHub(strict_serialization=True)
+        nodes = [ClusterNode(f"n{i}", hub) for i in range(2)]
+        nodes[0].bootstrap_cluster()
+        nodes[1].join("n0")
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 1}})
+        client = ClusterClient(nodes[0])
+        client.index("idx", "1", {"a": 1})
+        client.refresh("idx")
+        # seed the collector: the primary's node is slow
+        primary_node = next(n.node_id for n in nodes
+                            if n.shards.get(("idx", 0)) is not None
+                            and n.shards[("idx", 0)].primary)
+        other = next(n.node_id for n in nodes if n.node_id != primary_node)
+        client.response_collector.add_response_time(primary_node, 1.0)
+        client.response_collector.add_response_time(other, 0.001)
+        hub.requests_log.clear()
+        r = client.search("idx", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 1
+        query_targets = [dst for (src, dst, action) in hub.requests_log
+                         if "search" in action]
+        assert query_targets == [other]
+        for n in nodes:
+            n.close()
