@@ -166,7 +166,26 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
                 load[node] = load.get(node, 0) + 1
     if hot:
         _relocate_hot_replicas(table, alive, load, node_info,
-                               awareness_attributes, watermark_low, hot)
+                               awareness_attributes, watermark_low, hot,
+                               indices_meta)
+    # cancel surplus relocation targets whose reason went away (the hot
+    # source cooled down before the replacement finished)
+    for name, md in indices_meta.items():
+        if md.state != "open":
+            continue
+        desired = 1 + md.num_replicas
+        for copies in table[name].values():
+            if len(copies) <= desired:
+                continue
+            awaiting = any(c.node_id in hot and not c.primary for c in copies)
+            if awaiting:
+                continue  # relocation in progress: keep source + target
+            for c in list(copies):
+                if len(copies) <= desired:
+                    break
+                if not c.primary and c.state == ShardRoutingState.INITIALIZING:
+                    copies.remove(c)
+                    load[c.node_id] = load.get(c.node_id, 1) - 1
     _rebalance_replicas(table, alive, load, node_info, awareness_attributes,
                         watermark_low)
     return table
@@ -174,13 +193,28 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
 
 def _relocate_hot_replicas(table: RoutingTable, alive: set,
                            load: Dict[str, int], node_info, awareness,
-                           watermark_low: float, hot: set) -> None:
+                           watermark_low: float, hot: set,
+                           indices_meta: Dict) -> None:
     """Move replicas off high-watermark nodes when (and only when) a
-    target under the low watermark exists; a moved copy restarts as
-    INITIALIZING (relocation = recovery onto the target)."""
-    for shards in table.values():
+    target under the low watermark exists. A STARTED (data-bearing) source
+    stays until its replacement has started — relocation keeps both copies
+    live like the reference's RELOCATING state; only empty INITIALIZING
+    copies move directly."""
+    for index, shards in table.items():
+        desired_replicas = indices_meta[index].num_replicas
         for copies in shards.values():
-            for copy in copies:
+            # phase 1: a replacement started — retire the hot source
+            healthy_started = [c for c in copies
+                               if not c.primary
+                               and c.state == ShardRoutingState.STARTED
+                               and c.node_id not in hot]
+            for c in list(copies):
+                if (not c.primary and c.node_id in hot
+                        and len(healthy_started) >= desired_replicas):
+                    copies.remove(c)
+                    load[c.node_id] = load.get(c.node_id, 1) - 1
+            # phase 2: spawn replacements / move empty copies
+            for copy in list(copies):
                 if copy.primary or copy.node_id not in hot:
                     continue
                 used = {c.node_id for c in copies if c is not copy}
@@ -188,11 +222,20 @@ def _relocate_hot_replicas(table: RoutingTable, alive: set,
                 target = _pick_node(candidates, load,
                                     [c for c in copies if c is not copy],
                                     node_info, awareness, watermark_low)
-                if target is not None and target != copy.node_id:
+                if target is None or target == copy.node_id:
+                    continue
+                if copy.state == ShardRoutingState.INITIALIZING:
+                    # empty copy: move it outright
                     load[copy.node_id] = load.get(copy.node_id, 1) - 1
                     load[target] = load.get(target, 0) + 1
                     copy.node_id = target
-                    copy.state = ShardRoutingState.INITIALIZING
+                else:
+                    # data-bearing copy: add the target alongside; the
+                    # source retires on a later reroute once it starts
+                    copies.append(ShardRouting(
+                        copy.index, copy.shard_id, target, False,
+                        ShardRoutingState.INITIALIZING))
+                    load[target] = load.get(target, 0) + 1
 
 
 def _rebalance_replicas(table: RoutingTable, alive: set,

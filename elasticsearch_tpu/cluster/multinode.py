@@ -98,6 +98,11 @@ class ClusterNode:
         self.shards: Dict[Tuple[str, int], IndexShard] = {}
         self.mappers: Dict[str, MapperService] = {}
         self._lock = threading.RLock()
+        # serializes primary writes vs recovery finalize ONLY — a separate
+        # lock so it never participates in the node-lock ordering of
+        # publish/apply-state paths (cross-node deadlock avoidance: while
+        # held, the only outbound calls are lock-free replica writes)
+        self._replication_lock = threading.RLock()
         self._register_handlers()
 
     # ------------------------------------------------------------------
@@ -472,8 +477,8 @@ class ClusterNode:
         markAllocationIdAsInSync). From in-sync on, the write fan-out
         covers the copy even before the master publishes STARTED, so no
         op can fall into the finalize->STARTED window."""
-        with self._lock:  # serialize vs _on_write_primary: no op may land
-            # between the delta snapshot and the in-sync mark
+        with self._replication_lock:  # serialize vs _on_write_primary: no
+            # op may land between the delta snapshot and the in-sync mark
             shard = self.shards.get((payload["index"], payload["shard"]))
             tracker = getattr(shard, "checkpoints", None) if shard else None
             delta = []
@@ -532,8 +537,19 @@ class ClusterNode:
     # ------------------------------------------------------------------
 
     def _on_write_primary(self, payload, src) -> dict:
-        with self._lock:  # pairs with _on_recovery_finalize serialization
-            return self._write_primary_locked(payload, src)
+        with self._replication_lock:  # pairs with _on_recovery_finalize
+            result, failed_copies = self._write_primary_locked(payload, src)
+        # report failed copies OUTSIDE the lock: the master's publish can
+        # re-enter other nodes' locks and must not nest under ours
+        for node_id in failed_copies:
+            try:
+                self.transport.send_request(self.master_id, ACTION_SHARD_FAILED, {
+                    "index": payload["index"], "shard": payload["shard"],
+                    "node": node_id,
+                })
+            except NodeNotConnectedException:
+                pass
+        return result
 
     def _write_primary_locked(self, payload, src) -> dict:
         index, sid = payload["index"], payload["shard"]
@@ -569,6 +585,7 @@ class ClusterNode:
         replica_payload["global_checkpoint"] = (
             tracker.global_checkpoint if tracker is not None else -1)
         acks = 1
+        failed_copies = []
         for copy in self.routing.get(index, {}).get(sid, []):
             if copy.primary:
                 continue
@@ -587,21 +604,16 @@ class ClusterNode:
                     tracker.update_local_checkpoint(
                         copy.node_id, ack.get("local_checkpoint", -1))
             except (NodeNotConnectedException, ElasticsearchTpuException):
-                # fail the copy on the master and continue (§5.3); the
-                # in-sync set shrinks so the global checkpoint advances
+                # shrink the in-sync set now; the master report happens
+                # outside the replication lock (§5.3)
                 if tracker is not None:
                     tracker.remove(copy.node_id)
-                try:
-                    self.transport.send_request(self.master_id, ACTION_SHARD_FAILED, {
-                        "index": index, "shard": sid, "node": copy.node_id,
-                    })
-                except NodeNotConnectedException:
-                    pass
+                failed_copies.append(copy.node_id)
         if tracker is not None:
             shard.engine.global_checkpoint = tracker.global_checkpoint
         result["_shards"] = {"total": len(self.routing.get(index, {}).get(sid, [])),
                              "successful": acks, "failed": 0}
-        return result
+        return result, failed_copies
 
     def _on_write_replica(self, payload, src) -> dict:
         shard = self.shards.get((payload["index"], payload["shard"]))

@@ -359,6 +359,16 @@ PROCESSORS = {
     "grok": _p_grok,
 }
 
+# geoip + user_agent ship as plugins in the reference (ingest-geoip,
+# ingest-user-agent); registered here as always-available processors
+from elasticsearch_tpu.ingest.geo_ua import (  # noqa: E402
+    geoip_processor,
+    user_agent_processor,
+)
+
+PROCESSORS["geoip"] = geoip_processor
+PROCESSORS["user_agent"] = user_agent_processor
+
 
 class Pipeline:
     def __init__(self, pipeline_id: str, body: dict):

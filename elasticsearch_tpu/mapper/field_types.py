@@ -711,16 +711,56 @@ class CompletionFieldType(FieldType):
     type_name = "completion"
     ordinal_doc_values = True
 
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        # context mappings (search/suggest/completion/context/*):
+        # [{"name": ..., "type": "category"|"geo", "precision": int}]
+        self.contexts = {c["name"]: c for c in self.params.get("contexts", [])}
+
     def parse_completion(self, value):
-        """-> (inputs: [str], weight: float)."""
+        """-> (inputs: [str], weight: float, contexts: {name: [str]}).
+        Geo context values encode to geohashes (the reference's
+        GeoContextMapping prefix encoding)."""
         if isinstance(value, str):
-            return [value], 1.0
+            return [value], 1.0, {}
         if isinstance(value, list):
-            return [str(v) for v in value], 1.0
+            return [str(v) for v in value], 1.0, {}
         if isinstance(value, dict):
             inputs = value.get("input", [])
             inputs = [inputs] if isinstance(inputs, str) else [str(v) for v in inputs]
-            return inputs, float(value.get("weight", 1.0))
+            ctx_out = {}
+            for cname, cvals in (value.get("contexts") or {}).items():
+                cdef = self.contexts.get(cname)
+                if cdef is None:
+                    raise MapperParsingException(
+                        f"context [{cname}] is not defined on completion "
+                        f"field [{self.name}]")
+                if not isinstance(cvals, list):
+                    cvals = [cvals]
+                if cdef.get("type", "category") == "geo":
+                    from elasticsearch_tpu.utils.geohash import encode
+
+                    encoded = []
+                    for p in cvals:
+                        try:
+                            if isinstance(p, dict):
+                                encoded.append(
+                                    encode(float(p["lat"]), float(p["lon"]), 12))
+                            elif isinstance(p, str) and "," in p:
+                                lat, lon = p.split(",", 1)
+                                encoded.append(
+                                    encode(float(lat), float(lon), 12))
+                            else:  # raw geohash
+                                encoded.append(str(p))
+                        except (KeyError, TypeError, ValueError) as e:
+                            raise MapperParsingException(
+                                f"failed to parse geo context [{cname}] of "
+                                f"completion field [{self.name}]: {p!r}"
+                            ) from e
+                    ctx_out[cname] = encoded
+                else:
+                    ctx_out[cname] = [str(c) for c in cvals]
+            return inputs, float(value.get("weight", 1.0)), ctx_out
         raise MapperParsingException(
             f"failed to parse completion field [{self.name}] value [{value!r}]"
         )

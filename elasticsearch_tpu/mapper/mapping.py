@@ -72,6 +72,14 @@ class DocumentMapper:
         self._object_paths: set = set()
         # nested object paths ("type": "nested") -> their mapping params
         self.nested_paths: Dict[str, dict] = {}
+        # _size metadata field (plugins/mapper-size SizeFieldMapper):
+        # {"_size": {"enabled": true}} indexes the source's byte size as a
+        # queryable/aggregatable/sortable numeric field
+        self.size_enabled = bool((mapping.get("_size") or {}).get("enabled"))
+        if self.size_enabled:
+            from elasticsearch_tpu.mapper.field_types import LongFieldType
+
+            self.fields["_size"] = LongFieldType("_size", {})
         self._compile("", mapping.get("properties", {}))
         if len(self.fields) > total_fields_limit:
             raise IllegalArgumentException(
@@ -118,6 +126,11 @@ class DocumentMapper:
                            new_props, dynamic)
         if new_props:
             out.mapping_update = {"properties": new_props}
+        if self.size_enabled:
+            import json as _json
+
+            out.numeric_values["_size"] = [float(len(
+                _json.dumps(source, separators=(",", ":"), default=str)))]
         out.field_names = sorted(
             set(out.terms) | set(out.numeric_values) | set(out.string_values)
             | set(out.geo_values) | set(out.range_values)
@@ -310,9 +323,12 @@ class DocumentMapper:
             )
             return
         if isinstance(ft, CompletionFieldType):
-            inputs, weight = ft.parse_completion(v)
+            inputs, weight, ctxs = ft.parse_completion(v)
             out.string_values.setdefault(ft.name, []).extend(inputs)
             out.numeric_values.setdefault(f"{ft.name}#weight", []).append(weight)
+            for cname, cvals in ctxs.items():
+                out.string_values.setdefault(
+                    f"{ft.name}#ctx.{cname}", []).extend(cvals)
             return
         if ft.index:
             terms = ft.index_terms(v, self.analyzers)
@@ -384,7 +400,8 @@ class MapperService:
             copy.deepcopy(new_mapping.get("properties", {})),
             "",
         )
-        for meta_key in ("dynamic", "_source", "_routing", "date_detection"):
+        for meta_key in ("dynamic", "_source", "_routing", "date_detection",
+                         "_size"):
             if meta_key in new_mapping:
                 merged[meta_key] = new_mapping[meta_key]
         # recompile validates the merged tree

@@ -65,13 +65,43 @@ def term_suggest(segments, field: str, text: str, analyzer,
     return out
 
 
+def _field_bigram_counts(segments, field: str) -> Dict[Tuple[str, str], int]:
+    """Consecutive-token pair counts over the field, reconstructed from
+    the host-side position lists (the shingle-field analog the reference's
+    phrase suggester reads its bigram stats from). Cached per segment."""
+    out: Dict[Tuple[str, str], int] = {}
+    for seg in segments:
+        cached = seg.dev_cache.get(f"bigrams.{field}")
+        if cached is None:
+            # doc -> {position: token}
+            per_doc: Dict[int, Dict[int, str]] = {}
+            for term, tid in seg.terms_for_field(field):
+                for doc, positions in seg.positions.get(tid, {}).items():
+                    slots = per_doc.setdefault(doc, {})
+                    for p in positions:
+                        slots[p] = term
+            cached = {}
+            for slots in per_doc.values():
+                for p, tok in slots.items():
+                    nxt = slots.get(p + 1)
+                    if nxt is not None:
+                        key = (tok, nxt)
+                        cached[key] = cached.get(key, 0) + 1
+            seg.dev_cache[f"bigrams.{field}"] = cached
+        for key, n in cached.items():
+            out[key] = out.get(key, 0) + n
+    return out
+
+
 def phrase_suggest(segments, field: str, text: str, analyzer,
                    size: int = 5, max_errors: float = 1.0) -> List[dict]:
     """Whole-phrase correction: per-token candidates (incl. the token
-    itself), best combinations scored by a unigram LM over the corpus
-    (the reference defaults to a bigram LM; unigram is the documented
-    round-1 model)."""
+    itself), best combinations scored by a bigram language model with
+    Stupid Backoff smoothing (the reference phrase suggester's default
+    model — search/suggest/phrase/StupidBackoffScorer.java, discount
+    0.4)."""
     freqs = _field_term_freqs(segments, field)
+    bigrams = _field_bigram_counts(segments, field)
     total = sum(freqs.values()) or 1
     tokens = [t for t, _, _ in analyzer.analyze_tokens(text)]
     if not tokens:
@@ -89,17 +119,29 @@ def phrase_suggest(segments, field: str, text: str, analyzer,
         cands.sort(key=lambda cf: -cf[1])
         per_token.append(cands[:4])
 
+    DISCOUNT = 0.4  # Stupid Backoff alpha
+
+    def transition_p(prev: Optional[str], word: str, unigram_p: float) -> float:
+        if prev is None:
+            return unigram_p
+        bi = bigrams.get((prev, word), 0)
+        if bi > 0 and freqs.get(prev):
+            return bi / freqs[prev]
+        return DISCOUNT * unigram_p
+
     # beam over combinations, bounded error count
     max_err = int(max_errors) if max_errors >= 1 else max(1, int(max_errors * len(tokens)))
     beams: List[Tuple[float, List[str], int]] = [(1.0, [], 0)]
     for i, cands in enumerate(per_token):
         nxt = []
         for score, words, errs in beams:
+            prev = words[-1] if words else None
             for cand, p in cands:
                 e = errs + (cand != tokens[i])
                 if e > max_err:
                     continue
-                nxt.append((score * p, words + [cand], e))
+                nxt.append((score * transition_p(prev, cand, p),
+                            words + [cand], e))
         nxt.sort(key=lambda b: -b[0])
         beams = nxt[:16]
     options = []
@@ -120,13 +162,81 @@ def phrase_suggest(segments, field: str, text: str, analyzer,
     }]
 
 
+def _doc_context_values(seg, field: str, cname: str, local: int) -> List[str]:
+    ccol = seg.ordinal_columns.get(f"{field}#ctx.{cname}")
+    if ccol is None or not ccol.exists[local]:
+        return []
+    sel = ccol.flat_docs[: ccol.count] == local
+    return [ccol.terms[o] for o in ccol.flat_ords[: ccol.count][sel]]
+
+
+def _context_boost(seg, field: str, local: int, contexts: dict,
+                   ctx_defs: dict) -> Optional[float]:
+    """None = filtered out; otherwise the multiplicative boost
+    (ContextMappings.ContextQuery: docs must match at least one value per
+    queried context; boosts multiply the suggestion weight)."""
+    total_boost = 1.0
+    for cname, wanted in contexts.items():
+        cdef = ctx_defs.get(cname)
+        if cdef is None:
+            raise ParsingException(
+                f"Unknown context name [{cname}], must be one of "
+                f"{sorted(ctx_defs)}")
+        have = _doc_context_values(seg, field, cname, local)
+        if not isinstance(wanted, list):
+            wanted = [wanted]
+        is_geo = cdef.get("type", "category") == "geo"
+        best = None
+        for w in wanted:
+            if is_geo:
+                from elasticsearch_tpu.utils.geohash import encode
+
+                boost = 1.0
+                precision = int(cdef.get("precision", 6))
+                if isinstance(w, dict):
+                    pt = w.get("context") or w
+                    precision = int(w.get("precision", precision))
+                    boost = float(w.get("boost", 1.0))
+                else:
+                    pt = w
+                if isinstance(pt, dict):
+                    want_prefix = encode(float(pt["lat"]), float(pt["lon"]),
+                                         precision)
+                elif isinstance(pt, str) and "," in pt:
+                    lat, lon = pt.split(",", 1)
+                    want_prefix = encode(float(lat), float(lon), precision)
+                else:
+                    want_prefix = str(pt)  # raw geohash prefix
+                if any(h.startswith(want_prefix) for h in have):
+                    best = max(best or 0.0, boost)
+            else:
+                if isinstance(w, dict):
+                    if "context" not in w:
+                        raise ParsingException(
+                            f"context query for [{cname}] requires [context]")
+                    value = str(w["context"])
+                    boost = float(w.get("boost", 1.0))
+                else:
+                    value, boost = str(w), 1.0
+                if value in have:
+                    best = max(best or 0.0, boost)
+        if best is None:
+            return None
+        total_boost *= best
+    return total_boost
+
+
 def completion_suggest(segments, field: str, prefix: str, size: int = 5,
-                       skip_duplicates: bool = False) -> List[dict]:
+                       skip_duplicates: bool = False,
+                       contexts: Optional[dict] = None,
+                       ctx_defs: Optional[dict] = None) -> List[dict]:
     """Prefix completion over indexed completion inputs.
 
     Inputs are stored as the field's ordinal column (sorted — the FST
-    analog); weights come from a parallel '<field>#weight' numeric column
-    when present."""
+    analog); weights come from a parallel '<field>#weight' numeric column;
+    context values (category or geohash-encoded geo) live in parallel
+    '<field>#ctx.<name>' columns (the reference's ContextMappings encode
+    contexts into the FST paths — search/suggest/completion/context/)."""
     options = []
     seen = set()
     for seg in segments:
@@ -146,6 +256,12 @@ def completion_suggest(segments, field: str, prefix: str, size: int = 5,
                 weight = 1.0
                 if wcol is not None and wcol.exists[local]:
                     weight = float(wcol.first_value[local])
+                if contexts:
+                    boost = _context_boost(seg, field, int(local), contexts,
+                                           ctx_defs or {})
+                    if boost is None:
+                        continue
+                    weight *= boost
                 if skip_duplicates and term in seen:
                     continue
                 seen.add(term)
@@ -202,10 +318,13 @@ def run_suggest(suggest_body: dict, shards, mapper_service) -> dict:
             )
         elif "completion" in spec:
             cfg = spec["completion"]
+            ft = mapper_service.field_type(cfg["field"])
             out[name] = completion_suggest(
                 segments, cfg["field"], text,
                 size=int(cfg.get("size", 5)),
                 skip_duplicates=bool(cfg.get("skip_duplicates", False)),
+                contexts=cfg.get("contexts"),
+                ctx_defs=getattr(ft, "contexts", None) or {},
             )
         else:
             raise ParsingException(

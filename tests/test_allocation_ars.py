@@ -42,6 +42,33 @@ class TestDiskThreshold:
         assert table["idx"][0] == []  # unassigned (red) rather than on a
         # node past the watermark
 
+    def test_started_replica_kept_until_replacement_starts(self):
+        from elasticsearch_tpu.cluster.state import ShardRoutingState
+
+        info = {f"n{i}": {"attrs": {}, "disk": 0.1} for i in range(1, 4)}
+        table = allocate({"idx": meta(shards=1, replicas=1)},
+                         ["n1", "n2", "n3"], node_info=info)
+        for c in table["idx"][0]:
+            c.state = ShardRoutingState.STARTED
+        replica_node = next(c.node_id for c in table["idx"][0] if not c.primary)
+        info[replica_node]["disk"] = 0.95
+        t2 = allocate({"idx": meta(shards=1, replicas=1)},
+                      ["n1", "n2", "n3"], previous=table, node_info=info)
+        replicas = [c for c in t2["idx"][0] if not c.primary]
+        # source retained (STARTED) + replacement (INITIALIZING) coexist
+        assert len(replicas) == 2
+        states = {c.node_id: c.state for c in replicas}
+        assert states[replica_node] == ShardRoutingState.STARTED
+        target = next(n for n in states if n != replica_node)
+        assert states[target] == ShardRoutingState.INITIALIZING
+        # replacement starts -> hot source retires on the next reroute
+        for c in t2["idx"][0]:
+            c.state = ShardRoutingState.STARTED
+        t3 = allocate({"idx": meta(shards=1, replicas=1)},
+                      ["n1", "n2", "n3"], previous=t2, node_info=info)
+        replicas3 = [c for c in t3["idx"][0] if not c.primary]
+        assert [c.node_id for c in replicas3] == [target]
+
     def test_high_watermark_moves_replicas_off(self):
         info = {"n1": {"attrs": {}, "disk": 0.1},
                 "n2": {"attrs": {}, "disk": 0.1},

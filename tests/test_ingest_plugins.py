@@ -1,0 +1,218 @@
+"""geoip + user_agent ingest processors, the _size metadata field,
+bigram phrase suggester, and completion suggester contexts.
+
+Mirrors plugins/ingest-geoip, plugins/ingest-user-agent,
+plugins/mapper-size, the phrase suggester's StupidBackoff bigram model
+(search/suggest/phrase/), and completion contexts
+(search/suggest/completion/context/).
+"""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    MapperParsingException,
+    ParsingException,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.node import Node
+
+CHROME_UA = ("Mozilla/5.0 (Windows NT 10.0; Win64; x64) AppleWebKit/537.36 "
+             "(KHTML, like Gecko) Chrome/70.0.3538.77 Safari/537.36")
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    yield n
+    n.close()
+
+
+class TestGeoIp:
+    def test_lookup_and_properties(self, node):
+        node.ingest.put_pipeline("geo", {"processors": [
+            {"geoip": {"field": "ip"}}]})
+        node.index_doc("logs", "1", {"ip": "8.8.8.8"}, pipeline="geo")
+        src = node.get_doc("logs", "1")["_source"]
+        assert src["geoip"]["country_iso_code"] == "US"
+        assert src["geoip"]["city_name"] == "Mountain View"
+        assert src["geoip"]["location"] == {"lat": 37.386, "lon": -122.0838}
+
+    def test_target_field_and_selected_properties(self, node):
+        node.ingest.put_pipeline("geo", {"processors": [
+            {"geoip": {"field": "ip", "target_field": "geo",
+                       "properties": ["country_iso_code"]}}]})
+        node.index_doc("logs", "1", {"ip": "81.2.69.145"}, pipeline="geo")
+        src = node.get_doc("logs", "1")["_source"]
+        assert src["geo"] == {"country_iso_code": "GB"}
+
+    def test_unresolvable_ip_adds_nothing(self, node):
+        node.ingest.put_pipeline("geo", {"processors": [
+            {"geoip": {"field": "ip"}}]})
+        node.index_doc("logs", "1", {"ip": "10.0.0.1"}, pipeline="geo")
+        assert "geoip" not in node.get_doc("logs", "1")["_source"]
+
+    def test_ipv6(self, node):
+        node.ingest.put_pipeline("geo", {"processors": [
+            {"geoip": {"field": "ip"}}]})
+        node.index_doc("logs", "1", {"ip": "2001:4860:4860::8888"},
+                       pipeline="geo")
+        src = node.get_doc("logs", "1")["_source"]
+        assert src["geoip"]["country_iso_code"] == "US"
+
+    def test_bad_ip_fails(self, node):
+        node.ingest.put_pipeline("geo", {"processors": [
+            {"geoip": {"field": "ip"}}]})
+        with pytest.raises(Exception):
+            node.index_doc("logs", "1", {"ip": "not-an-ip"}, pipeline="geo")
+
+    def test_missing_field_with_ignore_missing(self, node):
+        node.ingest.put_pipeline("geo", {"processors": [
+            {"geoip": {"field": "ip", "ignore_missing": True}}]})
+        node.index_doc("logs", "1", {"msg": "no ip"}, pipeline="geo")
+        assert node.get_doc("logs", "1")["found"]
+
+
+class TestUserAgent:
+    def test_chrome_on_windows(self, node):
+        node.ingest.put_pipeline("ua", {"processors": [
+            {"user_agent": {"field": "agent"}}]})
+        node.index_doc("logs", "1", {"agent": CHROME_UA}, pipeline="ua")
+        ua = node.get_doc("logs", "1")["_source"]["user_agent"]
+        assert ua["name"] == "Chrome"
+        assert ua["major"] == "70"
+        assert ua["os"]["name"] == "Windows 10"
+
+    def test_curl(self, node):
+        node.ingest.put_pipeline("ua", {"processors": [
+            {"user_agent": {"field": "agent", "target_field": "ua"}}]})
+        node.index_doc("logs", "1", {"agent": "curl/7.54.0"}, pipeline="ua")
+        ua = node.get_doc("logs", "1")["_source"]["ua"]
+        assert ua["name"] == "curl" and ua["version"] == "7.54"
+
+    def test_unknown_agent(self, node):
+        node.ingest.put_pipeline("ua", {"processors": [
+            {"user_agent": {"field": "agent"}}]})
+        node.index_doc("logs", "1", {"agent": "my-bot-thing"}, pipeline="ua")
+        assert node.get_doc("logs", "1")["_source"]["user_agent"]["name"] == "Other"
+
+
+class TestSizeField:
+    def test_size_indexed_and_queryable(self):
+        idx = IndexService("s", Settings({"index.number_of_shards": 1}),
+                           mapping={"_size": {"enabled": True},
+                                    "properties": {"t": {"type": "text"}}})
+        idx.index_doc("small", {"t": "x"})
+        idx.index_doc("big", {"t": "y" * 500})
+        idx.refresh()
+        r = idx.search({"query": {"range": {"_size": {"gt": 100}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["big"]
+        r = idx.search({"query": {"match_all": {}},
+                        "sort": [{"_size": "desc"}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["big", "small"]
+        r = idx.search({"size": 0, "aggs": {"sz": {"max": {"field": "_size"}}}})
+        assert r["aggregations"]["sz"]["value"] > 500
+        idx.close()
+
+    def test_disabled_by_default(self):
+        idx = IndexService("s2", Settings({"index.number_of_shards": 1}))
+        idx.index_doc("1", {"t": "x"})
+        idx.refresh()
+        r = idx.search({"query": {"exists": {"field": "_size"}}})
+        assert r["hits"]["total"] == 0
+        idx.close()
+
+
+class TestPhraseBigram:
+    def test_bigram_ranks_corpus_collocation_first(self):
+        idx = IndexService("p", Settings({"index.number_of_shards": 1}),
+                           mapping={"properties": {
+                               "body": {"type": "text"}}})
+        # "nobel prize" dominates as a bigram; "noble" also exists but
+        # never precedes "prize"
+        for i in range(5):
+            idx.index_doc(f"a{i}", {"body": "nobel prize winners list"})
+        for i in range(8):
+            idx.index_doc(f"b{i}", {"body": "a noble act of kindness"})
+        idx.refresh()
+        r = idx.search({"suggest": {"fix": {
+            "text": "nobl prize",
+            "phrase": {"field": "body"}}}})
+        options = r["suggest"]["fix"][0]["options"]
+        assert options, "expected phrase corrections"
+        # unigram-only scoring would prefer 'noble' (freq 8 > 5); the
+        # bigram model picks the collocation
+        assert options[0]["text"] == "nobel prize"
+        idx.close()
+
+
+class TestCompletionContexts:
+    def make(self):
+        idx = IndexService("c", Settings({"index.number_of_shards": 1}),
+                           mapping={"properties": {"suggest": {
+                               "type": "completion",
+                               "contexts": [
+                                   {"name": "place", "type": "category"},
+                                   {"name": "loc", "type": "geo",
+                                    "precision": 4},
+                               ]}}})
+        idx.index_doc("1", {"suggest": {
+            "input": ["timmy's", "timmy house"], "weight": 10,
+            "contexts": {"place": ["cafe"],
+                         "loc": [{"lat": 43.662, "lon": -79.38}]}}})
+        idx.index_doc("2", {"suggest": {
+            "input": ["timber mart"], "weight": 5,
+            "contexts": {"place": ["shop"],
+                         "loc": [{"lat": 48.85, "lon": 2.35}]}}})
+        idx.refresh()
+        return idx
+
+    def test_category_context_filters(self):
+        idx = self.make()
+        r = idx.search({"suggest": {"s": {
+            "prefix": "tim",
+            "completion": {"field": "suggest",
+                           "contexts": {"place": ["cafe"]}}}}})
+        texts = [o["text"] for o in r["suggest"]["s"][0]["options"]]
+        assert "timmy's" in texts and "timber mart" not in texts
+        idx.close()
+
+    def test_category_boost(self):
+        idx = self.make()
+        r = idx.search({"suggest": {"s": {
+            "prefix": "tim",
+            "completion": {"field": "suggest", "contexts": {"place": [
+                {"context": "shop", "boost": 10},
+                {"context": "cafe"}]}}}}})
+        options = r["suggest"]["s"][0]["options"]
+        # shop weight 5 * boost 10 = 50 beats cafe's 10
+        assert options[0]["text"] == "timber mart"
+        idx.close()
+
+    def test_geo_context(self):
+        idx = self.make()
+        r = idx.search({"suggest": {"s": {
+            "prefix": "tim",
+            "completion": {"field": "suggest", "contexts": {"loc": [
+                {"context": {"lat": 43.66, "lon": -79.39},
+                 "precision": 4}]}}}}})
+        texts = [o["text"] for o in r["suggest"]["s"][0]["options"]]
+        assert texts and all("timmy" in t for t in texts)
+        idx.close()
+
+    def test_unknown_context_rejected(self):
+        idx = self.make()
+        with pytest.raises(ParsingException):
+            idx.search({"suggest": {"s": {
+                "prefix": "tim",
+                "completion": {"field": "suggest",
+                               "contexts": {"nope": ["x"]}}}}})
+        idx.close()
+
+    def test_undefined_context_rejected_at_index_time(self):
+        idx = self.make()
+        with pytest.raises(MapperParsingException):
+            idx.index_doc("bad", {"suggest": {
+                "input": ["x"], "contexts": {"undefined": ["y"]}}})
+        idx.close()
