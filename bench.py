@@ -61,25 +61,15 @@ def build_synthetic_corpus(seed=7):
     total_blocks = int(n_blocks_per_term.sum())
     block_docs = np.full((total_blocks, BLOCK), nd_pad, dtype=np.int32)
     block_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
-    term_block_start = np.zeros(VOCAB, dtype=np.int64)
-    b = 0
-    for t in range(VOCAB):
-        df = int(term_df[t])
-        if df == 0:
-            term_block_start[t] = b
-            continue
-        term_block_start[t] = b
-        seg_docs = docs[term_start[t]: term_end[t]]
-        seg_tfs = tfs[term_start[t]: term_end[t]]
-        nb = int(n_blocks_per_term[t])
-        pad = nb * BLOCK - df
-        block_docs[b: b + nb] = np.concatenate(
-            [seg_docs, np.full(pad, nd_pad, np.int32)]
-        ).reshape(nb, BLOCK)
-        block_tfs[b: b + nb] = np.concatenate(
-            [seg_tfs, np.zeros(pad, np.float32)]
-        ).reshape(nb, BLOCK)
-        b += nb
+    term_block_start = np.concatenate(
+        [[0], np.cumsum(n_blocks_per_term)[:-1]])
+    # vectorized block packing: posting j of term t lands in
+    # (term_block_start[t] + j // BLOCK, j % BLOCK)
+    within = np.arange(len(term_ids), dtype=np.int64) - term_start[term_ids]
+    rows = term_block_start[term_ids] + within // BLOCK
+    lanes = within % BLOCK
+    block_docs[rows, lanes] = docs
+    block_tfs[rows, lanes] = tfs
     norms = np.ones((1, nd_pad + 1), dtype=np.float32)
     norms[0, :N_DOCS] = doc_len.astype(np.float32)
     live1 = np.zeros(nd_pad + 1, dtype=bool)
