@@ -351,12 +351,14 @@ class ClusterNode:
                     from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
 
                     tracker = GlobalCheckpointTracker(self.node_id)
+                    tracker.seed_global_checkpoint(
+                        shard.engine.global_checkpoint)
                     tracker.update_local_checkpoint(
                         self.node_id, shard.engine.local_checkpoint)
                     for other in self.routing.get(index, {}).get(sid, []):
                         if (other.node_id != self.node_id
                                 and other.state == ShardRoutingState.STARTED):
-                            tracker.mark_in_sync(other.node_id, -1)
+                            tracker.mark_in_sync(other.node_id, -1, force=True)
                     shard.checkpoints = tracker
                 elif copy.state == ShardRoutingState.INITIALIZING and not copy.primary:
                     self._recover_replica(index, sid)
@@ -389,42 +391,54 @@ class ClusterNode:
             return  # next reroute retries
         shard = self.shards[(index, sid)]
         for op in resp["ops"]:
-            if op["op"] == "index":
-                shard.engine.index(
-                    op["id"], op["source"], op.get("routing"),
-                    seqno=op["seq_no"], add_to_translog=True,
-                )
-                shard.engine.version_map[op["id"]].version = op["version"]
+            self._apply_replicated_op(shard, op)
         shard.refresh()
         # confirm the replay to the primary (recovery finalize) so it can
         # mark this copy in-sync at a checkpoint we actually hold; the
         # response carries the ops written since the stream snapshot
-        fin = None
-        for _attempt in range(3):  # brief transient faults retry inline
-            try:
-                fin = self.transport.send_request(
-                    primary_node, ACTION_RECOVERY_FINALIZE, {
-                        "index": index, "shard": sid,
-                        "local_checkpoint": shard.engine.local_checkpoint,
-                    })
+        # finalize loop: confirm our checkpoint, apply the returned delta,
+        # repeat until the delta is empty so the primary has seen a
+        # caught-up checkpoint and promotes us out of pending-in-sync
+        # even if no further writes arrive (reference: pendingInSync wait
+        # in markAllocationIdAsInSync)
+        for _round in range(5):
+            fin = None
+            for _attempt in range(3):  # brief transient faults retry inline
+                try:
+                    fin = self.transport.send_request(
+                        primary_node, ACTION_RECOVERY_FINALIZE, {
+                            "index": index, "shard": sid,
+                            "local_checkpoint": shard.engine.local_checkpoint,
+                        })
+                    break
+                except (NodeNotConnectedException, ElasticsearchTpuException):
+                    time.sleep(0.02)
+            if fin is None:
+                return  # primary unreachable: stay INITIALIZING; the next
+                # cluster-state publish or master health check re-runs recovery
+            if not fin.get("ops"):
                 break
-            except (NodeNotConnectedException, ElasticsearchTpuException):
-                time.sleep(0.02)
-        if fin is None:
-            return  # primary unreachable: stay INITIALIZING; the next
-            # cluster-state publish or master health check re-runs recovery
-        for op in fin.get("ops", []):
-            if op["op"] == "delete":
-                shard.engine.delete(op["id"], seqno=op["seq_no"])
-            else:
-                shard.engine.index(
-                    op["id"], op["source"], op.get("routing"),
-                    seqno=op["seq_no"], add_to_translog=True,
-                )
-                shard.engine.version_map[op["id"]].version = op["version"]
-        if fin.get("ops"):
+            # delta ops may race with the live write fan-out (this copy is
+            # already in the primary's replication group); the engine's
+            # seqno staleness guard makes the apply idempotent in either
+            # order
+            for op in fin["ops"]:
+                self._apply_replicated_op(shard, op)
             shard.refresh()
         self._report_started(index, sid)
+
+    @staticmethod
+    def _apply_replicated_op(shard, op: dict) -> None:
+        """Apply one replicated/recovery op (explicit seqno + version from
+        the primary); the engine's seqno staleness guard makes this
+        idempotent under redelivery and reordering."""
+        if op["op"] == "delete":
+            shard.engine.delete(op["id"], seqno=op["seq_no"],
+                                replicated_version=op.get("version"))
+        else:
+            shard.engine.index(op["id"], op["source"], op.get("routing"),
+                               seqno=op["seq_no"],
+                               replicated_version=op.get("version"))
 
     def _on_start_recovery(self, payload, src) -> dict:
         """Primary side: stream live docs as seqno-stamped ops (phase2)."""
@@ -445,10 +459,12 @@ class ClusterNode:
 
     @staticmethod
     def _collect_ops(shard, above_seqno: int = -1) -> list:
-        """Live docs as seqno-stamped index ops (> above_seqno). For delta
-        collection (above_seqno >= 0) deletes executed since the snapshot
-        are included too — the target may hold the doc from the snapshot
-        and must not keep it after being marked in-sync."""
+        """Live docs as seqno-stamped index ops (> above_seqno), plus
+        delete tombstones. Tombstones are ALWAYS included: a recovery
+        re-run hits a target that may already hold state from a previous
+        attempt (ops the staleness guard will noop-skip), so omitting
+        deletes would resurrect docs the primary removed between
+        attempts."""
         ops = []
         for seg in shard.engine.searchable_segments():
             for local in range(seg.num_docs):
@@ -461,14 +477,28 @@ class ClusterNode:
                         "seq_no": int(seg.seqnos[local]),
                         "version": int(seg.versions[local]),
                     })
-        if above_seqno >= 0:
-            for doc_id, entry in shard.engine.version_map.items():
-                if getattr(entry, "deleted", False) and entry.seqno > above_seqno:
-                    ops.append({"op": "delete", "id": doc_id,
-                                "seq_no": int(entry.seqno),
-                                "version": int(entry.version)})
+        for doc_id, entry in shard.engine.version_map.items():
+            if getattr(entry, "deleted", False) and entry.seqno > above_seqno:
+                ops.append({"op": "delete", "id": doc_id,
+                            "seq_no": int(entry.seqno),
+                            "version": int(entry.version)})
         ops.sort(key=lambda op: op["seq_no"])
         return ops
+
+    @staticmethod
+    def _delta_ops(shard, above_seqno: int) -> list:
+        """Ops with seqno > above_seqno for the finalize delta. Prefers a
+        translog read (cheap, no refresh, no index scan under the
+        replication lock); falls back to the full segment scan when the
+        translog no longer retains that range (trimmed by a flush)."""
+        from elasticsearch_tpu.index.translog import TranslogOp
+
+        tl = shard.engine.translog
+        if above_seqno >= tl.committed_seqno:
+            return [op.to_dict() for op in tl.snapshot(above_seqno + 1)
+                    if op.op_type != TranslogOp.NO_OP]
+        shard.refresh()
+        return ClusterNode._collect_ops(shard, above_seqno=above_seqno)
 
     def _on_recovery_finalize(self, payload, src) -> dict:
         """Primary side: the target applied the streamed ops — return the
@@ -483,9 +513,7 @@ class ClusterNode:
             tracker = getattr(shard, "checkpoints", None) if shard else None
             delta = []
             if shard is not None:
-                shard.refresh()
-                delta = self._collect_ops(
-                    shard, above_seqno=payload["local_checkpoint"])
+                delta = self._delta_ops(shard, payload["local_checkpoint"])
             if tracker is not None:
                 # credit only what the target confirmed; the delta is
                 # applied after this RPC returns and the next write ack
@@ -593,7 +621,9 @@ class ClusterNode:
             # in-sync by recovery finalize (the master may not have
             # published STARTED yet; skipping them would lose the ops
             # written in that window)
-            in_sync = tracker is not None and copy.node_id in tracker.in_sync
+            in_sync = tracker is not None and (
+                copy.node_id in tracker.in_sync
+                or copy.node_id in tracker.pending_in_sync)
             if copy.state != ShardRoutingState.STARTED and not in_sync:
                 continue
             try:
@@ -612,7 +642,16 @@ class ClusterNode:
         if tracker is not None:
             shard.engine.global_checkpoint = tracker.global_checkpoint
         result["_shards"] = {"total": len(self.routing.get(index, {}).get(sid, [])),
-                             "successful": acks, "failed": 0}
+                             "successful": acks, "failed": len(failed_copies)}
+        if failed_copies:
+            # ReplicationResponse.ShardInfo: per-copy failure details
+            result["_shards"]["failures"] = [
+                {"_index": index, "_shard": sid, "_node": node_id,
+                 "status": "INTERNAL_SERVER_ERROR", "primary": False,
+                 "reason": {"type": "replication_failed_exception",
+                            "reason": f"failed to replicate to [{node_id}]"}}
+                for node_id in failed_copies
+            ]
         return result, failed_copies
 
     def _on_write_replica(self, payload, src) -> dict:
@@ -625,12 +664,7 @@ class ClusterNode:
         if payload.get("primary_term", 1) < shard.primary_term:
             # stale primary (fencing, IndexShardOperationPermits analog)
             raise ElasticsearchTpuException("operation primary term is too old")
-        if payload["op"] == "index":
-            shard.engine.index(payload["id"], payload["source"],
-                               payload.get("routing"), seqno=payload["seq_no"])
-            shard.engine.version_map[payload["id"]].version = payload["version"]
-        else:
-            shard.engine.delete(payload["id"], seqno=payload["seq_no"])
+        self._apply_replicated_op(shard, payload)
         # learn the primary's global checkpoint; report our local one back
         shard.engine.global_checkpoint = max(
             shard.engine.global_checkpoint,

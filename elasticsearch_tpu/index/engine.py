@@ -37,6 +37,8 @@ class VersionEntry:
     segment: Optional[str]
     local_doc: int
     deleted: bool = False
+    # tombstone creation time, for gc_deletes pruning (deletes only)
+    ts: float = 0.0
 
 
 @dataclass
@@ -71,6 +73,12 @@ class Engine:
         # (piggybacked on replication ops); on a primary the shard's
         # GlobalCheckpointTracker is the source of truth
         self.global_checkpoint = -1
+        # tombstone retention (reference: index.gc_deletes, default 60s —
+        # InternalEngine.maybePruneDeletes); pruned entries below the
+        # global checkpoint can no longer be needed by recovery deltas
+        # except in the reference's own documented late-op window
+        self.gc_deletes = 60.0
+        self._last_tombstone_prune = 0.0
         self._lock = threading.RLock()
         self.refresh_count = 0
         self.flush_count = 0
@@ -111,12 +119,29 @@ class Engine:
     def index(self, doc_id: str, source: dict, routing: Optional[str] = None,
               version: Optional[int] = None, version_type: str = "internal",
               op_type: str = "index", seqno: Optional[int] = None,
-              add_to_translog: bool = True) -> dict:
+              add_to_translog: bool = True,
+              replicated_version: Optional[int] = None) -> dict:
         """Index one document (create or update). Returns the result dict
-        {_id, _version, _seq_no, result: created|updated}."""
+        {_id, _version, _seq_no, result: created|updated}.
+
+        ``replicated_version``: replica/recovery path — the op carries the
+        version the primary assigned; no conflict check, the version is
+        taken as-is (requires an explicit ``seqno``)."""
         t0 = time.monotonic()
         with self._lock:
             existing = self.version_map.get(doc_id)
+            if (seqno is not None and existing is not None
+                    and existing.seqno >= seqno):
+                # stale replica/recovery op: a newer op for this doc was
+                # already applied (reference: InternalEngine
+                # compareOpToLuceneDocBasedOnSeqNo) — idempotent skip
+                self.note_external_seqno(seqno)
+                return {
+                    "_id": doc_id,
+                    "_version": existing.version,
+                    "_seq_no": seqno,
+                    "result": "noop",
+                }
             current_version = (
                 existing.version if existing and not existing.deleted else 0
             )
@@ -125,10 +150,13 @@ class Engine:
             if version is not None and version_type == "internal":
                 if current_version != version:
                     raise VersionConflictEngineException(doc_id, current_version, version)
-            new_version = (
-                version if version_type == "external" and version is not None
-                else current_version + 1
-            )
+            if replicated_version is not None:
+                new_version = replicated_version
+            else:
+                new_version = (
+                    version if version_type == "external" and version is not None
+                    else current_version + 1
+                )
             if seqno is None:
                 seqno = self._next_seqno()
             else:
@@ -158,9 +186,21 @@ class Engine:
             }
 
     def delete(self, doc_id: str, version: Optional[int] = None,
-               seqno: Optional[int] = None, add_to_translog: bool = True) -> dict:
+               seqno: Optional[int] = None, add_to_translog: bool = True,
+               replicated_version: Optional[int] = None) -> dict:
         with self._lock:
             existing = self.version_map.get(doc_id)
+            if (seqno is not None and existing is not None
+                    and existing.seqno >= seqno):
+                # stale replica/recovery op — idempotent skip (see index())
+                self.note_external_seqno(seqno)
+                return {
+                    "_id": doc_id,
+                    "_version": existing.version,
+                    "_seq_no": seqno,
+                    "result": "noop",
+                    "found": not existing.deleted,
+                }
             found = existing is not None and not existing.deleted
             current_version = existing.version if found else 0
             if version is not None and current_version != version:
@@ -169,11 +209,22 @@ class Engine:
                 seqno = self._next_seqno()
             else:
                 self.note_external_seqno(seqno)
-            new_version = current_version + 1
+            new_version = (replicated_version if replicated_version is not None
+                           else current_version + 1)
             if found:
                 self._tombstone(existing)
                 self.version_map[doc_id] = VersionEntry(
-                    new_version, seqno, existing.segment, existing.local_doc, deleted=True
+                    new_version, seqno, existing.segment, existing.local_doc,
+                    deleted=True, ts=time.monotonic()
+                )
+            else:
+                # record the tombstone even when the doc isn't present:
+                # the seqno staleness guard needs it to reject an older
+                # index op that arrives after this delete (out-of-order
+                # replica delivery / recovery-delta vs fan-out race)
+                self.version_map[doc_id] = VersionEntry(
+                    new_version, seqno, None, -1, deleted=True,
+                    ts=time.monotonic()
                 )
             if add_to_translog:
                 self.translog.add(TranslogOp(
@@ -240,10 +291,29 @@ class Engine:
     # Refresh / flush / merge
     # ------------------------------------------------------------------
 
+    def _prune_tombstones(self) -> None:
+        """Drop delete tombstones that are old (gc_deletes) AND globally
+        durable (seqno <= global checkpoint) — reference:
+        InternalEngine.maybePruneDeletes. Bounds version_map memory and
+        recovery-stream size for long-lived indices."""
+        now = time.monotonic()
+        # throttle the full-map scan off the hot NRT path (reference
+        # prunes at most every gcDeletes/4)
+        if now - self._last_tombstone_prune < self.gc_deletes / 4:
+            return
+        self._last_tombstone_prune = now
+        horizon = now - self.gc_deletes
+        gcp = self.global_checkpoint
+        stale = [doc_id for doc_id, e in self.version_map.items()
+                 if e.deleted and e.ts <= horizon and e.seqno <= gcp]
+        for doc_id in stale:
+            del self.version_map[doc_id]
+
     def refresh(self) -> bool:
         """Seal the buffer into a searchable segment (NRT reader swap)."""
         with self._lock:
             self.refresh_count += 1
+            self._prune_tombstones()
             if self.buffer.num_docs == 0:
                 return False
             seg = self.buffer.seal()
@@ -254,7 +324,9 @@ class Engine:
                 seg.delete_doc(int(remap[local_doc]) if remap is not None
                                else local_doc)
             for doc_id, entry in self.version_map.items():
-                if entry.segment is None:
+                # local_doc < 0: tombstone for a doc that was never in the
+                # buffer (not-found delete) — nothing to re-home
+                if entry.segment is None and entry.local_doc >= 0:
                     entry.segment = seg.name
                     if remap is not None:
                         entry.local_doc = int(remap[entry.local_doc])
@@ -320,11 +392,11 @@ class Engine:
         for op in ops:
             if op.op_type == TranslogOp.INDEX:
                 self.index(op.doc_id, op.source, op.routing, seqno=op.seqno,
-                           add_to_translog=False)
-                # replay preserves the recorded version
-                self.version_map[op.doc_id].version = op.version
+                           add_to_translog=False,
+                           replicated_version=op.version)
             elif op.op_type == TranslogOp.DELETE:
-                self.delete(op.doc_id, seqno=op.seqno, add_to_translog=False)
+                self.delete(op.doc_id, seqno=op.seqno, add_to_translog=False,
+                            replicated_version=op.version)
         if ops:
             self.refresh()
         return len(ops)

@@ -58,6 +58,7 @@ class IndexService:
         idx_slow_info = settings.get_time(
             "index.indexing.slowlog.threshold.index.info")
         idx_slow_source = settings.get_int("index.indexing.slowlog.source", 1000)
+        gc_deletes = settings.get_time("index.gc_deletes")
         self.shards: Dict[int, IndexShard] = {}
         for sid in range(self.num_shards):
             shard_path = os.path.join(data_path, str(sid)) if data_path else None
@@ -69,6 +70,8 @@ class IndexService:
                                indexing_slowlog_warn_s=idx_slow_warn,
                                indexing_slowlog_info_s=idx_slow_info,
                                indexing_slowlog_source_chars=idx_slow_source)
+            if gc_deletes is not None:
+                shard.engine.gc_deletes = gc_deletes
             if shard_path and shard.engine.store.read_commit() is not None:
                 shard.recover_from_store()
             elif shard_path and os.path.exists(

@@ -48,11 +48,12 @@ def parse_index_sort(settings, mapper_service) -> Optional[SortSpec]:
     modes = settings.get_list("index.sort.mode") or []
 
     def nth(lst, i, default):
+        # option arrays must match the field array length exactly
+        # (IndexSortConfig: a single-element list is NOT broadcast over
+        # multiple sort fields)
         if not lst:
             return default
-        if len(lst) == 1:
-            return lst[0]
-        if i >= len(lst):
+        if len(lst) != len(fields):
             raise IllegalArgumentException(
                 f"index.sort option lists must match index.sort.field length "
                 f"({len(fields)})")

@@ -302,23 +302,23 @@ def _build(type_name: str, cfg: dict) -> Similarity:
         return LMDirichletSimilarity(mu=float(cfg.get("mu", 2000.0)))
     if t == "LMJelinekMercer":
         return LMJelinekMercerSimilarity(lam=float(cfg.get("lambda", 0.1)))
-    if t == "DFR":
-        return DFRSimilarity(
-            basic_model=str(cfg.get("basic_model", "g")),
-            after_effect=str(cfg.get("after_effect", "l")),
-            normalization=str(cfg.get("normalization", "h2")),
-            c=float(cfg.get("normalization.h2.c",
-                            cfg.get("normalization.h1.c", 1.0))),
-            z=float(cfg.get("normalization.z.z", 0.30)),
-        )
-    if t == "IB":
+    if t in ("DFR", "IB"):
+        # the c parameter comes from the key matching the *configured*
+        # normalization (normalization.h1.c for h1, .h2.c for h2, ...);
+        # a stray key for a different normalization is ignored
+        norm = str(cfg.get("normalization", "h2"))
+        c = float(cfg.get(f"normalization.{norm}.c", 1.0))
+        z = float(cfg.get("normalization.z.z", 0.30))
+        if t == "DFR":
+            return DFRSimilarity(
+                basic_model=str(cfg.get("basic_model", "g")),
+                after_effect=str(cfg.get("after_effect", "l")),
+                normalization=norm, c=c, z=z,
+            )
         return IBSimilarity(
             distribution=str(cfg.get("distribution", "ll")),
             lam=str(cfg.get("lambda", "df")),
-            normalization=str(cfg.get("normalization", "h2")),
-            c=float(cfg.get("normalization.h2.c",
-                            cfg.get("normalization.h1.c", 1.0))),
-            z=float(cfg.get("normalization.z.z", 0.30)),
+            normalization=norm, c=c, z=z,
         )
     raise IllegalArgumentException(f"Unknown Similarity type [{t}]")
 

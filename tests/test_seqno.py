@@ -42,20 +42,31 @@ class TestTracker:
         assert t.global_checkpoint == 5
         t.initiate_tracking("r1")  # recovering: does not hold back
         assert t.global_checkpoint == 5
+        # below the current global checkpoint: membership is deferred
+        # (pendingInSync) so the global checkpoint never moves backwards
         t.mark_in_sync("r1", 3)
-        assert t.global_checkpoint == 3
-        t.update_local_checkpoint("r1", 5)
+        assert t.global_checkpoint == 5
+        assert "r1" in t.pending_in_sync and "r1" not in t.in_sync
+        t.update_local_checkpoint("r1", 5)  # caught up: promoted
+        assert "r1" in t.in_sync
         assert t.global_checkpoint == 5
         t.update_local_checkpoint("r1", 4)  # never goes backwards
         assert t.global_checkpoint == 5
+        t.update_local_checkpoint("p", 8)
+        assert t.global_checkpoint == 5  # r1 holds it back now
+        t.update_local_checkpoint("r1", 8)
+        assert t.global_checkpoint == 8
 
     def test_remove_advances(self):
         t = GlobalCheckpointTracker("p")
         t.update_local_checkpoint("p", 9)
-        t.mark_in_sync("r1", 2)
-        assert t.global_checkpoint == 2
+        t.mark_in_sync("r1", 2)  # deferred: pending until it reaches 9
+        assert t.global_checkpoint == 9
+        t.update_local_checkpoint("r1", 3)
+        assert "r1" in t.pending_in_sync
         t.remove("r1")
         assert t.global_checkpoint == 9
+        assert "r1" not in t.pending_in_sync
         t.remove("p")  # primary is never removed
         assert t.global_checkpoint == 9
 
@@ -180,6 +191,12 @@ class TestTrackerLifecycle:
             {"index": "idx", "shard": 0,
              "local_checkpoint": resp["max_seq_no"]}, "fake")
         assert {op["id"] for op in fin["ops"]} == {"b"}
+        # the copy confirmed a checkpoint below the primary's (op "b"
+        # landed after the snapshot), so membership is deferred to
+        # pending-in-sync — it already joins the write fan-out, and
+        # promotes once its acks catch up to the global checkpoint
+        assert "fake" in tracker.pending_in_sync
+        tracker.update_local_checkpoint("fake", tracker.global_checkpoint)
         assert "fake" in tracker.in_sync
 
     def test_bad_wait_for_active_shards_is_400(self, cluster):
@@ -268,3 +285,131 @@ class TestRefreshScheduling:
         node.index_doc("idx", "1", {"a": 1}, refresh="wait_for")
         assert node.search("idx", {"query": {"match_all": {}}})["hits"]["total"] == 1
         node.close()
+
+
+class TestSeqnoIdempotentApply:
+    """Out-of-order replica/recovery delivery: the engine's seqno
+    staleness guard (reference: InternalEngine
+    compareOpToLuceneDocBasedOnSeqNo) must make apply order-independent."""
+
+    def _engine(self):
+        # keep the service referenced: its finalizer removes the data dir
+        self._idx = IndexService("s", Settings({"index.number_of_shards": 1,
+                                                "index.refresh_interval": "-1"}))
+        return self._idx.shards[0].engine
+
+    def test_stale_index_after_newer_index_is_noop(self):
+        eng = self._engine()
+        eng.index("x", {"n": 2}, seqno=5)
+        res = eng.index("x", {"n": 1}, seqno=3)
+        assert res["result"] == "noop"
+        eng.refresh()
+        assert eng.get("x").source == {"n": 2}
+
+    def test_stale_index_after_delete_is_not_resurrected(self):
+        # delete at seqno 14 arrives before the index at seqno 13
+        eng = self._engine()
+        eng.delete("x", seqno=14)
+        res = eng.index("x", {"n": 1}, seqno=13)
+        assert res["result"] == "noop"
+        eng.refresh()
+        assert not eng.get("x").found
+
+    def test_not_found_delete_tombstone_survives_refresh(self):
+        eng = self._engine()
+        eng.index("other", {"n": 0}, seqno=1)
+        eng.delete("x", seqno=14)
+        eng.refresh()  # tombstone with no buffered doc must not corrupt seal
+        res = eng.index("x", {"n": 1}, seqno=13)
+        assert res["result"] == "noop"
+        assert not eng.get("x").found
+        assert eng.get("other").source == {"n": 0}
+
+    def test_newer_index_after_stale_delete_applies(self):
+        eng = self._engine()
+        eng.delete("x", seqno=3)
+        res = eng.index("x", {"n": 9}, seqno=7)
+        assert res["result"] == "created"
+        eng.refresh()
+        assert eng.get("x").source == {"n": 9}
+
+    def test_local_checkpoint_advances_on_noop(self):
+        eng = self._engine()
+        eng.index("x", {"n": 2}, seqno=5)
+        eng.index("x", {"n": 1}, seqno=3)
+        assert eng.local_checkpoint == 5
+
+
+class TestRecoveryRerun:
+    def test_rerun_recovery_delivers_interim_deletes(self, cluster):
+        # A recovery attempt that dies before finalize leaves the target
+        # holding streamed state; a delete executed on the primary before
+        # the re-run must still reach the target (tombstones are always
+        # streamed), or the target resurrects the doc.
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 0}})
+        client = ClusterClient(nodes[0])
+        client.index("idx", "x", {"n": 1})
+        primary_node = next(n for n in nodes
+                            if n.shards.get(("idx", 0)) is not None)
+        # first recovery stream (target applies it, then "dies" pre-finalize)
+        resp1 = primary_node._on_start_recovery(
+            {"index": "idx", "shard": 0, "target": "fake"}, "fake")
+        assert {op["id"] for op in resp1["ops"]} == {"x"}
+        # interim ops on the primary: delete x, index y
+        client.delete("idx", "x")
+        client.index("idx", "y", {"n": 2})
+        # re-run stream must now carry the x tombstone and y
+        resp2 = primary_node._on_start_recovery(
+            {"index": "idx", "shard": 0, "target": "fake"}, "fake")
+        by_id = {(op["op"], op["id"]) for op in resp2["ops"]}
+        assert ("delete", "x") in by_id
+        assert ("index", "y") in by_id
+
+    def test_finalize_delta_from_translog_includes_deletes(self, cluster):
+        hub, nodes = cluster
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 1,
+                                                "number_of_replicas": 0}})
+        client = ClusterClient(nodes[0])
+        client.index("idx", "a", {"n": 1})
+        primary_node = next(n for n in nodes
+                            if n.shards.get(("idx", 0)) is not None)
+        resp = primary_node._on_start_recovery(
+            {"index": "idx", "shard": 0, "target": "fake"}, "fake")
+        # ops in the stream->finalize window, including a delete
+        client.index("idx", "b", {"n": 2})
+        client.delete("idx", "a")
+        fin = primary_node._on_recovery_finalize(
+            {"index": "idx", "shard": 0,
+             "local_checkpoint": resp["max_seq_no"]}, "fake")
+        kinds = {(op["op"], op["id"]) for op in fin["ops"]}
+        assert ("index", "b") in kinds
+        assert ("delete", "a") in kinds
+
+
+class TestTombstoneGc:
+    def test_old_durable_tombstones_pruned_on_refresh(self):
+        idx = IndexService("s", Settings({"index.number_of_shards": 1,
+                                          "index.refresh_interval": "-1",
+                                          "index.gc_deletes": "0s"}))
+        eng = idx.shards[0].engine
+        eng.index("a", {"n": 1})
+        eng.delete("a")
+        eng.global_checkpoint = eng.local_checkpoint  # globally durable
+        eng.refresh()
+        assert "a" not in eng.version_map
+
+    def test_recent_or_undurable_tombstones_kept(self):
+        idx = IndexService("s", Settings({"index.number_of_shards": 1,
+                                          "index.refresh_interval": "-1",
+                                          "index.gc_deletes": "0s"}))
+        eng = idx.shards[0].engine
+        eng.index("a", {"n": 1})
+        eng.delete("a")
+        # not globally durable yet (gcp behind): must be retained for
+        # recovery deltas
+        eng.global_checkpoint = -1
+        eng.refresh()
+        assert "a" in eng.version_map
+        self._idx = idx
