@@ -83,6 +83,11 @@ class IndexService:
             self.shards[sid] = shard
         # periodic NRT refresh (index.refresh_interval, default 1s; -1
         # disables — IndexService#getRefreshInterval + refresh scheduling)
+        # mesh-executed query phase (parallel/plan_exec.IndexMeshSearch):
+        # lazy — staged on the first eligible search; the setting gates it
+        # (index.search.mesh: true default; false = host merge only)
+        self._mesh_search = None
+        self._mesh_enabled = settings.get_bool("index.search.mesh", True)
         iv = settings.get_time("index.refresh_interval")
         self.refresh_interval = 1.0 if iv is None else iv
         self._refresh_stop = None
@@ -201,6 +206,34 @@ class IndexService:
     # Search (scatter -> merge -> fetch; §3.2 of SURVEY.md)
     # ------------------------------------------------------------------
 
+    def _try_mesh_search(self, body: dict, k: int) -> Optional[dict]:
+        """Mesh query phase + host fetch phase. None = ineligible."""
+        import time as _time
+
+        from elasticsearch_tpu.search.service import fetch_hits
+
+        t0 = _time.monotonic()
+        if self._mesh_search is None:
+            from elasticsearch_tpu.parallel.plan_exec import IndexMeshSearch
+
+            self._mesh_search = IndexMeshSearch(self)
+        out = self._mesh_search.query(body, max(k, 1))
+        if out is None:
+            return None
+        total, refs, max_score = out
+        from_ = int(body.get("from", 0) or 0)
+        size = int(body.get("size")) if body.get("size") is not None else 10
+        refs_window = refs[from_: from_ + size] if size >= 0 else refs[from_:]
+        hits = fetch_hits(refs_window, self.shards, body, self.name)
+        return {
+            "took": int((_time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": len(self.shards),
+                        "successful": len(self.shards),
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": total, "max_score": max_score, "hits": hits},
+        }
+
     def search(self, body: Optional[dict] = None,
                preference_shards: Optional[List[int]] = None) -> dict:
         t0 = time.monotonic()
@@ -210,6 +243,15 @@ class IndexService:
         k = from_ + size
         shard_ids = preference_shards or sorted(self.shards)
         sort_spec = normalize_sort(body.get("sort"))
+
+        # mesh data plane: eligible searches over all shards run as ONE
+        # multi-device program (query + DFS-free scoring + global top-k
+        # merge in-XLA); fallback is the per-shard host merge below
+        if (self._mesh_enabled and preference_shards is None
+                and not body.get("scroll")):
+            mesh_resp = self._try_mesh_search(body, k)
+            if mesh_resp is not None:
+                return mesh_resp
 
         shard_results = []
         failures = []

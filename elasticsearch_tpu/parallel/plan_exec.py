@@ -1,0 +1,318 @@
+"""The production mesh data plane: ANY query plan over a device mesh.
+
+Round-1's `parallel/distributed.py` proved the collectives pattern on one
+hardcoded disjunction kernel; this module generalizes it to the full query
+DSL. The per-shard plans built by ``QueryBuilder.to_plan`` (identical tree
+structure, shard-local arrays) are STACKED — every plan array padded to a
+common shape with a leading ``[n_devices]`` axis — and the template plan's
+``emit`` is traced ONCE inside ``shard_map``. The result is one compiled
+XLA program executing the whole scatter-gather:
+
+  per-device:  plan.emit -> (scores, matched) over the local shard
+               -> local lax.top_k
+  collective:  all_gather(top-k) over ICI -> global top-k on every device
+               (the TopDocs.merge analog,
+               action/search/SearchPhaseController.java:408)
+               psum(total_hits) (+ psum'd agg partials, aggs_mesh.py)
+
+Per-array padding semantics come from ``PlanNode.pad_kinds`` — padded
+lanes either carry ``valid=False`` masks or scatter onto the stacked
+sentinel doc (``nd1-1``), which ``live1`` kills.
+
+Reference: the RPC fan-out this replaces is
+action/search/AbstractSearchAsyncAction.java + SearchTransportService
+("indices:data/read/search[phase/query]"), per SURVEY.md §5.7/§5.8.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from elasticsearch_tpu.search.plan import EmitCtx, PlanNode
+
+
+class PlanStructureMismatch(Exception):
+    """Per-shard plans for the same query diverged structurally (e.g. a
+    field exists on one shard only with a different similarity) — the
+    caller falls back to the host-merge path."""
+
+
+def _check_same_structure(plans: List[PlanNode]) -> None:
+    def skeleton(p: PlanNode):
+        return (type(p).__name__, len(p.arrays()),
+                tuple(skeleton(c) for c in p.children()))
+
+    first = skeleton(plans[0])
+    for p in plans[1:]:
+        if skeleton(p) != first:
+            raise PlanStructureMismatch(
+                f"{skeleton(p)} != {first}")
+
+
+_PAD_VALUES = {"z": 0, "o": 1, "n": np.nan, "m1": -1}
+
+
+def stack_plans(plans: List[PlanNode], local_nd_pads: List[int],
+                stacked_nd1: int, n_devices: int) -> List[np.ndarray]:
+    """Stack per-shard plan arrays to mesh-ready arrays.
+
+    Returns a flat list aligned with ``template.flat_arrays()`` where every
+    entry has a leading [n_devices] axis. Device slots beyond len(plans)
+    replicate shard 0's arrays — their seg arrays have live1 all-False, so
+    they contribute nothing.
+    """
+    _check_same_structure(plans)
+    kinds = plans[0].flat_pad_kinds()
+    flats = [[np.asarray(a) for a in p.flat_arrays()] for p in plans]
+    n_arrays = len(kinds)
+    for f in flats:
+        if len(f) != n_arrays:
+            raise PlanStructureMismatch("flat array count mismatch")
+    sentinel = stacked_nd1 - 1
+    stacked: List[np.ndarray] = []
+    for i, kind in enumerate(kinds):
+        parts = [f[i] for f in flats]
+        # replicate shard 0 into unused device slots
+        parts = parts + [parts[0]] * (n_devices - len(parts))
+        if kind == "s" or parts[0].ndim == 0:
+            stacked.append(np.stack([np.asarray(p) for p in parts]))
+            continue
+        if kind == "dense":
+            tail = parts[0].shape[1:]
+            out = np.zeros((n_devices, stacked_nd1) + tail, parts[0].dtype)
+            for d, a in enumerate(parts):
+                out[d, : a.shape[0]] = a
+            stacked.append(out)
+            continue
+        max_shape = tuple(
+            max(p.shape[j] for p in parts) for j in range(parts[0].ndim)
+        )
+        if kind == "d":
+            out = np.full((n_devices,) + max_shape, sentinel,
+                          dtype=parts[0].dtype)
+        else:
+            out = np.full((n_devices,) + max_shape, _PAD_VALUES[kind],
+                          dtype=parts[0].dtype)
+        for d, a in enumerate(parts):
+            if kind == "d":
+                # re-point the shard-local sentinel doc to the stacked
+                # one (replicated filler slots came from shard 0)
+                src_shard = d if d < len(plans) else 0
+                a = np.where(a == local_nd_pads[src_shard], sentinel, a)
+            out[(d,) + tuple(slice(0, s) for s in a.shape)] = a
+        stacked.append(out)
+    return stacked
+
+
+class _TemplateHolder:
+    """lru_cache key: plan structure + stacked shapes; holds the template
+    plan whose emit() defines the trace (same pattern as plan.py)."""
+
+    __slots__ = ("plan", "_key")
+
+    def __init__(self, plan: PlanNode, key: str):
+        self.plan = plan
+        self._key = key
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _TemplateHolder) and self._key == other._key
+
+
+@functools.lru_cache(maxsize=128)
+def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int):
+    plan = holder.plan
+    n_dev = mesh.devices.size
+
+    def per_device(seg, plan_arrays):
+        seg = {name: a[0] for name, a in seg.items()}
+        ctx = EmitCtx(seg, [a[0] for a in plan_arrays])
+        scores, matched = plan.emit(ctx)
+        matched = matched & seg["live1"]
+        total = jax.lax.psum(jnp.sum(matched.astype(jnp.int32)), "shards")
+        masked = jnp.where(matched, scores, -jnp.inf)
+        kk = min(k, masked.shape[0])
+        loc_scores, loc_docs = jax.lax.top_k(masked, kk)
+        # global merge over ICI: every device holds the same global top-k
+        all_scores = jax.lax.all_gather(loc_scores, "shards").reshape(-1)
+        all_docs = jax.lax.all_gather(loc_docs, "shards").reshape(-1)
+        top_scores, top_idx = jax.lax.top_k(all_scores, kk)
+        top_shard = (top_idx // kk).astype(jnp.int32)
+        top_doc = all_docs[top_idx]
+        return (top_scores[None], top_shard[None], top_doc[None],
+                total[None])
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(PS("shards"), PS("shards")),
+        out_specs=(PS("shards"),) * 4,
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(seg, plan_arrays):
+        outs = mapped(seg, plan_arrays)
+        # merge is replicated: row 0 == row i
+        return tuple(o[0] for o in outs)
+
+    return run
+
+
+def _shapes_sig(arrays) -> str:
+    return ";".join(f"{a.shape}{a.dtype}" for a in arrays)
+
+
+class IndexMeshSearch:
+    """Routes an index's production query phase through the mesh.
+
+    Owned by IndexService. Eligible searches (plain query + top-k by
+    score) run as ONE multi-device program over all (shard, segment)
+    pairs; anything the program doesn't cover yet returns None and the
+    caller uses the host-merge path — same shape as the reference
+    choosing between query-then-fetch variants per request.
+
+    Staging is cached against the identity of the segment set and
+    invalidated automatically when any shard refreshes/merges."""
+
+    # request keys the mesh program does not cover (yet) — presence of
+    # any of them falls back to the host path
+    UNSUPPORTED = ("sort", "collapse", "rescore", "search_after", "slice",
+                   "post_filter", "min_score", "terminate_after", "profile",
+                   "aggs", "aggregations", "suggest", "highlight")
+
+    def __init__(self, index_service, mesh: Optional[Mesh] = None):
+        self.svc = index_service
+        self._mesh = mesh
+        self._executor: Optional[MeshPlanExecutor] = None
+        self._staged_key = None
+        self._pairs: List[Tuple[int, object]] = []  # (shard_id, segment)
+        self.query_total = 0
+
+    def _mesh_or_default(self) -> Mesh:
+        if self._mesh is None:
+            from elasticsearch_tpu.parallel.mesh import shard_mesh
+
+            self._mesh = shard_mesh()
+        return self._mesh
+
+    def _current_pairs(self) -> List[Tuple[int, object]]:
+        pairs = []
+        for sid in sorted(self.svc.shards):
+            eng = self.svc.shards[sid].engine
+            for seg in eng.searchable_segments():
+                if seg.num_docs > 0:
+                    pairs.append((sid, seg))
+        return pairs
+
+    def _ensure_staged(self) -> bool:
+        pairs = self._current_pairs()
+        if not pairs:
+            return False
+        mesh = self._mesh_or_default()
+        if len(pairs) > mesh.devices.size:
+            return False
+        # live_doc_count participates: deletes mutate a sealed segment's
+        # live mask in place, which must invalidate the staged live1
+        key = tuple((sid, id(seg), seg.live_doc_count) for sid, seg in pairs)
+        if key != self._staged_key:
+            self._executor = MeshPlanExecutor([seg for _, seg in pairs],
+                                              mesh)
+            self._pairs = pairs
+            self._staged_key = key
+        return True
+
+    def query(self, body: dict, k: int):
+        """Returns (total, refs, max_score) or None if ineligible."""
+        from elasticsearch_tpu.search.query_dsl import (
+            ShardQueryContext,
+            parse_query,
+        )
+        from elasticsearch_tpu.search.service import DocRef
+
+        body = body or {}
+        if any(body.get(key) is not None for key in self.UNSUPPORTED):
+            return None
+        if len(self.svc.shards) < 2:
+            return None  # single shard: host path is already one program
+        if any(getattr(self.svc.shards[s].engine, "index_sort", None)
+               for s in self.svc.shards):
+            return None  # index-sorted early termination beats top-k
+        if not self._ensure_staged():
+            return None
+        qb = parse_query(body.get("query"))
+        try:
+            plans = []
+            for sid, seg in self._pairs:
+                shard = self.svc.shards[sid]
+                ctx = ShardQueryContext(shard.mapper_service,
+                                        engine=shard.engine)
+                plans.append(qb.to_plan(ctx, seg))
+            scores, slots, docs, total = self._executor.execute(plans, k)
+        except PlanStructureMismatch:
+            return None
+        except NotImplementedError:
+            return None  # a builder without a plan form
+        self.query_total += 1
+        refs = []
+        max_score = None
+        for s, slot, d in zip(scores, slots, docs):
+            if s == -np.inf:
+                continue
+            sid, seg = self._pairs[int(slot)]
+            refs.append(DocRef(sid, seg.name, int(d), float(s)))
+            if max_score is None:
+                max_score = float(s)
+        return int(total), refs, max_score
+
+
+class MeshPlanExecutor:
+    """Stage N shard segments onto an N-device mesh once; run any query
+    plan as one compiled multi-device program.
+
+    ``segments``: one sealed segment per shard (the staging unit — a shard
+    with several NRT segments is force-merged or served by the host path
+    until its next seal)."""
+
+    def __init__(self, segments: List, mesh: Optional[Mesh] = None):
+        from elasticsearch_tpu.parallel.distributed import stack_shard_arrays
+        from elasticsearch_tpu.parallel.mesh import shard_mesh
+
+        self.mesh = mesh or shard_mesh()
+        self.n_dev = self.mesh.devices.size
+        self.segments = segments
+        stacked = stack_shard_arrays(segments, self.n_dev)
+        self.nd_pad = stacked.pop("nd_pad")
+        self.nd1 = self.nd_pad + 1
+        sharding = NamedSharding(self.mesh, PS("shards"))
+        self._seg_staged = {
+            name: jax.device_put(arr, sharding)
+            for name, arr in stacked.items()
+        }
+        self._sharding = sharding
+
+    def execute(self, plans: List[PlanNode], k: int):
+        """plans: one per shard, same query. Returns
+        (top_scores [k], top_shard [k], top_doc [k], total) as numpy/int —
+        doc ids are in the STACKED doc space (valid per-shard ids since
+        every shard zero-bases)."""
+        if len(plans) != len(self.segments):
+            raise ValueError("one plan per staged shard required")
+        local_pads = [s.nd_pad for s in self.segments]
+        stacked = stack_plans(plans, local_pads, self.nd1, self.n_dev)
+        key = (plans[0].key() + "|" + _shapes_sig(stacked)
+               + f"|k{k}|n{self.n_dev}")
+        run = _mesh_query_program(self.mesh, _TemplateHolder(plans[0], key), k)
+        staged_plan = [jax.device_put(a, self._sharding) for a in stacked]
+        top_scores, top_shard, top_doc, total = run(self._seg_staged,
+                                                    staged_plan)
+        return (np.asarray(top_scores), np.asarray(top_shard),
+                np.asarray(top_doc), int(total))

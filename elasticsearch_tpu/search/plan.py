@@ -46,6 +46,29 @@ class PlanNode:
             out.extend(c.flat_arrays())
         return out
 
+    def pad_kinds(self) -> List[str]:
+        """How each entry of arrays() pads when per-shard plans for the
+        SAME query are stacked onto a device mesh (parallel/plan_exec.py).
+        Aligned with arrays(). Kinds:
+          "s"     scalar — stacked to [n_dev], never padded
+          "z"     pad with 0 / False
+          "o"     pad with 1 (divisors: avgdl, similarity params)
+          "n"     pad with nan (value columns: nan compares False)
+          "m1"    pad with -1 (ordinal ids; -1 never matches a real ord)
+          "d"     doc-id array — pad with the stacked sentinel doc
+                  (nd1-1, dead in live1) and re-point the shard-local
+                  sentinel to the stacked one
+          "dense" dense-over-docs [local_nd1,...] — zero-extend to the
+                  stacked nd1
+        """
+        return ["z"] * len(self.arrays())
+
+    def flat_pad_kinds(self) -> List[str]:
+        out = list(self.pad_kinds())
+        for c in self.children():
+            out.extend(c.flat_pad_kinds())
+        return out
+
 
 class EmitCtx:
     """Carries the segment device arrays + the flat plan-array iterator
@@ -125,6 +148,9 @@ class ScoreTermsNode(PlanNode):
                 self.q_valid, self.min_match, self.q_p1, self.q_p2, self.q_p3,
                 self.q_kinds]
 
+    def pad_kinds(self):
+        return ["z", "z", "z", "o", "z", "s", "o", "o", "z", "z"]
+
     def emit(self, ctx):
         from elasticsearch_tpu.index.similarity import emit_contrib
 
@@ -187,6 +213,9 @@ class PhraseScoreNode(PlanNode):
         return [self.docs, self.freqs, self.weight, self.avgdl,
                 self.p1, self.p2, self.p3]
 
+    def pad_kinds(self):
+        return ["d", "z", "s", "s", "s", "s", "s"]
+
     def emit(self, ctx):
         from elasticsearch_tpu.index.similarity import emit_contrib
 
@@ -212,6 +241,9 @@ class MatchAllNode(PlanNode):
 
     def arrays(self):
         return [self.boost]
+
+    def pad_kinds(self):
+        return ["s"]
 
     def emit(self, ctx):
         (boost,) = ctx.take(1)
@@ -240,6 +272,9 @@ class NumericRangeNode(PlanNode):
     def arrays(self):
         return [self.flat_docs, self.flat_values, self.lo, self.hi]
 
+    def pad_kinds(self):
+        return ["d", "n", "s", "s"]
+
     def emit(self, ctx):
         flat_docs, flat_values, lo, hi = ctx.take(4)
         cond = (flat_values >= lo) & (flat_values <= hi)
@@ -257,6 +292,9 @@ class NumericTermsNode(PlanNode):
 
     def arrays(self):
         return [self.flat_docs, self.flat_values, self.values]
+
+    def pad_kinds(self):
+        return ["d", "n", "n"]
 
     def emit(self, ctx):
         flat_docs, flat_values, values = ctx.take(3)
@@ -276,6 +314,9 @@ class OrdTermsNode(PlanNode):
     def arrays(self):
         return [self.flat_docs, self.flat_ords, self.ords]
 
+    def pad_kinds(self):
+        return ["d", "m1", "m1"]
+
     def emit(self, ctx):
         flat_docs, flat_ords, ords = ctx.take(3)
         cond = (flat_ords[:, None] == ords[None, :]).any(axis=1)
@@ -294,6 +335,9 @@ class OrdRangeNode(PlanNode):
 
     def arrays(self):
         return [self.flat_docs, self.flat_ords, self.lo_ord, self.hi_ord]
+
+    def pad_kinds(self):
+        return ["d", "m1", "s", "s"]
 
     def emit(self, ctx):
         flat_docs, flat_ords, lo, hi = ctx.take(4)
@@ -321,6 +365,9 @@ class RangePairNode(PlanNode):
     def arrays(self):
         return [self.flat_docs, self.lo_vals, self.hi_vals, self.q_lo, self.q_hi]
 
+    def pad_kinds(self):
+        return ["d", "n", "n", "s", "s"]
+
     def emit(self, ctx):
         flat_docs, lo_vals, hi_vals, q_lo, q_hi = ctx.take(5)
         if self.relation == "within":
@@ -345,6 +392,9 @@ class DenseMaskNode(PlanNode):
     def arrays(self):
         return [self.mask]
 
+    def pad_kinds(self):
+        return ["dense"]
+
     def emit(self, ctx):
         (mask,) = ctx.take(1)
         return ctx.zeros_f(), mask
@@ -364,6 +414,9 @@ class DenseScoreNode(PlanNode):
 
     def arrays(self):
         return [self.scores, self.mask]
+
+    def pad_kinds(self):
+        return ["dense", "dense"]
 
     def emit(self, ctx):
         scores, mask = ctx.take(2)
@@ -386,6 +439,9 @@ class GeoDistanceNode(PlanNode):
         return [self.flat_docs, self.lat, self.lon, self.center_lat,
                 self.center_lon, self.radius_m]
 
+    def pad_kinds(self):
+        return ["d", "z", "z", "s", "s", "s"]
+
     def emit(self, ctx):
         flat_docs, lat, lon, clat, clon, radius = ctx.take(6)
         d = mask_ops.haversine_distance_m(lat, lon, clat, clon)
@@ -404,6 +460,9 @@ class GeoBoxNode(PlanNode):
 
     def arrays(self):
         return [self.flat_docs, self.lat, self.lon, self.box]
+
+    def pad_kinds(self):
+        return ["d", "z", "z", "z"]
 
     def emit(self, ctx):
         flat_docs, lat, lon, box = ctx.take(4)
@@ -447,6 +506,9 @@ class BoolNode(PlanNode):
     def arrays(self):
         return [self.msm, self.boost]
 
+    def pad_kinds(self):
+        return ["s", "s"]
+
     def emit(self, ctx):
         msm, boost = ctx.take(2)
         matched = ctx.seg["live1"]
@@ -485,6 +547,9 @@ class ConstantScoreNode(PlanNode):
     def arrays(self):
         return [self.boost]
 
+    def pad_kinds(self):
+        return ["s"]
+
     def emit(self, ctx):
         (boost,) = ctx.take(1)
         _, m = self.child.emit(ctx)
@@ -505,6 +570,9 @@ class BoostNode(PlanNode):
     def arrays(self):
         return [self.boost]
 
+    def pad_kinds(self):
+        return ["s"]
+
     def emit(self, ctx):
         (boost,) = ctx.take(1)
         s, m = self.child.emit(ctx)
@@ -524,6 +592,9 @@ class DisMaxNode(PlanNode):
 
     def arrays(self):
         return [self.tie_breaker]
+
+    def pad_kinds(self):
+        return ["s"]
 
     def emit(self, ctx):
         (tie,) = ctx.take(1)
@@ -563,6 +634,9 @@ class FunctionScoreNode(PlanNode):
 
     def arrays(self):
         return [self.weight] + list(self.factor_columns)
+
+    def pad_kinds(self):
+        return ["s"] + ["dense"] * len(self.factor_columns)
 
     def emit(self, ctx):
         taken = ctx.take(1 + len(self.factor_columns))
