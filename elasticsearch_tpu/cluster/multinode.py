@@ -91,6 +91,11 @@ class ClusterNode:
         # cluster-state copy (every node holds the latest published state)
         self.state_version = 0
         self.indices_meta: Dict[str, IndexMetadata] = {}
+        # per-shard primary terms, owned by the master and carried in the
+        # published state (reference: IndexMetaData.primaryTerm(shardId),
+        # bumped on every primary promotion/reassignment) — replicas learn
+        # the current term from the publish, not from write traffic
+        self.primary_terms: Dict[Tuple[str, int], int] = {}
         self.routing: RoutingTable = {}
         self.known_nodes: List[str] = []
         self.master_id: Optional[str] = None
@@ -241,10 +246,29 @@ class ClusterNode:
 
     def _master_reroute_and_publish(self) -> None:
         data_nodes = [n for n in self.known_nodes]  # all nodes are data nodes here
+        old_primaries = {
+            (index, sid): copy.node_id
+            for index, shards in self.routing.items()
+            for sid, copies in shards.items()
+            for copy in copies if copy.primary
+        }
         self.routing = allocate(
             self.indices_meta, data_nodes, self.routing,
             node_info=self.node_info_map,
             awareness_attributes=self.awareness_attributes or None)
+        # bump the term wherever the primary copy moved to another node
+        # (promotion after failure, cancel+reassign): the old primary may
+        # still be alive and issuing writes — the higher term fences it
+        for index, shards in self.routing.items():
+            for sid, copies in shards.items():
+                key = (index, sid)
+                self.primary_terms.setdefault(key, 1)
+                new_primary = next(
+                    (c.node_id for c in copies if c.primary), None)
+                old = old_primaries.get(key)
+                if (new_primary is not None and old is not None
+                        and new_primary != old):
+                    self.primary_terms[key] += 1
         self.state_version += 1
         state = self._state_dict()
         for node in list(self.known_nodes):
@@ -270,6 +294,10 @@ class ClusterNode:
                 for name, md in self.indices_meta.items()
             },
             "routing": routing_to_dict(self.routing),
+            "primary_terms": {
+                f"{index}#{sid}": term
+                for (index, sid), term in self.primary_terms.items()
+            },
         }
 
     # ------------------------------------------------------------------
@@ -297,6 +325,10 @@ class ClusterNode:
                 for name, info in state["indices"].items()
             }
             self.routing = routing_from_dict(state["routing"])
+            self.primary_terms = {
+                (key.rsplit("#", 1)[0], int(key.rsplit("#", 1)[1])): term
+                for key, term in state.get("primary_terms", {}).items()
+            }
             self._reconcile_shards()
 
     def _mapper_for(self, index: str) -> MapperService:
@@ -340,14 +372,13 @@ class ClusterNode:
                         self._recover_replica(index, sid)
             else:
                 if copy.primary and not shard.primary:
-                    # replica promoted: bump primary term (fencing) and
-                    # seed a tracker from the routing table's started
-                    # copies (reference: in-sync allocation ids from
-                    # IndexMetaData) — their checkpoints are unknown (-1)
-                    # until the next write ack, keeping the global
-                    # checkpoint conservative
+                    # replica promoted: adopt the master-assigned term
+                    # (fencing) and seed a tracker from the routing
+                    # table's started copies (reference: in-sync
+                    # allocation ids from IndexMetaData) — their
+                    # checkpoints are unknown (-1) until the next write
+                    # ack, keeping the global checkpoint conservative
                     shard.primary = True
-                    shard.primary_term += 1
                     from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
 
                     tracker = GlobalCheckpointTracker(self.node_id)
@@ -362,6 +393,11 @@ class ClusterNode:
                     shard.checkpoints = tracker
                 elif copy.state == ShardRoutingState.INITIALIZING and not copy.primary:
                     self._recover_replica(index, sid)
+            # every copy (primary or replica) adopts the published term so
+            # equal-seqno tie-breaks and zombie-primary fencing work even
+            # on copies that saw no write traffic from the new primary
+            shard.primary_term = max(
+                shard.primary_term, self.primary_terms.get((index, sid), 1))
             # prune tracker membership to the current routing copies: a
             # departed replica must not pin the global checkpoint
             tracker = getattr(shard, "checkpoints", None)
@@ -434,11 +470,13 @@ class ClusterNode:
         idempotent under redelivery and reordering."""
         if op["op"] == "delete":
             shard.engine.delete(op["id"], seqno=op["seq_no"],
-                                replicated_version=op.get("version"))
+                                replicated_version=op.get("version"),
+                                primary_term=op.get("primary_term", 1))
         else:
             shard.engine.index(op["id"], op["source"], op.get("routing"),
                                seqno=op["seq_no"],
-                               replicated_version=op.get("version"))
+                               replicated_version=op.get("version"),
+                               primary_term=op.get("primary_term", 1))
 
     def _on_start_recovery(self, payload, src) -> dict:
         """Primary side: stream live docs as seqno-stamped ops (phase2)."""
@@ -466,9 +504,11 @@ class ClusterNode:
         deletes would resurrect docs the primary removed between
         attempts."""
         ops = []
+        vmap = shard.engine.version_map
         for seg in shard.engine.searchable_segments():
             for local in range(seg.num_docs):
                 if seg.live[local] and int(seg.seqnos[local]) > above_seqno:
+                    entry = vmap.get(seg.doc_ids[local])
                     ops.append({
                         "op": "index",
                         "id": seg.doc_ids[local],
@@ -476,12 +516,14 @@ class ClusterNode:
                         "routing": seg.routings[local],
                         "seq_no": int(seg.seqnos[local]),
                         "version": int(seg.versions[local]),
+                        "primary_term": entry.term if entry is not None else 1,
                     })
-        for doc_id, entry in shard.engine.version_map.items():
+        for doc_id, entry in vmap.items():
             if getattr(entry, "deleted", False) and entry.seqno > above_seqno:
                 ops.append({"op": "delete", "id": doc_id,
                             "seq_no": int(entry.seqno),
-                            "version": int(entry.version)})
+                            "version": int(entry.version),
+                            "primary_term": entry.term})
         ops.sort(key=lambda op: op["seq_no"])
         return ops
 
@@ -664,6 +706,10 @@ class ClusterNode:
         if payload.get("primary_term", 1) < shard.primary_term:
             # stale primary (fencing, IndexShardOperationPermits analog)
             raise ElasticsearchTpuException("operation primary term is too old")
+        # learn a newer term from write traffic too — the publish that
+        # carries it may still be in flight
+        shard.primary_term = max(shard.primary_term,
+                                 payload.get("primary_term", 1))
         self._apply_replicated_op(shard, payload)
         # learn the primary's global checkpoint; report our local one back
         shard.engine.global_checkpoint = max(

@@ -39,6 +39,10 @@ class VersionEntry:
     deleted: bool = False
     # tombstone creation time, for gc_deletes pruning (deletes only)
     ts: float = 0.0
+    # primary term of the op that produced this entry — equal-seqno ties
+    # in the staleness guard break by term (reference:
+    # InternalEngine.compareOpToLuceneDocBasedOnSeqNo)
+    term: int = 1
 
 
 @dataclass
@@ -120,7 +124,8 @@ class Engine:
               version: Optional[int] = None, version_type: str = "internal",
               op_type: str = "index", seqno: Optional[int] = None,
               add_to_translog: bool = True,
-              replicated_version: Optional[int] = None) -> dict:
+              replicated_version: Optional[int] = None,
+              primary_term: int = 1) -> dict:
         """Index one document (create or update). Returns the result dict
         {_id, _version, _seq_no, result: created|updated}.
 
@@ -131,10 +136,14 @@ class Engine:
         with self._lock:
             existing = self.version_map.get(doc_id)
             if (seqno is not None and existing is not None
-                    and existing.seqno >= seqno):
+                    and (existing.seqno > seqno
+                         or (existing.seqno == seqno
+                             and existing.term >= primary_term))):
                 # stale replica/recovery op: a newer op for this doc was
                 # already applied (reference: InternalEngine
-                # compareOpToLuceneDocBasedOnSeqNo) — idempotent skip
+                # compareOpToLuceneDocBasedOnSeqNo) — equal seqnos break
+                # by primary term (a new primary may reuse seqnos above
+                # the old primary's checkpoint) — idempotent skip
                 self.note_external_seqno(seqno)
                 return {
                     "_id": doc_id,
@@ -170,11 +179,12 @@ class Engine:
             local_doc = self.buffer.add_document(parsed, seqno, new_version)
             self._buffer_routings[local_doc] = routing
             self.version_map[doc_id] = VersionEntry(
-                new_version, seqno, None, local_doc
+                new_version, seqno, None, local_doc, term=primary_term
             )
             if add_to_translog:
                 self.translog.add(TranslogOp(
-                    TranslogOp.INDEX, seqno, doc_id, source, routing, new_version
+                    TranslogOp.INDEX, seqno, doc_id, source, routing,
+                    new_version, primary_term
                 ))
             self.indexing_total += 1
             self.indexing_time += time.monotonic() - t0
@@ -187,11 +197,14 @@ class Engine:
 
     def delete(self, doc_id: str, version: Optional[int] = None,
                seqno: Optional[int] = None, add_to_translog: bool = True,
-               replicated_version: Optional[int] = None) -> dict:
+               replicated_version: Optional[int] = None,
+               primary_term: int = 1) -> dict:
         with self._lock:
             existing = self.version_map.get(doc_id)
             if (seqno is not None and existing is not None
-                    and existing.seqno >= seqno):
+                    and (existing.seqno > seqno
+                         or (existing.seqno == seqno
+                             and existing.term >= primary_term))):
                 # stale replica/recovery op — idempotent skip (see index())
                 self.note_external_seqno(seqno)
                 return {
@@ -215,7 +228,7 @@ class Engine:
                 self._tombstone(existing)
                 self.version_map[doc_id] = VersionEntry(
                     new_version, seqno, existing.segment, existing.local_doc,
-                    deleted=True, ts=time.monotonic()
+                    deleted=True, ts=time.monotonic(), term=primary_term
                 )
             else:
                 # record the tombstone even when the doc isn't present:
@@ -224,11 +237,12 @@ class Engine:
                 # replica delivery / recovery-delta vs fan-out race)
                 self.version_map[doc_id] = VersionEntry(
                     new_version, seqno, None, -1, deleted=True,
-                    ts=time.monotonic()
+                    ts=time.monotonic(), term=primary_term
                 )
             if add_to_translog:
                 self.translog.add(TranslogOp(
-                    TranslogOp.DELETE, seqno, doc_id, version=new_version
+                    TranslogOp.DELETE, seqno, doc_id, version=new_version,
+                    primary_term=primary_term
                 ))
             self.delete_total += 1
             return {
@@ -377,7 +391,13 @@ class Engine:
             for doc_id, source, routing, seqno, version in live_docs:
                 parsed = self.mapper_service.parse_document(doc_id, source, routing)
                 local = builder.add_document(parsed, seqno, version)
-                self.version_map[doc_id] = VersionEntry(version, seqno, builder.name, local)
+                # carry the op's primary term through the rebuild — the
+                # equal-seqno staleness tie-break and recovery streams
+                # read it from the version map
+                old = self.version_map.get(doc_id)
+                self.version_map[doc_id] = VersionEntry(
+                    version, seqno, builder.name, local,
+                    term=old.term if old is not None else 1)
             merged = builder.seal()
             remap = builder.seal_doc_remap
             if remap is not None:
@@ -393,10 +413,12 @@ class Engine:
             if op.op_type == TranslogOp.INDEX:
                 self.index(op.doc_id, op.source, op.routing, seqno=op.seqno,
                            add_to_translog=False,
-                           replicated_version=op.version)
+                           replicated_version=op.version,
+                           primary_term=op.primary_term)
             elif op.op_type == TranslogOp.DELETE:
                 self.delete(op.doc_id, seqno=op.seqno, add_to_translog=False,
-                            replicated_version=op.version)
+                            replicated_version=op.version,
+                            primary_term=op.primary_term)
         if ops:
             self.refresh()
         return len(ops)

@@ -87,16 +87,28 @@ class IndexShard:
         self.state = ShardState.RECOVERING
         segments = self.engine.store.load_segments()
         self.engine.segments = segments
+        commit = self.engine.store.read_commit() or {}
+        doc_terms = commit.get("doc_terms", {})
         max_seq = -1
         for seg in segments:
             for local, doc_id in enumerate(seg.doc_ids):
                 if seg.live[local]:
                     self.engine.version_map[doc_id] = VersionEntry(
                         int(seg.versions[local]), int(seg.seqnos[local]),
-                        seg.name, local,
+                        seg.name, local, term=doc_terms.get(doc_id, 1),
                     )
             if seg.num_docs:
                 max_seq = max(max_seq, int(seg.seqnos.max()))
+        # re-adopt persisted delete tombstones: without them a stale op
+        # replayed by recovery could resurrect a deleted doc
+        import time as _time
+
+        for doc_id, t in commit.get("tombstones", {}).items():
+            self.engine.version_map[doc_id] = VersionEntry(
+                t["version"], t["seq_no"], None, -1, deleted=True,
+                ts=_time.monotonic(), term=t.get("term", 1),
+            )
+            max_seq = max(max_seq, t["seq_no"])
         if max_seq >= 0:
             self.engine.note_external_seqno(max_seq)
         self.engine.recover_from_translog()
@@ -116,7 +128,7 @@ class IndexShard:
         self._ensure_started()
         t0 = time.monotonic()
         r = self.engine.index(doc_id, source, routing, version, version_type,
-                              op_type, seqno)
+                              op_type, seqno, primary_term=self.primary_term)
         self._maybe_indexing_slowlog(time.monotonic() - t0, doc_id, source)
         r["_index"] = self.index_name
         r["_shard"] = self.shard_id
@@ -143,7 +155,8 @@ class IndexShard:
     def delete_doc(self, doc_id: str, version: Optional[int] = None,
                    seqno: Optional[int] = None) -> dict:
         self._ensure_started()
-        r = self.engine.delete(doc_id, version, seqno)
+        r = self.engine.delete(doc_id, version, seqno,
+                               primary_term=self.primary_term)
         r["_index"] = self.index_name
         r["_primary_term"] = self.primary_term
         return r

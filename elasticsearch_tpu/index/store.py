@@ -58,6 +58,23 @@ class Store:
             "segments": [s.name for s in segments],
             "max_seq_no": int(max_seqno),
         }
+        if version_map is not None:
+            # persist what segments cannot re-derive: delete tombstones
+            # (the seqno staleness guard consults them after restart) and
+            # non-default primary terms (equal-seqno tie-breaks survive
+            # recovery) — reference keeps both in Lucene soft-delete docs
+            commit["tombstones"] = {
+                doc_id: {"seq_no": int(e.seqno), "version": int(e.version),
+                         "term": int(getattr(e, "term", 1))}
+                for doc_id, e in version_map.items()
+                if getattr(e, "deleted", False)
+            }
+            commit["doc_terms"] = {
+                doc_id: int(e.term)
+                for doc_id, e in version_map.items()
+                if not getattr(e, "deleted", False)
+                and getattr(e, "term", 1) != 1
+            }
         tmp = self._commit_path() + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(commit, f)

@@ -46,7 +46,11 @@ class PlanStructureMismatch(Exception):
 
 def _check_same_structure(plans: List[PlanNode]) -> None:
     def skeleton(p: PlanNode):
-        return (type(p).__name__, len(p.arrays()),
+        # trace_statics participates: a static parameter baked into the
+        # template's trace (similarity kinds, range relation, boost_mode)
+        # that diverges per shard would silently score non-template
+        # shards with the wrong formula
+        return (type(p).__name__, len(p.arrays()), p.trace_statics(),
                 tuple(skeleton(c) for c in p.children()))
 
     first = skeleton(plans[0])
@@ -111,9 +115,35 @@ def stack_plans(plans: List[PlanNode], local_nd_pads: List[int],
     return stacked
 
 
+def _strip_plan(p: PlanNode) -> PlanNode:
+    """Structural clone with data arrays dropped.
+
+    emit() reads data exclusively through ``ctx.take`` during tracing;
+    only static attributes (kinds, relation, boost_mode, child lists,
+    ``len(factor_columns)``) are consulted on ``self``. Caching the full
+    template would pin up to maxsize copies of doc-sized numpy columns
+    (e.g. FunctionScoreNode factor columns) for the process lifetime."""
+    import copy
+
+    q = copy.copy(p)
+    for name, val in vars(q).items():
+        if isinstance(val, np.ndarray) and val.size > 8:
+            setattr(q, name, None)
+        elif isinstance(val, PlanNode):
+            setattr(q, name, _strip_plan(val))
+        elif isinstance(val, list) and val:
+            if all(isinstance(v, PlanNode) for v in val):
+                setattr(q, name, [_strip_plan(c) for c in val])
+            elif all(isinstance(v, np.ndarray) for v in val):
+                # length is trace-relevant (ctx.take count); contents not
+                setattr(q, name, [None] * len(val))
+    return q
+
+
 class _TemplateHolder:
-    """lru_cache key: plan structure + stacked shapes; holds the template
-    plan whose emit() defines the trace (same pattern as plan.py)."""
+    """lru_cache key: plan structure + stacked shapes; holds an
+    array-stripped template plan whose emit() defines the trace (same
+    pattern as plan.py)."""
 
     __slots__ = ("plan", "_key")
 
@@ -142,10 +172,14 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int):
         masked = jnp.where(matched, scores, -jnp.inf)
         kk = min(k, masked.shape[0])
         loc_scores, loc_docs = jax.lax.top_k(masked, kk)
-        # global merge over ICI: every device holds the same global top-k
+        # global merge over ICI: every device holds the same global top-k.
+        # The merged pool holds n_dev*kk candidates, so the global cut is
+        # min(k, pool) — NOT kk: when k exceeds one shard's padded doc
+        # count, hits beyond the largest shard are still real.
         all_scores = jax.lax.all_gather(loc_scores, "shards").reshape(-1)
         all_docs = jax.lax.all_gather(loc_docs, "shards").reshape(-1)
-        top_scores, top_idx = jax.lax.top_k(all_scores, kk)
+        top_scores, top_idx = jax.lax.top_k(
+            all_scores, min(k, all_scores.shape[0]))
         top_shard = (top_idx // kk).astype(jnp.int32)
         top_doc = all_docs[top_idx]
         return (top_scores[None], top_shard[None], top_doc[None],
@@ -310,7 +344,8 @@ class MeshPlanExecutor:
         stacked = stack_plans(plans, local_pads, self.nd1, self.n_dev)
         key = (plans[0].key() + "|" + _shapes_sig(stacked)
                + f"|k{k}|n{self.n_dev}")
-        run = _mesh_query_program(self.mesh, _TemplateHolder(plans[0], key), k)
+        run = _mesh_query_program(
+            self.mesh, _TemplateHolder(_strip_plan(plans[0]), key), k)
         staged_plan = [jax.device_put(a, self._sharding) for a in stacked]
         top_scores, top_shard, top_doc, total = run(self._seg_staged,
                                                     staged_plan)

@@ -63,6 +63,14 @@ class PlanNode:
         """
         return ["z"] * len(self.arrays())
 
+    def trace_statics(self) -> tuple:
+        """Static (non-array) attributes baked into the traced program.
+        Per-shard plans for the same query may only be stacked onto one
+        mesh template when these agree — array lengths may differ (they
+        pad), but a differing static here would score non-template shards
+        with the wrong formula."""
+        return ()
+
     def flat_pad_kinds(self) -> List[str]:
         out = list(self.pad_kinds())
         for c in self.children():
@@ -143,6 +151,9 @@ class ScoreTermsNode(PlanNode):
         # the fast path + similarity set change the traced program
         return f"terms[{len(self.q_blocks)},{','.join(self.kinds)},{self._fast}]"
 
+    def trace_statics(self):
+        return (self.kinds, self._fast)
+
     def arrays(self):
         return [self.q_blocks, self.q_weights, self.q_norm_rows, self.q_avgdl,
                 self.q_valid, self.min_match, self.q_p1, self.q_p2, self.q_p3,
@@ -208,6 +219,9 @@ class PhraseScoreNode(PlanNode):
 
     def key(self):
         return f"phrase[{len(self.docs)},{self.norm_row},{self.kind}]"
+
+    def trace_statics(self):
+        return (self.norm_row, self.kind)
 
     def arrays(self):
         return [self.docs, self.freqs, self.weight, self.avgdl,
@@ -361,6 +375,9 @@ class RangePairNode(PlanNode):
 
     def key(self):
         return f"rpair[{len(self.flat_docs)},{self.relation}]"
+
+    def trace_statics(self):
+        return (self.relation,)
 
     def arrays(self):
         return [self.flat_docs, self.lo_vals, self.hi_vals, self.q_lo, self.q_hi]
@@ -628,6 +645,9 @@ class FunctionScoreNode(PlanNode):
 
     def key(self):
         return f"fscore[{len(self.factor_columns)},{self.boost_mode}]({self.child.key()})"
+
+    def trace_statics(self):
+        return (self.boost_mode,)
 
     def children(self):
         return [self.child]

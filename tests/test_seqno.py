@@ -413,3 +413,97 @@ class TestTombstoneGc:
         eng.refresh()
         assert "a" in eng.version_map
         self._idx = idx
+
+
+class TestPrimaryTermTieBreak:
+    """Equal-seqno ops break by primary term (reference:
+    InternalEngine.compareOpToLuceneDocBasedOnSeqNo) and the term
+    survives force_merge / store restart / cluster publish."""
+
+    def test_equal_seqno_higher_term_wins(self):
+        idx = IndexService("s", Settings({"index.number_of_shards": 1}))
+        eng = idx.shards[0].engine
+        # zombie old primary's op at (seqno 5, term 1)
+        eng.index("x", {"v": "old"}, seqno=5, replicated_version=1,
+                  primary_term=1)
+        # new primary reuses seqno 5 at term 2 — must overwrite
+        r = eng.index("x", {"v": "new"}, seqno=5, replicated_version=2,
+                      primary_term=2)
+        assert r["result"] != "noop"
+        assert eng.get("x").source == {"v": "new"}
+        # the zombie redelivered after: noop
+        r2 = eng.index("x", {"v": "old"}, seqno=5, replicated_version=1,
+                       primary_term=1)
+        assert r2["result"] == "noop"
+        assert eng.get("x").source == {"v": "new"}
+
+    def test_equal_seqno_equal_term_idempotent(self):
+        idx = IndexService("s", Settings({"index.number_of_shards": 1}))
+        eng = idx.shards[0].engine
+        eng.index("x", {"v": 1}, seqno=3, replicated_version=1,
+                  primary_term=2)
+        r = eng.index("x", {"v": 1}, seqno=3, replicated_version=1,
+                      primary_term=2)
+        assert r["result"] == "noop"
+
+    def test_force_merge_preserves_term(self):
+        idx = IndexService("s", Settings({"index.number_of_shards": 1}))
+        eng = idx.shards[0].engine
+        eng.index("x", {"v": 1}, seqno=5, replicated_version=1,
+                  primary_term=3)
+        eng.refresh()
+        eng.force_merge()
+        assert eng.version_map["x"].term == 3
+        # a zombie equal-seqno lower-term op still noops after the merge
+        r = eng.index("x", {"v": 0}, seqno=5, replicated_version=1,
+                      primary_term=1)
+        assert r["result"] == "noop"
+
+    def test_store_restart_preserves_terms_and_tombstones(self, tmp_path):
+        from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+        from elasticsearch_tpu.index.shard import IndexShard
+        from elasticsearch_tpu.mapper.mapping import MapperService
+
+        def make_shard():
+            return IndexShard(
+                "i", 0, MapperService(AnalysisRegistry()),
+                data_path=str(tmp_path / "shard0"))
+
+        s1 = make_shard()
+        s1.start_fresh()
+        s1.engine.index("keep", {"v": 1}, seqno=1, replicated_version=1,
+                        primary_term=2)
+        s1.engine.index("gone", {"v": 1}, seqno=2, replicated_version=1,
+                        primary_term=2)
+        s1.engine.delete("gone", seqno=4, replicated_version=2,
+                         primary_term=3)
+        s1.engine.flush()
+        s1.engine.close()
+        s2 = make_shard()
+        s2.recover_from_store()
+        assert s2.engine.version_map["keep"].term == 2
+        tomb = s2.engine.version_map["gone"]
+        assert tomb.deleted and tomb.term == 3 and tomb.seqno == 4
+        # stale index op for the deleted doc cannot resurrect it
+        r = s2.engine.index("gone", {"v": 1}, seqno=3,
+                            replicated_version=1, primary_term=2)
+        assert r["result"] == "noop"
+        assert not s2.engine.get("gone").found
+
+    def test_promotion_publishes_bumped_term_to_all_copies(self, cluster):
+        hub, nodes = cluster
+        # 3 shards over 3 nodes: at least one primary is NOT on the
+        # master, so the master survives to run the promotion
+        nodes[0].create_index("idx", {"index": {"number_of_shards": 3,
+                                                "number_of_replicas": 2}})
+        master = next(n for n in nodes if n.is_master)
+        sid, primary_node = next(
+            (sid, n) for sid in range(3) for n in nodes
+            if n is not master and n.shards[("idx", sid)].primary)
+        others = [n for n in nodes if n is not primary_node]
+        assert all(n.shards[("idx", sid)].primary_term == 1 for n in nodes)
+        # kill the primary: a replica is promoted with term 2, and the
+        # publish carries the new term to EVERY remaining copy
+        hub.disconnect(primary_node.node_id)
+        master.node_left(primary_node.node_id)
+        assert all(n.shards[("idx", sid)].primary_term == 2 for n in others)
