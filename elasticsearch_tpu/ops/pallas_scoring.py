@@ -1,0 +1,506 @@
+"""Pallas TPU kernel for the BM25 scoring hot loop.
+
+This is the TPU-native replacement for the reference's per-segment scoring
+loop — Lucene's ``BulkScorer`` driven from ``QueryPhase.execute``
+(core/src/main/java/org/elasticsearch/search/query/QueryPhase.java:272) —
+and for the XLA scatter-add formulation it previously compiled to here
+(ops/scoring.py:score_term_blocks). XLA lowers a scatter-add with
+duplicate indices to a serialized per-element loop on TPU, which made the
+chip 4x slower than host numpy (BENCH_r03). This kernel removes the
+scatter entirely:
+
+- The doc space is partitioned into tiles of ``W`` docs (W = TILE_SUB*128).
+  The kernel grid iterates tiles; each grid step owns one dense
+  ``[TILE_SUB, 128]`` f32 score accumulator that lives in VMEM/vregs and
+  never round-trips through HBM.
+- For each query term lane, the blocks of postings that can intersect the
+  tile are a *contiguous* run of block rows (postings are doc-sorted within
+  a term), located host-side from per-block [min_doc, max_doc] metadata.
+  The run's rows are DMA'd by the BlockSpec index_map from scalar-prefetched
+  per-(tile, lane) row bounds — the DMA engine does the gather.
+- The scatter "score[doc] += w*frac" becomes a radix-decomposed one-hot
+  matmul on the MXU: with local = doc - tile_base, hi = local >> 7,
+  lo = local & 127,
+
+      acc[hi, lo] += sum_p [hi_p == hi] * ([lo_p == lo] * w * frac_p)
+                   = onehot_hi^T  @  (onehot_lo * w * frac)
+
+  i.e. one (TILE_SUB x R) @ (R x 128) f32 matmul per lane per tile. The
+  one-hot generation is O(R * (TILE_SUB + 128)) VPU compares instead of the
+  O(R * W) of a direct dense compare — the scatter itself rides the MXU.
+- Per-posting BM25 norm factors ``frac = tf*(k1+1)/(tf + k1*(1-b+b*len/avgdl))``
+  are precomputed per segment at staging time (Lucene's analog: norms are
+  baked into per-doc impacts), so the kernel needs no random doc-length
+  gather; a term's score is just ``idf_weight * frac``.
+- The top-k is fused: each tile emits its local top-K (scores, doc ids) and
+  its live-match count; the host program merges n_tiles*K candidates with
+  one tiny ``lax.top_k``. The dense score vector never reaches HBM in the
+  top-k variant. A dense variant writes the [nd] scores (and match counts)
+  for plan programs that need downstream masking/aggregation.
+
+All shapes are static and bucketed (T_pad lanes, CB covering-blocks, W)
+so compiled programs cache across queries (SURVEY.md section 7.3).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from elasticsearch_tpu.index.segment import next_pow2
+from elasticsearch_tpu.ops.scoring import B, K1
+
+LANE = 128
+# default tile = 4096 docs = 32 sublanes x 128 lanes
+DEFAULT_TILE_SUB = 32
+# segment block arrays are padded with this many sentinel rows so that both
+# CB-aligned DMA windows (2*cb rows from the aligned start) stay in bounds
+# for any window starting at a real block row; cb <= CB_MAX // 2
+CB_MAX = 128
+
+NEG_INF = float("-inf")
+
+
+# ----------------------------------------------------------------------
+# Host-side geometry: which docs does tile t get from term lane j?
+# ----------------------------------------------------------------------
+
+
+class TileGeometry(NamedTuple):
+    """Static tiling of one segment's doc space."""
+
+    nd_pad: int  # padded doc count (power of two)
+    tile_sub: int  # sublanes per tile
+    n_tiles: int
+
+    @property
+    def tile_w(self) -> int:
+        return self.tile_sub * LANE
+
+
+def tile_geometry(nd_pad: int, tile_sub: int = DEFAULT_TILE_SUB) -> TileGeometry:
+    """Pick the tile shape for a segment: W = tile_sub*128 docs per tile,
+    shrinking for small segments so n_tiles >= 1 and W <= nd_pad. The doc
+    space is floored at one LANE (128): segments smaller than that are
+    scored over a 128-doc space whose tail is dead (live mask zeros)."""
+    nd_pad = max(nd_pad, LANE)
+    if nd_pad & (nd_pad - 1) or tile_sub & (tile_sub - 1):
+        raise ValueError(
+            f"nd_pad={nd_pad} and tile_sub={tile_sub} must be powers of two "
+            f"(otherwise tail docs would fall outside every tile)")
+    w = tile_sub * LANE
+    while w > nd_pad and w > LANE:
+        w //= 2
+    sub = w // LANE
+    n_tiles = max(nd_pad // w, 1)
+    assert n_tiles * sub * LANE == nd_pad
+    return TileGeometry(nd_pad=nd_pad, tile_sub=sub, n_tiles=n_tiles)
+
+
+def pad_segment_blocks(
+    block_docs: np.ndarray, block_frac: np.ndarray, sentinel: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Append CB_MAX sentinel rows so CB-aligned DMA windows never read out
+    of bounds (sentinel docs fail every tile's range check)."""
+    pad_docs = np.full((CB_MAX, LANE), sentinel, dtype=np.int32)
+    pad_frac = np.zeros((CB_MAX, LANE), dtype=np.float32)
+    return (
+        np.concatenate([block_docs.astype(np.int32), pad_docs]),
+        np.concatenate([block_frac.astype(np.float32), pad_frac]),
+    )
+
+
+def compute_block_frac(
+    block_docs: np.ndarray,
+    block_tfs: np.ndarray,
+    doc_len: np.ndarray,  # [>= nd_pad (+1)] float32 per-doc field length
+    avgdl: float,
+    k1: float = K1,
+    b: float = B,
+) -> np.ndarray:
+    """Per-posting BM25 norm factor (everything except idf*boost):
+    tf*(k1+1) / (tf + k1*(1-b+b*len/avgdl)). Sentinel/padding lanes
+    (tf == 0) get exactly 0, which downstream masks key on."""
+    tf = block_tfs.astype(np.float32)
+    dl = doc_len[np.minimum(block_docs, len(doc_len) - 1)].astype(np.float32)
+    denom = tf + k1 * (1.0 - b + b * dl / max(avgdl, 1e-9))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        frac = np.where(tf > 0.0, tf * (k1 + 1.0) / denom, 0.0)
+    return frac.astype(np.float32)
+
+
+def block_min_max(block_docs: np.ndarray, block_tfs: np.ndarray,
+                  sentinel: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-block [min_doc, max_doc] over *real* postings (tf > 0).
+    Empty blocks get an empty range (min > max) that never matches a tile."""
+    real = block_tfs > 0.0
+    bmin = np.where(real, block_docs, sentinel).min(axis=1).astype(np.int64)
+    bmax = np.where(real, block_docs, -1).max(axis=1).astype(np.int64)
+    return bmin, bmax
+
+
+class QueryLane(NamedTuple):
+    """One scoring lane: a term (or term+field) posting run and its weight."""
+
+    block_start: int  # first block row of the term in the segment
+    block_count: int
+    weight: float  # idf * boost (0 disables the lane)
+
+
+def build_tile_tables(
+    lanes: Sequence[QueryLane],
+    bmin: np.ndarray,
+    bmax: np.ndarray,
+    geom: TileGeometry,
+    t_pad: Optional[int] = None,
+    cb: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Host-side query planning: per (tile, lane) the absolute block-row
+    window [row_lo, row_hi) covering the tile's doc range, padded to
+    T_pad lanes. Returns (row_lo, row_hi [n_tiles, T_pad] i32,
+    weights [1, T_pad] f32, CB) where CB is the uniform pow2 window bucket.
+    The kernel DMAs TWO consecutive CB-aligned windows starting at
+    align(row_lo, CB) — rows [align(lo), align(lo) + 2*CB) — so any window
+    with row_hi - row_lo <= CB is fully covered regardless of where the
+    aligned start lands (it can sit up to CB-1 rows before row_lo)."""
+    w = geom.tile_w
+    n_tiles = geom.n_tiles
+    t_pad = t_pad or next_pow2(max(len(lanes), 1))
+    row_lo = np.zeros((n_tiles, t_pad), dtype=np.int32)
+    row_hi = np.zeros((n_tiles, t_pad), dtype=np.int32)
+    weights = np.zeros((1, t_pad), dtype=np.float32)
+    tile_lo = np.arange(n_tiles, dtype=np.int64) * w
+    need = 1
+    for j, lane in enumerate(lanes):
+        s, c = lane.block_start, lane.block_count
+        if c <= 0 or lane.weight == 0.0:
+            continue
+        tb_min = bmin[s: s + c]
+        tb_max = bmax[s: s + c]
+        # first block whose max_doc >= tile start; first block whose
+        # min_doc >= tile end — [first, end) covers the tile
+        first = np.searchsorted(tb_max, tile_lo, side="left")
+        end = np.searchsorted(tb_min, tile_lo + w, side="left")
+        end = np.maximum(end, first)
+        row_lo[:, j] = s + first
+        row_hi[:, j] = s + end
+        weights[0, j] = lane.weight
+        cov = int((end - first).max()) if c else 0
+        need = max(need, cov)
+    # mosaic requires sublane block sizes divisible by 8; the double-window
+    # scheme covers any alignment as long as cov <= cb, and the segment
+    # padding (CB_MAX rows) must fit both windows: cb <= CB_MAX // 2
+    cb_req = next_pow2(max(need, 8))
+    if cb_req > CB_MAX // 2:
+        raise ValueError(
+            f"per-tile covering window of {need} blocks exceeds the kernel "
+            f"bound {CB_MAX // 2}; use a smaller tile_sub")
+    if cb is not None:
+        if cb < cb_req:
+            raise ValueError(f"cb={cb} too small, need {cb_req}")
+        if cb > CB_MAX // 2 or cb & (cb - 1):
+            raise ValueError(
+                f"cb={cb} must be a power of two <= {CB_MAX // 2} (the "
+                f"second DMA window must stay inside the sentinel padding)")
+        cb_req = cb
+    return row_lo, row_hi, weights, cb_req
+
+
+# ----------------------------------------------------------------------
+# The kernel
+# ----------------------------------------------------------------------
+
+
+def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
+                 with_counts: bool):
+    """Kernel body. Mosaic constraints shape the formulation:
+
+    - only lane-collapsing reshapes ((cb,128) -> (1, cb*128)) lower; the
+      column reshape (-> (rows, 1)) crashes the backend compiler, so every
+      per-posting vector lives as a (1, rows) row and the accumulator is
+      kept TRANSPOSED: accT[lane, sub] with doc local id = sub*128 + lane.
+    - the scatter-matmul contracts over the posting axis on the LANES of
+      both operands (the q @ k^T pattern):
+          accT (LANE, sub) += lovT (LANE, rows) . ohT (sub, rows)^T
+      where ohT one-hots the doc's high radix (local >> 7) and lovT
+      one-hots the low radix (local & 127) scaled by weight*frac.
+    - scalar stores to VMEM are rejected, so the per-tile top-k builds
+      (1, k) vectors with masked selects and stores whole blocks.
+    - bool -> f32 astype trips a recursive convert_element_type fallback;
+      where-selects lower cleanly.
+    """
+    w = sub * LANE
+    # two consecutive cb-aligned DMA windows per lane -> 2*cb rows
+    cb2 = 2 * cb
+    rows = cb2 * LANE
+
+    def kernel(rowlo_ref, rowhi_ref, *refs):
+        docs_refs = [(refs[4 * j], refs[4 * j + 2]) for j in range(t_pad)]
+        frac_refs = [(refs[4 * j + 1], refs[4 * j + 3]) for j in range(t_pad)]
+        live_ref = refs[4 * t_pad]
+        w_ref = refs[4 * t_pad + 1]
+        outs = refs[4 * t_pad + 2:]
+        t = pl.program_id(0)
+        base = jnp.int32(t) * jnp.int32(w)
+        accT = jnp.zeros((LANE, sub), jnp.float32)
+        cntT = jnp.zeros((LANE, sub), jnp.float32) if with_counts else None
+        for j in range(t_pad):
+            rlo = rowlo_ref[t, j]
+            rhi = rowhi_ref[t, j]
+            # aligned first row actually DMA'd (must mirror lane_map below)
+            sb = lax.div(rlo, jnp.int32(cb)) * jnp.int32(cb)
+            docs = jnp.concatenate(
+                [docs_refs[j][0][...], docs_refs[j][1][...]], axis=0)
+            frac = jnp.concatenate(
+                [frac_refs[j][0][...], frac_refs[j][1][...]], axis=0)
+            blk = sb + lax.broadcasted_iota(jnp.int32, (cb2, LANE), 0)
+            local = docs - base
+            valid = (
+                (blk >= rlo) & (blk < rhi)
+                & (local >= jnp.int32(0)) & (local < jnp.int32(w))
+                & (frac > jnp.float32(0.0))
+            )
+            # NB every scalar int literal below must be an explicit int32:
+            # inside the kernel trace weak python ints become i64 scalars,
+            # and mosaic's i64->i32 demotion fallback recurses forever
+            safe = jnp.where(valid, local, jnp.int32(0))
+            hi = jnp.where(valid, lax.shift_right_logical(
+                safe, jnp.int32(7)), jnp.int32(-1))
+            lo = jnp.where(valid, jnp.bitwise_and(safe, jnp.int32(LANE - 1)),
+                           jnp.int32(-1))
+            wj = w_ref[0, j]
+            hi_row = hi.reshape(1, rows)
+            lo_row = lo.reshape(1, rows)
+            wf_row = (frac * wj).reshape(1, rows)
+            ohT = jnp.where(
+                lax.broadcasted_iota(jnp.int32, (sub, rows), 0) == hi_row,
+                jnp.float32(1.0), jnp.float32(0.0))
+            lovT = jnp.where(
+                lax.broadcasted_iota(jnp.int32, (LANE, rows), 0) == lo_row,
+                wf_row, jnp.float32(0.0))
+            accT = accT + lax.dot_general(
+                lovT, ohT, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if with_counts:
+                lovT1 = jnp.where(
+                    lax.broadcasted_iota(jnp.int32, (LANE, rows), 0) == lo_row,
+                    jnp.float32(1.0), jnp.float32(0.0))
+                cntT = cntT + lax.dot_general(
+                    lovT1, ohT, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+        live = live_ref[...] > jnp.float32(0.0)  # (LANE, sub) transposed
+        if dense:
+            out_scores = outs[0]
+            out_scores[...] = jnp.where(live, accT, jnp.float32(0.0))
+            if with_counts:
+                outs[1][...] = jnp.where(live, cntT, jnp.float32(0.0))
+            return
+        out_s, out_d, out_h = outs
+        matched = (accT > jnp.float32(0.0)) & live
+        hits = jnp.sum(jnp.where(matched, jnp.float32(1.0), jnp.float32(0.0)))
+        out_h[...] = hits.reshape(1, 1, 1)
+        # float literals must be explicit f32: a weak python -inf traces as
+        # an f64 scalar inside the kernel and crashes the TPU compiler
+        ninf = jnp.float32(NEG_INF)
+        masked = jnp.where(matched, accT, ninf)
+        # local doc id at accT[lane, s] is s*128 + lane
+        lin = (lax.broadcasted_iota(jnp.int32, (LANE, sub), 1) * jnp.int32(LANE)
+               + lax.broadcasted_iota(jnp.int32, (LANE, sub), 0))
+        outv_s = jnp.full((1, k), NEG_INF, jnp.float32)
+        outv_d = jnp.full((1, k), -1, jnp.int32)
+        k_iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
+        for i in range(k):
+            mx = jnp.max(masked)
+            sel = jnp.where(masked == mx, lin, jnp.int32(w))
+            idx = jnp.min(sel)
+            outv_s = jnp.where(k_iota == jnp.int32(i), mx, outv_s)
+            outv_d = jnp.where(
+                k_iota == jnp.int32(i),
+                jnp.where(mx == ninf, jnp.int32(-1), base + idx),
+                outv_d)
+            masked = jnp.where(lin == idx, ninf, masked)
+        out_s[...] = outv_s.reshape(1, 1, k)
+        out_d[...] = outv_d.reshape(1, 1, k)
+
+    return kernel
+
+
+def _compiler_params():
+    try:
+        return pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+    except (TypeError, AttributeError):  # older/newer API drift
+        return None
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("t_pad", "cb", "sub", "k", "dense", "with_counts",
+                     "interpret"),
+)
+def score_tiles(
+    docs_padded,  # [n_blocks + CB_MAX, LANE] i32 (pad_segment_blocks)
+    frac_padded,  # [n_blocks + CB_MAX, LANE] f32
+    live_t,  # [n_tiles * LANE, sub] f32 (1.0 = live; build_live_t)
+    row_lo,  # [n_tiles, t_pad] i32
+    row_hi,  # [n_tiles, t_pad] i32
+    weights,  # [1, t_pad] f32
+    *,
+    t_pad: int,
+    cb: int,
+    sub: int,
+    k: int = 10,
+    dense: bool = False,
+    with_counts: bool = False,
+    interpret: bool = False,
+):
+    """Run the tile-scoring kernel over a segment.
+
+    top-k variant (dense=False): returns (tile_scores [n_tiles, 1, k] f32,
+    tile_docs [n_tiles, 1, k] i32 (-1 = empty), tile_hits [n_tiles, 1, 1]).
+
+    dense variant (dense=True): returns scores [n_tiles*LANE, sub] f32 in
+    the kernel's transposed tile layout (dense_to_flat -> [nd_pad]) and,
+    with_counts, match counts of the same shape (for minimum_should_match
+    / conjunction masking).
+    """
+    n_tiles = row_lo.shape[0]
+    w = sub * LANE
+    k = min(k, w)
+
+    # index maps must return int32 everywhere (and build the constant INSIDE
+    # the lambda — captured tracers are rejected): the engine runs with jax
+    # x64 enabled (ops/__init__.py), under which python-int literals become
+    # i64 constants in the mosaic transform functions and crash the TPU
+    # compile helper
+    def zero():
+        return jnp.int32(0)
+
+    def lane_map(j, half):
+        # lax.div (truncating) == floor-div for the non-negative row indices;
+        # jnp's // lowers to a floor_divide jaxpr the mosaic index_map
+        # rejects. half=0/1 selects the first/second cb-aligned window.
+        return lambda t, rlo, rhi: (
+            lax.div(rlo[t, j], jnp.int32(cb)) + jnp.int32(half), zero())
+
+    in_specs = []
+    operands = []
+    for j in range(t_pad):
+        for half in (0, 1):
+            in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, half)))
+            operands.append(docs_padded)
+            in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, half)))
+            operands.append(frac_padded)
+    in_specs.append(
+        pl.BlockSpec((LANE, sub), lambda t, rlo, rhi: (t, zero())))
+    operands.append(live_t)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    operands.append(weights)
+
+    if dense:
+        out_specs = [
+            pl.BlockSpec((LANE, sub), lambda t, rlo, rhi: (t, zero()))]
+        out_shape = [jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32)]
+        if with_counts:
+            out_specs.append(
+                pl.BlockSpec((LANE, sub), lambda t, rlo, rhi: (t, zero())))
+            out_shape.append(
+                jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32))
+    else:
+        # 3D outputs: the last two dims of each block equal the array dims,
+        # satisfying mosaic's (8, 128)-divisibility-or-full-dim rule for
+        # small per-tile outputs
+        out_specs = [
+            pl.BlockSpec((1, 1, k),
+                         lambda t, rlo, rhi: (t, zero(), zero())),
+            pl.BlockSpec((1, 1, k),
+                         lambda t, rlo, rhi: (t, zero(), zero())),
+            pl.BlockSpec((1, 1, 1),
+                         lambda t, rlo, rhi: (t, zero(), zero())),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((n_tiles, 1, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, 1, k), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, 1, 1), jnp.float32),
+        ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    kernel = _make_kernel(t_pad, cb, sub, k, dense, with_counts)
+    kwargs = {}
+    params = _compiler_params()
+    if params is not None and not interpret:
+        kwargs["compiler_params"] = params
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=tuple(out_shape),
+        interpret=interpret,
+        **kwargs,
+    )(row_lo, row_hi, *operands)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_tile_topk(tile_scores, tile_docs, tile_hits, k: int):
+    """Merge per-tile candidates: global top-k by score (doc id descending
+    tiebreak is irrelevant — -1 slots carry -inf) + total live hit count."""
+    flat_s = tile_scores.reshape(-1)
+    flat_d = tile_docs.reshape(-1)
+    kk = min(k, flat_s.shape[0])
+    top_s, top_i = lax.top_k(flat_s, kk)
+    return top_s, flat_d[top_i], jnp.sum(tile_hits).astype(jnp.int64)
+
+
+def build_live_t(live: np.ndarray, geom: TileGeometry) -> np.ndarray:
+    """Host-side: live mask [>= nd_pad] bool/float -> the kernel's
+    transposed tile layout [n_tiles * LANE, sub] f32."""
+    sub, n_tiles = geom.tile_sub, geom.n_tiles
+    flat = np.zeros(geom.nd_pad, np.float32)
+    flat[: len(live)] = live[: geom.nd_pad].astype(np.float32)
+    return np.ascontiguousarray(
+        flat.reshape(n_tiles, sub, LANE).transpose(0, 2, 1)
+    ).reshape(n_tiles * LANE, sub)
+
+
+@functools.partial(jax.jit, static_argnames=("sub",))
+def dense_to_flat(dense, sub: int):
+    """Device-side: kernel dense output [n_tiles*LANE, sub] -> [nd_pad]
+    in natural doc order (doc = tile*W + s*128 + lane)."""
+    n_tiles = dense.shape[0] // LANE
+    return dense.reshape(n_tiles, LANE, sub).transpose(0, 2, 1).reshape(-1)
+
+
+# ----------------------------------------------------------------------
+# Numpy reference (tests + CPU fallback parity)
+# ----------------------------------------------------------------------
+
+
+def reference_scores(
+    block_docs: np.ndarray,
+    block_frac: np.ndarray,
+    lanes: Sequence[QueryLane],
+    nd_pad: int,
+) -> np.ndarray:
+    """Dense scores via host scatter-add — the oracle the kernel must match."""
+    scores = np.zeros(nd_pad, np.float32)
+    for lane in lanes:
+        if lane.block_count <= 0 or lane.weight == 0.0:
+            continue
+        rows = slice(lane.block_start, lane.block_start + lane.block_count)
+        docs = block_docs[rows].ravel()
+        frac = block_frac[rows].ravel()
+        real = (frac > 0) & (docs < nd_pad)
+        np.add.at(scores, docs[real], lane.weight * frac[real])
+    return scores
