@@ -140,7 +140,12 @@ def compute_block_frac(
 def block_min_max(block_docs: np.ndarray, block_tfs: np.ndarray,
                   sentinel: int) -> Tuple[np.ndarray, np.ndarray]:
     """Per-block [min_doc, max_doc] over *real* postings (tf > 0).
-    Empty blocks get an empty range (min > max) that never matches a tile."""
+
+    A term's block run must have NO all-padding block before its last block
+    (SegmentBuilder.seal packs postings densely, so this always holds) —
+    an empty mid-run block would get bmax=-1/bmin=sentinel and break the
+    sortedness that build_tile_tables' searchsorted coverage relies on;
+    build_tile_tables guards this with an explicit monotonicity check."""
     real = block_tfs > 0.0
     bmin = np.where(real, block_docs, sentinel).min(axis=1).astype(np.int64)
     bmax = np.where(real, block_docs, -1).max(axis=1).astype(np.int64)
@@ -185,6 +190,12 @@ def build_tile_tables(
             continue
         tb_min = bmin[s: s + c]
         tb_max = bmax[s: s + c]
+        if c > 1 and (np.any(np.diff(tb_min) < 0)
+                      or np.any(np.diff(tb_max) < 0)):
+            raise ValueError(
+                f"lane {j}: per-block doc ranges not sorted (empty mid-run "
+                f"block or unsorted postings) — coverage would be silently "
+                f"wrong")
         # first block whose max_doc >= tile start; first block whose
         # min_doc >= tile end — [first, end) covers the tile
         first = np.searchsorted(tb_max, tile_lo, side="left")
@@ -460,7 +471,7 @@ def merge_tile_topk(tile_scores, tile_docs, tile_hits, k: int):
     flat_d = tile_docs.reshape(-1)
     kk = min(k, flat_s.shape[0])
     top_s, top_i = lax.top_k(flat_s, kk)
-    return top_s, flat_d[top_i], jnp.sum(tile_hits).astype(jnp.int64)
+    return top_s, flat_d[top_i], jnp.sum(tile_hits).astype(jnp.int32)
 
 
 def build_live_t(live: np.ndarray, geom: TileGeometry) -> np.ndarray:
