@@ -538,20 +538,23 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
             lanes_for(terms), bmin, bmax, geom, t_pad=4, cb=cb_run)
         args = (jnp.asarray(rl), jnp.asarray(rh), jnp.asarray(w))
 
+        from elasticsearch_tpu.ops import pallas_aggs as pag
+
         @jax.jit
         def agg_query(docs, frac, live_t, rl, rh, w, kw):
             ds = psc.score_tiles(docs, frac, live_t, rl, rh, w,
                                  t_pad=4, cb=cb_run, sub=geom.tile_sub,
                                  dense=True)[0]
             scores = psc.dense_to_flat(ds, geom.tile_sub)
-            matched = scores > 0
-            contrib = jnp.where(matched, 1.0, 0.0).astype(jnp.float32)
-            # terms agg: segment-sum doc counts over keyword ordinals
-            counts = jnp.zeros((2001,), jnp.float32).at[kw].add(contrib)
-            top_counts, top_ords = lax.top_k(counts[:2000], 10)
+            contrib = jnp.where(scores > 0, jnp.float32(1.0),
+                                jnp.float32(0.0))
+            # terms agg: pallas segment-sum over keyword ordinals (the
+            # scatter-free BucketsAggregator.collect analog)
+            (counts,) = pag.segment_aggregate(kw, contrib, n_ords=2000)
+            top_counts, top_ords = lax.top_k(counts, 10)
             # cardinality: count of distinct matched ordinals (exact here;
             # the engine's HLL++ kernel is ops/aggs.py)
-            card = jnp.sum(counts[:2000] > 0)
+            card = jnp.sum(counts > 0)
             return top_counts, top_ords, card
 
         def run_agg():

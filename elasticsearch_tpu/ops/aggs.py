@@ -22,10 +22,36 @@ InternalAggregation.doReduce, here mapped onto psum-style tree reduction
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _pallas_mode():
+    """Bucket segment-sums route through the pallas kernel
+    (ops/pallas_aggs.py) on TPU — XLA lowers `.at[].add` with duplicate
+    indices to a serialized loop there. ES_TPU_PALLAS=off forces the
+    scatter path; =interpret exercises the kernel on CPU (tests)."""
+    env = os.environ.get("ES_TPU_PALLAS", "auto")
+    if env == "off":
+        return None
+    if env == "interpret":
+        return "interpret"
+    return "compiled" if jax.default_backend() == "tpu" else None
+
+
+def _segsum(ords, contrib, n_ords: int, mode: str, values=None):
+    """Run the pallas segment-sum (it pads to its chunk multiple itself)."""
+    from elasticsearch_tpu.ops.pallas_aggs import segment_aggregate
+
+    return segment_aggregate(
+        jnp.asarray(ords, jnp.int32), jnp.asarray(contrib, jnp.float32),
+        None if values is None else jnp.asarray(values, jnp.float32),
+        n_ords=n_ords, with_sum=values is not None,
+        interpret=(mode == "interpret"))
+
 
 # ---------------------------------------------------------------------------
 # Bucket aggs
@@ -33,6 +59,19 @@ import numpy as np
 
 
 @functools.partial(jax.jit, static_argnames=("n_ords",))
+def _ordinal_counts_scatter(flat_docs, flat_ords, mask, n_ords: int):
+    contrib = mask[flat_docs].astype(jnp.int32)
+    return jnp.zeros((n_ords,), jnp.int32).at[flat_ords].add(contrib, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("n_ords", "mode"))
+def _ordinal_counts_pallas(flat_docs, flat_ords, mask, n_ords: int,
+                           mode: str):
+    contrib = jnp.where(mask[flat_docs], jnp.float32(1.0), jnp.float32(0.0))
+    (cnt,) = _segsum(flat_ords, contrib, n_ords, mode)
+    return cnt.astype(jnp.int32)
+
+
 def ordinal_counts(flat_docs, flat_ords, mask, n_ords: int):
     """Per-ordinal doc counts over matched docs (terms agg heart).
 
@@ -40,28 +79,78 @@ def ordinal_counts(flat_docs, flat_ords, mask, n_ords: int):
     distinct value (matches the reference: a doc adds 1 to each of its
     ordinals' buckets).
     """
-    contrib = mask[flat_docs].astype(jnp.int32)
-    return jnp.zeros((n_ords,), jnp.int32).at[flat_ords].add(contrib, mode="drop")
+    mode = _pallas_mode()
+    if mode:
+        return _ordinal_counts_pallas(flat_docs, flat_ords, mask, n_ords,
+                                      mode)
+    return _ordinal_counts_scatter(flat_docs, flat_ords, mask, n_ords)
 
 
 @functools.partial(jax.jit, static_argnames=("n_ords",))
-def ordinal_sums(flat_docs, flat_ords, mask, values_by_doc, n_ords: int):
-    """Sum of a per-doc metric value, bucketed by ordinal (terms + sub-sum)."""
+def _ordinal_sums_scatter(flat_docs, flat_ords, mask, values_by_doc,
+                          n_ords: int):
     contrib = jnp.where(mask[flat_docs], values_by_doc[flat_docs], 0.0)
     return jnp.zeros((n_ords,), jnp.float64).at[flat_ords].add(contrib, mode="drop")
 
 
+@functools.partial(jax.jit, static_argnames=("n_ords", "mode"))
+def _ordinal_sums_pallas(flat_docs, flat_ords, mask, values_by_doc,
+                         n_ords: int, mode: str):
+    contrib = jnp.where(mask[flat_docs], jnp.float32(1.0), jnp.float32(0.0))
+    vals = values_by_doc[flat_docs].astype(jnp.float32)
+    _, tot = _segsum(flat_ords, contrib, n_ords, mode, values=vals)
+    return tot.astype(jnp.float64)
+
+
+def ordinal_sums(flat_docs, flat_ords, mask, values_by_doc, n_ords: int):
+    """Sum of a per-doc metric value, bucketed by ordinal (terms + sub-sum).
+    The pallas path accumulates in f32 (TPU has no f64); the CPU scatter
+    path keeps f64."""
+    mode = _pallas_mode()
+    if mode:
+        return _ordinal_sums_pallas(flat_docs, flat_ords, mask,
+                                    values_by_doc, n_ords, mode)
+    return _ordinal_sums_scatter(flat_docs, flat_ords, mask, values_by_doc,
+                                 n_ords)
+
+
 @functools.partial(jax.jit, static_argnames=("n_buckets",))
-def histogram_counts(flat_docs, flat_values, mask, interval, offset, min_bucket_key,
-                     n_buckets: int):
-    """Fixed-interval histogram: bucket = floor((v - offset)/interval),
-    rebased by min_bucket_key; out-of-range values drop (callers size the
-    bucket range from segment min/max so nothing real drops)."""
+def _histogram_counts_scatter(flat_docs, flat_values, mask, interval, offset,
+                              min_bucket_key, n_buckets: int):
     bucket = jnp.floor((flat_values - offset) / interval).astype(jnp.int64) - min_bucket_key
     valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
     contrib = valid.astype(jnp.int32)
     bucket = jnp.clip(bucket, 0, n_buckets - 1)
     return jnp.zeros((n_buckets,), jnp.int32).at[bucket].add(contrib)
+
+
+@functools.partial(jax.jit, static_argnames=("n_buckets", "mode"))
+def _histogram_counts_pallas(flat_docs, flat_values, mask, interval, offset,
+                             min_bucket_key, n_buckets: int, mode: str):
+    # exact int64 rebase like the scatter path: date-histogram epoch-ms
+    # keys would lose thousands of buckets to float rounding otherwise
+    bucket = (jnp.floor((flat_values - offset) / interval).astype(jnp.int64)
+              - min_bucket_key).astype(jnp.int32)
+    valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
+    contrib = jnp.where(valid, jnp.float32(1.0), jnp.float32(0.0))
+    # the kernel drops out-of-range ordinals itself; no clip needed
+    (cnt,) = _segsum(bucket, contrib, n_buckets, mode)
+    return cnt.astype(jnp.int32)
+
+
+def histogram_counts(flat_docs, flat_values, mask, interval, offset,
+                     min_bucket_key, n_buckets: int):
+    """Fixed-interval histogram: bucket = floor((v - offset)/interval),
+    rebased by min_bucket_key; out-of-range values drop (callers size the
+    bucket range from segment min/max so nothing real drops)."""
+    mode = _pallas_mode()
+    if mode:
+        return _histogram_counts_pallas(
+            jnp.asarray(flat_docs), jnp.asarray(flat_values),
+            jnp.asarray(mask), interval, offset, min_bucket_key, n_buckets,
+            mode)
+    return _histogram_counts_scatter(flat_docs, flat_values, mask, interval,
+                                     offset, min_bucket_key, n_buckets)
 
 
 @functools.partial(jax.jit, static_argnames=("n_ranges",))
@@ -80,14 +169,42 @@ def range_counts(flat_docs, flat_values, mask, lo, hi, n_ranges: int):
 
 
 @functools.partial(jax.jit, static_argnames=("n_buckets",))
-def value_histogram_sums(flat_docs, flat_values, metric_by_doc, mask, interval,
-                         offset, min_bucket_key, n_buckets: int):
-    """Sum of a per-doc metric grouped by histogram bucket of this field."""
+def _value_histogram_sums_scatter(flat_docs, flat_values, metric_by_doc, mask,
+                                  interval, offset, min_bucket_key,
+                                  n_buckets: int):
     bucket = jnp.floor((flat_values - offset) / interval).astype(jnp.int64) - min_bucket_key
     valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
     contrib = jnp.where(valid, metric_by_doc[flat_docs], 0.0)
     bucket = jnp.clip(bucket, 0, n_buckets - 1)
     return jnp.zeros((n_buckets,), jnp.float64).at[bucket].add(contrib)
+
+
+@functools.partial(jax.jit, static_argnames=("n_buckets", "mode"))
+def _value_histogram_sums_pallas(flat_docs, flat_values, metric_by_doc, mask,
+                                 interval, offset, min_bucket_key,
+                                 n_buckets: int, mode: str):
+    bucket = (jnp.floor((flat_values - offset) / interval).astype(jnp.int64)
+              - min_bucket_key).astype(jnp.int32)
+    valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
+    contrib = jnp.where(valid, jnp.float32(1.0), jnp.float32(0.0))
+    vals = metric_by_doc[flat_docs].astype(jnp.float32)
+    _, tot = _segsum(bucket, contrib, n_buckets, mode, values=vals)
+    return tot.astype(jnp.float64)
+
+
+def value_histogram_sums(flat_docs, flat_values, metric_by_doc, mask, interval,
+                         offset, min_bucket_key, n_buckets: int):
+    """Sum of a per-doc metric grouped by histogram bucket of this field.
+    Pallas path accumulates in f32 (TPU has no f64)."""
+    mode = _pallas_mode()
+    if mode:
+        return _value_histogram_sums_pallas(
+            jnp.asarray(flat_docs), jnp.asarray(flat_values),
+            jnp.asarray(metric_by_doc), jnp.asarray(mask), interval, offset,
+            min_bucket_key, n_buckets, mode)
+    return _value_histogram_sums_scatter(flat_docs, flat_values,
+                                         metric_by_doc, mask, interval,
+                                         offset, min_bucket_key, n_buckets)
 
 
 # ---------------------------------------------------------------------------
