@@ -29,11 +29,18 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _pallas_mode():
+# beyond this many contributions per call the kernel's f32 accumulator
+# could lose count exactness (2^24); the int32 scatter path stays exact
+_PALLAS_COUNT_EXACT_LIMIT = 1 << 24
+
+
+def _pallas_mode(n_entries: int = 0):
     """Bucket segment-sums route through the pallas kernel
     (ops/pallas_aggs.py) on TPU — XLA lowers `.at[].add` with duplicate
     indices to a serialized loop there. ES_TPU_PALLAS=off forces the
     scatter path; =interpret exercises the kernel on CPU (tests)."""
+    if n_entries > _PALLAS_COUNT_EXACT_LIMIT:
+        return None
     env = os.environ.get("ES_TPU_PALLAS", "auto")
     if env == "off":
         return None
@@ -42,7 +49,8 @@ def _pallas_mode():
     return "compiled" if jax.default_backend() == "tpu" else None
 
 
-def _segsum(ords, contrib, n_ords: int, mode: str, values=None):
+def _segsum(ords, contrib, n_ords: int, mode: str, values=None,
+            sum_only: bool = False):
     """Run the pallas segment-sum (it pads to its chunk multiple itself)."""
     from elasticsearch_tpu.ops.pallas_aggs import segment_aggregate
 
@@ -50,7 +58,7 @@ def _segsum(ords, contrib, n_ords: int, mode: str, values=None):
         jnp.asarray(ords, jnp.int32), jnp.asarray(contrib, jnp.float32),
         None if values is None else jnp.asarray(values, jnp.float32),
         n_ords=n_ords, with_sum=values is not None,
-        interpret=(mode == "interpret"))
+        with_count=not sum_only, interpret=(mode == "interpret"))
 
 
 # ---------------------------------------------------------------------------
@@ -79,7 +87,7 @@ def ordinal_counts(flat_docs, flat_ords, mask, n_ords: int):
     distinct value (matches the reference: a doc adds 1 to each of its
     ordinals' buckets).
     """
-    mode = _pallas_mode()
+    mode = _pallas_mode(flat_ords.shape[0])
     if mode:
         return _ordinal_counts_pallas(flat_docs, flat_ords, mask, n_ords,
                                       mode)
@@ -98,7 +106,8 @@ def _ordinal_sums_pallas(flat_docs, flat_ords, mask, values_by_doc,
                          n_ords: int, mode: str):
     contrib = jnp.where(mask[flat_docs], jnp.float32(1.0), jnp.float32(0.0))
     vals = values_by_doc[flat_docs].astype(jnp.float32)
-    _, tot = _segsum(flat_ords, contrib, n_ords, mode, values=vals)
+    tot = _segsum(flat_ords, contrib, n_ords, mode, values=vals,
+                  sum_only=True)[0]
     return tot.astype(jnp.float64)
 
 
@@ -106,7 +115,7 @@ def ordinal_sums(flat_docs, flat_ords, mask, values_by_doc, n_ords: int):
     """Sum of a per-doc metric value, bucketed by ordinal (terms + sub-sum).
     The pallas path accumulates in f32 (TPU has no f64); the CPU scatter
     path keeps f64."""
-    mode = _pallas_mode()
+    mode = _pallas_mode(flat_ords.shape[0])
     if mode:
         return _ordinal_sums_pallas(flat_docs, flat_ords, mask,
                                     values_by_doc, n_ords, mode)
@@ -128,12 +137,14 @@ def _histogram_counts_scatter(flat_docs, flat_values, mask, interval, offset,
 def _histogram_counts_pallas(flat_docs, flat_values, mask, interval, offset,
                              min_bucket_key, n_buckets: int, mode: str):
     # exact int64 rebase like the scatter path: date-histogram epoch-ms
-    # keys would lose thousands of buckets to float rounding otherwise
-    bucket = (jnp.floor((flat_values - offset) / interval).astype(jnp.int64)
-              - min_bucket_key).astype(jnp.int32)
-    valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
+    # keys would lose thousands of buckets to float rounding otherwise.
+    # validity is checked on the int64 bucket BEFORE narrowing — an int32
+    # cast of a far-out-of-range value would wrap into a valid bucket
+    bucket64 = (jnp.floor((flat_values - offset) / interval)
+                .astype(jnp.int64) - min_bucket_key)
+    valid = mask[flat_docs] & (bucket64 >= 0) & (bucket64 < n_buckets)
+    bucket = jnp.where(valid, bucket64, -1).astype(jnp.int32)
     contrib = jnp.where(valid, jnp.float32(1.0), jnp.float32(0.0))
-    # the kernel drops out-of-range ordinals itself; no clip needed
     (cnt,) = _segsum(bucket, contrib, n_buckets, mode)
     return cnt.astype(jnp.int32)
 
@@ -143,7 +154,7 @@ def histogram_counts(flat_docs, flat_values, mask, interval, offset,
     """Fixed-interval histogram: bucket = floor((v - offset)/interval),
     rebased by min_bucket_key; out-of-range values drop (callers size the
     bucket range from segment min/max so nothing real drops)."""
-    mode = _pallas_mode()
+    mode = _pallas_mode(flat_values.shape[0])
     if mode:
         return _histogram_counts_pallas(
             jnp.asarray(flat_docs), jnp.asarray(flat_values),
@@ -183,12 +194,14 @@ def _value_histogram_sums_scatter(flat_docs, flat_values, metric_by_doc, mask,
 def _value_histogram_sums_pallas(flat_docs, flat_values, metric_by_doc, mask,
                                  interval, offset, min_bucket_key,
                                  n_buckets: int, mode: str):
-    bucket = (jnp.floor((flat_values - offset) / interval).astype(jnp.int64)
-              - min_bucket_key).astype(jnp.int32)
-    valid = mask[flat_docs] & (bucket >= 0) & (bucket < n_buckets)
+    bucket64 = (jnp.floor((flat_values - offset) / interval)
+                .astype(jnp.int64) - min_bucket_key)
+    valid = mask[flat_docs] & (bucket64 >= 0) & (bucket64 < n_buckets)
+    bucket = jnp.where(valid, bucket64, -1).astype(jnp.int32)
     contrib = jnp.where(valid, jnp.float32(1.0), jnp.float32(0.0))
     vals = metric_by_doc[flat_docs].astype(jnp.float32)
-    _, tot = _segsum(bucket, contrib, n_buckets, mode, values=vals)
+    tot = _segsum(bucket, contrib, n_buckets, mode, values=vals,
+                  sum_only=True)[0]
     return tot.astype(jnp.float64)
 
 
@@ -196,7 +209,7 @@ def value_histogram_sums(flat_docs, flat_values, metric_by_doc, mask, interval,
                          offset, min_bucket_key, n_buckets: int):
     """Sum of a per-doc metric grouped by histogram bucket of this field.
     Pallas path accumulates in f32 (TPU has no f64)."""
-    mode = _pallas_mode()
+    mode = _pallas_mode(flat_values.shape[0])
     if mode:
         return _value_histogram_sums_pallas(
             jnp.asarray(flat_docs), jnp.asarray(flat_values),

@@ -47,7 +47,7 @@ CHUNK_SUB = 8
 CHUNK = CHUNK_SUB * LANE
 
 
-def _make_segsum_kernel(o_sub: int, with_sum: bool):
+def _make_segsum_kernel(o_sub: int, with_sum: bool, with_count: bool):
     def kernel(ord_ref, mask_ref, *refs):
         if with_sum:
             val_ref = refs[0]
@@ -55,8 +55,12 @@ def _make_segsum_kernel(o_sub: int, with_sum: bool):
         else:
             val_ref = None
             outs = refs
-        out_cnt = outs[0]
-        out_sum = outs[1] if with_sum else None
+        if with_count:
+            out_cnt = outs[0]
+            out_sum = outs[1] if with_sum else None
+        else:
+            out_cnt = None
+            out_sum = outs[0]
         c = pl.program_id(0)
 
         ords = ord_ref[...]  # (CHUNK_SUB, LANE) i32
@@ -73,23 +77,22 @@ def _make_segsum_kernel(o_sub: int, with_sum: bool):
         ohT = jnp.where(
             lax.broadcasted_iota(jnp.int32, (o_sub, CHUNK), 0) == hi_row,
             jnp.float32(1.0), jnp.float32(0.0))
-        lov1 = jnp.where(
-            lax.broadcasted_iota(jnp.int32, (LANE, CHUNK), 0) == lo_row,
-            jnp.float32(1.0), jnp.float32(0.0))
         # accT layout (LANE=lo, o_sub=hi): ordinal o sits at
         # [o & 127, o >> 7]
-        cnt = lax.dot_general(lov1, ohT, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32)
+        if with_count:
+            lov1 = jnp.where(
+                lax.broadcasted_iota(jnp.int32, (LANE, CHUNK), 0) == lo_row,
+                jnp.float32(1.0), jnp.float32(0.0))
+            cnt = lax.dot_general(lov1, ohT, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
 
-        @pl.when(c == jnp.int32(0))
-        def _():
-            out_cnt[...] = cnt
-            if with_sum:
-                out_sum[...] = jnp.zeros((LANE, o_sub), jnp.float32)
+            @pl.when(c == jnp.int32(0))
+            def _():
+                out_cnt[...] = cnt
 
-        @pl.when(c != jnp.int32(0))
-        def _():
-            out_cnt[...] = out_cnt[...] + cnt
+            @pl.when(c != jnp.int32(0))
+            def _():
+                out_cnt[...] = out_cnt[...] + cnt
 
         if with_sum:
             vals = val_ref[...]
@@ -98,13 +101,20 @@ def _make_segsum_kernel(o_sub: int, with_sum: bool):
                 vals.reshape(1, CHUNK), jnp.float32(0.0))
             tot = lax.dot_general(lovv, ohT, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
-            out_sum[...] = out_sum[...] + tot
+
+            @pl.when(c == jnp.int32(0))
+            def _():
+                out_sum[...] = tot
+
+            @pl.when(c != jnp.int32(0))
+            def _():
+                out_sum[...] = out_sum[...] + tot
 
     return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("n_ords", "with_sum",
-                                             "interpret"))
+                                             "with_count", "interpret"))
 def segment_aggregate(
     ords,  # [nd] int32 per-doc bucket ordinal (-1 or >= n_ords = skip)
     mask,  # [nd] float32 query-match mask (>0 = in the agg)
@@ -112,13 +122,19 @@ def segment_aggregate(
     *,
     n_ords: int,
     with_sum: bool = False,
+    with_count: bool = True,
     interpret: bool = False,
 ):
     """Per-bucket doc counts (and value sums) in one device pass.
 
-    Returns count [n_ords] f32 (and total [n_ords] f32 when with_sum).
-    Inputs of any length are padded to a CHUNK multiple internally (mask
-    pads 0, so padding never contributes).
+    Returns a tuple of (count [n_ords] f32 if with_count, total [n_ords]
+    f32 if with_sum) — sum-only callers set with_count=False to skip the
+    count matmul entirely. Inputs of any length are padded to a CHUNK
+    multiple internally (mask pads 0, so padding never contributes).
+
+    Accumulation is f32: counts are exact up to 2^24 contributions per
+    call (the dispatchers in ops/aggs.py fall back to the int32 scatter
+    path beyond that), and sums carry f32 precision.
 
     Non-finite metric values are sanitized (NaN -> 0, +/-inf -> +/-f32max)
     before the one-hot matmul: a raw inf would turn the 0*inf products of
@@ -126,7 +142,15 @@ def segment_aggregate(
     scatter path: an inf value saturates its own bucket's sum instead of
     making it inf exactly, and NaN values are treated as missing.
     """
+    assert with_sum or with_count
     nd = ords.shape[0]
+    if nd == 0:
+        outs = []
+        if with_count:
+            outs.append(jnp.zeros((n_ords,), jnp.float32))
+        if with_sum:
+            outs.append(jnp.zeros((n_ords,), jnp.float32))
+        return tuple(outs)
     target = ((nd + CHUNK - 1) // CHUNK) * CHUNK
     if target != nd:
         ords = jnp.pad(ords, (0, target - nd))
@@ -157,12 +181,11 @@ def segment_aggregate(
 
     # accumulator blocks are revisited every step (constant index map) so
     # they stay resident in VMEM for the whole pass
-    out_specs = [pl.BlockSpec((LANE, o_sub), lambda c: (zero(), zero()))]
-    out_shape = [jax.ShapeDtypeStruct((LANE, o_sub), jnp.float32)]
-    if with_sum:
-        out_specs.append(pl.BlockSpec((LANE, o_sub),
-                                      lambda c: (zero(), zero())))
-        out_shape.append(jax.ShapeDtypeStruct((LANE, o_sub), jnp.float32))
+    n_outs = int(with_count) + int(with_sum)
+    out_specs = [pl.BlockSpec((LANE, o_sub), lambda c: (zero(), zero()))
+                 for _ in range(n_outs)]
+    out_shape = [jax.ShapeDtypeStruct((LANE, o_sub), jnp.float32)
+                 for _ in range(n_outs)]
 
     kwargs = {}
     try:
@@ -172,7 +195,7 @@ def segment_aggregate(
     except (TypeError, AttributeError):
         pass
     out = pl.pallas_call(
-        _make_segsum_kernel(o_sub, with_sum),
+        _make_segsum_kernel(o_sub, with_sum, with_count),
         grid=(n_chunks,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -185,23 +208,7 @@ def segment_aggregate(
     def unpack(a):
         return a.T.reshape(-1)[:n_ords]
 
-    if with_sum:
-        return unpack(out[0]), unpack(out[1])
-    return (unpack(out[0]),)
-
-
-def pad_doc_inputs(*arrays, fill=0):
-    """Pad 1-D per-doc arrays up to a CHUNK multiple (mask pads with 0 so
-    padded docs never contribute)."""
-    nd = arrays[0].shape[0]
-    target = ((nd + CHUNK - 1) // CHUNK) * CHUNK
-    if target == nd:
-        return arrays if len(arrays) > 1 else arrays[0]
-    out = []
-    for a in arrays:
-        pad = np.full(target - nd, fill, a.dtype)
-        out.append(np.concatenate([a, pad]))
-    return tuple(out) if len(out) > 1 else out[0]
+    return tuple(unpack(a) for a in out)
 
 
 def reference_segment_aggregate(ords, mask, values=None, *, n_ords):

@@ -22,6 +22,11 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration test (multi-process)")
+
+
 @pytest.fixture()
 def tmp_data_dir(tmp_path):
     return str(tmp_path / "data")

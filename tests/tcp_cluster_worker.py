@@ -1,0 +1,98 @@
+"""Subprocess worker for the 3-process TCP cluster test.
+
+Hosts one ClusterNode over TcpTransportHub and executes JSON commands from
+stdin (one per line), answering on stdout — the test framework's analog of
+driving a real node over its API while discovery/replication run over
+sockets. Exercised by tests/test_tcp_transport.py.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from elasticsearch_tpu.cluster.multinode import ClusterClient, ClusterNode  # noqa: E402
+from elasticsearch_tpu.transport.tcp import TcpTransportHub  # noqa: E402
+
+
+def main():
+    name = sys.argv[1]
+    port = int(sys.argv[2])
+    hub = TcpTransportHub(port=port)
+    node = ClusterNode(name, hub)
+    client = ClusterClient(node)
+    out = sys.stdout
+
+    def reply(obj):
+        out.write(json.dumps(obj) + "\n")
+        out.flush()
+
+    reply({"ready": True, "port": hub.port})
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        cmd = json.loads(line)
+        op = cmd.pop("op")
+        try:
+            if op == "add_peer":
+                hub.add_peer(cmd["node"], "127.0.0.1", cmd["port"])
+                reply({"ok": True})
+            elif op == "bootstrap":
+                node.bootstrap_cluster()
+                reply({"ok": True})
+            elif op == "join":
+                node.join(cmd["seed"])
+                reply({"ok": True})
+            elif op == "create_index":
+                node.create_index(cmd["index"], cmd.get("settings"),
+                                  cmd.get("mappings"))
+                reply({"ok": True})
+            elif op == "index":
+                reply({"ok": True,
+                       "result": client.index(cmd["index"], cmd["id"],
+                                              cmd["doc"])})
+            elif op == "get":
+                reply({"ok": True, "result": client.get(cmd["index"],
+                                                        cmd["id"])})
+            elif op == "refresh":
+                client.refresh(cmd["index"])
+                reply({"ok": True})
+            elif op == "search":
+                reply({"ok": True,
+                       "result": client.search(cmd["index"],
+                                               cmd.get("body"))})
+            elif op == "check_nodes":
+                reply({"ok": True, "departed": node.check_nodes()})
+            elif op == "state":
+                reply({"ok": True, "master": node.master_id,
+                       "nodes": node.known_nodes,
+                       "version": node.state_version})
+            elif op == "routing":
+                from elasticsearch_tpu.cluster.allocation import (
+                    routing_to_dict,
+                )
+                routing = {
+                    f"{idx}:{sh}": copies
+                    for idx, shards in routing_to_dict(node.routing).items()
+                    for sh, copies in shards.items()}
+                reply({"ok": True, "routing": routing})
+            elif op == "exit":
+                reply({"ok": True})
+                break
+            else:
+                reply({"ok": False, "error": f"unknown op {op}"})
+        except Exception as e:  # noqa: BLE001
+            reply({"ok": False, "error": f"{type(e).__name__}: {e}"})
+    node.close()
+    hub.close()
+
+
+if __name__ == "__main__":
+    main()

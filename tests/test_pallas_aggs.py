@@ -12,7 +12,6 @@ import jax.numpy as jnp
 
 from elasticsearch_tpu.ops.pallas_aggs import (
     CHUNK,
-    pad_doc_inputs,
     reference_segment_aggregate,
     segment_aggregate,
 )
@@ -20,12 +19,10 @@ from elasticsearch_tpu.ops.pallas_aggs import (
 
 def run(ords, mask, vals=None, n_ords=None):
     if vals is None:
-        po, pm = pad_doc_inputs(ords, mask)
-        return segment_aggregate(jnp.asarray(po), jnp.asarray(pm),
+        return segment_aggregate(jnp.asarray(ords), jnp.asarray(mask),
                                  n_ords=n_ords, interpret=True)
-    po, pm, pv = pad_doc_inputs(ords, mask, vals)
-    return segment_aggregate(jnp.asarray(po), jnp.asarray(pm),
-                             jnp.asarray(pv), n_ords=n_ords, with_sum=True,
+    return segment_aggregate(jnp.asarray(ords), jnp.asarray(mask),
+                             jnp.asarray(vals), n_ords=n_ords, with_sum=True,
                              interpret=True)
 
 
@@ -67,6 +64,23 @@ class TestSegmentAggregate:
         (cnt,) = run(ords, mask, n_ords=10_000)
         (rc,) = reference_segment_aggregate(ords, mask, n_ords=10_000)
         np.testing.assert_allclose(np.asarray(cnt), rc)
+
+    def test_zero_length_input(self):
+        (cnt,) = segment_aggregate(
+            jnp.asarray(np.zeros(0, np.int32)),
+            jnp.asarray(np.zeros(0, np.float32)), n_ords=16, interpret=True)
+        assert np.asarray(cnt).shape == (16,) and np.asarray(cnt).sum() == 0
+
+    def test_sum_only(self):
+        rng = np.random.RandomState(11)
+        ords = rng.randint(0, 30, 500).astype(np.int32)
+        mask = np.ones(500, np.float32)
+        vals = rng.randn(500).astype(np.float32)
+        (tot,) = segment_aggregate(
+            jnp.asarray(ords), jnp.asarray(mask), jnp.asarray(vals),
+            n_ords=30, with_sum=True, with_count=False, interpret=True)
+        _, rt = reference_segment_aggregate(ords, mask, vals, n_ords=30)
+        np.testing.assert_allclose(np.asarray(tot), rt, rtol=1e-4, atol=1e-4)
 
     def test_exact_chunk_multiple(self):
         nd = CHUNK * 3

@@ -1,0 +1,248 @@
+"""TCP transport tests: frame protocol, in-process socket hubs, and a real
+3-OS-process cluster (create index -> replicated writes -> search ->
+node kill -> failover) — the SURVEY §5.8 DCN control-plane requirement.
+
+Role models: TcpTransport framing/request-response
+(core/.../transport/TcpTransport.java:121, TcpHeader.java:30), and the
+multi-node integration style of InternalTestCluster but across real OS
+processes."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    NodeNotConnectedException,
+)
+from elasticsearch_tpu.transport.local import TransportService
+from elasticsearch_tpu.transport.tcp import (
+    KIND_ERROR,
+    KIND_REQUEST,
+    KIND_RESPONSE,
+    TcpTransportHub,
+    _encode,
+    _read_frame,
+)
+
+
+class TestWireFormat:
+    def test_roundtrip(self):
+        import io
+        import socket
+
+        a, b = socket.socketpair()
+        try:
+            frame = _encode(KIND_REQUEST, 42, {"src": "n1", "action": "x",
+                                               "payload": {"v": [1, 2]}})
+            a.sendall(frame)
+            kind, req_id, body = _read_frame(b)
+            assert (kind, req_id) == (KIND_REQUEST, 42)
+            assert body["payload"] == {"v": [1, 2]}
+        finally:
+            a.close()
+            b.close()
+
+    def test_numpy_payloads_serialize(self):
+        frame = _encode(KIND_RESPONSE, 1, {
+            "result": {"count": np.int64(3), "score": np.float32(1.5),
+                       "arr": np.arange(3)}})
+        assert b"1.5" in frame
+
+
+def make_pair():
+    hub_a = TcpTransportHub()
+    hub_b = TcpTransportHub()
+    svc_a = TransportService("a", hub_a)
+    svc_b = TransportService("b", hub_b)
+    hub_a.add_peer("b", "127.0.0.1", hub_b.port)
+    hub_b.add_peer("a", "127.0.0.1", hub_a.port)
+    return hub_a, hub_b, svc_a, svc_b
+
+
+class TestSocketHub:
+    def test_request_response(self):
+        hub_a, hub_b, svc_a, svc_b = make_pair()
+        try:
+            svc_b.register_handler("echo", lambda p, src: {"got": p,
+                                                           "from": src})
+            out = svc_a.send_request("b", "echo", {"x": 1})
+            assert out == {"got": {"x": 1}, "from": "a"}
+        finally:
+            hub_a.close()
+            hub_b.close()
+
+    def test_remote_error_propagates_typed(self):
+        hub_a, hub_b, svc_a, svc_b = make_pair()
+        try:
+            def boom(p, src):
+                raise IllegalArgumentException("bad arg over the wire")
+
+            svc_b.register_handler("boom", boom)
+            with pytest.raises(IllegalArgumentException, match="over the wire"):
+                svc_a.send_request("b", "boom", {})
+        finally:
+            hub_a.close()
+            hub_b.close()
+
+    def test_nested_rpc_no_deadlock(self):
+        """b's handler calls back into a while a waits on b (join->publish
+        pattern); per-request handler threads must prevent deadlock."""
+        hub_a, hub_b, svc_a, svc_b = make_pair()
+        try:
+            svc_a.register_handler("pong", lambda p, src: {"pong": True})
+
+            def ping(p, src):
+                back = svc_b.send_request("a", "pong", {})
+                return {"nested": back}
+
+            svc_b.register_handler("ping", ping)
+            out = svc_a.send_request("b", "ping", {})
+            assert out == {"nested": {"pong": True}}
+        finally:
+            hub_a.close()
+            hub_b.close()
+
+    def test_unknown_peer(self):
+        hub_a = TcpTransportHub()
+        svc_a = TransportService("a", hub_a)
+        try:
+            with pytest.raises(NodeNotConnectedException):
+                svc_a.send_request("ghost", "x", {})
+        finally:
+            hub_a.close()
+
+    def test_dead_peer(self):
+        hub_a, hub_b, svc_a, svc_b = make_pair()
+        hub_b.close()
+        try:
+            with pytest.raises(NodeNotConnectedException):
+                svc_a.send_request("b", "echo", {})
+        finally:
+            hub_a.close()
+
+    def test_concurrent_requests_one_connection(self):
+        import threading
+
+        hub_a, hub_b, svc_a, svc_b = make_pair()
+        try:
+            svc_b.register_handler("sq", lambda p, src: p["n"] * p["n"])
+            results = {}
+
+            def call(n):
+                results[n] = svc_a.send_request("b", "sq", {"n": n})
+
+            threads = [threading.Thread(target=call, args=(n,))
+                       for n in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results == {n: n * n for n in range(16)}
+        finally:
+            hub_a.close()
+            hub_b.close()
+
+
+class Worker:
+    def __init__(self, name):
+        self.name = name
+        script = os.path.join(os.path.dirname(__file__),
+                              "tcp_cluster_worker.py")
+        self.proc = subprocess.Popen(
+            [sys.executable, script, name, "0"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1)
+        ready = json.loads(self._readline(timeout=90))
+        assert ready.get("ready")
+        self.port = ready["port"]
+
+    def _readline(self, timeout=60):
+        import select
+
+        r, _, _ = select.select([self.proc.stdout], [], [], timeout)
+        if not r:
+            raise TimeoutError(f"worker {self.name} silent")
+        return self.proc.stdout.readline()
+
+    def call(self, op, **kw):
+        self.proc.stdin.write(json.dumps({"op": op, **kw}) + "\n")
+        self.proc.stdin.flush()
+        resp = json.loads(self._readline())
+        if not resp.get("ok"):
+            raise RuntimeError(f"{self.name} {op}: {resp.get('error')}")
+        return resp
+
+    def kill(self):
+        self.proc.kill()
+        self.proc.wait()
+
+    def stop(self):
+        if self.proc.poll() is None:
+            try:
+                self.call("exit")
+            except Exception:
+                pass
+            self.proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+class TestThreeProcessCluster:
+    def test_cluster_lifecycle_and_failover(self):
+        workers = {}
+        try:
+            for name in ("n1", "n2", "n3"):
+                workers[name] = Worker(name)
+            # full-mesh address book
+            for a in workers.values():
+                for b in workers.values():
+                    if a is not b:
+                        a.call("add_peer", node=b.name, port=b.port)
+            workers["n1"].call("bootstrap")
+            workers["n2"].call("join", seed="n1")
+            workers["n3"].call("join", seed="n1")
+            st = workers["n1"].call("state")
+            assert st["master"] == "n1"
+            assert sorted(st["nodes"]) == ["n1", "n2", "n3"]
+
+            workers["n1"].call(
+                "create_index", index="logs",
+                settings={"number_of_shards": 2, "number_of_replicas": 1},
+                mappings={"properties": {"msg": {"type": "text"}}})
+            for i in range(20):
+                workers["n1"].call("index", index="logs", id=str(i),
+                                   doc={"msg": f"event number {i}"})
+            workers["n2"].call("refresh", index="logs")
+            res = workers["n2"].call(
+                "search", index="logs",
+                body={"query": {"match": {"msg": "event"}}, "size": 25})
+            assert res["result"]["hits"]["total"] == 20
+
+            # doc readable via another node (routing + remote GET)
+            got = workers["n3"].call("get", index="logs", id="7")
+            assert got["result"]["_source"]["msg"] == "event number 7"
+
+            # kill a data node; master detects + promotes; search survives
+            workers["n2"].kill()
+            departed = workers["n1"].call("check_nodes")["departed"]
+            assert "n2" in departed
+            res = workers["n3"].call(
+                "search", index="logs",
+                body={"query": {"match": {"msg": "event"}}, "size": 25})
+            assert res["result"]["hits"]["total"] == 20
+            routing = workers["n1"].call("routing")["routing"]
+            for copies in routing.values():
+                primaries = [c for c in copies if c["primary"]]
+                assert len(primaries) == 1
+                assert primaries[0]["node"] != "n2"
+        finally:
+            for w in workers.values():
+                try:
+                    w.stop()
+                except Exception:
+                    w.kill()
