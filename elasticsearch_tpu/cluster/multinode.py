@@ -63,6 +63,7 @@ ACTION_QUERY = "indices:data/read/search[phase/query+fetch]"
 ACTION_REFRESH = "indices:admin/refresh[s]"
 ACTION_RECOVER = "internal:index/shard/recovery/start_recovery"
 ACTION_RECOVERY_FINALIZE = "internal:index/shard/recovery/finalize"
+ACTION_MASTER_PING = "internal:discovery/zen/fd/master_ping"
 
 
 class ClusterNode:
@@ -88,7 +89,12 @@ class ClusterNode:
         self.node_info_map: Dict[str, dict] = {}
         self.transport = TransportService(self.node_id, hub)
         self.hub = hub
-        # cluster-state copy (every node holds the latest published state)
+        # cluster-state copy (every node holds the latest published state).
+        # (epoch, version) orders states like the reference's cluster-state
+        # term+version: the epoch bumps at every election, so a deposed
+        # master's re-published state (same version base, old epoch) is
+        # rejected by every node that followed the new master
+        self.cluster_epoch = 0
         self.state_version = 0
         self.indices_meta: Dict[str, IndexMetadata] = {}
         # per-shard primary terms, owned by the master and carried in the
@@ -125,6 +131,7 @@ class ClusterNode:
         t.register_handler(ACTION_REFRESH, self._on_refresh)
         t.register_handler(ACTION_RECOVER, self._on_start_recovery)
         t.register_handler(ACTION_RECOVERY_FINALIZE, self._on_recovery_finalize)
+        t.register_handler(ACTION_MASTER_PING, self._on_master_ping)
 
     @property
     def is_master(self) -> bool:
@@ -140,7 +147,9 @@ class ClusterNode:
             self.master_id = self.node_id
             self.known_nodes = [self.node_id]
             self.node_info_map[self.node_id] = {
-                "attrs": self.attrs, "disk": self.disk_used_fraction}
+                "attrs": self.attrs, "disk": self.disk_used_fraction,
+                "master_eligible": self.master_eligible}
+            self.cluster_epoch = 1
             self.state_version = 1
 
     def join(self, seed_node: str) -> None:
@@ -167,9 +176,10 @@ class ClusterNode:
             self.node_info_map[node] = {
                 "attrs": payload.get("attrs") or {},
                 "disk": payload.get("disk") or 0.0,
+                "master_eligible": bool(payload.get("master_eligible", True)),
             }
-            self._master_reroute_and_publish()
-            return {"master": self.node_id}
+        self._master_reroute_and_publish()
+        return {"master": self.node_id}
 
     def node_left(self, departed: str) -> None:
         """Master-side removal (fault detection outcome or explicit leave)."""
@@ -178,25 +188,171 @@ class ClusterNode:
                 raise IllegalArgumentException("node_left must run on the master")
             if departed in self.known_nodes:
                 self.known_nodes.remove(departed)
-            self._master_reroute_and_publish()
+        self._master_reroute_and_publish()
 
     def check_nodes(self) -> List[str]:
         """Fault detection (NodesFaultDetection): master pings all nodes;
-        unreachable ones are removed. Returns departed node ids."""
+        unreachable ones are removed. A ping answered with a HIGHER
+        cluster epoch means this node was deposed while partitioned — it
+        steps down and rejoins the real cluster (the reference's
+        "another master for the cluster" rejoin). Returns departed ids."""
         departed = []
+        new_cluster: Optional[dict] = None
         with self._lock:
             if not self.is_master:
                 return []
-            for node in list(self.known_nodes):
-                if node == self.node_id:
-                    continue
-                try:
-                    self.transport.send_request(node, ACTION_PUBLISH, None)
-                except NodeNotConnectedException:
-                    departed.append(node)
+            peers = [n for n in self.known_nodes if n != self.node_id]
+            my_epoch = self.cluster_epoch
+        # ping OUTSIDE the lock: a slow peer must not stall every other
+        # master operation for a socket timeout per FD tick
+        for node in peers:
+            try:
+                resp = self.transport.send_request(node, ACTION_PUBLISH, None)
+                resp = resp or {}
+                if (resp.get("epoch", 0) > my_epoch
+                        or (resp.get("epoch", 0) == my_epoch
+                            and (resp.get("master") or self.node_id)
+                            < self.node_id)):
+                    # a cluster with precedence over ours (higher epoch,
+                    # or same epoch under a lower-id master) exists
+                    new_cluster = resp
+                    break
+            except NodeNotConnectedException:
+                departed.append(node)
+        if new_cluster is not None:
+            with self._lock:
+                self.master_id = new_cluster["master"]
+            try:
+                self.join(new_cluster["master"])
+            except NodeNotConnectedException:
+                pass
+            return []
         for node in departed:
             self.node_left(node)
         return departed
+
+    # ------------------------------------------------------------------
+    # Master fault detection + re-election (MasterFaultDetection.java:56,
+    # ZenDiscovery.handleMasterGone -> ElectMasterService: nodes ping the
+    # master; on loss the lowest-id master-eligible survivor elects
+    # itself, bumps the state version and republishes; promotions bump
+    # primary terms, fencing in-flight writes from the deposed master)
+    # ------------------------------------------------------------------
+
+    def _on_master_ping(self, payload, src) -> dict:
+        return {"master": self.master_id, "is_master": self.is_master,
+                "version": self.state_version}
+
+    def _master_eligible_nodes(self, exclude: Optional[str] = None):
+        out = []
+        for n in self.known_nodes:
+            if n == exclude:
+                continue
+            info = self.node_info_map.get(n) or {}
+            eligible = info.get("master_eligible", True)
+            if n == self.node_id:
+                eligible = self.master_eligible
+            if eligible:
+                out.append(n)
+        return sorted(out)
+
+    def check_master(self) -> Optional[str]:
+        """Non-master fault detection: ping the master; on loss run the
+        election. Returns the new master id if one was chosen, else None."""
+        with self._lock:
+            master = self.master_id
+            if master is None or master == self.node_id:
+                return None
+        try:
+            resp = self.transport.send_request(master, ACTION_MASTER_PING,
+                                               None)
+            if resp.get("is_master"):
+                return None
+            # it abdicated/lost an election itself: adopt its view only
+            # after VERIFYING the proposed master is alive and actually
+            # master — blindly adopting could flip us back to a dead node
+            proposed = resp.get("master")
+            if proposed and proposed != master:
+                try:
+                    r2 = self.transport.send_request(
+                        proposed, ACTION_MASTER_PING, None)
+                    if r2.get("is_master"):
+                        with self._lock:
+                            self.master_id = proposed
+                        return proposed
+                except NodeNotConnectedException:
+                    pass
+            # our presumptive master is alive but not (yet) master: stay
+            # put; its own election tick converges the cluster
+            return None
+        except NodeNotConnectedException:
+            pass
+        return self._handle_master_failure(master)
+
+    def _handle_master_failure(self, dead: str) -> Optional[str]:
+        with self._lock:
+            if self.master_id != dead:
+                return self.master_id  # someone already converged us
+            candidates = self._master_eligible_nodes(exclude=dead)
+        # walk candidates in election order, skipping unreachable ones
+        # (a previously-dead node may still linger in known_nodes: it must
+        # not be "elected" just because its id sorts first)
+        for cand in candidates:
+            if cand == self.node_id:
+                break
+            try:
+                self.transport.send_request(cand, ACTION_MASTER_PING, None)
+                break  # lowest REACHABLE eligible node
+            except NodeNotConnectedException:
+                continue
+        else:
+            return None
+        new_master = cand
+        if new_master != self.node_id:
+            # not the winner: adopt the deterministic result; the winner
+            # converges through its own master fault detection tick and
+            # publishes the new state to us
+            with self._lock:
+                if self.master_id == dead:
+                    self.master_id = new_master
+            return new_master
+        with self._lock:
+            if self.master_id != dead:
+                return self.master_id  # lost a race with another publish
+            # assume mastership: bump the epoch (fences the deposed
+            # master's future publishes), drop it, reroute (promotes
+            # its primaries with bumped terms), republish
+            self.master_id = self.node_id
+            self.cluster_epoch += 1
+            if dead in self.known_nodes:
+                self.known_nodes.remove(dead)
+            self.node_info_map.pop(dead, None)
+            self.node_info_map.setdefault(self.node_id, {
+                "attrs": self.attrs, "disk": self.disk_used_fraction,
+                "master_eligible": self.master_eligible})
+        self._master_reroute_and_publish()
+        return self.node_id
+
+    def start_fault_detection(self, interval: float = 1.0) -> None:
+        """Background FD ticker: the master pings all nodes
+        (NodesFaultDetection), everyone else pings the master
+        (MasterFaultDetection)."""
+        if getattr(self, "_fd_thread", None):
+            return
+        self._fd_stop = threading.Event()
+
+        def tick():
+            while not self._fd_stop.wait(interval):
+                try:
+                    if self.is_master:
+                        self.check_nodes()
+                    else:
+                        self.check_master()
+                except Exception:  # noqa: BLE001 — FD must never die
+                    pass
+
+        self._fd_thread = threading.Thread(target=tick, daemon=True)
+        self._fd_thread.start()
 
     def create_index(self, name: str, settings: Optional[dict] = None,
                      mappings: Optional[dict] = None) -> dict:
@@ -214,8 +370,8 @@ class ClusterNode:
                 creation_date=int(time.time() * 1000),
             )
             self.indices_meta[name] = md
-            self._master_reroute_and_publish()
-            return {"acknowledged": True, "index": name}
+        self._master_reroute_and_publish()
+        return {"acknowledged": True, "index": name}
 
     def delete_index(self, name: str) -> dict:
         with self._lock:
@@ -225,8 +381,8 @@ class ClusterNode:
                 raise IndexNotFoundException(name)
             del self.indices_meta[name]
             self.routing.pop(name, None)
-            self._master_reroute_and_publish()
-            return {"acknowledged": True}
+        self._master_reroute_and_publish()
+        return {"acknowledged": True}
 
     def update_node_disk(self, node_id: str, used_fraction: float) -> None:
         """Master-side disk-usage report (DiskThresholdMonitor input);
@@ -241,10 +397,32 @@ class ClusterNode:
 
     def reroute(self) -> None:
         """Explicit reroute (POST /_cluster/reroute analog)."""
-        with self._lock:
-            self._master_reroute_and_publish()
+        self._master_reroute_and_publish()
 
     def _master_reroute_and_publish(self) -> None:
+        """Reroute + self-apply under the lock, then publish to the other
+        nodes OUTSIDE it: a follower's publish handler may synchronously
+        recover replicas and report shard-started back to this master —
+        holding our lock across the publish round-trip would deadlock
+        that nested RPC over a real (TCP) transport. (The in-process hub
+        hid this: same-thread RLock reentrancy.) Callers must therefore
+        NOT hold self._lock when calling this."""
+        with self._lock:
+            state, deferred = self._master_reroute_locked()
+        for action in deferred:  # own-primary started reports etc.
+            action()
+        self._publish_to_followers(state)
+
+    def _publish_to_followers(self, state: dict) -> None:
+        for node in state["nodes"]:
+            if node == self.node_id:
+                continue
+            try:
+                self.transport.send_request(node, ACTION_PUBLISH, state)
+            except NodeNotConnectedException:
+                pass  # fault detection will remove it
+
+    def _master_reroute_locked(self) -> Tuple[dict, list]:
         data_nodes = [n for n in self.known_nodes]  # all nodes are data nodes here
         old_primaries = {
             (index, sid): copy.node_id
@@ -271,17 +449,12 @@ class ClusterNode:
                     self.primary_terms[key] += 1
         self.state_version += 1
         state = self._state_dict()
-        for node in list(self.known_nodes):
-            if node == self.node_id:
-                continue
-            try:
-                self.transport.send_request(node, ACTION_PUBLISH, state)
-            except NodeNotConnectedException:
-                pass  # fault detection will remove it
-        self._apply_state(state)
+        deferred = self._apply_state_locked(state)  # self-apply
+        return state, deferred
 
     def _state_dict(self) -> dict:
         return {
+            "epoch": self.cluster_epoch,
             "version": self.state_version,
             "master": self.master_id,
             "nodes": list(self.known_nodes),
@@ -298,6 +471,16 @@ class ClusterNode:
                 f"{index}#{sid}": term
                 for (index, sid), term in self.primary_terms.items()
             },
+            # every node learns eligibility so any survivor can compute
+            # the deterministic election result (ElectMasterService sorts
+            # master-eligible nodes; lowest id wins)
+            "node_info": {
+                n: {"master_eligible": bool(
+                    info.get("master_eligible", True)),
+                    "attrs": info.get("attrs") or {},
+                    "disk": info.get("disk") or 0.0}
+                for n, info in self.node_info_map.items()
+            },
         }
 
     # ------------------------------------------------------------------
@@ -306,30 +489,61 @@ class ClusterNode:
 
     def _on_publish(self, payload, src) -> dict:
         if payload is None:
-            return {"ok": True}  # ping
+            # ping: answer with our view so a deposed master can notice
+            # the higher-epoch cluster and step down (check_nodes)
+            return {"ok": True, "master": self.master_id,
+                    "epoch": self.cluster_epoch}
         self._apply_state(payload)
         return {"ok": True, "version": payload["version"]}
 
     def _apply_state(self, state: dict) -> None:
         with self._lock:
-            if state["version"] < self.state_version and state["master"] == self.master_id:
-                return  # stale
-            self.state_version = state["version"]
-            self.master_id = state["master"]
-            self.known_nodes = list(state["nodes"])
-            self.indices_meta = {
-                name: IndexMetadata(
-                    name, Settings(info["settings"]), info["mappings"],
-                    state=info.get("state", "open"),
-                )
-                for name, info in state["indices"].items()
-            }
-            self.routing = routing_from_dict(state["routing"])
-            self.primary_terms = {
-                (key.rsplit("#", 1)[0], int(key.rsplit("#", 1)[1])): term
-                for key, term in state.get("primary_terms", {}).items()
-            }
-            self._reconcile_shards()
+            deferred = self._apply_state_locked(state)
+        # recovery + shard-started reporting run OUTSIDE the node lock
+        # (but still synchronously, before the publish response returns):
+        # they issue nested RPCs — a recovery's shard-started report makes
+        # the master publish back to THIS node, which must be able to take
+        # our lock. Holding it here deadlocks the cluster over TCP.
+        for action in deferred:
+            action()
+
+    def _apply_state_locked(self, state: dict) -> list:
+        """Adopt a published state (caller holds self._lock). Returns the
+        deferred recovery/report actions, which the caller MUST run after
+        releasing the lock."""
+        epoch = state.get("epoch", 0)
+        if epoch < self.cluster_epoch:
+            return []  # publish from a deposed master — reject
+        if epoch == self.cluster_epoch:
+            if state["master"] == self.master_id:
+                if state["version"] < self.state_version:
+                    return []  # stale
+            elif state["master"] > (self.master_id or ""):
+                # two independent elections can reach the SAME epoch (each
+                # bumps from its local value); break the tie like the
+                # election does — the lower node id wins — so exactly one
+                # side is rejected and the clusters can converge
+                return []
+        self.cluster_epoch = epoch
+        self.state_version = state["version"]
+        self.master_id = state["master"]
+        self.known_nodes = list(state["nodes"])
+        self.indices_meta = {
+            name: IndexMetadata(
+                name, Settings(info["settings"]), info["mappings"],
+                state=info.get("state", "open"),
+            )
+            for name, info in state["indices"].items()
+        }
+        self.routing = routing_from_dict(state["routing"])
+        self.primary_terms = {
+            (key.rsplit("#", 1)[0], int(key.rsplit("#", 1)[1])): term
+            for key, term in state.get("primary_terms", {}).items()
+        }
+        if state.get("node_info"):
+            self.node_info_map = {
+                n: dict(info) for n, info in state["node_info"].items()}
+        return self._reconcile_shards()
 
     def _mapper_for(self, index: str) -> MapperService:
         if index not in self.mappers:
@@ -339,9 +553,12 @@ class ClusterNode:
             )
         return self.mappers[index]
 
-    def _reconcile_shards(self) -> None:
+    def _reconcile_shards(self) -> list:
         """Create/remove/promote local shards to match the routing table
-        (IndicesClusterStateService: createOrUpdateShards/removeShards)."""
+        (IndicesClusterStateService: createOrUpdateShards/removeShards).
+        Returns deferred recovery/report actions for the caller to run
+        after releasing the node lock (see _apply_state)."""
+        deferred: list = []
         wanted: Dict[Tuple[str, int], ShardRouting] = {}
         for index, shards in self.routing.items():
             for sid, copies in shards.items():
@@ -367,9 +584,11 @@ class ClusterNode:
                 if copy.state == ShardRoutingState.INITIALIZING:
                     if copy.primary:
                         # fresh primary starts empty and reports started
-                        self._report_started(index, sid)
+                        deferred.append(
+                            lambda i=index, s=sid: self._report_started(i, s))
                     else:
-                        self._recover_replica(index, sid)
+                        deferred.append(
+                            lambda i=index, s=sid: self._recover_replica(i, s))
             else:
                 if copy.primary and not shard.primary:
                     # replica promoted: adopt the master-assigned term
@@ -392,7 +611,8 @@ class ClusterNode:
                             tracker.mark_in_sync(other.node_id, -1, force=True)
                     shard.checkpoints = tracker
                 elif copy.state == ShardRoutingState.INITIALIZING and not copy.primary:
-                    self._recover_replica(index, sid)
+                    deferred.append(
+                        lambda i=index, s=sid: self._recover_replica(i, s))
             # every copy (primary or replica) adopts the published term so
             # equal-seqno tie-breaks and zombie-primary fencing work even
             # on copies that saw no write traffic from the new primary
@@ -404,6 +624,7 @@ class ClusterNode:
             if tracker is not None:
                 tracker.prune({c.node_id
                                for c in self.routing.get(index, {}).get(sid, [])})
+        return deferred
 
     def _primary_node(self, index: str, sid: int) -> Optional[str]:
         for copy in self.routing.get(index, {}).get(sid, []):
@@ -425,7 +646,13 @@ class ClusterNode:
             })
         except (NodeNotConnectedException, ElasticsearchTpuException):
             return  # next reroute retries
-        shard = self.shards[(index, sid)]
+        # recovery runs outside the node lock (deferred from
+        # _apply_state): a concurrent newer state may have removed the
+        # local copy in the meantime — bail instead of KeyError-ing
+        # through the publish RPC
+        shard = self.shards.get((index, sid))
+        if shard is None:
+            return
         for op in resp["ops"]:
             self._apply_replicated_op(shard, op)
         shard.refresh()
@@ -580,12 +807,7 @@ class ClusterNode:
                     copy.state = ShardRoutingState.STARTED
             self.state_version += 1
             state = self._state_dict()
-        for node in list(self.known_nodes):
-            if node != self.node_id:
-                try:
-                    self.transport.send_request(node, ACTION_PUBLISH, state)
-                except NodeNotConnectedException:
-                    pass
+        self._publish_to_followers(state)
         self._apply_state(state)
         return {"ok": True}
 
@@ -599,7 +821,7 @@ class ClusterNode:
             self.routing[payload["index"]][payload["shard"]] = [
                 c for c in copies if c.node_id != payload["node"]
             ]
-            self._master_reroute_and_publish()
+        self._master_reroute_and_publish()
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -760,6 +982,8 @@ class ClusterNode:
         return {"ok": True}
 
     def close(self) -> None:
+        if getattr(self, "_fd_stop", None) is not None:
+            self._fd_stop.set()
         for shard in self.shards.values():
             shard.close()
         self.transport.close()

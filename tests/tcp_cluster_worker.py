@@ -70,6 +70,8 @@ def main():
                                                cmd.get("body"))})
             elif op == "check_nodes":
                 reply({"ok": True, "departed": node.check_nodes()})
+            elif op == "check_master":
+                reply({"ok": True, "master": node.check_master()})
             elif op == "state":
                 reply({"ok": True, "master": node.master_id,
                        "nodes": node.known_nodes,

@@ -212,7 +212,8 @@ class TestThreeProcessCluster:
 
             workers["n1"].call(
                 "create_index", index="logs",
-                settings={"number_of_shards": 2, "number_of_replicas": 1},
+                settings={"index": {"number_of_shards": 2,
+                                    "number_of_replicas": 1}},
                 mappings={"properties": {"msg": {"type": "text"}}})
             for i in range(20):
                 workers["n1"].call("index", index="logs", id=str(i),
@@ -240,6 +241,19 @@ class TestThreeProcessCluster:
                 primaries = [c for c in copies if c["primary"]]
                 assert len(primaries) == 1
                 assert primaries[0]["node"] != "n2"
+
+            # kill the MASTER: the surviving node detects the loss over
+            # the socket, elects itself, and keeps serving
+            workers["n1"].kill()
+            new_master = workers["n3"].call("check_master")["master"]
+            assert new_master == "n3"
+            st = workers["n3"].call("state")
+            assert st["master"] == "n3"
+            assert "n1" not in st["nodes"]
+            res = workers["n3"].call(
+                "search", index="logs",
+                body={"query": {"match": {"msg": "event"}}, "size": 25})
+            assert res["result"]["hits"]["total"] == 20
         finally:
             for w in workers.values():
                 try:
