@@ -24,6 +24,9 @@ optimization this path adds later).
 
 from __future__ import annotations
 
+import base64
+import os
+import shutil
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -63,7 +66,16 @@ ACTION_QUERY = "indices:data/read/search[phase/query+fetch]"
 ACTION_REFRESH = "indices:admin/refresh[s]"
 ACTION_RECOVER = "internal:index/shard/recovery/start_recovery"
 ACTION_RECOVERY_FINALIZE = "internal:index/shard/recovery/finalize"
+ACTION_RECOVER_FILES_START = "internal:index/shard/recovery/files/start"
+ACTION_RECOVER_FILE_CHUNK = "internal:index/shard/recovery/files/chunk"
 ACTION_MASTER_PING = "internal:discovery/zen/fd/master_ping"
+
+# phase1 file-chunk size (RecoverySettings.CHUNK_SIZE analog, 512KB)
+RECOVERY_CHUNK_BYTES = 512 * 1024
+# a source-side file session whose target went silent for this long is
+# reclaimed (the reference cancels recoveries on timeout); sessions hold a
+# full in-memory snapshot of the shard's files
+RECOVERY_SESSION_MAX_AGE_S = 600.0
 
 
 class ClusterNode:
@@ -114,6 +126,15 @@ class ClusterNode:
         # publish/apply-state paths (cross-node deadlock avoidance: while
         # held, the only outbound calls are lock-free replica writes)
         self._replication_lock = threading.RLock()
+        # phase1 file-recovery sessions on this node as a recovery SOURCE:
+        # session id -> {"files": {relpath: bytes}, "t0", "sent"}. The file
+        # bytes are snapshotted at session start (the reference holds an
+        # IndexCommit ref instead) so concurrent flush/merge can't mutate
+        # the view mid-transfer.
+        self._recovery_sessions: Dict[str, dict] = {}
+        self._recovery_session_seq = 0
+        # indices.recovery.max_bytes_per_sec analog (None = unthrottled)
+        self.recovery_max_bytes_per_sec: Optional[float] = None
         self._register_handlers()
 
     # ------------------------------------------------------------------
@@ -131,6 +152,10 @@ class ClusterNode:
         t.register_handler(ACTION_REFRESH, self._on_refresh)
         t.register_handler(ACTION_RECOVER, self._on_start_recovery)
         t.register_handler(ACTION_RECOVERY_FINALIZE, self._on_recovery_finalize)
+        t.register_handler(ACTION_RECOVER_FILES_START,
+                           self._on_start_file_recovery)
+        t.register_handler(ACTION_RECOVER_FILE_CHUNK,
+                           self._on_recovery_file_chunk)
         t.register_handler(ACTION_MASTER_PING, self._on_master_ping)
 
     @property
@@ -640,9 +665,19 @@ class ClusterNode:
         primary_node = self._primary_node(index, sid)
         if primary_node is None or primary_node == self.node_id:
             return
+        # phase1: copy the primary's committed segment files in chunks so
+        # a fresh replica doesn't replay the whole history doc-by-doc;
+        # any failure falls back to full ops replay (above_seqno = -1)
+        above_seqno = -1
+        try:
+            above_seqno = self._pull_recovery_files(index, sid, primary_node)
+        except (NodeNotConnectedException, ElasticsearchTpuException,
+                OSError, ValueError):
+            above_seqno = -1
         try:
             resp = self.transport.send_request(primary_node, ACTION_RECOVER, {
                 "index": index, "shard": sid, "target": self.node_id,
+                "above_seqno": above_seqno,
             })
         except (NodeNotConnectedException, ElasticsearchTpuException):
             return  # next reroute retries
@@ -706,7 +741,9 @@ class ClusterNode:
                                primary_term=op.get("primary_term", 1))
 
     def _on_start_recovery(self, payload, src) -> dict:
-        """Primary side: stream live docs as seqno-stamped ops (phase2)."""
+        """Primary side: stream live docs as seqno-stamped ops — phase2
+        replay, above the seqno the file phase already shipped (or the
+        whole history when there was no file phase: above_seqno = -1)."""
         shard = self.shards.get((payload["index"], payload["shard"]))
         if shard is None or not shard.primary:
             raise ElasticsearchTpuException(
@@ -714,13 +751,140 @@ class ClusterNode:
                 f"[{payload['index']}][{payload['shard']}]"
             )
         shard.refresh()
-        ops = self._collect_ops(shard)
+        ops = self._collect_ops(shard, payload.get("above_seqno", -1))
         # the target is tracked (not yet in-sync) until it confirms the
         # replay via the finalize RPC (_on_recovery_finalize)
         tracker = getattr(shard, "checkpoints", None)
         if tracker is not None:
             tracker.initiate_tracking(src)
         return {"ops": ops, "max_seq_no": shard.engine.max_seqno}
+
+    # --- phase1: segment-file shipping (RecoverySourceHandler.phase1) ---
+
+    def _on_start_file_recovery(self, payload, src) -> dict:
+        """Primary side: flush a commit, snapshot the store's files, and
+        open a chunked-transfer session. The target copies segment files
+        instead of replaying the whole history doc-by-doc
+        (indices/recovery/RecoverySourceHandler.java:165)."""
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        if shard is None or not shard.primary:
+            raise ElasticsearchTpuException(
+                f"recovery source is not the primary for "
+                f"[{payload['index']}][{payload['shard']}]")
+        shard.flush()  # durable commit: segments + tombstones + terms
+        store = shard.engine.store
+        commit = store.read_commit() or {}
+        files: Dict[str, bytes] = {}
+        base = store.directory
+        for root, _dirs, names in os.walk(base):
+            for name in names:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, base)
+                with open(full, "rb") as f:
+                    files[rel] = f.read()
+        with self._lock:
+            # reclaim sessions whose targets went silent (died mid-pull)
+            now = time.monotonic()
+            for key in [k for k, v in self._recovery_sessions.items()
+                        if now - v.get("last_used", v["t0"])
+                        > RECOVERY_SESSION_MAX_AGE_S]:
+                del self._recovery_sessions[key]
+            self._recovery_session_seq += 1
+            session = (f"{payload['index']}_{payload['shard']}_{src}_"
+                       f"{self._recovery_session_seq}")
+            self._recovery_sessions[session] = {
+                "files": files, "t0": time.monotonic(),
+                "last_used": time.monotonic(), "sent": 0, "target": src,
+            }
+        manifest = [{"path": p, "size": len(b)} for p, b in files.items()]
+        return {"session": session, "files": manifest,
+                "max_seq_no": int(commit.get("max_seq_no", -1))}
+
+    def _on_recovery_file_chunk(self, payload, src) -> dict:
+        with self._lock:
+            sess = self._recovery_sessions.get(payload["session"])
+        if sess is None:
+            raise ElasticsearchTpuException(
+                f"unknown recovery session [{payload['session']}]")
+        data = sess["files"].get(payload["path"])
+        if data is None:
+            raise ElasticsearchTpuException(
+                f"unknown recovery file [{payload['path']}]")
+        off = int(payload.get("offset", 0))
+        length = min(int(payload.get("length", RECOVERY_CHUNK_BYTES)),
+                     RECOVERY_CHUNK_BYTES)
+        chunk = data[off: off + length]
+        sess["sent"] += len(chunk)
+        sess["last_used"] = time.monotonic()
+        # source-side throttle (indices.recovery.max_bytes_per_sec):
+        # sleep the FULL deficit so low rates are actually honored (a
+        # capped single sleep would floor the effective rate at
+        # chunk_size / cap regardless of the setting)
+        rate = self.recovery_max_bytes_per_sec
+        if rate:
+            ahead = sess["sent"] / rate - (time.monotonic() - sess["t0"])
+            if ahead > 0:
+                time.sleep(min(ahead, 30.0))
+        return {"data": base64.b64encode(chunk).decode("ascii"),
+                "eof": off + len(chunk) >= len(data)}
+
+    def _close_recovery_sessions(self, index: str, sid: int,
+                                 target: str) -> None:
+        prefix = f"{index}_{sid}_{target}_"
+        with self._lock:
+            for key in [k for k in self._recovery_sessions
+                        if k.startswith(prefix)]:
+                del self._recovery_sessions[key]
+
+    def _pull_recovery_files(self, index: str, sid: int,
+                             primary_node: str) -> int:
+        """Target side of phase1: open a session on the primary, pull
+        every committed file in chunks into the local store, and install
+        the segments (store load + version map + tombstone adoption).
+        Returns the max seqno contained in the shipped files (the phase2
+        replay floor). Raises on any mismatch; the caller falls back to
+        full ops replay."""
+        shard = self.shards.get((index, sid))
+        if shard is None:
+            raise ElasticsearchTpuException("local copy vanished")
+        start = self.transport.send_request(
+            primary_node, ACTION_RECOVER_FILES_START, {
+                "index": index, "shard": sid, "target": self.node_id})
+        if not start.get("files") or start.get("max_seq_no", -1) < 0:
+            return -1  # empty primary: nothing to ship, pure ops replay
+        store = shard.engine.store
+        # a retry may leave partial files behind — start clean
+        shutil.rmtree(store.directory, ignore_errors=True)
+        os.makedirs(store.directory, exist_ok=True)
+        for entry in start["files"]:
+            rel, size = entry["path"], entry["size"]
+            full = os.path.join(store.directory, rel)
+            os.makedirs(os.path.dirname(full) or store.directory,
+                        exist_ok=True)
+            with open(full, "wb") as f:
+                offset = 0
+                while offset < size:
+                    chunk = self.transport.send_request(
+                        primary_node, ACTION_RECOVER_FILE_CHUNK, {
+                            "session": start["session"], "path": rel,
+                            "offset": offset,
+                            "length": RECOVERY_CHUNK_BYTES})
+                    data = base64.b64decode(chunk["data"])
+                    if not data and not chunk.get("eof"):
+                        raise ElasticsearchTpuException(
+                            f"empty non-final chunk for [{rel}]")
+                    f.write(data)
+                    offset += len(data)
+                    if chunk.get("eof"):
+                        break
+            if os.path.getsize(full) != size:
+                raise ElasticsearchTpuException(
+                    f"short file [{rel}]: {os.path.getsize(full)} != {size}")
+        # install: load the shipped commit (verifies per-segment
+        # checksums), rebuild the version map and tombstones — the same
+        # path a restarting node uses (IndexShard.recover_from_store)
+        shard.recover_from_store()
+        return int(start["max_seq_no"])
 
     @staticmethod
     def _collect_ops(shard, above_seqno: int = -1) -> list:
@@ -788,7 +952,10 @@ class ClusterNode:
                 # applied after this RPC returns and the next write ack
                 # advances the checkpoint
                 tracker.mark_in_sync(src, payload["local_checkpoint"])
-            return {"ok": True, "ops": delta}
+        # phase1 file session no longer needed once the target reached
+        # the finalize stage — free the snapshot bytes
+        self._close_recovery_sessions(payload["index"], payload["shard"], src)
+        return {"ok": True, "ops": delta}
 
     def _report_started(self, index: str, sid: int) -> None:
         try:

@@ -87,6 +87,20 @@ class IndexShard:
         self.state = ShardState.RECOVERING
         segments = self.engine.store.load_segments()
         self.engine.segments = segments
+        # advance the segment-name counter past every recovered name: a
+        # fresh engine restarts at 0, and a later seal reusing an existing
+        # name would make store.commit() skip writing the new segment and
+        # clobber the old one's live mask — silent data loss on the next
+        # flush (bites both restart recovery and peer file recovery)
+        for seg in segments:
+            tail = seg.name.rsplit("_", 1)[-1]
+            if tail.isdigit():
+                self.engine._segment_counter = max(
+                    self.engine._segment_counter, int(tail))
+        # the in-progress buffer was named with the stale counter at
+        # engine construction; rename it clear of the recovered names
+        if self.engine.buffer.num_docs == 0:
+            self.engine.buffer = self.engine._new_builder()
         commit = self.engine.store.read_commit() or {}
         doc_terms = commit.get("doc_terms", {})
         max_seq = -1
