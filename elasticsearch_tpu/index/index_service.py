@@ -88,6 +88,16 @@ class IndexService:
         # (index.search.mesh: true default; false = host merge only)
         self._mesh_search = None
         self._mesh_enabled = settings.get_bool("index.search.mesh", True)
+        # shard request cache (IndicesRequestCache.java:64): size==0
+        # (agg/count) responses cached against the shards' visibility
+        # epochs; index.requests.cache.enable gates it (default on)
+        from elasticsearch_tpu.index.request_cache import RequestCache
+
+        self._request_cache_enabled = settings.get_bool(
+            "index.requests.cache.enable", True)
+        cache_bytes = settings.get_int(
+            "index.requests.cache.size_in_bytes", 8 * 1024 * 1024)
+        self.request_cache = RequestCache(max_bytes=cache_bytes)
         iv = settings.get_time("index.refresh_interval")
         self.refresh_interval = 1.0 if iv is None else iv
         self._refresh_stop = None
@@ -236,8 +246,33 @@ class IndexService:
 
     def search(self, body: Optional[dict] = None,
                preference_shards: Optional[List[int]] = None) -> dict:
+        from elasticsearch_tpu.index.request_cache import (
+            RequestCache,
+            cacheable,
+            shard_epoch,
+        )
+
         t0 = time.monotonic()
         body = body or {}
+        cache_key = None
+        if (self._request_cache_enabled and preference_shards is None
+                and cacheable(body)):
+            epochs = [shard_epoch(self.shards[sid])
+                      for sid in sorted(self.shards)]
+            cache_key = RequestCache.key_for(body, epochs)
+            if cache_key is not None:
+                cached = self.request_cache.get(cache_key)
+                if cached is not None:
+                    cached["took"] = int((time.monotonic() - t0) * 1000)
+                    return cached
+        resp = self._search_uncached(body, preference_shards)
+        if cache_key is not None:
+            self.request_cache.put(cache_key, resp)
+        return resp
+
+    def _search_uncached(self, body: dict,
+                         preference_shards: Optional[List[int]] = None) -> dict:
+        t0 = time.monotonic()
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size")) if body.get("size") is not None else 10
         k = from_ + size
@@ -365,6 +400,7 @@ class IndexService:
             "translog": {
                 "operations": sum(s["translog"]["operations"] for s in shard_stats.values()),
             },
+            "request_cache": self.request_cache.stats(),
         }
         return {"primaries": totals, "total": totals, "shards": shard_stats}
 
