@@ -159,11 +159,21 @@ class Engine:
             if version is not None and version_type == "internal":
                 if current_version != version:
                     raise VersionConflictEngineException(doc_id, current_version, version)
+            elif version is not None and version_type == "external":
+                # VersionType.EXTERNAL: strictly greater, equality conflicts
+                if version <= current_version:
+                    raise VersionConflictEngineException(
+                        doc_id, current_version, version)
+            elif version is not None and version_type == "external_gte":
+                if version < current_version:
+                    raise VersionConflictEngineException(
+                        doc_id, current_version, version)
             if replicated_version is not None:
                 new_version = replicated_version
             else:
                 new_version = (
-                    version if version_type == "external" and version is not None
+                    version if version is not None
+                    and version_type in ("external", "external_gte")
                     else current_version + 1
                 )
             if seqno is None:
@@ -198,7 +208,8 @@ class Engine:
     def delete(self, doc_id: str, version: Optional[int] = None,
                seqno: Optional[int] = None, add_to_translog: bool = True,
                replicated_version: Optional[int] = None,
-               primary_term: int = 1) -> dict:
+               primary_term: int = 1,
+               version_type: str = "internal") -> dict:
         with self._lock:
             existing = self.version_map.get(doc_id)
             if (seqno is not None and existing is not None
@@ -216,14 +227,35 @@ class Engine:
                 }
             found = existing is not None and not existing.deleted
             current_version = existing.version if found else 0
-            if version is not None and current_version != version:
-                raise VersionConflictEngineException(doc_id, current_version, version)
+            external_delete = False
+            if version is not None:
+                if version_type == "external":
+                    # VersionType.EXTERNAL.isVersionConflictForWrites:
+                    # conflict unless the provided version is STRICTLY
+                    # greater (equality conflicts; only external_gte
+                    # accepts it)
+                    if version <= current_version:
+                        raise VersionConflictEngineException(
+                            doc_id, current_version, version)
+                    external_delete = True
+                elif version_type == "external_gte":
+                    if version < current_version:
+                        raise VersionConflictEngineException(
+                            doc_id, current_version, version)
+                    external_delete = True
+                elif current_version != version:
+                    raise VersionConflictEngineException(
+                        doc_id, current_version, version)
             if seqno is None:
                 seqno = self._next_seqno()
             else:
                 self.note_external_seqno(seqno)
-            new_version = (replicated_version if replicated_version is not None
-                           else current_version + 1)
+            if replicated_version is not None:
+                new_version = replicated_version
+            elif external_delete:
+                new_version = version
+            else:
+                new_version = current_version + 1
             if found:
                 self._tombstone(existing)
                 self.version_map[doc_id] = VersionEntry(

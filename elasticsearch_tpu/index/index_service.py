@@ -37,6 +37,9 @@ class IndexService:
     def __init__(self, name: str, settings: Settings = Settings.EMPTY,
                  mapping: Optional[dict] = None, data_path: Optional[str] = None):
         self.name = name
+        # 6.x single-type name (custom names deprecated, echoed in
+        # document/search/mapping responses; _doc canonical)
+        self.doc_type = "_doc"
         self.settings = settings
         self.creation_date = int(time.time() * 1000)
         self.uuid = f"{name}-{self.creation_date:x}"

@@ -167,10 +167,12 @@ class IndexShard:
                   doc_id, str(source)[: self.indexing_slowlog_source_chars])
 
     def delete_doc(self, doc_id: str, version: Optional[int] = None,
-                   seqno: Optional[int] = None) -> dict:
+                   seqno: Optional[int] = None,
+                   version_type: str = "internal") -> dict:
         self._ensure_started()
         r = self.engine.delete(doc_id, version, seqno,
-                               primary_term=self.primary_term)
+                               primary_term=self.primary_term,
+                               version_type=version_type)
         r["_index"] = self.index_name
         r["_primary_term"] = self.primary_term
         return r

@@ -114,8 +114,7 @@ class Node:
             raise IndexAlreadyExistsException(name)
         settings = Settings.from_dict(body.get("settings") or {})
         mappings = body.get("mappings") or {}
-        if "_doc" in mappings or "doc" in mappings:  # typed mapping form
-            mappings = mappings.get("_doc") or mappings.get("doc")
+        mappings, doc_type = _unwrap_typed_mapping(mappings)
         aliases = {a: (spec or {}) for a, spec in (body.get("aliases") or {}).items()}
 
         # apply matching templates, lowest order first (MetaDataCreateIndexService)
@@ -142,6 +141,7 @@ class Node:
         self.index_scoped_settings.validate(merged_settings, allow_unknown=True)
         svc = IndexService(name, merged_settings, merged_mappings,
                            self._index_data_path(name))
+        svc.doc_type = doc_type  # 6.x custom type name echoed in responses
         self.indices[name] = svc
 
         def update(state: ClusterState) -> ClusterState:
@@ -365,6 +365,8 @@ class Node:
             out["_version"] = g.version
             out["_seq_no"] = g.seqno
             out["_source"] = g.source
+            if routing is not None:
+                out["_routing"] = routing
         return out
 
     def delete_doc(self, index: str, doc_id: str, routing=None, refresh=None, **kw) -> dict:
@@ -375,22 +377,45 @@ class Node:
 
     def update_doc(self, index: str, doc_id: str, body: dict, routing=None,
                    refresh=None) -> dict:
-        svc = self.index_service(index)
+        # upserts auto-create the index like every other write
+        # (TransportUpdateAction resolves through auto-create)
+        auto = "upsert" in (body or {}) or (body or {}).get("doc_as_upsert")
+        svc = self.index_service(index, auto_create=bool(auto))
         r = svc.update_doc(doc_id, body, routing)
         self._maybe_refresh(svc, refresh)
         self._maybe_update_mapping_meta(index)
         return r
 
-    def mget(self, body: dict, default_index: Optional[str] = None) -> dict:
+    def mget(self, body: dict, default_index: Optional[str] = None,
+             default_type: Optional[str] = None) -> dict:
+        specs = body.get("docs")
+        if specs is None and "ids" in body:
+            # short form: {"ids": [...]} against the URL's index
+            specs = [{"_id": i} for i in body["ids"]]
         docs = []
-        for spec in body.get("docs", []):
+        for spec in specs or []:
             index = spec.get("_index", default_index)
+            if "_id" not in spec:
+                docs.append({
+                    "_index": index,
+                    "_type": spec.get("_type", default_type) or "_doc",
+                    "error": {
+                        "type": "action_request_validation_exception",
+                        "reason": "Validation Failed: 1: id is missing;",
+                    },
+                })
+                continue
+            routing = spec.get("routing", spec.get("_routing"))
             try:
-                docs.append(self.get_doc(index, spec["_id"], spec.get("routing")))
+                d = self.get_doc(index, str(spec["_id"]), routing)
+                d["_type"] = spec.get("_type", default_type) or "_doc"
+                docs.append(d)
             except IndexNotFoundException:
                 docs.append({
                     "_index": index, "_id": spec["_id"],
-                    "error": {"type": "index_not_found_exception"},
+                    "_type": spec.get("_type", default_type) or "_doc",
+                    "error": {"type": "index_not_found_exception",
+                              "reason": f"no such index [{index}]"},
                 })
         return {"docs": docs}
 
@@ -1073,6 +1098,25 @@ class Node:
                 self._persist_index_meta(name)
                 self.indices[name].flush()
             self.indices[name].close()
+
+
+MAPPING_TOP_LEVEL_KEYS = {
+    "properties", "dynamic", "dynamic_templates", "_source", "_meta",
+    "_routing", "_all", "_field_names", "_size", "date_detection",
+    "numeric_detection", "dynamic_date_formats",
+}
+
+
+def _unwrap_typed_mapping(mappings):
+    """6.x typed mapping form: {"my_type": {...}} wraps the real mapping
+    in a single custom type name (deprecated; _doc canonical). Returns
+    (mapping, type_name)."""
+    if (isinstance(mappings, dict) and len(mappings) == 1):
+        (key, inner), = mappings.items()
+        if (key not in MAPPING_TOP_LEVEL_KEYS and isinstance(inner, dict)
+                and (not inner or set(inner) & MAPPING_TOP_LEVEL_KEYS)):
+            return inner, key
+    return mappings, "_doc"
 
 
 def _template_matches(template: dict, index_name: str) -> bool:

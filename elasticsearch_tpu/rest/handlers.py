@@ -37,8 +37,16 @@ def register_all(c) -> None:
     r("PUT", "/{index}/{type}/{id}", _index_doc)
     r("POST", "/{index}/{type}/{id}", _index_doc)
     r("GET", "/{index}/{type}/{id}", _get_doc)
+    r("HEAD", "/{index}/{type}/{id}", _head_doc)
     r("DELETE", "/{index}/{type}/{id}", _delete_doc)
     r("POST", "/{index}/{type}/{id}/_update", _update_doc)
+    r("PUT", "/{index}/{type}/{id}/_create", _create_doc)
+    r("POST", "/{index}/{type}/{id}/_create", _create_doc)
+    r("PUT", "/{index}/_create/{id}", _create_doc)
+    r("POST", "/{index}/_create/{id}", _create_doc)
+    r("GET", "/{index}/{type}/{id}/_explain", _explain)
+    r("POST", "/{index}/{type}/{id}/_explain", _explain)
+    r("GET", "/{index}/{type}/{id}/_source", _get_source)
     r("POST", "/_mget", _mget)
     r("POST", "/{index}/_mget", _mget)
     r("GET", "/_mget", _mget)
@@ -48,7 +56,11 @@ def register_all(c) -> None:
     r("PUT", "/_bulk", _bulk)
     r("POST", "/{index}/_bulk", _bulk)
 
-    # --- search family ---
+    # --- search family (typed 6.x forms included) ---
+    r("GET", "/{index}/{type}/_search", _search)
+    r("POST", "/{index}/{type}/_search", _search)
+    r("GET", "/{index}/{type}/_count", _count)
+    r("POST", "/{index}/{type}/_count", _count)
     r("GET", "/_search", _search)
     r("POST", "/_search", _search)
     r("GET", "/{index}/_search", _search)
@@ -271,7 +283,45 @@ def _typed_api_warning(req) -> None:
             "use /{index}/_doc/{id} instead")
 
 
-def _index_doc(node, req):
+def _doc_type_of(node, index):
+    svc = node.indices.get(index)
+    return getattr(svc, "doc_type", "_doc") if svc is not None else "_doc"
+
+
+def _echo_type(req, r, node=None):
+    """6.x typed-path compatibility: document API responses echo the
+    type from the request path (custom types are deprecated but legal);
+    type `_all` resolves to the index's actual type."""
+    if isinstance(r, dict):
+        t = req.param("type")
+        if (t is None or t == "_all") and node is not None:
+            t = _doc_type_of(node, req.param("index"))
+        r["_type"] = t or "_doc"
+    return r
+
+
+def _write_shards_header(node, req, r):
+    """Single-doc write responses carry the replication-group header
+    (ReplicationResponse.ShardInfo): total = 1 primary + replicas."""
+    if isinstance(r, dict) and "_shards" not in r:
+        try:
+            svc = node.index_service(req.param("index"))
+            total = 1 + svc.num_replicas
+        except Exception:  # noqa: BLE001 — header is best-effort
+            total = 1
+        r["_shards"] = {"total": total, "successful": 1, "failed": 0}
+    return r
+
+
+def _forced_refresh(req, r):
+    """refresh=true responses carry forced_refresh
+    (TransportWriteAction.WriteResponse.setForcedRefresh)."""
+    if isinstance(r, dict) and req.param("refresh") in ("", "true", True):
+        r["forced_refresh"] = True
+    return r
+
+
+def _index_doc(node, req, force_create: bool = False):
     _typed_api_warning(req)
     body = req.json_body()
     if body is None:
@@ -280,14 +330,19 @@ def _index_doc(node, req):
     if req.param("version") is not None:
         kw["version"] = int(req.param("version"))
         kw["version_type"] = req.param("version_type", "internal")
-    if req.param("op_type") == "create":
+    if force_create or req.param("op_type") == "create":
         kw["op_type"] = "create"
     r = node.index_doc(req.param("index"), req.param("id"), body,
                        routing=req.param("routing"), refresh=req.param("refresh"),
                        pipeline=req.param("pipeline"),
                        wait_for_active_shards=req.param("wait_for_active_shards"),
                        **kw)
+    _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return (201 if r.get("result") == "created" else 200), r
+
+
+def _create_doc(node, req):
+    return _index_doc(node, req, force_create=True)
 
 
 def _index_doc_auto_id(node, req):
@@ -298,12 +353,39 @@ def _index_doc_auto_id(node, req):
                        routing=req.param("routing"), refresh=req.param("refresh"),
                        pipeline=req.param("pipeline"),
                        wait_for_active_shards=req.param("wait_for_active_shards"))
+    _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return 201, r
+
+
+def _apply_source_filtering(req, r):
+    """_source=false / _source=a,b / _source_include(s) / _source_exclude(s)
+    on single-doc GETs (FetchSourceContext.parseFromRestRequest) — same
+    filter_source the search fetch phase uses, so dotted paths and
+    wildcards behave identically on both surfaces."""
+    if not isinstance(r, dict) or "_source" not in r:
+        return r
+    from elasticsearch_tpu.search.service import filter_source
+
+    src_param = req.param("_source")
+    includes = req.param("_source_includes") or req.param("_source_include")
+    excludes = req.param("_source_excludes") or req.param("_source_exclude")
+    if src_param is None and includes is None and excludes is None:
+        return r
+    if src_param is not None and src_param.lower() == "false":
+        del r["_source"]
+        return r
+    if src_param is not None and src_param.lower() != "true":
+        includes = src_param
+    inc = [f.strip() for f in includes.split(",")] if includes else None
+    exc = [f.strip() for f in excludes.split(",")] if excludes else None
+    r["_source"] = filter_source(r["_source"], inc, exc)
+    return r
 
 
 def _get_doc(node, req):
     _typed_api_warning(req)
     r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
+    _echo_type(req, _apply_source_filtering(req, r), node)
     return (200 if r["found"] else 404), r
 
 
@@ -321,8 +403,14 @@ def _get_source(node, req):
 
 def _delete_doc(node, req):
     _typed_api_warning(req)
+    kw = {}
+    if req.param("version") is not None:
+        kw["version"] = int(req.param("version"))
+        kw["version_type"] = req.param("version_type", "internal")
     r = node.delete_doc(req.param("index"), req.param("id"),
-                        routing=req.param("routing"), refresh=req.param("refresh"))
+                        routing=req.param("routing"),
+                        refresh=req.param("refresh"), **kw)
+    _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return (200 if r.get("found") else 404), r
 
 
@@ -330,11 +418,31 @@ def _update_doc(node, req):
     _typed_api_warning(req)
     r = node.update_doc(req.param("index"), req.param("id"), req.json_body({}),
                         routing=req.param("routing"), refresh=req.param("refresh"))
+    _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
+    src_param = req.param("_source")
+    want_get = (req.param("fields")
+                or (src_param is not None and src_param.lower() != "false"))
+    if want_get and r.get("result") != "noop":
+        from elasticsearch_tpu.search.service import filter_source
+
+        g = node.get_doc(req.param("index"), req.param("id"),
+                         req.param("routing"))
+        if g.get("found"):
+            src = g["_source"]
+            if src_param and src_param.lower() != "true":
+                src = filter_source(src, src_param.split(","), None)
+            get_sec = {"found": True, "_source": src}
+            if req.param("fields"):
+                want = req.param("fields").split(",")
+                get_sec["fields"] = {f: [g["_source"][f]]
+                                     for f in want if f in g["_source"]}
+            r["get"] = get_sec
     return 200, r
 
 
 def _mget(node, req):
-    return 200, node.mget(req.json_body({}), req.param("index"))
+    return 200, node.mget(req.json_body({}), req.param("index"),
+                          req.param("type"))
 
 
 def _bulk(node, req):
@@ -392,7 +500,17 @@ def _search_body(req):
 
 def _search(node, req):
     body = _search_body(req)
-    return 200, node.search(req.param("index", "_all"), body, scroll=req.param("scroll"))
+    resp = node.search(req.param("index", "_all"), body,
+                       scroll=req.param("scroll"))
+    _echo_hit_types(node, resp)
+    return 200, resp
+
+
+def _echo_hit_types(node, resp):
+    """Hits echo their index's 6.x type name (custom types deprecated)."""
+    for hit in (resp.get("hits", {}) or {}).get("hits", []):
+        if isinstance(hit, dict) and hit.get("_type") == "_doc":
+            hit["_type"] = _doc_type_of(node, hit.get("_index"))
 
 
 def _scroll(node, req):
@@ -631,10 +749,19 @@ def _put_mapping(node, req):
 
 def _get_mapping(node, req):
     state = node.cluster_service.state
+    want_type = req.param("type")
     out = {}
     for name in state.resolve_index_names(req.param("index", "_all")):
         svc = node.indices[name]
-        out[name] = {"mappings": {"_doc": svc.mapping_dict()}}
+        dt = getattr(svc, "doc_type", "_doc")
+        if want_type and want_type not in (dt, "_all"):
+            continue
+        out[name] = {"mappings": {dt: svc.mapping_dict()}}
+    if want_type and not out:
+        from elasticsearch_tpu.common.errors import (
+            ResourceNotFoundException,
+        )
+        raise ResourceNotFoundException(f"type[[{want_type}]] missing")
     return 200, out
 
 

@@ -1,0 +1,2 @@
+"""Test infrastructure: the YAML REST conformance runner and helpers
+(the analog of the reference's test/framework module)."""

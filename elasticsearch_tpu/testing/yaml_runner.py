@@ -1,0 +1,479 @@
+"""YAML REST conformance runner.
+
+Executes the reference's language-agnostic REST test suite
+(rest-api-spec/src/main/resources/rest-api-spec/test/**) against this
+engine's HTTP surface — the compatibility metric SURVEY §4.6.4 calls
+"reusable nearly verbatim". Role model:
+test/framework/src/main/java/org/elasticsearch/test/rest/yaml/
+ESClientYamlSuiteTestCase.java and its section classes (DoSection,
+MatchAssertion, LengthAssertion, SetSection, SkipSection).
+
+Requests are constructed generically from the reference's API specs
+(rest-api-spec/src/main/resources/rest-api-spec/api/*.json): the best
+matching URL template is the longest whose {parts} are all provided;
+remaining arguments become query params; `body` is JSON (or newline-
+delimited JSON for bulk-style endpoints).
+
+Supported step types: do (with catch), match (incl. /regex/ values and
+$stash substitution), length, is_true, is_false, gt/gte/lt/lte, set.
+Skip sections honor `version` ranges (this engine presents as 6.0.0)
+and a feature allowlist.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+# what we present to `skip: version:` ranges (the reference line we track)
+ENGINE_VERSION = (6, 0, 0)
+SUPPORTED_FEATURES = {"stash_in_path", "stash_in_key"}
+
+
+class YamlTestSkipped(Exception):
+    pass
+
+
+class YamlTestFailure(AssertionError):
+    pass
+
+
+# ----------------------------------------------------------------------
+# API specs
+# ----------------------------------------------------------------------
+
+
+class ApiSpecs:
+    def __init__(self, api_dir: str):
+        import os
+
+        self.apis: Dict[str, dict] = {}
+        for name in os.listdir(api_dir):
+            if not name.endswith(".json") or name == "_common.json":
+                continue
+            with open(os.path.join(api_dir, name), encoding="utf-8") as f:
+                spec = json.load(f)
+            for api_name, api in spec.items():
+                self.apis[api_name] = api
+
+    def build_request(self, api_name: str, args: dict
+                      ) -> Tuple[str, str, dict, Any]:
+        """Returns (method, path, query_params, body)."""
+        api = self.apis.get(api_name)
+        if api is None:
+            raise YamlTestFailure(f"unknown api [{api_name}]")
+        args = dict(args)
+        body = args.pop("body", None)
+        url = api["url"]
+        part_names = set((url.get("parts") or {}).keys())
+        # choose the longest path whose {parts} are all provided
+        best, best_parts = None, -1
+        for path in url.get("paths", [url.get("path")]):
+            parts = re.findall(r"{(\w+)}", path)
+            if all(p in args and args[p] is not None for p in parts):
+                if len(parts) > best_parts:
+                    best, best_parts = path, len(parts)
+        if best is None:
+            raise YamlTestFailure(
+                f"[{api_name}] no path matches args {sorted(args)}")
+        path = best
+        used = set()
+        for p in re.findall(r"{(\w+)}", path):
+            val = args[p]
+            if isinstance(val, (list, tuple)):
+                val = ",".join(str(v) for v in val)
+            path = path.replace("{" + p + "}",
+                                urllib.parse.quote(str(val), safe=""))
+            used.add(p)
+        params = {k: v for k, v in args.items()
+                  if k not in used and k not in part_names and v is not None}
+        methods = api.get("methods", ["GET"])
+        if body is not None and "GET" in methods and len(methods) > 1:
+            method = next(m for m in methods if m != "GET")
+        elif body is not None and methods == ["GET"]:
+            method = "GET"
+        else:
+            method = methods[0]
+        # prefer PUT for doc-targeting index/create calls (id in path)
+        if api_name in ("index", "create") and "{id}" in best:
+            method = "PUT" if "PUT" in methods else method
+        return method, path, params, body
+
+
+# ----------------------------------------------------------------------
+# Stash + response path lookups
+# ----------------------------------------------------------------------
+
+
+def stash_sub(value: Any, stash: dict) -> Any:
+    if isinstance(value, str):
+        if value.startswith("$"):
+            key = value[1:]
+            if key in stash:
+                return stash[key]
+        # ${...} inline form
+        def repl(m):
+            return str(stash.get(m.group(1), m.group(0)))
+
+        return re.sub(r"\$\{(\w+)\}", repl, value)
+    if isinstance(value, dict):
+        return {stash_sub(k, stash): stash_sub(v, stash)
+                for k, v in value.items()}
+    if isinstance(value, list):
+        return [stash_sub(v, stash) for v in value]
+    return value
+
+
+def lookup(resp: Any, path: str, stash: dict) -> Any:
+    """Dotted-path lookup with numeric indices, \\. escapes and $stash."""
+    if path in ("$body", ""):
+        return resp
+    cur = resp
+    for raw in re.split(r"(?<!\\)\.", path):
+        key = raw.replace("\\.", ".")
+        key = stash_sub(key, stash)
+        if isinstance(key, str) and key.startswith("$"):
+            key = stash.get(key[1:], key)
+        if isinstance(cur, list):
+            cur = cur[int(key)]
+        elif isinstance(cur, dict):
+            if key not in cur and str(key) in cur:
+                key = str(key)
+            cur = cur[key]
+        else:
+            raise YamlTestFailure(
+                f"cannot descend into {type(cur).__name__} at [{key}] "
+                f"of path [{path}]")
+    return cur
+
+
+def values_match(expected: Any, actual: Any) -> bool:
+    if isinstance(expected, str) and len(expected) > 1 \
+            and expected.startswith("/") and expected.rstrip().endswith("/"):
+        pattern = expected.strip().strip("/")
+        return re.search(pattern, str(actual), re.VERBOSE) is not None
+    if isinstance(expected, dict) and isinstance(actual, dict):
+        return all(k in actual and values_match(v, actual[k])
+                   for k, v in expected.items())
+    if isinstance(expected, list) and isinstance(actual, list):
+        return (len(expected) == len(actual)
+                and all(values_match(e, a)
+                        for e, a in zip(expected, actual)))
+    if isinstance(expected, bool) or isinstance(actual, bool):
+        return bool(expected) == bool(actual) \
+            and isinstance(expected, type(actual))
+    if isinstance(expected, (int, float)) and isinstance(actual, (int, float)):
+        return float(expected) == float(actual)
+    if isinstance(expected, (int, float)) and isinstance(actual, str):
+        try:
+            return float(expected) == float(actual)
+        except ValueError:
+            return False
+    return expected == actual
+
+
+# ----------------------------------------------------------------------
+# Skip sections
+# ----------------------------------------------------------------------
+
+
+def _parse_version(s: str) -> Tuple[int, ...]:
+    nums = re.findall(r"\d+", s)
+    return tuple(int(n) for n in nums[:3]) if nums else (0, 0, 0)
+
+
+def should_skip(skip: dict) -> Optional[str]:
+    features = skip.get("features") or []
+    if isinstance(features, str):
+        features = [features]
+    unsupported = [f for f in features if f not in SUPPORTED_FEATURES]
+    if unsupported:
+        return f"features {unsupported}"
+    version = skip.get("version")
+    if version:
+        if str(version).strip().lower() == "all":
+            return "version: all"
+        m = re.match(r"\s*(\S*)\s*-\s*(\S*)\s*", str(version))
+        if m:
+            lo = _parse_version(m.group(1)) if m.group(1) else (0, 0, 0)
+            hi = (_parse_version(m.group(2)) if m.group(2)
+                  else (99, 99, 99))
+            if lo <= ENGINE_VERSION <= hi:
+                return f"version range {version}"
+    return None
+
+
+# ----------------------------------------------------------------------
+# Runner
+# ----------------------------------------------------------------------
+
+CATCH_STATUS = {
+    "missing": {404},
+    "conflict": {409},
+    "forbidden": {403},
+    "unauthorized": {401},
+    "request_timeout": {408},
+    "bad_request": {400},
+}
+
+
+class YamlTestClient:
+    """HTTP client against the engine's REST server."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+
+    def request(self, method: str, path: str, params: dict, body) -> Tuple[int, Any]:
+        url = self.base_url + (path if path.startswith("/") else "/" + path)
+        if params:
+            def flat_one(v):
+                if isinstance(v, bool):
+                    return "true" if v else "false"  # not python's "True"
+                if isinstance(v, (list, tuple)):
+                    return ",".join(flat_one(x) for x in v)
+                return str(v)
+
+            url += "?" + urllib.parse.urlencode(
+                {k: flat_one(v) for k, v in params.items()})
+        data = None
+        headers = {}
+        if body is not None:
+            if isinstance(body, (list, tuple)):
+                # bulk-style: newline-delimited JSON; string elements are
+                # already-serialized lines (bulk/20_list_of_strings.yml)
+                data = ("\n".join(
+                    x.strip() if isinstance(x, str) else json.dumps(x)
+                    for x in body) + "\n").encode()
+                headers["Content-Type"] = "application/x-ndjson"
+            elif isinstance(body, str):
+                data = body.encode()
+                headers["Content-Type"] = "application/json"
+            else:
+                data = json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req) as resp:
+                raw = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+        if not raw:
+            # cat/text endpoints legitimately return empty bodies the
+            # tests regex-match against ^$
+            return status, ""
+        try:
+            return status, json.loads(raw)
+        except json.JSONDecodeError:
+            return status, raw.decode("utf-8", "replace")
+
+
+class YamlTestRunner:
+    def __init__(self, specs: ApiSpecs, client: YamlTestClient):
+        self.specs = specs
+        self.client = client
+
+    # -- one file ------------------------------------------------------
+
+    def run_file(self, path: str) -> List[str]:
+        """Run every test doc in a YAML file. Returns the executed test
+        names; raises YamlTestFailure on the first failing assertion and
+        YamlTestSkipped if the whole file is skipped."""
+        with open(path, encoding="utf-8") as f:
+            docs = list(yaml.safe_load_all(f))
+        setup_steps: List[dict] = []
+        teardown_steps: List[dict] = []
+        tests: List[Tuple[str, list]] = []
+        for doc in docs:
+            if not doc:
+                continue
+            for name, steps in doc.items():
+                if name == "setup":
+                    setup_steps = steps
+                elif name == "teardown":
+                    teardown_steps = steps
+                else:
+                    tests.append((name, steps))
+        # file-level skip lives in the setup section
+        for step in setup_steps:
+            if "skip" in step:
+                reason = should_skip(step["skip"])
+                if reason:
+                    raise YamlTestSkipped(f"setup skip: {reason}")
+        executed = []
+        for name, steps in tests:
+            skip_reason = None
+            for step in steps:
+                if "skip" in step:
+                    skip_reason = should_skip(step["skip"])
+                    if skip_reason:
+                        break
+            if skip_reason:
+                continue
+            stash: Dict[str, Any] = {}
+            try:
+                for step in setup_steps:
+                    self.run_step(step, stash, where=f"{name}/setup")
+                for step in steps:
+                    self.run_step(step, stash, where=name)
+            finally:
+                for step in teardown_steps:
+                    try:
+                        self.run_step(step, stash, where=f"{name}/teardown")
+                    except Exception:
+                        pass
+                self.wipe()
+            executed.append(name)
+        return executed
+
+    def wipe(self) -> None:
+        """Reset cluster state between tests (the reference's
+        wipeCluster): delete all indices and templates."""
+        self.client.request("DELETE", "/*", {}, None)
+        status, templates = self.client.request("GET", "/_template", {}, None)
+        if status == 200 and isinstance(templates, dict):
+            for name in templates:
+                self.client.request("DELETE", f"/_template/{name}", {}, None)
+
+    # -- steps ---------------------------------------------------------
+
+    def run_step(self, step: dict, stash: dict, where: str) -> None:
+        for kind, payload in step.items():
+            if kind == "skip":
+                continue
+            handler = getattr(self, f"_step_{kind}", None)
+            if handler is None:
+                raise YamlTestFailure(f"[{where}] unsupported step [{kind}]")
+            handler(payload, stash, where)
+
+    def _step_do(self, payload: dict, stash: dict, where: str) -> None:
+        payload = dict(payload)
+        catch = payload.pop("catch", None)
+        payload.pop("warnings", None)
+        payload.pop("headers", None)
+        if len(payload) != 1:
+            raise YamlTestFailure(f"[{where}] do with {len(payload)} apis")
+        (api_name, args), = payload.items()
+        args = stash_sub(args or {}, stash)
+        # `ignore: 404` style client-side status suppression
+        ignore = args.pop("ignore", None) if isinstance(args, dict) else None
+        if ignore is not None and not isinstance(ignore, list):
+            ignore = [ignore]
+        try:
+            method, path, params, body = self.specs.build_request(
+                api_name, args)
+        except YamlTestFailure:
+            if catch == "param":
+                # client-side request validation failure — exactly what
+                # catch: param expects
+                return
+            raise
+        status, resp = self.client.request(method, path, params, body)
+        if method == "HEAD":
+            # the reference runner exposes HEAD (exists-style) results as
+            # a boolean body; a 404 is a legitimate "false", not an error
+            stash["__last_response"] = status < 300
+            if status in (200, 404):
+                return
+        stash["__last_response"] = resp
+        if ignore and status in {int(i) for i in ignore}:
+            return
+        if catch is None:
+            if status >= 400:
+                raise YamlTestFailure(
+                    f"[{where}] {api_name} failed [{status}]: "
+                    f"{str(resp)[:400]}")
+            return
+        if catch.startswith("/") and catch.endswith("/"):
+            if status < 400:
+                raise YamlTestFailure(
+                    f"[{where}] expected error matching {catch}, got "
+                    f"[{status}]")
+            if not re.search(catch.strip("/"), json.dumps(resp)):
+                raise YamlTestFailure(
+                    f"[{where}] error {str(resp)[:300]} !~ {catch}")
+            return
+        if catch == "param":
+            # client-side validation errors surface as 400s here
+            if status < 400:
+                raise YamlTestFailure(f"[{where}] expected param error")
+            return
+        if catch == "request":
+            if status < 400:
+                raise YamlTestFailure(
+                    f"[{where}] expected request error, got [{status}]")
+            return
+        expected = CATCH_STATUS.get(catch)
+        if expected is None:
+            raise YamlTestFailure(f"[{where}] unknown catch [{catch}]")
+        if status not in expected:
+            raise YamlTestFailure(
+                f"[{where}] expected {catch} {expected}, got [{status}]: "
+                f"{str(resp)[:300]}")
+
+    def _last(self, stash: dict):
+        return stash.get("__last_response")
+
+    def _step_match(self, payload: dict, stash: dict, where: str) -> None:
+        for path, expected in payload.items():
+            expected = stash_sub(expected, stash)
+            actual = lookup(self._last(stash), path, stash)
+            if not values_match(expected, actual):
+                raise YamlTestFailure(
+                    f"[{where}] match {path}: expected {expected!r}, "
+                    f"got {actual!r}")
+
+    def _step_length(self, payload: dict, stash: dict, where: str) -> None:
+        for path, expected in payload.items():
+            actual = lookup(self._last(stash), path, stash)
+            if len(actual) != int(stash_sub(expected, stash)):
+                raise YamlTestFailure(
+                    f"[{where}] length {path}: expected {expected}, "
+                    f"got {len(actual)}")
+
+    def _step_set(self, payload: dict, stash: dict, where: str) -> None:
+        for path, var in payload.items():
+            stash[var] = lookup(self._last(stash), path, stash)
+
+    def _step_is_true(self, payload, stash: dict, where: str) -> None:
+        try:
+            val = lookup(self._last(stash), payload, stash)
+        except (KeyError, IndexError, YamlTestFailure):
+            val = None
+        if val in (None, False, "", 0, {}, []):
+            raise YamlTestFailure(f"[{where}] is_true {payload}: {val!r}")
+
+    def _step_is_false(self, payload, stash: dict, where: str) -> None:
+        try:
+            val = lookup(self._last(stash), payload, stash)
+        except (KeyError, IndexError, YamlTestFailure):
+            val = None
+        if val not in (None, False, "", 0, {}, []):
+            raise YamlTestFailure(f"[{where}] is_false {payload}: {val!r}")
+
+    def _cmp(self, payload: dict, stash: dict, where: str, op, name) -> None:
+        for path, expected in payload.items():
+            expected = stash_sub(expected, stash)
+            actual = lookup(self._last(stash), path, stash)
+            if not op(float(actual), float(expected)):
+                raise YamlTestFailure(
+                    f"[{where}] {name} {path}: {actual!r} vs {expected!r}")
+
+    def _step_gt(self, payload, stash, where):
+        self._cmp(payload, stash, where, lambda a, b: a > b, "gt")
+
+    def _step_gte(self, payload, stash, where):
+        self._cmp(payload, stash, where, lambda a, b: a >= b, "gte")
+
+    def _step_lt(self, payload, stash, where):
+        self._cmp(payload, stash, where, lambda a, b: a < b, "lt")
+
+    def _step_lte(self, payload, stash, where):
+        self._cmp(payload, stash, where, lambda a, b: a <= b, "lte")
