@@ -215,13 +215,18 @@ class Segment:
             # recursively, one restage per level
             objs = np.nonzero(np.isin(nctx.parent_of, locals_))[0]
             nctx.segment.delete_docs(objs)
-        if self._device is not None:  # restage only the live mask
+        if self._device is not None:  # restage only the live masks
             import jax.numpy as jnp
 
             self._device["live"] = jnp.asarray(self.live)
             self._device["live1"] = jnp.asarray(
                 np.concatenate([self.live, np.zeros(1, dtype=bool)])
             )
+            if "k_live_t" in self._device:
+                from elasticsearch_tpu.ops import pallas_scoring as psc
+
+                self._device["k_live_t"] = jnp.asarray(psc.build_live_t(
+                    self.live.astype(np.float32), self.kernel_geom))
 
     def term_id(self, field_name: str, token: str) -> int:
         key = f"{field_name}{FIELD_SEP}{token}"
@@ -286,7 +291,9 @@ class Segment:
     # ------------------------------------------------------------------
 
     def device_arrays(self) -> dict:
-        """Stage postings/norms/live-mask to the default device (cached)."""
+        """Stage postings/norms/live-mask to the default device (cached).
+        When the pallas scoring kernel is active (TPU, or interpret mode
+        in tests) the kernel's tile-layout arrays ride along."""
         if self._device is None:
             import jax.numpy as jnp
 
@@ -298,7 +305,54 @@ class Segment:
                 "live": jnp.asarray(self.live),
                 "live1": jnp.asarray(live1),
             }
+        if "k_docs" not in self._device:
+            # lazy: the pallas mode may turn on after the first staging
+            # (ES_TPU_PALLAS flips in tests; backend selection at runtime)
+            self._stage_kernel_arrays()
         return self._device
+
+    def _stage_kernel_arrays(self) -> None:
+        from elasticsearch_tpu.ops.aggs import _pallas_mode
+
+        if not _pallas_mode():
+            return
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        geom = psc.tile_geometry(self.nd_pad)
+        frac = self._block_frac()
+        bmin, bmax = psc.block_min_max(self.block_docs, self.block_tfs,
+                                       self.nd_pad)
+        dp, fp = psc.pad_segment_blocks(self.block_docs, frac, self.nd_pad)
+        self.kernel_geom = geom
+        self.kernel_bmin = bmin
+        self.kernel_bmax = bmax
+        self._device["k_docs"] = jnp.asarray(dp)
+        self._device["k_frac"] = jnp.asarray(fp)
+        self._device["k_live_t"] = jnp.asarray(
+            psc.build_live_t(self.live.astype(np.float32), geom))
+
+    def _block_frac(self) -> np.ndarray:
+        """Per-posting BM25 norm factors, computed per FIELD (each field's
+        avgdl and doc-length column differ; a block belongs to exactly one
+        term and thus one field)."""
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        frac = np.zeros_like(self.block_tfs)
+        for field, row in self.field_norm_idx.items():
+            prefix = f"{field}{FIELD_SEP}"
+            lo = bisect.bisect_left(self.term_keys, prefix)
+            hi = bisect.bisect_left(self.term_keys, prefix + "￿")
+            if lo >= hi:
+                continue
+            b0 = int(self.term_block_start[lo])
+            b1 = int(self.term_block_start[hi - 1]
+                     + self.term_block_count[hi - 1])
+            frac[b0:b1] = psc.compute_block_frac(
+                self.block_docs[b0:b1], self.block_tfs[b0:b1],
+                self.norms[row], self.field_avgdl(field))
+        return frac
 
     def device_column(self, key: str, build) -> Any:
         """Cached device staging for a doc-value array (build() -> np array)."""

@@ -82,6 +82,10 @@ def stack_plans(plans: List[PlanNode], local_nd_pads: List[int],
     sentinel = stacked_nd1 - 1
     stacked: List[np.ndarray] = []
     for i, kind in enumerate(kinds):
+        if kind == "x":
+            # non-stackable node (e.g. the pallas tile kernel's 2-D
+            # per-query tables) — the host per-shard path serves these
+            raise PlanStructureMismatch("plan contains non-stackable arrays")
         parts = [f[i] for f in flats]
         # replicate shard 0 into unused device slots
         parts = parts + [parts[0]] * (n_devices - len(parts))
@@ -289,6 +293,9 @@ class IndexMeshSearch:
                 shard = self.svc.shards[sid]
                 ctx = ShardQueryContext(shard.mapper_service,
                                         engine=shard.engine)
+                # mesh plans must stack across shards; the pallas tile
+                # node is non-stackable, so pin the scatter nodes here
+                ctx.for_mesh = True
                 plans.append(qb.to_plan(ctx, seg))
             scores, slots, docs, total = self._executor.execute(plans, k)
         except PlanStructureMismatch:

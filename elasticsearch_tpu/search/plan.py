@@ -198,6 +198,65 @@ class ScoreTermsNode(PlanNode):
         return scores, counts >= min_match
 
 
+class PallasScoreTermsNode(PlanNode):
+    """BM25 disjunction executed by the tile-scoring pallas kernel
+    (ops/pallas_scoring.py) instead of the XLA scatter-add — the TPU
+    replacement for the reference's BulkScorer loop
+    (search/query/QueryPhase.java:272). Chosen by score_terms_node when
+    every lane is default-constant BM25 and the segment staged kernel
+    arrays; the query carries per-(tile, lane) covering-block windows
+    computed host-side from per-block doc ranges."""
+
+    def __init__(self, row_lo, row_hi, kweights, min_match, *, cb: int,
+                 sub: int, interpret: bool):
+        self.row_lo = row_lo  # [n_tiles, t_pad] i32
+        self.row_hi = row_hi
+        self.kweights = kweights  # [1, t_pad] f32
+        self.min_match = np.float32(min_match)
+        self.cb = cb
+        self.sub = sub
+        self.t_pad = int(row_lo.shape[1])
+        self.n_tiles = int(row_lo.shape[0])
+        self.interpret = interpret
+        self.with_counts = min_match > 1
+
+    def key(self):
+        return (f"pterms[{self.n_tiles},{self.t_pad},{self.cb},{self.sub},"
+                f"{self.with_counts},{self.interpret}]")
+
+    def trace_statics(self):
+        return (self.cb, self.sub, self.t_pad, self.with_counts,
+                self.interpret)
+
+    def arrays(self):
+        return [self.row_lo, self.row_hi, self.kweights, self.min_match]
+
+    def pad_kinds(self):
+        # "x": not stackable onto a mesh template (2-D per-query tables);
+        # the mesh executor rejects plans containing it and the host
+        # per-shard path runs instead
+        return ["x", "x", "x", "s"]
+
+    def emit(self, ctx):
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        row_lo, row_hi, kweights, min_match = ctx.take(4)
+        outs = psc.score_tiles(
+            ctx.seg["k_docs"], ctx.seg["k_frac"], ctx.seg["k_live_t"],
+            row_lo, row_hi, kweights,
+            t_pad=self.t_pad, cb=self.cb, sub=self.sub,
+            dense=True, with_counts=self.with_counts,
+            interpret=self.interpret)
+        nd = ctx.nd1 - 1
+        scores = psc.dense_to_flat(outs[0], self.sub)[:nd]
+        scores = jnp.concatenate([scores, jnp.zeros(1, jnp.float32)])
+        if self.with_counts:
+            counts = psc.dense_to_flat(outs[1], self.sub)[:nd]
+            counts = jnp.concatenate([counts, jnp.zeros(1, jnp.float32)])
+            return scores, counts >= min_match
+        return scores, scores > 0.0
+
+
 class PhraseScoreNode(PlanNode):
     """Pre-verified phrase matches (host position intersection) scored by
     the field's similarity over the phrase frequency — MatchPhraseQuery

@@ -33,7 +33,7 @@ from elasticsearch_tpu.mapper.field_types import (
     NumberFieldType,
     TextFieldType,
 )
-from elasticsearch_tpu.ops.scoring import bm25_idf
+from elasticsearch_tpu.ops.scoring import B, K1, bm25_idf
 from elasticsearch_tpu.search import plan as P
 
 # default max_expansions for multi-term queries (MultiTermQuery rewrites)
@@ -104,6 +104,7 @@ def term_blocks_arrays(segment, weighted_terms, ctx=None):
     blocks, weights, rows, avgdls = [], [], [], []
     p1s, p2s, p3s, kind_ids = [], [], [], []
     kinds: List[str] = []
+    lanes_meta = []  # (block_start, block_count, weight, kernel_eligible)
     n_terms_present = 0
     for field, token, boost in weighted_terms:
         tid = segment.term_id(field, token)
@@ -129,6 +130,14 @@ def term_blocks_arrays(segment, weighted_terms, ctx=None):
             kinds.append(kind)
         kid = kinds.index(kind)
         start = int(segment.term_block_start[tid])
+        # the pallas tile kernel precomputes per-posting norm factors
+        # with default-constant BM25 and the segment's local stats; any
+        # other similarity/params must take the scatter path. (No dfs-
+        # adjusted avgdl reaches this builder today; if one ever does,
+        # its lane must be marked ineligible here.)
+        lanes_meta.append((start, int(segment.term_block_count[tid]),
+                           float(w),
+                           kind == "bm25" and p1 == K1 and p2 == B))
         for bi in range(start, start + int(segment.term_block_count[tid])):
             blocks.append(bi)
             weights.append(w)
@@ -150,6 +159,7 @@ def term_blocks_arrays(segment, weighted_terms, ctx=None):
         "q_kinds": _pad_pow2(kind_ids, 0, dtype=np.int32),
         "kinds": tuple(kinds) if kinds else ("bm25",),
         "n_present": n_terms_present,
+        "lanes_meta": lanes_meta,
     }
 
 
@@ -157,12 +167,52 @@ def score_terms_node(segment, weighted_terms, min_match=1, ctx=None) -> P.PlanNo
     arrs = term_blocks_arrays(segment, weighted_terms, ctx=ctx)
     if arrs["n_present"] == 0 or min_match > arrs["n_present"]:
         return P.MatchNoneNode()
+    node = None
+    if not getattr(ctx, "for_mesh", False):
+        node = _pallas_score_terms_node(segment, arrs, min_match)
+    if node is not None:
+        return node
     return P.ScoreTermsNode(
         arrs["q_blocks"], arrs["q_weights"], arrs["q_norm_rows"],
         arrs["q_avgdl"], arrs["q_valid"], min_match,
         q_p1=arrs["q_p1"], q_p2=arrs["q_p2"], q_p3=arrs["q_p3"],
         q_kinds=arrs["q_kinds"], kinds=arrs["kinds"],
     )
+
+
+def _pallas_score_terms_node(segment, arrs, min_match):
+    """Route eligible BM25 disjunctions through the tile-scoring kernel:
+    all lanes default-constant BM25 (positive weights for the score>0
+    match rule unless counting), and the segment staged kernel arrays."""
+    from elasticsearch_tpu.ops.aggs import _pallas_mode
+
+    mode = _pallas_mode()
+    if not mode:
+        return None
+    lanes = arrs["lanes_meta"]
+    if not lanes or not all(ok for _, _, _, ok in lanes):
+        return None
+    # positive weights always: score>0 is the match rule for min_match<=1,
+    # and zero-weight lanes would be dropped from the kernel's match
+    # COUNTS too (build_tile_tables skips them) — the scatter path counts
+    # them, so they must take it
+    if not all(w > 0 for _, _, w, _ in lanes):
+        return None
+    segment.device_arrays()  # ensure kernel staging ran
+    geom = getattr(segment, "kernel_geom", None)
+    if geom is None:
+        return None
+    from elasticsearch_tpu.ops import pallas_scoring as psc
+
+    try:
+        row_lo, row_hi, kweights, cb = psc.build_tile_tables(
+            [psc.QueryLane(s, c, w) for s, c, w, _ in lanes],
+            segment.kernel_bmin, segment.kernel_bmax, geom)
+    except ValueError:
+        return None  # covering window exceeds the kernel bound
+    return P.PallasScoreTermsNode(
+        row_lo, row_hi, kweights, min_match,
+        cb=cb, sub=geom.tile_sub, interpret=(mode == "interpret"))
 
 
 def _numeric_csr(segment, field):

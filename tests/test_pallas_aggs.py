@@ -176,6 +176,46 @@ class TestOpsDispatchParity:
         assert out[5] > 1e38  # inf saturates its own bucket only
 
 
+class TestEngineScoringParity:
+    """Full-text search through Node.search must produce identical hits
+    whether the query phase runs the pallas tile-scoring kernel
+    (ES_TPU_PALLAS=interpret routes score_terms_node through
+    PallasScoreTermsNode) or the XLA scatter program."""
+
+    def test_match_query_parity(self, monkeypatch):
+        from elasticsearch_tpu.node import Node
+
+        rng = np.random.RandomState(12)
+        words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
+                 "eta", "theta"]
+        monkeypatch.setenv("ES_TPU_PALLAS", "off")
+        node = Node()
+        node.create_index("docs", {"mappings": {"_doc": {"properties": {
+            "body": {"type": "text"}}}}})
+        for i in range(120):
+            text = " ".join(rng.choice(words, rng.randint(3, 9)))
+            node.index_doc("docs", str(i), {"body": text},
+                           refresh=(i == 119))
+        queries = [
+            {"query": {"match": {"body": "alpha gamma"}}, "size": 15},
+            {"query": {"match": {"body": {"query": "beta delta zeta",
+                                          "operator": "and"}}}, "size": 15},
+            {"query": {"bool": {"should": [
+                {"match": {"body": "theta"}},
+                {"match": {"body": "eta epsilon"}}]}}, "size": 20},
+        ]
+        ref = [node.search("docs", q) for q in queries]
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        got = [node.search("docs", q) for q in queries]
+        for r, g, q in zip(ref, got, queries):
+            assert g["hits"]["total"] == r["hits"]["total"], q
+            r_hits = [(h["_id"], round(h["_score"], 4))
+                      for h in r["hits"]["hits"]]
+            g_hits = [(h["_id"], round(h["_score"], 4))
+                      for h in g["hits"]["hits"]]
+            assert sorted(g_hits) == sorted(r_hits), q
+
+
 class TestEnginePallasParity:
     """The engine's terms partial (search/aggregations.py ->
     ops/aggs.ordinal_counts) must produce identical buckets through the

@@ -11,6 +11,14 @@ import pytest
 
 import jax
 
+
+@pytest.fixture(autouse=True)
+def _scatter_plans(monkeypatch):
+    """This module tests the MESH-stacked plan path; pallas tile-kernel
+    nodes are (for now) explicitly non-stackable and served by the host
+    per-shard fallback, so pin plan building to the scatter nodes."""
+    monkeypatch.setenv("ES_TPU_PALLAS", "off")
+
 from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
 from elasticsearch_tpu.index.segment import SegmentBuilder
 from elasticsearch_tpu.mapper.mapping import MapperService
