@@ -10,26 +10,30 @@ reference threads this through ``ThreadContext`` response headers).
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import threading
-from typing import List
+from typing import List, Optional
 
 _logger = logging.getLogger("elasticsearch_tpu.deprecation")
 _seen: set = set()
 _seen_lock = threading.Lock()
-_tls = threading.local()
+# a ContextVar (not threading.local): the REST dispatcher captures its
+# context and runs the handler on a thread-pool worker (ThreadPool), and
+# the copied context carries the SAME collector list across that hop
+_warnings_var: "contextvars.ContextVar[Optional[list]]" =     contextvars.ContextVar("estpu_request_warnings", default=None)
 
 
 def begin_request() -> None:
-    """Reset the current thread's warning collector (called by the REST
+    """Reset the current request's warning collector (called by the REST
     dispatcher at the start of each request)."""
-    _tls.warnings = []
+    _warnings_var.set([])
 
 
 def collect_warnings() -> List[str]:
     """Drain the warnings recorded during the current request."""
-    out = list(getattr(_tls, "warnings", []))
-    _tls.warnings = []
+    out = list(_warnings_var.get() or [])
+    _warnings_var.set([])
     return out
 
 
@@ -47,6 +51,6 @@ class DeprecationLogger:
             if message not in _seen:
                 _seen.add(message)
                 _logger.warning("[%s] %s", self._name, message)
-        warnings = getattr(_tls, "warnings", None)
+        warnings = _warnings_var.get()
         if warnings is not None and message not in warnings:
             warnings.append(message)

@@ -325,13 +325,20 @@ class Segment:
         bmin, bmax = psc.block_min_max(self.block_docs, self.block_tfs,
                                        self.nd_pad)
         dp, fp = psc.pad_segment_blocks(self.block_docs, frac, self.nd_pad)
-        self.kernel_geom = geom
+        # stage fully, then publish atomically: a concurrent search thread
+        # must never observe k_docs without k_frac/k_live_t (dict.update
+        # of a prebuilt dict is atomic under the GIL), and kernel_geom is
+        # the eligibility signal so it is set LAST
+        staged = {
+            "k_docs": jnp.asarray(dp),
+            "k_frac": jnp.asarray(fp),
+            "k_live_t": jnp.asarray(
+                psc.build_live_t(self.live.astype(np.float32), geom)),
+        }
         self.kernel_bmin = bmin
         self.kernel_bmax = bmax
-        self._device["k_docs"] = jnp.asarray(dp)
-        self._device["k_frac"] = jnp.asarray(fp)
-        self._device["k_live_t"] = jnp.asarray(
-            psc.build_live_t(self.live.astype(np.float32), geom))
+        self._device.update(staged)
+        self.kernel_geom = geom
 
     def _block_frac(self) -> np.ndarray:
         """Per-posting BM25 norm factors, computed per FIELD (each field's

@@ -64,6 +64,11 @@ class Node:
             master_node_id=self.node_id,
         )
         self.cluster_service = ClusterService(initial)
+        # named bounded executors (ThreadPool.java) — the REST layer runs
+        # handler work on the action's pool; full queues reject with 429
+        from elasticsearch_tpu.common.thread_pool import ThreadPool
+
+        self.thread_pool = ThreadPool()
         self.indices: Dict[str, IndexService] = {}
         self.ingest = IngestService(self)
         self.tasks = TaskManager(self.node_id)
@@ -812,6 +817,7 @@ class Node:
                     },
                     "jvm": {"uptime_in_millis": int((time.time() - self.start_time) * 1000)},
                     "process": {"open_file_descriptors": -1},
+                    "thread_pool": self.thread_pool.stats(),
                 }
             },
         }
@@ -1089,6 +1095,7 @@ class Node:
         if self._closed:
             return
         self._closed = True
+        self.thread_pool.shutdown()
         from elasticsearch_tpu.transport.remote_cluster import unregister_node
 
         unregister_node(self)

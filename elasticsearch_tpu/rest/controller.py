@@ -94,6 +94,25 @@ class Route:
         return m.groupdict()
 
 
+_SEARCH_MARKERS = ("_search", "_count", "_msearch", "_explain",
+                   "_validate", "_field_caps", "_suggest", "_percolate")
+_GET_MARKERS = ("_doc", "_mget", "_source", "_termvectors")
+
+
+def _executor_for(method: str, pattern: str) -> str:
+    """Route -> named pool, mirroring the per-action executor choices of
+    the reference's transport actions (ThreadPool.Names)."""
+    if any(m in pattern for m in _SEARCH_MARKERS):
+        return "search"
+    if "_bulk" in pattern or "_update" in pattern:
+        return "write"
+    if any(m in pattern for m in _GET_MARKERS):
+        return "get" if method in ("GET", "HEAD") else "write"
+    if "{type}/{id}" in pattern or pattern.endswith("/{id}"):
+        return "get" if method in ("GET", "HEAD") else "write"
+    return "management"
+
+
 class RestController:
     def __init__(self, node):
         self.node = node
@@ -128,7 +147,20 @@ class RestController:
                 params.update(path_params)
                 req = RestRequest(method, path, params, body)
                 try:
-                    return route.handler(self.node, req)
+                    pool = getattr(self.node, "thread_pool", None)
+                    if pool is None:
+                        return route.handler(self.node, req)
+                    # run handler work on the action's named executor; a
+                    # full bounded queue rejects with 429 (ThreadPool +
+                    # EsRejectedExecutionException semantics). The copied
+                    # contextvars context carries the request's
+                    # deprecation-warning collector across the thread hop.
+                    import contextvars
+
+                    ctx = contextvars.copy_context()
+                    return pool.run(
+                        _executor_for(method, route.pattern),
+                        lambda: ctx.run(route.handler, self.node, req))
                 except ElasticsearchTpuException as e:
                     return e.status_code, e.to_dict()
                 except Exception as e:  # uncaught -> 500, reference behavior

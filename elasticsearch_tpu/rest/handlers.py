@@ -1099,8 +1099,9 @@ def _cat_recovery(node, req):
 
 
 def _cat_thread_pool(node, req):
-    rows = [[node.node_name, pool, 0, 0, 0]
-            for pool in ("bulk", "search", "get", "index", "management")]
+    stats = node.thread_pool.stats()
+    rows = [[node.node_name, pool, st["active"], st["queue"], st["rejected"]]
+            for pool, st in stats.items()]
     return _cat_table(req, rows, ["node_name", "name", "active", "queue", "rejected"])
 
 

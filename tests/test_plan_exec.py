@@ -11,14 +11,6 @@ import pytest
 
 import jax
 
-
-@pytest.fixture(autouse=True)
-def _scatter_plans(monkeypatch):
-    """This module tests the MESH-stacked plan path; pallas tile-kernel
-    nodes are (for now) explicitly non-stackable and served by the host
-    per-shard fallback, so pin plan building to the scatter nodes."""
-    monkeypatch.setenv("ES_TPU_PALLAS", "off")
-
 from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
 from elasticsearch_tpu.index.segment import SegmentBuilder
 from elasticsearch_tpu.mapper.mapping import MapperService
@@ -40,6 +32,17 @@ MAPPING = {
         "price": {"type": "float"},
     }
 }
+
+
+
+@pytest.fixture(autouse=True)
+def _scatter_plans(monkeypatch):
+    """This module tests the MESH-stacked plan path; pallas tile-kernel
+    nodes are (for now) explicitly non-stackable and served by the host
+    per-shard fallback, so pin plan building to the scatter nodes.
+    (_pallas_mode reads ES_TPU_PALLAS at call time — import order is
+    irrelevant.)"""
+    monkeypatch.setenv("ES_TPU_PALLAS", "off")
 
 
 def build_corpus(n_shards, docs_per_shard, seed=0):

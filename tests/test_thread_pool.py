@@ -1,0 +1,121 @@
+"""Named bounded executors + backpressure (ThreadPool.java:67-77,
+EsRejectedExecutionException -> HTTP 429)."""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.thread_pool import (
+    EsRejectedExecutionException,
+    ThreadPool,
+)
+
+
+class TestThreadPool:
+    def test_submit_runs_and_returns(self):
+        tp = ThreadPool(cores=2)
+        try:
+            assert tp.run("search", lambda: 41 + 1) == 42
+        finally:
+            tp.shutdown()
+
+    def test_exceptions_propagate(self):
+        tp = ThreadPool(cores=2)
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                tp.run("write", lambda: (_ for _ in ()).throw(
+                    ValueError("boom")))
+        finally:
+            tp.shutdown()
+
+    def test_bounded_queue_rejects(self):
+        tp = ThreadPool(cores=1, overrides={
+            "tiny": {"threads": 1, "queue_size": 2}})
+        try:
+            gate = threading.Event()
+            futures = [tp.submit("tiny", gate.wait)]
+            # wait until the single worker picked the task up...
+            deadline = time.monotonic() + 2
+            while (tp.executor("tiny").stats().active == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            # ...then fill the 2-slot queue; the next submit must reject
+            futures += [tp.submit("tiny", gate.wait) for _ in range(2)]
+            with pytest.raises(EsRejectedExecutionException):
+                tp.submit("tiny", gate.wait)
+            st = tp.executor("tiny").stats()
+            assert st.rejected >= 1
+            gate.set()
+            for f in futures:
+                f.result(5)
+        finally:
+            tp.shutdown()
+
+    def test_stats_shape(self):
+        tp = ThreadPool(cores=2)
+        try:
+            tp.run("get", lambda: None)
+            st = tp.stats()
+            assert {"search", "write", "get", "management",
+                    "generic"} <= set(st)
+            assert st["get"]["completed"] >= 1
+            for pool in st.values():
+                assert {"threads", "queue_size", "active", "queue",
+                        "rejected", "completed"} <= set(pool)
+        finally:
+            tp.shutdown()
+
+    def test_unknown_pool_falls_back_to_generic(self):
+        tp = ThreadPool(cores=2)
+        try:
+            assert tp.run("no-such-pool", lambda: "ok") == "ok"
+            assert tp.executor("generic").stats().completed >= 1
+        finally:
+            tp.shutdown()
+
+
+class TestRestBackpressure:
+    def test_search_overload_returns_429(self, monkeypatch):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("idx")
+        node.index_doc("idx", "1", {"f": "v"}, refresh=True)
+        # shrink the search pool so overload is cheap to produce
+        from elasticsearch_tpu.common.thread_pool import ThreadPool
+
+        node.thread_pool.shutdown()
+        node.thread_pool = ThreadPool(cores=1, overrides={
+            "search": {"threads": 1, "queue_size": 1}})
+        from elasticsearch_tpu.rest.controller import RestController
+
+        ctrl = RestController(node)
+
+        gate = threading.Event()
+        started = threading.Event()
+
+        def slow_search():
+            started.set()
+            gate.wait(10)
+            return ctrl_result[0]
+
+        # occupy the single search thread
+        blocker = node.thread_pool.submit("search", slow_search)
+        ctrl_result = [None]
+        started.wait(5)
+        node.thread_pool.submit("search", lambda: None)  # fills the queue
+        status, body = ctrl.dispatch(
+            "GET", "/idx/_search", {}, None)
+        gate.set()
+        blocker.result(10)
+        assert status == 429
+        assert body["error"]["type"] == "es_rejected_execution_exception"
+
+    def test_thread_pool_stats_in_node_stats(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        st = node.node_stats()
+        pools = st["nodes"][node.node_id]["thread_pool"]
+        assert "search" in pools and "write" in pools
