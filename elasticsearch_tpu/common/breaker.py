@@ -134,3 +134,49 @@ class CircuitBreakerService:
 def noop_breaker_service() -> CircuitBreakerService:
     """Breakers with no limits — used by tests and single-user tools."""
     return CircuitBreakerService(0, 0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Process-level service (the node configures it from settings at startup;
+# library code reaches it through breaker_service())
+# ---------------------------------------------------------------------------
+
+_service: Optional[CircuitBreakerService] = None
+_service_lock = threading.Lock()
+
+# default budget when no settings configure one: the reference defaults to
+# percentages of the JVM heap; here an absolute working-set budget
+_DEFAULT_TOTAL = 1_500_000_000
+
+
+def breaker_service() -> CircuitBreakerService:
+    global _service
+    with _service_lock:
+        if _service is None:
+            _service = CircuitBreakerService(
+                total_limit=_DEFAULT_TOTAL,
+                request_limit=int(_DEFAULT_TOTAL * 0.6),
+                fielddata_limit=int(_DEFAULT_TOTAL * 0.6),
+            )
+        return _service
+
+
+def configure_breaker_service(settings) -> CircuitBreakerService:
+    """Node startup: (re)configure the hierarchy's LIMITS from
+    indices.breaker.* settings (HierarchyCircuitBreakerService). The
+    service object and its accounted bytes survive — multiple in-process
+    nodes share one process-wide accounting (last configuration wins on
+    limits); replacing the object would silently forget every byte the
+    running searches already accounted."""
+    total = settings.get_bytes("indices.breaker.total.limit",
+                               _DEFAULT_TOTAL)
+    request = settings.get_bytes("indices.breaker.request.limit",
+                                 int(total * 0.6))
+    fielddata = settings.get_bytes("indices.breaker.fielddata.limit",
+                                   int(total * 0.6))
+    svc = breaker_service()
+    svc.parent.limit_bytes = total
+    svc.get_breaker(CircuitBreaker.REQUEST).limit_bytes = request
+    svc.get_breaker(CircuitBreaker.FIELDDATA).limit_bytes = fielddata
+    svc.get_breaker(CircuitBreaker.IN_FLIGHT_REQUESTS).limit_bytes = total
+    return svc

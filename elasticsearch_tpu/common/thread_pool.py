@@ -104,20 +104,24 @@ class _Executor:
 
     def submit(self, fn: Callable[[], Any]) -> Future:
         """Enqueue; raises EsRejectedExecutionException when the bounded
-        queue is full (the backpressure signal)."""
-        if self._shut:
-            raise EsRejectedExecutionException(
-                f"rejected execution on [{self.name}]: pool is shut down")
+        queue is full (the backpressure signal). The shut-check and the
+        enqueue happen under the pool lock so a concurrent shutdown can
+        never strand a task behind the stop sentinels (which would hang
+        its caller forever)."""
         self._ensure_workers()
         future: Future = Future()
-        try:
-            self._queue.put_nowait((fn, future))
-        except queue.Full:
-            with self._lock:
+        with self._lock:
+            if self._shut:
+                raise EsRejectedExecutionException(
+                    f"rejected execution on [{self.name}]: pool is shut "
+                    f"down")
+            try:
+                self._queue.put_nowait((fn, future))
+            except queue.Full:
                 self._rejected += 1
-            raise EsRejectedExecutionException(
-                f"rejected execution on [{self.name}]: queue capacity "
-                f"[{self.queue_size}] is full") from None
+                raise EsRejectedExecutionException(
+                    f"rejected execution on [{self.name}]: queue capacity "
+                    f"[{self.queue_size}] is full") from None
         return future
 
     def stats(self) -> PoolStats:
@@ -129,17 +133,19 @@ class _Executor:
 
     def shutdown(self) -> None:
         with self._lock:
-            self._shut = True
+            self._shut = True  # submits are locked out from here on
             started = len(self._workers)
-        # fail queued-but-unstarted work so blocked callers wake up
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if item is not _STOP:
-                item[1].set_exception(EsRejectedExecutionException(
-                    f"[{self.name}] shut down before execution"))
+            # fail queued-but-unstarted work so blocked callers wake up
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _STOP:
+                    item[1].set_exception(EsRejectedExecutionException(
+                        f"[{self.name}] shut down before execution"))
+        # sentinels outside the lock: workers may need to drain a few
+        # before capacity frees when threads > queue_size
         for _ in range(started):
             self._queue.put(_STOP)
 

@@ -436,6 +436,8 @@ class Engine:
                 for entry in self.version_map.values():
                     if entry.segment == builder.name:
                         entry.local_doc = int(remap[entry.local_doc])
+            for old_seg in self.segments:
+                old_seg.release_breaker_charges()
             self.segments = [merged] if merged.num_docs else []
 
     def recover_from_translog(self) -> int:
@@ -477,4 +479,6 @@ class Engine:
         }
 
     def close(self) -> None:
+        for seg in self.segments:
+            seg.release_breaker_charges()
         self.translog.close()

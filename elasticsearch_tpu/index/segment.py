@@ -187,6 +187,10 @@ class Segment:
         self.live = np.ones(self.nd_pad, dtype=bool)
         self.live[num_docs:] = False
         self._id_to_doc: Optional[Dict[str, int]] = None
+        # circuit-breaker bytes charged for lazily-built per-segment
+        # structures (text fielddata); released when the segment is
+        # dropped (merge/close) — see release_breaker_charges()
+        self.breaker_charges: Dict[str, int] = {}
         self._device: Optional[dict] = None
         # generic device-array cache for doc-value columns (key -> jnp array)
         self.dev_cache: Dict[str, Any] = {}
@@ -368,6 +372,21 @@ class Segment:
 
             self.dev_cache[key] = jnp.asarray(build())
         return self.dev_cache[key]
+
+    def release_breaker_charges(self) -> None:
+        """The segment is being dropped (merge replaced it / shard close):
+        give its accounted fielddata bytes back to the breaker."""
+        if not self.breaker_charges:
+            return
+        from elasticsearch_tpu.common.breaker import (
+            CircuitBreaker,
+            breaker_service,
+        )
+
+        total = sum(self.breaker_charges.values())
+        self.breaker_charges.clear()
+        breaker_service().get_breaker(
+            CircuitBreaker.FIELDDATA).add_without_breaking(-total)
 
     def memory_bytes(self) -> int:
         total = self.block_docs.nbytes + self.block_tfs.nbytes + self.norms.nbytes

@@ -69,6 +69,10 @@ class Node:
         from elasticsearch_tpu.common.thread_pool import ThreadPool
 
         self.thread_pool = ThreadPool()
+        from elasticsearch_tpu.common.breaker import configure_breaker_service
+
+        # hierarchical memory circuit breakers (indices.breaker.*)
+        self.breaker_service = configure_breaker_service(settings)
         self.indices: Dict[str, IndexService] = {}
         self.ingest = IngestService(self)
         self.tasks = TaskManager(self.node_id)
@@ -818,6 +822,7 @@ class Node:
                     "jvm": {"uptime_in_millis": int((time.time() - self.start_time) * 1000)},
                     "process": {"open_file_descriptors": -1},
                     "thread_pool": self.thread_pool.stats(),
+                    "breakers": self.breaker_service.stats(),
                 }
             },
         }

@@ -146,7 +146,24 @@ class RestController:
                 params = dict(query)
                 params.update(path_params)
                 req = RestRequest(method, path, params, body)
+                inflight = None
+                reserved = False
+                if body and hasattr(self.node, "breaker_service"):
+                    # in-flight requests breaker: the buffered request body
+                    # counts against memory until the response is built
+                    from elasticsearch_tpu.common.breaker import (
+                        CircuitBreaker,
+                    )
+                    inflight = self.node.breaker_service.get_breaker(
+                        CircuitBreaker.IN_FLIGHT_REQUESTS)
                 try:
+                    if inflight is not None:
+                        inflight.add_estimate_bytes_and_maybe_break(
+                            len(body), "<http_request>")
+                        # only a SUCCESSFUL reservation may be released —
+                        # a tripped add already rolled itself back, and
+                        # releasing it again would drive used negative
+                        reserved = True
                     pool = getattr(self.node, "thread_pool", None)
                     if pool is None:
                         return route.handler(self.node, req)
@@ -168,6 +185,9 @@ class RestController:
                         "error": {"type": type(e).__name__, "reason": str(e)},
                         "status": 500,
                     }
+                finally:
+                    if reserved:
+                        inflight.add_without_breaking(-len(body))
         # path matched under another method -> 405
         for route in self.routes:
             if route.method != method and route.match(path) is not None:

@@ -121,17 +121,47 @@ def _resolve_ordinal_field(segment, field: str):
     return _text_fielddata(segment, field)
 
 
+_fielddata_build_lock = __import__("threading").Lock()
+
+
 def _text_fielddata(segment, field: str):
     """Build (and cache) an ordinal view of a text field from its postings
     — the reference's heap-loaded text fielddata (index/fielddata/), built
     lazily at first aggregation. (The reference gates this behind
-    fielddata=true; we build it implicitly — documented delta.)"""
+    fielddata=true; we build it implicitly — documented delta.)
+
+    Serialized under a build lock: concurrent search-pool threads racing
+    the dev_cache check would double-build AND double-account the
+    fielddata breaker bytes."""
     cache_key = f"fielddata.{field}"
-    if cache_key in segment.dev_cache:
-        return segment.dev_cache[cache_key]
+    hit = segment.dev_cache.get(cache_key)
+    if hit is not None:
+        return hit
+    with _fielddata_build_lock:
+        hit = segment.dev_cache.get(cache_key)
+        if hit is not None:
+            return hit
+        return _build_text_fielddata(segment, field, cache_key)
+
+
+def _build_text_fielddata(segment, field: str, cache_key: str):
     terms = segment.terms_for_field(field)
     if not terms:
         return None
+    from elasticsearch_tpu.common.breaker import (
+        CircuitBreaker,
+        breaker_service,
+    )
+
+    # fielddata breaker: account BEFORE building (the reference's
+    # RamAccountingTermsEnum pattern — fail fast, not after allocation);
+    # the segment remembers the charge so dropping it releases the bytes
+    est_bytes = sum(int(segment.term_doc_freq[tid]) for _, tid in terms) * 8 \
+        + segment.nd_pad * 5
+    breaker_service().get_breaker(
+        CircuitBreaker.FIELDDATA).add_estimate_bytes_and_maybe_break(
+        est_bytes, f"fielddata [{field}]")
+    segment.breaker_charges[cache_key] = est_bytes
     from elasticsearch_tpu.index.segment import OrdinalColumn, next_pow2
 
     token_list = [t for t, _ in terms]
@@ -645,18 +675,37 @@ def _finalize_metric(spec: AggSpec, partials: List[dict]) -> dict:
     raise ParsingException(f"cannot finalize metric [{t}]")
 
 
+def _agg_request_estimate(specs: List[AggSpec], views) -> int:
+    """Per-request accounting estimate for the request breaker: bucket
+    machinery scales with (aggs x segments x docs-touched)."""
+    n_specs = sum(1 + len(s.subs) for s in specs)
+    n_docs = sum(int(v.segment.nd_pad) for v in views)
+    return n_specs * (n_docs * 4 + 4096)
+
+
 def run_aggregations(specs: List[AggSpec], views: List[SegmentView]) -> dict:
     """Execute an agg tree over segment views; returns the response dict
     keyed by agg name (single-node path: segments of one or more shards)."""
-    out = {}
-    pipeline_specs = [s for s in specs if s.type in PIPELINE_TYPES]
-    for spec in specs:
-        if spec.type in PIPELINE_TYPES:
-            continue
-        out[spec.name] = _run_one(spec, views)
-    for spec in pipeline_specs:
-        _apply_pipeline(spec, out)
-    return out
+    from elasticsearch_tpu.common.breaker import (
+        CircuitBreaker,
+        breaker_service,
+    )
+
+    breaker = breaker_service().get_breaker(CircuitBreaker.REQUEST)
+    est = _agg_request_estimate(specs, views)
+    breaker.add_estimate_bytes_and_maybe_break(est, "<agg_request>")
+    try:
+        out = {}
+        pipeline_specs = [s for s in specs if s.type in PIPELINE_TYPES]
+        for spec in specs:
+            if spec.type in PIPELINE_TYPES:
+                continue
+            out[spec.name] = _run_one(spec, views)
+        for spec in pipeline_specs:
+            _apply_pipeline(spec, out)
+        return out
+    finally:
+        breaker.add_without_breaking(-est)
 
 
 def _run_one(spec: AggSpec, views: List[SegmentView]) -> dict:

@@ -1,0 +1,97 @@
+"""Circuit breaker wiring (HierarchyCircuitBreakerService.java:43):
+fielddata/request/in-flight breakers account real allocations and trip
+as HTTP errors; stats ride _nodes/stats."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breaker import (
+    CircuitBreaker,
+    CircuitBreakerService,
+    breaker_service,
+    configure_breaker_service,
+)
+from elasticsearch_tpu.common.errors import CircuitBreakingException
+from elasticsearch_tpu.common.settings import Settings
+
+
+@pytest.fixture(autouse=True)
+def _restore_breakers():
+    yield
+    configure_breaker_service(Settings.EMPTY)
+
+
+def make_node(**breaker_settings):
+    from elasticsearch_tpu.node import Node
+
+    node = Node(Settings.from_dict(breaker_settings) if breaker_settings
+                else Settings.EMPTY)
+    node.create_index("logs", {
+        "mappings": {"_doc": {"properties": {
+            "tag": {"type": "text"},
+            "msg": {"type": "text"},
+        }}}})
+    for i in range(50):
+        node.index_doc("logs", str(i), {
+            "tag": f"t{i % 5}", "msg": f"event {i}"}, refresh=(i == 49))
+    return node
+
+
+class TestBreakerWiring:
+    def test_request_breaker_trips_agg(self):
+        node = make_node(**{"indices.breaker.total.limit": "5kb",
+                            "indices.breaker.request.limit": "2kb"})
+        with pytest.raises(Exception) as ei:
+            node.search("logs", {
+                "size": 0,
+                "aggs": {"tags": {"terms": {"field": "tag"}}}})
+        assert "circuit_breaking_exception" in str(
+            getattr(ei.value, "to_dict", lambda: {"error": {"type": type(ei.value).__name__}})())
+
+    def test_request_breaker_releases_after_request(self):
+        node = make_node()
+        node.search("logs", {"size": 0,
+                             "aggs": {"tags": {"terms": {"field": "tag"}}}})
+        breaker = node.breaker_service.get_breaker(CircuitBreaker.REQUEST)
+        assert breaker.used_bytes == 0
+
+    def test_fielddata_breaker_accounts_text_fielddata(self):
+        node = make_node()
+        before = node.breaker_service.get_breaker(
+            CircuitBreaker.FIELDDATA).used_bytes
+        node.search("logs", {"size": 0,
+                             "aggs": {"tags": {"terms": {"field": "tag"}}}})
+        after = node.breaker_service.get_breaker(
+            CircuitBreaker.FIELDDATA).used_bytes
+        assert after > before  # fielddata stays accounted (cache-resident)
+
+    def test_inflight_breaker_trips_on_oversized_body(self):
+        node = make_node(**{"indices.breaker.total.limit": "100mb"})
+        from elasticsearch_tpu.rest.controller import RestController
+
+        # shrink in-flight limit directly
+        node.breaker_service.get_breaker(
+            CircuitBreaker.IN_FLIGHT_REQUESTS).limit_bytes = 64
+        ctrl = RestController(node)
+        big = b'{"query": {"match": {"msg": "' + b"x" * 200 + b'"}}}'
+        status, bodyr = ctrl.dispatch("POST", "/logs/_search", {}, big)
+        assert status == 429
+        assert bodyr["error"]["type"] == "circuit_breaking_exception"
+
+    def test_parent_breaker_sums_children(self):
+        svc = CircuitBreakerService(total_limit=100, request_limit=90,
+                                    fielddata_limit=90)
+        svc.get_breaker(CircuitBreaker.REQUEST) \
+            .add_estimate_bytes_and_maybe_break(60, "a")
+        with pytest.raises(CircuitBreakingException):
+            svc.get_breaker(CircuitBreaker.FIELDDATA) \
+                .add_estimate_bytes_and_maybe_break(60, "b")
+        # failed reservation rolled back
+        assert svc.get_breaker(CircuitBreaker.FIELDDATA).used_bytes == 0
+
+    def test_breaker_stats_in_node_stats(self):
+        node = make_node()
+        st = node.node_stats()["nodes"][node.node_id]["breakers"]
+        assert {"request", "fielddata", "in_flight_requests", "parent"} \
+            <= set(st)
+        assert st["request"]["limit_size_in_bytes"] > 0
