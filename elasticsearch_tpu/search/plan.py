@@ -77,6 +77,17 @@ class PlanNode:
             out.extend(c.flat_pad_kinds())
         return out
 
+    def describe(self) -> dict:
+        """Profile tree (search/profile/query/ProfileScorer.java analog).
+        The whole plan executes as ONE fused XLA program, so child nodes
+        carry structure, not separate timings — the root's breakdown owns
+        the measured device time and children are marked fused."""
+        return {
+            "type": type(self).__name__,
+            "description": self.key(),
+            "children": [c.describe() for c in self.children()],
+        }
+
 
 class EmitCtx:
     """Carries the segment device arrays + the flat plan-array iterator

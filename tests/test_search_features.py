@@ -256,3 +256,34 @@ class TestSlice:
             assert not (seen & got)  # disjoint
             seen |= got
         assert seen == {"0", "1", "2", "3"}
+
+
+class TestProfileTree:
+    """Profile responses carry the plan-node tree with pipeline-stage
+    breakdowns (ProfileScorer analog; children of the fused device
+    program carry structure, the root owns the measured time)."""
+
+    def test_profile_query_tree(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("prof", {"mappings": {"_doc": {"properties": {
+            "t": {"type": "text"}, "n": {"type": "integer"}}}}})
+        for i in range(20):
+            node.index_doc("prof", str(i),
+                           {"t": f"word{i % 3} common", "n": i},
+                           refresh=(i == 19))
+        r = node.search("prof", {"profile": True, "query": {"bool": {
+            "must": [{"match": {"t": "common"}}],
+            "filter": [{"range": {"n": {"gte": 5}}}]}}})
+        q = r["profile"]["shards"][0]["searches"][0]["query"][0]
+        assert q["type"] == "BoolNode"
+        assert q["time_in_nanos"] > 0
+        assert {"build_plan", "execute_program",
+                "select_topk"} <= set(q["breakdown"])
+        kinds = {c["type"] for c in q["children"]}
+        assert "ScoreTermsNode" in kinds or "PallasScoreTermsNode" in kinds
+        for c in q["children"]:
+            assert c["breakdown"] == {"fused_into_parent_program": 0}
+        coll = r["profile"]["shards"][0]["searches"][0]["collector"][0]
+        assert coll["name"] == "TopKSelector"
