@@ -24,19 +24,28 @@ Handler = Callable[..., Tuple[int, Any]]
 
 class RestRequest:
     def __init__(self, method: str, path: str, params: Dict[str, str],
-                 body: Optional[bytes]):
+                 body: Optional[bytes], content_type: Optional[str] = None):
         self.method = method
         self.path = path
         self.params = params  # query params + path params merged
         self.raw_body = body or b""
+        self.content_type = content_type
 
     def json_body(self, default=None):
+        """Parse the structured request body — despite the historical
+        name, JSON/YAML/CBOR all parse here via content negotiation
+        (XContentFactory semantics; Content-Type first, sniffing second)."""
         if not self.raw_body.strip():
             return default
+        from elasticsearch_tpu.common.xcontent import (
+            XContentParseError,
+            parse,
+        )
+
         try:
-            return json.loads(self.raw_body)
-        except json.JSONDecodeError as e:
-            raise ParsingException(f"request body is not valid JSON: {e}") from e
+            return parse(self.raw_body, self.content_type)
+        except XContentParseError as e:
+            raise ParsingException(f"request body is not valid: {e}") from e
 
     def ndjson_lines(self) -> List[dict]:
         out = []
@@ -131,7 +140,8 @@ class RestController:
         self.routes.sort(key=lambda r: -r.specificity)
 
     def dispatch(self, method: str, path: str, query: Dict[str, str],
-                 body: Optional[bytes]) -> Tuple[int, Any]:
+                 body: Optional[bytes],
+                 content_type: Optional[str] = None) -> Tuple[int, Any]:
         from urllib.parse import unquote
 
         from elasticsearch_tpu.common.deprecation import begin_request
@@ -145,7 +155,7 @@ class RestController:
             if path_params is not None:
                 params = dict(query)
                 params.update(path_params)
-                req = RestRequest(method, path, params, body)
+                req = RestRequest(method, path, params, body, content_type)
                 inflight = None
                 reserved = False
                 if body and hasattr(self.node, "breaker_service"):

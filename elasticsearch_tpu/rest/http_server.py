@@ -27,7 +27,9 @@ class _Handler(BaseHTTPRequestHandler):
         query = dict(parse_qsl(parsed.query, keep_blank_values=True))
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
-        status, payload = self.controller.dispatch(method, parsed.path, query, body)
+        status, payload = self.controller.dispatch(
+            method, parsed.path, query, body,
+            content_type=self.headers.get("Content-Type"))
         from elasticsearch_tpu.common.deprecation import (
             collect_warnings,
             warning_header_value,
@@ -38,10 +40,13 @@ class _Handler(BaseHTTPRequestHandler):
             data = payload.encode("utf-8")
             ctype = "text/plain; charset=UTF-8"
         else:
-            pretty = "pretty" in query
-            data = json.dumps(payload, indent=2 if pretty else None,
-                              default=str).encode("utf-8")
-            ctype = "application/json; charset=UTF-8"
+            from elasticsearch_tpu.common.xcontent import (
+                response_format,
+                serialize,
+            )
+
+            fmt = response_format(query, self.headers.get("Accept"))
+            data, ctype = serialize(payload, fmt, pretty="pretty" in query)
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))

@@ -1,0 +1,144 @@
+"""XContent multi-format bodies/responses (XContentFactory/XContentType):
+JSON, YAML and CBOR negotiate via Content-Type / Accept / ?format= with
+first-bytes sniffing."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.xcontent import (
+    cbor_decode,
+    cbor_encode,
+    parse,
+    response_format,
+    serialize,
+    sniff_type,
+)
+
+
+class TestCborCodec:
+    def test_roundtrip_json_model(self):
+        doc = {"title": "hello", "n": 42, "neg": -7, "pi": 3.25,
+               "flags": [True, False, None],
+               "nested": {"a": [1, 2, {"b": "c"}]},
+               "unicode": "héllo wörld", "big": 1 << 40}
+        assert cbor_decode(cbor_encode(doc)) == doc
+
+    def test_long_strings_and_arrays(self):
+        doc = {"s": "x" * 300, "arr": list(range(500))}
+        assert cbor_decode(cbor_encode(doc)) == doc
+
+
+class TestNegotiation:
+    def test_sniffing(self):
+        assert sniff_type(b'  {"a": 1}') == "json"
+        assert sniff_type(b"---\na: 1\n") == "yaml"
+        assert sniff_type(cbor_encode({"a": 1})) == "cbor"
+
+    def test_parse_by_content_type(self):
+        assert parse(b"a: 1\nb: [x, y]\n",
+                     "application/yaml") == {"a": 1, "b": ["x", "y"]}
+        assert parse(cbor_encode({"q": 9}), "application/cbor") == {"q": 9}
+        assert parse(b'{"j": true}', "application/json") == {"j": True}
+
+    def test_response_format(self):
+        assert response_format({}, None) == "json"
+        assert response_format({"format": "yaml"}, None) == "yaml"
+        assert response_format({}, "application/cbor") == "cbor"
+
+    def test_serialize_yaml(self):
+        data, mime = serialize({"a": [1, 2]}, "yaml")
+        assert mime.startswith("application/yaml")
+        assert b"a:" in data
+
+    def test_yaml_serializes_non_native_objects(self):
+        class Weird:
+            def __str__(self):
+                return "weird!"
+
+        data, _ = serialize({"x": Weird(), "b": b"\xff\x00"}, "yaml")
+        assert b"weird!" in data
+
+    def test_cbor_truncated_string_rejected(self):
+        from elasticsearch_tpu.common.xcontent import XContentParseError
+
+        with pytest.raises(XContentParseError, match="truncated"):
+            cbor_decode(b"\x65ab")  # declares 5 bytes, 2 present
+
+    def test_cbor_trailing_bytes_rejected(self):
+        from elasticsearch_tpu.common.xcontent import XContentParseError
+
+        with pytest.raises(XContentParseError, match="trailing"):
+            cbor_decode(cbor_encode({"a": 1}) + b"junk")
+
+    def test_cbor_bigint_degrades_to_string(self):
+        assert cbor_decode(cbor_encode({"n": 1 << 70})) == {"n": str(1 << 70)}
+
+    def test_sniff_whitespace_prefixed_yaml(self):
+        assert sniff_type(b"\n---\na: 1\n") == "yaml"
+
+    def test_accept_list_with_qvalues(self):
+        assert response_format(
+            {}, "application/yaml, application/json;q=0.5") == "yaml"
+
+
+class TestHttpSurface:
+    @pytest.fixture(scope="class")
+    def server(self):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.http_server import HttpServer
+
+        node = Node()
+        srv = HttpServer(node, port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}"
+        srv.stop()
+
+    def _req(self, base, method, path, body=None, headers=None):
+        import urllib.request
+
+        req = urllib.request.Request(base + path, data=body, method=method,
+                                     headers=headers or {})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, resp.headers.get("Content-Type"), \
+                    resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Content-Type"), e.read()
+
+    def test_yaml_request_and_response(self, server):
+        st, _, _ = self._req(server, "PUT", "/ymx/_doc/1?refresh=true",
+                             b'{"msg": "yaml works"}',
+                             {"Content-Type": "application/json"})
+        assert st == 201
+        body = b"query:\n  match:\n    msg: yaml\n"
+        st, ctype, raw = self._req(
+            server, "POST", "/ymx/_search?format=yaml", body,
+            {"Content-Type": "application/yaml"})
+        assert st == 200
+        assert ctype.startswith("application/yaml")
+        import yaml as _yaml
+
+        parsed = _yaml.safe_load(raw)
+        assert parsed["hits"]["total"] == 1
+
+    def test_cbor_request_and_response(self, server):
+        doc = cbor_encode({"msg": "cbor payload"})
+        st, _, _ = self._req(server, "PUT", "/cbx/_doc/1?refresh=true", doc,
+                             {"Content-Type": "application/cbor"})
+        assert st == 201
+        q = cbor_encode({"query": {"match": {"msg": "cbor"}}})
+        st, ctype, raw = self._req(server, "POST", "/cbx/_search", q,
+                                   {"Content-Type": "application/cbor",
+                                    "Accept": "application/cbor"})
+        assert st == 200
+        assert ctype.startswith("application/cbor")
+        parsed = cbor_decode(raw)
+        assert parsed["hits"]["total"] == 1
+        assert parsed["hits"]["hits"][0]["_source"]["msg"] == "cbor payload"
+
+    def test_sniffed_yaml_without_header(self, server):
+        st, _, raw = self._req(server, "POST", "/ymx/_search",
+                               b"---\nquery:\n  match_all: {}\n")
+        assert st == 200
+        assert json.loads(raw)["hits"]["total"] >= 1
