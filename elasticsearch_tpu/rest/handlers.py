@@ -595,16 +595,111 @@ def _explain(node, req):
     resp = svc.search(q)
     matched = resp["hits"]["total"] > 0
     score = resp["hits"]["hits"][0]["_score"] if matched else 0.0
-    return 200, {
+    details = _bm25_explanation_details(
+        svc, doc_id, body.get("query")) if matched else []
+    out = {
         "_index": svc.name,
         "_id": doc_id,
         "matched": matched,
         "explanation": {
             "value": score,
-            "description": "BM25 score via TPU scatter-add scorer (sum of term contributions)",
-            "details": [],
+            "description": ("sum of:" if details else
+                            "score via the fused TPU query program"),
+            "details": details,
         },
     }
+    _echo_type(req, out)
+    return 200, out
+
+
+def _bm25_explanation_details(svc, doc_id, query_body):
+    """Per-term BM25 breakdown (BM25Similarity.explain's tree: boost *
+    idf * tfNorm with their inputs) for queries that expand to term
+    lanes; other query shapes keep the summary-level explanation."""
+    import math
+
+    from elasticsearch_tpu.ops.scoring import B, K1, bm25_idf
+    from elasticsearch_tpu.search.query_dsl import (
+        ShardQueryContext,
+        parse_query,
+    )
+
+    try:
+        qb = parse_query(query_body)
+    except Exception:  # noqa: BLE001 — summary fallback
+        return []
+    shard = svc.shards[svc._route(doc_id)]
+    ctx = ShardQueryContext(svc.mapper_service, engine=shard.engine)
+    lanes = qb.explain_terms(ctx)
+    if not lanes:
+        return []
+    entry = shard.engine.version_map.get(doc_id)
+    if entry is None or entry.segment is None:
+        return []
+    segment = next((s for s in shard.engine.searchable_segments()
+                    if s.name == entry.segment), None)
+    if segment is None:
+        return []
+    local = entry.local_doc
+    details = []
+    for field, token, boost in lanes:
+        tid = segment.term_id(field, token)
+        if tid < 0:
+            continue
+        start = int(segment.term_block_start[tid])
+        count = int(segment.term_block_count[tid])
+        blk = segment.block_docs[start:start + count]
+        sel = blk == local
+        if not sel.any():
+            continue
+        freq = float(segment.block_tfs[start:start + count][sel][0])
+        row = segment.field_norm_idx.get(field, 0)
+        dl = float(segment.norms[row][local])
+        avgdl = segment.field_avgdl(field)
+        st = segment.field_stats.get(field, {})
+        n_docs = int(st.get("doc_count", segment.num_docs))
+        df = int(segment.term_doc_freq[tid])
+        idf = bm25_idf(df, n_docs)
+        tf_norm = freq * (K1 + 1) / (freq + K1 * (1 - B + B * dl / avgdl))
+        details.append({
+            "value": boost * idf * tf_norm,
+            "description": f"weight({field}:{token} in {local}) "
+                           f"[PerFieldSimilarity], result of:",
+            "details": [{
+                "value": boost * idf * tf_norm,
+                "description": f"score(doc={local}, freq={freq}), "
+                               f"product of:",
+                "details": [
+                    {"value": boost, "description": "boost", "details": []},
+                    {"value": idf,
+                     "description": "idf, computed as log(1 + (N - n + 0.5)"
+                                    " / (n + 0.5)) from:",
+                     "details": [
+                         {"value": df,
+                          "description": "n, number of documents containing "
+                                         "term", "details": []},
+                         {"value": n_docs,
+                          "description": "N, total number of documents with "
+                                         "field", "details": []}]},
+                    {"value": tf_norm,
+                     "description": "tfNorm, computed as (freq * (k1 + 1)) /"
+                                    " (freq + k1 * (1 - b + b * dl / avgdl))"
+                                    " from:",
+                     "details": [
+                         {"value": freq, "description": "termFreq",
+                          "details": []},
+                         {"value": K1, "description": "parameter k1",
+                          "details": []},
+                         {"value": B, "description": "parameter b",
+                          "details": []},
+                         {"value": avgdl,
+                          "description": "avgFieldLength", "details": []},
+                         {"value": dl, "description": "fieldLength",
+                          "details": []}]},
+                ],
+            }],
+        })
+    return details
 
 
 def _search_template(node, req):

@@ -248,6 +248,12 @@ class QueryBuilder:
     def to_plan(self, ctx: ShardQueryContext, segment) -> P.PlanNode:
         raise NotImplementedError
 
+    def explain_terms(self, ctx) -> Optional[List[Tuple[str, str, float]]]:
+        """(field, token, boost) lanes for the explain API's per-term BM25
+        breakdown; None when this query type has no term-lane expansion
+        (the explain response then stays summary-level)."""
+        return None
+
     def _wrap_boost(self, node: P.PlanNode) -> P.PlanNode:
         if self.boost != 1.0:
             return P.BoostNode(node, self.boost)
@@ -292,6 +298,13 @@ class MatchQueryBuilder(QueryBuilder):
         return ft.index_terms(self.query, ctx.analyzers) or [
             ft.term_for_query(self.query, ctx.analyzers)
         ]
+
+    def explain_terms(self, ctx):
+        ft = ctx.field_type(self.field)
+        if ft is None or not isinstance(ft, TextFieldType):
+            return None
+        return [(self.field, t, self.boost)
+                for t in self._analyzed_terms(ctx)]
 
     def to_plan(self, ctx, segment):
         ft = ctx.field_type(self.field)
@@ -526,6 +539,21 @@ class TermQueryBuilder(QueryBuilder):
         node = score_terms_node(segment, [(self.field, token, self.boost)], 1,
                                 ctx=ctx)
         return node
+
+    def explain_terms(self, ctx):
+        ft = ctx.field_type(self.field)
+        from elasticsearch_tpu.mapper.field_types import (
+            BooleanFieldType,
+            KeywordFieldType,
+        )
+
+        if isinstance(ft, (KeywordFieldType, BooleanFieldType)) or ft is None:
+            token = (ft.term_for_query(self.value, ctx.analyzers)
+                     if ft is not None else str(self.value))
+            return [(self.field, token, self.boost)]
+        if isinstance(ft, TextFieldType):
+            return [(self.field, str(self.value), self.boost)]
+        return None
 
 
 class TermsQueryBuilder(QueryBuilder):
@@ -805,6 +833,14 @@ class BoolQueryBuilder(QueryBuilder):
         self.should = should or []
         self.must_not = must_not or []
         self.minimum_should_match = minimum_should_match
+
+    def explain_terms(self, ctx):
+        lanes = []
+        for child in list(self.must) + list(self.should):
+            sub = child.explain_terms(ctx)
+            if sub:
+                lanes.extend(sub)
+        return lanes or None
 
     def to_plan(self, ctx, segment):
         must = [q.to_plan(ctx, segment) for q in self.must]

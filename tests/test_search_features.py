@@ -287,3 +287,54 @@ class TestProfileTree:
             assert c["breakdown"] == {"fused_into_parent_program": 0}
         coll = r["profile"]["shards"][0]["searches"][0]["collector"][0]
         assert coll["name"] == "TopKSelector"
+
+
+class TestExplainDetail:
+    """Explain responses carry Lucene-style per-term BM25 breakdowns
+    (BM25Similarity.explain analog: boost * idf * tfNorm with inputs)."""
+
+    def test_match_query_breakdown_sums_to_score(self):
+        import json
+
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.controller import RestController
+
+        node = Node()
+        node.create_index("ex", {"mappings": {"_doc": {"properties": {
+            "t": {"type": "text"}}}}})
+        for i in range(10):
+            node.index_doc(
+                "ex", str(i),
+                {"t": f"quick brown fox {i}" if i % 2 else "lazy dog"},
+                refresh=(i == 9))
+        ctrl = RestController(node)
+        st, body = ctrl.dispatch(
+            "POST", "/ex/_explain/1", {},
+            json.dumps({"query": {"match": {"t": "quick fox"}}}).encode())
+        assert st == 200 and body["matched"]
+        exp = body["explanation"]
+        assert len(exp["details"]) == 2  # one weight per matched term
+        assert sum(d["value"] for d in exp["details"]) == \
+            __import__("pytest").approx(exp["value"], rel=1e-6)
+        comp = exp["details"][0]["details"][0]["details"]
+        descs = " ".join(c["description"] for c in comp)
+        assert "idf" in descs and "tfNorm" in descs and "boost" in descs
+        idf_node = next(c for c in comp if c["description"].startswith("idf"))
+        assert {d["description"][0] for d in idf_node["details"]} == {"n", "N"}
+
+    def test_unrecognized_query_stays_summary(self):
+        import json
+
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.controller import RestController
+
+        node = Node()
+        node.create_index("ex2", {"mappings": {"_doc": {"properties": {
+            "n": {"type": "integer"}}}}})
+        node.index_doc("ex2", "1", {"n": 5}, refresh=True)
+        ctrl = RestController(node)
+        st, body = ctrl.dispatch(
+            "POST", "/ex2/_explain/1", {},
+            json.dumps({"query": {"range": {"n": {"gte": 1}}}}).encode())
+        assert st == 200 and body["matched"]
+        assert body["explanation"]["details"] == []
