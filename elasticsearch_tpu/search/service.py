@@ -747,17 +747,27 @@ _HL_POST = "</em>"
 
 def highlight_fields(source: dict, mapper_service, query_terms: Dict[str, set],
                      highlight_body: dict) -> Dict[str, List[str]]:
-    """Plain highlighter (subphase/highlight/PlainHighlighter): re-analyze
-    the stored text, wrap matched tokens, emit best fragments."""
+    """Highlight sub-phase. Two highlighters, selected per field by
+    ``type`` (subphase/highlight/):
+
+    - "unified" (the 6.x default, UnifiedHighlighter): sentence-bounded
+      passages scored like Lucene's PassageScorer (unique-term coverage
+      with log tf saturation), top passages selected and term-wrapped.
+    - "plain" (PlainHighlighter): token-window fragments around matches.
+    """
     out = {}
     fields_spec = highlight_body.get("fields", {})
     pre = (highlight_body.get("pre_tags") or [_HL_PRE])[0]
     post = (highlight_body.get("post_tags") or [_HL_POST])[0]
     require_match = highlight_body.get("require_field_match", True)
+    default_type = highlight_body.get("type", "unified")
     all_terms = set().union(*query_terms.values()) if query_terms else set()
     for fname, fspec in fields_spec.items():
-        fragment_size = int((fspec or {}).get("fragment_size", 100))
-        n_frags = int((fspec or {}).get("number_of_fragments", 5))
+        fspec = fspec or {}
+        fragment_size = int(fspec.get("fragment_size", 100))
+        n_frags = int(fspec.get("number_of_fragments", 5))
+        hl_type = fspec.get("type", default_type)
+        order = fspec.get("order", highlight_body.get("order", "none"))
         for resolved in mapper_service.mapper.simple_match_to_fields(fname) or [fname]:
             value = _source_value(source, resolved)
             if value is None:
@@ -770,14 +780,90 @@ def highlight_fields(source: dict, mapper_service, query_terms: Dict[str, set],
             if not terms:
                 continue
             spans = [
-                (s, e) for tok, s, e in analyzer.analyze_tokens(text) if tok in terms
+                (s, e, tok) for tok, s, e in analyzer.analyze_tokens(text)
+                if tok in terms
             ]
             if not spans:
                 continue
-            fragments = _build_fragments(text, spans, fragment_size, n_frags, pre, post)
+            if hl_type == "plain":
+                fragments = _build_fragments(
+                    text, [(s, e) for s, e, _ in spans], fragment_size,
+                    n_frags, pre, post)
+            else:
+                fragments = _unified_fragments(
+                    text, spans, fragment_size, n_frags, pre, post, order)
             if fragments:
                 out[resolved] = fragments
     return out
+
+
+_SENTENCE_BREAK = None  # compiled lazily
+
+
+def _split_passages(text: str, max_len: int) -> List[tuple]:
+    """Sentence-bounded passages [(start, end)], long sentences split at
+    max_len word boundaries (java.text.BreakIterator analog)."""
+    import re as _re
+
+    global _SENTENCE_BREAK
+    if _SENTENCE_BREAK is None:
+        _SENTENCE_BREAK = _re.compile(r"(?<=[.!?])\s+|\n+")
+    bounds = []
+    start = 0
+    for m in _SENTENCE_BREAK.finditer(text):
+        bounds.append((start, m.start()))
+        start = m.end()
+    if start < len(text):
+        bounds.append((start, len(text)))
+    out = []
+    for s, e in bounds:
+        while e - s > max_len * 2:
+            cut = text.rfind(" ", s, s + max_len)
+            if cut <= s:
+                cut = s + max_len
+            out.append((s, cut))
+            s = cut + 1
+        if e > s:
+            out.append((s, e))
+    return out
+
+
+def _unified_fragments(text, spans, fragment_size, n_frags, pre, post,
+                       order) -> List[str]:
+    """UnifiedHighlighter: score sentence passages by unique-term coverage
+    with log tf saturation (PassageScorer semantics), take the top
+    passages, wrap their matches."""
+    import math
+
+    passages = _split_passages(text, fragment_size)
+    scored = []
+    for idx, (ps, pe) in enumerate(passages):
+        inside = [(s, e) for s, e, _tok in spans if s >= ps and e <= pe]
+        if not inside:
+            continue
+        tfs: Dict[str, int] = {}
+        for s, e, tok in spans:
+            if s >= ps and e <= pe:
+                tfs[tok] = tfs.get(tok, 0) + 1
+        score = sum(1.0 + math.log1p(tf) for tf in tfs.values())
+        scored.append((score, idx, ps, pe, inside))
+    if not scored:
+        return []
+    scored.sort(key=lambda t: (-t[0], t[1]))
+    chosen = scored[:n_frags]
+    if order != "score":
+        chosen.sort(key=lambda t: t[1])  # document order (6.x default)
+    fragments = []
+    for _score, _idx, ps, pe, inside in chosen:
+        frag = []
+        pos = ps
+        for a, b in sorted(inside):
+            frag.append(text[pos:a])
+            frag.append(pre + text[a:b] + post)
+            pos = b
+        frag.append(text[pos:pe])
+        fragments.append("".join(frag))
+    return fragments
 
 
 def _source_value(source: dict, path: str):

@@ -338,3 +338,54 @@ class TestExplainDetail:
             json.dumps({"query": {"range": {"n": {"gte": 1}}}}).encode())
         assert st == 200 and body["matched"]
         assert body["explanation"]["details"] == []
+
+
+class TestUnifiedHighlighter:
+    """Unified highlighter (the 6.x default): sentence-bounded passages
+    scored by unique-term coverage; plain remains available per field."""
+
+    @staticmethod
+    def _node():
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("hl", {"mappings": {"_doc": {"properties": {
+            "body": {"type": "text"}}}}})
+        node.index_doc("hl", "1", {"body": (
+            "The quick brown fox jumps over the lazy dog. "
+            "Nothing interesting happens in this sentence at all. "
+            "Another fox appears and the fox runs away quickly. "
+            "The end of the story arrives without any animals.")},
+            refresh=True)
+        return node
+
+    def test_passages_are_sentence_bounded_and_scored(self):
+        node = self._node()
+        r = node.search("hl", {
+            "query": {"match": {"body": "fox"}},
+            "highlight": {"fields": {"body": {"number_of_fragments": 2}}}})
+        frags = r["hits"]["hits"][0]["highlight"]["body"]
+        assert len(frags) == 2
+        # document order by default; both fox sentences present, the
+        # boring sentences absent
+        assert frags[0].startswith("The quick brown")
+        assert "<em>fox</em>" in frags[0] and "<em>fox</em>" in frags[1]
+        assert all("Nothing interesting" not in f for f in frags)
+
+    def test_score_order_puts_best_passage_first(self):
+        node = self._node()
+        r = node.search("hl", {
+            "query": {"match": {"body": "fox"}},
+            "highlight": {"order": "score",
+                          "fields": {"body": {"number_of_fragments": 2}}}})
+        frags = r["hits"]["hits"][0]["highlight"]["body"]
+        # the two-fox sentence outranks the one-fox sentence
+        assert frags[0].count("<em>fox</em>") == 2
+
+    def test_plain_type_still_available(self):
+        node = self._node()
+        r = node.search("hl", {
+            "query": {"match": {"body": "fox"}},
+            "highlight": {"fields": {"body": {"type": "plain"}}}})
+        frags = r["hits"]["hits"][0]["highlight"]["body"]
+        assert any("<em>fox</em>" in f for f in frags)
