@@ -298,13 +298,18 @@ class Engine:
     # Read path
     # ------------------------------------------------------------------
 
-    def get(self, doc_id: str) -> GetResult:
-        """Realtime get: buffer (unrefreshed) or sealed segment."""
+    def get(self, doc_id: str, realtime: bool = True) -> GetResult:
+        """Realtime get: buffer (unrefreshed) or sealed segment. With
+        realtime=False, only search-visible (sealed) docs are returned —
+        the reference reads the last refreshed reader
+        (ShardGetService realtime=false)."""
         with self._lock:
             entry = self.version_map.get(doc_id)
             if entry is None or entry.deleted:
                 return GetResult(False, doc_id)
             if entry.segment is None:
+                if not realtime:
+                    return GetResult(False, doc_id)
                 return GetResult(
                     True, doc_id,
                     source=self.buffer.sources[entry.local_doc],

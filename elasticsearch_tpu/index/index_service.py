@@ -172,9 +172,10 @@ class IndexService:
             routing = parent
         return routing
 
-    def get_doc(self, doc_id: str, routing: Optional[str] = None):
+    def get_doc(self, doc_id: str, routing: Optional[str] = None,
+                realtime: bool = True):
         shard = self.shards[self._route(doc_id, routing)]
-        return shard.get_doc(doc_id)
+        return shard.get_doc(doc_id, realtime=realtime)
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kw) -> dict:
         shard = self.shards[self._route(doc_id, routing)]

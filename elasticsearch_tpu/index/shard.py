@@ -177,9 +177,9 @@ class IndexShard:
         r["_primary_term"] = self.primary_term
         return r
 
-    def get_doc(self, doc_id: str):
+    def get_doc(self, doc_id: str, realtime: bool = True):
         self._ensure_started()
-        return self.engine.get(doc_id)
+        return self.engine.get(doc_id, realtime=realtime)
 
     def refresh(self) -> bool:
         return self.engine.refresh()

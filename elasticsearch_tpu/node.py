@@ -361,9 +361,13 @@ class Node:
             )
             self._persist_index_meta(index)
 
-    def get_doc(self, index: str, doc_id: str, routing=None) -> dict:
+    def get_doc(self, index: str, doc_id: str, routing=None,
+                realtime=True, refresh=None) -> dict:
         svc = self.index_service(index)
-        g = svc.get_doc(doc_id, routing)
+        if refresh in (True, "true", ""):
+            # GET ?refresh=true forces a refresh before reading
+            svc.refresh()
+        g = svc.get_doc(doc_id, routing, realtime=realtime)
         out = {
             "_index": svc.name,
             "_type": "_doc",
@@ -396,7 +400,8 @@ class Node:
         return r
 
     def mget(self, body: dict, default_index: Optional[str] = None,
-             default_type: Optional[str] = None) -> dict:
+             default_type: Optional[str] = None, realtime: bool = True,
+             refresh=None) -> dict:
         specs = body.get("docs")
         if specs is None and "ids" in body:
             # short form: {"ids": [...]} against the URL's index
@@ -416,7 +421,8 @@ class Node:
                 continue
             routing = spec.get("routing", spec.get("_routing"))
             try:
-                d = self.get_doc(index, str(spec["_id"]), routing)
+                d = self.get_doc(index, str(spec["_id"]), routing,
+                                 realtime=realtime, refresh=refresh)
                 d["_type"] = spec.get("_type", default_type) or "_doc"
                 docs.append(d)
             except IndexNotFoundException:

@@ -382,23 +382,35 @@ def _apply_source_filtering(req, r):
     return r
 
 
+def _realtime_params(req):
+    rt = req.param("realtime")
+    return {
+        "realtime": not (rt is not None and rt.lower() == "false"),
+        "refresh": req.param("refresh"),
+    }
+
+
 def _get_doc(node, req):
     _typed_api_warning(req)
-    r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
+    r = node.get_doc(req.param("index"), req.param("id"),
+                     req.param("routing"), **_realtime_params(req))
     _echo_type(req, _apply_source_filtering(req, r), node)
     return (200 if r["found"] else 404), r
 
 
 def _head_doc(node, req):
-    r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
+    r = node.get_doc(req.param("index"), req.param("id"),
+                     req.param("routing"), **_realtime_params(req))
     return (200 if r["found"] else 404), {}
 
 
 def _get_source(node, req):
-    r = node.get_doc(req.param("index"), req.param("id"), req.param("routing"))
+    r = node.get_doc(req.param("index"), req.param("id"),
+                     req.param("routing"), **_realtime_params(req))
     if not r["found"]:
         return 404, {}
-    return 200, r["_source"]
+    _apply_source_filtering(req, r)
+    return 200, r.get("_source", {})
 
 
 def _delete_doc(node, req):
@@ -441,8 +453,10 @@ def _update_doc(node, req):
 
 
 def _mget(node, req):
+    rp = _realtime_params(req)
     return 200, node.mget(req.json_body({}), req.param("index"),
-                          req.param("type"))
+                          req.param("type"), realtime=rp["realtime"],
+                          refresh=rp["refresh"])
 
 
 def _bulk(node, req):

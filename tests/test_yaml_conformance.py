@@ -38,12 +38,16 @@ PASSING = [
     "exists/10_basic.yml",
     "exists/30_parent.yml",
     "exists/40_routing.yml",
+    "exists/60_realtime_refresh.yml",
     "exists/70_defaults.yml",
     "get/40_routing.yml",
+    "get/60_realtime_refresh.yml",
     "get/80_missing.yml",
     "get_source/10_basic.yml",
     "get_source/15_default_values.yml",
     "get_source/40_routing.yml",
+    "get_source/60_realtime_refresh.yml",
+    "get_source/70_source_filtering.yml",
     "get_source/80_missing.yml",
     "index/12_result.yml",
     "index/20_optype.yml",
