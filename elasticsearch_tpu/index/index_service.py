@@ -294,7 +294,24 @@ class IndexService:
 
         shard_results = []
         failures = []
+        # can_match prefilter (SearchService.canMatch /
+        # TransportSearchAction pre-filtering): shards whose doc-value
+        # bounds cannot satisfy a pure range query are skipped without
+        # executing the query phase
+        skipped = 0
+        active_ids = []
         for sid in shard_ids:
+            if preference_shards is None and not _can_match(
+                    self.shards[sid], body):
+                skipped += 1
+                continue
+            active_ids.append(sid)
+        if not active_ids and shard_ids:
+            # keep at least one shard so the response shape (empty hits,
+            # empty agg frames) is produced by a real query phase
+            active_ids = [shard_ids[0]]
+            skipped -= 1
+        for sid in active_ids:
             try:
                 shard_results.append(
                     self.shards[sid].searcher.query(body, size_hint=max(k, 1))
@@ -339,8 +356,8 @@ class IndexService:
             "timed_out": False,
             "_shards": {
                 "total": len(shard_ids),
-                "successful": len(shard_results),
-                "skipped": 0,
+                "successful": len(shard_results) + skipped,
+                "skipped": skipped,
                 "failed": len(failures),
             },
             "hits": {
@@ -428,3 +445,38 @@ def _deep_merge(base: dict, patch: dict) -> dict:
         else:
             base[key] = value
     return base
+
+
+def _can_match(shard, body: dict) -> bool:
+    """Shard-level rewrite of a PURE range query against the shard's
+    doc-value bounds (the reference's canMatch phase rewrites the query
+    against min/max points). Conservative: anything but a bare range
+    query matches."""
+    query = (body or {}).get("query")
+    if not isinstance(query, dict) or set(query) != {"range"}:
+        return True
+    (field, cond), = query["range"].items()
+    if not isinstance(cond, dict):
+        return True
+    lo = cond.get("gte", cond.get("gt"))
+    hi = cond.get("lte", cond.get("lt"))
+    if not all(isinstance(v, (int, float)) or v is None for v in (lo, hi)):
+        return True  # dates/strings need parsing context; don't prefilter
+    any_col = False
+    for seg in shard.engine.searchable_segments():
+        col = seg.numeric_columns.get(field)
+        if col is None or col.count == 0:
+            continue
+        any_col = True
+        seg_min = float(col.min_value[seg.live[: seg.nd_pad]].min()) \
+            if seg.live[: seg.num_docs].any() else float("inf")
+        seg_max = float(col.max_value[seg.live[: seg.nd_pad]].max()) \
+            if seg.live[: seg.num_docs].any() else float("-inf")
+        if (lo is None or seg_max >= lo) and (hi is None or seg_min <= hi):
+            return True
+    if not any_col:
+        # no doc values for the field on this shard: only unrefreshed
+        # buffer docs could match, and the query phase reads sealed
+        # segments only — but match the conservative default
+        return True
+    return False

@@ -389,3 +389,62 @@ class TestUnifiedHighlighter:
             "highlight": {"fields": {"body": {"type": "plain"}}}})
         frags = r["hits"]["hits"][0]["highlight"]["body"]
         assert any("<em>fox</em>" in f for f in frags)
+
+
+class TestCanMatchPrefilter:
+    """can_match shard prefilter (SearchService.canMatch): shards whose
+    doc-value bounds cannot satisfy a pure range query are skipped and
+    reported in _shards.skipped."""
+
+    def test_range_query_skips_non_matching_shards(self):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.utils.murmur3 import shard_id_for
+
+        node = Node()
+        node.create_index("rng", {
+            # host per-shard path (the mesh data plane executes eligible
+            # multi-shard queries as one program and never visits the
+            # coordinator's shard loop)
+            "settings": {"index": {"number_of_shards": 2,
+                                   "search": {"mesh": False}}},
+            "mappings": {"_doc": {"properties": {
+                "n": {"type": "integer"}}}}})
+        # find routing keys that land on distinct shards
+        r0 = next(r for r in map(str, range(100))
+                  if shard_id_for(r, 2) == 0)
+        r1 = next(r for r in map(str, range(100))
+                  if shard_id_for(r, 2) == 1)
+        for i in range(10):
+            node.index_doc("rng", f"a{i}", {"n": i}, routing=r0)
+        for i in range(10):
+            node.index_doc("rng", f"b{i}", {"n": 1000 + i}, routing=r1)
+        node.indices["rng"].refresh()
+
+        res = node.search("rng", {"query": {"range": {"n": {"gte": 900}}},
+                                  "size": 20})
+        assert res["hits"]["total"] == 10
+        assert res["_shards"]["skipped"] == 1
+        assert res["_shards"]["successful"] == 2
+
+        # both shards overlap -> nothing skipped
+        res = node.search("rng", {"query": {"range": {"n": {"gte": 0}}},
+                                  "size": 30})
+        assert res["hits"]["total"] == 20
+        assert res["_shards"]["skipped"] == 0
+
+        # nothing matches anywhere: one shard still runs for the frame
+        res = node.search("rng", {"query": {"range": {"n": {"gte": 10000}}}})
+        assert res["hits"]["total"] == 0
+        assert res["_shards"]["skipped"] == 1
+
+    def test_non_range_queries_never_skip(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node()
+        node.create_index("nr", {
+            "settings": {"index": {"number_of_shards": 2}},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text"}}}}})
+        node.index_doc("nr", "1", {"t": "hello"}, refresh=True)
+        res = node.search("nr", {"query": {"match": {"t": "hello"}}})
+        assert res["_shards"]["skipped"] == 0
