@@ -1111,6 +1111,7 @@ class Node:
 
         unregister_node(self)
         self.plugins_service.close()
+        self.snapshots.close()
         for name in list(self.indices):
             if self.persistent_path:
                 self._persist_index_meta(name)

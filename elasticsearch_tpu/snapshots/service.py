@@ -16,6 +16,8 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import tempfile
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -36,11 +38,22 @@ class SnapshotState:
 class FsRepository:
     """Shared-filesystem blob repository (core/.../repositories/fs)."""
 
-    def __init__(self, name: str, settings: dict):
+    def __init__(self, name: str, settings: dict, base_path: Optional[str] = None):
         self.name = name
         location = settings.get("location")
         if not location:
             raise IllegalArgumentException("[fs] repository requires [location] setting")
+        # Relative locations resolve under the node's repo root and must stay
+        # inside it (the analog of the reference's path.repo containment check,
+        # core/.../env/Environment.resolveRepoFile) so conformance suites with
+        # bare names don't scatter dirs into the cwd.
+        if base_path and not os.path.isabs(location):
+            resolved = os.path.realpath(os.path.join(base_path, location))
+            root = os.path.realpath(base_path)
+            if not (resolved == root or resolved.startswith(root + os.sep)):
+                raise IllegalArgumentException(
+                    f"location [{location}] resolves outside the repository root")
+            location = resolved
         self.location = location
         os.makedirs(location, exist_ok=True)
 
@@ -68,16 +81,42 @@ class SnapshotsService:
     def __init__(self, node):
         self.node = node
         self.repositories: Dict[str, FsRepository] = {}
+        self._tmp_repo_base: Optional[str] = None
+        self._tmp_repo_lock = threading.Lock()
         # RepositoryPlugin extension point: {type: factory(name, settings,
         # node)} — fs is built-in, cloud types arrive via plugins
         self.repository_types: Dict[str, object] = {}
 
     # --- repositories ---
 
+    def _repo_base_path(self) -> str:
+        """Root under which relative fs-repo locations resolve.
+
+        Persistent nodes use <path.data>/repos (mirroring _index_data_path's
+        gate in node.py); in-memory nodes get a lazily-created node-scoped
+        temp dir so a bare relative location never touches the cwd.
+        """
+        if getattr(self.node, "persistent_path", False):
+            return os.path.join(self.node.data_path, "repos")
+        with self._tmp_repo_lock:
+            if self._tmp_repo_base is None:
+                self._tmp_repo_base = tempfile.mkdtemp(prefix="estpu-repos-")
+            return self._tmp_repo_base
+
+    def close(self) -> None:
+        with self._tmp_repo_lock:
+            if self._tmp_repo_base is not None:
+                shutil.rmtree(self._tmp_repo_base, ignore_errors=True)
+                self._tmp_repo_base = None
+
     def put_repository(self, name: str, body: dict) -> dict:
         rtype = body.get("type")
         if rtype == "fs":
-            repo = FsRepository(name, body.get("settings") or {})
+            settings = body.get("settings") or {}
+            loc = settings.get("location")
+            base = (self._repo_base_path()
+                    if loc and not os.path.isabs(loc) else None)
+            repo = FsRepository(name, settings, base_path=base)
         elif rtype in self.repository_types:
             repo = self.repository_types[rtype](
                 name, body.get("settings") or {}, self.node)
