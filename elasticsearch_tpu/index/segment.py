@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -194,6 +195,8 @@ class Segment:
         self._device: Optional[dict] = None
         # generic device-array cache for doc-value columns (key -> jnp array)
         self.dev_cache: Dict[str, Any] = {}
+        # guards lazy per-sub live-mask staging vs delete_docs' restage
+        self._live_t_lock = threading.Lock()
 
     # ------------------------------------------------------------------
 
@@ -226,11 +229,16 @@ class Segment:
             self._device["live1"] = jnp.asarray(
                 np.concatenate([self.live, np.zeros(1, dtype=bool)])
             )
-            if "k_live_t" in self._device:
-                from elasticsearch_tpu.ops import pallas_scoring as psc
-
-                self._device["k_live_t"] = jnp.asarray(psc.build_live_t(
-                    self.live.astype(np.float32), self.kernel_geom))
+            with self._live_t_lock:
+                if "k_live_t" in self._device:
+                    self._device["k_live_t"] = self._build_live_t_device(
+                        self.kernel_geom.tile_sub)
+                # per-sub variants staged by kernel_live_t_for (dense-term
+                # queries that shrank the tile) restage the same way
+                for key in [k for k in self._device
+                            if k.startswith("k_live_t_")]:
+                    sub = int(key.rsplit("_", 1)[1])
+                    self._device[key] = self._build_live_t_device(sub)
 
     def term_id(self, field_name: str, token: str) -> int:
         key = f"{field_name}{FIELD_SEP}{token}"
@@ -343,6 +351,29 @@ class Segment:
         self.kernel_bmax = bmax
         self._device.update(staged)
         self.kernel_geom = geom
+
+    def _build_live_t_device(self, sub: int):
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        return jnp.asarray(psc.build_live_t(
+            self.live.astype(np.float32),
+            psc.tile_geometry(self.nd_pad, sub)))
+
+    def kernel_live_t_for(self, sub: int) -> str:
+        """Lazily stage the live-mask tile layout for a non-default
+        tile_sub and return its device-dict key. Queries containing a
+        dense (high-df) term shrink the tile so the per-tile covering
+        window fits the kernel bound (see query_dsl's geometry ladder);
+        docs/frac/bmin/bmax are tile-size independent, only this mask
+        layout changes. Locked against delete_docs' restage so a stale
+        mask can never be published after a concurrent delete."""
+        key = f"k_live_t_{sub}"
+        with self._live_t_lock:
+            if key not in self._device:
+                self._device[key] = self._build_live_t_device(sub)
+        return key
 
     def _block_frac(self) -> np.ndarray:
         """Per-posting BM25 norm factors, computed per FIELD (each field's

@@ -96,11 +96,21 @@ def _make_segsum_kernel(o_sub: int, with_sum: bool, with_count: bool):
 
         if with_sum:
             vals = val_ref[...]
-            lovv = jnp.where(
-                lax.broadcasted_iota(jnp.int32, (LANE, CHUNK), 0) == lo_row,
-                vals.reshape(1, CHUNK), jnp.float32(0.0))
-            tot = lax.dot_general(lovv, ohT, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
+            # two-pass error-compensated matmul (see pallas_scoring.py):
+            # default bf16 MXU passes would round the metric values to 8-bit
+            # mantissas; bf16-high + f32-residual summed over two DEFAULT
+            # dots restores ~2^-17 rel error at 1/3 of HIGHEST's passes
+            # (ohT is 0/1, bf16-exact)
+            vrow = vals.reshape(1, CHUNK)
+            v_hi = vrow.astype(jnp.bfloat16).astype(jnp.float32)
+            v_lo = vrow - v_hi
+            lane_iota = lax.broadcasted_iota(jnp.int32, (LANE, CHUNK), 0)
+            lov_hi = jnp.where(lane_iota == lo_row, v_hi, jnp.float32(0.0))
+            lov_lo = jnp.where(lane_iota == lo_row, v_lo, jnp.float32(0.0))
+            tot = (lax.dot_general(lov_hi, ohT, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+                   + lax.dot_general(lov_lo, ohT, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32))
 
             @pl.when(c == jnp.int32(0))
             def _():
@@ -158,9 +168,15 @@ def segment_aggregate(
         if values is not None:
             values = jnp.pad(values, (0, target - nd))
     if values is not None:
-        fmax = jnp.float32(np.finfo(np.float32).max)
-        values = jnp.nan_to_num(values.astype(jnp.float32), nan=0.0,
-                                posinf=fmax, neginf=-fmax)
+        # clamp to the bf16-representable range: the kernel's two-pass
+        # compensated matmul splits values at bf16 precision, and f32-max
+        # would overflow to inf there (inf - inf = NaN poisons buckets)
+        fmax = jnp.float32(float(jnp.finfo(jnp.bfloat16).max))
+        # clip as well as nan_to_num: finite f32 values above bf16-max would
+        # still round to inf inside the kernel's bf16 split
+        values = jnp.clip(
+            jnp.nan_to_num(values.astype(jnp.float32), nan=0.0,
+                           posinf=fmax, neginf=-fmax), -fmax, fmax)
     n_chunks = target // CHUNK
     o_pad = next_pow2(max(n_ords, LANE))
     o_sub = o_pad // LANE

@@ -219,7 +219,7 @@ class PallasScoreTermsNode(PlanNode):
     computed host-side from per-block doc ranges."""
 
     def __init__(self, row_lo, row_hi, kweights, min_match, *, cb: int,
-                 sub: int, interpret: bool):
+                 sub: int, interpret: bool, live_key: str = "k_live_t"):
         self.row_lo = row_lo  # [n_tiles, t_pad] i32
         self.row_hi = row_hi
         self.kweights = kweights  # [1, t_pad] f32
@@ -230,10 +230,13 @@ class PallasScoreTermsNode(PlanNode):
         self.n_tiles = int(row_lo.shape[0])
         self.interpret = interpret
         self.with_counts = min_match > 1
+        # live-mask layout key in the segment device dict: the geometry
+        # ladder stages per-sub variants for dense-term queries
+        self.live_key = live_key
 
     def key(self):
         return (f"pterms[{self.n_tiles},{self.t_pad},{self.cb},{self.sub},"
-                f"{self.with_counts},{self.interpret}]")
+                f"{self.with_counts},{self.interpret},{self.live_key}]")
 
     def trace_statics(self):
         return (self.cb, self.sub, self.t_pad, self.with_counts,
@@ -253,7 +256,7 @@ class PallasScoreTermsNode(PlanNode):
 
         row_lo, row_hi, kweights, min_match = ctx.take(4)
         outs = psc.score_tiles(
-            ctx.seg["k_docs"], ctx.seg["k_frac"], ctx.seg["k_live_t"],
+            ctx.seg["k_docs"], ctx.seg["k_frac"], ctx.seg[self.live_key],
             row_lo, row_hi, kweights,
             t_pad=self.t_pad, cb=self.cb, sub=self.sub,
             dense=True, with_counts=self.with_counts,

@@ -204,15 +204,31 @@ def _pallas_score_terms_node(segment, arrs, min_match):
         return None
     from elasticsearch_tpu.ops import pallas_scoring as psc
 
-    try:
-        row_lo, row_hi, kweights, cb = psc.build_tile_tables(
-            [psc.QueryLane(s, c, w) for s, c, w, _ in lanes],
-            segment.kernel_bmin, segment.kernel_bmax, geom)
-    except ValueError:
-        return None  # covering window exceeds the kernel bound
+    qlanes = [psc.QueryLane(s, c, w) for s, c, w, _ in lanes]
+    # geometry ladder: big tiles are fastest (per-grid-step overhead
+    # dominates), but a dense term's per-tile covering window can exceed
+    # the kernel bound there — retry with smaller tiles. Non-overlapping
+    # sorted block ranges guarantee the window fits at tile_sub <= 32
+    # (need <= sub + 2 blocks), so the ladder always terminates on the
+    # kernel path for any well-formed segment.
+    sub = geom.tile_sub
+    while True:
+        g = geom if sub == geom.tile_sub else psc.tile_geometry(
+            geom.nd_pad, sub)
+        try:
+            row_lo, row_hi, kweights, cb = psc.build_tile_tables(
+                qlanes, segment.kernel_bmin, segment.kernel_bmax, g)
+            break
+        except ValueError:
+            if sub <= 32 or g.tile_sub < sub:
+                return None  # malformed ranges; scatter path handles it
+            sub //= 2
+    live_key = ("k_live_t" if g.tile_sub == geom.tile_sub
+                else segment.kernel_live_t_for(g.tile_sub))
     return P.PallasScoreTermsNode(
         row_lo, row_hi, kweights, min_match,
-        cb=cb, sub=geom.tile_sub, interpret=(mode == "interpret"))
+        cb=cb, sub=g.tile_sub, interpret=(mode == "interpret"),
+        live_key=live_key)
 
 
 def _numeric_csr(segment, field):

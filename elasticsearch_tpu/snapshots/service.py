@@ -13,6 +13,7 @@ never the source of truth.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import shutil
@@ -33,6 +34,25 @@ class SnapshotState:
     SUCCESS = "SUCCESS"
     IN_PROGRESS = "IN_PROGRESS"
     FAILED = "FAILED"
+
+
+# process-wide repo root for in-memory nodes: a shared-filesystem repository
+# contract means the SAME relative location must alias the SAME directory on
+# every node (RepositoriesService resolves against the configured path.repo
+# the same way), so the fallback root is per-process, not per-node. Created
+# lazily, removed at interpreter exit.
+_proc_repo_base: Optional[str] = None
+_proc_repo_lock = threading.Lock()
+
+
+def _process_repo_base() -> str:
+    global _proc_repo_base
+    with _proc_repo_lock:
+        if _proc_repo_base is None:
+            _proc_repo_base = tempfile.mkdtemp(prefix="estpu-repos-")
+            atexit.register(shutil.rmtree, _proc_repo_base,
+                            ignore_errors=True)
+        return _proc_repo_base
 
 
 class FsRepository:
@@ -81,8 +101,6 @@ class SnapshotsService:
     def __init__(self, node):
         self.node = node
         self.repositories: Dict[str, FsRepository] = {}
-        self._tmp_repo_base: Optional[str] = None
-        self._tmp_repo_lock = threading.Lock()
         # RepositoryPlugin extension point: {type: factory(name, settings,
         # node)} — fs is built-in, cloud types arrive via plugins
         self.repository_types: Dict[str, object] = {}
@@ -93,21 +111,17 @@ class SnapshotsService:
         """Root under which relative fs-repo locations resolve.
 
         Persistent nodes use <path.data>/repos (mirroring _index_data_path's
-        gate in node.py); in-memory nodes get a lazily-created node-scoped
-        temp dir so a bare relative location never touches the cwd.
-        """
+        gate in node.py); in-memory nodes share the process-wide temp root
+        so a bare relative location never touches the cwd AND still names
+        the same directory on every node (the shared-fs repo contract)."""
         if getattr(self.node, "persistent_path", False):
             return os.path.join(self.node.data_path, "repos")
-        with self._tmp_repo_lock:
-            if self._tmp_repo_base is None:
-                self._tmp_repo_base = tempfile.mkdtemp(prefix="estpu-repos-")
-            return self._tmp_repo_base
+        return _process_repo_base()
 
     def close(self) -> None:
-        with self._tmp_repo_lock:
-            if self._tmp_repo_base is not None:
-                shutil.rmtree(self._tmp_repo_base, ignore_errors=True)
-                self._tmp_repo_base = None
+        # the in-memory repo root is process-scoped (shared across nodes),
+        # cleaned by atexit — nothing node-scoped to release here
+        pass
 
     def put_repository(self, name: str, body: dict) -> dict:
         rtype = body.get("type")

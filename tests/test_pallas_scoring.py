@@ -278,3 +278,38 @@ class TestHostGeometry:
         assert tile_geometry(64).n_tiles == 1
         g = tile_geometry(1 << 20)
         assert g.n_tiles * g.tile_w == 1 << 20
+
+    def test_dense_term_needs_smaller_tile(self):
+        """A clustered dense term overflows the covering-window bound at
+        big tiles; the planner's geometry ladder must find a tile_sub
+        where it fits (sub=32 always does: need <= sub + 2), and the
+        kernel at that geometry must still match the oracle."""
+        nd = 1 << 16  # 64k docs so tile_sub=128 tiles exist
+        nd_pad = nd
+        # one term matching every doc: 512 maximally-dense blocks
+        docs = np.arange(nd, dtype=np.int32).reshape(-1, LANE)
+        tfs = np.ones_like(docs, np.float32)
+        frac = compute_block_frac(docs, tfs, np.full(nd_pad + 1, 10.0,
+                                                     np.float32), 10.0)
+        bmin, bmax = block_min_max(docs, tfs, nd_pad)
+        lanes = [QueryLane(0, docs.shape[0], 1.5)]
+        with pytest.raises(ValueError):
+            build_tile_tables(lanes, bmin, bmax,
+                              tile_geometry(nd_pad, tile_sub=128))
+        # the ladder's floor geometry fits and scores correctly
+        geom = tile_geometry(nd_pad, tile_sub=32)
+        row_lo, row_hi, weights, cb = build_tile_tables(
+            lanes, bmin, bmax, geom)
+        assert cb <= CB_MAX // 2
+        dp, fp = pad_segment_blocks(docs, frac, nd_pad)
+        live = np.ones(nd_pad, np.float32)
+        out = score_tiles(
+            jnp.asarray(dp), jnp.asarray(fp),
+            jnp.asarray(build_live_t(live, geom)),
+            jnp.asarray(row_lo), jnp.asarray(row_hi), jnp.asarray(weights),
+            t_pad=weights.shape[1], cb=cb, sub=geom.tile_sub, k=10,
+            interpret=True)
+        top_s, top_d, hits = merge_tile_topk(*out, 10)
+        ref = reference_scores(docs, frac, lanes, nd_pad)
+        assert int(hits) == nd
+        assert_topk_valid(top_s, top_d, ref, 10)
