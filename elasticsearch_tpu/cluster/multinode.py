@@ -391,7 +391,9 @@ class ClusterNode:
 
                 raise IndexAlreadyExistsException(name)
             md = IndexMetadata(
-                name, Settings.from_dict(settings or {}), mappings or {"properties": {}},
+                name,
+                Settings.from_dict(settings or {}).with_index_prefix(),
+                mappings or {"properties": {}},
                 creation_date=int(time.time() * 1000),
             )
             self.indices_meta[name] = md

@@ -51,6 +51,19 @@ class Settings:
     def as_dict(self) -> Dict[str, Any]:
         return dict(self._data)
 
+    def with_index_prefix(self) -> "Settings":
+        """Normalize index-level settings: bare keys get the ``index.``
+        prefix (the reference accepts both ``number_of_shards`` and
+        ``index.number_of_shards`` in create-index/update-settings bodies
+        and canonicalizes via IndexScopedSettings prefix normalization —
+        silently dropping the bare form loses e.g. the shard count)."""
+        out = {}
+        for k, v in self._data.items():
+            if not k.startswith("index.") and k != "index":
+                k = "index." + k
+            out[k] = v
+        return Settings(out)
+
     def as_nested_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         for key, value in sorted(self._data.items()):

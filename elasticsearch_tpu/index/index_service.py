@@ -234,19 +234,23 @@ class IndexService:
         out = self._mesh_search.query(body, max(k, 1))
         if out is None:
             return None
-        total, refs, max_score = out
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size")) if body.get("size") is not None else 10
+        refs = out["refs"]
         refs_window = refs[from_: from_ + size] if size >= 0 else refs[from_:]
         hits = fetch_hits(refs_window, self.shards, body, self.name)
-        return {
+        resp = {
             "took": int((_time.monotonic() - t0) * 1000),
             "timed_out": False,
             "_shards": {"total": len(self.shards),
                         "successful": len(self.shards),
                         "skipped": 0, "failed": 0},
-            "hits": {"total": total, "max_score": max_score, "hits": hits},
+            "hits": {"total": out["total"], "max_score": out["max_score"],
+                     "hits": hits},
         }
+        if out["aggregations"] is not None:
+            resp["aggregations"] = out["aggregations"]
+        return resp
 
     def search(self, body: Optional[dict] = None,
                preference_shards: Optional[List[int]] = None) -> dict:

@@ -121,7 +121,8 @@ class Node:
             name in md.aliases for md in self.cluster_service.state.indices.values()
         ):
             raise IndexAlreadyExistsException(name)
-        settings = Settings.from_dict(body.get("settings") or {})
+        settings = Settings.from_dict(
+            body.get("settings") or {}).with_index_prefix()
         mappings = body.get("mappings") or {}
         mappings, doc_type = _unwrap_typed_mapping(mappings)
         aliases = {a: (spec or {}) for a, spec in (body.get("aliases") or {}).items()}
@@ -136,7 +137,7 @@ class Node:
         merged_mappings: dict = {}
         for t in templates:
             merged_settings = merged_settings.merged_with(
-                Settings.from_dict(t.get("settings") or {})
+                Settings.from_dict(t.get("settings") or {}).with_index_prefix()
             )
             t_map = t.get("mappings") or {}
             if "_doc" in t_map:
@@ -911,11 +912,8 @@ class Node:
         }
 
     def update_index_settings(self, expression: str, body: dict) -> dict:
-        flat = Settings.from_dict(body.get("settings", body) or {})
-        normalized = Settings({
-            (k if k.startswith("index.") else f"index.{k}"): v
-            for k, v in flat.as_dict().items()
-        })
+        normalized = Settings.from_dict(
+            body.get("settings", body) or {}).with_index_prefix()
         self.index_scoped_settings.validate_dynamic_update(normalized)
         names = self.cluster_service.state.resolve_index_names(expression)
 
@@ -1051,7 +1049,8 @@ class Node:
         svc = self.index_service(source)
         settings = dict((body.get("settings") or {}))
         target_shards = int(
-            Settings.from_dict(settings).get("index.number_of_shards", 1)
+            Settings.from_dict(settings).with_index_prefix()
+            .get("index.number_of_shards", 1)
         )
         if svc.num_shards % target_shards != 0:
             raise IllegalArgumentException(

@@ -163,7 +163,18 @@ class _TemplateHolder:
 
 
 @functools.lru_cache(maxsize=128)
-def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int):
+def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
+                        sort_keys: Optional[Tuple[str, str]] = None,
+                        with_views: bool = False):
+    """One compiled scatter-gather program.
+
+    sort_keys: None ranks by score; (key_name, raw_name) ranks by the
+    staged oriented key column and carries the raw field values for the
+    response's per-hit ``sort`` array (FieldSortBuilder semantics).
+    with_views: additionally return the per-device matched masks and
+    scores (sharded, no collective) — the aggregation reduce consumes
+    them as SegmentViews exactly like the host path's shard partials.
+    """
     plan = holder.plan
     n_dev = mesh.devices.size
 
@@ -173,34 +184,53 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int):
         scores, matched = plan.emit(ctx)
         matched = matched & seg["live1"]
         total = jax.lax.psum(jnp.sum(matched.astype(jnp.int32)), "shards")
-        masked = jnp.where(matched, scores, -jnp.inf)
+        if sort_keys is None:
+            rank_key = scores
+        else:
+            rank_key = seg[sort_keys[0]]
+        masked = jnp.where(matched, rank_key, -jnp.inf)
         kk = min(k, masked.shape[0])
-        loc_scores, loc_docs = jax.lax.top_k(masked, kk)
+        loc_keys, loc_docs = jax.lax.top_k(masked, kk)
+        loc_scores = scores[loc_docs]
         # global merge over ICI: every device holds the same global top-k.
         # The merged pool holds n_dev*kk candidates, so the global cut is
         # min(k, pool) — NOT kk: when k exceeds one shard's padded doc
         # count, hits beyond the largest shard are still real.
-        all_scores = jax.lax.all_gather(loc_scores, "shards").reshape(-1)
+        all_keys = jax.lax.all_gather(loc_keys, "shards").reshape(-1)
         all_docs = jax.lax.all_gather(loc_docs, "shards").reshape(-1)
-        top_scores, top_idx = jax.lax.top_k(
-            all_scores, min(k, all_scores.shape[0]))
+        all_scores = jax.lax.all_gather(loc_scores, "shards").reshape(-1)
+        top_keys, top_idx = jax.lax.top_k(
+            all_keys, min(k, all_keys.shape[0]))
         top_shard = (top_idx // kk).astype(jnp.int32)
         top_doc = all_docs[top_idx]
-        return (top_scores[None], top_shard[None], top_doc[None],
-                total[None])
+        top_score = all_scores[top_idx]
+        if sort_keys is None:
+            top_raw = top_keys  # == scores
+        else:
+            loc_raw = seg[sort_keys[1]][loc_docs]
+            all_raw = jax.lax.all_gather(loc_raw, "shards").reshape(-1)
+            top_raw = all_raw[top_idx]
+        outs = [top_keys[None], top_shard[None], top_doc[None],
+                total[None], top_score[None], top_raw[None]]
+        if with_views:
+            outs.extend([matched[None], scores[None]])
+        return tuple(outs)
 
+    n_merged = 6
     mapped = shard_map(
         per_device, mesh=mesh,
         in_specs=(PS("shards"), PS("shards")),
-        out_specs=(PS("shards"),) * 4,
+        out_specs=(PS("shards"),) * (n_merged + (2 if with_views else 0)),
         check_vma=False,
     )
 
     @jax.jit
     def run(seg, plan_arrays):
         outs = mapped(seg, plan_arrays)
-        # merge is replicated: row 0 == row i
-        return tuple(o[0] for o in outs)
+        # merged outputs are replicated (row 0 == row i); view outputs
+        # keep their sharded leading axis
+        merged = tuple(o[0] for o in outs[:n_merged])
+        return merged + tuple(outs[n_merged:])
 
     return run
 
@@ -222,10 +252,13 @@ class IndexMeshSearch:
     invalidated automatically when any shard refreshes/merges."""
 
     # request keys the mesh program does not cover (yet) — presence of
-    # any of them falls back to the host path
-    UNSUPPORTED = ("sort", "collapse", "rescore", "search_after", "slice",
+    # any of them falls back to the host path. sort and aggs ARE covered:
+    # single-field f32-exact numeric/_doc/_score sorts rank in-program,
+    # and aggregations reduce over the program's per-device matched masks
+    # with the same framework as the host path (full agg-type parity).
+    UNSUPPORTED = ("collapse", "rescore", "search_after", "slice",
                    "post_filter", "min_score", "terminate_after", "profile",
-                   "aggs", "aggregations", "suggest", "highlight")
+                   "suggest", "highlight")
 
     def __init__(self, index_service, mesh: Optional[Mesh] = None):
         self.svc = index_service
@@ -268,8 +301,41 @@ class IndexMeshSearch:
             self._staged_key = key
         return True
 
+    def _sort_plan(self, body: dict):
+        """Resolve the request's sort to staged mesh key columns.
+
+        Returns (sort_keys, sort_spec) — sort_keys None for relevance —
+        or the string "fallback" when the sort can't run on the mesh."""
+        from elasticsearch_tpu.search.service import normalize_sort
+
+        sort_spec = normalize_sort(body.get("sort"))
+        if sort_spec is None:
+            return None, None
+        if len(sort_spec) != 1:
+            return "fallback", None
+        field, order, missing = sort_spec[0]
+        if not isinstance(field, str) or field == "_geo_distance":
+            return "fallback", None
+        if field == "_score":
+            if order != "desc":
+                return "fallback", None  # ascending-score sort is exotic
+            # relevance ranking, but the response carries sort values
+            return None, sort_spec
+        if isinstance(missing, dict):
+            return "fallback", None
+        keys = self._executor.ensure_sort_column(field, order, missing)
+        if keys is None:
+            return "fallback", None
+        return keys, sort_spec
+
     def query(self, body: dict, k: int):
-        """Returns (total, refs, max_score) or None if ineligible."""
+        """Returns {total, refs, max_score, aggregations} or None if
+        ineligible."""
+        from elasticsearch_tpu.search.aggregations import (
+            SegmentView,
+            parse_aggs,
+            run_aggregations,
+        )
         from elasticsearch_tpu.search.query_dsl import (
             ShardQueryContext,
             parse_query,
@@ -286,9 +352,14 @@ class IndexMeshSearch:
             return None  # index-sorted early termination beats top-k
         if not self._ensure_staged():
             return None
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        sort_keys, sort_spec = self._sort_plan(body)
+        if sort_keys == "fallback":
+            return None
         qb = parse_query(body.get("query"))
         try:
             plans = []
+            ctxs = {}
             for sid, seg in self._pairs:
                 shard = self.svc.shards[sid]
                 ctx = ShardQueryContext(shard.mapper_service,
@@ -296,23 +367,52 @@ class IndexMeshSearch:
                 # mesh plans must stack across shards; the pallas tile
                 # node is non-stackable, so pin the scatter nodes here
                 ctx.for_mesh = True
+                ctxs[sid] = ctx
                 plans.append(qb.to_plan(ctx, seg))
-            scores, slots, docs, total = self._executor.execute(plans, k)
+            outs = self._executor.execute(plans, k, sort_keys=sort_keys,
+                                          with_views=bool(agg_specs))
         except PlanStructureMismatch:
             return None
         except NotImplementedError:
             return None  # a builder without a plan form
+        keys, slots, docs, total, scores, raws = outs[:6]
+        keys = np.asarray(keys)
+        scores = np.asarray(scores)
+        raws = np.asarray(raws)
         self.query_total += 1
         refs = []
         max_score = None
-        for s, slot, d in zip(scores, slots, docs):
-            if s == -np.inf:
+        for i, (key, slot, d) in enumerate(zip(keys, np.asarray(slots),
+                                               np.asarray(docs))):
+            if key == -np.inf:
                 continue
             sid, seg = self._pairs[int(slot)]
-            refs.append(DocRef(sid, seg.name, int(d), float(s)))
-            if max_score is None:
-                max_score = float(s)
-        return int(total), refs, max_score
+            score = float(scores[i])
+            if sort_keys is None:
+                sv = (score,) if sort_spec else ()
+            else:
+                # missing-fill sentinels surface as +/-inf, which
+                # fetch_hits renders as null (same as the host path)
+                raw = float(raws[i])
+                if abs(raw) >= 3.0e38:
+                    raw = np.inf if raw > 0 else -np.inf
+                sv = (raw,)
+            refs.append(DocRef(sid, seg.name, int(d), score, sv))
+            if max_score is None and sort_spec is None:
+                max_score = score
+        aggregations = None
+        if agg_specs:
+            matched_np = np.asarray(outs[6])
+            scores_np = np.asarray(outs[7])
+            views = []
+            for i, (sid, seg) in enumerate(self._pairs):
+                nd1 = seg.nd_pad + 1
+                views.append(SegmentView(
+                    seg, matched_np[i, :nd1], ctxs[sid],
+                    scores_np[i, :nd1]))
+            aggregations = run_aggregations(agg_specs, views)
+        return {"total": int(total), "refs": refs, "max_score": max_score,
+                "aggregations": aggregations}
 
 
 class MeshPlanExecutor:
@@ -340,21 +440,76 @@ class MeshPlanExecutor:
         }
         self._sharding = sharding
 
-    def execute(self, plans: List[PlanNode], k: int):
-        """plans: one per shard, same query. Returns
-        (top_scores [k], top_shard [k], top_doc [k], total) as numpy/int —
-        doc ids are in the STACKED doc space (valid per-shard ids since
-        every shard zero-bases)."""
+    def ensure_sort_column(self, field: str, order: str, missing) -> Optional[
+            Tuple[str, str]]:
+        """Stage (oriented key, raw values) columns for a single-field sort
+        and return their seg-dict names, or None if the field can't sort
+        exactly on the mesh.
+
+        The in-program rank key is f32; a float64 column only qualifies if
+        every value is exactly f32-representable (timestamps usually are
+        not — resolution 2^-24 relative — and silently reordering near-tied
+        dates would be wrong, so those fall back to the host path). The
+        oriented key follows _sort_keys: negate for asc, missing-fill with
+        finite sentinels so -inf stays reserved for "not matched"."""
+        token = (repr(missing) if isinstance(missing, (int, float))
+                 else str(missing or "_last"))
+        name = f"msort.{field}.{order}.{token}"
+        if name in self._seg_staged:
+            return name, name + ".raw"
+        big = np.float32(3.0e38)
+        keys = np.zeros((self.n_dev, self.nd1), np.float32)
+        raws = np.zeros((self.n_dev, self.nd1), np.float32)
+        for i, seg in enumerate(self.segments):
+            if field == "_doc":
+                if seg.nd_pad > (1 << 24):
+                    return None  # doc id not f32-exact
+                raw = np.arange(seg.nd_pad, dtype=np.float64)
+                exists = np.ones(seg.nd_pad, bool)
+            else:
+                col = seg.numeric_columns.get(field)
+                if col is None:
+                    return None
+                raw = (col.min_value if order == "asc"
+                       else col.max_value).astype(np.float64)
+                exists = col.exists
+                vals = raw[exists]
+                if not np.array_equal(
+                        vals, vals.astype(np.float32).astype(np.float64)):
+                    return None  # not exactly f32-representable
+            if missing is None or missing == "_last":
+                fill = np.float64(-big if order == "desc" else big)
+            elif missing == "_first":
+                fill = np.float64(big if order == "desc" else -big)
+            else:
+                fill = np.float64(missing)
+            raw = np.where(exists, raw, fill)
+            key = np.clip(raw if order == "desc" else -raw, -big, big)
+            keys[i, : seg.nd_pad] = key.astype(np.float32)
+            keys[i, seg.nd_pad:] = -big  # padding never outranks real docs
+            raws[i, : seg.nd_pad] = raw.astype(np.float32)
+        self._seg_staged[name] = jax.device_put(keys, self._sharding)
+        self._seg_staged[name + ".raw"] = jax.device_put(
+            raws, self._sharding)
+        return name, name + ".raw"
+
+    def execute(self, plans: List[PlanNode], k: int,
+                sort_keys: Optional[Tuple[str, str]] = None,
+                with_views: bool = False):
+        """plans: one per shard, same query. Returns (top_keys [k],
+        top_shard [k], top_doc [k], total, top_score [k], top_raw [k]
+        [, matched [n_dev, nd1], scores [n_dev, nd1]]) — doc ids are in
+        the STACKED doc space (valid per-shard ids since every shard
+        zero-bases)."""
         if len(plans) != len(self.segments):
             raise ValueError("one plan per staged shard required")
         local_pads = [s.nd_pad for s in self.segments]
         stacked = stack_plans(plans, local_pads, self.nd1, self.n_dev)
         key = (plans[0].key() + "|" + _shapes_sig(stacked)
-               + f"|k{k}|n{self.n_dev}")
+               + f"|k{k}|n{self.n_dev}|s{sort_keys}|v{with_views}")
         run = _mesh_query_program(
-            self.mesh, _TemplateHolder(_strip_plan(plans[0]), key), k)
+            self.mesh, _TemplateHolder(_strip_plan(plans[0]), key), k,
+            sort_keys=sort_keys, with_views=with_views)
         staged_plan = [jax.device_put(a, self._sharding) for a in stacked]
-        top_scores, top_shard, top_doc, total = run(self._seg_staged,
-                                                    staged_plan)
-        return (np.asarray(top_scores), np.asarray(top_shard),
-                np.asarray(top_doc), int(total))
+        outs = run(self._seg_staged, staged_plan)
+        return outs

