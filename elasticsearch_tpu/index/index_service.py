@@ -183,8 +183,8 @@ class IndexService:
 
     def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None) -> dict:
         """Update API (action/update/TransportUpdateAction): partial doc
-        merge, upsert, doc_as_upsert; scripted updates support the
-        bucket-script expression subset."""
+        merge, upsert, doc_as_upsert; scripted updates run painless over
+        ctx._source with ctx.op semantics (UpdateHelper.executeScripts)."""
         shard = self.shards[self._route(doc_id, routing)]
         existing = shard.get_doc(doc_id)
         if not existing.found:
@@ -192,8 +192,16 @@ class IndexService:
             if body.get("doc_as_upsert") and "doc" in body:
                 return self.index_doc(doc_id, body["doc"], routing)
             if "upsert" in body:
+                if "script" in body and body.get("scripted_upsert"):
+                    return self._scripted_update(
+                        doc_id, body, dict(body["upsert"]), routing,
+                        version=0)
                 return self.index_doc(doc_id, body["upsert"], routing)
             raise DocumentMissingException(self.name, doc_id)
+        if "script" in body:
+            return self._scripted_update(
+                doc_id, body, dict(existing.source), routing,
+                version=existing.version)
         if "doc" in body:
             merged = _deep_merge(dict(existing.source), body["doc"])
             if merged == existing.source and body.get("detect_noop", True):
@@ -203,6 +211,30 @@ class IndexService:
                 }
             return self.index_doc(doc_id, merged, routing)
         raise DocumentMissingException(self.name, doc_id)
+
+    def _scripted_update(self, doc_id: str, body: dict, source: dict,
+                         routing: Optional[str], version: int) -> dict:
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+        from elasticsearch_tpu.script.expression import compile_script
+        from elasticsearch_tpu.script.painless import execute_update_script
+
+        spec = body["script"]
+        script = compile_script(spec)
+        if not hasattr(script, "run"):
+            raise IllegalArgumentException(
+                "update scripts must be painless (the numeric expression "
+                "engine has no ctx mutation surface)")
+        params = (spec.get("params") if isinstance(spec, dict) else None) or {}
+        new_source, op = execute_update_script(
+            script, source, params,
+            doc_meta={"_index": self.name, "_id": doc_id,
+                      "_version": version})
+        if op == "none":
+            return {"_index": self.name, "_id": doc_id,
+                    "_version": version, "result": "noop"}
+        if op == "delete":
+            return self.delete_doc(doc_id, routing=routing)
+        return self.index_doc(doc_id, new_source, routing)
 
     def refresh(self) -> None:
         for shard in self.shards.values():

@@ -338,7 +338,40 @@ def _p_uppercase(cfg, doc):
     _p_case(True)(cfg, doc)
 
 
+def _p_script(cfg, doc):
+    """Script processor (ingest/common/ScriptProcessor.java): the painless
+    script mutates ``ctx`` in place. Like the reference's
+    getSourceAndMetadata, ctx exposes the source AND the _index/_id
+    metadata keys; metadata writes flow back to the document metadata,
+    not into the stored source."""
+    from elasticsearch_tpu.script.expression import compile_script
+
+    # accept both config shapes: {source, lang, params} inline, or the
+    # nested {"script": {source, lang, params}} form
+    nested = cfg.get("script") if isinstance(cfg.get("script"), dict) else {}
+    spec = {k: v for k, v in {**nested, **cfg}.items()
+            if k in ("source", "inline", "lang", "id")}
+    params = cfg.get("params") or nested.get("params") or {}
+    script = compile_script(spec)
+    run = getattr(script, "run", None)
+    if run is None:  # numeric expression engine: no ctx mutation surface
+        raise IngestProcessorException(
+            "script processor requires a painless script")
+    ctx = doc.source
+    saved = {k: ctx.get(k) for k in ("_index", "_id") if k in ctx}
+    ctx.update(doc.meta)
+    try:
+        run({"ctx": ctx, "params": dict(params)})
+    finally:
+        for k in ("_index", "_id"):
+            value = ctx.pop(k, None)
+            if value != doc.meta.get(k):
+                doc.meta[k] = value
+        ctx.update(saved)  # a source field literally named _index/_id
+
+
 PROCESSORS = {
+    "script": _p_script,
     "set": _p_set,
     "remove": _p_remove,
     "rename": _p_rename,

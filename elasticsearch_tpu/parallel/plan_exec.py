@@ -316,13 +316,17 @@ class IndexMeshSearch:
         field, order, missing = sort_spec[0]
         if not isinstance(field, str) or field == "_geo_distance":
             return "fallback", None
-        if field == "_score":
-            if order != "desc":
-                return "fallback", None  # ascending-score sort is exotic
-            # relevance ranking, but the response carries sort values
-            return None, sort_spec
+        # (a single _score sort never reaches here: normalize_sort
+        # collapses it to relevance ranking already)
         if isinstance(missing, dict):
             return "fallback", None
+        if isinstance(missing, str) and missing not in ("_last", "_first"):
+            return "fallback", None  # host path owns the error shape
+        if isinstance(missing, (int, float)) and not isinstance(
+                missing, bool):
+            # the fill participates in the f32 rank key like any value
+            if float(np.float32(missing)) != float(missing):
+                return "fallback", None
         keys = self._executor.ensure_sort_column(field, order, missing)
         if keys is None:
             return "fallback", None
@@ -389,7 +393,7 @@ class IndexMeshSearch:
             sid, seg = self._pairs[int(slot)]
             score = float(scores[i])
             if sort_keys is None:
-                sv = (score,) if sort_spec else ()
+                sv = ()
             else:
                 # missing-fill sentinels surface as +/-inf, which
                 # fetch_hits renders as null (same as the host path)

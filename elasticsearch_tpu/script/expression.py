@@ -15,6 +15,7 @@ Painless's Definition for the same reason).
 
 from __future__ import annotations
 
+import functools
 import math
 import re
 from typing import Dict, Optional
@@ -128,23 +129,60 @@ class CompiledScript:
 CUSTOM_SCRIPT_ENGINES: dict = {}
 
 
-def compile_script(script_spec) -> CompiledScript:
+def expression_eligible(src: str) -> bool:
+    """True when the source fits the numeric-expression grammar (the
+    whole-segment array fast path). The full painless engine serves
+    everything else."""
+    stripped = _DOC_VALUE_RE.sub("0", src)
+    stripped = _DOC_LEN_RE.sub("0", stripped)
+    stripped = _SCORE_RE.sub("0", stripped)
+    stripped = _PARAM_RE.sub("0", stripped)
+    for fn in _FUNCTIONS:
+        stripped = stripped.replace(fn, "")
+    return all(c in _ALLOWED for c in stripped)
+
+
+def compile_script(script_spec):
     """Accepts the reference's script spec shapes: a string, or
     {"source"|"inline": ..., "lang": ..., "params": {...}} (params bound
     at execute). Non-default langs dispatch to plugin script engines
-    (ScriptService.compile — script/ScriptService.java:223)."""
+    (ScriptService.compile — script/ScriptService.java:223).
+
+    The default lang is painless; sources that fit the numeric expression
+    grammar compile to the expression engine (vectorized whole-segment
+    array math — the XLA-friendly path), everything else to the painless
+    interpreter (script/painless.py). lang=expression forces the numeric
+    engine and rejects anything outside its grammar at compile time."""
     if isinstance(script_spec, str):
-        return CompiledScript(script_spec)
+        script_spec = {"source": script_spec}
     src = script_spec.get("source") or script_spec.get("inline")
     if src is None:
         raise ParsingException("script requires [source]")
+    if not isinstance(src, str):
+        raise ParsingException("script [source] must be a string")
     lang = script_spec.get("lang")
     if lang is not None and lang not in ("painless", "expression"):
         engine = CUSTOM_SCRIPT_ENGINES.get(lang)
         if engine is None:
             raise ParsingException(f"script_lang not supported [{lang}]")
         return engine(src)
-    return CompiledScript(src)
+    return _compile_default_lang(src, lang)
+
+
+@functools.lru_cache(maxsize=512)
+def _compile_default_lang(src: str, lang):
+    """Compiled scripts are stateless (fresh interpreter per execution),
+    so identical sources share one parse — bulk pipelines and scripted
+    updates would otherwise re-lex/re-parse per document."""
+    if expression_eligible(src):
+        return CompiledScript(src)
+    if lang == "expression":
+        raise ParsingException(
+            f"unsupported script [{src}]: lang=expression allows only "
+            f"numeric expressions over doc values/params")
+    from elasticsearch_tpu.script.painless import PainlessScript
+
+    return PainlessScript(src)
 
 
 def segment_columns(segment, doc_fields) -> Dict[str, "object"]:

@@ -1028,8 +1028,22 @@ def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
 
             fields_out = hit.setdefault("fields", {})
             for fname, (script, sparams) in compiled_scripts.items():
-                dv = doc_values_for(seg, d, script.doc_fields)
-                fields_out[fname] = [script.execute(dv, sparams, ref.score or 0.0)]
+                if hasattr(script, "run"):
+                    # painless: typed doc values (strings stay strings)
+                    from elasticsearch_tpu.script.painless import (
+                        DocMap,
+                        segment_doc_resolver,
+                    )
+
+                    val = script.run({
+                        "doc": DocMap(segment_doc_resolver(seg, d)),
+                        "params": dict(sparams),
+                        "_score": ref.score or 0.0,
+                    })
+                else:
+                    dv = doc_values_for(seg, d, script.doc_fields)
+                    val = script.execute(dv, sparams, ref.score or 0.0)
+                fields_out[fname] = [val]
         if sort_spec is not None:
             hit["sort"] = [
                 v if not np.isinf(v) else None for v in ref.sort_values

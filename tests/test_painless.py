@@ -1,0 +1,231 @@
+"""Painless-class scripting engine (script/painless.py).
+
+Role model: modules/lang-painless (Compiler.java) — same surface
+(statements, Java-ish method whitelists, doc values, ctx mutation, loop
+budget), interpreted host-side; the numeric subset keeps routing to the
+expression engine's vectorized path (script/expression.py), asserted here
+too."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.script.expression import CompiledScript, compile_script
+from elasticsearch_tpu.script.painless import (
+    PainlessScript,
+    ScriptException,
+    execute_update_script,
+)
+
+
+class TestLanguage:
+    def run(self, src, **bindings):
+        return PainlessScript(src).run(bindings)
+
+    def test_arithmetic_and_types(self):
+        assert self.run("return 7 / 2") == 3          # java int division
+        assert self.run("return 7.0 / 2") == 3.5
+        assert self.run("return -7 / 2") == -3        # truncate toward zero
+        assert self.run("return -7 % 3") == -1        # sign of dividend
+        assert self.run("return 2 + 3 * 4") == 14
+        assert self.run("return (int) 3.9") == 3
+        assert self.run("return 'a' + 1 + 2") == "a12"
+
+    def test_control_flow(self):
+        src = """
+        int total = 0;
+        for (int i = 0; i < 10; i++) {
+          if (i % 2 == 0) { continue }
+          if (i > 7) { break }
+          total += i;
+        }
+        return total;
+        """
+        assert self.run(src) == 1 + 3 + 5 + 7
+
+    def test_while_and_ternary(self):
+        src = "int n = 0; while (n < 5) { n++ } return n > 4 ? 'big' : 'small'"
+        assert self.run(src) == "big"
+
+    def test_foreach_list_and_map(self):
+        src = """
+        def m = ['a': 1, 'b': 2];
+        def keys = '';
+        for (def k : m) { keys += k }
+        def total = 0;
+        for (def v : m.values()) { total += v }
+        return keys + total;
+        """
+        assert self.run(src) == "ab3"
+
+    def test_collections_methods(self):
+        src = """
+        List l = new ArrayList();
+        l.add(3); l.add(1); l.add(2);
+        l.sort();
+        Map m = new HashMap();
+        m.put('first', l.get(0));
+        m.put('n', l.size());
+        return m['first'] + m.getOrDefault('n', 0) + l.indexOf(2);
+        """
+        assert self.run(src) == 1 + 3 + 1
+
+    def test_string_methods(self):
+        src = """
+        String s = ' Hello,World ';
+        def t = s.trim();
+        def parts = t.split(',');
+        return parts[0].toLowerCase() + '|' + parts[1].substring(0, 3)
+               + '|' + t.length();
+        """
+        assert self.run(src) == "hello|Wor|11"
+
+    def test_math_and_statics(self):
+        assert self.run("return Math.max(2, Math.abs(-5))") == 5
+        assert self.run("return Math.floor(Math.PI)") == 3
+        assert self.run("return Integer.parseInt('42') + 1") == 43
+        assert self.run("return String.valueOf(1.5)") == "1.5"
+
+    def test_null_and_safe_navigation(self):
+        assert self.run("def x = null; return x ?: 'd'") == "d"
+        assert self.run("def x = null; return x?.length()") is None
+        with pytest.raises(ScriptException):
+            self.run("def x = null; return x.length()")
+
+    def test_elvis_chains_and_instanceof(self):
+        assert self.run("def x = 'a'; return x instanceof String") is True
+        assert self.run("def x = [1]; return x instanceof Map") is False
+
+    def test_loop_budget_guard(self):
+        with pytest.raises(ScriptException, match="budget"):
+            self.run("while (true) { }")
+        with pytest.raises(ScriptException, match="budget"):
+            self.run("for (int i = 0; i >= 0; i) { def x = 1 }")
+
+    def test_compile_errors(self):
+        with pytest.raises(ScriptException):
+            PainlessScript("def x = ")
+        with pytest.raises(ScriptException):
+            PainlessScript("return 'unterminated")
+        with pytest.raises(ScriptException):
+            PainlessScript("x +++")
+
+    def test_no_python_internals_reachable(self):
+        for src in (
+            "return ''.__class__",
+            "def x = [1]; return x.__len__()",
+            "return params.size.__globals__",
+        ):
+            with pytest.raises(ScriptException):
+                self.run(src, params={})
+        # map field access is painless get() shorthand: missing -> null,
+        # never a python attribute
+        assert self.run("return params.__globals__", params={}) is None
+
+    def test_doc_values_semantics(self):
+        s = PainlessScript(
+            "if (doc['p'].size() == 0) { return -1 } return doc['p'].value")
+        assert s.execute({"p": 4.0}) == 4.0
+        assert s.execute({}) == -1
+        # .value on a missing field raises, like the reference
+        with pytest.raises(ScriptException, match="doesn't have a value"):
+            PainlessScript("return doc['p'].value").execute({})
+
+
+class TestDispatch:
+    def test_numeric_source_uses_expression_engine(self):
+        s = compile_script("doc['a'].value * 2")
+        assert isinstance(s, CompiledScript)
+
+    def test_painless_source_uses_interpreter(self):
+        s = compile_script({"source": "def x = 1; return x"})
+        assert isinstance(s, PainlessScript)
+
+    def test_lang_expression_rejects_statements(self):
+        with pytest.raises(ParsingException):
+            compile_script({"lang": "expression",
+                            "source": "def x = 1; return x"})
+
+
+class TestContexts:
+    @pytest.fixture()
+    def idx(self):
+        idx = IndexService("scripted", Settings.EMPTY, {
+            "properties": {
+                "title": {"type": "text"},
+                "tag": {"type": "keyword"},
+                "n": {"type": "integer"},
+                "price": {"type": "float"},
+            }})
+        for i in range(8):
+            idx.index_doc(str(i), {
+                "title": f"doc {i}", "tag": "even" if i % 2 == 0 else "odd",
+                "n": i, "price": i * 2.0})
+        idx.refresh()
+        yield idx
+        idx.close()
+
+    def test_scripted_update(self, idx):
+        r = idx.update_doc("3", {"script": {
+            "source": "ctx._source.n += params.by; "
+                      "ctx._source.tags = ['updated']",
+            "params": {"by": 10}}})
+        assert r["result"] == "updated"
+        g = idx.get_doc("3")
+        assert g.source["n"] == 13
+        assert g.source["tags"] == ["updated"]
+
+    def test_scripted_update_noop_and_delete(self, idx):
+        r = idx.update_doc("2", {"script": {"source": "ctx.op = 'none'"}})
+        assert r["result"] == "noop"
+        r = idx.update_doc("2", {"script": {
+            "source": "if (ctx._source.n == 2) { ctx.op = 'delete' }"}})
+        assert r["result"] == "deleted"
+        assert not idx.get_doc("2").found
+
+    def test_scripted_upsert(self, idx):
+        r = idx.update_doc("99", {
+            "scripted_upsert": True,
+            "upsert": {"n": 0},
+            "script": {"source": "ctx._source.n += 5"}})
+        assert r["result"] == "created"
+        assert idx.get_doc("99").source["n"] == 5
+
+    def test_script_fields_painless_strings(self, idx):
+        r = idx.search({
+            "query": {"term": {"tag": "even"}},
+            "script_fields": {
+                "label": {"script": {
+                    "source": "return doc['tag'].value.toUpperCase() + '-' "
+                              "+ (int) doc['n'].value",
+                }},
+            }, "size": 1, "sort": [{"n": "asc"}]})
+        hit = r["hits"]["hits"][0]
+        assert hit["fields"]["label"] == ["EVEN-0"]
+
+    def test_script_query_painless(self, idx):
+        r = idx.search({"query": {"bool": {"filter": [{"script": {"script": {
+            "source": "if (doc['n'].size() == 0) { return false } "
+                      "def v = doc['n'].value; return v % 3 == 0"
+        }}}]}}, "size": 10})
+        ids = sorted(h["_id"] for h in r["hits"]["hits"])
+        assert ids == ["0", "3", "6"]
+
+    def test_ingest_script_processor(self):
+        from elasticsearch_tpu.ingest.pipeline import IngestDocument, PROCESSORS
+
+        doc = IngestDocument({"a": 2, "tags": ["x"]}, "1", "i")
+        PROCESSORS["script"](
+            {"source": "ctx.b = ctx.a * 3; ctx.tags.add('scripted')",
+             "params": {}}, doc)
+        assert doc.source["b"] == 6
+        assert doc.source["tags"] == ["x", "scripted"]
+
+
+class TestUpdateScriptHelper:
+    def test_invalid_op_rejected(self):
+        with pytest.raises(ScriptException, match="not allowed"):
+            execute_update_script(
+                PainlessScript("ctx.op = 'explode'"), {"a": 1})
