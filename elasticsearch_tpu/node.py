@@ -873,7 +873,10 @@ class Node:
                     for idx in new.resolve_index_names(idx_expr):
                         for alias in aliases:
                             if verb == "add":
-                                meta = {k: spec[k] for k in ("filter", "routing")
+                                meta = {k: spec[k]
+                                        for k in ("filter", "routing",
+                                                  "index_routing",
+                                                  "search_routing")
                                         if k in spec}
                                 new.indices[idx].aliases[alias] = meta
                             elif verb == "remove":

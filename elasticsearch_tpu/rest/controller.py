@@ -83,8 +83,10 @@ class Route:
             if m:
                 if m.group(1) == "index":
                     # index names/aliases cannot start with '_' — keeps API
-                    # endpoints from being swallowed by /{index} routes
-                    regex += f"/(?P<{m.group(1)}>[^_/][^/]*)"
+                    # endpoints from being swallowed by /{index} routes.
+                    # `_all` is the one legal underscore expression in
+                    # index position (/_all/_refresh etc.)
+                    regex += f"/(?P<{m.group(1)}>_all|[^_/][^/]*)"
                 else:
                     regex += f"/(?P<{m.group(1)}>[^/]+)"
             else:

@@ -14,6 +14,7 @@ from typing import List, Tuple
 from elasticsearch_tpu.common.errors import (
     ActionRequestValidationException,
     IllegalArgumentException,
+    VersionConflictEngineException,
 )
 from elasticsearch_tpu.version import __version__
 
@@ -28,6 +29,7 @@ def register_all(c) -> None:
     r("PUT", "/{index}/_doc/{id}", _index_doc)
     r("POST", "/{index}/_doc/{id}", _index_doc)
     r("POST", "/{index}/_doc", _index_doc_auto_id)
+    r("POST", "/{index}/{type}", _index_doc_auto_id)
     r("GET", "/{index}/_doc/{id}", _get_doc)
     r("HEAD", "/{index}/_doc/{id}", _head_doc)
     r("DELETE", "/{index}/_doc/{id}", _delete_doc)
@@ -119,11 +121,17 @@ def register_all(c) -> None:
     r("POST", "/{index}/_flush", _flush)
     r("GET", "/{index}/_flush", _flush)
     r("POST", "/_flush", _flush)
+    # synced flush: durability already implies a sync point here, so it
+    # degrades to a flush with the sync-shaped response
+    r("POST", "/{index}/_flush/synced", _flush_synced)
+    r("POST", "/_flush/synced", _flush_synced)
+    r("GET", "/{index}/_flush/synced", _flush_synced)
     r("POST", "/{index}/_forcemerge", _forcemerge)
     r("POST", "/_forcemerge", _forcemerge)
     r("GET", "/{index}/_stats", _index_stats)
     r("GET", "/_stats", _index_stats)
     r("GET", "/{index}/_segments", _segments)
+    r("GET", "/_segments", _segments)
     r("PUT", "/{index}/_mapping", _put_mapping)
     r("PUT", "/{index}/_mapping/{type}", _put_mapping)
     r("POST", "/{index}/_mapping", _put_mapping)
@@ -134,6 +142,8 @@ def register_all(c) -> None:
     r("PUT", "/_settings", _put_index_settings)
     r("GET", "/{index}/_settings", _get_index_settings)
     r("GET", "/_settings", _get_index_settings)
+    r("GET", "/{index}/_settings/{setting}", _get_index_settings)
+    r("GET", "/_settings/{setting}", _get_index_settings)
     r("GET", "/_analyze", _analyze)
     r("POST", "/_analyze", _analyze)
     r("GET", "/{index}/_analyze", _analyze)
@@ -220,22 +230,28 @@ def register_all(c) -> None:
     r("GET", "/_cat/count", _cat_count)
     r("GET", "/_cat/count/{index}", _cat_count)
     r("GET", "/_cat/aliases", _cat_aliases)
+    r("GET", "/_cat/aliases/{name}", _cat_aliases)
     r("GET", "/_cat/templates", _cat_templates)
+    r("GET", "/_cat/templates/{name}", _cat_templates)
     r("GET", "/_cat/master", _cat_master)
     r("GET", "/_cat/segments", _cat_segments)
     r("GET", "/_cat/plugins", lambda n, q: _cat_table(
         q,
-        [[n.node_name, p["name"], p["version"]]
+        [[n.node_id, n.node_name, p["name"], p["version"], "-"]
          for p in n.plugins_service.info()],
-        ["name", "component", "version"]))
+        ["id", "name", "component", "version", "description"]))
     r("GET", "/_cat/tasks", _cat_tasks)
     r("GET", "/_cat/pending_tasks", lambda n, q: _cat_table(
         q, [], ["insertOrder", "timeInQueue", "priority", "source"]))
     r("GET", "/_cat/allocation", _cat_allocation)
     r("GET", "/_cat/recovery", _cat_recovery)
     r("GET", "/_cat/thread_pool", _cat_thread_pool)
-    r("GET", "/_cat/fielddata", lambda n, q: _cat_table(q, [], ["node", "field", "size"]))
-    r("GET", "/_cat/nodeattrs", lambda n, q: _cat_table(q, [], ["node", "attr", "value"]))
+    r("GET", "/_cat/fielddata", lambda n, q: _cat_table(
+        q, [], ["id", "host", "ip", "node", "field", "size"]))
+    r("GET", "/_cat/fielddata/{fields}", lambda n, q: _cat_table(
+        q, [], ["id", "host", "ip", "node", "field", "size"]))
+    r("GET", "/_cat/nodeattrs", lambda n, q: _cat_table(
+        q, [], ["node", "id", "pid", "host", "ip", "port", "attr", "value"]))
     r("GET", "/_cat/repositories", _cat_repositories)
     r("GET", "/_cat/snapshots/{repo}", _cat_snapshots)
 
@@ -321,6 +337,21 @@ def _forced_refresh(req, r):
     return r
 
 
+def _record_doc_type(node, req):
+    """6.x first-write-wins type naming: indexing through a typed path
+    onto an index whose type is still the default records the custom
+    name, so later responses echo it (even via untyped/_all paths)."""
+    t = req.param("type")
+    if t in (None, "_doc", "_all"):
+        return
+    try:
+        svc = node.index_service(req.param("index"))
+    except Exception:
+        return
+    if svc.doc_type == "_doc":
+        svc.doc_type = t
+
+
 def _index_doc(node, req, force_create: bool = False):
     _typed_api_warning(req)
     body = req.json_body()
@@ -337,6 +368,7 @@ def _index_doc(node, req, force_create: bool = False):
                        pipeline=req.param("pipeline"),
                        wait_for_active_shards=req.param("wait_for_active_shards"),
                        **kw)
+    _record_doc_type(node, req)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return (201 if r.get("result") == "created" else 200), r
 
@@ -346,6 +378,16 @@ def _create_doc(node, req):
 
 
 def _index_doc_auto_id(node, req):
+    t = req.param("type")
+    if t is not None:
+        # the POST /{index}/{type} route would otherwise swallow typoed
+        # or unregistered /{index}/_endpoint POSTs as documents: type
+        # names may not start with '_' (MapperService.validateTypeName)
+        if t.startswith("_") and t != "_doc":
+            raise IllegalArgumentException(
+                f"Document mapping type name can't start with '_', "
+                f"found: [{t}]")
+        _typed_api_warning(req)
     body = req.json_body()
     if body is None:
         raise ActionRequestValidationException("Validation Failed: 1: source is missing;")
@@ -353,6 +395,7 @@ def _index_doc_auto_id(node, req):
                        routing=req.param("routing"), refresh=req.param("refresh"),
                        pipeline=req.param("pipeline"),
                        wait_for_active_shards=req.param("wait_for_active_shards"))
+    _record_doc_type(node, req)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return 201, r
 
@@ -394,6 +437,39 @@ def _get_doc(node, req):
     _typed_api_warning(req)
     r = node.get_doc(req.param("index"), req.param("id"),
                      req.param("routing"), **_realtime_params(req))
+    if r["found"] and req.param("version") is not None:
+        # GetRequest version check: reading a stale version conflicts
+        try:
+            want = int(req.param("version"))
+        except ValueError:
+            raise IllegalArgumentException(
+                f"failed to parse version [{req.param('version')}]") from None
+        have = r.get("_version")
+        # reads conflict on ANY mismatch for every version_type
+        # (VersionType.isVersionConflictForReads: only equality passes)
+        ok = (want == have)
+        if not ok:
+            raise VersionConflictEngineException(
+                req.param("id"), have, want)
+    stored = req.param("stored_fields")
+    if r["found"] and stored is not None:
+        wanted = [f for f in str(stored).split(",") if f]
+        src = r.get("_source") or {}
+        svc = node.index_service(req.param("index"))
+        fields = {}
+        for f in wanted:
+            if f == "_source":
+                continue
+            ft = svc.mapper_service.field_type(f)
+            if (ft is None or not ft.params.get("store", False)
+                    or f not in src):
+                continue
+            v = src[f]
+            fields[f] = v if isinstance(v, list) else [v]
+        if fields:
+            r["fields"] = fields
+        if "_source" not in wanted:
+            r.pop("_source", None)
     _echo_type(req, _apply_source_filtering(req, r), node)
     return (200 if r["found"] else 404), r
 
@@ -818,6 +894,22 @@ def _flush(node, req):
     return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
 
+def _flush_synced(node, req):
+    """Synced flush (SyncedFlushService): every flush here commits a
+    durable sync point, so the response reports all shards successful in
+    the reference's per-index shape."""
+    names = node.cluster_service.state.resolve_index_names(
+        req.param("index", "_all"))
+    out = {"_shards": {"total": 0, "successful": 0, "failed": 0}}
+    for name in names:
+        node.indices[name].flush()
+        n = node.indices[name].num_shards
+        out["_shards"]["total"] += n
+        out["_shards"]["successful"] += n
+        out[name] = {"total": n, "successful": n, "failed": 0}
+    return 200, out
+
+
 def _forcemerge(node, req):
     names = node.cluster_service.state.resolve_index_names(req.param("index", "_all"))
     for name in names:
@@ -837,13 +929,23 @@ def _index_stats(node, req):
 
 
 def _segments(node, req):
-    svc = node.index_service(req.param("index"))
-    shards = {}
-    for sid, shard in svc.shards.items():
-        shards[str(sid)] = [{
-            "segments": {s.name: s.stats() for s in shard.engine.segments},
-        }]
-    return 200, {"indices": {svc.name: {"shards": shards}}}
+    names = node.cluster_service.state.resolve_index_names(
+        req.param("index", "_all"))
+    indices = {}
+    total = 0
+    for name in names:
+        svc = node.indices[name]
+        shards = {}
+        for sid, shard in svc.shards.items():
+            shards[str(sid)] = [{
+                "segments": {s.name: s.stats()
+                             for s in shard.engine.segments},
+            }]
+            total += 1
+        indices[name] = {"shards": shards}
+    return 200, {"indices": indices,
+                 "_shards": {"total": total, "successful": total,
+                             "failed": 0}}
 
 
 def _put_mapping(node, req):
@@ -879,17 +981,51 @@ def _put_index_settings(node, req):
                                            req.json_body({}) or {})
 
 
+def _settings_values_as_strings(obj):
+    """The reference renders every setting value as a string
+    (Settings#toXContent); booleans lowercase."""
+    if isinstance(obj, dict):
+        return {k: _settings_values_as_strings(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_settings_values_as_strings(v) for v in obj]
+    if isinstance(obj, bool):
+        return "true" if obj else "false"
+    return str(obj)
+
+
+def _render_settings(settings, flat: bool):
+    """Settings -> response dict: index.-prefixed, flat or nested,
+    string-valued."""
+    from elasticsearch_tpu.common.settings import Settings
+
+    if isinstance(settings, dict):
+        settings = Settings.from_dict(settings)
+    settings = settings.with_index_prefix()
+    if flat:
+        return _settings_values_as_strings(settings.as_dict())
+    return _settings_values_as_strings(settings.as_nested_dict())
+
+
 def _get_index_settings(node, req):
+    import fnmatch
+
     state = node.cluster_service.state
+    flat = req.param("flat_settings") in ("true", True)
+    name_filter = req.param("setting")
     out = {}
     for name in state.resolve_index_names(req.param("index", "_all")):
         md = state.indices[name]
-        settings = md.settings.as_nested_dict()
-        idx_settings = settings.setdefault("index", {})
-        idx_settings.setdefault("number_of_shards", str(md.num_shards))
-        idx_settings.setdefault("number_of_replicas", str(md.num_replicas))
-        idx_settings.setdefault("uuid", node.indices[name].uuid if name in node.indices else name)
-        out[name] = {"settings": settings}
+        settings = md.settings.as_dict()
+        settings.setdefault("index.number_of_shards", md.num_shards)
+        settings.setdefault("index.number_of_replicas", md.num_replicas)
+        settings.setdefault(
+            "index.uuid",
+            node.indices[name].uuid if name in node.indices else name)
+        if name_filter and name_filter != "_all":
+            pats = [p for p in str(name_filter).split(",") if p]
+            settings = {k: v for k, v in settings.items()
+                        if any(fnmatch.fnmatchcase(k, p) for p in pats)}
+        out[name] = {"settings": _render_settings(settings, flat)}
     return 200, out
 
 
@@ -936,16 +1072,25 @@ def _get_alias(node, req):
     out = {}
     for idx in state.resolve_index_names(req.param("index", "_all")):
         aliases = state.indices[idx].aliases
-        if name_filter:
+        if name_filter and name_filter != "_all":
             import fnmatch
 
+            patterns = [p for p in str(name_filter).split(",") if p]
             aliases = {a: v for a, v in aliases.items()
-                       if fnmatch.fnmatchcase(a, name_filter)}
+                       if any(fnmatch.fnmatchcase(a, p) for p in patterns)}
             if not aliases:
                 continue
         out[idx] = {"aliases": aliases}
-    if name_filter and not out:
-        return 404, {"error": f"alias [{name_filter}] missing", "status": 404}
+    if name_filter and name_filter != "_all":
+        # a NAMED (non-wildcard) pattern matching nothing -> 404, but the
+        # body still carries whatever did match (GetAliasesResponse)
+        found = {a for v in out.values() for a in v["aliases"]}
+        import fnmatch as _fn
+        missing = [p for p in str(name_filter).split(",")
+                   if p and "*" not in p and p not in found]
+        if missing:
+            return 404, {**out, "error": f"aliases {missing} missing",
+                         "status": 404}
     return 200, out
 
 
@@ -969,7 +1114,12 @@ def _head_alias(node, req):
 
 
 def _put_template(node, req):
-    return 200, node.put_template(req.param("name"), req.json_body({}) or {})
+    name = req.param("name")
+    if req.param("create") in ("true", True) and \
+            name in node.cluster_service.state.templates:
+        raise IllegalArgumentException(
+            f"index_template [{name}] already exists")
+    return 200, node.put_template(name, req.json_body({}) or {})
 
 
 def _get_template(node, req):
@@ -977,12 +1127,33 @@ def _get_template(node, req):
 
     templates = node.cluster_service.state.templates
     name = req.param("name")
+    flat = req.param("flat_settings") in ("true", True)
+
+    def render(t):
+        t = dict(t)
+        if "settings" in t:
+            t["settings"] = _render_settings(t["settings"] or {}, flat)
+        if t.get("aliases"):
+            # AliasMetaData normalizes `routing` into index_routing +
+            # search_routing on output
+            out = {}
+            for a, spec in t["aliases"].items():
+                spec = dict(spec or {})
+                routing = spec.pop("routing", None)
+                if routing is not None:
+                    spec.setdefault("index_routing", routing)
+                    spec.setdefault("search_routing", routing)
+                out[a] = spec
+            t["aliases"] = out
+        return t
+
     if name:
-        matched = {k: v for k, v in templates.items() if fnmatch.fnmatchcase(k, name)}
+        matched = {k: render(v) for k, v in templates.items()
+                   if fnmatch.fnmatchcase(k, name)}
         if not matched:
             return 404, {"error": f"index_template [{name}] missing", "status": 404}
         return 200, matched
-    return 200, dict(templates)
+    return 200, {k: render(v) for k, v in templates.items()}
 
 
 def _delete_template(node, req):
@@ -1059,6 +1230,42 @@ def _simulate_pipeline_by_id(node, req):
 
 
 def _cat_table(req, rows: List[List], headers: List[str]) -> Tuple[int, object]:
+    if req.param("help") in ("true", True):
+        # RestTable help: one line per column — name | alias | description
+        w = max(len(h) for h in headers)
+        return 200, "".join(f"{h.ljust(w)} | - | {h}\n" for h in headers)
+    # s: sort by column(s), `name` or `name:desc`, comma list
+    sort_spec = req.param("s")
+    if sort_spec:
+        keys = sort_spec if isinstance(sort_spec, list) \
+            else str(sort_spec).split(",")
+        for key in reversed([k for k in keys if k]):
+            name, _, direction = key.partition(":")
+            if name not in headers:
+                raise IllegalArgumentException(
+                    f"Unable to sort by unknown sort key `{name}`")
+            i = headers.index(name)
+
+            def sort_key(row, _i=i):
+                v = row[_i]
+                try:
+                    return (0, float(v), "")
+                except (TypeError, ValueError):
+                    return (1, 0.0, str(v))
+            rows = sorted(rows, key=sort_key, reverse=direction == "desc")
+    # h: select/reorder columns
+    h_spec = req.param("h")
+    if h_spec:
+        wanted = h_spec if isinstance(h_spec, list) \
+            else str(h_spec).split(",")
+        idx = []
+        for name in wanted:
+            if name not in headers:
+                raise IllegalArgumentException(
+                    f"Field [{name}] not found in the cat table")
+            idx.append(headers.index(name))
+        headers = [headers[i] for i in idx]
+        rows = [[row[i] for i in idx] for row in rows]
     if req.param("format") == "json":
         return 200, [dict(zip(headers, row)) for row in rows]
     verbose = req.bool_param("v")
@@ -1068,7 +1275,7 @@ def _cat_table(req, rows: List[List], headers: List[str]) -> Tuple[int, object]:
     if not cols:
         return 200, ""
     widths = [max(len(r[i]) for r in cols) for i in range(len(headers))]
-    lines = [" ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+    lines = [" ".join(c.ljust(w) for c, w in zip(row, widths))
              for row in cols]
     return 200, "\n".join(lines) + "\n"
 
@@ -1102,16 +1309,26 @@ def _cat_indices(node, req):
 
 def _cat_health(node, req):
     h = node.health()
+    if req.param("ts") in ("false", False, "0"):
+        rows = [[h["cluster_name"], h["status"], h["number_of_nodes"],
+                 h["number_of_data_nodes"], h["active_shards"],
+                 h["active_primary_shards"], h["relocating_shards"],
+                 h["initializing_shards"], h["unassigned_shards"], 0, "-",
+                 f"{h['active_shards_percent_as_number']:.1f}%"]]
+        return _cat_table(req, rows, [
+            "cluster", "status", "node.total", "node.data", "shards", "pri",
+            "relo", "init", "unassign", "pending_tasks",
+            "max_task_wait_time", "active_shards_percent"])
     rows = [[int(time.time()), time.strftime("%H:%M:%S"), h["cluster_name"],
              h["status"], h["number_of_nodes"], h["number_of_data_nodes"],
              h["active_shards"], h["active_primary_shards"],
              h["relocating_shards"], h["initializing_shards"],
-             h["unassigned_shards"], "-",
+             h["unassigned_shards"], 0, "-",
              f"{h['active_shards_percent_as_number']:.1f}%"]]
     return _cat_table(req, rows, [
         "epoch", "timestamp", "cluster", "status", "node.total", "node.data",
         "shards", "pri", "relo", "init", "unassign", "pending_tasks",
-        "active_shards_percent",
+        "max_task_wait_time", "active_shards_percent",
     ])
 
 
@@ -1148,16 +1365,29 @@ def _cat_count(node, req):
 def _cat_aliases(node, req):
     rows = []
     for name, md in node.cluster_service.state.indices.items():
-        for alias in md.aliases:
-            rows.append([alias, name, "-", "-", "-"])
+        for alias, spec in md.aliases.items():
+            spec = spec or {}
+            routing = spec.get("routing")
+            rows.append([
+                alias, name,
+                "*" if spec.get("filter") else "-",
+                spec.get("index_routing") or routing or "-",
+                spec.get("search_routing") or routing or "-",
+            ])
     return _cat_table(req, rows, ["alias", "index", "filter", "routing.index",
                                   "routing.search"])
 
 
 def _cat_templates(node, req):
+    import fnmatch
+
+    pat = req.param("name")
     rows = []
     for name, t in node.cluster_service.state.templates.items():
-        rows.append([name, str(t.get("index_patterns", [])), t.get("order", 0), "-"])
+        if pat and not fnmatch.fnmatchcase(name, pat):
+            continue
+        rows.append([name, "[" + ", ".join(t.get("index_patterns", [])) + "]",
+                     t.get("order", 0), t.get("version", "")])
     return _cat_table(req, rows, ["name", "index_patterns", "order", "version"])
 
 
@@ -1172,11 +1402,16 @@ def _cat_segments(node, req):
         for sid, shard in svc.shards.items():
             for seg in shard.engine.segments:
                 st = seg.stats()
-                rows.append([name, sid, "p", seg.name, st["num_docs"],
-                             st["deleted_docs"], f"{st['memory_in_bytes']}b", "true"])
-    return _cat_table(req, rows, ["index", "shard", "prirep", "segment",
-                                  "docs.count", "docs.deleted", "size",
-                                  "searchable"])
+                rows.append([name, sid, "p", "127.0.0.1", node.node_id,
+                             seg.name, 1, st["num_docs"],
+                             st["deleted_docs"], f"{st['memory_in_bytes']}b",
+                             f"{st['memory_in_bytes']}b", "true", "true",
+                             __version__, "false"])
+    return _cat_table(req, rows, ["index", "shard", "prirep", "ip", "id",
+                                  "segment", "generation", "docs.count",
+                                  "docs.deleted", "size", "size.memory",
+                                  "committed", "searchable", "version",
+                                  "compound"])
 
 
 def _cat_tasks(node, req):
@@ -1192,10 +1427,16 @@ def _cat_tasks(node, req):
 
 def _cat_allocation(node, req):
     n_shards = sum(s.num_shards for s in node.indices.values())
-    rows = [[n_shards, "0b", "0b", "-", "-", "127.0.0.1", "127.0.0.1",
+    import shutil as _sh
+
+    du = _sh.disk_usage("/")
+    rows = [[n_shards, "0b", f"{du.used // (1 << 30)}gb",
+             f"{du.free // (1 << 30)}gb", f"{du.total // (1 << 30)}gb",
+             int(du.used * 100 / du.total), "127.0.0.1", "127.0.0.1",
              node.node_name]]
     return _cat_table(req, rows, ["shards", "disk.indices", "disk.used",
-                                  "disk.avail", "disk.percent", "host", "ip", "node"])
+                                  "disk.avail", "disk.total", "disk.percent",
+                                  "host", "ip", "node"])
 
 
 def _cat_recovery(node, req):
@@ -1222,8 +1463,19 @@ def _cat_repositories(node, req):
 
 def _cat_snapshots(node, req):
     snaps = node.snapshots.get_snapshot(req.param("repo"))["snapshots"]
-    rows = [[s["snapshot"], s["state"],
-             s.get("start_time_in_millis", 0), s.get("end_time_in_millis", 0),
-             len(s["indices"])] for s in snaps]
-    return _cat_table(req, rows, ["id", "status", "start_epoch", "end_epoch",
-                                  "indices"])
+    rows = []
+    for s in snaps:
+        t0 = int(s.get("start_time_in_millis", 0) // 1000)
+        t1 = int(s.get("end_time_in_millis", 0) // 1000)
+        ns = len(s.get("shards_total", s["indices"])) \
+            if isinstance(s.get("shards_total", s["indices"]), list) \
+            else s.get("shards_total", len(s["indices"]))
+        rows.append([s["snapshot"], s["state"], t0,
+                     time.strftime("%H:%M:%S", time.gmtime(t0)), t1,
+                     time.strftime("%H:%M:%S", time.gmtime(t1)),
+                     f"{max(t1 - t0, 0)}s", len(s["indices"]),
+                     ns, 0, ns, "-"])
+    return _cat_table(req, rows, ["id", "status", "start_epoch", "start_time",
+                                  "end_epoch", "end_time", "duration",
+                                  "indices", "successful_shards",
+                                  "failed_shards", "total_shards", "reason"])

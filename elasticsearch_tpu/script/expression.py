@@ -40,6 +40,17 @@ class CompiledScript:
     def __init__(self, source: str):
         self.source = source
         self.doc_fields = _DOC_VALUE_RE.findall(source) + _DOC_LEN_RE.findall(source)
+        self._painless = None  # lazy fallback for non-numeric PARAMS
+
+    def _painless_fallback(self):
+        # a source can fit the numeric grammar while its params are
+        # strings/lists at runtime (e.g. "params.label"): re-dispatch to
+        # the full language instead of crashing on float()
+        if self._painless is None:
+            from elasticsearch_tpu.script.painless import PainlessScript
+
+            self._painless = PainlessScript(self.source)
+        return self._painless
 
     def execute(self, doc_values: Dict[str, float],
                 params: Optional[Dict] = None, score: float = 0.0):
@@ -52,7 +63,12 @@ class CompiledScript:
         )
         expr = _SCORE_RE.sub(repr(float(score)), expr)
         for name, value in sorted((params or {}).items(), key=lambda kv: -len(kv[0])):
-            expr = expr.replace(f"params.{name}", repr(float(value)))
+            try:
+                sub = repr(float(value))
+            except (TypeError, ValueError):
+                return self._painless_fallback().execute(
+                    doc_values, params, score)
+            expr = expr.replace(f"params.{name}", sub)
         stripped = expr
         for fn in _FUNCTIONS:
             stripped = stripped.replace(fn, "")
@@ -101,7 +117,12 @@ class CompiledScript:
         expr = _SCORE_RE.sub(
             lambda m: bind(scores if scores is not None else 0.0), expr)
         for name, value in sorted((params or {}).items(), key=lambda kv: -len(kv[0])):
-            expr = expr.replace(f"params.{name}", repr(float(value)))
+            try:
+                sub = repr(float(value))
+            except (TypeError, ValueError):
+                return self._painless_fallback().execute_columns(
+                    columns, params, scores)
+            expr = expr.replace(f"params.{name}", sub)
         stripped = re.sub(r"_v\d+_", "", expr)
         for fn in _FUNCTIONS:
             stripped = stripped.replace(fn, "")

@@ -187,6 +187,10 @@ class _Parser:
         stmts = []
         while self.peek().kind != "eof":
             stmts.append(self.statement())
+        # the trailing expression statement is the script's value
+        # (painless source "doc['n'].value * 2" has no explicit return)
+        if stmts and stmts[-1][0] == "expr":
+            stmts[-1] = ("return", stmts[-1][1])
         return ("block", stmts)
 
     def block_or_stmt(self):
@@ -925,14 +929,26 @@ class Interpreter:
             fn = _MATH.get(name)
             if fn is None:
                 raise ScriptException(f"unknown Math method [{name}]")
-            return fn(*[_num(a) for a in args])
+            try:
+                return fn(*[_num(a) for a in args])
+            except ScriptException:
+                raise
+            except (ValueError, TypeError, OverflowError) as e:
+                raise ScriptException(f"Math.{name}: {e}") from e
         if isinstance(obj, _StaticClass):
             return obj.call(name, args)
         table = _METHODS.get(type(obj))
         if table is not None:
             fn = table.get(name)
             if fn is not None:
-                return fn(obj, *args)
+                try:
+                    return fn(obj, *args)
+                except ScriptException:
+                    raise
+                except (IndexError, KeyError, ValueError, TypeError,
+                        AttributeError) as e:
+                    raise ScriptException(
+                        f"{type(obj).__name__}.{name}: {e}") from e
         if isinstance(obj, DocValues):
             if name == "size":
                 return obj.size()

@@ -262,13 +262,19 @@ class YamlTestClient:
             with urllib.request.urlopen(req) as resp:
                 raw = resp.read()
                 status = resp.status
+                ctype = resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
             raw = e.read()
             status = e.code
+            ctype = e.headers.get("Content-Type", "") if e.headers else ""
         if not raw:
             # cat/text endpoints legitimately return empty bodies the
             # tests regex-match against ^$
             return status, ""
+        if "json" not in ctype:
+            # text responses (cat API) must stay strings: "2\n" would
+            # otherwise json-parse into a number and break regex matches
+            return status, raw.decode("utf-8", "replace")
         try:
             return status, json.loads(raw)
         except json.JSONDecodeError:
@@ -442,12 +448,20 @@ class YamlTestRunner:
         for path, var in payload.items():
             stash[var] = lookup(self._last(stash), path, stash)
 
+    @staticmethod
+    def _is_falsy(val) -> bool:
+        """Reference falsiness: null/false/""/"false"/0 only — an empty
+        object or list IS true (put-template alias bodies are {})."""
+        return val is None or val is False or val in ("", "false") or (
+            isinstance(val, (int, float)) and not isinstance(val, bool)
+            and val == 0)
+
     def _step_is_true(self, payload, stash: dict, where: str) -> None:
         try:
             val = lookup(self._last(stash), payload, stash)
         except (KeyError, IndexError, YamlTestFailure):
             val = None
-        if val in (None, False, "", 0, {}, []):
+        if self._is_falsy(val):
             raise YamlTestFailure(f"[{where}] is_true {payload}: {val!r}")
 
     def _step_is_false(self, payload, stash: dict, where: str) -> None:
@@ -455,7 +469,7 @@ class YamlTestRunner:
             val = lookup(self._last(stash), payload, stash)
         except (KeyError, IndexError, YamlTestFailure):
             val = None
-        if val not in (None, False, "", 0, {}, []):
+        if not self._is_falsy(val):
             raise YamlTestFailure(f"[{where}] is_false {payload}: {val!r}")
 
     def _cmp(self, payload: dict, stash: dict, where: str, op, name) -> None:

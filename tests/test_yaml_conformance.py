@@ -25,24 +25,33 @@ PASSING = [
     "bulk/30_big_string.yml",
     "bulk/50_refresh.yml",
     "cat.aliases/30_json.yml",
+    "cat.health/10_basic.yml",
+    "cat.plugins/10_basic.yml",
+    "cat.repositories/10_basic.yml",
     "cluster.reroute/10_basic.yml",
     "create/10_with_id.yml",
     "create/15_without_id.yml",
     "create/40_routing.yml",
     "delete/10_basic.yml",
+    "delete/11_shard_header.yml",
     "delete/12_result.yml",
     "delete/20_internal_version.yml",
     "delete/25_external_version.yml",
     "delete/26_external_gte_version.yml",
+    "delete/30_routing.yml",
     "delete/60_missing.yml",
     "exists/10_basic.yml",
     "exists/30_parent.yml",
     "exists/40_routing.yml",
     "exists/60_realtime_refresh.yml",
     "exists/70_defaults.yml",
+    "get/10_basic.yml",
+    "get/15_default_values.yml",
+    "get/20_stored_fields.yml",
     "get/40_routing.yml",
     "get/60_realtime_refresh.yml",
     "get/80_missing.yml",
+    "get/90_versions.yml",
     "get_source/10_basic.yml",
     "get_source/15_default_values.yml",
     "get_source/40_routing.yml",
@@ -50,6 +59,7 @@ PASSING = [
     "get_source/70_source_filtering.yml",
     "get_source/80_missing.yml",
     "index/12_result.yml",
+    "index/15_without_id.yml",
     "index/20_optype.yml",
     "index/30_internal_version.yml",
     "index/36_external_gte_version.yml",
@@ -63,7 +73,13 @@ PASSING = [
     "indices.get_mapping/30_missing_index.yml",
     "indices.get_mapping/40_aliases.yml",
     "indices.get_mapping/60_empty.yml",
+    "indices.get_settings/10_basic.yml",
+    "indices.get_settings/20_aliases.yml",
+    "indices.get_template/10_basic.yml",
     "indices.get_template/20_get_missing.yml",
+    "indices.put_alias/all_path_options.yml",
+    "indices.put_settings/all_path_options.yml",
+    "indices.refresh/10_basic.yml",
     "indices.rollover/20_max_doc_condition.yml",
     "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
@@ -71,16 +87,20 @@ PASSING = [
     "mlt/10_basic.yml",
     "nodes.info/10_basic.yml",
     "ping/10_ping.yml",
+    "remote.info/10_info.yml",
     "search.aggregation/70_adjacency_matrix.yml",
+    "search/110_field_collapsing.yml",
     "search/issue4895.yml",
     "snapshot.create/10_basic.yml",
     "suggest/10_basic.yml",
+    "termvectors/40_versions.yml",
     "update/10_doc.yml",
+    "update/11_shard_header.yml",
     "update/12_result.yml",
     "update/20_doc_upsert.yml",
     "update/22_doc_as_upsert.yml",
     "update/40_routing.yml",
-    "update/80_source_filtering.yml"
+    "update/80_source_filtering.yml",
 ]
 
 
