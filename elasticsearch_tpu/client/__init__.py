@@ -1,0 +1,12 @@
+"""Client package: the in-process typed facade (client.base) and the HTTP
+client (client.http) with connection pooling, round-robin, failure
+marking and sniffing — the RestClient/Transport split of the reference's
+low-level REST client (client/rest/.../RestClient.java)."""
+
+from elasticsearch_tpu.client_base import Client  # noqa: F401
+from elasticsearch_tpu.client.http import (  # noqa: F401
+    HttpClient,
+    NoLiveHostError,
+    Response,
+    TransportError,
+)

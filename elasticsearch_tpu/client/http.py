@@ -1,0 +1,247 @@
+"""Low-level HTTP client: round-robin, failure marking, sniffing.
+
+Role model: the reference's low-level REST client
+(client/rest/src/main/java/org/elasticsearch/client/RestClient.java) —
+host rotation (RestClient.performRequest -> nextHost), dead-host marking
+with exponentially growing resurrect timeouts
+(RestClient.DeadHostState), retry of idempotent requests on connection
+errors, and the sniffer that refreshes the host list from /_nodes
+(client/sniffer/.../ElasticsearchNodesSniffer.java).
+
+Pure stdlib (urllib + threads): the client is infrastructure, not the
+TPU compute path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class TransportError(Exception):
+    """HTTP-level error response (status >= 400)."""
+
+    def __init__(self, status: int, body: Any):
+        self.status = status
+        self.body = body
+        reason = body
+        if isinstance(body, dict):
+            err = body.get("error")
+            reason = err.get("reason") if isinstance(err, dict) else err
+        super().__init__(f"[{status}] {reason}")
+
+
+class NoLiveHostError(Exception):
+    """Every configured host is marked dead and none could be revived."""
+
+
+class Response:
+    __slots__ = ("status", "body", "host")
+
+    def __init__(self, status: int, body: Any, host: str):
+        self.status = status
+        self.body = body
+        self.host = host
+
+
+class _HostState:
+    """DeadHostState: failed hosts sit out with exponential backoff
+    (1min base, doubling per consecutive failure, capped at 30min)."""
+
+    __slots__ = ("host", "failures", "dead_until")
+
+    BASE_TIMEOUT = 60.0
+    MAX_TIMEOUT = 1800.0
+
+    def __init__(self, host: str):
+        self.host = host
+        self.failures = 0
+        self.dead_until = 0.0
+
+    def mark_dead(self, now: float) -> None:
+        self.failures += 1
+        timeout = min(self.BASE_TIMEOUT * (2 ** (self.failures - 1)),
+                      self.MAX_TIMEOUT)
+        self.dead_until = now + timeout
+
+    def mark_alive(self) -> None:
+        self.failures = 0
+        self.dead_until = 0.0
+
+    def usable(self, now: float) -> bool:
+        return now >= self.dead_until
+
+
+class HttpClient:
+    """Round-robin HTTP client over one or more nodes.
+
+    >>> client = HttpClient(["http://127.0.0.1:9200"])
+    >>> client.request("GET", "/_cluster/health").body["status"]
+
+    sniff=True refreshes the host list from GET /_nodes/http on a
+    background interval (and eagerly after a host failure), so nodes
+    joining/leaving the cluster rotate in without reconfiguration.
+    """
+
+    def __init__(self, hosts: List[str], timeout: float = 30.0,
+                 max_retries: int = 3, sniff: bool = False,
+                 sniff_interval: float = 300.0):
+        if not hosts:
+            raise ValueError("at least one host required")
+        self._lock = threading.Lock()
+        self._states = [_HostState(h.rstrip("/")) for h in hosts]
+        self._rr = 0
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self._sniff_enabled = sniff
+        self._sniff_interval = sniff_interval
+        self._last_sniff = 0.0
+        self._closed = False
+
+    # --- host selection (RestClient.nextHost) ---
+
+    def _next_host(self) -> _HostState:
+        now = time.monotonic()
+        with self._lock:
+            n = len(self._states)
+            # prefer live hosts in round-robin order
+            for i in range(n):
+                st = self._states[(self._rr + i) % n]
+                if st.usable(now):
+                    self._rr = (self._rr + i + 1) % n
+                    return st
+            # all dead: revive the one whose timeout expires soonest
+            # (DeadHostState comparison — gives it a trial request)
+            return min(self._states, key=lambda s: s.dead_until)
+
+    def hosts(self) -> List[str]:
+        with self._lock:
+            return [s.host for s in self._states]
+
+    def set_hosts(self, hosts: List[str]) -> None:
+        with self._lock:
+            known = {s.host: s for s in self._states}
+            self._states = [known.get(h.rstrip("/"), _HostState(h.rstrip("/")))
+                            for h in dict.fromkeys(hosts)]
+
+    # --- sniffing (ElasticsearchNodesSniffer) ---
+
+    def sniff(self) -> List[str]:
+        """Refresh hosts from /_nodes/http of any live node."""
+        resp = self.request("GET", "/_nodes/http", _sniffing=True)
+        found = []
+        for info in (resp.body.get("nodes") or {}).values():
+            addr = (info.get("http") or {}).get("publish_address")
+            if addr:
+                found.append(addr if addr.startswith("http")
+                             else f"http://{addr}")
+        if found:
+            self.set_hosts(found)
+        self._last_sniff = time.monotonic()
+        return self.hosts()
+
+    def _maybe_sniff(self, force: bool = False) -> None:
+        if not self._sniff_enabled:
+            return
+        now = time.monotonic()
+        if force or now - self._last_sniff >= self._sniff_interval:
+            try:
+                self.sniff()
+            except Exception:  # noqa: BLE001 — sniffing is best-effort
+                self._last_sniff = now
+
+    # --- requests ---
+
+    def request(self, method: str, path: str,
+                body: Optional[Any] = None,
+                params: Optional[Dict[str, Any]] = None,
+                _sniffing: bool = False) -> Response:
+        if not _sniffing:
+            self._maybe_sniff()
+        url_path = path if path.startswith("/") else "/" + path
+        if params:
+            url_path += "?" + urllib.parse.urlencode(
+                {k: str(v) for k, v in params.items()})
+        data = None
+        headers = {}
+        if body is not None:
+            data = (body.encode() if isinstance(body, str)
+                    else json.dumps(body).encode())
+            headers["Content-Type"] = "application/json"
+        # only idempotent requests may be replayed after a connection
+        # error/timeout: the server may have executed a POST before the
+        # failure, and re-sending would duplicate the write
+        idempotent = method.upper() in ("GET", "HEAD", "PUT", "DELETE")
+        attempts = max(1, self.max_retries) if idempotent else 1
+        last_exc: Optional[Exception] = None
+        for _ in range(attempts):
+            st = self._next_host()
+            req = urllib.request.Request(st.host + url_path, data=data,
+                                         method=method, headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    st.mark_alive()
+                    return Response(r.status, self._parse(r), st.host)
+            except urllib.error.HTTPError as e:
+                # the node answered: it is alive; 4xx/5xx do not rotate
+                st.mark_alive()
+                raw = e.read()
+                try:
+                    parsed = json.loads(raw)
+                except (ValueError, TypeError):
+                    parsed = raw.decode("utf-8", "replace")
+                raise TransportError(e.code, parsed) from None
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                st.mark_dead(time.monotonic())
+                last_exc = e
+                self._maybe_sniff(force=True)
+        raise NoLiveHostError(
+            f"no usable host out of {self.hosts()}: {last_exc}")
+
+    @staticmethod
+    def _parse(r) -> Any:
+        raw = r.read()
+        if not raw:
+            return None
+        ctype = r.headers.get("Content-Type", "")
+        if "json" in ctype:
+            return json.loads(raw)
+        return raw.decode("utf-8", "replace")
+
+    # --- convenience verbs (high-level client surface) ---
+
+    def get(self, path: str, **kw) -> Response:
+        return self.request("GET", path, **kw)
+
+    def put(self, path: str, body=None, **kw) -> Response:
+        return self.request("PUT", path, body=body, **kw)
+
+    def post(self, path: str, body=None, **kw) -> Response:
+        return self.request("POST", path, body=body, **kw)
+
+    def delete(self, path: str, **kw) -> Response:
+        return self.request("DELETE", path, **kw)
+
+    # typed helpers mirroring client_base.Client
+
+    def index(self, index: str, doc_id: str, body: dict, **params) -> dict:
+        return self.put(f"/{index}/_doc/{doc_id}", body=body,
+                        params=params or None).body
+
+    def get_doc(self, index: str, doc_id: str) -> dict:
+        return self.get(f"/{index}/_doc/{doc_id}").body
+
+    def search(self, index: str, body: dict) -> dict:
+        return self.post(f"/{index}/_search", body=body).body
+
+    def bulk(self, lines: List[dict]) -> dict:
+        payload = "\n".join(json.dumps(x) for x in lines) + "\n"
+        return self.post("/_bulk", body=payload).body
+
+    def refresh(self, index: str) -> dict:
+        return self.post(f"/{index}/_refresh").body

@@ -30,6 +30,7 @@ from elasticsearch_tpu.common.errors import (
     InvalidIndexNameException,
     ResourceNotFoundException,
 )
+from elasticsearch_tpu.common import monitor
 from elasticsearch_tpu.common.settings import (
     CLUSTER_NAME,
     NODE_NAME,
@@ -810,6 +811,10 @@ class Node:
                     "roles": ["master", "data", "ingest"],
                     "settings": self.settings.as_nested_dict(),
                     "plugins": self.plugins_service.info(),
+                    "http": {
+                        "publish_address": getattr(
+                            self, "http_publish_address", None),
+                    },
                 }
             },
         }
@@ -827,7 +832,11 @@ class Node:
                         "docs": {"count": sum(s.num_docs for s in self.indices.values())},
                     },
                     "jvm": {"uptime_in_millis": int((time.time() - self.start_time) * 1000)},
-                    "process": {"open_file_descriptors": -1},
+                    # monitor probes (OsProbe/ProcessProbe/FsProbe analogs)
+                    "os": monitor.os_stats(),
+                    "process": monitor.process_stats(),
+                    "fs": monitor.fs_stats(
+                        self.data_path if self.persistent_path else "."),
                     "thread_pool": self.thread_pool.stats(),
                     "breakers": self.breaker_service.stats(),
                 }

@@ -337,6 +337,16 @@ def _forced_refresh(req, r):
     return r
 
 
+def _validate_type_param(req):
+    """MapperService.validateTypeName: type names can't start with '_'
+    (only the canonical _doc is allowed)."""
+    t = req.param("type")
+    if t is not None and t.startswith("_") and t not in ("_doc", "_all"):
+        raise IllegalArgumentException(
+            f"Document mapping type name can't start with '_', "
+            f"found: [{t}]")
+
+
 def _record_doc_type(node, req):
     """6.x first-write-wins type naming: indexing through a typed path
     onto an index whose type is still the default records the custom
@@ -353,6 +363,7 @@ def _record_doc_type(node, req):
 
 
 def _index_doc(node, req, force_create: bool = False):
+    _validate_type_param(req)
     _typed_api_warning(req)
     body = req.json_body()
     if body is None:
@@ -383,10 +394,7 @@ def _index_doc_auto_id(node, req):
         # the POST /{index}/{type} route would otherwise swallow typoed
         # or unregistered /{index}/_endpoint POSTs as documents: type
         # names may not start with '_' (MapperService.validateTypeName)
-        if t.startswith("_") and t != "_doc":
-            raise IllegalArgumentException(
-                f"Document mapping type name can't start with '_', "
-                f"found: [{t}]")
+        _validate_type_param(req)
         _typed_api_warning(req)
     body = req.json_body()
     if body is None:
@@ -1010,7 +1018,7 @@ def _get_index_settings(node, req):
     import fnmatch
 
     state = node.cluster_service.state
-    flat = req.param("flat_settings") in ("true", True)
+    flat = req.bool_param("flat_settings")
     name_filter = req.param("setting")
     out = {}
     for name in state.resolve_index_names(req.param("index", "_all")):
@@ -1115,7 +1123,7 @@ def _head_alias(node, req):
 
 def _put_template(node, req):
     name = req.param("name")
-    if req.param("create") in ("true", True) and \
+    if req.bool_param("create") and \
             name in node.cluster_service.state.templates:
         raise IllegalArgumentException(
             f"index_template [{name}] already exists")
@@ -1127,7 +1135,7 @@ def _get_template(node, req):
 
     templates = node.cluster_service.state.templates
     name = req.param("name")
-    flat = req.param("flat_settings") in ("true", True)
+    flat = req.bool_param("flat_settings")
 
     def render(t):
         t = dict(t)
@@ -1230,7 +1238,7 @@ def _simulate_pipeline_by_id(node, req):
 
 
 def _cat_table(req, rows: List[List], headers: List[str]) -> Tuple[int, object]:
-    if req.param("help") in ("true", True):
+    if req.bool_param("help"):
         # RestTable help: one line per column — name | alias | description
         w = max(len(h) for h in headers)
         return 200, "".join(f"{h.ljust(w)} | - | {h}\n" for h in headers)
@@ -1258,6 +1266,7 @@ def _cat_table(req, rows: List[List], headers: List[str]) -> Tuple[int, object]:
     if h_spec:
         wanted = h_spec if isinstance(h_spec, list) \
             else str(h_spec).split(",")
+        wanted = [w for w in wanted if w]
         idx = []
         for name in wanted:
             if name not in headers:
@@ -1427,13 +1436,17 @@ def _cat_tasks(node, req):
 
 def _cat_allocation(node, req):
     n_shards = sum(s.num_shards for s in node.indices.values())
-    import shutil as _sh
+    from elasticsearch_tpu.common.monitor import fs_stats
 
-    du = _sh.disk_usage("/")
-    rows = [[n_shards, "0b", f"{du.used // (1 << 30)}gb",
-             f"{du.free // (1 << 30)}gb", f"{du.total // (1 << 30)}gb",
-             int(du.used * 100 / du.total), "127.0.0.1", "127.0.0.1",
-             node.node_name]]
+    fs = fs_stats(node.data_path if node.persistent_path else ".")
+    tot = fs.get("total", {})
+    total_b = tot.get("total_in_bytes", 0)
+    free_b = tot.get("free_in_bytes", 0)
+    used_b = max(total_b - free_b, 0)
+    rows = [[n_shards, "0b", f"{used_b // (1 << 30)}gb",
+             f"{free_b // (1 << 30)}gb", f"{total_b // (1 << 30)}gb",
+             int(used_b * 100 / total_b) if total_b else 0,
+             "127.0.0.1", "127.0.0.1", node.node_name]]
     return _cat_table(req, rows, ["shards", "disk.indices", "disk.used",
                                   "disk.avail", "disk.total", "disk.percent",
                                   "host", "ip", "node"])
@@ -1467,9 +1480,7 @@ def _cat_snapshots(node, req):
     for s in snaps:
         t0 = int(s.get("start_time_in_millis", 0) // 1000)
         t1 = int(s.get("end_time_in_millis", 0) // 1000)
-        ns = len(s.get("shards_total", s["indices"])) \
-            if isinstance(s.get("shards_total", s["indices"]), list) \
-            else s.get("shards_total", len(s["indices"]))
+        ns = s.get("shards_total", len(s["indices"]))
         rows.append([s["snapshot"], s["state"], t0,
                      time.strftime("%H:%M:%S", time.gmtime(t0)), t1,
                      time.strftime("%H:%M:%S", time.gmtime(t1)),

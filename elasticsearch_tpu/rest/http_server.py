@@ -83,6 +83,11 @@ class HttpServer:
         handler = type("BoundHandler", (_Handler,), {"controller": self.controller})
         self.server = ThreadingHTTPServer((host, port), handler)
         self.port = self.server.server_address[1]
+        # the sniffer reads this from /_nodes/http (publish_address);
+        # wildcard/empty binds publish a concrete loopback address
+        publish_host = host if host not in ("", "0.0.0.0", "::") \
+            else "127.0.0.1"
+        node.http_publish_address = f"{publish_host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:

@@ -63,6 +63,8 @@ class CompiledScript:
         )
         expr = _SCORE_RE.sub(repr(float(score)), expr)
         for name, value in sorted((params or {}).items(), key=lambda kv: -len(kv[0])):
+            if f"params.{name}" not in expr:
+                continue  # unreferenced param must not force the fallback
             try:
                 sub = repr(float(value))
             except (TypeError, ValueError):
@@ -117,6 +119,8 @@ class CompiledScript:
         expr = _SCORE_RE.sub(
             lambda m: bind(scores if scores is not None else 0.0), expr)
         for name, value in sorted((params or {}).items(), key=lambda kv: -len(kv[0])):
+            if f"params.{name}" not in expr:
+                continue  # unreferenced param must not force the fallback
             try:
                 sub = repr(float(value))
             except (TypeError, ValueError):

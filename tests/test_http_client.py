@@ -1,0 +1,85 @@
+"""HTTP client: round-robin, dead-host marking, retries, sniffing
+(client/rest/.../RestClient.java + sniffer semantics)."""
+
+import pytest
+
+from elasticsearch_tpu.client import (
+    HttpClient,
+    NoLiveHostError,
+    TransportError,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.http_server import HttpServer
+
+
+@pytest.fixture()
+def cluster():
+    nodes, servers = [], []
+    for _ in range(2):
+        n = Node()
+        s = HttpServer(n, port=0)
+        s.start()
+        nodes.append(n)
+        servers.append(s)
+    yield nodes, servers
+    for s in servers:
+        s.stop()
+    for n in nodes:
+        n.close()
+
+
+class TestHttpClient:
+    def test_round_robin_rotates_hosts(self, cluster):
+        nodes, servers = cluster
+        client = HttpClient([f"http://127.0.0.1:{s.port}" for s in servers])
+        seen = {client.request("GET", "/").host for _ in range(4)}
+        assert len(seen) == 2  # both hosts served requests
+
+    def test_error_responses_do_not_mark_dead(self, cluster):
+        _, servers = cluster
+        client = HttpClient([f"http://127.0.0.1:{servers[0].port}"])
+        with pytest.raises(TransportError) as e:
+            client.request("GET", "/missing_index/_doc/1")
+        assert e.value.status == 404
+        # host still usable: next request succeeds without retries
+        assert client.request("GET", "/").status == 200
+
+    def test_dead_host_failover(self, cluster):
+        _, servers = cluster
+        # one dead port + one live: requests transparently fail over
+        dead = "http://127.0.0.1:1"  # nothing listens on port 1
+        live = f"http://127.0.0.1:{servers[0].port}"
+        client = HttpClient([dead, live], timeout=2)
+        for _ in range(3):
+            assert client.request("GET", "/").host == live
+        # the dead host is marked and skipped without costing a retry
+        states = {s.host: s for s in client._states}
+        assert states[dead].failures >= 1
+        assert states[live].failures == 0
+
+    def test_all_dead_raises(self):
+        client = HttpClient(["http://127.0.0.1:1"], timeout=1,
+                            max_retries=2)
+        with pytest.raises(NoLiveHostError):
+            client.request("GET", "/")
+
+    def test_sniffer_discovers_nodes(self, cluster):
+        _, servers = cluster
+        client = HttpClient([f"http://127.0.0.1:{servers[0].port}"])
+        hosts = client.sniff()
+        assert hosts == [f"http://127.0.0.1:{servers[0].port}"]
+
+    def test_typed_helpers_end_to_end(self, cluster):
+        _, servers = cluster
+        client = HttpClient([f"http://127.0.0.1:{s.port}" for s in servers])
+        # both hosts front DIFFERENT single nodes; pin to one for writes
+        client.set_hosts([f"http://127.0.0.1:{servers[0].port}"])
+        client.put("/lib", body={"mappings": {"properties": {
+            "t": {"type": "text"}}}})
+        client.index("lib", "1", {"t": "round robin retry sniff"})
+        client.bulk([{"index": {"_index": "lib", "_id": "2"}},
+                     {"t": "bulk doc"}])
+        client.refresh("lib")
+        r = client.search("lib", {"query": {"match": {"t": "bulk"}}})
+        assert r["hits"]["total"] == 1
+        assert client.get_doc("lib", "1")["found"] is True
