@@ -56,7 +56,7 @@ def pctl(xs, p):
     return float(np.percentile(np.asarray(xs), p) * 1000)
 
 
-def measure_marginal(fn, queries, b_small=5, b_big=30, reps=3):
+def measure_marginal(fn, queries, b_small=10, b_big=60, reps=5):
     """Per-query device service time in seconds via marginal batch timing.
 
     Runs batches of b_small and b_big chained executions, each ending in one
@@ -299,12 +299,20 @@ def run_measurement() -> dict:
         staged_kq = [(jnp.asarray(rl), jnp.asarray(rh), jnp.asarray(w))
                      for rl, rh, w, _ in kqueries]
 
-        def run_kernel(q):
-            rl, rh, w = q
+        @jax.jit
+        def _kernel_fused(docs, frac, live_t, rl, rh, w):
+            # one program = one dispatch: the tile kernel + global merge
+            # fuse under a single jit (two separate dispatches double the
+            # per-call overhead and the marginal-timing jitter)
             ts_, td_, th_ = psc.score_tiles(
-                dev["docs"], dev["frac"], dev["live_t"], rl, rh, w,
+                docs, frac, live_t, rl, rh, w,
                 t_pad=4, cb=cb_run, sub=geom.tile_sub, k=K)
             return psc.merge_tile_topk(ts_, td_, th_, K)
+
+        def run_kernel(q):
+            rl, rh, w = q
+            return _kernel_fused(dev["docs"], dev["frac"], dev["live_t"],
+                                 rl, rh, w)
 
         t0 = time.perf_counter()
         top_s, top_d, hits = run_kernel(staged_kq[0])
@@ -469,7 +477,7 @@ def run_measurement() -> dict:
         }
         recall = kernel_metrics["recall"]
         method = ("marginal batch timing: per-query device service time = "
-                  "(T[30 chained queries] - T[5]) / 25, each batch ending in "
+                  "(T[60 chained queries] - T[10]) / 50, each batch ending in "
                   "one tiny D2H that forces completion; cancels the axon "
                   "tunnel's fixed ~70ms per-sync overhead (its "
                   "block_until_ready does not await completion, so naive "

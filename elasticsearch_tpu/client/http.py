@@ -36,6 +36,24 @@ class TransportError(Exception):
         super().__init__(f"[{status}] {reason}")
 
 
+def _was_never_sent(exc) -> bool:
+    """True when the failure guarantees the request never reached a
+    server (safe to replay non-idempotent requests)."""
+    import errno
+
+    reasons = [exc]
+    if isinstance(exc, urllib.error.URLError):
+        reasons.append(exc.reason)
+    for r in reasons:
+        if isinstance(r, ConnectionRefusedError):
+            return True
+        if isinstance(r, OSError) and r.errno in (errno.ECONNREFUSED,
+                                                  errno.EHOSTUNREACH,
+                                                  errno.ENETUNREACH):
+            return True
+    return False
+
+
 class NoLiveHostError(Exception):
     """Every configured host is marked dead and none could be revived."""
 
@@ -173,11 +191,8 @@ class HttpClient:
             data = (body.encode() if isinstance(body, str)
                     else json.dumps(body).encode())
             headers["Content-Type"] = "application/json"
-        # only idempotent requests may be replayed after a connection
-        # error/timeout: the server may have executed a POST before the
-        # failure, and re-sending would duplicate the write
         idempotent = method.upper() in ("GET", "HEAD", "PUT", "DELETE")
-        attempts = max(1, self.max_retries) if idempotent else 1
+        attempts = max(1, self.max_retries)
         last_exc: Optional[Exception] = None
         for _ in range(attempts):
             st = self._next_host()
@@ -199,7 +214,14 @@ class HttpClient:
             except (urllib.error.URLError, TimeoutError, OSError) as e:
                 st.mark_dead(time.monotonic())
                 last_exc = e
-                self._maybe_sniff(force=True)
+                if not _sniffing:  # a failing sniff must not re-sniff
+                    self._maybe_sniff(force=True)
+                if not idempotent and not _was_never_sent(e):
+                    # the server may have executed the POST before a
+                    # timeout/reset: replaying could duplicate the write.
+                    # Connection-refused failures were never delivered, so
+                    # those still fail over to the next host.
+                    break
         raise NoLiveHostError(
             f"no usable host out of {self.hosts()}: {last_exc}")
 

@@ -253,76 +253,96 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
       where-selects lower cleanly.
     """
     w = sub * LANE
-    # two consecutive cb-aligned DMA windows per lane -> 2*cb rows
-    cb2 = 2 * cb
-    rows = cb2 * LANE
+    # two consecutive cb-aligned DMA windows per lane; each processes its
+    # cb rows independently so its whole compute block can be skipped
+    rows = cb * LANE
 
     def kernel(rowlo_ref, rowhi_ref, *refs):
         docs_refs = [(refs[4 * j], refs[4 * j + 2]) for j in range(t_pad)]
         frac_refs = [(refs[4 * j + 1], refs[4 * j + 3]) for j in range(t_pad)]
         live_ref = refs[4 * t_pad]
         w_ref = refs[4 * t_pad + 1]
-        outs = refs[4 * t_pad + 2:]
+        n_outs = (1 + int(with_counts)) if dense else 3
+        outs = refs[4 * t_pad + 2: 4 * t_pad + 2 + n_outs]
+        acc_ref = refs[4 * t_pad + 2 + n_outs]
+        cnt_ref = refs[4 * t_pad + 3 + n_outs] if with_counts else None
         t = pl.program_id(0)
         base = jnp.int32(t) * jnp.int32(w)
-        accT = jnp.zeros((LANE, sub), jnp.float32)
-        cntT = jnp.zeros((LANE, sub), jnp.float32) if with_counts else None
+        # scratch accumulators persist across grid steps: reset first
+        acc_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
+        if with_counts:
+            cnt_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
         for j in range(t_pad):
             rlo = rowlo_ref[t, j]
             rhi = rowhi_ref[t, j]
             # aligned first row actually DMA'd (must mirror lane_map below)
             sb = lax.div(rlo, jnp.int32(cb)) * jnp.int32(cb)
-            docs = jnp.concatenate(
-                [docs_refs[j][0][...], docs_refs[j][1][...]], axis=0)
-            frac = jnp.concatenate(
-                [frac_refs[j][0][...], frac_refs[j][1][...]], axis=0)
-            blk = sb + lax.broadcasted_iota(jnp.int32, (cb2, LANE), 0)
-            local = docs - base
-            valid = (
-                (blk >= rlo) & (blk < rhi)
-                & (local >= jnp.int32(0)) & (local < jnp.int32(w))
-                & (frac > jnp.float32(0.0))
-            )
-            # NB every scalar int literal below must be an explicit int32:
-            # inside the kernel trace weak python ints become i64 scalars,
-            # and mosaic's i64->i32 demotion fallback recurses forever
-            safe = jnp.where(valid, local, jnp.int32(0))
-            hi = jnp.where(valid, lax.shift_right_logical(
-                safe, jnp.int32(7)), jnp.int32(-1))
-            lo = jnp.where(valid, jnp.bitwise_and(safe, jnp.int32(LANE - 1)),
-                           jnp.int32(-1))
             wj = w_ref[0, j]
-            hi_row = hi.reshape(1, rows)
-            lo_row = lo.reshape(1, rows)
-            wf_row = (frac * wj).reshape(1, rows)
-            ohT = jnp.where(
-                lax.broadcasted_iota(jnp.int32, (sub, rows), 0) == hi_row,
-                jnp.float32(1.0), jnp.float32(0.0))
-            # two-pass error-compensated matmul: the MXU's default single
-            # bf16 pass rounds w*frac to an 8-bit mantissa (~0.2% rel error
-            # — enough to reorder near-tied BM25 ranks vs the host oracle),
-            # and Precision.HIGHEST costs 6 passes. Splitting the value into
-            # bf16 high + f32 residual parts and summing two DEFAULT dots
-            # gives ~2^-17 rel error at 1/3 the MXU passes (ohT is 0/1,
-            # bf16-exact, so only this operand needs compensation).
-            lane_iota = lax.broadcasted_iota(jnp.int32, (LANE, rows), 0)
-            wf_hi = wf_row.astype(jnp.bfloat16).astype(jnp.float32)
-            wf_lo = wf_row - wf_hi
-            lov_hi = jnp.where(lane_iota == lo_row, wf_hi, jnp.float32(0.0))
-            lov_lo = jnp.where(lane_iota == lo_row, wf_lo, jnp.float32(0.0))
-            accT = accT + lax.dot_general(
-                lov_hi, ohT, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            accT = accT + lax.dot_general(
-                lov_lo, ohT, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            if with_counts:
-                lovT1 = jnp.where(
-                    lax.broadcasted_iota(jnp.int32, (LANE, rows), 0) == lo_row,
-                    jnp.float32(1.0), jnp.float32(0.0))
-                cntT = cntT + lax.dot_general(
-                    lovT1, ohT, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+            for half in (0, 1):
+                start = sb + jnp.int32(half * cb)
+                # skip the whole window when it can't intersect the lane's
+                # covering run: empty lanes skip both halves, and the
+                # second half only runs on the rare misaligned overflow —
+                # this halves the one-hot/MXU work in the common case
+                needed = (rhi > rlo) & (start < rhi) \
+                    & (start + jnp.int32(cb) > rlo)
+
+                @pl.when(needed)
+                def _(j=j, half=half, start=start, rlo=rlo, rhi=rhi, wj=wj):
+                    docs = docs_refs[j][half][...]
+                    frac = frac_refs[j][half][...]
+                    blk = start + lax.broadcasted_iota(
+                        jnp.int32, (cb, LANE), 0)
+                    local = docs - base
+                    valid = (
+                        (blk >= rlo) & (blk < rhi)
+                        & (local >= jnp.int32(0)) & (local < jnp.int32(w))
+                        & (frac > jnp.float32(0.0))
+                    )
+                    # NB every scalar int literal below must be an explicit
+                    # int32: inside the kernel trace weak python ints become
+                    # i64 scalars, and mosaic's i64->i32 demotion fallback
+                    # recurses forever
+                    safe = jnp.where(valid, local, jnp.int32(0))
+                    hi = jnp.where(valid, lax.shift_right_logical(
+                        safe, jnp.int32(7)), jnp.int32(-1))
+                    lo = jnp.where(valid, jnp.bitwise_and(
+                        safe, jnp.int32(LANE - 1)), jnp.int32(-1))
+                    hi_row = hi.reshape(1, rows)
+                    lo_row = lo.reshape(1, rows)
+                    wf_row = (frac * wj).reshape(1, rows)
+                    ohT = jnp.where(
+                        lax.broadcasted_iota(
+                            jnp.int32, (sub, rows), 0) == hi_row,
+                        jnp.float32(1.0), jnp.float32(0.0))
+                    # two-pass error-compensated matmul: the MXU's default
+                    # single bf16 pass rounds w*frac to an 8-bit mantissa
+                    # (~0.2% rel error — enough to reorder near-tied BM25
+                    # ranks vs the host oracle), and Precision.HIGHEST
+                    # costs 6 passes. bf16-high + f32-residual summed over
+                    # two DEFAULT dots gives ~2^-17 rel error at 1/3 the
+                    # passes (ohT is 0/1, bf16-exact).
+                    lane_iota = lax.broadcasted_iota(
+                        jnp.int32, (LANE, rows), 0)
+                    wf_hi = wf_row.astype(jnp.bfloat16).astype(jnp.float32)
+                    wf_lo = wf_row - wf_hi
+                    lov_hi = jnp.where(lane_iota == lo_row, wf_hi,
+                                       jnp.float32(0.0))
+                    lov_lo = jnp.where(lane_iota == lo_row, wf_lo,
+                                       jnp.float32(0.0))
+                    acc_ref[...] = acc_ref[...] + lax.dot_general(
+                        lov_hi, ohT, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) + lax.dot_general(
+                        lov_lo, ohT, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    if with_counts:
+                        lovT1 = jnp.where(lane_iota == lo_row,
+                                          jnp.float32(1.0), jnp.float32(0.0))
+                        cnt_ref[...] = cnt_ref[...] + lax.dot_general(
+                            lovT1, ohT, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        accT = acc_ref[...]
+        cntT = cnt_ref[...] if with_counts else None
         live = live_ref[...] > jnp.float32(0.0)  # (LANE, sub) transposed
         if dense:
             out_scores = outs[0]
@@ -462,11 +482,15 @@ def score_tiles(
             jax.ShapeDtypeStruct((n_tiles, 1, 1), jnp.float32),
         ]
 
+    scratch_shapes = [pltpu.VMEM((LANE, sub), jnp.float32)]
+    if with_counts:
+        scratch_shapes.append(pltpu.VMEM((LANE, sub), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_tiles,),
         in_specs=in_specs,
         out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
     )
     kernel = _make_kernel(t_pad, cb, sub, k, dense, with_counts)
     kwargs = {}

@@ -341,7 +341,7 @@ def _validate_type_param(req):
     """MapperService.validateTypeName: type names can't start with '_'
     (only the canonical _doc is allowed)."""
     t = req.param("type")
-    if t is not None and t.startswith("_") and t not in ("_doc", "_all"):
+    if t is not None and t.startswith("_") and t != "_doc":
         raise IllegalArgumentException(
             f"Document mapping type name can't start with '_', "
             f"found: [{t}]")

@@ -84,9 +84,16 @@ class HttpServer:
         self.server = ThreadingHTTPServer((host, port), handler)
         self.port = self.server.server_address[1]
         # the sniffer reads this from /_nodes/http (publish_address);
-        # wildcard/empty binds publish a concrete loopback address
-        publish_host = host if host not in ("", "0.0.0.0", "::") \
-            else "127.0.0.1"
+        # wildcard/empty binds publish a concrete routable address (a
+        # remote sniffer receiving 127.0.0.1 would redirect to itself)
+        publish_host = host
+        if host in ("", "0.0.0.0", "::"):
+            import socket
+
+            try:
+                publish_host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                publish_host = "127.0.0.1"
         node.http_publish_address = f"{publish_host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 

@@ -344,6 +344,45 @@ def _f(seg, field):
     return field if field in seg.ordinal_columns else f"{field}.keyword"
 
 
+def _terms_global_merge(spec, views) -> Optional[Dict]:
+    """Cross-segment terms counts in GLOBAL ordinal space
+    (GlobalOrdinalsStringTermsAggregator): per-segment device counts fold
+    into one int64 array via the cached local->global maps; strings only
+    materialize for the surviving buckets. None when any segment lacks a
+    string-ordinal column for the field (numeric terms keep the
+    string-keyed path)."""
+    from elasticsearch_tpu.index.global_ordinals import global_ordinals
+
+    field = spec.body.get("field")
+    if field is None or not views:
+        return None
+    cols = []
+    for v in views:
+        ocol = _resolve_ordinal_field(v.segment, field)
+        if ocol is None and _resolve_value_field(v.segment, field) is not None:
+            return None  # numeric terms
+        cols.append(ocol)
+    # pass the resolved columns through: text fields materialize ordinal
+    # fielddata lazily and live outside segment.ordinal_columns
+    gords = global_ordinals([v.segment for v in views], field, columns=cols)
+    if not gords.terms:
+        return {}
+    total = np.zeros(len(gords.terms), np.int64)
+    for v, ocol in zip(views, cols):
+        if ocol is None or ocol.count == 0:
+            continue
+        seg = v.segment
+        docs = seg.device_column(f"ord.{_f(seg, field)}.docs",
+                                 lambda: ocol.flat_docs)
+        ords = seg.device_column(f"ord.{_f(seg, field)}.ords",
+                                 lambda: ocol.flat_ords)
+        counts = np.asarray(agg_ops.ordinal_counts(
+            docs, ords, jnp.asarray(v.mask), len(ocol.terms)))
+        gords.fold_counts(seg, counts.astype(np.int64), total)
+    nz = np.nonzero(total)[0]
+    return {gords.terms[i]: int(total[i]) for i in nz}
+
+
 _CAL_INTERVALS = {"year": "Y", "quarter": None, "month": "M", "week": "W",
                   "day": "D", "hour": "h", "minute": "m", "second": "s"}
 _FIXED_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
@@ -772,11 +811,13 @@ def _run_one_inner(spec: AggSpec, views: List[SegmentView]) -> dict:
         return {"buckets": buckets}
 
     if spec.type == "terms":
-        partials = [compute_partial(spec, v) for v in views]
-        merged: Dict = {}
-        for p in partials:
-            for k, c in p["counts"].items():
-                merged[k] = merged.get(k, 0) + c
+        merged = _terms_global_merge(spec, views)
+        if merged is None:  # numeric/missing field: string-keyed partials
+            partials = [compute_partial(spec, v) for v in views]
+            merged = {}
+            for p in partials:
+                for k, c in p["counts"].items():
+                    merged[k] = merged.get(k, 0) + c
         size = int(spec.body.get("size", 10))
         order = spec.body.get("order", {"_count": "desc"})
         items = list(merged.items())

@@ -1,0 +1,62 @@
+"""Global ordinals (index/global_ordinals.py): one ordinal space across
+segments — GlobalOrdinalsBuilder/OrdinalMap semantics."""
+
+import numpy as np
+
+from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.global_ordinals import global_ordinals
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.mapper.mapping import MapperService
+
+MAPPING = {"properties": {"tag": {"type": "keyword"}}}
+
+
+def _seg(name, values):
+    svc = MapperService(AnalysisRegistry(), MAPPING)
+    b = SegmentBuilder(name)
+    for i, v in enumerate(values):
+        b.add_document(svc.parse_document(str(i), {"tag": v}), i)
+    return b.seal()
+
+
+class TestGlobalOrdinals:
+    def test_merged_space_and_fold(self):
+        s1 = _seg("s1", ["b", "a", "c"])
+        s2 = _seg("s2", ["c", "d"])
+        g = global_ordinals([s1, s2], "tag")
+        assert g.terms == ["a", "b", "c", "d"]
+        # fold per-segment counts (local ord order is segment-sorted)
+        out = np.zeros(4, np.int64)
+        g.fold_counts(s1, np.asarray([1, 1, 1]), out)   # a b c
+        g.fold_counts(s2, np.asarray([2, 5]), out)      # c d
+        assert out.tolist() == [1, 1, 3, 5]
+
+    def test_cache_by_segment_identity(self):
+        s1 = _seg("s1", ["x"])
+        s2 = _seg("s2", ["y"])
+        a = global_ordinals([s1, s2], "tag")
+        b = global_ordinals([s1, s2], "tag")
+        assert a is b  # cached
+        s3 = _seg("s2", ["y"])  # same name, new object (post-refresh)
+        c = global_ordinals([s1, s3], "tag")
+        assert c is not a
+
+    def test_terms_agg_parity_across_segments(self):
+        """End-to-end: multi-segment terms agg equals single-segment
+        semantics (global-ordinals merge vs per-segment dicts)."""
+        idx = IndexService("gords", Settings.EMPTY, MAPPING)
+        tags = ["red", "green", "blue", "red", "red", "green"]
+        for i, t in enumerate(tags[:3]):
+            idx.index_doc(str(i), {"tag": t})
+        idx.refresh()  # segment 1
+        for i, t in enumerate(tags[3:], start=3):
+            idx.index_doc(str(i), {"tag": t})
+        idx.refresh()  # segment 2
+        r = idx.search({"size": 0, "aggs": {
+            "t": {"terms": {"field": "tag"}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["t"]["buckets"]}
+        assert buckets == {"red": 3, "green": 2, "blue": 1}
+        idx.close()
