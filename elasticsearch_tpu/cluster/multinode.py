@@ -56,6 +56,7 @@ from elasticsearch_tpu.transport.local import TransportHub, TransportService
 from elasticsearch_tpu.utils.murmur3 import shard_id_for
 
 ACTION_PUBLISH = "internal:cluster/coordination/publish_state"
+ACTION_COMMIT = "internal:cluster/coordination/commit_state"
 ACTION_JOIN = "internal:discovery/zen/join"
 ACTION_SHARD_FAILED = "internal:cluster/shard/failure"
 ACTION_SHARD_STARTED = "internal:cluster/shard/started"
@@ -78,13 +79,23 @@ RECOVERY_CHUNK_BYTES = 512 * 1024
 RECOVERY_SESSION_MAX_AGE_S = 600.0
 
 
+class FailedToCommitClusterStateException(ElasticsearchTpuException):
+    """The publish quorum was not reached; the master stepped down and
+    the state change is NOT committed (discovery/zen/publish —
+    FailedToCommitClusterStateException). Clients must treat the request
+    as failed."""
+
+    status_code = 503
+
+
 class ClusterNode:
     """One node of the in-process cluster (a real Node analog hosting only
     its allocated shards)."""
 
     def __init__(self, name: str, hub: TransportHub, master_eligible: bool = True,
                  data: bool = True, attrs: Optional[Dict[str, str]] = None,
-                 awareness_attributes: Optional[List[str]] = None):
+                 awareness_attributes: Optional[List[str]] = None,
+                 min_master_nodes: int = 1):
         self.name = name
         self.node_id = name  # stable, human-readable ids make tests clear
         self.master_eligible = master_eligible
@@ -117,6 +128,16 @@ class ClusterNode:
         self.routing: RoutingTable = {}
         self.known_nodes: List[str] = []
         self.master_id: Optional[str] = None
+        # discovery.zen.minimum_master_nodes: the election AND the publish
+        # commit both require this many master-eligible nodes (self
+        # included) — the split-brain guard (ElectMasterService
+        # .hasEnoughMasterNodes / PublishClusterStateAction commit quorum).
+        # The reference's default is 1 (unsafe by default, warned about);
+        # production clusters set (eligible // 2) + 1.
+        self.min_master_nodes = max(1, int(min_master_nodes))
+        # two-phase publish: follower-side buffered state awaiting commit
+        # keyed by (epoch, version) — dropped when superseded
+        self._pending_publish: Optional[dict] = None
         # local shards: (index, shard_id) -> IndexShard
         self.shards: Dict[Tuple[str, int], IndexShard] = {}
         self.mappers: Dict[str, MapperService] = {}
@@ -142,6 +163,7 @@ class ClusterNode:
     def _register_handlers(self) -> None:
         t = self.transport
         t.register_handler(ACTION_PUBLISH, self._on_publish)
+        t.register_handler(ACTION_COMMIT, self._on_commit)
         t.register_handler(ACTION_JOIN, self._on_join)
         t.register_handler(ACTION_SHARD_FAILED, self._on_shard_failed)
         t.register_handler(ACTION_SHARD_STARTED, self._on_shard_started)
@@ -252,6 +274,18 @@ class ClusterNode:
             except NodeNotConnectedException:
                 pass
             return []
+        with self._lock:
+            still_master = self.master_id == self.node_id
+        if still_master:
+            remaining = [n for n in peers if n not in departed]
+            if self._reachable_eligible(remaining) < self.min_master_nodes:
+                # the master lost its quorum (minority side of a
+                # partition): step down instead of continuing to accept
+                # writes that the majority side will fence
+                with self._lock:
+                    if self.master_id == self.node_id:
+                        self.master_id = None
+                return departed
         for node in departed:
             self.node_left(node)
         return departed
@@ -266,7 +300,8 @@ class ClusterNode:
 
     def _on_master_ping(self, payload, src) -> dict:
         return {"master": self.master_id, "is_master": self.is_master,
-                "version": self.state_version}
+                "version": self.state_version,
+                "epoch": self.cluster_epoch}
 
     def _master_eligible_nodes(self, exclude: Optional[str] = None):
         out = []
@@ -286,8 +321,30 @@ class ClusterNode:
         election. Returns the new master id if one was chosen, else None."""
         with self._lock:
             master = self.master_id
-            if master is None or master == self.node_id:
+            if master == self.node_id:
                 return None
+        if master is None:
+            # headless (stepped down after quorum loss): probe known
+            # peers for a live master to rejoin, else run an election —
+            # without this the node stays orphaned after the partition
+            # heals (the majority removed us; nobody publishes to us)
+            for peer in sorted(self.known_nodes):
+                if peer == self.node_id:
+                    continue
+                try:
+                    resp = self.transport.send_request(
+                        peer, ACTION_MASTER_PING, None) or {}
+                except NodeNotConnectedException:
+                    continue
+                claimed = resp.get("master") if not resp.get("is_master") \
+                    else peer
+                if claimed and resp.get("epoch", 0) >= self.cluster_epoch:
+                    try:
+                        self.join(claimed)
+                        return claimed
+                    except NodeNotConnectedException:
+                        continue
+            return self._handle_master_failure(None)
         try:
             resp = self.transport.send_request(master, ACTION_MASTER_PING,
                                                None)
@@ -321,18 +378,31 @@ class ClusterNode:
             candidates = self._master_eligible_nodes(exclude=dead)
         # walk candidates in election order, skipping unreachable ones
         # (a previously-dead node may still linger in known_nodes: it must
-        # not be "elected" just because its id sorts first)
+        # not be "elected" just because its id sorts first); count the
+        # reachable eligibles for the quorum check
+        reachable = []
+        winner = None
         for cand in candidates:
             if cand == self.node_id:
-                break
+                reachable.append(cand)
+                if winner is None:
+                    winner = cand
+                continue
             try:
                 self.transport.send_request(cand, ACTION_MASTER_PING, None)
-                break  # lowest REACHABLE eligible node
+                reachable.append(cand)
+                if winner is None:
+                    winner = cand
             except NodeNotConnectedException:
                 continue
-        else:
+        if winner is None:
             return None
-        new_master = cand
+        if len(reachable) < self.min_master_nodes:
+            # not enough master nodes (ElectMasterService
+            # .hasEnoughMasterNodes): refuse the election — a minority
+            # partition must stay headless rather than split-brain
+            return None
+        new_master = winner
         if new_master != self.node_id:
             # not the winner: adopt the deterministic result; the winner
             # converges through its own master fault detection tick and
@@ -440,14 +510,56 @@ class ClusterNode:
             action()
         self._publish_to_followers(state)
 
+    def _reachable_eligible(self, nodes) -> int:
+        """Count of master-eligible nodes among `nodes` (self included if
+        eligible) — the election/commit quorum input."""
+        count = 1 if self.master_eligible else 0
+        for n in nodes:
+            if n == self.node_id:
+                continue
+            info = self.node_info_map.get(n) or {}
+            if info.get("master_eligible", True):
+                count += 1
+        return count
+
     def _publish_to_followers(self, state: dict) -> None:
+        """Two-phase publish (PublishClusterStateAction): phase 1 sends
+        the state, followers BUFFER it; once master-eligible acks (self
+        included) reach minimum_master_nodes, phase 2 commits and
+        followers apply. Short of the quorum, the master steps down
+        (FailedToCommitClusterStateException -> rejoin) and the buffered
+        state dies unapplied on every follower."""
+        key = {"epoch": state["epoch"], "version": state["version"]}
+        acks = 1 if self.master_eligible else 0
+        reached = []
         for node in state["nodes"]:
             if node == self.node_id:
                 continue
             try:
-                self.transport.send_request(node, ACTION_PUBLISH, state)
+                resp = self.transport.send_request(node, ACTION_PUBLISH,
+                                                   state) or {}
+                if not resp.get("ok"):
+                    continue  # explicit rejection (stale epoch) != ack
+                reached.append(node)
+                info = self.node_info_map.get(node) or {}
+                if info.get("master_eligible", True):
+                    acks += 1
             except NodeNotConnectedException:
                 pass  # fault detection will remove it
+        if acks < self.min_master_nodes:
+            with self._lock:
+                if self.master_id == self.node_id:
+                    self.master_id = None  # stepped down; a quorum-backed
+                    # master (or a healed partition) re-converges us
+            raise FailedToCommitClusterStateException(
+                f"publish of cluster state [{state['version']}] reached "
+                f"{acks} of the required {self.min_master_nodes} "
+                f"master-eligible acks")
+        for node in reached:
+            try:
+                self.transport.send_request(node, ACTION_COMMIT, key)
+            except NodeNotConnectedException:
+                pass
 
     def _master_reroute_locked(self) -> Tuple[dict, list]:
         data_nodes = [n for n in self.known_nodes]  # all nodes are data nodes here
@@ -520,8 +632,30 @@ class ClusterNode:
             # the higher-epoch cluster and step down (check_nodes)
             return {"ok": True, "master": self.master_id,
                     "epoch": self.cluster_epoch}
-        self._apply_state(payload)
+        with self._lock:
+            if payload["epoch"] < self.cluster_epoch:
+                # a deposed master re-publishing from a stale epoch: the
+                # rejection must be VISIBLE in the ack so its commit
+                # quorum fails (not just swallowed at apply time)
+                return {"ok": False, "reason": "stale epoch",
+                        "epoch": self.cluster_epoch,
+                        "master": self.master_id}
+            pending = self._pending_publish
+            if pending is None or (
+                    (payload["epoch"], payload["version"])
+                    >= (pending["epoch"], pending["version"])):
+                self._pending_publish = payload
         return {"ok": True, "version": payload["version"]}
+
+    def _on_commit(self, payload, src) -> dict:
+        with self._lock:
+            pending = self._pending_publish
+            if pending is None or (pending["epoch"], pending["version"]) != (
+                    payload["epoch"], payload["version"]):
+                return {"ok": False}
+            self._pending_publish = None
+        self._apply_state(pending)
+        return {"ok": True}
 
     def _apply_state(self, state: dict) -> None:
         with self._lock:

@@ -16,6 +16,7 @@ hashing on the query path.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -67,7 +68,7 @@ def global_ordinals(segments: Sequence, field: str,
     with _cache_lock:
         hit = _cache.get(key)
         if hit is not None:
-            return hit
+            return hit[0]
     per_seg: List[Tuple[object, List[str]]] = []
     for i, seg in enumerate(segments):
         ocol = (columns[i] if columns is not None
@@ -86,8 +87,19 @@ def global_ordinals(segments: Sequence, field: str,
         else:
             seg_maps[id(seg)] = np.zeros(0, np.int32)
     built = GlobalOrdinals(field, all_terms, seg_maps)
+
+    def _evict(_ref, _key=key):
+        # a cached entry must die WITH its segments: the key embeds
+        # id(segment), and CPython reuses ids after free — a stale hit
+        # would fold counts through the wrong local->global map
+        with _cache_lock:
+            _cache.pop(_key, None)
+
+    # the weakrefs ride in the cache VALUE: they must stay alive for the
+    # eviction callback to ever fire
+    refs = [weakref.ref(seg, _evict) for seg in segments]
     with _cache_lock:
         if len(_cache) >= _CACHE_MAX:
             _cache.pop(next(iter(_cache)))
-        _cache[key] = built
+        _cache[key] = (built, refs)
     return built

@@ -83,17 +83,14 @@ class HttpServer:
         handler = type("BoundHandler", (_Handler,), {"controller": self.controller})
         self.server = ThreadingHTTPServer((host, port), handler)
         self.port = self.server.server_address[1]
-        # the sniffer reads this from /_nodes/http (publish_address);
-        # wildcard/empty binds publish a concrete routable address (a
-        # remote sniffer receiving 127.0.0.1 would redirect to itself)
-        publish_host = host
-        if host in ("", "0.0.0.0", "::"):
-            import socket
-
-            try:
-                publish_host = socket.gethostbyname(socket.gethostname())
-            except OSError:
-                publish_host = "127.0.0.1"
+        # the sniffer reads this from /_nodes/http (publish_address).
+        # Wildcard binds fall back to loopback: hostname resolution can
+        # yield 127.0.1.1 (Debian /etc/hosts) or stale-DNS addresses the
+        # machine doesn't own, which would poison a sniffing client's
+        # host list; multi-host deployments should bind a concrete
+        # address (http.publish_host in the reference)
+        publish_host = host if host not in ("", "0.0.0.0", "::") \
+            else "127.0.0.1"
         node.http_publish_address = f"{publish_host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 

@@ -214,3 +214,116 @@ class TestMasterFailover:
         hub.disconnect("n1")
         assert nodes["n2"].check_master() is None
         assert not nodes["n2"].is_master
+
+
+def quorum_cluster(names=("n1", "n2", "n3"), mmn=2):
+    hub = TransportHub(strict_serialization=True)
+    nodes = {}
+    for name in names:
+        nodes[name] = ClusterNode(name, hub, min_master_nodes=mmn)
+    nodes[names[0]].bootstrap_cluster()
+    for name in names[1:]:
+        nodes[name].join(names[0])
+    return hub, nodes
+
+
+class TestQuorum:
+    """discovery.zen.minimum_master_nodes: split-brain guard on election
+    AND publish commit (ElectMasterService.hasEnoughMasterNodes,
+    PublishClusterStateAction commit quorum)."""
+
+    def test_minority_partition_cannot_elect(self):
+        hub, nodes = quorum_cluster()
+        hub.disconnect("n3")  # n3 alone: 1 of 3 eligibles
+        assert nodes["n3"].check_master() is None
+        assert nodes["n3"].master_id in (None, "n1")  # never itself
+        assert not nodes["n3"].is_master
+
+    def test_majority_partition_elects(self):
+        hub, nodes = quorum_cluster()
+        hub.disconnect("n1")  # master isolated; n2+n3 = 2 >= quorum
+        winner = nodes["n2"].check_master()
+        assert winner == "n2"
+        assert nodes["n2"].is_master
+        # the new state committed on the majority side
+        assert nodes["n3"].check_master() in ("n2", None)
+        assert nodes["n3"].master_id == "n2"
+
+    def test_isolated_master_steps_down(self):
+        hub, nodes = quorum_cluster()
+        hub.disconnect("n1")
+        nodes["n1"].check_nodes()  # sees both peers gone -> quorum lost
+        assert not nodes["n1"].is_master
+        assert nodes["n1"].master_id is None
+
+    def test_publish_without_quorum_steps_down(self):
+        from elasticsearch_tpu.cluster.multinode import (
+            FailedToCommitClusterStateException,
+        )
+
+        hub, nodes = quorum_cluster()
+        hub.disconnect("n1")
+        # n1 still believes it is master and tries to mutate state: the
+        # commit quorum fails, the client SEES the failure (the reference
+        # throws FailedToCommitClusterStateException), and n1 steps down
+        with pytest.raises(FailedToCommitClusterStateException):
+            nodes["n1"].create_index(
+                "ghost", {"index": {"number_of_shards": 1,
+                                    "number_of_replicas": 0}})
+        assert not nodes["n1"].is_master
+        assert "ghost" not in nodes["n2"].indices_meta
+        assert "ghost" not in nodes["n3"].indices_meta
+
+    def test_headless_node_recovers_via_fd_tick(self):
+        hub, nodes = quorum_cluster()
+        hub.disconnect("n1")
+        nodes["n2"].check_master()   # majority elects n2
+        nodes["n1"].check_nodes()    # minority master steps down
+        assert nodes["n1"].master_id is None
+        hub.heal()
+        # the production FD tick path (check_master with no master) must
+        # rejoin without manual intervention
+        assert nodes["n1"].check_master() == "n2"
+        assert nodes["n1"].master_id == "n2"
+
+    def test_stale_epoch_publish_rejected_in_phase1(self):
+        from elasticsearch_tpu.cluster.multinode import ACTION_PUBLISH
+
+        hub, nodes = quorum_cluster()
+        hub.disconnect("n1")
+        nodes["n2"].check_master()  # epoch bumped on majority side
+        hub.heal()
+        stale = nodes["n1"]._state_dict()  # old epoch
+        resp = nodes["n1"].transport.send_request("n2", ACTION_PUBLISH, stale)
+        assert resp["ok"] is False and "stale" in resp["reason"]
+
+    def test_two_phase_follower_applies_only_on_commit(self):
+        from elasticsearch_tpu.cluster.multinode import (
+            ACTION_COMMIT,
+            ACTION_PUBLISH,
+        )
+
+        hub, nodes = quorum_cluster()
+        n1, n2 = nodes["n1"], nodes["n2"]
+        state = n1._state_dict()
+        state["version"] += 1
+        # phase 1: buffered, NOT applied
+        n1.transport.send_request("n2", ACTION_PUBLISH, state)
+        assert n2.state_version == state["version"] - 1
+        assert n2._pending_publish is not None
+        # phase 2: commit applies it
+        n1.transport.send_request("n2", ACTION_COMMIT, {
+            "epoch": state["epoch"], "version": state["version"]})
+        assert n2.state_version == state["version"]
+        assert n2._pending_publish is None
+
+    def test_healed_partition_reconverges(self):
+        hub, nodes = quorum_cluster()
+        hub.disconnect("n1")
+        nodes["n2"].check_master()   # majority elects n2
+        nodes["n1"].check_nodes()    # minority master steps down
+        hub.heal()
+        # deposed n1 notices the higher-epoch cluster on its next tick
+        nodes["n1"].join("n2")
+        assert nodes["n1"].master_id == "n2"
+        assert nodes["n1"].cluster_epoch == nodes["n2"].cluster_epoch
