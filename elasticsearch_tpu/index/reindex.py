@@ -16,6 +16,49 @@ from elasticsearch_tpu.common.errors import IllegalArgumentException
 DEFAULT_BATCH = 1000
 
 
+def _compile_byquery_script(body: dict):
+    """The reference's script hook on reindex/update_by_query
+    (AbstractAsyncBulkByScrollAction.buildScriptApplier): a painless
+    script mutating ctx._source, with ctx.op controlling per-doc fate
+    (index | noop | delete). Returns None when no script is given."""
+    spec = body.get("script")
+    if spec is None:
+        return None
+    from elasticsearch_tpu.script.expression import compile_script
+
+    script = compile_script(spec)
+    if not hasattr(script, "run"):
+        raise IllegalArgumentException(
+            "by-query scripts must be painless (ctx mutation)")
+    params = (spec.get("params") if isinstance(spec, dict) else None) or {}
+    return script, params
+
+
+def _apply_byquery_script(compiled, hit) -> str:
+    """Run the script against one hit; returns the resulting op.
+
+    The hit's _source is DEEP-copied first: _scan_batches hands out the
+    segment's live stored-source dicts, and a script mutating a nested
+    object (then nooping) must never alter data that was never written
+    back through the engine. ctx._id/_index rewrites (reindex routing
+    scripts) propagate to the hit."""
+    import copy
+
+    from elasticsearch_tpu.script.painless import ScriptException
+
+    script, params = compiled
+    ctx = {"_source": copy.deepcopy(hit["_source"]),
+           "_index": hit["_index"], "_id": hit["_id"], "op": "index"}
+    script.run({"ctx": ctx, "params": dict(params)})
+    op = ctx.get("op", "index")
+    if op not in ("index", "none", "noop", "delete", "create"):
+        raise ScriptException(f"Operation type [{op}] not allowed")
+    hit["_source"] = ctx["_source"]
+    hit["_index"] = ctx.get("_index", hit["_index"])
+    hit["_id"] = str(ctx.get("_id", hit["_id"]))
+    return "none" if op == "noop" else op
+
+
 def _scan_batches(node, index_expr: str, query: Optional[dict], batch_size: int):
     """Yield batches of hits by walking shards/segments directly — the
     exact-cursor equivalent of the reference's _doc-ordered scroll (a
@@ -60,36 +103,60 @@ def reindex(node, body: dict) -> dict:
     max_docs = body.get("max_docs") or body.get("size")
     op_type = dest.get("op_type", "index")
     pipeline = dest.get("pipeline")
+    compiled = _compile_byquery_script(body)
     task = node.tasks.register("indices:data/write/reindex",
                                f"reindex from [{src_index}] to [{dst_index}]")
-    created = updated = total = 0
+    created = updated = total = noops = deleted = 0
     failures = []
     try:
         for hits in _scan_batches(node, src_index, source.get("query"), batch_size):
             task.ensure_not_cancelled()
             ops = []
+            reached_max = False
             for h in hits:
                 if max_docs is not None and total >= int(max_docs):
+                    reached_max = True
                     break
                 total += 1
+                dest_for_doc = dst_index
+                if compiled is not None:
+                    op = _apply_byquery_script(compiled, h)
+                    if op == "none":
+                        noops += 1
+                        continue
+                    if op == "delete":
+                        # ctx.op = 'delete' removes the doc from the DEST
+                        # index (the reference's reindex delete semantics)
+                        try:
+                            r = node.delete_doc(dst_index, h["_id"])
+                            if r.get("found", True):
+                                deleted += 1
+                        except Exception:  # noqa: BLE001 — absent in dest
+                            pass
+                        continue
+                    # scripts may rewrite ctx._index for per-doc routing
+                    if h["_index"] != src_index:
+                        dest_for_doc = h["_index"]
                 ops.append((
                     "create" if op_type == "create" else "index",
-                    {"_index": dst_index, "_id": h["_id"], "pipeline": pipeline},
+                    {"_index": dest_for_doc, "_id": h["_id"],
+                     "pipeline": pipeline},
                     h["_source"],
                 ))
-            if not ops:
-                break
-            resp = node.bulk(ops)
-            for item in resp["items"]:
-                r = next(iter(item.values()))
-                if "error" in r:
-                    failures.append(r["error"])
-                elif r.get("result") == "created":
-                    created += 1
-                else:
-                    updated += 1
-            task.status = {"total": total, "created": created, "updated": updated}
-            if max_docs is not None and total >= int(max_docs):
+            if ops:
+                resp = node.bulk(ops)
+                for item in resp["items"]:
+                    r = next(iter(item.values()))
+                    if "error" in r:
+                        failures.append(r["error"])
+                    elif r.get("result") == "created":
+                        created += 1
+                    else:
+                        updated += 1
+            task.status = {"total": total, "created": created,
+                           "updated": updated, "noops": noops,
+                           "deleted": deleted}
+            if reached_max:
                 break
     finally:
         node.tasks.unregister(task)
@@ -101,33 +168,49 @@ def reindex(node, body: dict) -> dict:
         "total": total,
         "created": created,
         "updated": updated,
-        "deleted": 0,
+        "deleted": deleted,
         "batches": -(-total // batch_size) if total else 0,
         "version_conflicts": 0,
-        "noops": 0,
+        "noops": noops,
         "retries": {"bulk": 0, "search": 0},
         "failures": failures,
     }
 
 
 def update_by_query(node, index_expr: str, body: Optional[dict]) -> dict:
-    """Re-indexes matching docs in place (no script support yet: the
-    reference's script hook maps to ingest-style mutations via `script`
-    param in later rounds; a bare update_by_query refreshes mappings)."""
+    """Re-indexes matching docs in place; with a painless ``script`` each
+    doc's ctx._source is transformed and ctx.op may turn the update into
+    a noop or a delete (UpdateByQueryRequest + buildScriptApplier)."""
     t0 = time.monotonic()
     body = body or {}
-    updated = total = 0
+    compiled = _compile_byquery_script(body)
+    updated = total = noops = deleted = 0
     task = node.tasks.register("indices:data/write/update/byquery",
                                f"update-by-query [{index_expr}]")
     try:
         for hits in _scan_batches(node, index_expr, body.get("query"), DEFAULT_BATCH):
             task.ensure_not_cancelled()
-            ops = [("index", {"_index": h["_index"], "_id": h["_id"]}, h["_source"])
-                   for h in hits]
-            total += len(ops)
-            resp = node.bulk(ops)
-            updated += sum(1 for i in resp["items"] if "error" not in next(iter(i.values())))
-            task.status = {"total": total, "updated": updated}
+            ops = []
+            for h in hits:
+                total += 1
+                if compiled is not None:
+                    op = _apply_byquery_script(compiled, h)
+                    if op == "none":
+                        noops += 1
+                        continue
+                    if op == "delete":
+                        r = node.delete_doc(h["_index"], h["_id"])
+                        if r.get("found", True):
+                            deleted += 1
+                        continue
+                ops.append(("index", {"_index": h["_index"], "_id": h["_id"]},
+                            h["_source"]))
+            if ops:
+                resp = node.bulk(ops)
+                updated += sum(1 for i in resp["items"]
+                               if "error" not in next(iter(i.values())))
+            task.status = {"total": total, "updated": updated,
+                           "noops": noops, "deleted": deleted}
     finally:
         node.tasks.unregister(task)
     for name in node.cluster_service.state.resolve_index_names(index_expr):
@@ -137,9 +220,9 @@ def update_by_query(node, index_expr: str, body: Optional[dict]) -> dict:
         "timed_out": False,
         "total": total,
         "updated": updated,
-        "deleted": 0,
+        "deleted": deleted,
         "version_conflicts": 0,
-        "noops": 0,
+        "noops": noops,
         "failures": [],
     }
 

@@ -229,3 +229,105 @@ class TestUpdateScriptHelper:
         with pytest.raises(ScriptException, match="not allowed"):
             execute_update_script(
                 PainlessScript("ctx.op = 'explode'"), {"a": 1})
+
+
+class TestByQueryScripts:
+    @pytest.fixture()
+    def node(self):
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from elasticsearch_tpu.node import Node
+
+        n = Node()
+        n.create_index("src", {"mappings": {"properties": {
+            "n": {"type": "integer"}, "kind": {"type": "keyword"}}}})
+        for i in range(6):
+            n.index_doc("src", str(i), {"n": i, "kind": "even" if i % 2 == 0
+                                        else "odd"})
+        n.indices["src"].refresh()
+        yield n
+        n.close()
+
+    def test_update_by_query_with_script(self, node):
+        r = node.indices["src"]  # noqa: F841 — warm reference
+        from elasticsearch_tpu.index.reindex import update_by_query
+
+        out = update_by_query(node, "src", {
+            "query": {"term": {"kind": "odd"}},
+            "script": {"source": "ctx._source.n += params.by",
+                       "params": {"by": 100}}})
+        assert out["updated"] == 3 and out["noops"] == 0
+        assert node.get_doc("src", "1")["_source"]["n"] == 101
+        assert node.get_doc("src", "0")["_source"]["n"] == 0  # untouched
+
+    def test_update_by_query_ctx_op(self, node):
+        from elasticsearch_tpu.index.reindex import update_by_query
+
+        out = update_by_query(node, "src", {"script": {"source": """
+            if (ctx._source.n == 0) { ctx.op = 'delete' }
+            else if (ctx._source.kind == 'odd') { ctx.op = 'none' }
+            else { ctx._source.touched = true }
+        """}})
+        assert out["deleted"] == 1
+        assert out["noops"] == 3
+        assert out["updated"] == 2
+        assert not node.get_doc("src", "0")["found"]
+        assert node.get_doc("src", "2")["_source"]["touched"] is True
+
+    def test_reindex_with_script(self, node):
+        from elasticsearch_tpu.index.reindex import reindex
+
+        out = reindex(node, {
+            "source": {"index": "src"},
+            "dest": {"index": "dst"},
+            "script": {"source": "if (ctx._source.kind == 'odd') "
+                                 "{ ctx.op = 'none' } "
+                                 "else { ctx._source.copied = true }"}})
+        assert out["created"] == 3
+        node.indices["dst"].refresh()
+        r = node.search("dst", {"query": {"match_all": {}}, "size": 10})
+        assert r["hits"]["total"] == 3
+        assert all(h["_source"]["copied"] is True for h in r["hits"]["hits"])
+
+    def test_reindex_script_counts_and_multibatch(self, node):
+        """A batch whose docs ALL noop must not end the scan, and
+        noops/deleted must be reported (total == created+updated+noops
+        +deleted)."""
+        from elasticsearch_tpu.index.reindex import reindex
+
+        out = reindex(node, {
+            "source": {"index": "src", "size": 2},  # 3 batches of 2
+            "dest": {"index": "dst2"},
+            "script": {"source": "if (ctx._source.n < 4) "
+                                 "{ ctx.op = 'noop' }"}})
+        # docs 0-3 noop (incl. the ENTIRE first two batches); 4,5 copy
+        assert out["noops"] == 4
+        assert out["created"] == 2
+        assert out["total"] == 6
+        assert out["total"] == (out["created"] + out["updated"]
+                                + out["noops"] + out["deleted"])
+
+    def test_reindex_script_id_rewrite(self, node):
+        from elasticsearch_tpu.index.reindex import reindex
+
+        reindex(node, {
+            "source": {"index": "src"},
+            "dest": {"index": "dst3"},
+            "script": {"source": "ctx._id = ctx._id + '-v2'"}})
+        node.indices["dst3"].refresh()
+        assert node.get_doc("dst3", "0-v2")["found"]
+        assert not node.get_doc("dst3", "0")["found"]
+
+    def test_script_noop_does_not_corrupt_source(self, node):
+        """A script mutating a NESTED object then nooping must not alter
+        the stored source (deep-copy contract)."""
+        from elasticsearch_tpu.index.reindex import update_by_query
+
+        node.index_doc("src", "nested", {"n": 50, "meta": {"flag": False}})
+        node.indices["src"].refresh()
+        update_by_query(node, "src", {
+            "query": {"term": {"n": 50}},
+            "script": {"source": "ctx._source.meta.flag = true; "
+                                 "ctx.op = 'none'"}})
+        assert node.get_doc("src", "nested")["_source"]["meta"]["flag"] \
+            is False
