@@ -1420,9 +1420,18 @@ class ClusterClient:
                 max_score = (resp["max_score"] if max_score is None
                              else max(max_score, resp["max_score"]))
             all_hits.extend(resp["hits"])
-        sort_present = body.get("sort") is not None
-        if sort_present:
-            all_hits.sort(key=lambda h: tuple(h.get("_sort_tuple", [])))
+        from elasticsearch_tpu.search.service import (
+            multi_pass_sort,
+            normalize_sort,
+        )
+
+        # normalize_sort collapses a lone _score sort to None: that (and
+        # no sort at all) ranks by score descending
+        spec = (normalize_sort(body.get("sort"))
+                if body.get("sort") is not None else None)
+        if spec:
+            multi_pass_sort(all_hits, spec,
+                            lambda h: tuple(h.get("_sort_tuple", ())))
         else:
             all_hits.sort(key=lambda h: -(h.get("_score") or 0.0))
         for h in all_hits:

@@ -322,7 +322,7 @@ class ShardSearcher:
         keys, all_key_arrays = self._sort_keys(seg, scores, sort_spec)
         out = [DocRef(self.shard_id, seg.name, int(d), float(scores[d]),
                       tuple(arr[d] for arr in all_key_arrays)) for d in idx]
-        out.sort(key=lambda r: _ref_sort_key(r, sort_spec))
+        sort_refs(out, sort_spec)
         return out
 
     def _select(self, seg, scores, matched, sort_spec, search_after, k,
@@ -367,7 +367,7 @@ class ShardSearcher:
         for (d,) in cand:
             sv = tuple(arr[d] for arr in all_key_arrays)
             out.append(DocRef(self.shard_id, seg.name, d, float(scores[d]), sv))
-        out.sort(key=lambda r: _ref_sort_key(r, sort_spec))
+        sort_refs(out, sort_spec)
         return out[:k]
 
     def _sort_keys(self, seg, scores, sort_spec):
@@ -397,12 +397,66 @@ class ShardSearcher:
                     ocol = seg.ordinal_columns.get(field_name) or seg.ordinal_columns.get(
                         f"{field_name}.keyword"
                     )
-                    if ocol is None:
+                    ft = self.mapper_service.field_type(field_name)
+                    string_typed = (ocol is not None or (
+                        ft is not None
+                        and getattr(ft, "ordinal_doc_values", False)))
+                    if not string_typed:
+                        # numeric/unmapped: float fill (custom missing must
+                        # be a number here)
                         fill = _missing_fill(missing, order)
                         raw = np.full(seg.nd_pad, fill, dtype=np.float64)
+                    elif ocol is None:
+                        # keyword-typed field with NO column in this
+                        # segment: every doc is missing — values must stay
+                        # STRINGS so the cross-segment merge never mixes
+                        # floats into a string sort
+                        sfill = _missing_fill_str(missing, order)
+                        raw = np.full(seg.nd_pad, sfill, dtype=object)
+                        fillf = (np.inf if sfill == _STR_SENTINEL_HIGH
+                                 else -np.inf)
+                        key = fillf if order == "desc" else -fillf
+                        oriented.append(np.full(
+                            seg.nd_pad, float(np.clip(key, -1e300, 1e300))))
+                        raw_arrays.append(raw)
+                        continue
                     else:
-                        fill = _missing_fill(missing, order)
-                        raw = np.where(ocol.exists, ocol.first_ord.astype(np.float64), fill)
+                        # ordinals order the SELECTION within this segment
+                        # (local ord order == string order), but the merge
+                        # across segments/shards must compare the STRINGS:
+                        # ordinal spaces are per-segment, so an ordinal
+                        # sort value from one segment is meaningless next
+                        # to another's (the global-ordinals problem).
+                        # A custom string `missing` ranks at its bisect
+                        # position between ordinals (exactly where the
+                        # string sorts).
+                        if missing in (None, "_last", "_first"):
+                            fill = _missing_fill(missing, order)
+                        else:
+                            import bisect as _bisect
+
+                            pos = _bisect.bisect_left(ocol.terms,
+                                                      str(missing))
+                            fill = pos - 0.5
+                        ord_key = np.where(
+                            ocol.exists, ocol.first_ord.astype(np.float64),
+                            fill)
+                        sfill = _missing_fill_str(missing, order)
+                        cache_key = (f"sortstr.{field_name}.{order}."
+                                     f"{missing!r}")
+                        raw = seg.dev_cache.get(cache_key)
+                        if raw is None:
+                            terms_arr = np.asarray(ocol.terms + [sfill],
+                                                   dtype=object)
+                            raw = terms_arr[np.where(
+                                ocol.exists, ocol.first_ord,
+                                len(ocol.terms))]
+                            seg.dev_cache[cache_key] = raw
+                        raw_arrays.append(raw)
+                        oriented.append(np.clip(
+                            ord_key if order == "desc" else -ord_key,
+                            -1e300, 1e300))
+                        continue
             raw_arrays.append(raw)
             # clamp ±inf (missing-value fills) to large finite sentinels:
             # -inf in the oriented key is reserved for "not matched", and a
@@ -486,6 +540,14 @@ def P_select_topk(scores, matched, k):
     return select_topk(jnp.asarray(scores), jnp.asarray(matched), live1, int(k))
 
 
+def _sort_value_out(v):
+    """Sort value -> response form: missing fills (inf floats / string
+    sentinels) render as null."""
+    if isinstance(v, str):
+        return None if v in (_STR_SENTINEL_HIGH, _STR_SENTINEL_LOW) else v
+    return v if not np.isinf(v) else None
+
+
 def _missing_fill(missing, order) -> float:
     if missing in (None, "_last"):
         return -np.inf if order == "desc" else np.inf
@@ -494,13 +556,52 @@ def _missing_fill(missing, order) -> float:
     return float(missing)
 
 
-def _ref_sort_key(ref: DocRef, sort_spec) -> Tuple:
-    out = []
-    for value, (fname, order, _) in zip(ref.sort_values, sort_spec):
-        v = value
-        out.append(-v if order == "desc" else v)
-    out.append(ref.local_doc)
-    return tuple(out)
+# string-sort missing sentinels: HIGH sorts after every practical term,
+# LOW (a NUL) before; both render as null in sort-value output
+_STR_SENTINEL_HIGH = "\U0010ffff\U0010ffff\U0010ffff\U0010ffff"
+_STR_SENTINEL_LOW = "\x00"
+
+
+def _missing_fill_str(missing, order) -> str:
+    if missing in (None, "_last"):
+        # "_last" = end of the RESULT order: largest for asc, smallest
+        # for desc
+        return _STR_SENTINEL_HIGH if order == "asc" else _STR_SENTINEL_LOW
+    if missing == "_first":
+        return _STR_SENTINEL_LOW if order == "asc" else _STR_SENTINEL_HIGH
+    return str(missing)
+
+
+def multi_pass_sort(items, sort_spec, values_of, tiebreak=None):
+    """Stable multi-pass sort over per-field sort values.
+
+    Strings can't be negated for desc the way floats can (and per-
+    segment ORDINALS must never be merge keys — spaces differ), so
+    instead of one composite key the list is sorted once per field from
+    the least-significant up, relying on sort stability — every pass
+    keeps O(n) key extraction. A tiebreak key, when given, runs first
+    (least significant). Mixed value types within one field (keyword in
+    one index, numeric/unmapped in another) are a request error, as in
+    the reference."""
+    if tiebreak is not None:
+        items.sort(key=tiebreak)
+    try:
+        for i in reversed(range(len(sort_spec))):
+            _f, order, _m = sort_spec[i]
+            items.sort(key=lambda x, i=i: values_of(x)[i],
+                       reverse=order == "desc")
+    except TypeError:
+        raise IllegalArgumentException(
+            "can't sort across indices mapping the sort field to "
+            "different types (string vs numeric)") from None
+
+
+def sort_refs(refs: List[DocRef], sort_spec,
+              with_shard: bool = False) -> None:
+    multi_pass_sort(
+        refs, sort_spec, lambda r: r.sort_values,
+        tiebreak=(lambda r: (r.shard_id, r.local_doc)) if with_shard
+        else (lambda r: r.local_doc))
 
 
 def _search_after_mask(key_arrays, sort_spec, after_values) -> np.ndarray:
@@ -508,10 +609,19 @@ def _search_after_mask(key_arrays, sort_spec, after_values) -> np.ndarray:
     n = key_arrays[0].shape[0]
     gt = np.zeros(n, dtype=bool)
     eq = np.ones(n, dtype=bool)
-    for arr, (fname, order, _), after in zip(key_arrays, sort_spec, after_values):
+    for arr, (fname, order, missing), after in zip(key_arrays, sort_spec,
+                                                   after_values):
         # a null cursor value is a missing-value doc's sort key (fetch
-        # serializes the inf fill as null): map back to the fill
-        a = (np.inf if order == "asc" else -np.inf) if after is None else float(after)
+        # serializes the fill as null): map back to the fill
+        if arr.dtype == object:  # keyword sort: string comparisons
+            a = (_missing_fill_str(missing, order) if after is None
+                 else str(after))
+        else:
+            # _geo_distance entries carry the geo spec dict in the missing
+            # slot; their missing-value fill is always +inf (sorts last)
+            m = None if isinstance(missing, dict) else missing
+            a = (_missing_fill(m, order)
+                 if after is None else float(after))
         if order == "desc":
             gt |= eq & (arr < a)
         else:
@@ -694,7 +804,7 @@ def merge_refs(refs: List[DocRef], sort_spec, k: int) -> List[DocRef]:
     if sort_spec is None:
         refs.sort(key=lambda r: (-r.score, r.shard_id, r.local_doc))
     else:
-        refs.sort(key=lambda r: _ref_sort_key(r, sort_spec) + (r.shard_id,))
+        sort_refs(refs, sort_spec, with_shard=True)
     return refs[:k]
 
 
@@ -1045,9 +1155,7 @@ def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
                     val = script.execute(dv, sparams, ref.score or 0.0)
                 fields_out[fname] = [val]
         if sort_spec is not None:
-            hit["sort"] = [
-                v if not np.isinf(v) else None for v in ref.sort_values
-            ]
+            hit["sort"] = [_sort_value_out(v) for v in ref.sort_values]
         if highlight_body:
             if not query_terms:
                 qb = parse_query(source_body.get("query"))
