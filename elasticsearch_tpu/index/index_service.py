@@ -98,6 +98,10 @@ class IndexService:
 
         self._request_cache_enabled = settings.get_bool(
             "index.requests.cache.enable", True)
+        # stats counters (IndexingStats/GetStats/RefreshStats/FlushStats)
+        self._get_total = 0
+        self._refresh_total = 0
+        self._flush_total = 0
         cache_bytes = settings.get_int(
             "index.requests.cache.size_in_bytes", 8 * 1024 * 1024)
         self.request_cache = RequestCache(max_bytes=cache_bytes)
@@ -174,6 +178,7 @@ class IndexService:
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None,
                 realtime: bool = True):
+        self._get_total += 1
         shard = self.shards[self._route(doc_id, routing)]
         return shard.get_doc(doc_id, realtime=realtime)
 
@@ -237,10 +242,12 @@ class IndexService:
         return self.index_doc(doc_id, new_source, routing)
 
     def refresh(self) -> None:
+        self._refresh_total += 1
         for shard in self.shards.values():
             shard.refresh()
 
     def flush(self) -> None:
+        self._flush_total += 1
         for shard in self.shards.values():
             shard.flush()
 
@@ -435,28 +442,82 @@ class IndexService:
         return sum(s.num_docs for s in self.shards.values())
 
     def stats(self) -> dict:
+        """Full CommonStats section set (action/admin/indices/stats) —
+        every section present so metric filtering can subset; untracked
+        counters report zero rather than omitting the section."""
         shard_stats = {sid: s.stats() for sid, s in self.shards.items()}
+        index_total = sum(s["indexing"]["index_total"]
+                          for s in shard_stats.values())
+        delete_total = sum(s["indexing"]["delete_total"]
+                           for s in shard_stats.values())
+        mem_bytes = sum(s["segments"]["memory_in_bytes"]
+                        for s in shard_stats.values())
+        groups: Dict[str, dict] = {}
+        for s in shard_stats.values():
+            for g, gs in (s["search"].get("groups") or {}).items():
+                agg = groups.setdefault(g, {k: 0 for k in gs})
+                for k, v in gs.items():
+                    agg[k] += v
+        fielddata_bytes = sum(
+            sum(seg.breaker_charges.values())
+            for sh in self.shards.values()
+            for seg in sh.engine.searchable_segments())
+        search = {
+            "open_contexts": 0,
+            "query_total": sum(s["search"]["query_total"]
+                               for s in shard_stats.values()),
+            "query_time_in_millis": sum(s["search"]["query_time_in_millis"]
+                                        for s in shard_stats.values()),
+            "fetch_total": sum(s["search"].get("fetch_total", 0)
+                               for s in shard_stats.values()),
+        }
+        if groups:
+            search["groups"] = groups
         totals = {
-            "docs": {"count": self.num_docs},
+            "docs": {"count": self.num_docs, "deleted": 0},
+            "store": {"size_in_bytes": mem_bytes,
+                      "throttle_time_in_millis": 0},
             "indexing": {
-                "index_total": sum(s["indexing"]["index_total"] for s in shard_stats.values()),
-                "delete_total": sum(s["indexing"]["delete_total"] for s in shard_stats.values()),
+                "index_total": index_total,
+                "index_time_in_millis": 0,
+                "delete_total": delete_total,
+                "index_failed": 0,
+                "types": {self.doc_type or "_doc": {
+                    "index_total": index_total,
+                    "index_time_in_millis": 0,
+                    "delete_total": delete_total,
+                }},
             },
-            "search": {
-                "query_total": sum(s["search"]["query_total"] for s in shard_stats.values()),
-                "query_time_in_millis": sum(
-                    s["search"]["query_time_in_millis"] for s in shard_stats.values()
-                ),
-            },
+            "get": {"total": self._get_total, "time_in_millis": 0,
+                    "exists_total": 0, "missing_total": 0, "current": 0},
+            "search": search,
+            "merges": {"current": 0, "current_docs": 0, "total": 0,
+                       "total_time_in_millis": 0, "total_docs": 0},
+            "refresh": {"total": self._refresh_total,
+                        "total_time_in_millis": 0, "listeners": 0},
+            "flush": {"total": self._flush_total,
+                      "total_time_in_millis": 0},
+            "warmer": {"current": 0, "total": 0, "total_time_in_millis": 0},
+            "query_cache": {"memory_size_in_bytes": 0, "total_count": 0,
+                            "hit_count": 0, "miss_count": 0,
+                            "cache_count": 0, "evictions": 0},
+            "fielddata": {"memory_size_in_bytes": fielddata_bytes,
+                          "evictions": 0},
+            "completion": {"size_in_bytes": 0},
             "segments": {
-                "count": sum(s["segments"]["count"] for s in shard_stats.values()),
-                "memory_in_bytes": sum(
-                    s["segments"]["memory_in_bytes"] for s in shard_stats.values()
-                ),
+                "count": sum(s["segments"]["count"]
+                             for s in shard_stats.values()),
+                "memory_in_bytes": mem_bytes,
             },
             "translog": {
-                "operations": sum(s["translog"]["operations"] for s in shard_stats.values()),
+                "operations": sum(s["translog"]["operations"]
+                                  for s in shard_stats.values()),
+                "size_in_bytes": sum(
+                    s["translog"].get("size_in_bytes", 0)
+                    for s in shard_stats.values()),
             },
+            "recovery": {"current_as_source": 0, "current_as_target": 0,
+                         "throttle_time_in_millis": 0},
             "request_cache": self.request_cache.stats(),
         }
         return {"primaries": totals, "total": totals, "shards": shard_stats}

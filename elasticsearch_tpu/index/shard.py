@@ -228,11 +228,27 @@ class IndexShard:
             "query_time_in_millis": int(self.searcher.query_time * 1000),
             "fetch_total": self.searcher.fetch_total,
         }
+        if self.searcher.group_stats:
+            s["search"]["groups"] = {
+                g: dict(v) for g, v in self.searcher.group_stats.items()}
         s["routing"] = {
             "state": self.state,
             "primary": self.primary,
         }
         s["seq_no"] = self.seq_no_stats()
+        import base64 as _b64
+
+        # Lucene commit identity (SegmentInfos.getId analog): stable per
+        # (shard, committed generation)
+        gen = s.get("translog", {}).get("generation", 0)
+        cid = _b64.b64encode(
+            f"{self.index_name}/{self.shard_id}/{gen}".encode()).decode()
+        s["commit"] = {
+            "id": cid,
+            "generation": gen,
+            "user_data": {},
+            "num_docs": s.get("docs", {}).get("count", 0),
+        }
         return s
 
     def close(self) -> None:

@@ -384,6 +384,16 @@ class IndexMeshSearch:
         scores = np.asarray(scores)
         raws = np.asarray(raws)
         self.query_total += 1
+        # per-shard search stats stay attributed even though the mesh
+        # executes all shards as one program (SearchStats semantics)
+        for sid in self.svc.shards:
+            searcher = self.svc.shards[sid].searcher
+            searcher.query_total += 1
+            for g in body.get("stats") or []:
+                gs = searcher.group_stats.setdefault(str(g), {
+                    "query_total": 0, "query_time_in_millis": 0,
+                    "fetch_total": 0, "fetch_time_in_millis": 0})
+                gs["query_total"] += 1
         refs = []
         max_score = None
         for i, (key, slot, d) in enumerate(zip(keys, np.asarray(slots),

@@ -130,6 +130,8 @@ def register_all(c) -> None:
     r("POST", "/_forcemerge", _forcemerge)
     r("GET", "/{index}/_stats", _index_stats)
     r("GET", "/_stats", _index_stats)
+    r("GET", "/{index}/_stats/{metric}", _index_stats)
+    r("GET", "/_stats/{metric}", _index_stats)
     r("GET", "/{index}/_segments", _segments)
     r("GET", "/_segments", _segments)
     r("PUT", "/{index}/_mapping", _put_mapping)
@@ -926,14 +928,147 @@ def _forcemerge(node, req):
     return 200, {"_shards": {"total": n, "successful": n, "failed": 0}}
 
 
+_STATS_METRICS = {
+    "docs": "docs", "store": "store", "indexing": "indexing", "get": "get",
+    "search": "search", "merge": "merges", "refresh": "refresh",
+    "flush": "flush", "warmer": "warmer", "query_cache": "query_cache",
+    "fielddata": "fielddata", "completion": "completion",
+    "segments": "segments", "translog": "translog", "recovery": "recovery",
+    "request_cache": "request_cache", "suggest": "search",
+}
+
+
+def _filter_named(entries, param):
+    """groups=/types= filtering: comma lists, _all, and * wildcards
+    (the reference's CommonStatsFlags groups/types patterns)."""
+    import fnmatch
+
+    if not param or not entries:
+        return None
+    wanted = param if isinstance(param, list) else str(param).split(",")
+    if "_all" in wanted:
+        return dict(entries)
+    return {k: v for k, v in entries.items()
+            if any(fnmatch.fnmatchcase(k, w) for w in wanted if w)}
+
+
+def _sum_stats(dicts):
+    """Element-wise numeric merge of section dicts (nested)."""
+    out = {}
+    for d in dicts:
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out[k] = _sum_stats([out.get(k, {}), v])
+            elif isinstance(v, (int, float)):
+                out[k] = out.get(k, 0) + v
+            else:
+                out[k] = v
+    return out
+
+
 def _index_stats(node, req):
-    names = node.cluster_service.state.resolve_index_names(req.param("index", "_all"))
-    indices = {name: node.indices[name].stats() for name in names
-               if name in node.indices}
-    totals = {
-        "docs": {"count": sum(s["total"]["docs"]["count"] for s in indices.values())},
+    names = node.cluster_service.state.resolve_index_names(
+        req.param("index", "_all"))
+    metric_param = req.param("metric")
+    sections = None
+    if metric_param and metric_param != "_all":
+        parts = (metric_param if isinstance(metric_param, list)
+                 else str(metric_param).split(","))
+        sections = set()
+        for m in parts:
+            if not m or m == "_all":
+                sections = None
+                break
+            if m not in _STATS_METRICS:
+                import difflib
+
+                near = difflib.get_close_matches(m, _STATS_METRICS, n=3)
+                hint = (" -> did you mean " + (
+                    f"[{near[0]}]" if len(near) == 1
+                    else "any of [" + ", ".join(near) + "]") + "?") \
+                    if near else ""
+                raise IllegalArgumentException(
+                    f"request [{req.path}] contains unrecognized metric: "
+                    f"[{m}]{hint}")
+            sections.add(_STATS_METRICS[m])
+    level = req.param("level", "indices")
+    if level not in ("cluster", "indices", "shards"):
+        raise IllegalArgumentException(
+            f"level parameter must be one of [cluster] or [indices] or "
+            f"[shards] but was [{level}]")
+    groups_param = req.param("groups")
+    types_param = req.param("types")
+
+    def shape(stats_pair):
+        """Apply metric/groups/types filters to a {primaries,total} pair."""
+        out = {}
+        for side in ("primaries", "total"):
+            src_side = stats_pair[side]
+            side_out = {}
+            for key, val in src_side.items():
+                if sections is not None and key not in sections:
+                    continue
+                val = dict(val) if isinstance(val, dict) else val
+                if key == "search" and isinstance(val, dict):
+                    g = val.pop("groups", None)
+                    kept = _filter_named(g, groups_param)
+                    if kept:
+                        val["groups"] = kept
+                if key == "indexing" and isinstance(val, dict):
+                    t = val.pop("types", None)
+                    kept = _filter_named(t, types_param)
+                    if kept:
+                        val["types"] = kept
+                side_out[key] = val
+            out[side] = side_out
+        return out
+
+    state = node.cluster_service.state
+    indices = {}
+    shards_total = shards_ok = 0
+    for name in names:
+        if name not in node.indices:
+            continue
+        md = state.indices.get(name)
+        replicas = md.num_replicas if md is not None else 0
+        svc = node.indices[name]
+        # the reference's stats header counts ALL copies in `total`
+        # (including unassigned replicas: rest-api-spec
+        # indices.stats/10_index.yml expects 18 for 9 primaries + 9
+        # unassigned replicas with successful 9) — total here is NOT
+        # successful + failed
+        shards_total += svc.num_shards * (1 + replicas)
+        shards_ok += svc.num_shards
+        raw = svc.stats()
+        if req.bool_param("include_segment_file_sizes"):
+            for side in ("primaries", "total"):
+                seg = raw[side].get("segments")
+                if seg is not None:
+                    seg["file_sizes"] = {"postings": {
+                        "size_in_bytes": seg.get("memory_in_bytes", 0),
+                        "description": "block-packed postings arrays"}}
+        entry = shape(raw)
+        if level == "shards":
+            def shard_entry(s):
+                out = {k: v for k, v in s.items()
+                       if sections is None or k in sections
+                       or k in ("routing", "commit", "seq_no")}
+                return out
+            entry["shards"] = {str(sid): [shard_entry(s)]
+                               for sid, s in raw["shards"].items()}
+        indices[name] = entry
+    all_stats = {
+        "primaries": _sum_stats([i["primaries"] for i in indices.values()]),
+        "total": _sum_stats([i["total"] for i in indices.values()]),
     }
-    return 200, {"_all": {"total": totals}, "indices": indices}
+    resp = {
+        "_shards": {"total": shards_total, "successful": shards_ok,
+                    "failed": 0},
+        "_all": all_stats,
+    }
+    if level != "cluster":
+        resp["indices"] = indices
+    return 200, resp
 
 
 def _segments(node, req):

@@ -97,6 +97,9 @@ class ShardSearcher:
         self.query_total = 0
         self.query_time = 0.0
         self.fetch_total = 0
+        # per-group search stats ("stats": ["grp"] in request bodies —
+        # index/search/stats/SearchStats groupStats)
+        self.group_stats: Dict[str, dict] = {}
         # search slow log (index/SearchSlowLog.java): per-shard thresholds;
         # negative = disabled (the "-1" sentinel)
         self.slowlog_warn_s = (
@@ -126,6 +129,11 @@ class ShardSearcher:
         t0 = time.monotonic()
         self.query_total += 1
         source = source or {}
+        for g in source.get("stats") or []:
+            gs = self.group_stats.setdefault(str(g), {
+                "query_total": 0, "query_time_in_millis": 0,
+                "fetch_total": 0, "fetch_time_in_millis": 0})
+            gs["query_total"] += 1
         from_ = int(source.get("from", 0) or 0)
         size = int(source.get("size", 10) if source.get("size") is not None else 10)
         k = size_hint if size_hint is not None else from_ + size
@@ -616,11 +624,12 @@ def _search_after_mask(key_arrays, sort_spec, after_values) -> np.ndarray:
         if arr.dtype == object:  # keyword sort: string comparisons
             a = (_missing_fill_str(missing, order) if after is None
                  else str(after))
+        elif isinstance(missing, dict):
+            # _geo_distance: the missing slot carries the geo spec, and
+            # missing-geo docs ALWAYS fill +inf regardless of order
+            a = np.inf if after is None else float(after)
         else:
-            # _geo_distance entries carry the geo spec dict in the missing
-            # slot; their missing-value fill is always +inf (sorts last)
-            m = None if isinstance(missing, dict) else missing
-            a = (_missing_fill(m, order)
+            a = (_missing_fill(missing, order)
                  if after is None else float(after))
         if order == "desc":
             gt |= eq & (arr < a)

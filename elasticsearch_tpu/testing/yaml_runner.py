@@ -430,7 +430,12 @@ class YamlTestRunner:
     def _step_match(self, payload: dict, stash: dict, where: str) -> None:
         for path, expected in payload.items():
             expected = stash_sub(expected, stash)
-            actual = lookup(self._last(stash), path, stash)
+            try:
+                actual = lookup(self._last(stash), path, stash)
+            except (KeyError, IndexError, TypeError) as e:
+                raise YamlTestFailure(
+                    f"[{where}] match {path}: path missing ({e!r})"
+                ) from None
             if not values_match(expected, actual):
                 raise YamlTestFailure(
                     f"[{where}] match {path}: expected {expected!r}, "
@@ -475,7 +480,12 @@ class YamlTestRunner:
     def _cmp(self, payload: dict, stash: dict, where: str, op, name) -> None:
         for path, expected in payload.items():
             expected = stash_sub(expected, stash)
-            actual = lookup(self._last(stash), path, stash)
+            try:
+                actual = lookup(self._last(stash), path, stash)
+            except (KeyError, IndexError, TypeError) as e:
+                raise YamlTestFailure(
+                    f"[{where}] {name} {path}: path missing ({e!r})"
+                ) from None
             if not op(float(actual), float(expected)):
                 raise YamlTestFailure(
                     f"[{where}] {name} {path}: {actual!r} vs {expected!r}")

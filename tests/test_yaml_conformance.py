@@ -81,6 +81,11 @@ PASSING = [
     "indices.put_settings/all_path_options.yml",
     "indices.refresh/10_basic.yml",
     "indices.rollover/20_max_doc_condition.yml",
+    "indices.stats/10_index.yml",
+    "indices.stats/11_metric.yml",
+    "indices.stats/12_level.yml",
+    "indices.stats/14_groups.yml",
+    "indices.stats/15_types.yml",
     "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "info/20_lucene_version.yml",
