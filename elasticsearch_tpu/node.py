@@ -166,8 +166,50 @@ class Node:
         self.cluster_service.submit_state_update_task(f"create-index [{name}]", update)
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
 
-    def delete_index(self, expression: str) -> dict:
-        names = self.cluster_service.state.resolve_index_names(expression)
+    def delete_index(self, expression: str,
+                     ignore_unavailable: bool = False,
+                     allow_no_indices: bool = True) -> dict:
+        state = self.cluster_service.state
+        alias_parts = set()
+        for part in str(expression).split(","):
+            for md in state.indices.values():
+                if part and part in md.aliases:
+                    if ignore_unavailable:
+                        alias_parts.add(part)  # silently skipped (6.x)
+                        break
+                    raise IllegalArgumentException(
+                        f"The provided expression [{part}] matches an "
+                        f"alias, specify the corresponding concrete "
+                        f"indices instead.")
+        # wildcard patterns in a DELETE only expand over concrete index
+        # names — a pattern matching only aliases is a no-op
+        # (TransportDeleteIndexAction + IndicesOptions for destructive ops)
+        import fnmatch as _fn
+
+        names = []
+        for p in str(expression).split(","):
+            if not p or p in alias_parts:
+                continue
+            if "*" in p or p == "_all":
+                pat = "*" if p == "_all" else p
+                matched = [n for n in state.indices
+                           if _fn.fnmatchcase(n, pat)]
+                if not matched and not allow_no_indices:
+                    # a dead wildcard fails the WHOLE request before any
+                    # deletion (IndicesOptions.fromOptions strictness)
+                    raise IndexNotFoundException(p)
+                names.extend(matched)
+            else:
+                try:
+                    names.extend(state.resolve_index_names(p))
+                except IndexNotFoundException:
+                    if not ignore_unavailable:
+                        raise
+        names = list(dict.fromkeys(names))
+        if not names:
+            if not allow_no_indices:
+                raise IndexNotFoundException(str(expression))
+            return {"acknowledged": True}
         for name in names:
             svc = self.indices.pop(name, None)
             if svc is not None:
@@ -283,9 +325,26 @@ class Node:
         raise IndexNotFoundException(name)
 
     def resolve_search_indices(self, expression: str) -> List[IndexService]:
-        names = self.cluster_service.state.resolve_index_names(expression)
-        return [self.indices[n] for n in names
-                if self.cluster_service.state.indices[n].state == "open"]
+        state = self.cluster_service.state
+        out: List[IndexService] = []
+        seen = set()
+        parts = [p for p in str(expression or "_all").split(",") if p]             or ["_all"]
+        for part in parts:
+            wildcard = "*" in part or part in ("_all", "")
+            for n in state.resolve_index_names(part):
+                if n in seen:
+                    continue
+                if state.indices[n].state != "open":
+                    # wildcard EXPANSION skips closed indices, but a
+                    # closed index named explicitly is a request error
+                    # (IndexClosedException)
+                    if wildcard:
+                        continue
+                    raise IllegalArgumentException(
+                        f"closed index [{n}] - IndexClosedException")
+                seen.add(n)
+                out.append(self.indices[n])
+        return out
 
     # ------------------------------------------------------------------
     # Document APIs
@@ -295,6 +354,15 @@ class Node:
                   routing: Optional[str] = None, refresh=None,
                   pipeline: Optional[str] = None,
                   wait_for_active_shards=None, **kw) -> dict:
+        if doc_id is not None:
+            if doc_id == "":
+                raise IllegalArgumentException(
+                    "if _id is specified it must not be empty")
+            if len(doc_id.encode("utf-8")) > 512:
+                raise ActionRequestValidationException(
+                    f"Validation Failed: 1: id is too long, must be no "
+                    f"longer than 512 bytes but was: "
+                    f"{len(doc_id.encode('utf-8'))};")
         svc = self.index_service(index, auto_create=True)
         if wait_for_active_shards is not None:
             self._check_active_shards(svc, wait_for_active_shards)
@@ -425,7 +493,19 @@ class Node:
             try:
                 d = self.get_doc(index, str(spec["_id"]), routing,
                                  realtime=realtime, refresh=refresh)
-                d["_type"] = spec.get("_type", default_type) or "_doc"
+                want_type = spec.get("_type", default_type)
+                d["_type"] = want_type or "_doc"
+                if want_type not in (None, "_all", "_doc"):
+                    # a typed request only matches the index's actual type
+                    # (alias-aware resolution, like get_doc itself)
+                    try:
+                        svc = self.index_service(index)
+                    except Exception:  # noqa: BLE001 — handled as missing
+                        svc = None
+                    actual = getattr(svc, "doc_type", "_doc") or "_doc"
+                    if want_type != actual:
+                        d = {"_index": index, "_type": want_type,
+                             "_id": str(spec["_id"]), "found": False}
                 docs.append(d)
             except IndexNotFoundException:
                 docs.append({

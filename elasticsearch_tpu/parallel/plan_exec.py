@@ -389,11 +389,7 @@ class IndexMeshSearch:
         for sid in self.svc.shards:
             searcher = self.svc.shards[sid].searcher
             searcher.query_total += 1
-            for g in body.get("stats") or []:
-                gs = searcher.group_stats.setdefault(str(g), {
-                    "query_total": 0, "query_time_in_millis": 0,
-                    "fetch_total": 0, "fetch_time_in_millis": 0})
-                gs["query_total"] += 1
+            searcher.record_query_groups(body.get("stats"))
         refs = []
         max_score = None
         for i, (key, slot, d) in enumerate(zip(keys, np.asarray(slots),

@@ -158,6 +158,7 @@ def register_all(c) -> None:
     r("PUT", "/{index}/_alias/{name}", _put_alias)
     r("DELETE", "/{index}/_alias/{name}", _delete_alias)
     r("HEAD", "/_alias/{name}", _head_alias)
+    r("HEAD", "/{index}/_alias/{name}", _head_alias)
     r("PUT", "/_template/{name}", _put_template)
     r("GET", "/_template", _get_template)
     r("GET", "/_template/{name}", _get_template)
@@ -369,7 +370,8 @@ def _index_doc(node, req, force_create: bool = False):
     _typed_api_warning(req)
     body = req.json_body()
     if body is None:
-        raise ActionRequestValidationException("Validation Failed: 1: source is missing;")
+        raise ActionRequestValidationException(
+            "request body is required")
     kw = {}
     if req.param("version") is not None:
         kw["version"] = int(req.param("version"))
@@ -547,12 +549,20 @@ def _mget(node, req):
 
 def _bulk(node, req):
     lines = req.ndjson_lines()
+    if not lines:
+        raise ActionRequestValidationException("request body is required")
     default_index = req.param("index")
     ops = []
     i = 0
     while i < len(lines):
         action_line = lines[i]
-        ((action, meta),) = action_line.items() if action_line else (("index", {}),)
+        if not action_line:
+            # an empty {} action object (BulkRequest.add: the parser
+            # expects the action FIELD_NAME immediately)
+            raise IllegalArgumentException(
+                f"Malformed action/metadata line [{i + 1}], expected "
+                f"FIELD_NAME but found [END_OBJECT]")
+        ((action, meta),) = action_line.items()
         meta = dict(meta or {})
         meta.setdefault("_index", default_index)
         i += 1
@@ -867,13 +877,28 @@ def _create_index(node, req):
 
 
 def _delete_index(node, req):
-    return 200, node.delete_index(req.param("index"))
+    return 200, node.delete_index(
+        req.param("index"),
+        ignore_unavailable=req.bool_param("ignore_unavailable"),
+        allow_no_indices=req.bool_param("allow_no_indices", True))
 
 
 def _get_index(node, req):
     state = node.cluster_service.state
     out = {}
-    for name in state.resolve_index_names(req.param("index")):
+    expr = req.param("index")
+    if req.bool_param("ignore_unavailable"):
+        from elasticsearch_tpu.common.errors import IndexNotFoundException
+
+        names = []
+        for part in str(expr).split(","):
+            try:
+                names.extend(state.resolve_index_names(part))
+            except IndexNotFoundException:
+                continue  # ignore_unavailable skips only missing parts
+    else:
+        names = state.resolve_index_names(expr)
+    for name in names:
         md = state.indices[name]
         out[name] = md.to_dict()
     return 200, out
@@ -1250,8 +1275,12 @@ def _delete_alias(node, req):
 
 def _head_alias(node, req):
     state = node.cluster_service.state
-    for md in state.indices.values():
-        if req.param("name") in md.aliases:
+    index = req.param("index")
+    names = (state.resolve_index_names(index) if index else
+             list(state.indices))
+    for n in names:
+        md = state.indices.get(n)
+        if md is not None and req.param("name") in md.aliases:
             return 200, {}
     return 404, {}
 

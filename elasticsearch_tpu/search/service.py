@@ -111,6 +111,15 @@ class ShardSearcher:
             else None
         )
 
+    def record_query_groups(self, groups) -> None:
+        """Count one query against each requested stats group (shared by
+        the host path and the mesh path)."""
+        for g in groups or []:
+            gs = self.group_stats.setdefault(str(g), {
+                "query_total": 0, "query_time_in_millis": 0,
+                "fetch_total": 0, "fetch_time_in_millis": 0})
+            gs["query_total"] += 1
+
     def _maybe_slowlog(self, took_s: float, source: dict) -> None:
         if self.slowlog_warn_s is not None and took_s >= self.slowlog_warn_s:
             _slow_logger.warning(
@@ -129,11 +138,7 @@ class ShardSearcher:
         t0 = time.monotonic()
         self.query_total += 1
         source = source or {}
-        for g in source.get("stats") or []:
-            gs = self.group_stats.setdefault(str(g), {
-                "query_total": 0, "query_time_in_millis": 0,
-                "fetch_total": 0, "fetch_time_in_millis": 0})
-            gs["query_total"] += 1
+        self.record_query_groups(source.get("stats"))
         from_ = int(source.get("from", 0) or 0)
         size = int(source.get("size", 10) if source.get("size") is not None else 10)
         k = size_hint if size_hint is not None else from_ + size

@@ -368,6 +368,34 @@ class YamlTestRunner:
             raise YamlTestFailure(f"[{where}] do with {len(payload)} apis")
         (api_name, args), = payload.items()
         args = stash_sub(args or {}, stash)
+        if api_name == "raw":
+            # raw: {method, path, ...query params, body} — bypasses the
+            # api specs (used for malformed-request tests)
+            method = args.pop("method", "GET")
+            path = "/" + str(args.pop("path", "")).lstrip("/")
+            raw_body = args.pop("body", None)
+            status, resp = self.client.request(method, path, args, raw_body)
+            stash["__last_response"] = resp
+            if catch is None:
+                if status >= 400:
+                    raise YamlTestFailure(
+                        f"[{where}] raw {method} {path} failed "
+                        f"[{status}]: {str(resp)[:200]}")
+            elif catch.startswith("/") and catch.endswith("/"):
+                if status < 400 or not re.search(catch.strip("/"),
+                                                 json.dumps(resp)):
+                    raise YamlTestFailure(
+                        f"[{where}] raw expected error {catch}, got "
+                        f"[{status}] {str(resp)[:200]}")
+            elif catch in CATCH_STATUS:
+                if status not in CATCH_STATUS[catch]:
+                    raise YamlTestFailure(
+                        f"[{where}] raw expected {catch} "
+                        f"{CATCH_STATUS[catch]}, got [{status}]")
+            elif status < 400:
+                raise YamlTestFailure(
+                    f"[{where}] raw expected error, got [{status}]")
+            return
         # `ignore: 404` style client-side status suppression
         ignore = args.pop("ignore", None) if isinstance(args, dict) else None
         if ignore is not None and not isinstance(ignore, list):

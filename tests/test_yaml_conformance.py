@@ -21,6 +21,7 @@ pytestmark = pytest.mark.skipif(
     not os.path.isdir(BASE), reason="reference rest-api-spec not available")
 
 PASSING = [
+    "bulk/10_basic.yml",
     "bulk/20_list_of_strings.yml",
     "bulk/30_big_string.yml",
     "bulk/50_refresh.yml",
@@ -58,6 +59,7 @@ PASSING = [
     "get_source/60_realtime_refresh.yml",
     "get_source/70_source_filtering.yml",
     "get_source/80_missing.yml",
+    "index/10_with_id.yml",
     "index/12_result.yml",
     "index/15_without_id.yml",
     "index/20_optype.yml",
@@ -65,7 +67,9 @@ PASSING = [
     "index/36_external_gte_version.yml",
     "index/40_routing.yml",
     "indices.clear_cache/10_basic.yml",
+    "indices.delete/10_basic.yml",
     "indices.exists/10_basic.yml",
+    "indices.exists_alias/10_basic.yml",
     "indices.exists_template/10_basic.yml",
     "indices.exists_type/10_basic.yml",
     "indices.forcemerge/10_basic.yml",
@@ -77,6 +81,8 @@ PASSING = [
     "indices.get_settings/20_aliases.yml",
     "indices.get_template/10_basic.yml",
     "indices.get_template/20_get_missing.yml",
+    "indices.open/10_basic.yml",
+    "indices.open/20_multiple_indices.yml",
     "indices.put_alias/all_path_options.yml",
     "indices.put_settings/all_path_options.yml",
     "indices.refresh/10_basic.yml",
@@ -89,6 +95,7 @@ PASSING = [
     "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "info/20_lucene_version.yml",
+    "mget/10_basic.yml",
     "mlt/10_basic.yml",
     "nodes.info/10_basic.yml",
     "ping/10_ping.yml",
