@@ -321,6 +321,13 @@ def _builtin_analyzers() -> Dict[str, Analyzer]:
             standard_tokenizer,
             [lowercase_filter, make_stop_filter(), stemmer_filter],
         ),
+        # analysis-common SnowballAnalyzer (default English): same
+        # pipeline as "english" here — our stemmer approximates both
+        "snowball": Analyzer(
+            "snowball",
+            standard_tokenizer,
+            [lowercase_filter, make_stop_filter(), stemmer_filter],
+        ),
     }
 
 

@@ -589,7 +589,15 @@ def _search_body(req):
     # URI search: ?q=...&size=...&from=...&sort=f:asc
     q = req.param("q")
     if q is not None:
-        body["query"] = {"query_string": {"query": q}}
+        qs = {"query": q}
+        for name, key in (("df", "default_field"),
+                          ("default_operator", "default_operator"),
+                          ("analyzer", "analyzer")):
+            if req.param(name) is not None:
+                qs[key] = req.param(name)
+        if req.param("lenient") is not None:
+            qs["lenient"] = req.bool_param("lenient")
+        body["query"] = {"query_string": qs}
     for p in ("size", "from"):
         if req.param(p) is not None:
             body[p] = int(req.param(p))
@@ -696,10 +704,29 @@ def _field_caps(node, req):
 
 def _explain(node, req):
     body = req.json_body({}) or {}
+    if body and "query" not in body:
+        # a bare query object at the top level is a parse error
+        # (RestExplainAction expects the "query" element)
+        raise ActionRequestValidationException(
+            "Validation Failed: 1: query is missing;")
     svc = node.index_service(req.param("index"))
     doc_id = req.param("id")
+    inner = body.get("query")
+    if inner is None and req.param("q") is not None:
+        # URI-search form: ?q= with df/default_operator/analyzer/lenient
+        inner = {"query_string": {
+            "query": req.param("q"),
+            **({"default_field": req.param("df")} if req.param("df")
+               else {}),
+            **({"default_operator": req.param("default_operator")}
+               if req.param("default_operator") else {}),
+            **({"analyzer": req.param("analyzer")}
+               if req.param("analyzer") else {}),
+            **({"lenient": req.bool_param("lenient")}
+               if req.param("lenient") is not None else {}),
+        }}
     q = dict(body)
-    q["query"] = {"bool": {"must": [body.get("query", {"match_all": {}})],
+    q["query"] = {"bool": {"must": [inner or {"match_all": {}}],
                            "filter": [{"ids": {"values": [doc_id]}}]}}
     q["size"] = 1
     resp = svc.search(q)
@@ -718,6 +745,17 @@ def _explain(node, req):
             "details": details,
         },
     }
+    # the `get` section carries the (filtered) source when any _source
+    # param was given (RestExplainAction -> GetResult); reuses the same
+    # FetchSourceContext param parsing as single-doc GETs
+    if any(req.param(p) is not None for p in (
+            "_source", "_source_include", "_source_includes",
+            "_source_exclude", "_source_excludes")):
+        g = svc.get_doc(doc_id, routing=req.param("routing"))
+        if g.found:
+            get_out = {"found": True, "_source": dict(g.source)}
+            _apply_source_filtering(req, get_out)
+            out["get"] = get_out
     _echo_type(req, out)
     return 200, out
 

@@ -298,15 +298,21 @@ class MatchQueryBuilder(QueryBuilder):
     name = "match"
 
     def __init__(self, field: str, query, operator: str = "or",
-                 minimum_should_match: Optional[str] = None, **kw):
+                 minimum_should_match: Optional[str] = None,
+                 analyzer: Optional[str] = None, **kw):
         super().__init__(**kw)
         self.field = field
         self.query = query
         self.operator = operator.lower()
         self.minimum_should_match = minimum_should_match
+        # explicit search analyzer override (MatchQueryBuilder#analyzer)
+        self.analyzer = analyzer
 
     def _analyzed_terms(self, ctx) -> List[str]:
         ft = ctx.field_type(self.field)
+        if self.analyzer is not None:
+            # explicit analyzer override beats the field's search analyzer
+            return ctx.analyzers.get(self.analyzer).analyze(str(self.query))
         if ft is None:
             return [str(self.query)]
         if isinstance(ft, TextFieldType):
@@ -344,15 +350,19 @@ class MatchQueryBuilder(QueryBuilder):
 class MatchPhraseQueryBuilder(QueryBuilder):
     name = "match_phrase"
 
-    def __init__(self, field: str, query, slop: int = 0, **kw):
+    def __init__(self, field: str, query, slop: int = 0,
+                 analyzer: Optional[str] = None, **kw):
         super().__init__(**kw)
         self.field = field
         self.query = query
         self.slop = slop
+        self.analyzer = analyzer
 
     def to_plan(self, ctx, segment):
         ft = ctx.field_type(self.field)
-        if isinstance(ft, TextFieldType):
+        if self.analyzer is not None:
+            terms = ctx.analyzers.get(self.analyzer).analyze(str(self.query))
+        elif isinstance(ft, TextFieldType):
             terms = ft.query_terms(self.query, ctx.analyzers)
         else:
             terms = [str(self.query)]
@@ -478,13 +488,15 @@ class MultiMatchQueryBuilder(QueryBuilder):
     name = "multi_match"
 
     def __init__(self, query, fields: List[str], type_: str = "best_fields",
-                 operator: str = "or", tie_breaker: float = 0.0, **kw):
+                 operator: str = "or", tie_breaker: float = 0.0,
+                 analyzer: Optional[str] = None, **kw):
         super().__init__(**kw)
         self.query = query
         self.fields = fields
         self.type = type_
         self.operator = operator
         self.tie_breaker = tie_breaker
+        self.analyzer = analyzer
 
     def to_plan(self, ctx, segment):
         field_boosts = []
@@ -497,7 +509,8 @@ class MultiMatchQueryBuilder(QueryBuilder):
                 for resolved in ctx.mapper_service.mapper.simple_match_to_fields(f) or [f]:
                     field_boosts.append((resolved, 1.0))
         per_field = [
-            MatchQueryBuilder(f, self.query, operator=self.operator, boost=b)
+            MatchQueryBuilder(f, self.query, operator=self.operator,
+                              analyzer=self.analyzer, boost=b)
             .to_plan(ctx, segment)
             for f, b in field_boosts
         ]
@@ -967,12 +980,16 @@ class QueryStringQueryBuilder(QueryBuilder):
 
     def __init__(self, query: str, default_field: Optional[str] = None,
                  fields: Optional[List[str]] = None,
-                 default_operator: str = "or", **kw):
+                 default_operator: str = "or",
+                 analyzer: Optional[str] = None,
+                 lenient: bool = False, **kw):
         super().__init__(**kw)
         self.query = query
         self.default_field = default_field
         self.fields = fields
         self.default_operator = default_operator.lower()
+        self.analyzer = analyzer
+        self.lenient = lenient
 
     def _leaf(self, field: Optional[str], text: str, is_phrase: bool, ctx) -> QueryBuilder:
         if field is None:
@@ -982,13 +999,31 @@ class QueryStringQueryBuilder(QueryBuilder):
             if fields is None:
                 fields = ctx.default_fields() or ["*"]
             if len(fields) > 1:
-                return MultiMatchQueryBuilder(text, fields)
+                return MultiMatchQueryBuilder(text, fields,
+                                              analyzer=self.analyzer)
             field = fields[0]
+        if self.lenient:
+            # lenient=true drops clauses whose value can't parse for the
+            # field's type instead of failing the request
+            ft = ctx.field_type(field) if field else None
+            if ft is not None and not isinstance(ft, TextFieldType):
+                try:
+                    ft.term_for_query(text.strip('"'), ctx.analyzers)
+                    if isinstance(ft, NumberFieldType):
+                        float(text.strip('"'))
+                except Exception:  # noqa: BLE001 — the lenient contract
+                    return MatchNoneQueryBuilder()
         if is_phrase:
-            return MatchPhraseQueryBuilder(field, text)
+            return MatchPhraseQueryBuilder(field, text,
+                                           analyzer=self.analyzer)
         if "*" in text or "?" in text:
+            # analyzed (text) fields hold lowercased terms; the classic
+            # query_string parser lowercases expanded terms to match
+            ft = ctx.field_type(field)
+            if ft is None or isinstance(ft, TextFieldType):
+                text = text.lower()
             return WildcardQueryBuilder(field, text)
-        return MatchQueryBuilder(field, text)
+        return MatchQueryBuilder(field, text, analyzer=self.analyzer)
 
     def to_plan(self, ctx, segment):
         tokens = re.findall(r'\S*"[^"]*"|\S+', self.query)
@@ -1839,6 +1874,7 @@ def parse_query(body) -> QueryBuilder:
         return MatchQueryBuilder(
             field, value, operator=params.get("operator", "or"),
             minimum_should_match=params.get("minimum_should_match"),
+            analyzer=params.get("analyzer"),
             boost=float(params.get("boost", 1.0)),
         )
     if qtype == "match_phrase":
@@ -1948,6 +1984,8 @@ def parse_query(body) -> QueryBuilder:
             qbody["query"], default_field=qbody.get("default_field"),
             fields=qbody.get("fields"),
             default_operator=qbody.get("default_operator", "or"),
+            analyzer=qbody.get("analyzer"),
+            lenient=bool(qbody.get("lenient", False)),
             boost=float(qbody.get("boost", 1.0)),
         )
     if qtype == "geo_distance":

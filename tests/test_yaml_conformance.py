@@ -46,6 +46,9 @@ PASSING = [
     "exists/40_routing.yml",
     "exists/60_realtime_refresh.yml",
     "exists/70_defaults.yml",
+    "explain/10_basic.yml",
+    "explain/20_source_filtering.yml",
+    "explain/30_query_string.yml",
     "get/10_basic.yml",
     "get/15_default_values.yml",
     "get/20_stored_fields.yml",
