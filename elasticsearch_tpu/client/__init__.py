@@ -5,6 +5,7 @@ low-level REST client (client/rest/.../RestClient.java)."""
 
 from elasticsearch_tpu.client_base import Client  # noqa: F401
 from elasticsearch_tpu.client.http import (  # noqa: F401
+    AmbiguousWriteError,
     HttpClient,
     NoLiveHostError,
     Response,

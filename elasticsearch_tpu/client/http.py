@@ -58,6 +58,21 @@ class NoLiveHostError(Exception):
     """Every configured host is marked dead and none could be revived."""
 
 
+class AmbiguousWriteError(Exception):
+    """A non-idempotent request failed after it may have reached the
+    server (timeout / connection reset mid-flight). The write may or may
+    not have been applied; the client did NOT fail over, because a replay
+    could duplicate it. Distinct from NoLiveHostError: the cluster is not
+    known to be down — this one host gave an ambiguous answer."""
+
+    def __init__(self, host: str, cause: Exception):
+        self.host = host
+        super().__init__(
+            f"non-idempotent request to {host} failed after it may have "
+            f"been sent ({cause!r}); not retried to avoid duplicating "
+            f"the write")
+
+
 class Response:
     __slots__ = ("status", "body", "host")
 
@@ -221,7 +236,7 @@ class HttpClient:
                     # timeout/reset: replaying could duplicate the write.
                     # Connection-refused failures were never delivered, so
                     # those still fail over to the next host.
-                    break
+                    raise AmbiguousWriteError(st.host, e) from e
         raise NoLiveHostError(
             f"no usable host out of {self.hosts()}: {last_exc}")
 

@@ -79,6 +79,11 @@ RECOVERY_CHUNK_BYTES = 512 * 1024
 RECOVERY_SESSION_MAX_AGE_S = 600.0
 
 
+class NotMasterException(ElasticsearchTpuException):
+    """A master-only operation raced with a mastership change; callers
+    on the RPC path translate this to a benign {'ok': False}."""
+
+
 class FailedToCommitClusterStateException(ElasticsearchTpuException):
     """The publish quorum was not reached; the master stepped down and
     the state change is NOT committed (discovery/zen/publish —
@@ -138,6 +143,9 @@ class ClusterNode:
         # two-phase publish: follower-side buffered state awaiting commit
         # keyed by (epoch, version) — dropped when superseded
         self._pending_publish: Optional[dict] = None
+        # while a master-side state update is uncommitted, shards it
+        # removes are parked here instead of closed (rollback support)
+        self._removed_shards: Optional[list] = None
         # local shards: (index, shard_id) -> IndexShard
         self.shards: Dict[Tuple[str, int], IndexShard] = {}
         self.mappers: Dict[str, MapperService] = {}
@@ -451,7 +459,7 @@ class ClusterNode:
 
     def create_index(self, name: str, settings: Optional[dict] = None,
                      mappings: Optional[dict] = None) -> dict:
-        with self._lock:
+        def mutate():
             if not self.is_master:
                 raise IllegalArgumentException(
                     "create_index must be sent to the master"
@@ -460,25 +468,26 @@ class ClusterNode:
                 from elasticsearch_tpu.common.errors import IndexAlreadyExistsException
 
                 raise IndexAlreadyExistsException(name)
-            md = IndexMetadata(
+            self.indices_meta[name] = IndexMetadata(
                 name,
                 Settings.from_dict(settings or {}).with_index_prefix(),
                 mappings or {"properties": {}},
                 creation_date=int(time.time() * 1000),
             )
-            self.indices_meta[name] = md
-        self._master_reroute_and_publish()
+
+        self._submit_state_update(mutate)
         return {"acknowledged": True, "index": name}
 
     def delete_index(self, name: str) -> dict:
-        with self._lock:
+        def mutate():
             if not self.is_master:
                 raise IllegalArgumentException("delete_index must run on master")
             if name not in self.indices_meta:
                 raise IndexNotFoundException(name)
             del self.indices_meta[name]
             self.routing.pop(name, None)
-        self._master_reroute_and_publish()
+
+        self._submit_state_update(mutate)
         return {"acknowledged": True}
 
     def update_node_disk(self, node_id: str, used_fraction: float) -> None:
@@ -497,18 +506,64 @@ class ClusterNode:
         self._master_reroute_and_publish()
 
     def _master_reroute_and_publish(self) -> None:
-        """Reroute + self-apply under the lock, then publish to the other
-        nodes OUTSIDE it: a follower's publish handler may synchronously
+        self._submit_state_update(lambda: None)
+
+    def _submit_state_update(self, mutate) -> None:
+        """MasterService.runTasks analog: apply `mutate` + reroute +
+        self-apply under the lock, then publish to the other nodes
+        OUTSIDE it: a follower's publish handler may synchronously
         recover replicas and report shard-started back to this master —
         holding our lock across the publish round-trip would deadlock
         that nested RPC over a real (TCP) transport. (The in-process hub
         hid this: same-thread RLock reentrancy.) Callers must therefore
-        NOT hold self._lock when calling this."""
+        NOT hold self._lock when calling this.
+
+        If the commit quorum fails, the pre-change snapshot is restored
+        before FailedToCommitClusterStateException propagates: the
+        reference master only applies a state after its publish quorum
+        acks (PublishClusterStateAction), so a minority master must not
+        keep serving a change its client was told did NOT commit. Local
+        shards the uncommitted change removed (e.g. a rolled-back
+        delete_index) are held open until the commit succeeds and are
+        resurrected with their data on rollback — recreating them empty
+        would lose the master's copy while claiming nothing happened."""
         with self._lock:
-            state, deferred = self._master_reroute_locked()
-        for action in deferred:  # own-primary started reports etc.
-            action()
-        self._publish_to_followers(state)
+            snapshot = self._state_dict()
+            removed: list = []
+            self._removed_shards = removed
+            try:
+                mutate()
+                state, deferred = self._master_reroute_locked()
+            finally:
+                self._removed_shards = None
+        try:
+            for action in deferred:  # own-primary started reports etc.
+                # a deferred action may itself publish (shard-started →
+                # nested _submit_state_update) and hit the same failed
+                # quorum — that must roll back THIS change too
+                action()
+            with self._lock:
+                # a nested publish may have superseded `state`; shipping
+                # the stale version would cost a full 2-phase broadcast
+                # every follower then rejects as stale
+                superseded = ((self.cluster_epoch, self.state_version)
+                              > (state["epoch"], state["version"]))
+            if not superseded:
+                self._publish_to_followers(state)
+        except FailedToCommitClusterStateException:
+            with self._lock:
+                # put removed shards back BEFORE re-adopting the
+                # snapshot so its reconcile finds the data intact
+                for key, shard in removed:
+                    if key not in self.shards:
+                        self.shards[key] = shard
+                restore = self._adopt_state_locked(snapshot)
+                self.master_id = None  # stay stepped down post-rollback
+            for action in restore:
+                action()
+            raise
+        for _key, shard in removed:  # committed: the removal is final
+            shard.close()
 
     def _reachable_eligible(self, nodes) -> int:
         """Count of master-eligible nodes among `nodes` (self included if
@@ -558,7 +613,12 @@ class ClusterNode:
         for node in reached:
             try:
                 self.transport.send_request(node, ACTION_COMMIT, key)
-            except NodeNotConnectedException:
+            except Exception:  # noqa: BLE001 — commit is best-effort
+                # past the quorum the state IS committed; a follower
+                # whose apply blew up (e.g. its deferred shard-started
+                # report hit a nested failed quorum) must not bubble
+                # that back here and make us roll back a committed
+                # change — it will catch up on the next publish
                 pass
 
     def _master_reroute_locked(self) -> Tuple[dict, list]:
@@ -602,6 +662,13 @@ class ClusterNode:
                     "settings": md.settings.as_dict(),
                     "mappings": md.mappings,
                     "state": md.state,
+                    # full IndexMetadata: every apply (follower AND the
+                    # master's own self-apply/rollback) rebuilds from
+                    # this dict, so omitting a field here silently wipes
+                    # it cluster-wide
+                    "aliases": md.aliases,
+                    "creation_date": md.creation_date,
+                    "version": md.version,
                 }
                 for name, md in self.indices_meta.items()
             },
@@ -685,14 +752,23 @@ class ClusterNode:
                 # election does — the lower node id wins — so exactly one
                 # side is rejected and the clusters can converge
                 return []
-        self.cluster_epoch = epoch
+        return self._adopt_state_locked(state)
+
+    def _adopt_state_locked(self, state: dict) -> list:
+        """Unconditionally take on `state` (no staleness checks — callers
+        have already decided). Also the rollback primitive: a master whose
+        commit quorum failed re-adopts its pre-change snapshot here."""
+        self.cluster_epoch = state.get("epoch", 0)
         self.state_version = state["version"]
         self.master_id = state["master"]
         self.known_nodes = list(state["nodes"])
         self.indices_meta = {
             name: IndexMetadata(
                 name, Settings(info["settings"]), info["mappings"],
+                aliases=info.get("aliases") or {},
                 state=info.get("state", "open"),
+                creation_date=info.get("creation_date", 0),
+                version=info.get("version", 1),
             )
             for name, info in state["indices"].items()
         }
@@ -726,10 +802,16 @@ class ClusterNode:
                 for copy in copies:
                     if copy.node_id == self.node_id:
                         wanted[(index, sid)] = copy
-        # remove shards no longer ours
+        # remove shards no longer ours; inside an uncommitted state
+        # update the close is deferred so a failed commit quorum can
+        # resurrect the shard with its data (see _submit_state_update)
         for key in list(self.shards):
             if key not in wanted or key[0] not in self.indices_meta:
-                self.shards.pop(key).close()
+                shard = self.shards.pop(key)
+                if self._removed_shards is not None:
+                    self._removed_shards.append((key, shard))
+                else:
+                    shard.close()
         # create / update
         for (index, sid), copy in wanted.items():
             shard = self.shards.get((index, sid))
@@ -1100,18 +1182,37 @@ class ClusterNode:
             })
         except NodeNotConnectedException:
             pass
+        except FailedToCommitClusterStateException:
+            # the master could not commit the started-state; it rolled
+            # back and stepped down. Swallow: the next elected master
+            # re-allocates and this copy re-reports. Propagating would
+            # crash the applier loop that triggered the recovery. (When
+            # the report ran as a deferred action inside our OWN
+            # _submit_state_update, swallowing is still safe: the outer
+            # publish independently hits the same dead quorum and rolls
+            # the outer change back.)
+            pass
 
     def _on_shard_started(self, payload, src) -> dict:
         with self._lock:
             if not self.is_master:
                 return {"ok": False}
-            for copy in self.routing.get(payload["index"], {}).get(payload["shard"], []):
+
+        def mutate():
+            if not self.is_master:
+                raise NotMasterException("master changed")
+            for copy in self.routing.get(
+                    payload["index"], {}).get(payload["shard"], []):
                 if copy.node_id == payload["node"]:
                     copy.state = ShardRoutingState.STARTED
-            self.state_version += 1
-            state = self._state_dict()
-        self._publish_to_followers(state)
-        self._apply_state(state)
+
+        try:
+            self._submit_state_update(mutate)
+        except NotMasterException:
+            # mastership moved between the pre-check and the locked
+            # mutate: answer the benign no-op the reporter expects
+            # instead of raising across the RPC
+            return {"ok": False}
         return {"ok": True}
 
     def _on_shard_failed(self, payload, src) -> dict:
@@ -1120,11 +1221,23 @@ class ClusterNode:
         with self._lock:
             if not self.is_master:
                 return {"ok": False}
-            copies = self.routing.get(payload["index"], {}).get(payload["shard"], [])
+
+        def mutate():
+            if not self.is_master:
+                raise NotMasterException("master changed")
+            if payload["index"] not in self.routing:
+                # the index was deleted while the report was in flight —
+                # a benign no-op, not a crash across the reporter's RPC
+                raise NotMasterException("index no longer routed")
+            copies = self.routing[payload["index"]].get(payload["shard"], [])
             self.routing[payload["index"]][payload["shard"]] = [
                 c for c in copies if c.node_id != payload["node"]
             ]
-        self._master_reroute_and_publish()
+
+        try:
+            self._submit_state_update(mutate)
+        except NotMasterException:
+            return {"ok": False}
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -1142,7 +1255,12 @@ class ClusterNode:
                     "index": payload["index"], "shard": payload["shard"],
                     "node": node_id,
                 })
-            except NodeNotConnectedException:
+            except (NodeNotConnectedException,
+                    FailedToCommitClusterStateException):
+                # same rationale as _report_started: a master that could
+                # not commit the copy-removal rolled back and stepped
+                # down; the client's write already applied on the
+                # primary and must not error because of the report
                 pass
         return result
 

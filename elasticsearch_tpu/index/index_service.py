@@ -11,6 +11,7 @@ loop with a shard_map over a device mesh.
 
 from __future__ import annotations
 
+import copy
 import os
 import time
 from typing import Dict, List, Optional
@@ -204,8 +205,13 @@ class IndexService:
                 return self.index_doc(doc_id, body["upsert"], routing)
             raise DocumentMissingException(self.name, doc_id)
         if "script" in body:
+            # deep copy: engine.get returns the live buffer/segment source,
+            # and a script may mutate nested objects then set ctx.op='none' —
+            # a shallow copy would corrupt the stored doc in place, bypassing
+            # versioning and the translog (same hazard _apply_byquery_script
+            # guards against in index/reindex.py)
             return self._scripted_update(
-                doc_id, body, dict(existing.source), routing,
+                doc_id, body, copy.deepcopy(existing.source), routing,
                 version=existing.version)
         if "doc" in body:
             merged = _deep_merge(dict(existing.source), body["doc"])

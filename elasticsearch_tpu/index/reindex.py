@@ -119,8 +119,15 @@ def reindex(node, body: dict) -> dict:
                     break
                 total += 1
                 dest_for_doc = dst_index
+                doc_action = "create" if op_type == "create" else "index"
                 if compiled is not None:
                     op = _apply_byquery_script(compiled, h)
+                    if op == "create":
+                        # per-doc ctx.op='create' wins over dest.op_type:
+                        # existing dest docs become version conflicts
+                        # (AbstractAsyncBulkByScrollAction honors the
+                        # script-returned op when building the bulk item)
+                        doc_action = "create"
                     if op == "none":
                         noops += 1
                         continue
@@ -138,7 +145,7 @@ def reindex(node, body: dict) -> dict:
                     if h["_index"] != src_index:
                         dest_for_doc = h["_index"]
                 ops.append((
-                    "create" if op_type == "create" else "index",
+                    doc_action,
                     {"_index": dest_for_doc, "_id": h["_id"],
                      "pipeline": pipeline},
                     h["_source"],

@@ -63,6 +63,30 @@ class TestHttpClient:
         with pytest.raises(NoLiveHostError):
             client.request("GET", "/")
 
+    def test_ambiguous_write_not_reported_as_cluster_down(self, cluster):
+        """A non-idempotent request that dies mid-flight (timeout/reset,
+        not connection-refused) must raise AmbiguousWriteError naming the
+        one host — NOT NoLiveHostError, which would misrepresent a
+        single-host ambiguous write as cluster-wide unavailability and
+        hide that the POST may have been applied."""
+        import urllib.request
+        from unittest import mock
+
+        from elasticsearch_tpu.client import AmbiguousWriteError
+
+        _, servers = cluster
+        client = HttpClient([f"http://127.0.0.1:{s.port}" for s in servers])
+        reset = ConnectionResetError(104, "Connection reset by peer")
+        with mock.patch.object(urllib.request, "urlopen", side_effect=reset):
+            with pytest.raises(AmbiguousWriteError) as e:
+                client.request("POST", "/idx/_doc/1", body={"a": 1})
+        assert e.value.__cause__ is reset
+        # idempotent requests with the same failure still exhaust hosts
+        # and report cluster-wide unavailability
+        with mock.patch.object(urllib.request, "urlopen", side_effect=reset):
+            with pytest.raises(NoLiveHostError):
+                client.request("GET", "/")
+
     def test_sniffer_discovers_nodes(self, cluster):
         _, servers = cluster
         client = HttpClient([f"http://127.0.0.1:{servers[0].port}"])

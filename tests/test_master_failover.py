@@ -273,6 +273,51 @@ class TestQuorum:
         assert not nodes["n1"].is_master
         assert "ghost" not in nodes["n2"].indices_meta
         assert "ghost" not in nodes["n3"].indices_meta
+        # the minority master must NOT keep serving the uncommitted
+        # change: the client was told the state did not commit, so the
+        # index must not exist on n1 either (the reference master only
+        # applies after the publish quorum acks) — local meta, routing,
+        # and shard instances all roll back to the committed snapshot
+        assert "ghost" not in nodes["n1"].indices_meta
+        assert "ghost" not in nodes["n1"].routing
+        assert not any(k[0] == "ghost" for k in nodes["n1"].shards)
+
+    def test_delete_rollback_resurrects_shard_data(self):
+        """A minority master rolling back an uncommitted delete_index
+        must bring the LOCAL shard copies back with their data: the
+        self-applied delete closed them, and recreating them empty
+        (start_fresh) would lose the master's copy while telling the
+        client the delete never happened."""
+        from elasticsearch_tpu.cluster.multinode import (
+            ClusterClient,
+            FailedToCommitClusterStateException,
+        )
+
+        hub, nodes = quorum_cluster()
+        nodes["n1"].create_index(
+            "keep", {"index": {"number_of_shards": 2,
+                               "number_of_replicas": 0}},
+            {"properties": {"msg": {"type": "text"}}})
+        client = ClusterClient(nodes["n1"])
+        for i in range(8):
+            client.index("keep", str(i), {"msg": f"event {i}"})
+        client.refresh("keep")
+        before = client.search("keep", {"query": {"match_all": {}}})
+        assert before["hits"]["total"] == 8
+
+        def local_docs():
+            return sum(s.num_docs
+                       for (idx, _), s in nodes["n1"].shards.items()
+                       if idx == "keep")
+
+        before_local = local_docs()
+        assert before_local > 0  # n1 hosts at least one shard copy
+        hub.disconnect("n1")
+        with pytest.raises(FailedToCommitClusterStateException):
+            nodes["n1"].delete_index("keep")
+        # metadata rolled back AND the local shard data survived
+        assert "keep" in nodes["n1"].indices_meta
+        assert local_docs() == before_local
 
     def test_headless_node_recovers_via_fd_tick(self):
         hub, nodes = quorum_cluster()

@@ -185,6 +185,21 @@ class TestContexts:
         assert r["result"] == "deleted"
         assert not idx.get_doc("2").found
 
+    def test_noop_script_cannot_corrupt_live_source(self, idx):
+        """A script that mutates a NESTED object then sets ctx.op='none'
+        must leave the stored doc untouched: ctx._source is a deep copy,
+        not the live buffer/segment dict (a shallow copy would let the
+        mutation bypass versioning and the translog, so flush/recovery
+        would silently revert the visible data)."""
+        idx.index_doc("nested1", {"obj": {"inner": 1}, "n": 0})
+        before = idx.get_doc("nested1")
+        r = idx.update_doc("nested1", {"script": {
+            "source": "ctx._source.obj.inner = 999; ctx.op = 'none'"}})
+        assert r["result"] == "noop"
+        after = idx.get_doc("nested1")
+        assert after.source["obj"]["inner"] == 1
+        assert after.version == before.version
+
     def test_scripted_upsert(self, idx):
         r = idx.update_doc("99", {
             "scripted_upsert": True,
@@ -306,6 +321,26 @@ class TestByQueryScripts:
         assert out["total"] == 6
         assert out["total"] == (out["created"] + out["updated"]
                                 + out["noops"] + out["deleted"])
+
+    def test_reindex_script_ctx_op_create(self, node):
+        """ctx.op='create' in a reindex script must emit a CREATE bulk
+        action even when dest.op_type is the default 'index': existing
+        dest docs become conflicts instead of being overwritten
+        (AbstractAsyncBulkByScrollAction honors the script-returned op)."""
+        from elasticsearch_tpu.index.reindex import reindex
+
+        node.create_index("dstc", {"mappings": {"properties": {
+            "n": {"type": "integer"}}}})
+        node.index_doc("dstc", "0", {"n": -777})  # pre-existing dest doc
+        node.indices["dstc"].refresh()
+        out = reindex(node, {
+            "source": {"index": "src"},
+            "dest": {"index": "dstc"},  # op_type defaults to 'index'
+            "script": {"source": "ctx.op = 'create'"}})
+        # doc 0 conflicts (already present), the other 5 are created
+        assert out["created"] == 5
+        assert len(out["failures"]) == 1
+        assert node.get_doc("dstc", "0")["_source"]["n"] == -777
 
     def test_reindex_script_id_rewrite(self, node):
         from elasticsearch_tpu.index.reindex import reindex
