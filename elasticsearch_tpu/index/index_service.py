@@ -293,8 +293,17 @@ class IndexService:
             "hits": {"total": out["total"], "max_score": out["max_score"],
                      "hits": hits},
         }
+        if out.get("terminated_early") is not None:
+            resp["terminated_early"] = bool(out["terminated_early"])
         if out["aggregations"] is not None:
             resp["aggregations"] = out["aggregations"]
+        if body.get("suggest"):
+            # suggest is its own phase beside the query program
+            # (SuggestPhase) — same host code as the fallback path
+            from elasticsearch_tpu.search.suggest import run_suggest
+
+            resp["suggest"] = run_suggest(
+                body["suggest"], self.shards, self.mapper_service)
         return resp
 
     def search(self, body: Optional[dict] = None,

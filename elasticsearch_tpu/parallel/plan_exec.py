@@ -145,14 +145,18 @@ def _strip_plan(p: PlanNode) -> PlanNode:
 
 
 class _TemplateHolder:
-    """lru_cache key: plan structure + stacked shapes; holds an
-    array-stripped template plan whose emit() defines the trace (same
-    pattern as plan.py)."""
+    """lru_cache key: plan structure + stacked shapes; holds the
+    array-stripped template plans (main, post_filter, rescore) whose
+    emit() defines the trace (same pattern as plan.py)."""
 
-    __slots__ = ("plan", "_key")
+    __slots__ = ("plan", "pf_plan", "rs_plan", "_key")
 
-    def __init__(self, plan: PlanNode, key: str):
+    def __init__(self, plan: PlanNode, key: str,
+                 pf_plan: Optional[PlanNode] = None,
+                 rs_plan: Optional[PlanNode] = None):
         self.plan = plan
+        self.pf_plan = pf_plan
+        self.rs_plan = rs_plan
         self._key = key
 
     def __hash__(self):
@@ -165,8 +169,17 @@ class _TemplateHolder:
 @functools.lru_cache(maxsize=128)
 def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
                         sort_keys: Optional[Tuple[str, str]] = None,
-                        with_views: bool = False):
-    """One compiled scatter-gather program.
+                        with_views: bool = False,
+                        features: frozenset = frozenset(),
+                        slice_col: Optional[str] = None,
+                        rescore_static: Optional[Tuple[int, str]] = None):
+    """One compiled scatter-gather program covering the collector-chain
+    semantics of the reference's query phase (QueryPhase.java:179-268) as
+    fused mask stages:
+
+      emit -> live -> min_score -> slice -> [agg view] -> post_filter ->
+      total psum -> search_after cut -> (rescore window pass) ->
+      local top-k -> all_gather global merge
 
     sort_keys: None ranks by score; (key_name, raw_name) ranks by the
     staged oriented key column and carries the raw field values for the
@@ -174,24 +187,93 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
     with_views: additionally return the per-device matched masks and
     scores (sharded, no collective) — the aggregation reduce consumes
     them as SegmentViews exactly like the host path's shard partials.
+    features: which traced scalars participate ("min_score",
+    "search_after"); their VALUES arrive via the `scalars` argument so
+    pagination does not recompile.
+    rescore_static: (window_size, score_mode) — QueryRescorer's window
+    pass over the per-device (== per-segment, matching the host's
+    per-segment window) top candidates; weights are traced scalars.
     """
     plan = holder.plan
-    n_dev = mesh.devices.size
+    pf_plan = holder.pf_plan
+    rs_plan = holder.rs_plan
 
-    def per_device(seg, plan_arrays):
+    def per_device(seg, plan_arrays, pf_arrays, rs_arrays, scalars):
         seg = {name: a[0] for name, a in seg.items()}
         ctx = EmitCtx(seg, [a[0] for a in plan_arrays])
         scores, matched = plan.emit(ctx)
         matched = matched & seg["live1"]
-        total = jax.lax.psum(jnp.sum(matched.astype(jnp.int32)), "shards")
+        # stage order mirrors the host path (search/service.py query()):
+        # min_score and slice filter BEFORE aggs see the mask;
+        # post_filter only narrows hits+total, never aggregations
+        if "min_score" in features:
+            matched = matched & (scores >= scalars["min_score"])
+        if slice_col is not None:
+            matched = matched & seg[slice_col]
+        agg_matched = matched
+        if pf_plan is not None:
+            pf_ctx = EmitCtx(seg, [a[0] for a in pf_arrays])
+            _, pf_matched = pf_plan.emit(pf_ctx)
+            matched = matched & pf_matched
+        # per-device matched count is also returned sharded: a device is
+        # one SEGMENT, but terminate_after caps per SHARD — the caller
+        # groups segment counts by shard and applies the cap host-side
+        local_count = jnp.sum(matched.astype(jnp.int32))
+        total = jax.lax.psum(local_count, "shards")
         if sort_keys is None:
             rank_key = scores
         else:
             rank_key = seg[sort_keys[0]]
         masked = jnp.where(matched, rank_key, -jnp.inf)
-        kk = min(k, masked.shape[0])
-        loc_keys, loc_docs = jax.lax.top_k(masked, kk)
-        loc_scores = scores[loc_docs]
+        if "search_after" in features:
+            # strict 'after' cut in oriented-key space: desc keys are the
+            # raw values, asc keys their negation, so "comes after the
+            # cursor" is uniformly key < after_key (hits only — total is
+            # unaffected, same as TopFieldCollector paging)
+            masked = jnp.where(rank_key < scalars["search_after"],
+                               masked, -jnp.inf)
+        nd = masked.shape[0]
+        if rs_plan is not None:
+            # QueryRescorer window pass. Candidates = the host path's
+            # k_select = max(k, window) per segment; the first `window`
+            # of them (by original rank) get combined scores, the rest
+            # keep their original score; ranking then happens over the
+            # candidate set ONLY — a doc outside it can never re-enter,
+            # exactly like the host's seg_refs list.
+            window, score_mode = rescore_static
+            ksel = min(max(k, window), nd)
+            sel_keys, sel_docs = jax.lax.top_k(masked, ksel)
+            rs_ctx = EmitCtx(seg, [a[0] for a in rs_arrays])
+            rs_scores, _ = rs_plan.emit(rs_ctx)
+            w = min(window, ksel)
+            rs_sel = rs_scores[sel_docs[:w]]
+            qw = scalars["query_weight"]
+            rqw = scalars["rescore_query_weight"]
+            base = sel_keys[:w] * qw
+            resc = rs_sel * rqw
+            if score_mode == "total":
+                comb = base + resc
+            elif score_mode == "multiply":
+                comb = jnp.where(rs_sel != 0.0, base * rs_sel, base)
+            elif score_mode == "avg":
+                comb = (base + resc) / 2.0
+            elif score_mode == "max":
+                comb = jnp.maximum(base, resc)
+            elif score_mode == "min":
+                comb = jnp.minimum(base, resc)
+            else:
+                raise ValueError(f"score_mode {score_mode}")
+            # max/min could resurrect a -inf (unmatched/padding) lane
+            comb = jnp.where(sel_keys[:w] == -jnp.inf, -jnp.inf, comb)
+            cand_keys = jnp.concatenate([comb, sel_keys[w:]])
+            kk = min(k, ksel)
+            loc_keys, loc_i = jax.lax.top_k(cand_keys, kk)
+            loc_docs = sel_docs[loc_i]
+            loc_scores = loc_keys  # the rescored score IS the hit score
+        else:
+            kk = min(k, nd)
+            loc_keys, loc_docs = jax.lax.top_k(masked, kk)
+            loc_scores = scores[loc_docs]
         # global merge over ICI: every device holds the same global top-k.
         # The merged pool holds n_dev*kk candidates, so the global cut is
         # min(k, pool) — NOT kk: when k exceeds one shard's padded doc
@@ -205,28 +287,33 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
         top_doc = all_docs[top_idx]
         top_score = all_scores[top_idx]
         if sort_keys is None:
-            top_raw = top_keys  # == scores
+            top_raw = top_keys if rs_plan is None else top_score
         else:
             loc_raw = seg[sort_keys[1]][loc_docs]
             all_raw = jax.lax.all_gather(loc_raw, "shards").reshape(-1)
             top_raw = all_raw[top_idx]
         outs = [top_keys[None], top_shard[None], top_doc[None],
-                total[None], top_score[None], top_raw[None]]
+                total[None], top_score[None], top_raw[None],
+                local_count[None]]
         if with_views:
-            outs.extend([matched[None], scores[None]])
+            outs.extend([agg_matched[None], scores[None]])
         return tuple(outs)
 
+    # 6 replicated merge outputs; local_count (index 6) and the optional
+    # views stay SHARDED (one row per device)
     n_merged = 6
+    n_out = 7 + (2 if with_views else 0)
     mapped = shard_map(
         per_device, mesh=mesh,
-        in_specs=(PS("shards"), PS("shards")),
-        out_specs=(PS("shards"),) * (n_merged + (2 if with_views else 0)),
+        in_specs=(PS("shards"), PS("shards"), PS("shards"), PS("shards"),
+                  PS()),
+        out_specs=(PS("shards"),) * n_out,
         check_vma=False,
     )
 
     @jax.jit
-    def run(seg, plan_arrays):
-        outs = mapped(seg, plan_arrays)
+    def run(seg, plan_arrays, pf_arrays, rs_arrays, scalars):
+        outs = mapped(seg, plan_arrays, pf_arrays, rs_arrays, scalars)
         # merged outputs are replicated (row 0 == row i); view outputs
         # keep their sharded leading axis
         merged = tuple(o[0] for o in outs[:n_merged])
@@ -251,14 +338,17 @@ class IndexMeshSearch:
     Staging is cached against the identity of the segment set and
     invalidated automatically when any shard refreshes/merges."""
 
-    # request keys the mesh program does not cover (yet) — presence of
-    # any of them falls back to the host path. sort and aggs ARE covered:
-    # single-field f32-exact numeric/_doc/_score sorts rank in-program,
-    # and aggregations reduce over the program's per-device matched masks
-    # with the same framework as the host path (full agg-type parity).
-    UNSUPPORTED = ("collapse", "rescore", "search_after", "slice",
-                   "post_filter", "min_score", "terminate_after", "profile",
-                   "suggest", "highlight")
+    # request keys the mesh program does not cover — presence of any of
+    # them falls back to the host path. Everything else in the query
+    # phase runs in-program: single-field f32-exact numeric/_doc/_score
+    # AND keyword (global-ordinal) sorts, aggregations (reduced over the
+    # program's per-device matched masks), post_filter / min_score /
+    # slice as fused mask stages, search_after as an oriented-key cut,
+    # rescore as an in-program window pass, terminate_after as the
+    # host-identical reported-total cap. suggest and highlight are
+    # host-side phases orthogonal to the query program (fetch/suggest
+    # phases), served on the mesh path by the same code as the host path.
+    UNSUPPORTED = ("collapse", "profile")
 
     def __init__(self, index_service, mesh: Optional[Mesh] = None):
         self.svc = index_service
@@ -332,9 +422,68 @@ class IndexMeshSearch:
             return "fallback", None
         return keys, sort_spec
 
+    def _search_after_key(self, search_after, sort_spec,
+                          sort_keys) -> Optional[float]:
+        """Map the request's search_after cursor to the oriented-key
+        space of the staged rank column (strictly-after == key < value),
+        or None when the cursor can't cut exactly on the mesh."""
+        import bisect
+
+        if not isinstance(search_after, (list, tuple)):
+            return None
+        if len(search_after) != 1:
+            return None  # must match the (single-field) sort length
+        after = search_after[0]
+        big = 3.0e38
+        if sort_spec is None:
+            # relevance paging: scores strictly below the cursor score
+            try:
+                v = float(after)
+            except (TypeError, ValueError):
+                return None
+            if float(np.float32(v)) != v:
+                return None  # f32 rounding could move the boundary
+            return v
+        _field, order, missing = sort_spec[0]
+        meta = self._executor.sort_meta.get(sort_keys[0]) or {}
+        vocab = meta.get("vocab")
+        if vocab is not None:
+            if after is None:
+                # a null cursor is a missing-value doc's rendered key:
+                # anchor at the same fill ensure_sort_column staged
+                if missing == "_first":
+                    anchor = big if order == "desc" else -big
+                else:
+                    anchor = -big if order == "desc" else big
+            else:
+                # anchor the cursor string in global-ordinal space;
+                # between-terms strings land at bisect-position - 0.5 so
+                # the strict cut stays exact either way
+                s = str(after)
+                pos = bisect.bisect_left(vocab, s)
+                present = pos < len(vocab) and vocab[pos] == s
+                anchor = float(pos) if present else pos - 0.5
+                if float(np.float32(anchor)) != anchor:
+                    return None  # pos-0.5 loses exactness past 2^23
+            oriented = anchor if order == "desc" else -anchor
+            return float(np.clip(oriented, -big, big))
+        if after is None:
+            from elasticsearch_tpu.search.service import _missing_fill
+
+            anchor = _missing_fill(missing, order)
+        else:
+            try:
+                anchor = float(after)
+            except (TypeError, ValueError):
+                return None
+            if float(np.float32(anchor)) != anchor:
+                return None
+        oriented = anchor if order == "desc" else -anchor
+        return float(np.clip(oriented, -big, big))
+
     def query(self, body: dict, k: int):
-        """Returns {total, refs, max_score, aggregations} or None if
-        ineligible."""
+        """Returns {total, refs, max_score, aggregations,
+        terminated_early} or None if ineligible."""
         from elasticsearch_tpu.search.aggregations import (
             SegmentView,
             parse_aggs,
@@ -344,7 +493,12 @@ class IndexMeshSearch:
             ShardQueryContext,
             parse_query,
         )
-        from elasticsearch_tpu.search.service import DocRef
+        from elasticsearch_tpu.search.service import (
+            _STR_SENTINEL_HIGH,
+            _STR_SENTINEL_LOW,
+            DocRef,
+            _normalize_rescore,
+        )
 
         body = body or {}
         if any(body.get(key) is not None for key in self.UNSUPPORTED):
@@ -360,9 +514,54 @@ class IndexMeshSearch:
         sort_keys, sort_spec = self._sort_plan(body)
         if sort_keys == "fallback":
             return None
+
+        features = set()
+        scalars: Dict[str, float] = {}
+        min_score = body.get("min_score")
+        if min_score is not None:
+            ms = float(min_score)
+            if float(np.float32(ms)) != ms:
+                return None  # f32 compare could move the cut boundary
+            features.add("min_score")
+            scalars["min_score"] = ms
+        slice_col = None
+        slice_spec = body.get("slice")
+        if slice_spec is not None:
+            if (not isinstance(slice_spec, dict)
+                    or "id" not in slice_spec or "max" not in slice_spec):
+                return None  # host path owns the error shape
+            slice_col = self._executor.ensure_slice_column(slice_spec)
+            if slice_col is None:
+                return None
+        search_after = body.get("search_after")
+        if search_after is not None:
+            after_key = self._search_after_key(search_after, sort_spec,
+                                               sort_keys)
+            if after_key is None:
+                return None
+            features.add("search_after")
+            scalars["search_after"] = after_key
+        terminate_after = body.get("terminate_after")
+        rescore_static = None
+        rs_qb = None
+        rescore_specs = _normalize_rescore(body.get("rescore"))
+        if rescore_specs and sort_spec is None:
+            if len(rescore_specs) != 1:
+                return None  # chained rescorers: host path
+            spec = rescore_specs[0]
+            rescore_static = (spec["window_size"], spec["score_mode"])
+            scalars["query_weight"] = spec["query_weight"]
+            scalars["rescore_query_weight"] = spec["rescore_query_weight"]
+            rs_qb = parse_query(spec["rescore_query"])
+        # (rescore with an explicit sort is a no-op on the host path too)
+
         qb = parse_query(body.get("query"))
+        pf_qb = (parse_query(body["post_filter"])
+                 if body.get("post_filter") else None)
         try:
             plans = []
+            pf_plans = [] if pf_qb is not None else None
+            rs_plans = [] if rs_qb is not None else None
             ctxs = {}
             for sid, seg in self._pairs:
                 shard = self.svc.shards[sid]
@@ -373,16 +572,38 @@ class IndexMeshSearch:
                 ctx.for_mesh = True
                 ctxs[sid] = ctx
                 plans.append(qb.to_plan(ctx, seg))
-            outs = self._executor.execute(plans, k, sort_keys=sort_keys,
-                                          with_views=bool(agg_specs))
+                if pf_qb is not None:
+                    pf_plans.append(pf_qb.to_plan(ctx, seg))
+                if rs_qb is not None:
+                    rs_plans.append(rs_qb.to_plan(ctx, seg))
+            outs = self._executor.execute(
+                plans, k, sort_keys=sort_keys,
+                with_views=bool(agg_specs), pf_plans=pf_plans,
+                rs_plans=rs_plans, scalars=scalars,
+                features=frozenset(features), slice_col=slice_col,
+                rescore_static=rescore_static)
         except PlanStructureMismatch:
             return None
         except NotImplementedError:
             return None  # a builder without a plan form
-        keys, slots, docs, total, scores, raws = outs[:6]
+        keys, slots, docs, total, scores, raws, seg_counts = outs[:7]
         keys = np.asarray(keys)
         scores = np.asarray(scores)
         raws = np.asarray(raws)
+        total = int(total)
+        # terminate_after caps per SHARD (each shard's collector stops
+        # after N docs) while a mesh device holds one SEGMENT: group the
+        # per-device counts by shard before capping — host-path contract
+        # (search/service.py query(): cap reported total, set the flag)
+        terminated_early = None
+        if terminate_after:
+            ta = int(terminate_after)
+            counts = np.asarray(seg_counts)
+            by_shard: Dict[int, int] = {}
+            for i, (sid, _seg) in enumerate(self._pairs):
+                by_shard[sid] = by_shard.get(sid, 0) + int(counts[i])
+            total = sum(min(c, ta) for c in by_shard.values())
+            terminated_early = any(c >= ta for c in by_shard.values())
         self.query_total += 1
         # per-shard search stats stay attributed even though the mesh
         # executes all shards as one program (SearchStats semantics)
@@ -390,6 +611,10 @@ class IndexMeshSearch:
             searcher = self.svc.shards[sid].searcher
             searcher.query_total += 1
             searcher.record_query_groups(body.get("stats"))
+        vocab = None
+        if sort_keys is not None:
+            vocab = (self._executor.sort_meta.get(sort_keys[0])
+                     or {}).get("vocab")
         refs = []
         max_score = None
         for i, (key, slot, d) in enumerate(zip(keys, np.asarray(slots),
@@ -399,7 +624,17 @@ class IndexMeshSearch:
             sid, seg = self._pairs[int(slot)]
             score = float(scores[i])
             if sort_keys is None:
-                sv = ()
+                sv = (score,) if rescore_static is not None else ()
+            elif vocab is not None:
+                # global ordinal back to the term; missing-fill
+                # sentinels render as the host path's string sentinels
+                # (both serialize to null)
+                raw = float(raws[i])
+                if abs(raw) >= 3.0e38:
+                    sv = (_STR_SENTINEL_HIGH if raw > 0
+                          else _STR_SENTINEL_LOW,)
+                else:
+                    sv = (vocab[int(round(raw))],)
             else:
                 # missing-fill sentinels surface as +/-inf, which
                 # fetch_hits renders as null (same as the host path)
@@ -412,8 +647,8 @@ class IndexMeshSearch:
                 max_score = score
         aggregations = None
         if agg_specs:
-            matched_np = np.asarray(outs[6])
-            scores_np = np.asarray(outs[7])
+            matched_np = np.asarray(outs[7])
+            scores_np = np.asarray(outs[8])
             views = []
             for i, (sid, seg) in enumerate(self._pairs):
                 nd1 = seg.nd_pad + 1
@@ -421,8 +656,9 @@ class IndexMeshSearch:
                     seg, matched_np[i, :nd1], ctxs[sid],
                     scores_np[i, :nd1]))
             aggregations = run_aggregations(agg_specs, views)
-        return {"total": int(total), "refs": refs, "max_score": max_score,
-                "aggregations": aggregations}
+        return {"total": total, "refs": refs, "max_score": max_score,
+                "aggregations": aggregations,
+                "terminated_early": terminated_early}
 
 
 class MeshPlanExecutor:
@@ -449,6 +685,10 @@ class MeshPlanExecutor:
             for name, arr in stacked.items()
         }
         self._sharding = sharding
+        # per staged sort column: {"vocab": [terms]|None} — keyword sorts
+        # rank by GLOBAL ordinals built over the staged segment set and
+        # the caller maps ordinals back to terms for the response
+        self.sort_meta: Dict[str, dict] = {}
 
     def ensure_sort_column(self, field: str, order: str, missing) -> Optional[
             Tuple[str, str]]:
@@ -461,12 +701,24 @@ class MeshPlanExecutor:
         not — resolution 2^-24 relative — and silently reordering near-tied
         dates would be wrong, so those fall back to the host path). The
         oriented key follows _sort_keys: negate for asc, missing-fill with
-        finite sentinels so -inf stays reserved for "not matched"."""
+        finite sentinels so -inf stays reserved for "not matched".
+
+        Keyword fields rank by GLOBAL ordinals: per-segment ordinal spaces
+        are meaningless across shards (the reference's global-ordinals
+        problem, fielddata/ordinals/GlobalOrdinalsBuilder), so the staged
+        key is each doc's position in the sorted union of every staged
+        segment's terms — exact in f32 for < 2^24 distinct terms."""
         token = (repr(missing) if isinstance(missing, (int, float))
                  else str(missing or "_last"))
         name = f"msort.{field}.{order}.{token}"
         if name in self._seg_staged:
             return name, name + ".raw"
+        ords = [s.ordinal_columns.get(field)
+                or s.ordinal_columns.get(f"{field}.keyword")
+                for s in self.segments]
+        if any(o is not None for o in ords):
+            return self._ensure_keyword_sort_column(
+                name, ords, order, missing)
         big = np.float32(3.0e38)
         keys = np.zeros((self.n_dev, self.nd1), np.float32)
         raws = np.zeros((self.n_dev, self.nd1), np.float32)
@@ -501,25 +753,120 @@ class MeshPlanExecutor:
         self._seg_staged[name] = jax.device_put(keys, self._sharding)
         self._seg_staged[name + ".raw"] = jax.device_put(
             raws, self._sharding)
+        self.sort_meta[name] = {"vocab": None}
         return name, name + ".raw"
+
+    def _ensure_keyword_sort_column(self, name: str, ords: List,
+                                    order: str, missing) -> Optional[
+            Tuple[str, str]]:
+        """Global-ordinal key columns for a keyword sort (see
+        ensure_sort_column). `ords`: per-segment ordinal column or None
+        (None = every doc in that segment is missing)."""
+        if missing not in (None, "_last", "_first"):
+            return None  # custom-string missing ranks mid-vocab: host path
+        vocab: List[str] = sorted(
+            set().union(*(o.terms for o in ords if o is not None)))
+        if len(vocab) >= (1 << 24):
+            return None  # ordinal not f32-exact
+        big = np.float32(3.0e38)
+        if missing == "_first":
+            fill = np.float64(big if order == "desc" else -big)
+        else:
+            fill = np.float64(-big if order == "desc" else big)
+        keys = np.zeros((self.n_dev, self.nd1), np.float32)
+        raws = np.zeros((self.n_dev, self.nd1), np.float32)
+        for i, (seg, ocol) in enumerate(zip(self.segments, ords)):
+            if ocol is None:
+                raw = np.full(seg.nd_pad, fill)
+            else:
+                # local ordinal -> global ordinal (terms are sorted, so
+                # searchsorted is the OrdinalMap build)
+                g = np.searchsorted(vocab, ocol.terms).astype(np.float64)
+                raw = np.where(ocol.exists, g[ocol.first_ord], fill)
+            key = np.clip(raw if order == "desc" else -raw, -big, big)
+            keys[i, : seg.nd_pad] = key.astype(np.float32)
+            keys[i, seg.nd_pad:] = -big
+            raws[i, : seg.nd_pad] = raw.astype(np.float32)
+        self._seg_staged[name] = jax.device_put(keys, self._sharding)
+        self._seg_staged[name + ".raw"] = jax.device_put(
+            raws, self._sharding)
+        self.sort_meta[name] = {"vocab": vocab}
+        return name, name + ".raw"
+
+    def ensure_slice_column(self, slice_spec: dict) -> Optional[str]:
+        """Stage the deterministic scroll-slice doc partition
+        (search/slice/SliceBuilder: murmur3(_id) % max == id) as a boolean
+        mask column; shares the host path's per-segment cache."""
+        from elasticsearch_tpu.utils.murmur3 import hash_routing
+
+        sid = int(slice_spec["id"])
+        smax = int(slice_spec["max"])
+        name = f"mslice.{smax}.{sid}"
+        if name in self._seg_staged:
+            return name
+        out = np.zeros((self.n_dev, self.nd1), bool)
+        for i, seg in enumerate(self.segments):
+            cache_key = f"slice.{smax}.{sid}"  # same key the host uses
+            mask = seg.dev_cache.get(cache_key)
+            if mask is None:
+                mask = np.zeros(seg.nd_pad + 1, dtype=bool)
+                for local, doc_id in enumerate(seg.doc_ids):
+                    if hash_routing(doc_id) % smax == sid:
+                        mask[local] = True
+                seg.dev_cache[cache_key] = mask
+            out[i, : mask.shape[0]] = mask
+        self._seg_staged[name] = jax.device_put(out, self._sharding)
+        return name
 
     def execute(self, plans: List[PlanNode], k: int,
                 sort_keys: Optional[Tuple[str, str]] = None,
-                with_views: bool = False):
+                with_views: bool = False,
+                pf_plans: Optional[List[PlanNode]] = None,
+                rs_plans: Optional[List[PlanNode]] = None,
+                scalars: Optional[dict] = None,
+                features: frozenset = frozenset(),
+                slice_col: Optional[str] = None,
+                rescore_static: Optional[Tuple[int, str]] = None):
         """plans: one per shard, same query. Returns (top_keys [k],
         top_shard [k], top_doc [k], total, top_score [k], top_raw [k]
         [, matched [n_dev, nd1], scores [n_dev, nd1]]) — doc ids are in
         the STACKED doc space (valid per-shard ids since every shard
-        zero-bases)."""
+        zero-bases).
+
+        pf_plans / rs_plans: optional per-shard post_filter and rescore
+        query plans; scalars: traced values for `features` and rescore
+        weights (compiled once per feature SET, not per value)."""
         if len(plans) != len(self.segments):
             raise ValueError("one plan per staged shard required")
         local_pads = [s.nd_pad for s in self.segments]
         stacked = stack_plans(plans, local_pads, self.nd1, self.n_dev)
-        key = (plans[0].key() + "|" + _shapes_sig(stacked)
-               + f"|k{k}|n{self.n_dev}|s{sort_keys}|v{with_views}")
+        key_parts = [plans[0].key(), _shapes_sig(stacked)]
+        stacked_pf: List[np.ndarray] = []
+        stacked_rs: List[np.ndarray] = []
+        pf_tpl = rs_tpl = None
+        if pf_plans:
+            stacked_pf = stack_plans(pf_plans, local_pads, self.nd1,
+                                     self.n_dev)
+            pf_tpl = _strip_plan(pf_plans[0])
+            key_parts += ["pf:" + pf_plans[0].key(), _shapes_sig(stacked_pf)]
+        if rs_plans:
+            stacked_rs = stack_plans(rs_plans, local_pads, self.nd1,
+                                     self.n_dev)
+            rs_tpl = _strip_plan(rs_plans[0])
+            key_parts += ["rs:" + rs_plans[0].key(), _shapes_sig(stacked_rs)]
+        key = ("|".join(key_parts)
+               + f"|k{k}|n{self.n_dev}|s{sort_keys}|v{with_views}"
+               + f"|f{sorted(features)}|sl{slice_col}|r{rescore_static}")
         run = _mesh_query_program(
-            self.mesh, _TemplateHolder(_strip_plan(plans[0]), key), k,
-            sort_keys=sort_keys, with_views=with_views)
+            self.mesh,
+            _TemplateHolder(_strip_plan(plans[0]), key, pf_tpl, rs_tpl), k,
+            sort_keys=sort_keys, with_views=with_views, features=features,
+            slice_col=slice_col, rescore_static=rescore_static)
         staged_plan = [jax.device_put(a, self._sharding) for a in stacked]
-        outs = run(self._seg_staged, staged_plan)
+        staged_pf = [jax.device_put(a, self._sharding) for a in stacked_pf]
+        staged_rs = [jax.device_put(a, self._sharding) for a in stacked_rs]
+        jscalars = {name: jnp.float32(v)
+                    for name, v in (scalars or {}).items()}
+        outs = run(self._seg_staged, staged_plan, staged_pf, staged_rs,
+                   jscalars)
         return outs

@@ -166,7 +166,18 @@ def term_blocks_arrays(segment, weighted_terms, ctx=None):
 def score_terms_node(segment, weighted_terms, min_match=1, ctx=None) -> P.PlanNode:
     arrs = term_blocks_arrays(segment, weighted_terms, ctx=ctx)
     if arrs["n_present"] == 0 or min_match > arrs["n_present"]:
-        return P.MatchNoneNode()
+        if not getattr(ctx, "for_mesh", False):
+            return P.MatchNoneNode()
+        # mesh plans must keep the SAME tree skeleton on every shard: a
+        # term that happens to miss one shard's dictionary would turn
+        # that shard's node into MatchNone and force the whole query off
+        # the mesh (PlanStructureMismatch). An all-invalid-lane scorer
+        # emits zero matches through the identical trace instead.
+        if min_match > max(arrs["n_present"], 1):
+            # unsatisfiable even with every lane valid: emit can never
+            # match, but the skeleton must still line up — pin the
+            # threshold above the padded lane count
+            min_match = arrs["q_valid"].shape[0] + 1
     node = None
     if not getattr(ctx, "for_mesh", False):
         node = _pallas_score_terms_node(segment, arrs, min_match)
