@@ -2,7 +2,9 @@
 
 Mirrors the Rally `pmc` match-query config from BASELINE.md: a synthetic
 academic-scale corpus (1M docs, zipfian vocabulary, ~80 terms/doc), a
-multi-term BM25 disjunction with top-10 collection, p50/p99 service time.
+multi-term BM25 disjunction with top-10 collection, p50 service time
+(the marginal-batch method cannot observe per-query tails, so no p99 is
+claimed; a second independent p50 estimate bounds dispersion).
 
 The primary path is the Pallas tile-scoring kernel
 (elasticsearch_tpu/ops/pallas_scoring.py): doc-tiled scatter-free scoring
@@ -43,6 +45,9 @@ N_QUERY_TERMS = 3
 K = 10
 WARMUP = 5
 ITERS = 50
+# sustained pre-timing warm-up (~3.5s of device work): ramps the chip to
+# steady state so the first timed section is not ~0.6ms/query high
+WARM_QUERIES = int(os.environ.get("BENCH_WARM_QUERIES", "6000"))
 
 TPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "540"))
 CPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "600"))
@@ -335,6 +340,23 @@ def run_measurement() -> dict:
         np.asarray(hits)  # deliberate first D2H: enter the degraded-sync
         # mode NOW so every timed section sees identical sync behavior
 
+        # sustained warm-up to steady-state clocks/pipeline: without it
+        # the FIRST timed section reads ~0.6 ms/query high regardless of
+        # what it contains (round 4 reported "merge_topk 0.829ms" in the
+        # stage breakdown — that was exactly this artifact hitting the
+        # fused program, which was measured before score-only; verified
+        # by reordering the sections in experiments/merge_variants.py:
+        # whichever variant is timed first is slow, and the same program
+        # re-timed later runs at ~0.58 ms)
+        t0 = time.perf_counter()
+        wout = None
+        for i in range(WARM_QUERIES):
+            wout = run_kernel(staged_kq[i % len(staged_kq)])
+        if wout is not None:  # BENCH_WARM_QUERIES=0 skips the warm-up
+            np.asarray(wout[0])
+        log(f"steady-state warmup: {WARM_QUERIES} queries in "
+            f"{time.perf_counter() - t0:.1f}s")
+
         timed = staged_kq[WARMUP:]
         per_query = measure_marginal(run_kernel, timed)
 
@@ -348,10 +370,11 @@ def run_measurement() -> dict:
 
         kernel_metrics = {
             "p50": per_query * 1000,
-            # marginal estimates carry no per-query tail; report a second
-            # independent estimate as a dispersion proxy
-            "p99": max(measure_marginal(run_kernel, timed),
-                       per_query) * 1000,
+            # marginal estimates carry no per-query tail — a "p99" from
+            # this method would be an artifact (round-4 VERDICT). Report
+            # a SECOND independent p50 estimate as a dispersion proxy,
+            # under a name that says what it is.
+            "p50_2": measure_marginal(run_kernel, timed) * 1000,
             "stage_score_p50": score_only * 1000,
             # gate fetch happens after all timed sections
             "gate": (top_s, top_d),
@@ -370,7 +393,7 @@ def run_measurement() -> dict:
             jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax, cb_run, rng)
 
     # ---------------- timings: legacy scatter path (r03) ----------------
-    legacy_p50 = legacy_p99 = None
+    legacy_p50 = legacy_p50_2 = None
     try:
         n_legacy = (WARMUP + 10) if kernel_metrics else (WARMUP + ITERS // 2)
 
@@ -403,8 +426,7 @@ def run_measurement() -> dict:
         # CPU-backend fallback path, where the kernel section didn't run)
         legacy_pq = measure_marginal(run_legacy, lq[WARMUP:] or lq)
         legacy_p50 = legacy_pq * 1000
-        legacy_p99 = max(measure_marginal(run_legacy, lq[WARMUP:] or lq),
-                         legacy_pq) * 1000
+        legacy_p50_2 = measure_marginal(run_legacy, lq[WARMUP:] or lq) * 1000
     except Exception as e:  # noqa: BLE001
         log(f"legacy path failed: {e}")
 
@@ -460,7 +482,7 @@ def run_measurement() -> dict:
         raise RuntimeError("both kernel and legacy paths failed")
 
     if kernel_metrics is not None:
-        p50, p99 = kernel_metrics["p50"], kernel_metrics["p99"]
+        p50, p50_2 = kernel_metrics["p50"], kernel_metrics["p50_2"]
         path = "pallas_tile_kernel"
         # HBM traffic for one kernel query: two cb-aligned posting windows
         # (docs + frac) per lane per tile + the live mask + tiny outputs
@@ -483,7 +505,7 @@ def run_measurement() -> dict:
                   "block_until_ready does not await completion, so naive "
                   "per-call timing is meaningless on this backend)")
     else:
-        p50, p99 = legacy_p50, legacy_p99
+        p50, p50_2 = legacy_p50, legacy_p50_2
         path = "xla_scatter_fallback"
         nd1 = nd_pad + 1
         bytes_per_query = (
@@ -503,7 +525,9 @@ def run_measurement() -> dict:
         "extra": {
             "backend": platform,
             "path": path,
-            "p99_ms": round(p99, 3),
+            # marginal batch timing cannot observe per-query tails; a
+            # second independent estimate bounds run-to-run dispersion
+            "p50_second_estimate_ms": round(p50_2, 3),
             "qps_per_chip": round(1000.0 / p50, 1),
             "cpu_numpy_p50_ms": round(cpu_p50, 3),
             "legacy_scatter_p50_ms": (round(legacy_p50, 3)
@@ -585,9 +609,9 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
         def run_bool():
             return bool_query(dev["docs"], dev["frac"], dev["live_t"],
                               *args_m, *args_a, dev["numeric"])
-        p50b, p99b = time_it(run_bool)
+        p50b, p50b2 = time_it(run_bool)
         out["bool_must_should_filter"] = {"p50_ms": round(p50b, 3),
-                                          "p99_ms": round(p99b, 3)}
+                                          "p50_second_estimate_ms": round(p50b2, 3)}
     except Exception as e:  # noqa: BLE001
         out["bool_must_should_filter"] = {"error": f"{type(e).__name__}: {e}"}
 
@@ -620,9 +644,9 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
         def run_agg():
             return agg_query(dev["docs"], dev["frac"], dev["live_t"],
                              *args, dev["keyword_ord"])
-        p50a, p99a = time_it(run_agg)
+        p50a, p50a2 = time_it(run_agg)
         out["terms_cardinality_agg"] = {"p50_ms": round(p50a, 3),
-                                        "p99_ms": round(p99a, 3)}
+                                        "p50_second_estimate_ms": round(p50a2, 3)}
     except Exception as e:  # noqa: BLE001
         out["terms_cardinality_agg"] = {"error": f"{type(e).__name__}: {e}"}
 
@@ -651,9 +675,9 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
         def run_rescore():
             return rescore_query(dev["docs"], dev["frac"], dev["live_t"],
                                  *args, dev["numeric"])
-        p50r, p99r = time_it(run_rescore)
+        p50r, p50r2 = time_it(run_rescore)
         out["rescore_top1000"] = {"p50_ms": round(p50r, 3),
-                                  "p99_ms": round(p99r, 3)}
+                                  "p50_second_estimate_ms": round(p50r2, 3)}
     except Exception as e:  # noqa: BLE001
         out["rescore_top1000"] = {"error": f"{type(e).__name__}: {e}"}
 

@@ -1,0 +1,135 @@
+"""Time merge-stage variants after the tile kernel on the real chip.
+
+BENCH_r04 stage_breakdown: score_tiles 0.574ms, merge_topk 0.829ms of a
+1.403ms p50. The merge is lax.top_k over n_tiles*k=640 candidates fused
+in the same jit — this experiment isolates WHAT in the merge costs and
+which replacement is fastest. Uses bench.py's corpus + marginal timing.
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import bench
+from bench import build_synthetic_corpus, measure_marginal, idf, K, WARMUP, log
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from elasticsearch_tpu.ops import pallas_scoring as psc
+
+log(f"backend: {jax.default_backend()}")
+corpus = build_synthetic_corpus()
+nd_pad = corpus["nd_pad"]
+geom = psc.tile_geometry(nd_pad)
+frac = psc.compute_block_frac(corpus["block_docs"], corpus["block_tfs"],
+                              corpus["norms"][0], corpus["avgdl"])
+bmin, bmax = psc.block_min_max(corpus["block_docs"], corpus["block_tfs"], nd_pad)
+
+rng = np.random.RandomState(3)
+# same query construction as bench
+term_sets = [list(rng.randint(50, 1000, bench.N_QUERY_TERMS))
+             for _ in range(30)]
+
+def kernel_query(terms, t_pad=4, cb=None):
+    lanes = [psc.QueryLane(int(corpus["term_block_start"][t]),
+                           int(corpus["n_blocks_per_term"][t]),
+                           idf(int(corpus["term_df"][t])))
+             for t in terms]
+    return psc.build_tile_tables(lanes, bmin, bmax, geom, t_pad=t_pad, cb=cb)
+
+kqueries = [kernel_query(ts) for ts in term_sets]
+cb_run = max(kq[3] for kq in kqueries)
+staged = [(jnp.asarray(rl), jnp.asarray(rh), jnp.asarray(w))
+          for rl, rh, w, _ in kqueries]
+dp, fp = psc.pad_segment_blocks(corpus["block_docs"], frac, nd_pad)
+live_t = psc.build_live_t(corpus["live1"][:nd_pad].astype(np.float32), geom)
+dev = {"docs": jnp.asarray(dp), "frac": jnp.asarray(fp),
+       "live_t": jnp.asarray(live_t)}
+log(f"staged; geom={geom} cb={cb_run}")
+
+def score(rl, rh, w):
+    return psc.score_tiles(dev["docs"], dev["frac"], dev["live_t"],
+                           rl, rh, w, t_pad=4, cb=cb_run,
+                           sub=geom.tile_sub, k=K)
+
+def m_none(ts, td, th):
+    return (ts,)
+
+def m_topk(ts, td, th):
+    return psc.merge_tile_topk(ts, td, th, K)
+
+def m_max(ts, td, th):
+    return (jnp.max(ts), jnp.sum(th).astype(jnp.int32))
+
+def m_iter(ts, td, th):
+    s = ts.reshape(-1); d = td.reshape(-1)
+    outs_s, outs_d = [], []
+    for _ in range(K):
+        i = jnp.argmax(s)
+        outs_s.append(s[i]); outs_d.append(d[i])
+        s = s.at[i].set(-jnp.inf)
+    return (jnp.stack(outs_s), jnp.stack(outs_d),
+            jnp.sum(th).astype(jnp.int32))
+
+def m_rank(ts, td, th):
+    s = ts.reshape(-1); d = td.reshape(-1)
+    n = s.shape[0]
+    gt = (s[None, :] > s[:, None])
+    idx = jnp.arange(n)
+    tie = (s[None, :] == s[:, None]) & (idx[None, :] < idx[:, None])
+    rank = jnp.sum((gt | tie).astype(jnp.float32), axis=1)  # 0 = best
+    sel = (rank[None, :] == jnp.arange(K, dtype=rank.dtype)[:, None])
+    self = sel.astype(jnp.float32)
+    top_s = self @ s
+    top_d = (self @ d.astype(jnp.float32)).astype(jnp.int32)
+    return top_s, top_d, jnp.sum(th).astype(jnp.int32)
+
+def m_approx(ts, td, th):
+    s = ts.reshape(-1)
+    top_s, top_i = lax.approx_max_k(s, K, recall_target=0.99)
+    return top_s, td.reshape(-1)[top_i], jnp.sum(th).astype(jnp.int32)
+
+def m_sortall(ts, td, th):
+    # single variadic sort of (s, d) pairs; slice k — is top_k's sort the
+    # cost, or its surrounding glue?
+    s = ts.reshape(-1); d = td.reshape(-1)
+    ss, dd = lax.sort((-s, d), num_keys=1)
+    return -ss[:K], dd[:K], jnp.sum(th).astype(jnp.int32)
+
+variants = {"topk": m_topk, "rank": m_rank, "none": m_none,
+            "topk2": m_topk, "none2": m_none}
+variants["topk2"] = lambda ts, td, th: psc.merge_tile_topk(ts, td, th, K)
+variants["none2"] = lambda ts, td, th: (ts,)
+# sustained warm-up: ramp device clocks/pipeline to steady state before
+# ANY timed section (the first timed variant otherwise reads ~0.6ms high)
+@jax.jit
+def warm(docs, frac_a, live_a, rl, rh, w):
+    ts, td, th = psc.score_tiles(docs, frac_a, live_a, rl, rh, w,
+                                 t_pad=4, cb=cb_run, sub=geom.tile_sub, k=K)
+    return psc.merge_tile_topk(ts, td, th, K)
+out = None
+t0 = time.perf_counter()
+nwarm = 0
+while time.perf_counter() - t0 < 4.0:
+    for q in staged:
+        out = warm(dev["docs"], dev["frac"], dev["live_t"], *q)
+        nwarm += 1
+np.asarray(out[0])
+log(f"warmed up with {nwarm} queries in {time.perf_counter()-t0:.1f}s")
+results = {}
+first = True
+for name, m in variants.items():
+    @jax.jit
+    def fused(docs, frac_a, live_a, rl, rh, w, _m=m):
+        ts, td, th = psc.score_tiles(
+            docs, frac_a, live_a, rl, rh, w,
+            t_pad=4, cb=cb_run, sub=geom.tile_sub, k=K)
+        return _m(ts, td, th)
+    def run(q, _f=fused):
+        rl, rh, w = q
+        return _f(dev["docs"], dev["frac"], dev["live_t"], rl, rh, w)
+    out = run(staged[0]); np.asarray(out[0])  # compile + first D2H
+    pq = measure_marginal(run, staged[WARMUP:])
+    results[name] = pq * 1000
+    log(f"{name:8s}: {pq*1000:.3f} ms/query")
+log("deltas vs none: " + ", ".join(
+    f"{k}={results[k]-results['none']:+.3f}" for k in results if k != "none"))
