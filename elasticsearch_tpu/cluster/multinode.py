@@ -834,13 +834,17 @@ class ClusterNode:
                             lambda i=index, s=sid: self._recover_replica(i, s))
             else:
                 if copy.primary and not shard.primary:
-                    # replica promoted: adopt the master-assigned term
-                    # (fencing) and seed a tracker from the routing
-                    # table's started copies (reference: in-sync
-                    # allocation ids from IndexMetaData) — their
-                    # checkpoints are unknown (-1) until the next write
-                    # ack, keeping the global checkpoint conservative
-                    shard.primary = True
+                    # replica promoted: DRAIN in-flight ops, then adopt
+                    # the master-assigned term (fencing) — everything
+                    # after the permit barrier runs under the new term
+                    # (IndexShardOperationPermits.blockOperations) — and
+                    # seed a tracker from the routing table's started
+                    # copies (reference: in-sync allocation ids from
+                    # IndexMetaData); their checkpoints are unknown (-1)
+                    # until the next write ack, keeping the global
+                    # checkpoint conservative
+                    shard.promote_to_primary(
+                        self.primary_terms.get((index, sid), 1))
                     from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
 
                     tracker = GlobalCheckpointTracker(self.node_id)
@@ -1279,11 +1283,15 @@ class ClusterNode:
             active = sum(1 for c in copies
                          if c.state == ShardRoutingState.STARTED)
             check_active_shards(wfas, active, len(copies), f"[{index}][{sid}]")
-        if payload["op"] == "index":
-            result = shard.index_doc(payload["id"], payload["source"],
-                                     payload.get("routing"))
-        else:
-            result = shard.delete_doc(payload["id"])
+        # primary operation permit (IndexShard.java:2089): fences ops the
+        # coordinator routed under a superseded term AND holds the permit
+        # a promotion/handoff drain waits on
+        with shard.acquire_primary_permit(payload.get("term")):
+            if payload["op"] == "index":
+                result = shard.index_doc(payload["id"], payload["source"],
+                                         payload.get("routing"))
+            else:
+                result = shard.delete_doc(payload["id"])
         # track the primary's own checkpoint, then fan out to replicas with
         # the primary-assigned seqno/version + the current global checkpoint
         # (piggybacked like the reference's replication requests)
@@ -1459,12 +1467,18 @@ class ClusterClient:
             "op": "index", "index": index, "shard": sid, "id": doc_id,
             "source": source, "routing": routing,
             "wait_for_active_shards": wait_for_active_shards,
+            # the coordinator's view of the primary term rides along so
+            # the primary's operation permit can fence ops routed under
+            # a superseded term (TransportReplicationAction carries the
+            # primary term the same way)
+            "term": self.node.primary_terms.get((index, sid)),
         })
 
     def delete(self, index: str, doc_id: str) -> dict:
         sid, primary = self._routing_entry(index, doc_id, None)
         return self.node.transport.send_request(primary, ACTION_WRITE_PRIMARY, {
             "op": "delete", "index": index, "shard": sid, "id": doc_id,
+            "term": self.node.primary_terms.get((index, sid)),
         })
 
     def get(self, index: str, doc_id: str, prefer_replica: bool = False) -> dict:

@@ -1,0 +1,147 @@
+"""Encrypted secure-settings keystore (KeyStoreWrapper analog).
+
+Role model: the reference's ``common/settings/KeyStoreWrapper.java`` +
+the ``elasticsearch-keystore`` CLI (``AddStringKeyStoreCommand``):
+secrets (repository credentials, passwords) live in an encrypted file
+beside the config, not in elasticsearch.yml, and are exposed to the node
+as filtered "secure settings".
+
+Construction (stdlib-only — no AES available in this image):
+- key = PBKDF2-HMAC-SHA256(password, salt, 100k iterations)
+- keystream block i = SHA256(key || nonce || i); ciphertext = XOR
+  (a CTR-mode stream built from a PRF — the standard construction, with
+  SHA256 as the block PRF)
+- integrity/authenticity: HMAC-SHA256(mac_key, nonce || ciphertext)
+  with mac_key = PBKDF2(password, salt || "mac"), verified before
+  decryption (encrypt-then-MAC)
+
+A fresh random nonce per save means re-saving the same secrets never
+reuses a keystream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import json
+import os
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+)
+
+_ITERATIONS = 100_000
+_MAGIC = "estpu-keystore"
+_VERSION = 1
+
+
+class KeystoreException(ElasticsearchTpuException):
+    """Wrong password, corrupted file, or tampered content."""
+
+
+def _keys(password: str, salt: bytes):
+    enc = hashlib.pbkdf2_hmac("sha256", password.encode(), salt,
+                              _ITERATIONS)
+    mac = hashlib.pbkdf2_hmac("sha256", password.encode(), salt + b"mac",
+                              _ITERATIONS)
+    return enc, mac
+
+
+def _keystream_xor(key: bytes, nonce: bytes, data: bytes) -> bytes:
+    out = bytearray(len(data))
+    block = b""
+    for i in range(len(data)):
+        j = i % 32
+        if j == 0:
+            block = hashlib.sha256(
+                key + nonce + (i // 32).to_bytes(8, "big")).digest()
+        out[i] = data[i] ^ block[j]
+    return bytes(out)
+
+
+class KeyStore:
+    """In-memory view of the secure settings; ``save``/``load`` move it
+    through the encrypted on-disk format."""
+
+    FILENAME = "elasticsearch_tpu.keystore"
+
+    def __init__(self, secrets: Optional[Dict[str, str]] = None):
+        self._secrets: Dict[str, str] = dict(secrets or {})
+
+    # --- CLI-surface operations (add/list/remove/create) ---
+
+    def set_string(self, name: str, value: str) -> None:
+        if not name or name != name.lower():
+            raise IllegalArgumentException(
+                f"keystore setting name [{name}] must be lowercase")
+        self._secrets[name] = str(value)
+
+    def get_string(self, name: str) -> Optional[str]:
+        return self._secrets.get(name)
+
+    def remove(self, name: str) -> None:
+        if name not in self._secrets:
+            raise IllegalArgumentException(
+                f"keystore does not contain setting [{name}]")
+        del self._secrets[name]
+
+    def list_settings(self) -> List[str]:
+        return sorted(self._secrets)
+
+    def as_settings_dict(self) -> Dict[str, str]:
+        """The secure settings merged (filtered) into node settings."""
+        return dict(self._secrets)
+
+    # --- persistence ---
+
+    def save(self, path: str, password: str = "") -> None:
+        salt = os.urandom(16)
+        nonce = os.urandom(16)
+        enc_key, mac_key = _keys(password, salt)
+        plaintext = json.dumps(self._secrets).encode()
+        ciphertext = _keystream_xor(enc_key, nonce, plaintext)
+        tag = _hmac.new(mac_key, nonce + ciphertext,
+                        hashlib.sha256).hexdigest()
+        payload = {
+            "magic": _MAGIC,
+            "version": _VERSION,
+            "salt": salt.hex(),
+            "nonce": nonce.hex(),
+            "tag": tag,
+            "data": ciphertext.hex(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # MetaDataStateFormat atomic-rename rule
+
+    @classmethod
+    def load(cls, path: str, password: str = "") -> "KeyStore":
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        if payload.get("magic") != _MAGIC:
+            raise KeystoreException(f"[{path}] is not a keystore file")
+        salt = bytes.fromhex(payload["salt"])
+        nonce = bytes.fromhex(payload["nonce"])
+        ciphertext = bytes.fromhex(payload["data"])
+        enc_key, mac_key = _keys(password, salt)
+        tag = _hmac.new(mac_key, nonce + ciphertext,
+                        hashlib.sha256).hexdigest()
+        if not _hmac.compare_digest(tag, payload.get("tag", "")):
+            raise KeystoreException(
+                "keystore password is wrong, or the file was tampered "
+                "with (MAC verification failed)")
+        plaintext = _keystream_xor(enc_key, nonce, ciphertext)
+        return cls(json.loads(plaintext))
+
+    @classmethod
+    def load_if_exists(cls, config_dir: str,
+                       password: str = "") -> Optional["KeyStore"]:
+        path = os.path.join(config_dir, cls.FILENAME)
+        if not os.path.exists(path):
+            return None
+        return cls.load(path, password)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from contextlib import contextmanager
 from typing import Optional
 
 from elasticsearch_tpu.common.errors import IllegalArgumentException
@@ -34,6 +35,84 @@ class ShardState:
     CLOSED = "CLOSED"
 
 
+class ShardNotPrimaryException(IllegalArgumentException):
+    """The copy is not (any longer) the primary for the operation."""
+
+
+class OperationPermits:
+    """IndexShardOperationPermits analog (reference
+    index/shard/IndexShardOperationPermits.java, acquired at
+    IndexShard.java:2089): counted operation permits with a blocking
+    drain. Writers hold a permit across the engine op; a primary-term
+    bump or relocation handoff calls ``block_and_drain`` — new
+    acquisitions park, in-flight ones finish — and runs its critical
+    section against a quiesced shard."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._active = 0
+        self._blocked = False
+        # reentrancy: a thread already holding a permit (e.g. the
+        # replication layer wrapping shard.index_doc, which acquires its
+        # own) must not park behind a drain it would itself block
+        self._local = threading.local()
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    @contextmanager
+    def acquire(self, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        depth = getattr(self._local, "depth", 0)
+        with self._cond:
+            while self._blocked and depth == 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise IllegalArgumentException(
+                        "timed out waiting for operation permit "
+                        "(shard is draining)")
+                self._cond.wait(remaining)
+            self._active += 1
+        self._local.depth = depth + 1
+        try:
+            yield
+        finally:
+            self._local.depth = depth
+            with self._cond:
+                self._active -= 1
+                self._cond.notify_all()
+
+    @contextmanager
+    def block_and_drain(self, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._blocked:  # one drain at a time
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise IllegalArgumentException(
+                        "timed out waiting for a concurrent drain")
+                self._cond.wait(remaining)
+            self._blocked = True
+            try:
+                while self._active > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise IllegalArgumentException(
+                            "timed out draining in-flight operations")
+                    self._cond.wait(remaining)
+            except BaseException:
+                self._blocked = False
+                self._cond.notify_all()
+                raise
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._blocked = False
+                self._cond.notify_all()
+
+
 class IndexShard:
     def __init__(self, index_name: str, shard_id: int, mapper_service,
                  data_path: Optional[str] = None, primary: bool = True,
@@ -47,6 +126,9 @@ class IndexShard:
         self.primary = primary
         self.primary_term = 1
         self.state = ShardState.CREATED
+        # operation permits: writers hold one across the engine op;
+        # promotion/handoff drains (IndexShardOperationPermits)
+        self.permits = OperationPermits()
         # primary-side GlobalCheckpointTracker (set by the replication
         # layer when replicas exist; None = single copy)
         self.checkpoints = None
@@ -136,13 +218,52 @@ class IndexShard:
     # Write ops (primary-term fenced in the clustered path)
     # ------------------------------------------------------------------
 
+    def acquire_primary_permit(self, op_term: Optional[int] = None,
+                               timeout: float = 30.0):
+        """Primary-term-fenced operation permit
+        (IndexShard.acquirePrimaryOperationPermit, IndexShard.java:2089).
+        ``op_term``: the term the coordinator routed the op under — an
+        op carrying a term OLDER than this copy's current term raced a
+        promotion and must be rejected (the new primary may have
+        re-assigned its seqno); None means a local single-node op that
+        trivially runs under the current term."""
+        if not self.primary:
+            raise ShardNotPrimaryException(
+                f"shard [{self.index_name}][{self.shard_id}] is not a "
+                f"primary")
+        if op_term is not None and op_term < self.primary_term:
+            raise ShardNotPrimaryException(
+                f"operation primary term [{op_term}] is too old (current "
+                f"[{self.primary_term}])")
+        return self.permits.acquire(timeout=timeout)
+
+    def promote_to_primary(self, new_term: int) -> None:
+        """Replica promotion: drain in-flight ops, then adopt the
+        master-assigned term so everything after the barrier is fenced
+        by it (primaryTerm bump under blockOperations in the
+        reference)."""
+        with self.permits.block_and_drain():
+            self.primary = True
+            self.primary_term = max(self.primary_term, new_term)
+
+    @contextmanager
+    def relocation_handoff(self):
+        """Primary relocation handoff: quiesce the shard, run the
+        handoff critical section, then reject further primary ops here
+        (IndexShard.relocated + the drain inside blockOperations)."""
+        with self.permits.block_and_drain():
+            yield
+            self.primary = False
+
     def index_doc(self, doc_id: str, source: dict, routing: Optional[str] = None,
                   version: Optional[int] = None, version_type: str = "internal",
                   op_type: str = "index", seqno: Optional[int] = None) -> dict:
         self._ensure_started()
         t0 = time.monotonic()
-        r = self.engine.index(doc_id, source, routing, version, version_type,
-                              op_type, seqno, primary_term=self.primary_term)
+        with self.permits.acquire():
+            r = self.engine.index(doc_id, source, routing, version,
+                                  version_type, op_type, seqno,
+                                  primary_term=self.primary_term)
         self._maybe_indexing_slowlog(time.monotonic() - t0, doc_id, source)
         r["_index"] = self.index_name
         r["_shard"] = self.shard_id
@@ -170,9 +291,10 @@ class IndexShard:
                    seqno: Optional[int] = None,
                    version_type: str = "internal") -> dict:
         self._ensure_started()
-        r = self.engine.delete(doc_id, version, seqno,
-                               primary_term=self.primary_term,
-                               version_type=version_type)
+        with self.permits.acquire():
+            r = self.engine.delete(doc_id, version, seqno,
+                                   primary_term=self.primary_term,
+                                   version_type=version_type)
         r["_index"] = self.index_name
         r["_primary_term"] = self.primary_term
         return r
@@ -227,6 +349,12 @@ class IndexShard:
             "query_total": self.searcher.query_total,
             "query_time_in_millis": int(self.searcher.query_time * 1000),
             "fetch_total": self.searcher.fetch_total,
+            # which scoring engine served each segment query (execution-
+            # plane observability; index-level stats add mesh vs host)
+            "planes": {
+                "pallas_segments_total": self.searcher.pallas_segments_total,
+                "scatter_segments_total": self.searcher.scatter_segments_total,
+            },
         }
         if self.searcher.group_stats:
             s["search"]["groups"] = {

@@ -58,6 +58,18 @@ class Node:
         self.index_scoped_settings = index_scoped_settings()
         self.data_path = data_path or PATH_DATA.get(settings)
         self.persistent_path = data_path is not None or "path.data" in settings
+        # secure settings from the encrypted keystore (KeyStoreWrapper):
+        # kept OUT of the displayed settings (filtered) — consumers read
+        # node.secure_settings explicitly, like the reference's
+        # SecureSettings surface
+        self.secure_settings: Dict[str, str] = {}
+        if self.persistent_path and os.path.isdir(self.data_path or ""):
+            from elasticsearch_tpu.common.keystore import KeyStore
+
+            ks = KeyStore.load_if_exists(
+                self.data_path, os.environ.get("ES_TPU_KEYSTORE_PASS", ""))
+            if ks is not None:
+                self.secure_settings = ks.as_settings_dict()
         node = DiscoveryNode(self.node_id, self.node_name, "127.0.0.1:9300")
         initial = ClusterState(
             CLUSTER_NAME.get(settings),

@@ -214,7 +214,12 @@ def register_all(c) -> None:
     r("GET", "/_snapshot/{repo}", lambda n, q: (200, n.snapshots.get_repository(q.param("repo"))))
     r("DELETE", "/_snapshot/{repo}", lambda n, q: (200, n.snapshots.delete_repository(q.param("repo"))))
     r("PUT", "/_snapshot/{repo}/{snapshot}", lambda n, q: (200, n.snapshots.create_snapshot(
-        q.param("repo"), q.param("snapshot"), q.json_body({}))))
+        q.param("repo"), q.param("snapshot"), q.json_body({}),
+        wait_for_completion=q.bool_param("wait_for_completion", True))))
+    r("GET", "/_snapshot/{repo}/_status", lambda n, q: (200, n.snapshots.snapshot_status(
+        q.param("repo"))))
+    r("GET", "/_snapshot/{repo}/{snapshot}/_status", lambda n, q: (200, n.snapshots.snapshot_status(
+        q.param("repo"), q.param("snapshot"))))
     r("GET", "/_snapshot/{repo}/{snapshot}", lambda n, q: (200, n.snapshots.get_snapshot(
         q.param("repo"), q.param("snapshot"))))
     r("DELETE", "/_snapshot/{repo}/{snapshot}", lambda n, q: (200, n.snapshots.delete_snapshot(

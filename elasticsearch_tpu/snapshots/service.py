@@ -23,6 +23,7 @@ import time
 from typing import Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
     IllegalArgumentException,
     ResourceAlreadyExistsException,
     ResourceNotFoundException,
@@ -34,6 +35,7 @@ class SnapshotState:
     SUCCESS = "SUCCESS"
     IN_PROGRESS = "IN_PROGRESS"
     FAILED = "FAILED"
+    ABORTED = "ABORTED"
 
 
 # process-wide repo root for in-memory nodes: a shared-filesystem repository
@@ -99,11 +101,17 @@ class FsRepository:
 
 class SnapshotsService:
     def __init__(self, node):
+        import threading
+
         self.node = node
         self.repositories: Dict[str, FsRepository] = {}
         # RepositoryPlugin extension point: {type: factory(name, settings,
         # node)} — fs is built-in, cloud types arrive via plugins
         self.repository_types: Dict[str, object] = {}
+        # live snapshot progress: (repo, snapshot) -> tracking dict
+        # (SnapshotsInProgress custom in the reference's cluster state)
+        self._in_progress: Dict[tuple, dict] = {}
+        self._progress_lock = threading.Lock()
 
     # --- repositories ---
 
@@ -180,55 +188,189 @@ class SnapshotsService:
     # --- snapshot ---
 
     def create_snapshot(self, repo_name: str, snapshot: str,
-                        body: Optional[dict] = None) -> dict:
+                        body: Optional[dict] = None,
+                        wait_for_completion: bool = True) -> dict:
+        """Coordinated snapshot with live per-shard progress tracking
+        (SnapshotsService:105 + SnapshotShardsService). With
+        ``wait_for_completion=False`` the copy runs on a background
+        thread and ``_snapshot/_status`` reports shard stages mid-flight;
+        deleting an IN_PROGRESS snapshot aborts it and leaves the repo
+        consistent (the partial directory is removed)."""
+        import threading
+
         repo = self._repo(repo_name)
         body = body or {}
-        if snapshot in repo.list_snapshots():
-            raise ResourceAlreadyExistsException(
-                f"[{repo_name}:{snapshot}] snapshot with the same name already exists"
-            )
-        indices_expr = body.get("indices", "_all")
-        names = self.node.cluster_service.state.resolve_index_names(indices_expr)
-        snap_dir = repo.snapshot_path(snapshot)
-        os.makedirs(snap_dir, exist_ok=True)
-        manifest = {
-            "snapshot": snapshot,
-            "state": SnapshotState.IN_PROGRESS,
-            "start_time_in_millis": int(time.time() * 1000),
-            "indices": {},
-        }
-        shards_total = 0
-        for name in names:
-            svc = self.node.indices[name]
-            svc.flush()  # durable commit before copying (the reference
-            # snapshots from a Lucene commit the same way)
-            md = self.node.cluster_service.state.indices[name]
-            idx_dir = os.path.join(snap_dir, "indices", name)
-            shard_info = {}
-            for sid, shard in svc.shards.items():
-                shards_total += 1
-                src = shard.engine.store.directory
-                dst = os.path.join(idx_dir, str(sid))
-                shutil.copytree(src, dst, dirs_exist_ok=True)
-                shard_info[str(sid)] = {"segments": len(shard.engine.segments)}
-            manifest["indices"][name] = {
-                "settings": md.settings.as_dict(),
-                "mappings": svc.mapping_dict(),
-                "aliases": md.aliases,
-                "shards": shard_info,
+        key = (repo_name, snapshot)
+        with self._progress_lock:
+            if key in self._in_progress:
+                raise ResourceAlreadyExistsException(
+                    f"[{repo_name}:{snapshot}] snapshot is already running")
+            if snapshot in repo.list_snapshots():
+                raise ResourceAlreadyExistsException(
+                    f"[{repo_name}:{snapshot}] snapshot with the same name "
+                    f"already exists")
+            indices_expr = body.get("indices", "_all")
+            names = self.node.cluster_service.state.resolve_index_names(
+                indices_expr)
+            progress = {
+                "state": SnapshotState.IN_PROGRESS,
+                "start_time_in_millis": int(time.time() * 1000),
+                "abort": threading.Event(),
+                "done": threading.Event(),
+                # (index, sid) -> stage: INIT | STARTED | DONE | FAILURE
+                "shards": {(n, sid): "INIT" for n in names
+                           for sid in self.node.indices[n].shards},
+                "result": None,
             }
-        manifest["state"] = SnapshotState.SUCCESS
-        manifest["end_time_in_millis"] = int(time.time() * 1000)
-        with open(os.path.join(snap_dir, "manifest.json"), "w", encoding="utf-8") as f:
-            json.dump(manifest, f)
-        return {"snapshot": {
-            "snapshot": snapshot,
-            "uuid": snapshot,
-            "state": manifest["state"],
-            "indices": list(manifest["indices"].keys()),
-            "shards": {"total": shards_total, "failed": 0,
-                       "successful": shards_total},
-        }}
+            self._in_progress[key] = progress
+        if wait_for_completion:
+            self._run_snapshot(repo, repo_name, snapshot, names, progress)
+            if progress["state"] == SnapshotState.FAILED:
+                # synchronous callers get the error as an error, exactly
+                # as before the async path existed — not a 200 whose body
+                # lacks the success shape
+                raise ElasticsearchTpuException(
+                    f"[{repo_name}:{snapshot}] snapshot failed: "
+                    f"{progress['result'].get('reason')}")
+            return {"snapshot": progress["result"]}
+        t = threading.Thread(
+            target=self._run_snapshot,
+            args=(repo, repo_name, snapshot, names, progress),
+            name=f"snapshot[{repo_name}:{snapshot}]", daemon=True)
+        t.start()
+        return {"accepted": True}
+
+    def _run_snapshot(self, repo, repo_name: str, snapshot: str,
+                      names, progress) -> None:
+        key = (repo_name, snapshot)
+        snap_dir = repo.snapshot_path(snapshot)
+        aborted = False
+        try:
+            os.makedirs(snap_dir, exist_ok=True)
+            manifest = {
+                "snapshot": snapshot,
+                "state": SnapshotState.IN_PROGRESS,
+                "start_time_in_millis": progress["start_time_in_millis"],
+                "indices": {},
+            }
+            shards_total = 0
+            for name in names:
+                svc = self.node.indices[name]
+                svc.flush()  # durable commit before copying (the
+                # reference snapshots from a Lucene commit the same way)
+                md = self.node.cluster_service.state.indices[name]
+                idx_dir = os.path.join(snap_dir, "indices", name)
+                shard_info = {}
+                for sid, shard in svc.shards.items():
+                    if progress["abort"].is_set():
+                        aborted = True
+                        break
+                    progress["shards"][(name, sid)] = "STARTED"
+                    shards_total += 1
+                    src = shard.engine.store.directory
+                    dst = os.path.join(idx_dir, str(sid))
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                    shard_info[str(sid)] = {
+                        "segments": len(shard.engine.segments)}
+                    progress["shards"][(name, sid)] = "DONE"
+                if aborted:
+                    break
+                manifest["indices"][name] = {
+                    "settings": md.settings.as_dict(),
+                    "mappings": svc.mapping_dict(),
+                    "aliases": md.aliases,
+                    "shards": shard_info,
+                }
+            if aborted:
+                # abort leaves the repository consistent: the partial
+                # snapshot directory is removed entirely (the reference
+                # cleans up aborted shard blobs the same way)
+                shutil.rmtree(snap_dir, ignore_errors=True)
+                progress["state"] = SnapshotState.ABORTED
+                progress["result"] = {
+                    "snapshot": snapshot, "state": SnapshotState.ABORTED}
+                return
+            manifest["state"] = SnapshotState.SUCCESS
+            manifest["end_time_in_millis"] = int(time.time() * 1000)
+            with open(os.path.join(snap_dir, "manifest.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(manifest, f)
+            progress["state"] = SnapshotState.SUCCESS
+            progress["result"] = {
+                "snapshot": snapshot,
+                "uuid": snapshot,
+                "state": manifest["state"],
+                "indices": list(manifest["indices"].keys()),
+                "shards": {"total": shards_total, "failed": 0,
+                           "successful": shards_total},
+            }
+        except Exception as e:  # noqa: BLE001 — surface via status
+            shutil.rmtree(snap_dir, ignore_errors=True)
+            progress["state"] = SnapshotState.FAILED
+            progress["result"] = {"snapshot": snapshot,
+                                  "state": SnapshotState.FAILED,
+                                  "reason": f"{type(e).__name__}: {e}"}
+        finally:
+            progress["done"].set()
+            with self._progress_lock:
+                self._in_progress.pop(key, None)
+
+    def snapshot_status(self, repo_name: str,
+                        snapshot: Optional[str] = None) -> dict:
+        """_snapshot/_status (TransportSnapshotsStatusAction): live
+        per-shard stages for running snapshots; completed ones from the
+        repository manifest. Without a snapshot name: every snapshot
+        currently running in the repo."""
+        out = []
+        with self._progress_lock:
+            running = {k: v for k, v in self._in_progress.items()
+                       if k[0] == repo_name}
+        if snapshot in (None, "_current"):
+            wanted = list(running)
+        else:
+            wanted = [(repo_name, snapshot)]
+        for key in wanted:
+            prog = running.get(key)
+            if prog is not None:
+                stages = prog["shards"]
+                counts = {"initializing": 0, "started": 0, "done": 0,
+                          "failed": 0}
+                per_index: dict = {}
+                for (iname, sid), stage in stages.items():
+                    counts[{"INIT": "initializing", "STARTED": "started",
+                            "DONE": "done",
+                            "FAILURE": "failed"}[stage]] += 1
+                    per_index.setdefault(iname, {})[str(sid)] = {
+                        "stage": stage}
+                out.append({
+                    "snapshot": key[1],
+                    "repository": repo_name,
+                    "state": prog["state"],
+                    "shards_stats": dict(counts,
+                                         total=len(stages)),
+                    "indices": per_index,
+                })
+                continue
+            repo = self._repo(repo_name)
+            if key[1] not in repo.list_snapshots():
+                raise ResourceNotFoundException(
+                    f"[{repo_name}:{key[1]}] snapshot does not exist")
+            m = repo.read_manifest(key[1])
+            shards = {(iname, sid)
+                      for iname, info in m["indices"].items()
+                      for sid in info.get("shards", {})}
+            out.append({
+                "snapshot": key[1],
+                "repository": repo_name,
+                "state": m["state"],
+                "shards_stats": {"initializing": 0, "started": 0,
+                                 "failed": 0, "done": len(shards),
+                                 "total": len(shards)},
+                "indices": {iname: {str(sid): {"stage": "DONE"}
+                                    for sid in info.get("shards", {})}
+                            for iname, info in m["indices"].items()},
+            })
+        return {"snapshots": out}
 
     def get_snapshot(self, repo_name: str, snapshot: Optional[str] = None) -> dict:
         repo = self._repo(repo_name)
@@ -249,6 +391,21 @@ class SnapshotsService:
         return {"snapshots": out}
 
     def delete_snapshot(self, repo_name: str, snapshot: str) -> dict:
+        # DELETE of a RUNNING snapshot aborts it (SnapshotsService:105:
+        # deleteSnapshot sets the abort flag and waits for the shards to
+        # stop); the worker removes the partial directory itself
+        with self._progress_lock:
+            prog = self._in_progress.get((repo_name, snapshot))
+        if prog is not None:
+            prog["abort"].set()
+            prog["done"].wait(30)
+            if prog["state"] != SnapshotState.ABORTED:
+                # the worker raced past the abort flag and completed (or
+                # the wait timed out): fall through to the filesystem
+                # delete so the ack is truthful either way
+                pass
+            else:
+                return {"acknowledged": True}
         repo = self._repo(repo_name)
         path = repo.snapshot_path(snapshot)
         if not os.path.exists(path):
