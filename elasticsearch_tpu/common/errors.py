@@ -79,8 +79,26 @@ class QueryShardException(ElasticsearchTpuException):
     status_code = 400
 
 
+class QueryPhaseExecutionException(ElasticsearchTpuException):
+    """Query phase failed executing (ES: 500) — e.g. slice count over
+    index.max_slices_per_scroll."""
+
+    status_code = 500
+
+
 class MapperParsingException(ElasticsearchTpuException):
     status_code = 400
+
+
+class RoutingMissingException(ElasticsearchTpuException):
+    """A parent-mapped (or routing-required) type got a single-doc op
+    without routing/parent (ES: RoutingMissingException, 400)."""
+
+    status_code = 400
+
+    def __init__(self, doc_type: str, doc_id: str):
+        super().__init__(
+            f"routing is required for [{doc_type}]/[{doc_id}]")
 
 
 class IllegalArgumentException(ElasticsearchTpuException):

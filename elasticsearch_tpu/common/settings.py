@@ -417,8 +417,11 @@ NODE_SETTINGS = [
 
 # --- index-scoped ---
 
+# 6.x default: FIVE primary shards (IndexMetaData.SETTING_NUMBER_OF_SHARDS
+# default; 7.0 changed it to 1) — conformance tests encode the 5-shard
+# doc distribution
 INDEX_NUMBER_OF_SHARDS = Setting.int_setting(
-    "index.number_of_shards", 1, min_value=1, max_value=1024, scope=Scope.INDEX
+    "index.number_of_shards", 5, min_value=1, max_value=1024, scope=Scope.INDEX
 )
 INDEX_NUMBER_OF_REPLICAS = Setting.int_setting(
     "index.number_of_replicas", 1, min_value=0, scope=Scope.INDEX, dynamic=True
@@ -428,6 +431,10 @@ INDEX_REFRESH_INTERVAL = Setting.time_setting(
 )
 INDEX_MAX_RESULT_WINDOW = Setting.int_setting(
     "index.max_result_window", 10000, min_value=1, scope=Scope.INDEX, dynamic=True
+)
+INDEX_MAX_SLICES_PER_SCROLL = Setting.int_setting(
+    "index.max_slices_per_scroll", 1024, min_value=1, scope=Scope.INDEX,
+    dynamic=True
 )
 INDEX_BLOCK_SIZE = Setting.int_setting(
     # TPU-specific: posting block width (lane dimension); must stay a
@@ -459,6 +466,7 @@ INDEX_SETTINGS = [
     INDEX_NUMBER_OF_REPLICAS,
     INDEX_REFRESH_INTERVAL,
     INDEX_MAX_RESULT_WINDOW,
+    INDEX_MAX_SLICES_PER_SCROLL,
     INDEX_BLOCK_SIZE,
     INDEX_TRANSLOG_DURABILITY,
     INDEX_TRANSLOG_FLUSH_THRESHOLD,

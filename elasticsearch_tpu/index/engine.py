@@ -24,6 +24,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from elasticsearch_tpu.common.errors import VersionConflictEngineException
 from elasticsearch_tpu.index.segment import Segment, SegmentBuilder
 from elasticsearch_tpu.index.translog import Translog, TranslogOp
@@ -69,6 +71,9 @@ class Engine:
         self.segments: List[Segment] = []
         self.buffer = self._new_builder()
         self._buffer_deletes: set = set()
+        # deletes against SEALED segments buffered until the next refresh
+        # (NRT visibility — see _tombstone): (segment_name, local_doc)
+        self._pending_seg_deletes: List[tuple] = []
         self._buffer_routings: Dict[int, Optional[str]] = {}
         self.version_map: Dict[str, VersionEntry] = {}
         self._seqno = -1  # last assigned
@@ -85,6 +90,11 @@ class Engine:
         self._last_tombstone_prune = 0.0
         self._lock = threading.RLock()
         self.refresh_count = 0
+        # bumps only when a refresh CHANGED visibility (sealed new docs
+        # or applied buffered deletes) — the request-cache epoch
+        # component for delete-only refreshes, whose segment names and
+        # write counters are otherwise unchanged
+        self.visibility_epoch = 0
         self.flush_count = 0
         self.indexing_total = 0
         self.delete_total = 0
@@ -289,10 +299,12 @@ class Engine:
         if entry.segment is None:
             self._buffer_deletes.add(entry.local_doc)
         else:
-            for seg in self.segments:
-                if seg.name == entry.segment:
-                    seg.delete_doc(entry.local_doc)
-                    break
+            # NRT semantics: a delete against a sealed segment becomes
+            # SEARCH-visible only at the next refresh (Lucene applies
+            # buffered deletes on reader reopen); realtime GET sees it
+            # immediately through the version map tombstone
+            self._pending_seg_deletes.append(
+                (entry.segment, entry.local_doc))
 
     # ------------------------------------------------------------------
     # Read path
@@ -361,12 +373,28 @@ class Engine:
             del self.version_map[doc_id]
 
     def refresh(self) -> bool:
-        """Seal the buffer into a searchable segment (NRT reader swap)."""
+        """Seal the buffer into a searchable segment + apply buffered
+        sealed-segment deletes (NRT reader swap)."""
         with self._lock:
             self.refresh_count += 1
             self._prune_tombstones()
+            applied_deletes = bool(self._pending_seg_deletes)
+            if applied_deletes:
+                by_seg: Dict[str, list] = {}
+                for seg_name, local in self._pending_seg_deletes:
+                    by_seg.setdefault(seg_name, []).append(local)
+                for seg in self.segments:
+                    locals_ = by_seg.get(seg.name)
+                    if locals_:
+                        seg.delete_docs(np.asarray(locals_, dtype=np.int64))
+                self._pending_seg_deletes = []
             if self.buffer.num_docs == 0:
-                return False
+                if applied_deletes:
+                    self.visibility_epoch += 1
+                    for listener in self._refresh_listeners:
+                        listener()
+                    self._refresh_listeners = []
+                return applied_deletes
             seg = self.buffer.seal()
             # index sorting permutes docs at seal; pre-seal local ids held
             # by the version map / buffered deletes must translate
@@ -385,15 +413,18 @@ class Engine:
             self.buffer = self._new_builder()
             self._buffer_deletes = set()
             self._buffer_routings = {}
+            self.visibility_epoch += 1
             for listener in self._refresh_listeners:
                 listener()
             self._refresh_listeners = []
             return True
 
     def add_refresh_listener(self, listener) -> None:
-        """wait_for refresh support (RefreshListeners in the reference)."""
+        """wait_for refresh support (RefreshListeners in the reference).
+        Fires immediately only when NOTHING is pending visibility —
+        buffered docs AND buffered sealed-segment deletes both wait."""
         with self._lock:
-            if self.buffer.num_docs == 0:
+            if self.buffer.num_docs == 0 and not self._pending_seg_deletes:
                 listener()
             else:
                 self._refresh_listeners.append(listener)

@@ -76,6 +76,10 @@ class IndexService:
                                indexing_slowlog_source_chars=idx_slow_source)
             if gc_deletes is not None:
                 shard.engine.gc_deletes = gc_deletes
+            # slice resolution is shard-count-aware (SliceBuilder)
+            shard.searcher.num_shards = self.num_shards
+            shard.searcher.max_slices = settings.get_int(
+                "index.max_slices_per_scroll", 1024)
             if shard_path and shard.engine.store.read_commit() is not None:
                 shard.recover_from_store()
             elif shard_path and os.path.exists(
@@ -103,6 +107,9 @@ class IndexService:
         self._get_total = 0
         self._refresh_total = 0
         self._host_query_total = 0
+        # legacy _parent metadata field values (ParentFieldMapper):
+        # doc_id -> parent id, surfaced via stored_fields [_parent]
+        self.parents: Dict[str, str] = {}
         self._flush_total = 0
         cache_bytes = settings.get_int(
             "index.requests.cache.size_in_bytes", 8 * 1024 * 1024)
@@ -188,12 +195,23 @@ class IndexService:
         shard = self.shards[self._route(doc_id, routing)]
         return shard.delete_doc(doc_id, **kw)
 
-    def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None) -> dict:
+    def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None,
+                   version: Optional[int] = None) -> dict:
         """Update API (action/update/TransportUpdateAction): partial doc
         merge, upsert, doc_as_upsert; scripted updates run painless over
-        ctx._source with ctx.op semantics (UpdateHelper.executeScripts)."""
+        ctx._source with ctx.op semantics (UpdateHelper.executeScripts).
+        ``version``: internal optimistic-concurrency check against the
+        CURRENT doc version (UpdateRequest versioning)."""
         shard = self.shards[self._route(doc_id, routing)]
         existing = shard.get_doc(doc_id)
+        if version is not None and existing.found \
+                and existing.version != version:
+            from elasticsearch_tpu.common.errors import (
+                VersionConflictEngineException,
+            )
+
+            raise VersionConflictEngineException(
+                doc_id, existing.version, version)
         if not existing.found:
             # upserts go through index_doc so join-routing checks apply
             if body.get("doc_as_upsert") and "doc" in body:

@@ -138,5 +138,8 @@ def shard_epoch(shard) -> tuple:
     so writes must invalidate even though the buffered new doc isn't
     searchable yet)."""
     eng = shard.engine
+    # visibility_epoch moves on delete-only refreshes, whose segment
+    # names and write counters are unchanged (buffered NRT deletes)
     return (tuple(s.name for s in eng.searchable_segments()),
-            eng.indexing_total, eng.delete_total)
+            eng.indexing_total, eng.delete_total,
+            eng.visibility_epoch)

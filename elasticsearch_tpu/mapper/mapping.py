@@ -387,6 +387,14 @@ class MapperService:
     def dynamic(self) -> str:
         return str(self._mapping.get("dynamic", "true")).lower()
 
+    @property
+    def parent_type(self) -> Optional[str]:
+        """Legacy ``_parent`` metadata field (ParentFieldMapper): its
+        presence makes routing REQUIRED on single-doc ops, with the
+        ``parent`` param acting as the routing value."""
+        p = self._mapping.get("_parent") or {}
+        return p.get("type")
+
     def mapping_dict(self) -> dict:
         return copy.deepcopy(self._mapping)
 

@@ -471,7 +471,7 @@ class Node:
             doc_id = _uuid.uuid4().hex[:20]
             kw.setdefault("op_type", "create")
         r = svc.index_doc(doc_id, source, routing, **kw)
-        self._maybe_refresh(svc, refresh)
+        self._maybe_refresh(svc, refresh, doc_id=doc_id, routing=routing)
         self._maybe_update_mapping_meta(index)
         return r
 
@@ -485,15 +485,26 @@ class Node:
 
         check_active_shards(wanted, 1, 1 + svc.num_replicas, f"[{svc.name}]")
 
-    def _maybe_refresh(self, svc: IndexService, refresh) -> None:
+    def _maybe_refresh(self, svc: IndexService, refresh,
+                       doc_id=None, routing=None) -> None:
+        """Write-op refresh policy (TransportWriteAction). A write's
+        ``refresh=true`` refreshes ONLY the written shard — another
+        shard's still-buffered deletes must not become visible as a side
+        effect (the reference refreshes the shard the op ran on)."""
         if refresh in (True, "true", ""):
-            svc.refresh()
+            if doc_id is not None:
+                svc.shards[svc._route(doc_id, routing)].refresh()
+            else:
+                svc.refresh()
         elif refresh == "wait_for":
             # refresh=wait_for (RefreshListeners): block until the periodic
             # refresh makes the write visible; force one when the scheduler
             # is disabled (the listener-cap forced refresh analog)
             if not svc.refresh_interval or svc.refresh_interval <= 0:
-                svc.refresh()
+                if doc_id is not None:
+                    svc.shards[svc._route(doc_id, routing)].refresh()
+                else:
+                    svc.refresh()
                 return
             import threading
 
@@ -545,60 +556,111 @@ class Node:
             out["_version"] = g.version
             out["_seq_no"] = g.seqno
             out["_source"] = g.source
-            if routing is not None:
+            # the STORED routing (a parent-only write stores the parent
+            # as routing); fall back to echoing the request param
+            stored_routing = getattr(g, "routing", None)
+            if stored_routing is not None:
+                out["_routing"] = stored_routing
+            elif routing is not None:
                 out["_routing"] = routing
         return out
 
     def delete_doc(self, index: str, doc_id: str, routing=None, refresh=None, **kw) -> dict:
         svc = self.index_service(index)
         r = svc.delete_doc(doc_id, routing, **kw)
-        self._maybe_refresh(svc, refresh)
+        self._maybe_refresh(svc, refresh, doc_id=doc_id, routing=routing)
         return r
 
     def update_doc(self, index: str, doc_id: str, body: dict, routing=None,
-                   refresh=None) -> dict:
+                   refresh=None, version=None) -> dict:
         # upserts auto-create the index like every other write
         # (TransportUpdateAction resolves through auto-create)
         auto = "upsert" in (body or {}) or (body or {}).get("doc_as_upsert")
         svc = self.index_service(index, auto_create=bool(auto))
-        r = svc.update_doc(doc_id, body, routing)
-        self._maybe_refresh(svc, refresh)
+        r = svc.update_doc(doc_id, body, routing, version=version)
+        self._maybe_refresh(svc, refresh, doc_id=doc_id, routing=routing)
         self._maybe_update_mapping_meta(index)
         return r
 
     def mget(self, body: dict, default_index: Optional[str] = None,
              default_type: Optional[str] = None, realtime: bool = True,
-             refresh=None) -> dict:
+             refresh=None, stored_fields=None) -> dict:
         specs = body.get("docs")
         if specs is None and "ids" in body:
             # short form: {"ids": [...]} against the URL's index
             specs = [{"_id": i} for i in body["ids"]]
-        docs = []
-        for spec in specs or []:
-            index = spec.get("_index", default_index)
+        # whole-request validation (MultiGetRequest.validate): any bad
+        # item fails the REQUEST, not just the item
+        problems = []
+        if not specs:
+            problems.append("no documents to get")
+        for i, spec in enumerate(specs or []):
             if "_id" not in spec:
-                docs.append({
-                    "_index": index,
-                    "_type": spec.get("_type", default_type) or "_doc",
-                    "error": {
-                        "type": "action_request_validation_exception",
-                        "reason": "Validation Failed: 1: id is missing;",
-                    },
-                })
-                continue
+                problems.append("id is missing")
+            if spec.get("_index", default_index) is None:
+                problems.append("index is missing")
+        if problems:
+            raise ActionRequestValidationException(
+                "Validation Failed: " + " ".join(
+                    f"{i + 1}: {p};" for i, p in enumerate(problems)))
+        docs = []
+        for spec in specs:
+            index = spec.get("_index", default_index)
             routing = spec.get("routing", spec.get("_routing"))
+            if routing is None:
+                # legacy _parent: the parent id routes the doc
+                routing = spec.get("parent", spec.get("_parent"))
+            if routing is not None:
+                routing = str(routing)
             try:
                 d = self.get_doc(index, str(spec["_id"]), routing,
                                  realtime=realtime, refresh=refresh)
+                try:
+                    svc = self.index_service(index)
+                except Exception:  # noqa: BLE001 — handled as missing
+                    svc = None
+                stored = (spec.get("stored_fields") or spec.get("fields")
+                          or stored_fields)
+                if isinstance(stored, str):
+                    # MultiGetRequest accepts a single field name / CSV
+                    stored = [f for f in stored.split(",") if f]
+                if d.get("found") and stored and svc is not None:
+                    if "_parent" in stored:
+                        p = svc.parents.get(str(spec["_id"]))
+                        if p is not None:
+                            d["_parent"] = p
+                    src = d.get("_source") or {}
+                    fields = {}
+                    for f in stored:
+                        if f in ("_source", "_parent", "_routing"):
+                            continue
+                        ft = svc.mapper_service.field_type(f)
+                        if (ft is None or not ft.params.get("store", False)
+                                or f not in src):
+                            continue
+                        v = src[f]
+                        fields[f] = v if isinstance(v, list) else [v]
+                    if fields:
+                        d["fields"] = fields
+                    if "_source" not in stored:
+                        d.pop("_source", None)
+                if d.get("found") and "_source" in spec:
+                    # per-doc source filtering (FetchSourceContext)
+                    from elasticsearch_tpu.search.service import (
+                        _parse_source_spec,
+                        filter_source,
+                    )
+
+                    inc, exc, enabled = _parse_source_spec(spec["_source"])
+                    if not enabled:
+                        d.pop("_source", None)
+                    elif "_source" in d:
+                        d["_source"] = filter_source(d["_source"], inc, exc)
                 want_type = spec.get("_type", default_type)
                 d["_type"] = want_type or "_doc"
                 if want_type not in (None, "_all", "_doc"):
                     # a typed request only matches the index's actual type
                     # (alias-aware resolution, like get_doc itself)
-                    try:
-                        svc = self.index_service(index)
-                    except Exception:  # noqa: BLE001 — handled as missing
-                        svc = None
                     actual = getattr(svc, "doc_type", "_doc") or "_doc"
                     if want_type != actual:
                         d = {"_index": index, "_type": want_type,
@@ -606,7 +668,7 @@ class Node:
                 docs.append(d)
             except IndexNotFoundException:
                 docs.append({
-                    "_index": index, "_id": spec["_id"],
+                    "_index": index, "_id": str(spec["_id"]),
                     "_type": spec.get("_type", default_type) or "_doc",
                     "error": {"type": "index_not_found_exception",
                               "reason": f"no such index [{index}]"},
@@ -628,6 +690,10 @@ class Node:
             index = meta.get("_index")
             doc_id = meta.get("_id")
             routing = meta.get("routing") or meta.get("_routing")
+            parent = meta.get("parent") or meta.get("_parent")
+            if routing is None and parent is not None:
+                # legacy _parent: the parent id routes the doc
+                routing = str(parent)
             item_pipeline = meta.get("pipeline", pipeline)
             try:
                 if action == "index":
@@ -649,6 +715,11 @@ class Node:
                         f"Malformed action/metadata line, expected one of "
                         f"[create, delete, index, update] but found [{action}]"
                     )
+                if (parent is not None and r.get("_id")
+                        and action in ("index", "create", "update")):
+                    svc_p = self.indices.get(index)
+                    if svc_p is not None:
+                        svc_p.parents[str(r["_id"])] = str(parent)
                 touched.add(r.get("_index", index))
                 item = {action: {**{k: v for k, v in r.items() if k != "found"},
                                  "status": status}}
@@ -1283,6 +1354,11 @@ class Node:
         for n in names:
             svc = self.indices[n]
             svc.settings = svc.settings.merged_with(normalized)
+            # dynamic knobs consumed at query time re-read per request
+            # through svc.settings; per-searcher cached ones re-sync here
+            for shard in svc.shards.values():
+                shard.searcher.max_slices = svc.settings.get_int(
+                    "index.max_slices_per_scroll", 1024)
             self._persist_index_meta(n)
         return {"acknowledged": True}
 
@@ -1406,6 +1482,10 @@ class Node:
             Settings.from_dict(settings).with_index_prefix()
             .get("index.number_of_shards", 1)
         )
+        # pin the validated count into the create body: the index-level
+        # DEFAULT is 5 (6.x), so an unset value must not silently build
+        # an unshrunk 5-shard target
+        settings.setdefault("index.number_of_shards", target_shards)
         if svc.num_shards % target_shards != 0:
             raise IllegalArgumentException(
                 f"the number of source shards [{svc.num_shards}] must be a "
@@ -1475,8 +1555,8 @@ class Node:
 
 MAPPING_TOP_LEVEL_KEYS = {
     "properties", "dynamic", "dynamic_templates", "_source", "_meta",
-    "_routing", "_all", "_field_names", "_size", "date_detection",
-    "numeric_detection", "dynamic_date_formats",
+    "_routing", "_all", "_field_names", "_size", "_parent",
+    "date_detection", "numeric_detection", "dynamic_date_formats",
 }
 
 

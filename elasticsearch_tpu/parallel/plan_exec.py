@@ -267,8 +267,15 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
             comb = jnp.where(sel_keys[:w] == -jnp.inf, -jnp.inf, comb)
             cand_keys = jnp.concatenate([comb, sel_keys[w:]])
             kk = min(k, ksel)
-            loc_keys, loc_i = jax.lax.top_k(cand_keys, kk)
-            loc_docs = sel_docs[loc_i]
+            # rescoring reorders candidates, so ties in the COMBINED
+            # score must re-break by doc id to match the host's
+            # (-score, local_doc) sort — a plain top_k would keep
+            # original-rank order for ties (score_mode max/min produce
+            # exact ties routinely). Lexicographic (-score, doc) sort:
+            neg_sorted, docs_sorted = jax.lax.sort(
+                (-cand_keys, sel_docs), num_keys=2)
+            loc_keys = -neg_sorted[:kk]
+            loc_docs = docs_sorted[:kk]
             loc_scores = loc_keys  # the rescored score IS the hit score
         else:
             kk = min(k, nd)
@@ -530,7 +537,12 @@ class IndexMeshSearch:
             if (not isinstance(slice_spec, dict)
                     or "id" not in slice_spec or "max" not in slice_spec):
                 return None  # host path owns the error shape
-            slice_col = self._executor.ensure_slice_column(slice_spec)
+            try:
+                slice_col = self._executor.ensure_slice_column(
+                    slice_spec, [sid for sid, _seg in self._pairs],
+                    len(self.svc.shards))
+            except Exception:  # noqa: BLE001 — host path owns errors
+                return None
             if slice_col is None:
                 return None
         search_after = body.get("search_after")
@@ -793,25 +805,37 @@ class MeshPlanExecutor:
         self.sort_meta[name] = {"vocab": vocab}
         return name, name + ".raw"
 
-    def ensure_slice_column(self, slice_spec: dict) -> Optional[str]:
-        """Stage the deterministic scroll-slice doc partition
-        (search/slice/SliceBuilder: murmur3(_id) % max == id) as a boolean
-        mask column; shares the host path's per-segment cache."""
-        from elasticsearch_tpu.utils.murmur3 import hash_routing
+    def ensure_slice_column(self, slice_spec: dict,
+                            shard_of_device: List[int],
+                            num_shards: int) -> Optional[str]:
+        """Stage the deterministic slice doc partition as a boolean mask
+        column, shard-aware like the host path (SliceBuilder.toFilter's
+        three regimes — see search/service.resolve_slice); shares the
+        host path's per-segment mask cache."""
+        from elasticsearch_tpu.search.service import resolve_slice
+        from elasticsearch_tpu.utils.murmur3 import hash_slice_id
 
         sid = int(slice_spec["id"])
         smax = int(slice_spec["max"])
-        name = f"mslice.{smax}.{sid}"
+        name = f"mslice.{smax}.{sid}.{num_shards}"
         if name in self._seg_staged:
             return name
         out = np.zeros((self.n_dev, self.nd1), bool)
         for i, seg in enumerate(self.segments):
-            cache_key = f"slice.{smax}.{sid}"  # same key the host uses
+            resolved = resolve_slice(slice_spec, shard_of_device[i],
+                                     num_shards)
+            if resolved == "skip":
+                continue  # all-False row
+            if resolved is None:
+                out[i, : seg.nd_pad] = True  # whole shard in the slice
+                continue
+            rid, rmax = int(resolved["id"]), int(resolved["max"])
+            cache_key = f"slice.{rmax}.{rid}"  # same key the host uses
             mask = seg.dev_cache.get(cache_key)
             if mask is None:
                 mask = np.zeros(seg.nd_pad + 1, dtype=bool)
                 for local, doc_id in enumerate(seg.doc_ids):
-                    if hash_routing(doc_id) % smax == sid:
+                    if hash_slice_id(doc_id) % rmax == rid:
                         mask[local] = True
                 seg.dev_cache[cache_key] = mask
             out[i, : mask.shape[0]] = mask

@@ -51,7 +51,14 @@ def register_all(c) -> None:
     r("GET", "/{index}/{type}/{id}/_source", _get_source)
     r("POST", "/_mget", _mget)
     r("POST", "/{index}/_mget", _mget)
+    r("POST", "/{index}/{type}/_mget", _mget)
+    # explicit literal route: "/{index}/_doc/{id}" is MORE specific than
+    # "/{index}/{type}/_mget", so type "_doc" would otherwise index the
+    # mget body as a document with _id "_mget"
+    r("POST", "/{index}/_doc/_mget", _mget)
     r("GET", "/_mget", _mget)
+    r("GET", "/{index}/{type}/_mget", _mget)
+    r("GET", "/{index}/_doc/_mget", _mget)
 
     # --- bulk ---
     r("POST", "/_bulk", _bulk)
@@ -69,7 +76,10 @@ def register_all(c) -> None:
     r("POST", "/{index}/_search", _search)
     r("POST", "/_search/scroll", _scroll)
     r("GET", "/_search/scroll", _scroll)
+    r("POST", "/_search/scroll/{scroll_id}", _scroll)
+    r("GET", "/_search/scroll/{scroll_id}", _scroll)
     r("DELETE", "/_search/scroll", _clear_scroll)
+    r("DELETE", "/_search/scroll/{scroll_id}", _clear_scroll)
     r("POST", "/_msearch", _msearch)
     r("GET", "/_msearch", _msearch)
     r("POST", "/{index}/_msearch", _msearch)
@@ -180,8 +190,15 @@ def register_all(c) -> None:
     r("GET", "/_cluster/allocation/explain", _allocation_explain)
     r("GET", "/_nodes", lambda n, q: (200, n.node_info()))
     r("GET", "/_nodes/stats", lambda n, q: (200, n.node_stats()))
+    r("GET", "/_nodes/stats/{metric}", lambda n, q: (200, n.node_stats()))
+    r("GET", "/_nodes/stats/{metric}/{index_metric}",
+      lambda n, q: (200, n.node_stats()))
     r("GET", "/_nodes/{node_id}", lambda n, q: (200, n.node_info()))
     r("GET", "/_nodes/{node_id}/stats", lambda n, q: (200, n.node_stats()))
+    r("GET", "/_nodes/{node_id}/stats/{metric}",
+      lambda n, q: (200, n.node_stats()))
+    r("GET", "/_nodes/{node_id}/stats/{metric}/{index_metric}",
+      lambda n, q: (200, n.node_stats()))
     r("GET", "/_remote/info", lambda n, q: (200, n.remote_clusters.info()))
 
     # --- tasks ---
@@ -370,6 +387,33 @@ def _record_doc_type(node, req):
         svc.doc_type = t
 
 
+def _parent_routing(node, req):
+    """Legacy ``_parent`` metadata field (ParentFieldMapper): the
+    ``parent`` param acts as the routing value, and a parent-mapped type
+    REQUIRES parent/routing on every single-doc op
+    (RoutingMissingException). Returns (effective_routing, parent)."""
+    from elasticsearch_tpu.common.errors import RoutingMissingException
+
+    routing = req.param("routing")
+    parent = req.param("parent")
+    eff = routing if routing is not None else parent
+    if eff is None:
+        svc = node.indices.get(req.param("index"))
+        if (svc is not None
+                and svc.mapper_service.parent_type is not None):
+            raise RoutingMissingException(
+                svc.doc_type or "_doc", req.param("id") or "")
+    return eff, parent
+
+
+def _record_parent(node, req, doc_id, parent):
+    if parent is None or doc_id is None:
+        return
+    svc = node.indices.get(req.param("index"))
+    if svc is not None:
+        svc.parents[str(doc_id)] = str(parent)
+
+
 def _index_doc(node, req, force_create: bool = False):
     _validate_type_param(req)
     _typed_api_warning(req)
@@ -383,11 +427,13 @@ def _index_doc(node, req, force_create: bool = False):
         kw["version_type"] = req.param("version_type", "internal")
     if force_create or req.param("op_type") == "create":
         kw["op_type"] = "create"
+    routing, parent = _parent_routing(node, req)
     r = node.index_doc(req.param("index"), req.param("id"), body,
-                       routing=req.param("routing"), refresh=req.param("refresh"),
+                       routing=routing, refresh=req.param("refresh"),
                        pipeline=req.param("pipeline"),
                        wait_for_active_shards=req.param("wait_for_active_shards"),
                        **kw)
+    _record_parent(node, req, r.get("_id"), parent)
     _record_doc_type(node, req)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return (201 if r.get("result") == "created" else 200), r
@@ -408,10 +454,12 @@ def _index_doc_auto_id(node, req):
     body = req.json_body()
     if body is None:
         raise ActionRequestValidationException("Validation Failed: 1: source is missing;")
+    routing, parent = _parent_routing(node, req)
     r = node.index_doc(req.param("index"), None, body,
-                       routing=req.param("routing"), refresh=req.param("refresh"),
+                       routing=routing, refresh=req.param("refresh"),
                        pipeline=req.param("pipeline"),
                        wait_for_active_shards=req.param("wait_for_active_shards"))
+    _record_parent(node, req, r.get("_id"), parent)
     _record_doc_type(node, req)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return 201, r
@@ -452,8 +500,9 @@ def _realtime_params(req):
 
 def _get_doc(node, req):
     _typed_api_warning(req)
+    routing, _parent = _parent_routing(node, req)
     r = node.get_doc(req.param("index"), req.param("id"),
-                     req.param("routing"), **_realtime_params(req))
+                     routing, **_realtime_params(req))
     if r["found"] and req.param("version") is not None:
         # GetRequest version check: reading a stale version conflicts
         try:
@@ -477,6 +526,13 @@ def _get_doc(node, req):
         for f in wanted:
             if f == "_source":
                 continue
+            if f == "_parent":
+                p = svc.parents.get(str(req.param("id")))
+                if p is not None:
+                    r["_parent"] = p
+                continue
+            if f == "_routing":
+                continue  # node.get_doc already set the stored value
             ft = svc.mapper_service.field_type(f)
             if (ft is None or not ft.params.get("store", False)
                     or f not in src):
@@ -492,14 +548,16 @@ def _get_doc(node, req):
 
 
 def _head_doc(node, req):
+    routing, _parent = _parent_routing(node, req)
     r = node.get_doc(req.param("index"), req.param("id"),
-                     req.param("routing"), **_realtime_params(req))
+                     routing, **_realtime_params(req))
     return (200 if r["found"] else 404), {}
 
 
 def _get_source(node, req):
+    routing, _parent = _parent_routing(node, req)
     r = node.get_doc(req.param("index"), req.param("id"),
-                     req.param("routing"), **_realtime_params(req))
+                     routing, **_realtime_params(req))
     if not r["found"]:
         return 404, {}
     _apply_source_filtering(req, r)
@@ -512,8 +570,9 @@ def _delete_doc(node, req):
     if req.param("version") is not None:
         kw["version"] = int(req.param("version"))
         kw["version_type"] = req.param("version_type", "internal")
+    routing, _parent = _parent_routing(node, req)
     r = node.delete_doc(req.param("index"), req.param("id"),
-                        routing=req.param("routing"),
+                        routing=routing,
                         refresh=req.param("refresh"), **kw)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     return (200 if r.get("found") else 404), r
@@ -521,8 +580,18 @@ def _delete_doc(node, req):
 
 def _update_doc(node, req):
     _typed_api_warning(req)
+    routing, parent = _parent_routing(node, req)
+    version = req.param("version")
+    if version is not None and req.param(
+            "version_type", "internal") != "internal":
+        # UpdateRequest.validate(): only internal versioning applies
+        raise ActionRequestValidationException(
+            "Validation Failed: 1: version type [force/external] is not "
+            "supported by the update API;")
     r = node.update_doc(req.param("index"), req.param("id"), req.json_body({}),
-                        routing=req.param("routing"), refresh=req.param("refresh"))
+                        routing=routing, refresh=req.param("refresh"),
+                        version=int(version) if version is not None else None)
+    _record_parent(node, req, r.get("_id"), parent)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
     src_param = req.param("_source")
     want_get = (req.param("fields")
@@ -547,9 +616,12 @@ def _update_doc(node, req):
 
 def _mget(node, req):
     rp = _realtime_params(req)
+    stored = req.param("stored_fields")
     return 200, node.mget(req.json_body({}), req.param("index"),
                           req.param("type"), realtime=rp["realtime"],
-                          refresh=rp["refresh"])
+                          refresh=rp["refresh"],
+                          stored_fields=([f for f in str(stored).split(",")
+                                          if f] if stored else None))
 
 
 def _bulk(node, req):
@@ -644,10 +716,14 @@ def _scroll(node, req):
 
 def _clear_scroll(node, req):
     body = req.json_body({}) or {}
-    ids = body.get("scroll_id") or ["_all"]
+    ids = body.get("scroll_id") or req.param("scroll_id") or ["_all"]
     if isinstance(ids, str):
-        ids = [ids]
-    return 200, node.clear_scroll(ids)
+        ids = [i for i in ids.split(",") if i]
+    r = node.clear_scroll(ids)
+    # clearing ids none of which existed is a 404 (RestClearScrollAction
+    # maps num_freed == 0 to NOT_FOUND); _all always acknowledges
+    status = 200 if (r.get("num_freed", 0) > 0 or ids == ["_all"]) else 404
+    return status, r
 
 
 def _msearch(node, req):

@@ -544,6 +544,14 @@ class TermQueryBuilder(QueryBuilder):
         self.value = value
 
     def to_plan(self, ctx, segment):
+        if self.field == "_id":
+            # term on the _id metadata field == ids query (the reference
+            # routes both through IdFieldMapper's term query)
+            vals = (self.value if isinstance(self.value, list)
+                    else [self.value])
+            return IdsQueryBuilder(
+                [str(v) for v in vals], boost=self.boost).to_plan(
+                    ctx, segment)
         ft = ctx.field_type(self.field)
         from elasticsearch_tpu.mapper.field_types import RangeFieldType
 
@@ -605,6 +613,10 @@ class TermsQueryBuilder(QueryBuilder):
         self.values = values
 
     def to_plan(self, ctx, segment):
+        if self.field == "_id":
+            return IdsQueryBuilder(
+                [str(v) for v in self.values], boost=self.boost).to_plan(
+                    ctx, segment)
         ft = ctx.field_type(self.field)
         if isinstance(ft, (NumberFieldType, DateFieldType)):
             csr = _numeric_csr(segment, self.field)

@@ -219,7 +219,14 @@ class ShardSearcher:
             if min_score is not None:
                 matched = matched & (scores >= float(min_score))
             if slice_spec is not None:
-                matched = matched & self._slice_mask(seg, slice_spec)
+                resolved = resolve_slice(
+                    dict(slice_spec,
+                         _limit=getattr(self, "max_slices", 1024)),
+                    self.shard_id, getattr(self, "num_shards", 1))
+                if resolved == "skip":
+                    matched = np.zeros_like(matched)
+                elif resolved is not None:
+                    matched = matched & self._slice_mask(seg, resolved)
             if agg_views is not None and agg_specs:
                 agg_views.append(SegmentView(seg, matched.copy(), self.ctx, scores))
             if post_qb is not None:
@@ -347,9 +354,11 @@ class ShardSearcher:
         smax = int(slice_spec["max"])
         key = f"slice.{smax}.{sid}"
         if key not in seg.dev_cache:
+            from elasticsearch_tpu.utils.murmur3 import hash_slice_id
+
             mask = np.zeros(seg.nd_pad + 1, dtype=bool)
             for local, doc_id in enumerate(seg.doc_ids):
-                if hash_routing(doc_id) % smax == sid:
+                if hash_slice_id(doc_id) % smax == sid:
                     mask[local] = True
             seg.dev_cache[key] = mask
         return seg.dev_cache[key]
@@ -677,6 +686,45 @@ def _search_after_mask(key_arrays, sort_spec, after_values) -> np.ndarray:
         eq &= arr == a
     mask = np.concatenate([gt, np.zeros(1, dtype=bool)])
     return mask
+
+
+def resolve_slice(spec: dict, shard_id: int, num_shards: int):
+    """SliceBuilder.toFilter's shard-aware slice resolution
+    (search/slice/SliceBuilder.java:195-255). Returns:
+    - "skip": this shard is not part of the slice (MatchNoDocsQuery)
+    - None: the whole shard belongs to the slice (MatchAllDocsQuery)
+    - {"id", "max"}: doc-hash partition to apply within the shard
+    The three regimes: single shard → plain doc hash; max >= shards →
+    shards round-robin over slices with an intra-shard sub-partition;
+    max < shards → whole shards grouped per slice, no doc hashing."""
+    sid, smax = int(spec["id"]), int(spec["max"])
+    if smax <= 1:
+        raise IllegalArgumentException("max must be greater than 1")
+    if sid < 0 or sid >= smax:
+        raise IllegalArgumentException(
+            f"id must be in [0, {smax}), got {sid}")
+    limit = int(spec.get("_limit", 1024))
+    if smax > limit:
+        from elasticsearch_tpu.common.errors import (
+            QueryPhaseExecutionException,
+        )
+
+        raise QueryPhaseExecutionException(
+            f"The number of slices [{smax}] is too large. It must be "
+            f"less than [{limit}]. This limit can be set by changing "
+            f"the [index.max_slices_per_scroll] index level setting.")
+    if num_shards == 1:
+        return {"id": sid, "max": smax}
+    if smax >= num_shards:
+        target = sid % num_shards
+        if target != shard_id:
+            return "skip"
+        n_in_shard = smax // num_shards + (
+            1 if smax % num_shards > target else 0)
+        if n_in_shard == 1:
+            return None
+        return {"id": sid // num_shards, "max": n_in_shard}
+    return None if shard_id % smax == sid else "skip"
 
 
 def _normalize_rescore(body) -> List[dict]:

@@ -56,8 +56,58 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
     return h1 - (1 << 32) if h1 >= (1 << 31) else h1
 
 
+def encode_id(doc_id: str) -> bytes:
+    """The reference's binary _id term encoding
+    (index/mapper/Uid.java:232 encodeId): positive-numeric ids pack two
+    digits per nibble-pair behind a 0xfe marker, URL-base64 ids decode
+    to their raw bytes (0xfd escape when ambiguous), everything else is
+    0xff + UTF-8. The slice partition hash runs over THESE bytes."""
+    if not doc_id:
+        raise ValueError("Ids can't be empty")
+    if doc_id.isascii() and doc_id.isdigit():
+        out = bytearray([0xFE])
+        for i in range(0, len(doc_id), 2):
+            b1 = ord(doc_id[i]) - ord("0")
+            b2 = (ord(doc_id[i + 1]) - ord("0")
+                  if i + 1 < len(doc_id) else 0x0F)
+            out.append((b1 << 4) | b2)
+        return bytes(out)
+    if _is_url_base64_without_padding(doc_id):
+        import base64
+
+        raw = base64.urlsafe_b64decode(doc_id + "=" * (-len(doc_id) % 4))
+        if raw and raw[0] >= 0xFD:
+            return bytes([0xFD]) + raw
+        return raw
+    return bytes([0xFF]) + doc_id.encode("utf-8")
+
+
+def _is_url_base64_without_padding(doc_id: str) -> bool:
+    n = len(doc_id)
+    if n % 4 == 1:
+        return False
+    if n % 4 == 2 and doc_id[-1] not in "AQgw":
+        return False
+    if n % 4 == 3 and doc_id[-1] not in "AEIMQUYcgkosw048":
+        return False
+    return all(c.isascii() and (c.isalnum() or c in "-_") for c in doc_id)
+
+
+def hash_slice_id(doc_id: str) -> int:
+    """The slice partition hash (search/slice/TermsSliceQuery.java:80):
+    murmur3_x86_32 over the ENCODED _id term bytes (Uid.encodeId) with
+    the FIXED seed 7919 (StringHelper's default seed is
+    startup-time-random, so the query pins its own). floorMod against
+    slice ``max`` picks the slice."""
+    return murmur3_32(encode_id(doc_id), seed=7919)
+
+
 def hash_routing(routing: str) -> int:
-    return murmur3_32(routing.encode("utf-8"))
+    # the reference hashes the routing string's UTF-16LE char bytes, NOT
+    # UTF-8 (Murmur3HashFunction.hash(String): bytesToHash[i*2]=(byte)c,
+    # [i*2+1]=(byte)(c>>>8)) — matching it exactly keeps doc->shard
+    # placement identical to an Elasticsearch cluster's
+    return murmur3_32(routing.encode("utf-16-le"))
 
 
 def shard_id_for(routing: str, num_shards: int, partition_size: int = 1,

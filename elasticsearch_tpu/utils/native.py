@@ -118,7 +118,9 @@ def shard_ids_batch(routings: List[str], num_shards: int) -> Optional[np.ndarray
     lib = _try_load()
     if lib is None:
         return None
-    encoded = [r.encode("utf-8") for r in routings]
+    # UTF-16LE: the reference's Murmur3HashFunction hashes the routing
+    # string's char bytes little-endian (see utils/murmur3.hash_routing)
+    encoded = [r.encode("utf-16-le") for r in routings]
     buf = b"".join(encoded)
     offsets = np.zeros(len(encoded) + 1, dtype=np.int32)
     np.cumsum([len(e) for e in encoded], out=offsets[1:])

@@ -48,7 +48,7 @@ class TestSettings:
 
     def test_setting_default_and_validation(self):
         shards = S.INDEX_NUMBER_OF_SHARDS
-        assert shards.get(Settings.EMPTY) == 1
+        assert shards.get(Settings.EMPTY) == 5  # the 6.x default
         assert shards.get(Settings({"index.number_of_shards": "4"})) == 4
         with pytest.raises(IllegalArgumentException):
             shards.get(Settings({"index.number_of_shards": 0}))

@@ -125,7 +125,9 @@ class TestEngine:
         e.refresh()
         r = e.delete("1")
         assert r["result"] == "deleted"
-        assert not e.get("1").found
+        assert not e.get("1").found  # realtime GET sees the tombstone
+        assert e.num_docs == 1  # NRT: search-invisible until refresh
+        e.refresh()
         assert e.num_docs == 0
         assert e.delete("nope")["result"] == "not_found"
 

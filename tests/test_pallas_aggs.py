@@ -190,8 +190,10 @@ class TestEngineScoringParity:
                  "eta", "theta"]
         monkeypatch.setenv("ES_TPU_PALLAS", "off")
         node = Node()
-        node.create_index("docs", {"mappings": {"_doc": {"properties": {
-            "body": {"type": "text"}}}}})
+        node.create_index("docs", {
+            "settings": {"number_of_shards": 1},
+            "mappings": {"_doc": {"properties": {
+                "body": {"type": "text"}}}}})
         for i in range(120):
             text = " ".join(rng.choice(words, rng.randint(3, 9)))
             node.index_doc("docs", str(i), {"body": text},
@@ -231,6 +233,7 @@ class TestEnginePallasParity:
 
         node = Node()
         node.create_index("logs", {
+            "settings": {"number_of_shards": 1},
             "mappings": {"_doc": {"properties": {
                 "host": {"type": "keyword"},
                 "latency": {"type": "float"},

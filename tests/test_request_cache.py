@@ -10,6 +10,7 @@ from elasticsearch_tpu.node import Node
 def make_node():
     node = Node()
     node.create_index("logs", {
+        "settings": {"number_of_shards": 1},
         "mappings": {"_doc": {"properties": {
             "host": {"type": "keyword"},
             "msg": {"type": "text"},
@@ -48,18 +49,22 @@ class TestRequestCache:
     def test_write_invalidates_before_refresh(self):
         node = make_node()
         node.search("logs", dict(AGG_BODY))
-        # update an existing doc: the old copy dies immediately (live
-        # mask), so the cached total of 40 would be stale even though the
-        # new doc isn't searchable until refresh
+        # update an existing doc: per NRT semantics NOTHING changes for
+        # search until refresh (the old copy's delete is buffered, the
+        # new copy sits in the indexing buffer) — the cached entry stays
+        # valid and the refresh flips visibility + epoch together
         node.index_doc("logs", "7", {"host": "web-9", "msg": "changed"})
         r = node.search("logs", dict(AGG_BODY))
-        assert r["hits"]["total"] == 39  # old copy dead, new one unrefreshed
-        assert cache_stats(node)["hit_count"] == 0
+        assert r["hits"]["total"] == 40  # unchanged reader, cache valid
+        node.indices["logs"].refresh()
+        r = node.search("logs", dict(AGG_BODY))
+        # old copy out; the replacement doc no longer matches the query
+        assert r["hits"]["total"] == 39
 
     def test_delete_invalidates(self):
         node = make_node()
         node.search("logs", dict(AGG_BODY))
-        node.delete_doc("logs", "3")
+        node.delete_doc("logs", "3", refresh=True)
         r = node.search("logs", dict(AGG_BODY))
         assert r["hits"]["total"] == 39
         assert cache_stats(node)["hit_count"] == 0

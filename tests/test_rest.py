@@ -322,8 +322,11 @@ class TestIndexAdmin:
         ok(client.perform("POST", "/idx/_forcemerge"))
         r = ok(client.perform("GET", "/idx/_segments"))
         shards = r["indices"]["idx"]["shards"]
+        # force-merge leaves at most ONE segment per shard (docs spread
+        # over the default 5 shards, so shards-with-docs each show 1)
+        assert all(len(s[0]["segments"]) <= 1 for s in shards.values())
         total_segs = sum(len(s[0]["segments"]) for s in shards.values())
-        assert total_segs == 1
+        assert total_segs >= 1
 
 
 class TestClusterApi:

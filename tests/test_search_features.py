@@ -300,8 +300,10 @@ class TestExplainDetail:
         from elasticsearch_tpu.rest.controller import RestController
 
         node = Node()
-        node.create_index("ex", {"mappings": {"_doc": {"properties": {
-            "t": {"type": "text"}}}}})
+        node.create_index("ex", {
+            "settings": {"number_of_shards": 1},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text"}}}}})
         for i in range(10):
             node.index_doc(
                 "ex", str(i),
