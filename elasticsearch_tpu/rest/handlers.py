@@ -1263,6 +1263,72 @@ def _get_mapping(node, req):
     return 200, out
 
 
+def _flat_field_mappings(props: dict, prefix: str = "") -> dict:
+    """Flatten a properties tree to {full_path: leaf_params} (object
+    containers themselves are not fields)."""
+    out = {}
+    for name, params in (props or {}).items():
+        path = f"{prefix}{name}"
+        child = (params or {}).get("properties")
+        if child and "type" not in (params or {}):
+            out.update(_flat_field_mappings(child, path + "."))
+            continue
+        if child:
+            out.update(_flat_field_mappings(child, path + "."))
+        out[path] = {k: v for k, v in (params or {}).items()
+                     if k != "properties"}
+        for sub, sub_params in ((params or {}).get("fields") or {}).items():
+            out[f"{path}.{sub}"] = dict(sub_params or {})
+    return out
+
+
+def _get_field_mapping(node, req):
+    """GET /_mapping/field/{fields} (TransportGetFieldMappingsAction):
+    per-index, per-type field mapping extracts with full_name + the
+    field's mapping subtree; wildcards match the full path."""
+    import fnmatch as _fn
+
+    state = node.cluster_service.state
+    fields = [f for f in str(req.param("fields", "")).split(",") if f]
+    want_types = [t for t in str(req.param("type") or "").split(",") if t]
+    include_defaults = req.bool_param("include_defaults", False)
+    out = {}
+    matched_type = not want_types
+    for name in state.resolve_index_names(req.param("index", "_all")):
+        svc = node.indices[name]
+        dt = getattr(svc, "doc_type", "_doc") or "_doc"
+        if want_types and not any(
+                _fn.fnmatchcase(dt, t) for t in want_types):
+            continue
+        matched_type = True
+        flat = _flat_field_mappings(
+            svc.mapping_dict().get("properties") or {})
+        per_field = {}
+        for pattern in fields:
+            for path, params in flat.items():
+                if path == pattern or _fn.fnmatchcase(path, pattern):
+                    leaf = path.rsplit(".", 1)[-1]
+                    params = dict(params)
+                    if (include_defaults and params.get("type") == "text"
+                            and "analyzer" not in params):
+                        params["analyzer"] = "default"
+                    per_field[path] = {"full_name": path,
+                                       "mapping": {leaf: params}}
+        if per_field:
+            out[name] = {"mappings": {dt: per_field}}
+        elif want_types or req.param("index") is not None:
+            # index+type resolved but no field matched: empty marker —
+            # unless NOTHING matched anywhere, which renders {}
+            out[name] = {"mappings": {dt: {}}}
+    if want_types and not matched_type:
+        raise ResourceNotFoundException(
+            f"type[[{','.join(want_types)}]] missing")
+    if not any(per for v in out.values()
+               for per in v["mappings"].values()):
+        return 200, {}  # no field matched anywhere (reference shape)
+    return 200, out
+
+
 def _put_index_settings(node, req):
     return 200, node.update_index_settings(req.param("index", "_all"),
                                            req.json_body({}) or {})
