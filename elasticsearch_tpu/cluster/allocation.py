@@ -114,18 +114,33 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
         shards: Dict[int, List[ShardRouting]] = {}
         prev_shards = previous.get(name, {})
         for sid in range(md.num_shards):
-            prev_copies = [c for c in prev_shards.get(sid, [])
-                           if c.node_id in alive]
+            all_prev = prev_shards.get(sid, [])
+            prev_copies = [c for c in all_prev if c.node_id in alive]
             primary = next((c for c in prev_copies if c.primary), None)
             replicas = [c for c in prev_copies if not c.primary]
-            if primary is None and replicas:
-                # promote the first started replica (in-sync set analog)
+            if primary is None and all_prev:
+                # promote a STARTED replica only (the in-sync set
+                # analog): an INITIALIZING survivor may hold a partial
+                # recovery — promoting it would serve stale data
+                # silently; the reference refuses via in-sync allocation
+                # ids
                 started = [r for r in replicas
                            if r.state == ShardRoutingState.STARTED]
-                promo = (started or replicas)[0]
-                replicas.remove(promo)
-                promo.primary = True
-                primary = promo
+                if started:
+                    promo = started[0]
+                    replicas.remove(promo)
+                    promo.primary = True
+                    primary = promo
+                else:
+                    # no in-sync survivor: RETAIN the departed primary
+                    # copy in the table. The shard stays red (the fill
+                    # below sees a primary and will not allocate a fresh
+                    # empty one over lost data), and if the node comes
+                    # back its copy resumes with its data — the
+                    # reference's delayed-allocation / node-rejoin path
+                    primary = next(
+                        (c for c in all_prev
+                         if c.primary and c.node_id not in alive), None)
             copies: List[ShardRouting] = []
             if primary is not None:
                 copies.append(primary)
@@ -141,6 +156,10 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
         for sid in range(md.num_shards):
             copies = table[name][sid]
             if not any(c.primary for c in copies):
+                # reached only when the shard never had copies (fresh
+                # index / previously unplaceable): a shard that LOST its
+                # data keeps its departed primary routed above, so it
+                # stays red instead of restarting empty
                 node = _pick_node(list(alive), load, copies, node_info,
                                   awareness_attributes, watermark_low)
                 if node is not None:

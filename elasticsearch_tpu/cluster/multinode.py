@@ -49,10 +49,16 @@ from elasticsearch_tpu.common.errors import (
     IndexNotFoundException,
     NodeNotConnectedException,
 )
+from elasticsearch_tpu.common import settings as S
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.index.shard import IndexShard
 from elasticsearch_tpu.mapper.mapping import MapperService
-from elasticsearch_tpu.transport.local import TransportHub, TransportService
+from elasticsearch_tpu.transport.local import (
+    ConnectionHealth,
+    RetryPolicy,
+    TransportHub,
+    TransportService,
+)
 from elasticsearch_tpu.utils.murmur3 import shard_id_for
 
 ACTION_PUBLISH = "internal:cluster/coordination/publish_state"
@@ -69,6 +75,7 @@ ACTION_RECOVER = "internal:index/shard/recovery/start_recovery"
 ACTION_RECOVERY_FINALIZE = "internal:index/shard/recovery/finalize"
 ACTION_RECOVER_FILES_START = "internal:index/shard/recovery/files/start"
 ACTION_RECOVER_FILE_CHUNK = "internal:index/shard/recovery/files/chunk"
+ACTION_RECOVER_FILES_CLOSE = "internal:index/shard/recovery/files/close"
 ACTION_MASTER_PING = "internal:discovery/zen/fd/master_ping"
 
 # phase1 file-chunk size (RecoverySettings.CHUNK_SIZE analog, 512KB)
@@ -77,6 +84,15 @@ RECOVERY_CHUNK_BYTES = 512 * 1024
 # reclaimed (the reference cancels recoveries on timeout); sessions hold a
 # full in-memory snapshot of the shard's files
 RECOVERY_SESSION_MAX_AGE_S = 600.0
+
+
+def _time_setting(setting, settings: Settings) -> float:
+    """Resolve a time Setting to seconds — Setting.get returns string
+    defaults ('50ms') unparsed."""
+    from elasticsearch_tpu.common.units import parse_time_value
+
+    v = setting.get(settings)
+    return parse_time_value(v, setting.key) if isinstance(v, str) else float(v)
 
 
 class NotMasterException(ElasticsearchTpuException):
@@ -100,11 +116,47 @@ class ClusterNode:
     def __init__(self, name: str, hub: TransportHub, master_eligible: bool = True,
                  data: bool = True, attrs: Optional[Dict[str, str]] = None,
                  awareness_attributes: Optional[List[str]] = None,
-                 min_master_nodes: int = 1):
+                 min_master_nodes: int = 1,
+                 settings: Optional[Settings] = None):
         self.name = name
         self.node_id = name  # stable, human-readable ids make tests clear
         self.master_eligible = master_eligible
         self.data = data
+        # transport resilience knobs (common/settings.py registry): per-
+        # attempt request deadlines, the RetryableAction-style backoff
+        # policies, and the per-node connection health tracker
+        self.settings = settings or Settings.EMPTY
+        s = self.settings
+        self.request_timeout = _time_setting(S.TRANSPORT_REQUEST_TIMEOUT, s)
+        self.fd_ping_timeout = _time_setting(S.FD_PING_TIMEOUT, s)
+        self.publish_timeout = _time_setting(S.PUBLISH_TIMEOUT, s)
+        self.replication_timeout = _time_setting(S.REPLICATION_TIMEOUT, s)
+        self.recovery_action_timeout = _time_setting(S.RECOVERY_ACTION_TIMEOUT, s)
+        self.retry_policy = RetryPolicy(
+            max_attempts=S.TRANSPORT_RETRY_MAX_ATTEMPTS.get(s),
+            initial_backoff=_time_setting(S.TRANSPORT_RETRY_INITIAL_BACKOFF, s),
+            backoff_multiplier=S.TRANSPORT_RETRY_BACKOFF_MULTIPLIER.get(s),
+            max_backoff=_time_setting(S.TRANSPORT_RETRY_MAX_BACKOFF, s))
+        self.fd_retry = self.retry_policy.derive(
+            max_attempts=S.FD_PING_RETRIES.get(s))
+        self.recovery_retry = RetryPolicy(
+            max_attempts=S.RECOVERY_MAX_RETRIES.get(s),
+            initial_backoff=_time_setting(S.RECOVERY_RETRY_DELAY_NETWORK, s),
+            backoff_multiplier=S.TRANSPORT_RETRY_BACKOFF_MULTIPLIER.get(s),
+            max_backoff=_time_setting(S.TRANSPORT_RETRY_MAX_BACKOFF, s))
+        # publish/replication retries are bounded by an OVERALL deadline:
+        # an unresponsive peer costs one timeout, not timeout x attempts
+        # (drops fail fast and still get their backoff retries)
+        self.publish_retry = self.retry_policy.derive(
+            overall_timeout=self.publish_timeout)
+        self.replication_retry = self.retry_policy.derive(
+            overall_timeout=self.replication_timeout)
+        # fail-shard reports guard against SILENT divergence (an
+        # unreported failed replica stays STARTED in the routing table
+        # and could be promoted later, losing acked writes) — they get
+        # twice the retry budget of a normal request
+        self.report_retry = self.retry_policy.derive(
+            max_attempts=2 * self.retry_policy.max_attempts)
         # node attributes (node.attr.* — awareness zones etc.) + simulated
         # disk usage fraction (ClusterInfoService/FsProbe analog; tests set
         # it and call reroute)
@@ -115,7 +167,11 @@ class ClusterNode:
         self.awareness_attributes = list(awareness_attributes or [])
         # master-side: per-node info collected from joins
         self.node_info_map: Dict[str, dict] = {}
-        self.transport = TransportService(self.node_id, hub)
+        self.transport = TransportService(
+            self.node_id, hub,
+            health=ConnectionHealth(
+                failure_threshold=S.TRANSPORT_HEALTH_FAILURE_THRESHOLD.get(s),
+                quarantine_s=_time_setting(S.TRANSPORT_HEALTH_QUARANTINE, s)))
         self.hub = hub
         # cluster-state copy (every node holds the latest published state).
         # (epoch, version) orders states like the reference's cluster-state
@@ -186,6 +242,8 @@ class ClusterNode:
                            self._on_start_file_recovery)
         t.register_handler(ACTION_RECOVER_FILE_CHUNK,
                            self._on_recovery_file_chunk)
+        t.register_handler(ACTION_RECOVER_FILES_CLOSE,
+                           self._on_recovery_files_close)
         t.register_handler(ACTION_MASTER_PING, self._on_master_ping)
 
     @property
@@ -216,10 +274,14 @@ class ClusterNode:
             "attrs": self.attrs,
             "disk": self.disk_used_fraction,
         }
-        resp = self.transport.send_request(seed_node, ACTION_JOIN, payload)
+        resp = self.transport.send_request(
+            seed_node, ACTION_JOIN, payload,
+            timeout=self.request_timeout, retry=self.retry_policy)
         if resp.get("master") != seed_node:
             # redirected to the actual master
-            self.transport.send_request(resp["master"], ACTION_JOIN, payload)
+            self.transport.send_request(
+                resp["master"], ACTION_JOIN, payload,
+                timeout=self.request_timeout, retry=self.retry_policy)
 
     def _on_join(self, payload, src) -> dict:
         with self._lock:
@@ -252,17 +314,24 @@ class ClusterNode:
         steps down and rejoins the real cluster (the reference's
         "another master for the cluster" rejoin). Returns departed ids."""
         departed = []
+        lagging = []
         new_cluster: Optional[dict] = None
         with self._lock:
             if not self.is_master:
                 return []
             peers = [n for n in self.known_nodes if n != self.node_id]
             my_epoch = self.cluster_epoch
+            my_version = self.state_version
         # ping OUTSIDE the lock: a slow peer must not stall every other
-        # master operation for a socket timeout per FD tick
+        # master operation for a socket timeout per FD tick. The ping
+        # timeout bounds each attempt so an UNRESPONSIVE (not merely
+        # disconnected) node is detected; ping_retries keeps a lossy link
+        # from evicting a live node
         for node in peers:
             try:
-                resp = self.transport.send_request(node, ACTION_PUBLISH, None)
+                resp = self.transport.send_request(
+                    node, ACTION_PUBLISH, None,
+                    timeout=self.fd_ping_timeout, retry=self.fd_retry)
                 resp = resp or {}
                 if (resp.get("epoch", 0) > my_epoch
                         or (resp.get("epoch", 0) == my_epoch
@@ -272,8 +341,18 @@ class ClusterNode:
                     # or same epoch under a lower-id master) exists
                     new_cluster = resp
                     break
+                if ((resp.get("epoch", my_epoch), resp.get("version",
+                                                           my_version))
+                        < (my_epoch, my_version)):
+                    # the follower missed a publish (drops exhausted the
+                    # phase-1 retries): without repair its state DIVERGES
+                    # silently until the next unrelated state change —
+                    # re-publish the full state to it below
+                    lagging.append(node)
             except NodeNotConnectedException:
                 departed.append(node)
+        if lagging:
+            self._republish_to_lagging(lagging, my_epoch, my_version)
         if new_cluster is not None:
             with self._lock:
                 self.master_id = new_cluster["master"]
@@ -297,6 +376,34 @@ class ClusterNode:
         for node in departed:
             self.node_left(node)
         return departed
+
+    def _republish_to_lagging(self, nodes: List[str], my_epoch: int,
+                              my_version: int) -> None:
+        """FD repair path: push the CURRENT full state (publish + commit)
+        to followers whose ping showed an older (epoch, version). The
+        state dict is self-contained, so one round catches a follower up
+        no matter how many publishes it missed."""
+        with self._lock:
+            if not self.is_master:
+                return
+            if (self.cluster_epoch, self.state_version) < (my_epoch,
+                                                           my_version):
+                return  # our own view moved backwards (deposed): bail
+            state = self._state_dict()
+        key = {"epoch": state["epoch"], "version": state["version"]}
+        for node in nodes:
+            try:
+                resp = self.transport.send_request(
+                    node, ACTION_PUBLISH, state,
+                    timeout=self.publish_timeout,
+                    retry=self.publish_retry) or {}
+                if resp.get("ok"):
+                    self.transport.send_request(
+                        node, ACTION_COMMIT, key,
+                        timeout=self.publish_timeout,
+                        retry=self.publish_retry)
+            except (NodeNotConnectedException, ElasticsearchTpuException):
+                pass  # still unreachable: the next FD tick retries
 
     # ------------------------------------------------------------------
     # Master fault detection + re-election (MasterFaultDetection.java:56,
@@ -341,7 +448,9 @@ class ClusterNode:
                     continue
                 try:
                     resp = self.transport.send_request(
-                        peer, ACTION_MASTER_PING, None) or {}
+                        peer, ACTION_MASTER_PING, None,
+                        timeout=self.fd_ping_timeout,
+                        retry=self.fd_retry) or {}
                 except NodeNotConnectedException:
                     continue
                 claimed = resp.get("master") if not resp.get("is_master") \
@@ -354,8 +463,9 @@ class ClusterNode:
                         continue
             return self._handle_master_failure(None)
         try:
-            resp = self.transport.send_request(master, ACTION_MASTER_PING,
-                                               None)
+            resp = self.transport.send_request(
+                master, ACTION_MASTER_PING, None,
+                timeout=self.fd_ping_timeout, retry=self.fd_retry)
             if resp.get("is_master"):
                 return None
             # it abdicated/lost an election itself: adopt its view only
@@ -365,7 +475,8 @@ class ClusterNode:
             if proposed and proposed != master:
                 try:
                     r2 = self.transport.send_request(
-                        proposed, ACTION_MASTER_PING, None)
+                        proposed, ACTION_MASTER_PING, None,
+                        timeout=self.fd_ping_timeout, retry=self.fd_retry)
                     if r2.get("is_master"):
                         with self._lock:
                             self.master_id = proposed
@@ -397,7 +508,9 @@ class ClusterNode:
                     winner = cand
                 continue
             try:
-                self.transport.send_request(cand, ACTION_MASTER_PING, None)
+                self.transport.send_request(
+                    cand, ACTION_MASTER_PING, None,
+                    timeout=self.fd_ping_timeout, retry=self.fd_retry)
                 reachable.append(cand)
                 if winner is None:
                     winner = cand
@@ -591,8 +704,14 @@ class ClusterNode:
             if node == self.node_id:
                 continue
             try:
-                resp = self.transport.send_request(node, ACTION_PUBLISH,
-                                                   state) or {}
+                # per-follower deadline + retry: a transient drop retries
+                # with backoff; an unresponsive follower costs at most the
+                # publish timeout and simply does not ack (timeout quorum
+                # — PublishClusterStateAction's AckListener deadline)
+                resp = self.transport.send_request(
+                    node, ACTION_PUBLISH, state,
+                    timeout=self.publish_timeout,
+                    retry=self.publish_retry) or {}
                 if not resp.get("ok"):
                     continue  # explicit rejection (stale epoch) != ack
                 reached.append(node)
@@ -612,7 +731,9 @@ class ClusterNode:
                 f"master-eligible acks")
         for node in reached:
             try:
-                self.transport.send_request(node, ACTION_COMMIT, key)
+                self.transport.send_request(
+                    node, ACTION_COMMIT, key,
+                    timeout=self.publish_timeout, retry=self.publish_retry)
             except Exception:  # noqa: BLE001 — commit is best-effort
                 # past the quorum the state IS committed; a follower
                 # whose apply blew up (e.g. its deferred shard-started
@@ -696,9 +817,12 @@ class ClusterNode:
     def _on_publish(self, payload, src) -> dict:
         if payload is None:
             # ping: answer with our view so a deposed master can notice
-            # the higher-epoch cluster and step down (check_nodes)
+            # the higher-epoch cluster and step down, and so the master
+            # can spot a LAGGING follower (missed publish under faults)
+            # and re-publish to it (check_nodes)
             return {"ok": True, "master": self.master_id,
-                    "epoch": self.cluster_epoch}
+                    "epoch": self.cluster_epoch,
+                    "version": self.state_version}
         with self._lock:
             if payload["epoch"] < self.cluster_epoch:
                 # a deposed master re-publishing from a stale epoch: the
@@ -897,12 +1021,15 @@ class ClusterNode:
                 OSError, ValueError):
             above_seqno = -1
         try:
-            resp = self.transport.send_request(primary_node, ACTION_RECOVER, {
-                "index": index, "shard": sid, "target": self.node_id,
-                "above_seqno": above_seqno,
-            })
+            resp = self.transport.send_request(
+                primary_node, ACTION_RECOVER, {
+                    "index": index, "shard": sid, "target": self.node_id,
+                    "above_seqno": above_seqno,
+                },
+                timeout=self.recovery_action_timeout,
+                retry=self.recovery_retry)
         except (NodeNotConnectedException, ElasticsearchTpuException):
-            return  # next reroute retries
+            return  # retries with backoff exhausted; next reroute retries
         # recovery runs outside the node lock (deferred from
         # _apply_state): a concurrent newer state may have removed the
         # local copy in the meantime — bail instead of KeyError-ing
@@ -923,16 +1050,16 @@ class ClusterNode:
         # in markAllocationIdAsInSync)
         for _round in range(5):
             fin = None
-            for _attempt in range(3):  # brief transient faults retry inline
-                try:
-                    fin = self.transport.send_request(
-                        primary_node, ACTION_RECOVERY_FINALIZE, {
-                            "index": index, "shard": sid,
-                            "local_checkpoint": shard.engine.local_checkpoint,
-                        })
-                    break
-                except (NodeNotConnectedException, ElasticsearchTpuException):
-                    time.sleep(0.02)
+            try:  # transient faults retry with backoff (RetryableAction)
+                fin = self.transport.send_request(
+                    primary_node, ACTION_RECOVERY_FINALIZE, {
+                        "index": index, "shard": sid,
+                        "local_checkpoint": shard.engine.local_checkpoint,
+                    },
+                    timeout=self.recovery_action_timeout,
+                    retry=self.recovery_retry)
+            except (NodeNotConnectedException, ElasticsearchTpuException):
+                pass
             if fin is None:
                 return  # primary unreachable: stay INITIALIZING; the next
                 # cluster-state publish or master health check re-runs recovery
@@ -1071,9 +1198,30 @@ class ClusterNode:
             raise ElasticsearchTpuException("local copy vanished")
         start = self.transport.send_request(
             primary_node, ACTION_RECOVER_FILES_START, {
-                "index": index, "shard": sid, "target": self.node_id})
+                "index": index, "shard": sid, "target": self.node_id},
+            timeout=self.recovery_action_timeout,
+            retry=self.recovery_retry)
         if not start.get("files") or start.get("max_seq_no", -1) < 0:
             return -1  # empty primary: nothing to ship, pure ops replay
+        try:
+            return self._pull_session_files(shard, start, primary_node)
+        except BaseException:
+            # abort: tear the source-side session down NOW instead of
+            # leaving a full file snapshot pinned until the age-based
+            # reclaim (the reference cancels the recovery and releases
+            # its IndexCommit ref the same way); best-effort — the
+            # age-based sweep remains the backstop
+            try:
+                self.transport.send_request(
+                    primary_node, ACTION_RECOVER_FILES_CLOSE,
+                    {"session": start["session"]},
+                    timeout=self.recovery_action_timeout)
+            except (NodeNotConnectedException, ElasticsearchTpuException):
+                pass
+            raise
+
+    def _pull_session_files(self, shard, start: dict,
+                            primary_node: str) -> int:
         store = shard.engine.store
         # a retry may leave partial files behind — start clean
         shutil.rmtree(store.directory, ignore_errors=True)
@@ -1086,11 +1234,16 @@ class ClusterNode:
             with open(full, "wb") as f:
                 offset = 0
                 while offset < size:
+                    # chunk pulls retry with backoff: chunks are offset-
+                    # addressed reads of an immutable snapshot, so a
+                    # redelivered chunk is byte-identical
                     chunk = self.transport.send_request(
                         primary_node, ACTION_RECOVER_FILE_CHUNK, {
                             "session": start["session"], "path": rel,
                             "offset": offset,
-                            "length": RECOVERY_CHUNK_BYTES})
+                            "length": RECOVERY_CHUNK_BYTES},
+                        timeout=self.recovery_action_timeout,
+                        retry=self.recovery_retry)
                     data = base64.b64decode(chunk["data"])
                     if not data and not chunk.get("eof"):
                         raise ElasticsearchTpuException(
@@ -1107,6 +1260,13 @@ class ClusterNode:
         # path a restarting node uses (IndexShard.recover_from_store)
         shard.recover_from_store()
         return int(start["max_seq_no"])
+
+    def _on_recovery_files_close(self, payload, src) -> dict:
+        """Source side: the target aborted its file pull — free the
+        session's snapshot bytes immediately."""
+        with self._lock:
+            self._recovery_sessions.pop(payload["session"], None)
+        return {"ok": True}
 
     @staticmethod
     def _collect_ops(shard, above_seqno: int = -1) -> list:
@@ -1181,9 +1341,11 @@ class ClusterNode:
 
     def _report_started(self, index: str, sid: int) -> None:
         try:
-            self.transport.send_request(self.master_id, ACTION_SHARD_STARTED, {
-                "index": index, "shard": sid, "node": self.node_id,
-            })
+            self.transport.send_request(
+                self.master_id, ACTION_SHARD_STARTED, {
+                    "index": index, "shard": sid, "node": self.node_id,
+                },
+                timeout=self.request_timeout, retry=self.retry_policy)
         except NodeNotConnectedException:
             pass
         except FailedToCommitClusterStateException:
@@ -1255,17 +1417,33 @@ class ClusterNode:
         # re-enter other nodes' locks and must not nest under ours
         for node_id in failed_copies:
             try:
-                self.transport.send_request(self.master_id, ACTION_SHARD_FAILED, {
-                    "index": payload["index"], "shard": payload["shard"],
-                    "node": node_id,
-                })
-            except (NodeNotConnectedException,
-                    FailedToCommitClusterStateException):
-                # same rationale as _report_started: a master that could
-                # not commit the copy-removal rolled back and stepped
-                # down; the client's write already applied on the
-                # primary and must not error because of the report
+                self.transport.send_request(
+                    self.master_id, ACTION_SHARD_FAILED, {
+                        "index": payload["index"],
+                        "shard": payload["shard"],
+                        "node": node_id,
+                    },
+                    timeout=self.request_timeout, retry=self.report_retry)
+            except FailedToCommitClusterStateException:
+                # a master that could not commit the copy-removal rolled
+                # back and stepped down; the re-elected master's epoch
+                # fences the old cluster and reconciliation re-runs —
+                # the write keeps its ack (same rationale as
+                # _report_started)
                 pass
+            except NodeNotConnectedException as e:
+                # the failed copy could NOT be reported: the routing
+                # table still lists it STARTED, so a later promotion
+                # could pick the diverged copy and lose this op. The
+                # reference fails the primary rather than ack
+                # (ReplicationOperation.onNoLongerPrimary) — surface
+                # the uncertainty so the coordinator retries the write
+                # instead of treating it as durably replicated.
+                raise ElasticsearchTpuException(
+                    f"replica [{node_id}] failed for "
+                    f"[{payload['index']}][{payload['shard']}] but the "
+                    f"failure could not be reported to the master; the "
+                    f"write is not fully replicated") from e
         return result
 
     def _write_primary_locked(self, payload, src) -> dict:
@@ -1320,8 +1498,16 @@ class ClusterNode:
             if copy.state != ShardRoutingState.STARTED and not in_sync:
                 continue
             try:
+                # deadline + bounded retries: a lagging or blackholed
+                # replica costs at most the replication timeout, then is
+                # FAILED (removed from in-sync, reported to the master
+                # for reroute) while the primary keeps serving — the
+                # replicated op is seqno-stamped, so retries are
+                # idempotent under redelivery
                 ack = self.transport.send_request(
-                    copy.node_id, ACTION_WRITE_REPLICA, replica_payload)
+                    copy.node_id, ACTION_WRITE_REPLICA, replica_payload,
+                    timeout=self.replication_timeout,
+                    retry=self.replication_retry)
                 acks += 1
                 if tracker is not None:
                     tracker.update_local_checkpoint(
@@ -1436,12 +1622,21 @@ class ClusterClient:
         self.response_collector.on_send(node_id)
         t0 = time.monotonic()
         try:
-            resp = self.node.transport.send_request(node_id, action, payload)
-            # record SUCCESSFUL responses only: a dead node's instant
-            # connection error must not earn it the best rank
+            resp = self.node.transport.send_request(
+                node_id, action, payload,
+                timeout=self.node.request_timeout)
+            # successes feed the EWMA; failures go through the penalty
+            # path below — a dead node's instant connection error must
+            # not earn it the best rank
             self.response_collector.add_response_time(
                 node_id, time.monotonic() - t0)
             return resp
+        except NodeNotConnectedException:
+            # timed-out/unreachable copy: penalize its rank so adaptive
+            # replica selection reroutes reads away from it
+            self.response_collector.on_failure(
+                node_id, time.monotonic() - t0)
+            raise
         finally:
             self.response_collector.on_complete(node_id)
 
@@ -1463,6 +1658,9 @@ class ClusterClient:
               routing: Optional[str] = None,
               wait_for_active_shards=None) -> dict:
         sid, primary = self._routing_entry(index, doc_id, routing)
+        # deadline only, NO retry: re-sending a primary write after a
+        # timeout could double-apply it (the op has no client-side
+        # idempotency token); the uncertainty surfaces to the caller
         return self.node.transport.send_request(primary, ACTION_WRITE_PRIMARY, {
             "op": "index", "index": index, "shard": sid, "id": doc_id,
             "source": source, "routing": routing,
@@ -1472,14 +1670,14 @@ class ClusterClient:
             # a superseded term (TransportReplicationAction carries the
             # primary term the same way)
             "term": self.node.primary_terms.get((index, sid)),
-        })
+        }, timeout=self.node.request_timeout)
 
     def delete(self, index: str, doc_id: str) -> dict:
         sid, primary = self._routing_entry(index, doc_id, None)
         return self.node.transport.send_request(primary, ACTION_WRITE_PRIMARY, {
             "op": "delete", "index": index, "shard": sid, "id": doc_id,
             "term": self.node.primary_terms.get((index, sid)),
-        })
+        }, timeout=self.node.request_timeout)
 
     def get(self, index: str, doc_id: str, prefer_replica: bool = False) -> dict:
         md = self.node.indices_meta.get(index)
@@ -1509,7 +1707,7 @@ class ClusterClient:
                 try:
                     self.node.transport.send_request(copy.node_id, ACTION_REFRESH, {
                         "index": index, "shard": sid,
-                    })
+                    }, timeout=self.node.request_timeout)
                 except NodeNotConnectedException:
                     pass
 

@@ -37,6 +37,16 @@ class ResponseCollectorService:
             n = self._outstanding.get(node_id, 1)
             self._outstanding[node_id] = max(0, n - 1)
 
+    def on_failure(self, node_id: str, seconds: float = 0.0) -> None:
+        """A failed or timed-out request PENALIZES the node's rank:
+        double its EWMA (floored at the observed wasted time and 100ms)
+        so a node that keeps timing out stops being preferred — but is
+        never rewarded with a better rank by an instant connection
+        error. Successes recover the rank through the normal EWMA."""
+        with self._lock:
+            prev = self._ewma.get(node_id, 0.0)
+            self._ewma[node_id] = max(prev * 2.0, float(seconds), 0.1)
+
     def rank(self, node_id: str) -> float:
         """Lower is better. Unknown nodes rank best so they get probed
         (the reference seeds unknown nodes optimistically)."""

@@ -174,6 +174,21 @@ class NodeNotConnectedException(ElasticsearchTpuException):
     status_code = 500
 
 
+class ConnectTransportException(NodeNotConnectedException):
+    """Connection-level failure before the request reached the peer
+    (ES: ConnectTransportException). Raised by the per-node connection
+    health tracker when it fast-fails to a known-dead node; subclasses
+    NodeNotConnectedException so every existing failover path treats it
+    as a connection loss."""
+
+
+class ReceiveTimeoutTransportException(NodeNotConnectedException):
+    """The request was sent but no response arrived within the deadline
+    (ES: ReceiveTimeoutTransportException). Subclasses
+    NodeNotConnectedException: an unresponsive peer must trip the same
+    failover/fault-detection paths as a disconnected one."""
+
+
 class MasterNotDiscoveredException(ElasticsearchTpuException):
     status_code = 503
 

@@ -395,6 +395,57 @@ BREAKER_FIELDDATA_LIMIT = Setting.str_setting(
     "indices.breaker.fielddata.limit", "60%", dynamic=True
 )
 
+# --- transport resilience (transport/local.py RetryPolicy/ConnectionHealth;
+# wired through cluster/multinode.py — see docs/RESILIENCE.md) ---
+
+TRANSPORT_REQUEST_TIMEOUT = Setting.time_setting(
+    "transport.request.timeout", "30s", dynamic=True
+)
+TRANSPORT_RETRY_MAX_ATTEMPTS = Setting.int_setting(
+    "transport.retry.max_attempts", 3, min_value=1, dynamic=True
+)
+TRANSPORT_RETRY_INITIAL_BACKOFF = Setting.time_setting(
+    "transport.retry.initial_backoff", "50ms", dynamic=True
+)
+TRANSPORT_RETRY_BACKOFF_MULTIPLIER = Setting.float_setting(
+    "transport.retry.backoff_multiplier", 2.0, min_value=1.0, dynamic=True
+)
+TRANSPORT_RETRY_MAX_BACKOFF = Setting.time_setting(
+    "transport.retry.max_backoff", "2s", dynamic=True
+)
+TRANSPORT_HEALTH_FAILURE_THRESHOLD = Setting.int_setting(
+    "transport.health.failure_threshold", 3, min_value=1, dynamic=True
+)
+TRANSPORT_HEALTH_QUARANTINE = Setting.time_setting(
+    "transport.health.quarantine", "1s", dynamic=True
+)
+FD_PING_TIMEOUT = Setting.time_setting(
+    # discovery.zen.fd.ping_timeout: the reference defaults to 30s over
+    # real sockets; the in-process cluster detects an unresponsive node in
+    # seconds so FD ticks stay cheap
+    "discovery.zen.fd.ping_timeout", "5s", dynamic=True
+)
+FD_PING_RETRIES = Setting.int_setting(
+    "discovery.zen.fd.ping_retries", 3, min_value=1, dynamic=True
+)
+PUBLISH_TIMEOUT = Setting.time_setting(
+    "discovery.zen.publish_timeout", "30s", dynamic=True
+)
+REPLICATION_TIMEOUT = Setting.time_setting(
+    # per-replica write fan-out deadline: a blackholed replica is failed
+    # (and rerouted by the master) instead of blocking the primary
+    "cluster.replication.timeout", "30s", dynamic=True
+)
+RECOVERY_RETRY_DELAY_NETWORK = Setting.time_setting(
+    "indices.recovery.retry_delay_network", "500ms", dynamic=True
+)
+RECOVERY_MAX_RETRIES = Setting.int_setting(
+    "indices.recovery.max_retries", 5, min_value=1, dynamic=True
+)
+RECOVERY_ACTION_TIMEOUT = Setting.time_setting(
+    "indices.recovery.internal_action_timeout", "30s", dynamic=True
+)
+
 NODE_SETTINGS = [
     CLUSTER_NAME,
     NODE_NAME,
@@ -413,6 +464,20 @@ NODE_SETTINGS = [
     BREAKER_TOTAL_LIMIT,
     BREAKER_REQUEST_LIMIT,
     BREAKER_FIELDDATA_LIMIT,
+    TRANSPORT_REQUEST_TIMEOUT,
+    TRANSPORT_RETRY_MAX_ATTEMPTS,
+    TRANSPORT_RETRY_INITIAL_BACKOFF,
+    TRANSPORT_RETRY_BACKOFF_MULTIPLIER,
+    TRANSPORT_RETRY_MAX_BACKOFF,
+    TRANSPORT_HEALTH_FAILURE_THRESHOLD,
+    TRANSPORT_HEALTH_QUARANTINE,
+    FD_PING_TIMEOUT,
+    FD_PING_RETRIES,
+    PUBLISH_TIMEOUT,
+    REPLICATION_TIMEOUT,
+    RECOVERY_RETRY_DELAY_NETWORK,
+    RECOVERY_MAX_RETRIES,
+    RECOVERY_ACTION_TIMEOUT,
 ]
 
 # --- index-scoped ---

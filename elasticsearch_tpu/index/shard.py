@@ -218,6 +218,7 @@ class IndexShard:
     # Write ops (primary-term fenced in the clustered path)
     # ------------------------------------------------------------------
 
+    @contextmanager
     def acquire_primary_permit(self, op_term: Optional[int] = None,
                                timeout: float = 30.0):
         """Primary-term-fenced operation permit
@@ -226,16 +227,24 @@ class IndexShard:
         op carrying a term OLDER than this copy's current term raced a
         promotion and must be rejected (the new primary may have
         re-assigned its seqno); None means a local single-node op that
-        trivially runs under the current term."""
-        if not self.primary:
-            raise ShardNotPrimaryException(
-                f"shard [{self.index_name}][{self.shard_id}] is not a "
-                f"primary")
-        if op_term is not None and op_term < self.primary_term:
-            raise ShardNotPrimaryException(
-                f"operation primary term [{op_term}] is too old (current "
-                f"[{self.primary_term}])")
-        return self.permits.acquire(timeout=timeout)
+        trivially runs under the current term.
+
+        The permit is acquired FIRST and primary/term are validated under
+        it: validating before acquiring leaves a stale-write window — a
+        promotion or relocation handoff can drain and flip primary/term
+        while this op is parked waiting for the permit, and the
+        pre-validated op would then land under the new term. The permit
+        is released automatically when validation raises."""
+        with self.permits.acquire(timeout=timeout):
+            if not self.primary:
+                raise ShardNotPrimaryException(
+                    f"shard [{self.index_name}][{self.shard_id}] is not a "
+                    f"primary")
+            if op_term is not None and op_term < self.primary_term:
+                raise ShardNotPrimaryException(
+                    f"operation primary term [{op_term}] is too old "
+                    f"(current [{self.primary_term}])")
+            yield
 
     def promote_to_primary(self, new_term: int) -> None:
         """Replica promotion: drain in-flight ops, then adopt the

@@ -1046,49 +1046,95 @@ class Node:
 
     def _open_pit_scroll(self, pairs, body: dict, first_resp: dict,
                          keep_alive: str, pinned) -> str:
-        """Materialize the scroll's ENTIRE ordered result over the pinned
-        snapshot once; pages slice it. Exact for every sort (including
-        ties and the sortless relevance order — a search_after cursor
-        cannot page either one safely: equal sort values would be
-        skipped, and per-segment _doc ids are not globally unique)."""
-        from elasticsearch_tpu.search.service import merge_refs, normalize_sort
-
-        sort_spec = normalize_sort(body.get("sort"))
+        """Ordered result over the pinned snapshot as a LAZILY EXTENDED
+        PREFIX: opening a size=10 scroll over a large index materializes
+        only the first pages' worth of DocRefs, not O(corpus). Deeper
+        pages re-query the pinned views with a geometrically growing
+        top-k and append only refs not already in the prefix (identity =
+        (index, shard, segment, local doc)), so page boundaries never
+        skip or duplicate — across ties too, because the served prefix is
+        authoritative and the pinned snapshot is immutable. (A plain
+        search_after cursor cannot page ties or the sortless relevance
+        order safely; the prefix scheme can.)"""
         size = int(body.get("size")) if body.get("size") is not None else 10
+        size = max(size, 0)
         # aggregations were already computed by the first-page search;
         # the materialization pass only needs the ordered doc refs
         q_body = {k: v for k, v in body.items()
                   if k not in ("aggs", "aggregations")}
-        per_ref = []
+        nd_total = 0
+        sources = []
         for prefix, svc in pairs:
+            sources.append((prefix, svc.name))
             pins = pinned.get(svc.name) or {}
-            for sid in sorted(svc.shards):
-                views = pins.get(sid, [])
-                nd = sum(v.live_doc_count for v in views)
-                if nd == 0:
-                    continue
-                res = svc.shards[sid].searcher.query(
-                    dict(q_body), size_hint=nd, segments=views)
-                for ref in res.refs:
-                    per_ref.append((prefix, svc.name, ref))
-        by_id = {id(r): (p, n) for p, n, r in per_ref}
-        merged = merge_refs([r for _, _, r in per_ref], sort_spec,
-                            len(per_ref))
-        entries = [(by_id[id(r)][0], by_id[id(r)][1], r) for r in merged]
+            for views in pins.values():
+                nd_total += sum(v.live_doc_count for v in views)
         ctx = {
             "mode": "pit",
-            "entries": entries,
-            "pos": max(size, 0),
+            "entries": [],        # materialized ordered prefix
+            "seen": set(),        # identity keys of materialized refs
+            "sources": sources,
+            "nd_total": nd_total,
+            "last_target": 0,
+            "exhausted": nd_total == 0,
+            "lock": threading.Lock(),  # serializes extension + paging
+            "pos": size,
             "body": dict(body),
+            "q_body": q_body,
             "pinned": pinned,
             "total": first_resp["hits"]["total"],
             "max_score": first_resp["hits"]["max_score"],
         }
+        self._extend_pit_entries(ctx, size)
         # the first page comes from the SAME materialized order, so page
         # boundaries can never skip or duplicate across ties
         first_resp["hits"]["hits"] = self._fetch_scroll_page(
-            entries[: max(size, 0)], body, pinned)
+            ctx["entries"][:size], body, pinned)
         return self._register_scroll(ctx, keep_alive)
+
+    def _extend_pit_entries(self, ctx: dict, upto: int) -> None:
+        """Grow the materialized prefix to cover [0, upto). Each round
+        re-queries every pinned shard with a geometrically larger top-k
+        and appends unseen refs in merged order; geometric growth keeps
+        total re-query work O(final depth), and a fully drained target
+        (target >= pinned live docs, or fewer refs returned than asked)
+        marks the context exhausted."""
+        from elasticsearch_tpu.search.service import merge_refs, normalize_sort
+
+        sort_spec = normalize_sort(ctx["q_body"].get("sort"))
+        while len(ctx["entries"]) < upto and not ctx["exhausted"]:
+            target = min(ctx["nd_total"],
+                         max(upto, 2 * ctx["last_target"], 32))
+            per_ref = []
+            for prefix, name in ctx["sources"]:
+                svc = self.indices.get(name)
+                if svc is None:
+                    continue  # index deleted mid-scroll: its docs drop
+                pins = ctx["pinned"].get(name) or {}
+                for sid in sorted(svc.shards):
+                    views = pins.get(sid, [])
+                    nd = sum(v.live_doc_count for v in views)
+                    if nd == 0:
+                        continue
+                    res = svc.shards[sid].searcher.query(
+                        dict(ctx["q_body"]), size_hint=min(target, nd),
+                        segments=views)
+                    for ref in res.refs:
+                        per_ref.append((prefix, name, ref))
+            by_id = {id(r): (p, n) for p, n, r in per_ref}
+            merged = merge_refs([r for _, _, r in per_ref], sort_spec,
+                                target)
+            for r in merged:
+                prefix, name = by_id[id(r)]
+                key = (prefix, name, r.shard_id, r.segment_name,
+                       r.local_doc)
+                if key in ctx["seen"]:
+                    continue
+                ctx["seen"].add(key)
+                ctx["entries"].append((prefix, name, r))
+            if target >= ctx["nd_total"] or len(merged) < target:
+                ctx["exhausted"] = True
+            ctx["last_target"] = target
 
     def _fetch_scroll_page(self, entries, body: dict, pinned) -> List[dict]:
         from elasticsearch_tpu.search.service import fetch_hits
@@ -1135,10 +1181,15 @@ class Node:
             size = (int(ctx["body"].get("size"))
                     if ctx["body"].get("size") is not None else 10)
             size = max(size, 0)
-            with self._scroll_lock:
+            # extend the materialized prefix on demand (outside the
+            # global scroll lock: extension re-queries the pinned views;
+            # the per-context lock serializes pagers of THIS scroll)
+            with ctx["lock"]:
                 pos = ctx["pos"]
+                self._extend_pit_entries(ctx, pos + size)
                 page = ctx["entries"][pos: pos + size]
                 ctx["pos"] = pos + len(page)
+            with self._scroll_lock:
                 if keep_alive:
                     ctx["expire_at"] = (time.time()
                                         + parse_time_value(keep_alive,

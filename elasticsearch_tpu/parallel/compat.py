@@ -1,0 +1,13 @@
+"""jax version compatibility shims for the parallel execution layer."""
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.5 jax: experimental home + check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, **kw)
+
+__all__ = ["shard_map"]

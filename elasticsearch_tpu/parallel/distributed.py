@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
-from jax import shard_map
+
+from elasticsearch_tpu.parallel.compat import shard_map
 
 from elasticsearch_tpu.ops.scoring import B, K1, bm25_idf
 

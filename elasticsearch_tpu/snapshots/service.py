@@ -217,6 +217,11 @@ class SnapshotsService:
                 "start_time_in_millis": int(time.time() * 1000),
                 "abort": threading.Event(),
                 "done": threading.Event(),
+                # set by delete_snapshot when its abort wait timed out:
+                # the WORKER owns the partial directory and must clean it
+                # up (and suppress a SUCCESS manifest) instead of racing
+                # the deleter's rmtree against its own copytree
+                "delete_requested": False,
                 # (index, sid) -> stage: INIT | STARTED | DONE | FAILURE
                 "shards": {(n, sid): "INIT" for n in names
                            for sid in self.node.indices[n].shards},
@@ -281,6 +286,11 @@ class SnapshotsService:
                     "aliases": md.aliases,
                     "shards": shard_info,
                 }
+            # last-chance abort check BEFORE the manifest write: a delete
+            # raced past the per-shard checks — it must not observe a
+            # SUCCESS manifest for a snapshot it was told is gone
+            if progress["abort"].is_set() or progress["delete_requested"]:
+                aborted = True
             if aborted:
                 # abort leaves the repository consistent: the partial
                 # snapshot directory is removed entirely (the reference
@@ -311,8 +321,20 @@ class SnapshotsService:
                                   "state": SnapshotState.FAILED,
                                   "reason": f"{type(e).__name__}: {e}"}
         finally:
-            progress["done"].set()
+            # a delete that timed out waiting for us owns no files: the
+            # worker is the only writer under snap_dir, so it performs
+            # the removal the deleter could not do safely. The flag
+            # check and done.set() are atomic under the progress lock so
+            # a deleter setting the flag either is seen here or observes
+            # done already set (and falls through to its own fs delete)
             with self._progress_lock:
+                if progress["delete_requested"]:
+                    shutil.rmtree(snap_dir, ignore_errors=True)
+                    progress["state"] = SnapshotState.ABORTED
+                    progress["result"] = {
+                        "snapshot": snapshot,
+                        "state": SnapshotState.ABORTED}
+                progress["done"].set()
                 self._in_progress.pop(key, None)
 
     def snapshot_status(self, repo_name: str,
@@ -398,11 +420,26 @@ class SnapshotsService:
             prog = self._in_progress.get((repo_name, snapshot))
         if prog is not None:
             prog["abort"].set()
-            prog["done"].wait(30)
+            if not prog["done"].wait(30):
+                # the worker is still copying: IT owns the partial
+                # directory. Flag the delete so the worker removes the
+                # directory and suppresses its SUCCESS manifest when it
+                # finishes — an rmtree here would race its copytree and
+                # could leave a resurrected half-snapshot behind. Under
+                # the progress lock the worker either sees the flag in
+                # its finally-block or has already set done — in the
+                # latter (the wait timed out JUST as it finished) fall
+                # through to the filesystem delete ourselves.
+                with self._progress_lock:
+                    finished = prog["done"].is_set()
+                    if not finished:
+                        prog["delete_requested"] = True
+                if not finished:
+                    return {"acknowledged": True}
             if prog["state"] != SnapshotState.ABORTED:
-                # the worker raced past the abort flag and completed (or
-                # the wait timed out): fall through to the filesystem
-                # delete so the ack is truthful either way
+                # the worker raced past the abort flag and completed:
+                # fall through to the filesystem delete so the ack is
+                # truthful either way
                 pass
             else:
                 return {"acknowledged": True}

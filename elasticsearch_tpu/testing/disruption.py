@@ -1,0 +1,205 @@
+"""Injectable network disruption schemes for the transport hubs.
+
+Role model: the reference test framework's ``ServiceDisruptionScheme``
+family (test/framework/.../test/disruption/): ``NetworkDisruption`` with
+its ``NetworkDelay`` / ``NetworkDisconnect`` / ``NetworkUnresponsive``
+link behaviors, ``SlowClusterStateProcessing``, and
+``MockTransportService``'s per-action request blackholing.
+
+A scheme is installed on a hub (``TransportHub`` or ``TcpTransportHub``)
+with ``apply_to(hub)`` and applied to every delivery it matches:
+``applies(src, dst, action)`` filters, ``disrupt(src, dst, action)``
+executes the effect — sleep (delay), raise ``NodeNotConnectedException``
+(drop/partition), or block until the caller's request deadline fires
+(unresponsive/blackhole). Randomized schemes take an explicit ``seed`` so
+disruption tests are reproducible.
+
+Usage::
+
+    drop = NetworkDrop(0.3, seed=7).apply_to(hub)
+    delay = NetworkDelay(0.2).apply_to(hub)
+    ...drive the cluster...
+    drop.remove(); delay.remove()    # or hub.clear_disruptions()
+
+Schemes compose: every installed scheme whose filter matches runs, in
+installation order.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+from typing import Iterable, Optional, Sequence
+
+from elasticsearch_tpu.common.errors import NodeNotConnectedException
+
+
+class DisruptionScheme:
+    """Base scheme: optional link/action filters + the disruption hook.
+
+    ``src``/``dst``: restrict to deliveries from/to these node ids (None =
+    any). ``nodes``: restrict to deliveries touching any of these nodes in
+    either direction. ``actions``: fnmatch patterns over the action name
+    (``internal:cluster/*``).
+    """
+
+    def __init__(self, src: Optional[Iterable[str]] = None,
+                 dst: Optional[Iterable[str]] = None,
+                 nodes: Optional[Iterable[str]] = None,
+                 actions: Optional[Sequence[str]] = None):
+        self.src = set(src) if src else None
+        self.dst = set(dst) if dst else None
+        self.nodes = set(nodes) if nodes else None
+        self.actions = list(actions) if actions else None
+        self.hub = None
+
+    # --- lifecycle ----------------------------------------------------
+
+    def apply_to(self, hub) -> "DisruptionScheme":
+        hub.add_disruption(self)
+        self.hub = hub
+        return self
+
+    def remove(self) -> None:
+        if self.hub is not None:
+            self.hub.remove_disruption(self)
+            self.hub = None
+
+    # --- matching + effect --------------------------------------------
+
+    def applies(self, src: str, dst: str, action: str) -> bool:
+        if self.src is not None and src not in self.src:
+            return False
+        if self.dst is not None and dst not in self.dst:
+            return False
+        if self.nodes is not None and not ({src, dst} & self.nodes):
+            return False
+        if self.actions is not None and not any(
+                # exact match first: ES action names contain [s][r]
+                # suffixes that fnmatch would treat as character classes
+                action == pat or fnmatch.fnmatch(action, pat)
+                for pat in self.actions):
+            return False
+        return True
+
+    def disrupt(self, src: str, dst: str, action: str) -> None:
+        """Effect hook; runs outside the hub lock. May sleep or raise."""
+        raise NotImplementedError
+
+
+class NetworkDelay(DisruptionScheme):
+    """Fixed or uniformly-random per-delivery delay
+    (NetworkDisruption.NetworkDelay)."""
+
+    def __init__(self, seconds: float, max_seconds: Optional[float] = None,
+                 seed: Optional[int] = None, **filters):
+        super().__init__(**filters)
+        self.seconds = float(seconds)
+        self.max_seconds = float(max_seconds) if max_seconds else None
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+
+    def delay(self) -> float:
+        if self.max_seconds is None:
+            return self.seconds
+        with self._rng_lock:
+            return self._rng.uniform(self.seconds, self.max_seconds)
+
+    def disrupt(self, src, dst, action) -> None:
+        import time
+
+        time.sleep(self.delay())
+
+
+class NetworkDrop(DisruptionScheme):
+    """Probabilistic request drop: each matching delivery fails with
+    probability ``p`` (connection-level error, so retry policies and
+    failover engage). ``seed`` makes the drop sequence reproducible."""
+
+    def __init__(self, p: float, seed: Optional[int] = None, **filters):
+        super().__init__(**filters)
+        if not 0.0 <= p <= 1.0:
+            raise ValueError("drop probability must be in [0, 1]")
+        self.p = float(p)
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self.dropped = 0
+
+    def disrupt(self, src, dst, action) -> None:
+        with self._rng_lock:
+            hit = self._rng.random() < self.p
+        if hit:
+            self.dropped += 1
+            raise NodeNotConnectedException(
+                f"[{dst}] dropped [{action}] from [{src}] (injected)")
+
+
+class NetworkPartition(DisruptionScheme):
+    """Partition between two node sets (NetworkDisruption.Bridge /
+    TwoPartitions). ``one_way=True`` drops only side1→side2 traffic —
+    the asymmetric-partition case where a deposed master can still hear
+    the cluster that can no longer hear it."""
+
+    def __init__(self, side1: Iterable[str], side2: Iterable[str],
+                 one_way: bool = False, **filters):
+        super().__init__(**filters)
+        self.side1 = set(side1)
+        self.side2 = set(side2)
+        self.one_way = bool(one_way)
+
+    def disrupt(self, src, dst, action) -> None:
+        forward = src in self.side1 and dst in self.side2
+        backward = src in self.side2 and dst in self.side1
+        if forward or (backward and not self.one_way):
+            raise NodeNotConnectedException(
+                f"[{dst}] partitioned from [{src}] (injected)")
+
+
+class UnresponsiveNode(DisruptionScheme):
+    """The node accepts requests but never answers
+    (NetworkDisruption.NetworkUnresponsive): the delivery blocks until
+    the caller's request timeout fires (or ``max_block_s`` as a leak
+    guard), then fails. ``remove()``/``heal`` unblocks parked deliveries
+    immediately."""
+
+    def __init__(self, node: str, max_block_s: float = 60.0, **filters):
+        filters.setdefault("nodes", [node])
+        super().__init__(**filters)
+        self.node = node
+        self.max_block_s = float(max_block_s)
+        self._healed = threading.Event()
+
+    def remove(self) -> None:
+        self._healed.set()
+        super().remove()
+
+    def disrupt(self, src, dst, action) -> None:
+        self._healed.wait(self.max_block_s)
+        raise NodeNotConnectedException(
+            f"[{self.node}] unresponsive, [{action}] never answered "
+            f"(injected)")
+
+
+class ActionBlackhole(DisruptionScheme):
+    """Requests matching the action patterns vanish: the delivery blocks
+    until the caller's deadline (MockTransportService's request
+    blackholing by action name). Scope with ``dst=[...]`` to blackhole a
+    single replica's writes while the node otherwise stays reachable."""
+
+    def __init__(self, actions: Sequence[str], max_block_s: float = 60.0,
+                 **filters):
+        super().__init__(actions=list(actions), **filters)
+        self.max_block_s = float(max_block_s)
+        self._healed = threading.Event()
+        self.swallowed = 0
+
+    def remove(self) -> None:
+        self._healed.set()
+        super().remove()
+
+    def disrupt(self, src, dst, action) -> None:
+        self.swallowed += 1
+        self._healed.wait(self.max_block_s)
+        raise NodeNotConnectedException(
+            f"[{dst}] blackholed [{action}] from [{src}] (injected)")

@@ -35,6 +35,7 @@ from elasticsearch_tpu.common import errors as es_errors
 from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuException,
     NodeNotConnectedException,
+    ReceiveTimeoutTransportException,
 )
 from elasticsearch_tpu.transport.local import RemoteActionException
 
@@ -153,7 +154,7 @@ class _PeerConnection:
         if not slot["event"].wait(self.timeout):
             with self.plock:
                 self.pending.pop(req_id, None)
-            raise NodeNotConnectedException(
+            raise ReceiveTimeoutTransportException(
                 f"request timed out after {self.timeout}s")
         if slot["kind"] == KIND_ERROR:
             _raise_remote(slot["body"])
@@ -182,6 +183,7 @@ class TcpTransportHub:
         self._peers: Dict[str, Tuple[str, int]] = {}
         self._conns: Dict[str, _PeerConnection] = {}
         self._disconnected: Set[Tuple[str, str]] = set()
+        self._disruptions: list = []  # DisruptionScheme parity
         self._lock = threading.Lock()
         self.request_timeout = request_timeout
         self.requests_log: list = []
@@ -227,6 +229,35 @@ class TcpTransportHub:
             else:
                 self._disconnected = {
                     (x, y) for x, y in self._disconnected if a not in (x, y)}
+        self._reset_health(a)
+
+    def add_disruption(self, scheme) -> None:
+        with self._lock:
+            if scheme not in self._disruptions:
+                self._disruptions.append(scheme)
+
+    def remove_disruption(self, scheme) -> None:
+        with self._lock:
+            if scheme in self._disruptions:
+                self._disruptions.remove(scheme)
+        self._reset_health(None)
+
+    def clear_disruptions(self) -> None:
+        with self._lock:
+            self._disruptions.clear()
+        self._reset_health(None)
+
+    def _reset_health(self, node: Optional[str]) -> None:
+        with self._lock:
+            services = list(self._services.values())
+        for svc in services:
+            health = getattr(svc, "connection_health", None)
+            if health is None:
+                continue
+            if node is None or svc.node_id == node:
+                health.reset()
+            else:
+                health.reset(node)
 
     def deliver(self, src: str, dst: str, action: str, payload: Any) -> Any:
         with self._lock:
@@ -234,7 +265,11 @@ class TcpTransportHub:
                 raise NodeNotConnectedException(
                     f"[{dst}] disconnected from [{src}]")
             local = self._services.get(dst)
+            schemes = [s for s in self._disruptions
+                       if s.applies(src, dst, action)]
             self.requests_log.append((src, dst, action))
+        for scheme in schemes:  # outside the lock: schemes may sleep
+            scheme.disrupt(src, dst, action)
         if local is not None:
             return local.handle(action, payload, src)
         conn = self._connection(dst)

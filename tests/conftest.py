@@ -11,13 +11,22 @@ Must run before any jax import — pytest imports conftest first.
 import os
 
 # the image pins JAX_PLATFORMS=axon (the real TPU tunnel); tests must run
-# on the CPU backend with 8 virtual devices, so override hard.
+# on the CPU backend with 8 virtual devices, so override hard. The device
+# count must be set via XLA_FLAGS before jax initializes: the
+# jax_num_cpu_devices config option only exists on newer jax versions.
 os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.5 jax: XLA_FLAGS above already did it
+    pass
 
 import pytest  # noqa: E402
 

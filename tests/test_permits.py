@@ -138,6 +138,92 @@ class TestOperationPermits:
             with shard.acquire_primary_permit():
                 pass
 
+    def test_writer_parked_behind_promotion_drain_is_fenced(self):
+        """The stale-write window (ADVICE medium): validation must run
+        UNDER the permit. A writer that parks behind a promotion's drain
+        wakes under the NEW term — its op term is stale and must be
+        rejected, not land pre-validated under the bumped term."""
+        shard = make_shard(primary=True)
+        in_flight = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with shard.permits.acquire():
+                in_flight.set()
+                release.wait(5)
+
+        h = threading.Thread(target=holder)
+        h.start()
+        assert in_flight.wait(5)
+
+        p = threading.Thread(target=lambda: shard.promote_to_primary(5))
+        p.start()
+        time.sleep(0.05)  # drain is parked on the holder
+
+        result = {}
+
+        def writer():
+            try:
+                with shard.acquire_primary_permit(op_term=1):
+                    result["landed"] = True
+            except ShardNotPrimaryException as e:
+                result["error"] = str(e)
+
+        w = threading.Thread(target=writer)
+        w.start()
+        time.sleep(0.05)  # writer is parked behind the drain
+        release.set()
+        for t in (h, p, w):
+            t.join(5)
+        assert "landed" not in result, "stale write landed under new term"
+        assert "too old" in result["error"]
+        assert shard.primary_term == 5
+        # the rejected writer released its permit: a drain can proceed
+        with shard.permits.block_and_drain(timeout=1):
+            pass
+
+    def test_writer_parked_behind_handoff_loses_primary(self):
+        """Same window for relocation handoff: the parked writer wakes
+        on a copy that is no longer primary and must be rejected."""
+        shard = make_shard(primary=True)
+        in_flight = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with shard.permits.acquire():
+                in_flight.set()
+                release.wait(5)
+
+        h = threading.Thread(target=holder)
+        h.start()
+        assert in_flight.wait(5)
+
+        def handoff():
+            with shard.relocation_handoff():
+                pass
+
+        p = threading.Thread(target=handoff)
+        p.start()
+        time.sleep(0.05)
+
+        result = {}
+
+        def writer():
+            try:
+                with shard.acquire_primary_permit():
+                    result["landed"] = True
+            except ShardNotPrimaryException as e:
+                result["error"] = str(e)
+
+        w = threading.Thread(target=writer)
+        w.start()
+        time.sleep(0.05)
+        release.set()
+        for t in (h, p, w):
+            t.join(5)
+        assert "landed" not in result
+        assert "not a" in result["error"]
+
     def test_drain_timeout_raises_and_unblocks(self):
         from elasticsearch_tpu.common.errors import IllegalArgumentException
 

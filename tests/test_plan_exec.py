@@ -224,6 +224,11 @@ class TestIndexMeshAggsSort:
         idx = IndexService(name, Settings({
             "index.number_of_shards": 3,
             "index.search.mesh": mesh,
+            # no background NRT refresh: a refresh sneaking between
+            # index_doc calls under suite load seals extra segments,
+            # pushing (shard, segment) pairs past the 8-device mesh and
+            # flaking the mesh-served assertion
+            "index.refresh_interval": -1,
         }), mapping=self.BODY["mappings"])
         rng = np.random.RandomState(11)
         vocab = [f"w{i}" for i in range(10)]
@@ -344,6 +349,7 @@ class TestMeshFeatureParity:
         idx = IndexService(name, Settings({
             "index.number_of_shards": shards,
             "index.search.mesh": mesh,
+            "index.refresh_interval": -1,  # see TestIndexMeshAggsSort._mk
         }), mapping=self.BODY["mappings"])
         rng = np.random.RandomState(23)
         vocab = [f"w{i}" for i in range(10)]

@@ -174,3 +174,36 @@ class TestConcurrentWriteReindex:
         # values are the AT-START snapshot (no torn/updated reads)
         assert all(h["_source"]["kind"] == "orig"
                    for h in r["hits"]["hits"])
+
+
+class TestLazyMaterialization:
+    """The pit context materializes a lazily extended PREFIX, not
+    O(corpus) DocRefs at open (ISSUE 2 satellite)."""
+
+    def test_open_does_not_materialize_whole_corpus(self, node):
+        for i in range(200):
+            node.index_doc("big", f"b{i}", {"n": i})
+        node.indices["big"].refresh()
+        first = node.search("big", {"query": {"match_all": {}}, "size": 3},
+                            scroll="1m")
+        ctx = node.scrolls[first["_scroll_id"]]
+        assert len(ctx["entries"]) < 200  # only a prefix at open
+        ids = drain_scroll(node, first)
+        assert sorted(ids) == sorted(f"b{i}" for i in range(200))
+        assert len(ids) == len(set(ids))  # no dups across extensions
+        assert len(ctx["entries"]) == 200  # fully drained by the end
+
+    def test_lazy_pages_are_exact_under_sort(self, node):
+        first = node.search("src", {"query": {"match_all": {}}, "size": 4,
+                                    "sort": [{"n": "desc"}]}, scroll="1m")
+        ids = drain_scroll(node, first)
+        assert ids == [f"d{i}" for i in range(29, -1, -1)]
+
+    def test_lazy_pages_exact_with_ties(self, node):
+        # every doc shares the same sort key: extension rounds must not
+        # skip or duplicate across tie-heavy page boundaries
+        first = node.search("src", {"query": {"match_all": {}}, "size": 4,
+                                    "sort": [{"kind": "asc"}]}, scroll="1m")
+        ids = drain_scroll(node, first)
+        assert len(ids) == 30
+        assert len(set(ids)) == 30

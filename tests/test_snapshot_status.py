@@ -166,3 +166,63 @@ class TestKeystore:
         ks.remove("a.b")
         with pytest.raises(IllegalArgumentException):
             ks.remove("a.b")
+
+
+class TestDeleteVsRunningWorkerRace:
+    def test_delete_timeout_flags_worker_cleanup(self, node, monkeypatch):
+        """delete_snapshot whose abort wait TIMES OUT must not rmtree
+        against the worker's copytree: it flags delete_requested; the
+        worker removes the partial directory itself and suppresses its
+        SUCCESS manifest (ISSUE 2 satellite)."""
+        import shutil as _shutil
+
+        gate = threading.Event()
+        copying = threading.Event()
+        orig = _shutil.copytree
+
+        def stalled_copytree(*args, **kw):
+            copying.set()
+            gate.wait(10)  # worker stuck mid-copy, past abort checks
+            return orig(*args, **kw)
+
+        monkeypatch.setattr(
+            "elasticsearch_tpu.snapshots.service.shutil.copytree",
+            stalled_copytree)
+        r = node.snapshots.create_snapshot("r1", "racy", {},
+                                           wait_for_completion=False)
+        assert r == {"accepted": True}
+        assert copying.wait(5)
+        key = ("r1", "racy")
+        prog = node.snapshots._in_progress[key]
+
+        class _NeverDone:
+            """done-event stand-in whose wait always times out (the
+            worker is wedged in copytree for longer than the deleter
+            is willing to wait)."""
+
+            def __init__(self, real):
+                self.real = real
+
+            def wait(self, timeout=None):
+                return False
+
+            def is_set(self):
+                return self.real.is_set()
+
+            def set(self):
+                self.real.set()
+
+        real_done = prog["done"]
+        prog["done"] = _NeverDone(real_done)
+        resp = node.snapshots.delete_snapshot("r1", "racy")
+        assert resp == {"acknowledged": True}
+        assert prog["delete_requested"] is True
+        # the deleter did NOT remove the directory out from under the
+        # worker — the worker owns the cleanup
+        gate.set()
+        assert real_done.wait(10)
+        time.sleep(0.05)
+        assert prog["state"] == "ABORTED"
+        repo = node.snapshots._repo("r1")
+        assert not os.path.exists(repo.snapshot_path("racy"))
+        assert "racy" not in repo.list_snapshots()  # no SUCCESS manifest
