@@ -91,6 +91,27 @@ def measure_marginal(fn, queries, b_small=10, b_big=60, reps=5):
 # ----------------------------------------------------------------------
 
 
+def pack_postings(term_ids, docs, tfs, vocab, nd_pad):
+    """Block-pack a (term, doc)-sorted flat posting list (vectorized —
+    the same packing for the full corpus and for per-shard slices)."""
+    term_start = np.searchsorted(term_ids, np.arange(vocab))
+    term_end = np.searchsorted(term_ids, np.arange(vocab) + 1)
+    term_df = (term_end - term_start).astype(np.int64)
+    n_blocks_per_term = -(-term_df // BLOCK)
+    total_blocks = max(int(n_blocks_per_term.sum()), 1)
+    block_docs = np.full((total_blocks, BLOCK), nd_pad, dtype=np.int32)
+    block_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
+    term_block_start = np.concatenate(
+        [[0], np.cumsum(n_blocks_per_term)[:-1]])
+    within = np.arange(len(term_ids), dtype=np.int64) - term_start[term_ids]
+    rows = term_block_start[term_ids] + within // BLOCK
+    lanes = within % BLOCK
+    block_docs[rows, lanes] = docs
+    block_tfs[rows, lanes] = tfs.astype(np.float32)
+    return (block_docs, block_tfs, term_block_start, n_blocks_per_term,
+            term_df)
+
+
 def build_synthetic_corpus(seed=7):
     """Directly build block-packed postings for a zipfian corpus (bypasses
     the host tokenizer — the bench targets the query path)."""
@@ -112,20 +133,8 @@ def build_synthetic_corpus(seed=7):
     term_ids = (uniq // N_DOCS).astype(np.int32)
     docs = (uniq % N_DOCS).astype(np.int32)
     tfs = counts.astype(np.float32)
-    term_start = np.searchsorted(term_ids, np.arange(VOCAB))
-    term_end = np.searchsorted(term_ids, np.arange(VOCAB) + 1)
-    term_df = (term_end - term_start).astype(np.int64)
-    n_blocks_per_term = -(-term_df // BLOCK)
-    total_blocks = int(n_blocks_per_term.sum())
-    block_docs = np.full((total_blocks, BLOCK), nd_pad, dtype=np.int32)
-    block_tfs = np.zeros((total_blocks, BLOCK), dtype=np.float32)
-    term_block_start = np.concatenate(
-        [[0], np.cumsum(n_blocks_per_term)[:-1]])
-    within = np.arange(len(term_ids), dtype=np.int64) - term_start[term_ids]
-    rows = term_block_start[term_ids] + within // BLOCK
-    lanes = within % BLOCK
-    block_docs[rows, lanes] = docs
-    block_tfs[rows, lanes] = tfs
+    (block_docs, block_tfs, term_block_start, n_blocks_per_term,
+     term_df) = pack_postings(term_ids, docs, tfs, VOCAB, nd_pad)
     norms = np.ones((1, nd_pad + 1), dtype=np.float32)
     norms[0, :N_DOCS] = doc_len.astype(np.float32)
     live1 = np.zeros(nd_pad + 1, dtype=bool)
@@ -153,6 +162,10 @@ def build_synthetic_corpus(seed=7):
         "nd_pad": nd_pad,
         "keyword_ord": keyword_pad,
         "numeric": numeric,
+        # flat (term, doc)-sorted postings + per-doc lengths: the mesh
+        # config re-packs doc-range slices of these into per-shard blocks
+        "flat": (term_ids, docs, tfs),
+        "doc_len": doc_len,
     }
 
 
@@ -391,6 +404,17 @@ def run_measurement() -> dict:
     if kernel_metrics is not None:
         extra_configs = run_extra_configs(
             jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax, cb_run, rng)
+        # the mesh-path config: distributed scoring on the tile kernel
+        # (acceptance: within 2x of the single-chip pallas p50)
+        try:
+            extra_configs["mesh_pallas_packed"] = run_mesh_pallas_config(
+                jax, jnp, lax, psc, corpus, term_sets)
+        except Exception as e:  # noqa: BLE001 — recorded, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            extra_configs["mesh_pallas_packed"] = {
+                "error": f"{type(e).__name__}: {e}"}
 
     # ---------------- timings: legacy scatter path (r03) ----------------
     legacy_p50 = legacy_p50_2 = None
@@ -556,15 +580,36 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
     import numpy as np
 
     out = {}
+    # Estimator note (BENCH_r05 rescore_top1000 diagnosis: p50 1.625 vs
+    # second estimate 2.406 ms): between configs the device idles while
+    # the host stages the next config's arrays, so clocks ramp down and
+    # the next marginal estimate reads HIGH — the same artifact the main
+    # path's 6000-query warm-up removes, re-entering here config by
+    # config. Marginal-batch noise is one-sided (preemption, ramp-down
+    # and sync jitter only ADD time; nothing executes faster than the
+    # device), so the MINIMUM of several estimates after a short re-warm
+    # is the trustworthy p50; the spread field bounds dispersion.
+    out["estimator_note"] = (
+        "p50_ms is the min of 3 marginal estimates after a 200-query "
+        "re-warm (marginal noise is one-sided: idle clock ramp-down "
+        "between configs inflates estimates, nothing deflates them); "
+        "p50_spread_ms = max - min of the 3")
 
     def time_it(fn, warm=2):
         """fn() must return the (device-array, ...) outputs of one query.
-        Marginal batch timing — see measure_marginal."""
+        Marginal batch timing — see measure_marginal and estimator_note."""
         for _ in range(warm):
             fn()
-        pq = measure_marginal(lambda _q: fn(), [None])
-        pq2 = measure_marginal(lambda _q: fn(), [None])
-        return min(pq, pq2) * 1000, max(pq, pq2) * 1000
+        # short sustained re-warm to steady-state clocks: the host-side
+        # staging between configs idles the device long enough for the
+        # first estimate to read high otherwise
+        o = None
+        for _ in range(200):
+            o = fn()
+        np.asarray(o[0])
+        ests = sorted(measure_marginal(lambda _q: fn(), [None])
+                      for _ in range(3))
+        return ests[0] * 1000, (ests[-1] - ests[0]) * 1000
 
     def lanes_for(terms):
         return [psc.QueryLane(int(corpus["term_block_start"][t]),
@@ -609,9 +654,9 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
         def run_bool():
             return bool_query(dev["docs"], dev["frac"], dev["live_t"],
                               *args_m, *args_a, dev["numeric"])
-        p50b, p50b2 = time_it(run_bool)
+        p50b, spreadb = time_it(run_bool)
         out["bool_must_should_filter"] = {"p50_ms": round(p50b, 3),
-                                          "p50_second_estimate_ms": round(p50b2, 3)}
+                                          "p50_spread_ms": round(spreadb, 3)}
     except Exception as e:  # noqa: BLE001
         out["bool_must_should_filter"] = {"error": f"{type(e).__name__}: {e}"}
 
@@ -644,11 +689,42 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
         def run_agg():
             return agg_query(dev["docs"], dev["frac"], dev["live_t"],
                              *args, dev["keyword_ord"])
-        p50a, p50a2 = time_it(run_agg)
+        p50a, spreada = time_it(run_agg)
         out["terms_cardinality_agg"] = {"p50_ms": round(p50a, 3),
-                                        "p50_second_estimate_ms": round(p50a2, 3)}
+                                        "p50_spread_ms": round(spreada, 3)}
     except Exception as e:  # noqa: BLE001
         out["terms_cardinality_agg"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # ---- config 5: DMA double-buffering (tiles_per_step=2) ----
+    try:
+        terms = [int(x) for x in rng.randint(50, 1000, 3)]
+        rl5, rh5, w5, _ = psc.build_tile_tables(
+            lanes_for(terms), bmin, bmax, geom, t_pad=4, cb=cb_run)
+        args5 = (jnp.asarray(rl5), jnp.asarray(rh5), jnp.asarray(w5))
+
+        @jax.jit
+        def tps2_query(docs, frac, live_t, rl, rh, w):
+            ts_, td_, th_ = psc.score_tiles(
+                docs, frac, live_t, rl, rh, w,
+                t_pad=4, cb=cb_run, sub=geom.tile_sub, k=K,
+                tiles_per_step=2)
+            return psc.merge_tile_topk(ts_, td_, th_, K)
+
+        def run_tps2():
+            return tps2_query(dev["docs"], dev["frac"], dev["live_t"],
+                              *args5)
+        p50t, spreadt = time_it(run_tps2)
+        out["pallas_tiles_per_step2"] = {
+            "p50_ms": round(p50t, 3),
+            "p50_spread_ms": round(spreadt, 3),
+            "note": ("grid coarsened to 2 tiles/step: posting-window DMAs "
+                     "for the second tile issue while the first computes, "
+                     "halving the fixed per-step cost the kernel comment "
+                     "names as dominant; compare against the main p50 to "
+                     "decide the search.pallas.tiles_per_step default"),
+        }
+    except Exception as e:  # noqa: BLE001
+        out["pallas_tiles_per_step2"] = {"error": f"{type(e).__name__}: {e}"}
 
     # ---- config 4: rescore over top-1000 ----
     try:
@@ -675,13 +751,174 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
         def run_rescore():
             return rescore_query(dev["docs"], dev["frac"], dev["live_t"],
                                  *args, dev["numeric"])
-        p50r, p50r2 = time_it(run_rescore)
-        out["rescore_top1000"] = {"p50_ms": round(p50r, 3),
-                                  "p50_second_estimate_ms": round(p50r2, 3)}
+        p50r, spreadr = time_it(run_rescore)
+        out["rescore_top1000"] = {
+            "p50_ms": round(p50r, 3),
+            "p50_spread_ms": round(spreadr, 3),
+            "note": ("r05 showed 1.625 vs 2.406 ms estimates here: the "
+                     "second estimate ran after the device idled through "
+                     "host-side staging (clock ramp-down); see "
+                     "estimator_note — min-of-3 after re-warm is the "
+                     "trustworthy figure"),
+        }
     except Exception as e:  # noqa: BLE001
         out["rescore_top1000"] = {"error": f"{type(e).__name__}: {e}"}
 
     return out
+
+
+def run_mesh_pallas_config(jax, jnp, lax, psc, corpus, term_sets,
+                           n_shards=4):
+    """The packed mesh plane on this chip: the 1M corpus split into
+    n_shards doc-range shards, every shard scored BY THE TILE KERNEL
+    inside ONE shard_map program with all shards packed as slots on the
+    single device, candidates merged in-program — the mesh data plane of
+    parallel/plan_exec.py in bench form (same slot unroll, same per-slot
+    kernel invocation, same all_gather+top_k merge). This is the path a
+    multi-chip pod runs per device; acceptance: p50 within 2x of the
+    single-chip pallas p50 with recall@10 = 1.0 (it replaces the 6.9 ms
+    scatter formulation distributed queries were pinned to)."""
+    from jax.sharding import Mesh, PartitionSpec as PS
+
+    from elasticsearch_tpu.parallel.compat import shard_map
+
+    term_ids, docs, tfs = corpus["flat"]
+    doc_len = corpus["doc_len"]
+    shard_size = N_DOCS // n_shards
+    nd_pad_s = 1
+    while nd_pad_s < shard_size:
+        nd_pad_s *= 2
+    geom = psc.tile_geometry(nd_pad_s)
+    sub, n_tiles = geom.tile_sub, geom.n_tiles
+    shards = []
+    max_rows = 0
+    t0 = time.perf_counter()
+    for s in range(n_shards):
+        lo = s * shard_size
+        hi = (s + 1) * shard_size if s < n_shards - 1 else N_DOCS
+        m = (docs >= lo) & (docs < hi)
+        bd, bt, tbs, nbt, _df = pack_postings(
+            term_ids[m], docs[m] - lo, tfs[m], VOCAB, nd_pad_s)
+        norms_s = np.ones(nd_pad_s + 1, np.float32)
+        norms_s[: hi - lo] = doc_len[lo:hi].astype(np.float32)
+        # per-posting norm factors with the CORPUS avgdl: scores must
+        # equal the single-index kernel's exactly for the recall gate
+        frac = psc.compute_block_frac(bd, bt, norms_s, corpus["avgdl"])
+        bmin, bmax = psc.block_min_max(bd, bt, nd_pad_s)
+        dp, fp = psc.pad_segment_blocks(bd, frac, nd_pad_s)
+        live = np.zeros(nd_pad_s, np.float32)
+        live[: hi - lo] = 1.0
+        shards.append({"dp": dp, "fp": fp, "tbs": tbs, "nbt": nbt,
+                       "bmin": bmin, "bmax": bmax,
+                       "live_t": psc.build_live_t(live, geom),
+                       "live1": live.astype(bool), "lo": lo})
+        max_rows = max(max_rows, dp.shape[0])
+    k_docs = np.full((n_shards, max_rows, BLOCK), nd_pad_s, np.int32)
+    k_frac = np.zeros((n_shards, max_rows, BLOCK), np.float32)
+    for i, sh in enumerate(shards):
+        k_docs[i, : sh["dp"].shape[0]] = sh["dp"]
+        k_frac[i, : sh["fp"].shape[0]] = sh["fp"]
+    live_t = np.stack([sh["live_t"] for sh in shards])
+    live1 = np.stack([sh["live1"] for sh in shards])
+    log(f"mesh config: {n_shards} shards packed "
+        f"(nd_pad_s={nd_pad_s}, n_tiles={n_tiles}) built in "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    def shard_tables(terms, cb=None):
+        per = []
+        need_cb = 8
+        for sh in shards:
+            lanes = [psc.QueryLane(int(sh["tbs"][t]), int(sh["nbt"][t]),
+                                   idf(int(corpus["term_df"][t])))
+                     for t in terms]
+            rl, rh, w, cbr = psc.build_tile_tables(
+                lanes, sh["bmin"], sh["bmax"], geom, t_pad=4, cb=cb)
+            per.append((rl, rh, w))
+            need_cb = max(need_cb, cbr)
+        return (np.stack([p[0] for p in per]),
+                np.stack([p[1] for p in per]),
+                np.stack([p[2] for p in per]), need_cb)
+
+    queries = [shard_tables(ts) for ts in term_sets]
+    cb_run = max(q[3] for q in queries)
+    staged_q = [(jnp.asarray(rl), jnp.asarray(rh), jnp.asarray(w))
+                for rl, rh, w, _ in queries]
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("shards",))
+    spd = n_shards
+
+    def per_device(kd, kf, lt, lv, rl, rh, w):
+        cand_s, cand_d = [], []
+        for i in range(spd):
+            ds = psc.score_tiles(
+                kd[i], kf[i], lt[i], rl[i], rh[i], w[i],
+                t_pad=4, cb=cb_run, sub=sub, dense=True)[0]
+            scores = psc.dense_to_flat(ds, sub)
+            masked = jnp.where((scores > 0) & lv[i], scores, -jnp.inf)
+            s_i, d_i = lax.top_k(masked, K)
+            cand_s.append(s_i)
+            cand_d.append(d_i + jnp.int32(i * shard_size))
+        all_s = lax.all_gather(jnp.concatenate(cand_s), "shards").reshape(-1)
+        all_d = lax.all_gather(jnp.concatenate(cand_d), "shards").reshape(-1)
+        top_s, ti = lax.top_k(all_s, K)
+        return top_s[None], all_d[ti][None]
+
+    mapped = shard_map(per_device, mesh=mesh,
+                       in_specs=(PS("shards"),) * 7,
+                       out_specs=(PS("shards"),) * 2, check_vma=False)
+
+    @jax.jit
+    def run_prog(kd, kf, lt, lv, rl, rh, w):
+        o = mapped(kd, kf, lt, lv, rl, rh, w)
+        return o[0][0], o[1][0]
+
+    sharding = jax.sharding.NamedSharding(mesh, PS("shards"))
+    dev_kd = jax.device_put(k_docs, sharding)
+    dev_kf = jax.device_put(k_frac, sharding)
+    dev_lt = jax.device_put(live_t, sharding)
+    dev_lv = jax.device_put(live1, sharding)
+    for v in (dev_kd, dev_kf, dev_lt, dev_lv):
+        v.block_until_ready()
+
+    def run_mesh(q):
+        return run_prog(dev_kd, dev_kf, dev_lt, dev_lv, *q)
+
+    t0 = time.perf_counter()
+    top_s, top_d = run_mesh(staged_q[0])
+    np.asarray(top_s)
+    log(f"mesh program first compile+run in {time.perf_counter() - t0:.1f}s "
+        f"(cb={cb_run})")
+    # re-warm + marginal timing (same estimator as the main path)
+    wout = None
+    for i in range(400):
+        wout = run_mesh(staged_q[i % len(staged_q)])
+    np.asarray(wout[0])
+    timed = staged_q[WARMUP:]
+    ests = sorted(measure_marginal(run_mesh, timed) for _ in range(3))
+    # recall gate vs the full-corpus numpy oracle (shard-local doc ids
+    # were offset back to global in-program)
+    qb_pad = 1
+    nb = sum(int(corpus["n_blocks_per_term"][t]) for t in term_sets[0])
+    while qb_pad < nb:
+        qb_pad *= 2
+    ref_s, ref_i = numpy_reference_query(
+        corpus, make_query_legacy(corpus, term_sets[0], qb_pad))
+    got_s, got_d = (np.asarray(x) for x in run_mesh(staged_q[0]))
+    np.testing.assert_allclose(got_s, ref_s, rtol=1e-3)
+    recall = len(set(got_d.tolist()) & set(ref_i.tolist())) / K
+    return {
+        "p50_ms": round(ests[0] * 1000, 3),
+        "p50_spread_ms": round((ests[-1] - ests[0]) * 1000, 3),
+        "recall_at_10": recall,
+        "n_shards": n_shards,
+        "devices": 1,
+        "slots_per_device": spd,
+        "note": ("the mesh data plane scoring with the tile kernel: "
+                 "n_shards segments packed as slots on this one chip, "
+                 "scored per slot by score_tiles inside shard_map and "
+                 "merged in-program — distributed queries no longer pay "
+                 "the scatter formulation"),
+    }
 
 
 # ----------------------------------------------------------------------

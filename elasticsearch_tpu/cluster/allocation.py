@@ -116,7 +116,11 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
         for sid in range(md.num_shards):
             all_prev = prev_shards.get(sid, [])
             prev_copies = [c for c in all_prev if c.node_id in alive]
-            primary = next((c for c in prev_copies if c.primary), None)
+            primaries = [c for c in prev_copies if c.primary]
+            primary = primaries[0] if primaries else None
+            # a relocating primary's target carries primary=True too —
+            # it must survive the rebuild alongside the source
+            extra_primaries = primaries[1:]
             replicas = [c for c in prev_copies if not c.primary]
             if primary is None and all_prev:
                 # promote a STARTED replica only (the in-sync set
@@ -144,9 +148,31 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
             copies: List[ShardRouting] = []
             if primary is not None:
                 copies.append(primary)
+            copies.extend(extra_primaries)
             copies.extend(replicas)
             shards[sid] = copies
         table[name] = shards
+
+    # retire completed relocations: a RELOCATING source whose LINKED
+    # target (relocating_to) has STARTED hands off and leaves the table
+    # (the reference's relocation completion). The explicit link matters:
+    # with 2+ replicas, some other started same-role peer must not
+    # retire a source whose own target is still recovering.
+    for name, shards in table.items():
+        for copies in shards.values():
+            for c in list(copies):
+                if c.state != ShardRoutingState.RELOCATING:
+                    continue
+                target = next(
+                    (o for o in copies
+                     if o is not c and o.node_id == c.relocating_to), None)
+                if target is None:
+                    # target vanished (node left / cancelled): resume as
+                    # a normal started copy
+                    c.state = ShardRoutingState.STARTED
+                    c.relocating_to = None
+                elif target.state == ShardRoutingState.STARTED:
+                    copies.remove(c)
 
     load = _node_load(table)
     # fill unassigned primaries first, then replicas
@@ -199,6 +225,11 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
             awaiting = any(c.node_id in hot and not c.primary for c in copies)
             if awaiting:
                 continue  # relocation in progress: keep source + target
+            if any(c.state == ShardRoutingState.RELOCATING for c in copies):
+                # an explicit move in progress (reroute command): the
+                # source+target pair intentionally exceeds the desired
+                # copy count until the handoff retires the source
+                continue
             for c in list(copies):
                 if len(copies) <= desired:
                     break
@@ -271,6 +302,9 @@ def _rebalance_replicas(table: RoutingTable, alive: set,
         improved = False
         for shards in table.values():
             for copies in shards.values():
+                if any(c.state == ShardRoutingState.RELOCATING
+                       for c in copies):
+                    continue  # don't shuffle an explicit move's target
                 for copy in copies:
                     if copy.primary or copy.state != ShardRoutingState.INITIALIZING:
                         continue
@@ -286,6 +320,137 @@ def _rebalance_replicas(table: RoutingTable, alive: set,
                         load[best] = load.get(best, 0) + 1
                         copy.node_id = best
                         improved = True
+
+
+# ---------------------------------------------------------------------------
+# Reroute commands (cluster/routing/allocation/command/*.java)
+# ---------------------------------------------------------------------------
+
+
+class RerouteException(Exception):
+    """A reroute command failed validation (illegal_argument shape)."""
+
+
+def _find_copies(table: RoutingTable, index: str, shard: int,
+                 cmd: str) -> List[ShardRouting]:
+    if index not in table:
+        raise RerouteException(f"[{cmd}] no such index [{index}]")
+    if shard not in table[index]:
+        raise RerouteException(f"[{cmd}] no such shard [{index}][{shard}]")
+    return table[index][shard]
+
+
+def apply_command(table: RoutingTable, indices_meta: Dict,
+                  node_ids: Dict[str, str], name: str, args: dict) -> dict:
+    """Apply ONE reroute command in place; returns its explanation entry.
+
+    node_ids: {accepted name or id -> node_id} for node resolution.
+    Commands (AllocationCommands.registerFactory set, 6.x):
+    move, cancel, allocate_replica, allocate_empty_primary,
+    allocate_stale_primary.
+    """
+    def node_of(key: str, value) -> str:
+        nid = node_ids.get(str(value))
+        if nid is None:
+            raise RerouteException(
+                f"[{name}] no node found for [{key}] = [{value}]")
+        return nid
+
+    index = str(args.get("index", ""))
+    shard = int(args.get("shard", -1))
+    copies = _find_copies(table, index, shard, name)
+    decisions = []
+    if name == "move":
+        src = node_of("from_node", args.get("from_node"))
+        dst = node_of("to_node", args.get("to_node"))
+        copy = next((c for c in copies if c.node_id == src), None)
+        if copy is None:
+            raise RerouteException(
+                f"[move] shard [{index}][{shard}] not found on node [{src}]")
+        if copy.state != ShardRoutingState.STARTED:
+            raise RerouteException(
+                f"[move] shard [{index}][{shard}] on node [{src}] is "
+                f"[{copy.state}]; only STARTED shards can be moved")
+        if any(c.node_id == dst for c in copies):
+            raise RerouteException(
+                f"[move] a copy of [{index}][{shard}] already exists on "
+                f"node [{dst}] (SameShardAllocationDecider)")
+        # RELOCATING source + INITIALIZING target, like the reference;
+        # a later reroute retires the source once ITS target starts (the
+        # explicit relocating_to link — matching any started same-role
+        # peer would drop a healthy source while the target still
+        # recovers). The target inherits the source's primary flag
+        # (MoveAllocationCommand relocates the primary AS a primary —
+        # otherwise retiring the source would leave no primary copy)
+        copy.state = ShardRoutingState.RELOCATING
+        copy.relocating_to = dst
+        copies.append(ShardRouting(index, shard, dst, copy.primary,
+                                   ShardRoutingState.INITIALIZING))
+        decisions.append({"decider": "same_shard", "decision": "YES",
+                          "explanation": f"moving to [{dst}]"})
+    elif name == "cancel":
+        nid = node_of("node", args.get("node"))
+        copy = next((c for c in copies if c.node_id == nid), None)
+        if copy is None:
+            raise RerouteException(
+                f"[cancel] shard [{index}][{shard}] not found on node "
+                f"[{nid}]")
+        if copy.primary and not args.get("allow_primary", False):
+            raise RerouteException(
+                f"[cancel] can't cancel [{index}][{shard}] on node "
+                f"[{nid}], shard is primary and allow_primary is false")
+        copies.remove(copy)
+        decisions.append({"decider": "cancel", "decision": "YES",
+                          "explanation": f"cancelled on [{nid}]"})
+    elif name == "allocate_replica":
+        nid = node_of("node", args.get("node"))
+        if not any(c.primary for c in copies):
+            raise RerouteException(
+                f"[allocate_replica] trying to allocate a replica shard "
+                f"[{index}][{shard}], while corresponding primary shard "
+                f"is still unassigned")
+        if any(c.node_id == nid for c in copies):
+            raise RerouteException(
+                f"[allocate_replica] a copy of [{index}][{shard}] already "
+                f"exists on node [{nid}]")
+        md = indices_meta.get(index)
+        assigned_replicas = sum(1 for c in copies if not c.primary)
+        if md is not None and assigned_replicas >= md.num_replicas:
+            raise RerouteException(
+                f"[allocate_replica] all replica copies of "
+                f"[{index}][{shard}] are already assigned")
+        copies.append(ShardRouting(index, shard, nid, False,
+                                   ShardRoutingState.INITIALIZING))
+        decisions.append({"decider": "replica_after_primary",
+                          "decision": "YES",
+                          "explanation": f"allocated replica on [{nid}]"})
+    elif name in ("allocate_empty_primary", "allocate_stale_primary"):
+        nid = node_of("node", args.get("node"))
+        if not args.get("accept_data_loss", False):
+            raise RerouteException(
+                f"[{name}] allocating an empty primary for "
+                f"[{index}][{shard}] can result in data loss; please "
+                f"confirm by setting the accept_data_loss parameter to "
+                f"true")
+        live = next((c for c in copies
+                     if c.primary and c.state == ShardRoutingState.STARTED),
+                    None)
+        if live is not None:
+            raise RerouteException(
+                f"[{name}] primary [{index}][{shard}] is already assigned")
+        # drop any retained dead-primary routing and start over on nid
+        for c in list(copies):
+            if c.primary:
+                copies.remove(c)
+        copies.insert(0, ShardRouting(index, shard, nid, True,
+                                      ShardRoutingState.INITIALIZING))
+        decisions.append({"decider": "force_primary", "decision": "YES",
+                          "explanation": f"forced primary on [{nid}] "
+                                         f"(accept_data_loss)"})
+    else:
+        raise RerouteException(f"unknown reroute command [{name}]")
+    return {"command": name, "parameters": dict(args),
+            "decisions": decisions}
 
 
 def routing_to_dict(table: RoutingTable) -> dict:
@@ -305,7 +470,8 @@ def routing_from_dict(d: dict) -> RoutingTable:
         for sid, copies in shards.items():
             out[name][int(sid)] = [
                 ShardRouting(c["index"], c["shard"], c["node"], c["primary"],
-                             c["state"])
+                             c["state"],
+                             relocating_to=c.get("relocating_node"))
                 for c in copies
             ]
     return out

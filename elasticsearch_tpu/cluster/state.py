@@ -39,15 +39,23 @@ class ShardRouting:
     node_id: Optional[str]
     primary: bool
     state: str = ShardRoutingState.STARTED
+    # explicit relocation link (RELOCATING source -> target node): the
+    # allocator retires the source only when THIS node's copy has
+    # started, never some other same-role peer (reference:
+    # ShardRouting.relocatingNodeId)
+    relocating_to: Optional[str] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "index": self.index,
             "shard": self.shard_id,
             "node": self.node_id,
             "primary": self.primary,
             "state": self.state,
         }
+        if self.relocating_to is not None:
+            d["relocating_node"] = self.relocating_to
+        return d
 
 
 @dataclass
@@ -104,7 +112,8 @@ class ClusterState:
                  transient_settings: Optional[Settings] = None,
                  stored_scripts: Optional[Dict[str, dict]] = None,
                  ingest_pipelines: Optional[Dict[str, dict]] = None,
-                 repositories: Optional[Dict[str, dict]] = None):
+                 repositories: Optional[Dict[str, dict]] = None,
+                 routing: Optional[dict] = None):
         self.cluster_name = cluster_name
         self.version = version
         self.indices = dict(indices or {})
@@ -116,6 +125,10 @@ class ClusterState:
         self.stored_scripts = dict(stored_scripts or {})
         self.ingest_pipelines = dict(ingest_pipelines or {})
         self.repositories = dict(repositories or {})
+        # explicit routing table ({index: {shard_id: [ShardRouting]}}),
+        # set by reroute/allocation; None = synthesize from metadata
+        # (single-node: every primary on the master)
+        self.routing = routing
 
     def copy(self, **overrides) -> "ClusterState":
         kw = dict(
@@ -130,6 +143,7 @@ class ClusterState:
             stored_scripts=dict(self.stored_scripts),
             ingest_pipelines=copy.deepcopy(self.ingest_pipelines),
             repositories=copy.deepcopy(self.repositories),
+            routing=copy.deepcopy(self.routing),
         )
         kw.update(overrides)
         return ClusterState(**kw)
@@ -201,13 +215,25 @@ class ClusterState:
                     "transient": self.transient_settings.as_nested_dict(),
                 },
             },
-            "routing_table": {
-                "indices": {
-                    n: {"shards": {str(s.shard_id): [s.to_dict()] for s in shards}}
-                    for n, shards in self.routing_table().items()
-                }
-            },
+            "routing_table": {"indices": self._routing_table_dict()},
         }
+
+    def _routing_table_dict(self) -> dict:
+        """Render the routing table against CURRENT metadata: the explicit
+        table (reroute/allocation) is a per-index overlay — indices
+        created after the last reroute synthesize their default routing,
+        deleted indices drop out (the table must never freeze)."""
+        explicit = self.routing or {}
+        out = {}
+        for n, shards in self.routing_table().items():
+            if n in explicit:
+                out[n] = {"shards": {
+                    str(sid): [c.to_dict() for c in copies]
+                    for sid, copies in explicit[n].items()}}
+            else:
+                out[n] = {"shards": {str(s.shard_id): [s.to_dict()]
+                                     for s in shards}}
+        return out
 
 
 class ClusterService:

@@ -445,6 +445,24 @@ RECOVERY_MAX_RETRIES = Setting.int_setting(
 RECOVERY_ACTION_TIMEOUT = Setting.time_setting(
     "indices.recovery.internal_action_timeout", "30s", dynamic=True
 )
+def _validate_tiles_per_step(v):
+    # must divide the power-of-two tile counts the kernel produces; the
+    # kernel helper only honors these values, so reject everything else
+    # here instead of silently running with 1
+    if v not in (1, 2, 4, 8):
+        raise IllegalArgumentException(
+            f"Failed to parse value [{v}] for setting "
+            f"[search.pallas.tiles_per_step]: must be one of 1, 2, 4, 8")
+
+
+SEARCH_PALLAS_TILES_PER_STEP = Setting(
+    # TPU-specific DMA buffering toggle: tiles folded into one grid step
+    # of the tile-scoring kernel (ops/pallas_scoring.py) so their posting-
+    # window DMAs double-buffer against compute; exported to the kernel
+    # via ES_TPU_PALLAS_TPS at node startup. 1 = historical behavior.
+    "search.pallas.tiles_per_step", 1, int,
+    validator=_validate_tiles_per_step,
+)
 
 NODE_SETTINGS = [
     CLUSTER_NAME,
@@ -478,6 +496,7 @@ NODE_SETTINGS = [
     RECOVERY_RETRY_DELAY_NETWORK,
     RECOVERY_MAX_RETRIES,
     RECOVERY_ACTION_TIMEOUT,
+    SEARCH_PALLAS_TILES_PER_STEP,
 ]
 
 # --- index-scoped ---
@@ -526,7 +545,32 @@ INDEX_MAPPING_TOTAL_FIELDS_LIMIT = Setting.int_setting(
     "index.mapping.total_fields.limit", 1000, min_value=1, scope=Scope.INDEX, dynamic=True
 )
 
+# --- mesh data plane (parallel/plan_exec.py; docs/MESH.md) ---
+
+INDEX_SEARCH_MESH = Setting.bool_setting(
+    # serve eligible searches as one multi-device mesh program (true) or
+    # always host-merge per shard (false)
+    "index.search.mesh", True, scope=Scope.INDEX
+)
+INDEX_SEARCH_MESH_MAX_SLOTS = Setting.int_setting(
+    # packing limit: how many segments may pack onto one device before
+    # the index falls back to the host path (slots unroll in the device
+    # program, so compile time and per-device work grow with this)
+    "index.search.mesh.max_slots_per_device", 4, min_value=1, max_value=64,
+    scope=Scope.INDEX
+)
+INDEX_SEARCH_MESH_PLANE = Setting.str_setting(
+    # scoring-plane override inside the mesh program: auto = tile kernel
+    # when stageable with scatter fallback; pallas = kernel or host
+    # (never the scatter mesh); scatter = never build kernel plans
+    "index.search.mesh.plane", "auto",
+    choices={"auto", "pallas", "scatter"}, scope=Scope.INDEX
+)
+
 INDEX_SETTINGS = [
+    INDEX_SEARCH_MESH,
+    INDEX_SEARCH_MESH_MAX_SLOTS,
+    INDEX_SEARCH_MESH_PLANE,
     INDEX_NUMBER_OF_SHARDS,
     INDEX_NUMBER_OF_REPLICAS,
     INDEX_REFRESH_INTERVAL,

@@ -135,7 +135,8 @@ class Engine:
               op_type: str = "index", seqno: Optional[int] = None,
               add_to_translog: bool = True,
               replicated_version: Optional[int] = None,
-              primary_term: int = 1) -> dict:
+              primary_term: int = 1,
+              parent: Optional[str] = None) -> dict:
         """Index one document (create or update). Returns the result dict
         {_id, _version, _seq_no, result: created|updated}.
 
@@ -196,7 +197,8 @@ class Engine:
             created = existing is None or existing.deleted
             if existing is not None and not existing.deleted:
                 self._tombstone(existing)
-            local_doc = self.buffer.add_document(parsed, seqno, new_version)
+            local_doc = self.buffer.add_document(parsed, seqno, new_version,
+                                                 parent=parent)
             self._buffer_routings[local_doc] = routing
             self.version_map[doc_id] = VersionEntry(
                 new_version, seqno, None, local_doc, term=primary_term
@@ -204,7 +206,7 @@ class Engine:
             if add_to_translog:
                 self.translog.add(TranslogOp(
                     TranslogOp.INDEX, seqno, doc_id, source, routing,
-                    new_version, primary_term
+                    new_version, primary_term, parent=parent
                 ))
             self.indexing_total += 1
             self.indexing_time += time.monotonic() - t0
@@ -448,17 +450,21 @@ class Engine:
             self.refresh()
             live_docs = []
             for seg in self.segments:
+                seg_parents = getattr(seg, "parents", None) or []
                 for local_doc in range(seg.num_docs):
                     if seg.live[local_doc]:
                         live_docs.append((
                             seg.doc_ids[local_doc], seg.sources[local_doc],
                             seg.routings[local_doc],
                             int(seg.seqnos[local_doc]), int(seg.versions[local_doc]),
+                            (seg_parents[local_doc]
+                             if local_doc < len(seg_parents) else None),
                         ))
             builder = self._new_builder()
-            for doc_id, source, routing, seqno, version in live_docs:
+            for doc_id, source, routing, seqno, version, parent in live_docs:
                 parsed = self.mapper_service.parse_document(doc_id, source, routing)
-                local = builder.add_document(parsed, seqno, version)
+                local = builder.add_document(parsed, seqno, version,
+                                             parent=parent)
                 # carry the op's primary term through the rebuild — the
                 # equal-seqno staleness tie-break and recovery streams
                 # read it from the version map
@@ -484,7 +490,8 @@ class Engine:
                 self.index(op.doc_id, op.source, op.routing, seqno=op.seqno,
                            add_to_translog=False,
                            replicated_version=op.version,
-                           primary_term=op.primary_term)
+                           primary_term=op.primary_term,
+                           parent=op.parent)
             elif op.op_type == TranslogOp.DELETE:
                 self.delete(op.doc_id, seqno=op.seqno, add_to_translog=False,
                             replicated_version=op.version,

@@ -108,8 +108,11 @@ class IndexService:
         self._refresh_total = 0
         self._host_query_total = 0
         # legacy _parent metadata field values (ParentFieldMapper):
-        # doc_id -> parent id, surfaced via stored_fields [_parent]
+        # doc_id -> parent id, surfaced via stored_fields [_parent].
+        # Values persist with the document (translog/store record
+        # alongside routing) and are rebuilt here after recovery.
         self.parents: Dict[str, str] = {}
+        self._rebuild_parents()
         self._flush_total = 0
         cache_bytes = settings.get_int(
             "index.requests.cache.size_in_bytes", 8 * 1024 * 1024)
@@ -141,6 +144,26 @@ class IndexService:
             threading.Thread(target=_refresh_loop, daemon=True,
                              name=f"refresh[{name}]").start()
 
+    def _rebuild_parents(self) -> None:
+        """Re-derive the _parent registry from recovered shard state: the
+        sealed segments' per-doc parent column and the (translog-replayed)
+        buffer — so stored_fields [_parent] survives restart/restore
+        (round-5 advisor finding: the registry was memory-only)."""
+        for shard in self.shards.values():
+            eng = shard.engine
+            for seg in eng.segments:
+                parents = getattr(seg, "parents", None)
+                if not parents:
+                    continue
+                for local, doc_id in enumerate(seg.doc_ids):
+                    p = parents[local] if local < len(parents) else None
+                    if p is not None and seg.live[local]:
+                        self.parents[str(doc_id)] = str(p)
+            buf = eng.buffer
+            for local, p in enumerate(getattr(buf, "parents", []) or []):
+                if p is not None and local not in eng._buffer_deletes:
+                    self.parents[str(buf.doc_ids[local])] = str(p)
+
     # ------------------------------------------------------------------
     # Routing + document ops
     # ------------------------------------------------------------------
@@ -150,10 +173,16 @@ class IndexService:
                             self.num_shards)
 
     def index_doc(self, doc_id: str, source: dict, routing: Optional[str] = None,
-                  **kw) -> dict:
+                  parent: Optional[str] = None, **kw) -> dict:
         routing = self._check_join_routing(doc_id, source, routing)
         shard = self.shards[self._route(doc_id, routing)]
-        return shard.index_doc(doc_id, source, routing, **kw)
+        r = shard.index_doc(doc_id, source, routing, parent=parent, **kw)
+        if parent is not None:
+            # the registry serves stored_fields [_parent]; the value also
+            # rides the engine record (translog + segment) so it survives
+            # restart/restore — rebuilt in _rebuild_parents()
+            self.parents[str(doc_id)] = str(parent)
+        return r
 
     def _check_join_routing(self, doc_id: str, source: dict,
                             routing: Optional[str]) -> Optional[str]:
@@ -307,8 +336,10 @@ class IndexService:
             "took": int((_time.monotonic() - t0) * 1000),
             "timed_out": False,
             # which data plane served the query phase (execution-plane
-            # observability; mirrored as counters in _stats)
-            "_plane": "mesh",
+            # observability; mirrored as counters in _stats):
+            # "mesh_pallas" = the tile kernel scored inside the mesh
+            # program (the unified fast plane), "mesh" = scatter mesh
+            "_plane": out.get("plane", "mesh"),
             "_shards": {"total": len(self.shards),
                         "successful": len(self.shards),
                         "skipped": 0, "failed": 0},
@@ -532,6 +563,9 @@ class IndexService:
                 "mesh_query_total": (self._mesh_search.query_total
                                      if self._mesh_search is not None
                                      else 0),
+                "mesh_pallas_query_total": (
+                    self._mesh_search.pallas_query_total
+                    if self._mesh_search is not None else 0),
                 "host_query_total": self._host_query_total,
                 "pallas_segments_total": sum(
                     s["search"]["planes"]["pallas_segments_total"]

@@ -151,6 +151,7 @@ class Segment:
         positions: Optional[Dict[int, dict]] = None,
         nested: Optional[Dict[str, NestedContext]] = None,
         shapes: Optional[Dict[str, Dict[int, list]]] = None,
+        parents: Optional[List[Optional[str]]] = None,
     ):
         self.name = name
         self.num_docs = num_docs
@@ -158,6 +159,9 @@ class Segment:
         self.doc_ids = doc_ids
         self.sources = sources
         self.routings = routings
+        # legacy _parent metadata value per doc (None = no parent) —
+        # persisted with the segment like routings (ParentFieldMapper)
+        self.parents = parents if parents is not None else [None] * num_docs
         self.seqnos = seqnos
         self.versions = versions
         # sorted composite term keys; term_id = position
@@ -456,6 +460,7 @@ class SegmentBuilder:
         self.doc_ids: List[str] = []
         self.sources: List[dict] = []
         self.routings: List[Optional[str]] = []
+        self.parents: List[Optional[str]] = []
         self.seqnos: List[int] = []
         self.versions: List[int] = []
         # term_key -> list[(doc, tf)] — appended in doc order, so sorted by doc
@@ -478,12 +483,14 @@ class SegmentBuilder:
     def num_docs(self) -> int:
         return len(self.doc_ids)
 
-    def add_document(self, parsed, seqno: int, version: int = 1) -> int:
+    def add_document(self, parsed, seqno: int, version: int = 1,
+                     parent: Optional[str] = None) -> int:
         """parsed: mapper.ParsedDocument. Returns the local doc id."""
         doc = len(self.doc_ids)
         self.doc_ids.append(parsed.doc_id)
         self.sources.append(parsed.source)
         self.routings.append(parsed.routing)
+        self.parents.append(parent)
         self.seqnos.append(seqno)
         self.versions.append(version)
         for field_name, tokens in parsed.terms.items():
@@ -570,6 +577,7 @@ class SegmentBuilder:
         self.doc_ids = reorder(self.doc_ids)
         self.sources = reorder(self.sources)
         self.routings = reorder(self.routings)
+        self.parents = reorder(self.parents)
         self.seqnos = reorder(self.seqnos)
         self.versions = reorder(self.versions)
         self.postings = {
@@ -783,6 +791,7 @@ class SegmentBuilder:
             positions=positions,
             nested=nested,
             shapes={f: dict(per_doc) for f, per_doc in self.shape_values.items()},
+            parents=list(self.parents),
         )
 
 

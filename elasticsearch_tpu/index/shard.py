@@ -266,13 +266,15 @@ class IndexShard:
 
     def index_doc(self, doc_id: str, source: dict, routing: Optional[str] = None,
                   version: Optional[int] = None, version_type: str = "internal",
-                  op_type: str = "index", seqno: Optional[int] = None) -> dict:
+                  op_type: str = "index", seqno: Optional[int] = None,
+                  parent: Optional[str] = None) -> dict:
         self._ensure_started()
         t0 = time.monotonic()
         with self.permits.acquire():
             r = self.engine.index(doc_id, source, routing, version,
                                   version_type, op_type, seqno,
-                                  primary_term=self.primary_term)
+                                  primary_term=self.primary_term,
+                                  parent=parent)
         self._maybe_indexing_slowlog(time.monotonic() - t0, doc_id, source)
         r["_index"] = self.index_name
         r["_shard"] = self.shard_id

@@ -163,6 +163,9 @@ class Store:
             "geo_fields": {f: c.count for f, c in seg.geo_columns.items()},
             "doc_ids": seg.doc_ids,
             "routings": seg.routings,
+            # legacy _parent values (alongside routing; rebuilds the
+            # IndexService.parents registry on recovery)
+            "parents": seg.parents,
             # geo_shape sidecar: raw GeoJSON/WKT per doc (geometry rebuilt
             # lazily at query time)
             "shapes": {f: {str(doc): vals for doc, vals in per_doc.items()}
@@ -311,6 +314,7 @@ class Store:
             positions=positions,
             shapes={f: {int(doc): vals for doc, vals in per_doc.items()}
                     for f, per_doc in (meta.get("shapes") or {}).items()},
+            parents=meta.get("parents"),
         )
         live_path = os.path.join(d, "live.npy")
         if os.path.exists(live_path):

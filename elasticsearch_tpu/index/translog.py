@@ -26,7 +26,8 @@ class TranslogOp:
 
     def __init__(self, op_type: str, seqno: int, doc_id: Optional[str] = None,
                  source: Optional[dict] = None, routing: Optional[str] = None,
-                 version: int = 1, primary_term: int = 1):
+                 version: int = 1, primary_term: int = 1,
+                 parent: Optional[str] = None):
         self.op_type = op_type
         self.seqno = seqno
         self.doc_id = doc_id
@@ -34,6 +35,9 @@ class TranslogOp:
         self.routing = routing
         self.version = version
         self.primary_term = primary_term
+        # legacy _parent metadata value — persisted alongside routing so
+        # the registry survives restart (ParentFieldMapper stores it)
+        self.parent = parent
 
     def to_dict(self) -> dict:
         d = {"op": self.op_type, "seq_no": self.seqno, "primary_term": self.primary_term,
@@ -44,6 +48,8 @@ class TranslogOp:
             d["source"] = self.source
         if self.routing is not None:
             d["routing"] = self.routing
+        if self.parent is not None:
+            d["parent"] = self.parent
         return d
 
     @staticmethod
@@ -51,6 +57,7 @@ class TranslogOp:
         return TranslogOp(
             d["op"], d["seq_no"], d.get("id"), d.get("source"), d.get("routing"),
             d.get("version", 1), d.get("primary_term", 1),
+            parent=d.get("parent"),
         )
 
 

@@ -56,6 +56,17 @@ class Node:
         self.node_name = NODE_NAME.get(settings)
         self.cluster_settings = cluster_settings()
         self.index_scoped_settings = index_scoped_settings()
+        # kernel DMA-buffering toggle: exported once at startup; the
+        # pallas layer reads ES_TPU_PALLAS_TPS (see settings registry)
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_PALLAS_TILES_PER_STEP,
+        )
+
+        # exported unconditionally: a later Node in the same process must
+        # not inherit a previous Node's value through a stale env var
+        # (the env var is process-global — the last-constructed Node wins)
+        os.environ["ES_TPU_PALLAS_TPS"] = str(
+            int(SEARCH_PALLAS_TILES_PER_STEP.get(settings)))
         self.data_path = data_path or PATH_DATA.get(settings)
         self.persistent_path = data_path is not None or "path.data" in settings
         # secure settings from the encrypted keystore (KeyStoreWrapper):
@@ -450,7 +461,8 @@ class Node:
     def index_doc(self, index: str, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, refresh=None,
                   pipeline: Optional[str] = None,
-                  wait_for_active_shards=None, **kw) -> dict:
+                  wait_for_active_shards=None,
+                  parent: Optional[str] = None, **kw) -> dict:
         if doc_id is not None:
             if doc_id == "":
                 raise IllegalArgumentException(
@@ -470,7 +482,7 @@ class Node:
         if doc_id is None:
             doc_id = _uuid.uuid4().hex[:20]
             kw.setdefault("op_type", "create")
-        r = svc.index_doc(doc_id, source, routing, **kw)
+        r = svc.index_doc(doc_id, source, routing, parent=parent, **kw)
         self._maybe_refresh(svc, refresh, doc_id=doc_id, routing=routing)
         self._maybe_update_mapping_meta(index)
         return r
@@ -698,11 +710,15 @@ class Node:
             try:
                 if action == "index":
                     r = self.index_doc(index, doc_id, source, routing,
-                                       pipeline=item_pipeline)
+                                       pipeline=item_pipeline,
+                                       parent=(str(parent)
+                                               if parent is not None else None))
                     status = 201 if r.get("result") == "created" else 200
                 elif action == "create":
                     r = self.index_doc(index, doc_id, source, routing,
-                                       op_type="create", pipeline=item_pipeline)
+                                       op_type="create", pipeline=item_pipeline,
+                                       parent=(str(parent)
+                                               if parent is not None else None))
                     status = 201
                 elif action == "update":
                     r = self.update_doc(index, doc_id, source, routing)
@@ -1245,6 +1261,65 @@ class Node:
 
     def health(self) -> dict:
         return cluster_health(self.cluster_service.state, self.indices)
+
+    def reroute(self, body: Optional[dict] = None, dry_run: bool = False,
+                explain: bool = False) -> dict:
+        """_cluster/reroute (TransportClusterRerouteAction +
+        cluster/routing/allocation/command/): parse the command list,
+        apply each against the routing table, then run the allocator to
+        normalize (fill unassigned, balance), committing the new table to
+        cluster state unless dry_run. Returns the RESULTING state — not a
+        blind ack."""
+        import copy as _copy
+
+        from elasticsearch_tpu.cluster import allocation as alloc
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+        state = self.cluster_service.state
+        data_nodes = [nid for nid, n in state.nodes.items()
+                      if "data" in n.roles]
+        # accepted node addresses: id or name (the reference resolves
+        # both through DiscoveryNodes.resolveNode)
+        node_ids = {nid: nid for nid in state.nodes}
+        node_ids.update({n.name: nid for nid, n in state.nodes.items()})
+        open_meta = {name: md for name, md in state.indices.items()}
+        table = state.routing
+        if table is None:
+            table = alloc.allocate(open_meta, data_nodes)
+        table = _copy.deepcopy(table)
+        explanations = []
+        for cmd in (body or {}).get("commands") or []:
+            if not isinstance(cmd, dict) or len(cmd) != 1:
+                raise IllegalArgumentException(
+                    f"malformed reroute command {cmd!r}")
+            (name, args), = cmd.items()
+            try:
+                explanations.append(alloc.apply_command(
+                    table, open_meta, node_ids, name, dict(args or {})))
+            except alloc.RerouteException as e:
+                raise IllegalArgumentException(str(e)) from None
+        # normalize: the allocator keeps sticky placements, fills
+        # unassigned copies and retires finished relocations
+        new_table = alloc.allocate(open_meta, data_nodes, previous=table)
+        # single-node reality check: a primary routed to THIS node is
+        # backed by a live local shard — report it STARTED (the recovery
+        # that would move INITIALIZING->STARTED already happened)
+        for shards in new_table.values():
+            for copies in shards.values():
+                for c in copies:
+                    if c.primary and c.node_id == self.node_id:
+                        c.state = "STARTED"
+        if dry_run:
+            preview = state.copy(routing=new_table)
+            resp = {"acknowledged": True, "state": preview.to_dict()}
+        else:
+            new_state = self.cluster_service.submit_state_update_task(
+                "cluster_reroute (api)",
+                lambda s: s.copy(routing=new_table))
+            resp = {"acknowledged": True, "state": new_state.to_dict()}
+        if explain:
+            resp["explanations"] = explanations
+        return resp
 
     def cluster_stats(self) -> dict:
         state = self.cluster_service.state

@@ -73,6 +73,23 @@ CB_MAX = 128
 NEG_INF = float("-inf")
 
 
+def tiles_per_step_default() -> int:
+    """Grid-coarsening factor for DMA double-buffering across tiles.
+
+    Sourced from ES_TPU_PALLAS_TPS (the registered node setting
+    ``search.pallas.tiles_per_step`` exports it at startup). 1 = one tile
+    per grid step (historical behavior); 2/4/8 fold that many tiles into
+    one step so their posting-window DMAs overlap compute and the fixed
+    per-step dispatch cost amortizes."""
+    import os
+
+    try:
+        v = int(os.environ.get("ES_TPU_PALLAS_TPS", "1"))
+    except ValueError:
+        return 1
+    return v if v in (1, 2, 4, 8) else 1
+
+
 # ----------------------------------------------------------------------
 # Host-side geometry: which docs does tile t get from term lane j?
 # ----------------------------------------------------------------------
@@ -235,7 +252,7 @@ def build_tile_tables(
 
 
 def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
-                 with_counts: bool):
+                 with_counts: bool, tps: int = 1):
     """Kernel body. Mosaic constraints shape the formulation:
 
     - only lane-collapsing reshapes ((cb,128) -> (1, cb*128)) lower; the
@@ -251,6 +268,13 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
       (1, k) vectors with masked selects and stores whole blocks.
     - bool -> f32 astype trips a recursive convert_element_type fallback;
       where-selects lower cleanly.
+
+    ``tps`` (tiles per grid step): grid coarsening for DMA double-buffering
+    across tiles — one grid step owns tps consecutive tiles, so all of the
+    step's posting windows are issued up front and the DMA engine streams
+    tile i+1's windows while the MXU works tile i, and the fixed per-step
+    dispatch cost (which dominates the kernel — see module docstring) is
+    paid once per tps tiles.
     """
     w = sub * LANE
     # two consecutive cb-aligned DMA windows per lane; each processes its
@@ -258,124 +282,154 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
     rows = cb * LANE
 
     def kernel(rowlo_ref, rowhi_ref, *refs):
-        docs_refs = [(refs[4 * j], refs[4 * j + 2]) for j in range(t_pad)]
-        frac_refs = [(refs[4 * j + 1], refs[4 * j + 3]) for j in range(t_pad)]
-        live_ref = refs[4 * t_pad]
-        w_ref = refs[4 * t_pad + 1]
-        n_outs = (1 + int(with_counts)) if dense else 3
-        outs = refs[4 * t_pad + 2: 4 * t_pad + 2 + n_outs]
-        acc_ref = refs[4 * t_pad + 2 + n_outs]
-        cnt_ref = refs[4 * t_pad + 3 + n_outs] if with_counts else None
-        t = pl.program_id(0)
-        base = jnp.int32(t) * jnp.int32(w)
-        # scratch accumulators persist across grid steps: reset first
-        acc_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
-        if with_counts:
-            cnt_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
-        for j in range(t_pad):
-            rlo = rowlo_ref[t, j]
-            rhi = rowhi_ref[t, j]
-            # aligned first row actually DMA'd (must mirror lane_map below)
-            sb = lax.div(rlo, jnp.int32(cb)) * jnp.int32(cb)
-            wj = w_ref[0, j]
-            for half in (0, 1):
-                start = sb + jnp.int32(half * cb)
-                # skip the whole window when it can't intersect the lane's
-                # covering run: empty lanes skip both halves, and the
-                # second half only runs on the rare misaligned overflow —
-                # this halves the one-hot/MXU work in the common case
-                needed = (rhi > rlo) & (start < rhi) \
-                    & (start + jnp.int32(cb) > rlo)
+        def dref(j, ti, half):
+            return refs[4 * (j * tps + ti) + 2 * half]
 
-                @pl.when(needed)
-                def _(j=j, half=half, start=start, rlo=rlo, rhi=rhi, wj=wj):
-                    docs = docs_refs[j][half][...]
-                    frac = frac_refs[j][half][...]
-                    blk = start + lax.broadcasted_iota(
-                        jnp.int32, (cb, LANE), 0)
-                    local = docs - base
-                    valid = (
-                        (blk >= rlo) & (blk < rhi)
-                        & (local >= jnp.int32(0)) & (local < jnp.int32(w))
-                        & (frac > jnp.float32(0.0))
-                    )
-                    # NB every scalar int literal below must be an explicit
-                    # int32: inside the kernel trace weak python ints become
-                    # i64 scalars, and mosaic's i64->i32 demotion fallback
-                    # recurses forever
-                    safe = jnp.where(valid, local, jnp.int32(0))
-                    hi = jnp.where(valid, lax.shift_right_logical(
-                        safe, jnp.int32(7)), jnp.int32(-1))
-                    lo = jnp.where(valid, jnp.bitwise_and(
-                        safe, jnp.int32(LANE - 1)), jnp.int32(-1))
-                    hi_row = hi.reshape(1, rows)
-                    lo_row = lo.reshape(1, rows)
-                    wf_row = (frac * wj).reshape(1, rows)
-                    ohT = jnp.where(
-                        lax.broadcasted_iota(
-                            jnp.int32, (sub, rows), 0) == hi_row,
-                        jnp.float32(1.0), jnp.float32(0.0))
-                    # two-pass error-compensated matmul: the MXU's default
-                    # single bf16 pass rounds w*frac to an 8-bit mantissa
-                    # (~0.2% rel error — enough to reorder near-tied BM25
-                    # ranks vs the host oracle), and Precision.HIGHEST
-                    # costs 6 passes. bf16-high + f32-residual summed over
-                    # two DEFAULT dots gives ~2^-17 rel error at 1/3 the
-                    # passes (ohT is 0/1, bf16-exact).
-                    lane_iota = lax.broadcasted_iota(
-                        jnp.int32, (LANE, rows), 0)
-                    wf_hi = wf_row.astype(jnp.bfloat16).astype(jnp.float32)
-                    wf_lo = wf_row - wf_hi
-                    lov_hi = jnp.where(lane_iota == lo_row, wf_hi,
-                                       jnp.float32(0.0))
-                    lov_lo = jnp.where(lane_iota == lo_row, wf_lo,
-                                       jnp.float32(0.0))
-                    acc_ref[...] = acc_ref[...] + lax.dot_general(
-                        lov_hi, ohT, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) + lax.dot_general(
-                        lov_lo, ohT, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-                    if with_counts:
-                        lovT1 = jnp.where(lane_iota == lo_row,
-                                          jnp.float32(1.0), jnp.float32(0.0))
-                        cnt_ref[...] = cnt_ref[...] + lax.dot_general(
-                            lovT1, ohT, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        accT = acc_ref[...]
-        cntT = cnt_ref[...] if with_counts else None
-        live = live_ref[...] > jnp.float32(0.0)  # (LANE, sub) transposed
-        if dense:
-            out_scores = outs[0]
-            out_scores[...] = jnp.where(live, accT, jnp.float32(0.0))
+        def fref(j, ti, half):
+            return refs[4 * (j * tps + ti) + 2 * half + 1]
+
+        base_in = 4 * t_pad * tps
+        live_ref = refs[base_in]
+        w_ref = refs[base_in + 1]
+        n_outs = (1 + int(with_counts)) if dense else 3
+        outs = refs[base_in + 2: base_in + 2 + n_outs]
+        acc_ref = refs[base_in + 2 + n_outs]
+        cnt_ref = refs[base_in + 3 + n_outs] if with_counts else None
+        t = pl.program_id(0)
+        for ti in range(tps):
+            tile = jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti)
+            base = tile * jnp.int32(w)
+            # scratch accumulators persist across grid steps (and tiles
+            # within a step): reset first
+            acc_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
             if with_counts:
-                outs[1][...] = jnp.where(live, cntT, jnp.float32(0.0))
-            return
-        out_s, out_d, out_h = outs
-        matched = (accT > jnp.float32(0.0)) & live
-        hits = jnp.sum(jnp.where(matched, jnp.float32(1.0), jnp.float32(0.0)))
-        out_h[...] = hits.reshape(1, 1, 1)
-        # float literals must be explicit f32: a weak python -inf traces as
-        # an f64 scalar inside the kernel and crashes the TPU compiler
-        ninf = jnp.float32(NEG_INF)
-        masked = jnp.where(matched, accT, ninf)
-        # local doc id at accT[lane, s] is s*128 + lane
-        lin = (lax.broadcasted_iota(jnp.int32, (LANE, sub), 1) * jnp.int32(LANE)
-               + lax.broadcasted_iota(jnp.int32, (LANE, sub), 0))
-        outv_s = jnp.full((1, k), NEG_INF, jnp.float32)
-        outv_d = jnp.full((1, k), -1, jnp.int32)
-        k_iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
-        for i in range(k):
-            mx = jnp.max(masked)
-            sel = jnp.where(masked == mx, lin, jnp.int32(w))
-            idx = jnp.min(sel)
-            outv_s = jnp.where(k_iota == jnp.int32(i), mx, outv_s)
-            outv_d = jnp.where(
-                k_iota == jnp.int32(i),
-                jnp.where(mx == ninf, jnp.int32(-1), base + idx),
-                outv_d)
-            masked = jnp.where(lin == idx, ninf, masked)
-        out_s[...] = outv_s.reshape(1, 1, k)
-        out_d[...] = outv_d.reshape(1, 1, k)
+                cnt_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
+            for j in range(t_pad):
+                rlo = rowlo_ref[tile, j]
+                rhi = rowhi_ref[tile, j]
+                # aligned first row actually DMA'd (mirrors lane_map below)
+                sb = lax.div(rlo, jnp.int32(cb)) * jnp.int32(cb)
+                wj = w_ref[0, j]
+                for half in (0, 1):
+                    start = sb + jnp.int32(half * cb)
+                    # skip the whole window when it can't intersect the
+                    # lane's covering run: empty lanes skip both halves,
+                    # and the second half only runs on the rare misaligned
+                    # overflow — this halves the one-hot/MXU work in the
+                    # common case
+                    needed = (rhi > rlo) & (start < rhi) \
+                        & (start + jnp.int32(cb) > rlo)
+
+                    @pl.when(needed)
+                    def _(j=j, ti=ti, half=half, start=start, rlo=rlo,
+                          rhi=rhi, wj=wj, base=base):
+                        docs = dref(j, ti, half)[...]
+                        frac = fref(j, ti, half)[...]
+                        blk = start + lax.broadcasted_iota(
+                            jnp.int32, (cb, LANE), 0)
+                        local = docs - base
+                        valid = (
+                            (blk >= rlo) & (blk < rhi)
+                            & (local >= jnp.int32(0)) & (local < jnp.int32(w))
+                            & (frac > jnp.float32(0.0))
+                        )
+                        # NB every scalar int literal below must be an
+                        # explicit int32: inside the kernel trace weak
+                        # python ints become i64 scalars, and mosaic's
+                        # i64->i32 demotion fallback recurses forever
+                        safe = jnp.where(valid, local, jnp.int32(0))
+                        hi = jnp.where(valid, lax.shift_right_logical(
+                            safe, jnp.int32(7)), jnp.int32(-1))
+                        lo = jnp.where(valid, jnp.bitwise_and(
+                            safe, jnp.int32(LANE - 1)), jnp.int32(-1))
+                        hi_row = hi.reshape(1, rows)
+                        lo_row = lo.reshape(1, rows)
+                        wf_row = (frac * wj).reshape(1, rows)
+                        ohT = jnp.where(
+                            lax.broadcasted_iota(
+                                jnp.int32, (sub, rows), 0) == hi_row,
+                            jnp.float32(1.0), jnp.float32(0.0))
+                        # two-pass error-compensated matmul: the MXU's
+                        # default single bf16 pass rounds w*frac to an
+                        # 8-bit mantissa (~0.2% rel error — enough to
+                        # reorder near-tied BM25 ranks vs the host oracle),
+                        # and Precision.HIGHEST costs 6 passes. bf16-high +
+                        # f32-residual summed over two DEFAULT dots gives
+                        # ~2^-17 rel error at 1/3 the passes (ohT is 0/1,
+                        # bf16-exact).
+                        lane_iota = lax.broadcasted_iota(
+                            jnp.int32, (LANE, rows), 0)
+                        wf_hi = wf_row.astype(jnp.bfloat16).astype(jnp.float32)
+                        wf_lo = wf_row - wf_hi
+                        lov_hi = jnp.where(lane_iota == lo_row, wf_hi,
+                                           jnp.float32(0.0))
+                        lov_lo = jnp.where(lane_iota == lo_row, wf_lo,
+                                           jnp.float32(0.0))
+                        acc_ref[...] = acc_ref[...] + lax.dot_general(
+                            lov_hi, ohT, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) + lax.dot_general(
+                            lov_lo, ohT, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                        if with_counts:
+                            lovT1 = jnp.where(lane_iota == lo_row,
+                                              jnp.float32(1.0),
+                                              jnp.float32(0.0))
+                            cnt_ref[...] = cnt_ref[...] + lax.dot_general(
+                                lovT1, ohT, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            accT = acc_ref[...]
+            cntT = cnt_ref[...] if with_counts else None
+            # (LANE, sub) transposed live slab for THIS tile; tps==1 keeps
+            # the historical full-block access pattern
+            if tps == 1:
+                live = live_ref[...] > jnp.float32(0.0)
+            else:
+                live = live_ref[pl.ds(ti * LANE, LANE), :] > jnp.float32(0.0)
+            if dense:
+                sc = jnp.where(live, accT, jnp.float32(0.0))
+                if tps == 1:
+                    outs[0][...] = sc
+                    if with_counts:
+                        outs[1][...] = jnp.where(live, cntT, jnp.float32(0.0))
+                else:
+                    outs[0][pl.ds(ti * LANE, LANE), :] = sc
+                    if with_counts:
+                        outs[1][pl.ds(ti * LANE, LANE), :] = jnp.where(
+                            live, cntT, jnp.float32(0.0))
+                continue
+            out_s, out_d, out_h = outs
+            matched = (accT > jnp.float32(0.0)) & live
+            hits = jnp.sum(jnp.where(matched, jnp.float32(1.0),
+                                     jnp.float32(0.0)))
+            # float literals must be explicit f32: a weak python -inf traces
+            # as an f64 scalar inside the kernel and crashes the TPU compiler
+            ninf = jnp.float32(NEG_INF)
+            masked = jnp.where(matched, accT, ninf)
+            # local doc id at accT[lane, s] is s*128 + lane
+            lin = (lax.broadcasted_iota(jnp.int32, (LANE, sub), 1)
+                   * jnp.int32(LANE)
+                   + lax.broadcasted_iota(jnp.int32, (LANE, sub), 0))
+            outv_s = jnp.full((1, k), NEG_INF, jnp.float32)
+            outv_d = jnp.full((1, k), -1, jnp.int32)
+            k_iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
+            for i in range(k):
+                mx = jnp.max(masked)
+                sel = jnp.where(masked == mx, lin, jnp.int32(w))
+                idx = jnp.min(sel)
+                outv_s = jnp.where(k_iota == jnp.int32(i), mx, outv_s)
+                outv_d = jnp.where(
+                    k_iota == jnp.int32(i),
+                    jnp.where(mx == ninf, jnp.int32(-1), base + idx),
+                    outv_d)
+                masked = jnp.where(lin == idx, ninf, masked)
+            if tps == 1:
+                out_h[...] = hits.reshape(1, 1, 1)
+                out_s[...] = outv_s.reshape(1, 1, k)
+                out_d[...] = outv_d.reshape(1, 1, k)
+            else:
+                out_h[pl.ds(ti, 1)] = hits.reshape(1, 1, 1)
+                out_s[pl.ds(ti, 1)] = outv_s.reshape(1, 1, k)
+                out_d[pl.ds(ti, 1)] = outv_d.reshape(1, 1, k)
 
     return kernel
 
@@ -390,7 +444,7 @@ def _compiler_params():
 @functools.partial(
     jax.jit,
     static_argnames=("t_pad", "cb", "sub", "k", "dense", "with_counts",
-                     "interpret"),
+                     "interpret", "tiles_per_step"),
 )
 def score_tiles(
     docs_padded,  # [n_blocks + CB_MAX, LANE] i32 (pad_segment_blocks)
@@ -407,6 +461,7 @@ def score_tiles(
     dense: bool = False,
     with_counts: bool = False,
     interpret: bool = False,
+    tiles_per_step: int = 1,
 ):
     """Run the tile-scoring kernel over a segment.
 
@@ -417,10 +472,19 @@ def score_tiles(
     the kernel's transposed tile layout (dense_to_flat -> [nd_pad]) and,
     with_counts, match counts of the same shape (for minimum_should_match
     / conjunction masking).
+
+    tiles_per_step > 1 coarsens the grid: each step owns that many
+    consecutive tiles, double-buffering their DMA windows against compute
+    and amortizing the fixed per-grid-step cost that dominates this kernel
+    (the output layouts are unchanged). Clamped down to a divisor of
+    n_tiles.
     """
     n_tiles = row_lo.shape[0]
     w = sub * LANE
     k = min(k, w)
+    tps = max(1, int(tiles_per_step))
+    while n_tiles % tps:
+        tps //= 2
 
     # index maps must return int32 everywhere (and build the constant INSIDE
     # the lambda — captured tracers are rejected): the engine runs with jax
@@ -430,23 +494,26 @@ def score_tiles(
     def zero():
         return jnp.int32(0)
 
-    def lane_map(j, half):
+    def lane_map(j, ti, half):
         # lax.div (truncating) == floor-div for the non-negative row indices;
         # jnp's // lowers to a floor_divide jaxpr the mosaic index_map
-        # rejects. half=0/1 selects the first/second cb-aligned window.
+        # rejects. half=0/1 selects the first/second cb-aligned window of
+        # tile t*tps + ti.
         return lambda t, rlo, rhi: (
-            lax.div(rlo[t, j], jnp.int32(cb)) + jnp.int32(half), zero())
+            lax.div(rlo[jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti), j],
+                    jnp.int32(cb)) + jnp.int32(half), zero())
 
     in_specs = []
     operands = []
     for j in range(t_pad):
-        for half in (0, 1):
-            in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, half)))
-            operands.append(docs_padded)
-            in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, half)))
-            operands.append(frac_padded)
+        for ti in range(tps):
+            for half in (0, 1):
+                in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, ti, half)))
+                operands.append(docs_padded)
+                in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, ti, half)))
+                operands.append(frac_padded)
     in_specs.append(
-        pl.BlockSpec((LANE, sub), lambda t, rlo, rhi: (t, zero())))
+        pl.BlockSpec((tps * LANE, sub), lambda t, rlo, rhi: (t, zero())))
     operands.append(live_t)
     # the SMEM spec needs an explicit index map: the auto-generated default
     # returns weak python-int zeros, which trace to i64 under x64 and fail
@@ -457,11 +524,12 @@ def score_tiles(
 
     if dense:
         out_specs = [
-            pl.BlockSpec((LANE, sub), lambda t, rlo, rhi: (t, zero()))]
+            pl.BlockSpec((tps * LANE, sub), lambda t, rlo, rhi: (t, zero()))]
         out_shape = [jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32)]
         if with_counts:
             out_specs.append(
-                pl.BlockSpec((LANE, sub), lambda t, rlo, rhi: (t, zero())))
+                pl.BlockSpec((tps * LANE, sub),
+                             lambda t, rlo, rhi: (t, zero())))
             out_shape.append(
                 jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32))
     else:
@@ -469,11 +537,11 @@ def score_tiles(
         # satisfying mosaic's (8, 128)-divisibility-or-full-dim rule for
         # small per-tile outputs
         out_specs = [
-            pl.BlockSpec((1, 1, k),
+            pl.BlockSpec((tps, 1, k),
                          lambda t, rlo, rhi: (t, zero(), zero())),
-            pl.BlockSpec((1, 1, k),
+            pl.BlockSpec((tps, 1, k),
                          lambda t, rlo, rhi: (t, zero(), zero())),
-            pl.BlockSpec((1, 1, 1),
+            pl.BlockSpec((tps, 1, 1),
                          lambda t, rlo, rhi: (t, zero(), zero())),
         ]
         out_shape = [
@@ -487,12 +555,12 @@ def score_tiles(
         scratch_shapes.append(pltpu.VMEM((LANE, sub), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(n_tiles,),
+        grid=(n_tiles // tps,),
         in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=scratch_shapes,
     )
-    kernel = _make_kernel(t_pad, cb, sub, k, dense, with_counts)
+    kernel = _make_kernel(t_pad, cb, sub, k, dense, with_counts, tps)
     kwargs = {}
     params = _compiler_params()
     if params is not None and not interpret:

@@ -65,17 +65,22 @@ _PAD_VALUES = {"z": 0, "o": 1, "n": np.nan, "m1": -1}
 
 
 def stack_plans(plans: List[PlanNode], local_nd_pads: List[int],
-                stacked_nd1: int, n_devices: int) -> List[np.ndarray]:
+                stacked_nd1: int, n_slots: int) -> List[np.ndarray]:
     """Stack per-shard plan arrays to mesh-ready arrays.
 
     Returns a flat list aligned with ``template.flat_arrays()`` where every
-    entry has a leading [n_devices] axis. Device slots beyond len(plans)
-    replicate shard 0's arrays — their seg arrays have live1 all-False, so
-    they contribute nothing.
+    entry has a leading [n_slots] axis (slots = device x segments-packed-
+    per-device). Slots beyond len(plans) replicate shard 0's arrays —
+    their seg arrays have live1 all-False (and zero kernel frac), so they
+    contribute nothing.
     """
     _check_same_structure(plans)
     kinds = plans[0].flat_pad_kinds()
-    flats = [[np.asarray(a) for a in p.flat_arrays()] for p in plans]
+    try:
+        flats = [[np.asarray(a) for a in p.flat_arrays()] for p in plans]
+    except NotImplementedError:
+        # an unfinalized mesh kernel node — not stackable in this form
+        raise PlanStructureMismatch("plan contains unfinalized arrays")
     n_arrays = len(kinds)
     for f in flats:
         if len(f) != n_arrays:
@@ -84,18 +89,27 @@ def stack_plans(plans: List[PlanNode], local_nd_pads: List[int],
     stacked: List[np.ndarray] = []
     for i, kind in enumerate(kinds):
         if kind == "x":
-            # non-stackable node (e.g. the pallas tile kernel's 2-D
-            # per-query tables) — the host per-shard path serves these
+            # non-stackable node — the host per-shard path serves these
             raise PlanStructureMismatch("plan contains non-stackable arrays")
         parts = [f[i] for f in flats]
-        # replicate shard 0 into unused device slots
-        parts = parts + [parts[0]] * (n_devices - len(parts))
+        if kind == "k":
+            # kernel tables: stack verbatim, but ONLY when every shard's
+            # tables were harmonized to one shape (the kernel trace is
+            # shared — a shape divergence means harmonization didn't run
+            # and the plan must not reach the mesh program)
+            if len({(p.shape, str(p.dtype)) for p in parts}) != 1:
+                raise PlanStructureMismatch("kernel table shapes diverge")
+            parts = parts + [parts[0]] * (n_slots - len(parts))
+            stacked.append(np.stack(parts))
+            continue
+        # replicate shard 0 into unused slots
+        parts = parts + [parts[0]] * (n_slots - len(parts))
         if kind == "s" or parts[0].ndim == 0:
             stacked.append(np.stack([np.asarray(p) for p in parts]))
             continue
         if kind == "dense":
             tail = parts[0].shape[1:]
-            out = np.zeros((n_devices, stacked_nd1) + tail, parts[0].dtype)
+            out = np.zeros((n_slots, stacked_nd1) + tail, parts[0].dtype)
             for d, a in enumerate(parts):
                 out[d, : a.shape[0]] = a
             stacked.append(out)
@@ -104,10 +118,10 @@ def stack_plans(plans: List[PlanNode], local_nd_pads: List[int],
             max(p.shape[j] for p in parts) for j in range(parts[0].ndim)
         )
         if kind == "d":
-            out = np.full((n_devices,) + max_shape, sentinel,
+            out = np.full((n_slots,) + max_shape, sentinel,
                           dtype=parts[0].dtype)
         else:
-            out = np.full((n_devices,) + max_shape, _PAD_VALUES[kind],
+            out = np.full((n_slots,) + max_shape, _PAD_VALUES[kind],
                           dtype=parts[0].dtype)
         for d, a in enumerate(parts):
             if kind == "d":
@@ -169,6 +183,7 @@ class _TemplateHolder:
 
 @functools.lru_cache(maxsize=128)
 def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
+                        spd: int = 1,
                         sort_keys: Optional[Tuple[str, str]] = None,
                         with_views: bool = False,
                         features: frozenset = frozenset(),
@@ -182,26 +197,34 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
       total psum -> search_after cut -> (rescore window pass) ->
       local top-k -> all_gather global merge
 
+    spd: SLOTS per device. A device packs spd segments (the reference's
+    data node searching any number of Lucene leaves per shard,
+    search/internal/ContextIndexSearcher.java:53); the per-slot query
+    phases are unrolled into the device program, their candidates
+    concatenated before the ICI merge. spd=1 is the historical
+    one-segment-per-device layout.
     sort_keys: None ranks by score; (key_name, raw_name) ranks by the
     staged oriented key column and carries the raw field values for the
     response's per-hit ``sort`` array (FieldSortBuilder semantics).
-    with_views: additionally return the per-device matched masks and
+    with_views: additionally return the per-slot matched masks and
     scores (sharded, no collective) — the aggregation reduce consumes
     them as SegmentViews exactly like the host path's shard partials.
     features: which traced scalars participate ("min_score",
     "search_after"); their VALUES arrive via the `scalars` argument so
     pagination does not recompile.
     rescore_static: (window_size, score_mode) — QueryRescorer's window
-    pass over the per-device (== per-segment, matching the host's
+    pass over the per-slot (== per-segment, matching the host's
     per-segment window) top candidates; weights are traced scalars.
     """
     plan = holder.plan
     pf_plan = holder.pf_plan
     rs_plan = holder.rs_plan
 
-    def per_device(seg, plan_arrays, pf_arrays, rs_arrays, scalars):
-        seg = {name: a[0] for name, a in seg.items()}
-        ctx = EmitCtx(seg, [a[0] for a in plan_arrays])
+    def per_slot(seg, plan_arrays, pf_arrays, rs_arrays, scalars):
+        """One segment's query phase: emit -> mask stages -> local top-k.
+        Returns (loc_keys, loc_docs, loc_scores, loc_raw|None,
+        local_count, agg_matched, scores)."""
+        ctx = EmitCtx(seg, plan_arrays)
         scores, matched = plan.emit(ctx)
         matched = matched & seg["live1"]
         # stage order mirrors the host path (search/service.py query()):
@@ -213,14 +236,13 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
             matched = matched & seg[slice_col]
         agg_matched = matched
         if pf_plan is not None:
-            pf_ctx = EmitCtx(seg, [a[0] for a in pf_arrays])
+            pf_ctx = EmitCtx(seg, pf_arrays)
             _, pf_matched = pf_plan.emit(pf_ctx)
             matched = matched & pf_matched
-        # per-device matched count is also returned sharded: a device is
+        # per-slot matched count is also returned sharded: a slot is
         # one SEGMENT, but terminate_after caps per SHARD — the caller
         # groups segment counts by shard and applies the cap host-side
         local_count = jnp.sum(matched.astype(jnp.int32))
-        total = jax.lax.psum(local_count, "shards")
         if sort_keys is None:
             rank_key = scores
         else:
@@ -244,7 +266,7 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
             window, score_mode = rescore_static
             ksel = min(max(k, window), nd)
             sel_keys, sel_docs = jax.lax.top_k(masked, ksel)
-            rs_ctx = EmitCtx(seg, [a[0] for a in rs_arrays])
+            rs_ctx = EmitCtx(seg, rs_arrays)
             rs_scores, _ = rs_plan.emit(rs_ctx)
             w = min(window, ksel)
             rs_sel = rs_scores[sel_docs[:w]]
@@ -282,29 +304,56 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
             kk = min(k, nd)
             loc_keys, loc_docs = jax.lax.top_k(masked, kk)
             loc_scores = scores[loc_docs]
+        loc_raw = None
+        if sort_keys is not None:
+            loc_raw = seg[sort_keys[1]][loc_docs]
+        return (loc_keys, loc_docs, loc_scores, loc_raw, local_count,
+                agg_matched, scores)
+
+    def per_device(seg, plan_arrays, pf_arrays, rs_arrays, scalars):
+        dev = jax.lax.axis_index("shards")
+        slot_out = []
+        for i in range(spd):
+            seg_i = {name: a[i] for name, a in seg.items()}
+            slot_out.append(per_slot(
+                seg_i, [a[i] for a in plan_arrays],
+                [a[i] for a in pf_arrays], [a[i] for a in rs_arrays],
+                scalars))
+        kk = slot_out[0][0].shape[0]
+        cand_keys = jnp.concatenate([o[0] for o in slot_out])
+        cand_docs = jnp.concatenate([o[1] for o in slot_out])
+        cand_scores = jnp.concatenate([o[2] for o in slot_out])
+        # GLOBAL slot id per candidate: shard_map splits the [n_slots]
+        # leading axis contiguously, so device d owns slots [d*spd, ...)
+        cand_slot = (dev.astype(jnp.int32) * jnp.int32(spd)
+                     + jnp.repeat(jnp.arange(spd, dtype=jnp.int32), kk))
+        counts = jnp.stack([o[4] for o in slot_out])  # [spd]
+        total = jax.lax.psum(jnp.sum(counts), "shards")
         # global merge over ICI: every device holds the same global top-k.
-        # The merged pool holds n_dev*kk candidates, so the global cut is
-        # min(k, pool) — NOT kk: when k exceeds one shard's padded doc
-        # count, hits beyond the largest shard are still real.
-        all_keys = jax.lax.all_gather(loc_keys, "shards").reshape(-1)
-        all_docs = jax.lax.all_gather(loc_docs, "shards").reshape(-1)
-        all_scores = jax.lax.all_gather(loc_scores, "shards").reshape(-1)
+        # The merged pool holds n_slots*kk candidates, so the global cut
+        # is min(k, pool) — NOT kk: when k exceeds one segment's padded
+        # doc count, hits beyond the largest segment are still real.
+        all_keys = jax.lax.all_gather(cand_keys, "shards").reshape(-1)
+        all_docs = jax.lax.all_gather(cand_docs, "shards").reshape(-1)
+        all_scores = jax.lax.all_gather(cand_scores, "shards").reshape(-1)
+        all_slot = jax.lax.all_gather(cand_slot, "shards").reshape(-1)
         top_keys, top_idx = jax.lax.top_k(
             all_keys, min(k, all_keys.shape[0]))
-        top_shard = (top_idx // kk).astype(jnp.int32)
+        top_slot = all_slot[top_idx]
         top_doc = all_docs[top_idx]
         top_score = all_scores[top_idx]
         if sort_keys is None:
             top_raw = top_keys if rs_plan is None else top_score
         else:
-            loc_raw = seg[sort_keys[1]][loc_docs]
-            all_raw = jax.lax.all_gather(loc_raw, "shards").reshape(-1)
+            cand_raw = jnp.concatenate([o[3] for o in slot_out])
+            all_raw = jax.lax.all_gather(cand_raw, "shards").reshape(-1)
             top_raw = all_raw[top_idx]
-        outs = [top_keys[None], top_shard[None], top_doc[None],
+        outs = [top_keys[None], top_slot[None], top_doc[None],
                 total[None], top_score[None], top_raw[None],
-                local_count[None]]
+                counts]
         if with_views:
-            outs.extend([agg_matched[None], scores[None]])
+            outs.extend([jnp.stack([o[5] for o in slot_out]),
+                         jnp.stack([o[6] for o in slot_out])])
         return tuple(outs)
 
     # 6 replicated merge outputs; local_count (index 6) and the optional
@@ -365,6 +414,23 @@ class IndexMeshSearch:
         self._staged_key = None
         self._pairs: List[Tuple[int, object]] = []  # (shard_id, segment)
         self.query_total = 0
+        # queries whose scoring ran on the tile kernel inside the mesh
+        # program (the unified fast plane) vs the XLA scatter formulation
+        self.pallas_query_total = 0
+        settings = getattr(index_service, "settings", None)
+        # packing limit: segments are packed max_slots-deep per device
+        # before the index falls back to the host path (registered as
+        # index.search.mesh.max_slots_per_device)
+        self.max_slots = 4
+        # plane override: auto = kernel when stageable, scatter fallback;
+        # pallas = kernel or host (never the scatter mesh); scatter =
+        # never build kernel plans (index.search.mesh.plane)
+        self.plane_pref = "auto"
+        if settings is not None:
+            self.max_slots = settings.get_int(
+                "index.search.mesh.max_slots_per_device", 4)
+            self.plane_pref = settings.get_str(
+                "index.search.mesh.plane", "auto")
 
     def _mesh_or_default(self) -> Mesh:
         if self._mesh is None:
@@ -387,8 +453,8 @@ class IndexMeshSearch:
         if not pairs:
             return False
         mesh = self._mesh_or_default()
-        if len(pairs) > mesh.devices.size:
-            return False
+        if len(pairs) > mesh.devices.size * max(self.max_slots, 1):
+            return False  # packing bound (not a one-segment-per-device cap)
         # live_doc_count participates: deletes mutate a sealed segment's
         # live mask in place, which must invalidate the staged live1
         key = tuple((sid, id(seg), seg.live_doc_count) for sid, seg in pairs)
@@ -571,34 +637,59 @@ class IndexMeshSearch:
         qb = parse_query(body.get("query"))
         pf_qb = (parse_query(body["post_filter"])
                  if body.get("post_filter") else None)
-        try:
-            plans = []
-            pf_plans = [] if pf_qb is not None else None
-            rs_plans = [] if rs_qb is not None else None
-            ctxs = {}
-            for sid, seg in self._pairs:
-                shard = self.svc.shards[sid]
-                ctx = ShardQueryContext(shard.mapper_service,
-                                        engine=shard.engine)
-                # mesh plans must stack across shards; the pallas tile
-                # node is non-stackable, so pin the scatter nodes here
-                ctx.for_mesh = True
-                ctxs[sid] = ctx
-                plans.append(qb.to_plan(ctx, seg))
-                if pf_qb is not None:
-                    pf_plans.append(pf_qb.to_plan(ctx, seg))
-                if rs_qb is not None:
-                    rs_plans.append(rs_qb.to_plan(ctx, seg))
-            outs = self._executor.execute(
-                plans, k, sort_keys=sort_keys,
-                with_views=bool(agg_specs), pf_plans=pf_plans,
-                rs_plans=rs_plans, scalars=scalars,
-                features=frozenset(features), slice_col=slice_col,
-                rescore_static=rescore_static)
-        except PlanStructureMismatch:
+        # plane ladder: try the tile-kernel plane first (one fast plane
+        # for distributed queries — the reference runs the same BulkScorer
+        # hot loop on every shard), falling back to the scatter mesh when
+        # the kernel can't serve this query shape, then to the host path.
+        kernel_session = None
+        if self.plane_pref in ("auto", "pallas"):
+            kernel_session = self._executor.ensure_kernel()
+        attempts = []
+        if kernel_session is not None:
+            attempts.append(kernel_session)
+        if self.plane_pref != "pallas" or kernel_session is None:
+            attempts.append(None)
+        outs = None
+        used_pallas = False
+        for session in attempts:
+            try:
+                plans = []
+                pf_plans = [] if pf_qb is not None else None
+                rs_plans = [] if rs_qb is not None else None
+                ctxs = {}
+                for sid, seg in self._pairs:
+                    shard = self.svc.shards[sid]
+                    ctx = ShardQueryContext(shard.mapper_service,
+                                            engine=shard.engine)
+                    # mesh plans must stack across shards: scorer nodes
+                    # keep one skeleton on every shard, and kernel nodes
+                    # defer table geometry to harmonization below
+                    ctx.for_mesh = True
+                    ctx.mesh_kernel = session
+                    ctxs[sid] = ctx
+                    plans.append(qb.to_plan(ctx, seg))
+                    # post_filter/rescore plans stay on scatter nodes:
+                    # they gate/adjust, the main scorer is the hot loop
+                    ctx.mesh_kernel = None
+                    if pf_qb is not None:
+                        pf_plans.append(pf_qb.to_plan(ctx, seg))
+                    if rs_qb is not None:
+                        rs_plans.append(rs_qb.to_plan(ctx, seg))
+                used_pallas = False
+                if session is not None:
+                    used_pallas = self._executor.harmonize_kernel_nodes(
+                        plans) > 0
+                outs = self._executor.execute(
+                    plans, k, sort_keys=sort_keys,
+                    with_views=bool(agg_specs), pf_plans=pf_plans,
+                    rs_plans=rs_plans, scalars=scalars,
+                    features=frozenset(features), slice_col=slice_col,
+                    rescore_static=rescore_static)
+                break
+            except (PlanStructureMismatch, NotImplementedError):
+                continue  # next plane (or host fallback)
+        if outs is None:
             return None
-        except NotImplementedError:
-            return None  # a builder without a plan form
         keys, slots, docs, total, scores, raws, seg_counts = outs[:7]
         keys = np.asarray(keys)
         scores = np.asarray(scores)
@@ -618,6 +709,8 @@ class IndexMeshSearch:
             total = sum(min(c, ta) for c in by_shard.values())
             terminated_early = any(c >= ta for c in by_shard.values())
         self.query_total += 1
+        if used_pallas:
+            self.pallas_query_total += 1
         # per-shard search stats stay attributed even though the mesh
         # executes all shards as one program (SearchStats semantics)
         for sid in self.svc.shards:
@@ -671,16 +764,22 @@ class IndexMeshSearch:
             aggregations = run_aggregations(agg_specs, views)
         return {"total": total, "refs": refs, "max_score": max_score,
                 "aggregations": aggregations,
-                "terminated_early": terminated_early}
+                "terminated_early": terminated_early,
+                # which scoring engine the mesh program ran — surfaced as
+                # the response's _plane marker and the planes counters
+                "plane": "mesh_pallas" if used_pallas else "mesh"}
 
 
 class MeshPlanExecutor:
-    """Stage N shard segments onto an N-device mesh once; run any query
+    """Stage N sealed segments onto a device mesh once; run any query
     plan as one compiled multi-device program.
 
-    ``segments``: one sealed segment per shard (the staging unit — a shard
-    with several NRT segments is force-merged or served by the host path
-    until its next seal)."""
+    Segments PACK: with more segments than devices, each device owns
+    ``slots_per_dev = ceil(N / n_dev)`` slots in the stacked leading axis
+    and the per-device program unrolls its slots (per-slot live masks keep
+    padding slots dead) — a realistically-refreshed index (many NRT
+    segments per shard) stays on the mesh plane instead of silently
+    falling back to the host path."""
 
     def __init__(self, segments: List, mesh: Optional[Mesh] = None):
         from elasticsearch_tpu.parallel.distributed import stack_shard_arrays
@@ -689,7 +788,9 @@ class MeshPlanExecutor:
         self.mesh = mesh or shard_mesh()
         self.n_dev = self.mesh.devices.size
         self.segments = segments
-        stacked = stack_shard_arrays(segments, self.n_dev)
+        self.slots_per_dev = max(1, -(-len(segments) // self.n_dev))
+        self.n_slots = self.slots_per_dev * self.n_dev
+        stacked = stack_shard_arrays(segments, self.n_slots)
         self.nd_pad = stacked.pop("nd_pad")
         self.nd1 = self.nd_pad + 1
         sharding = NamedSharding(self.mesh, PS("shards"))
@@ -702,6 +803,147 @@ class MeshPlanExecutor:
         # rank by GLOBAL ordinals built over the staged segment set and
         # the caller maps ordinals back to terms for the response
         self.sort_meta: Dict[str, dict] = {}
+        # lazily-staged tile-kernel plane (ensure_kernel): False =
+        # unavailable, dict = {geom, meta: {id(seg): (bmin, bmax)}, mode}
+        self._kernel = None
+
+    # ------------------------------------------------------------------
+    # Tile-kernel plane staging (the unified fast plane)
+    # ------------------------------------------------------------------
+
+    def ensure_kernel(self) -> Optional[dict]:
+        """Stage the pallas tile-scoring plane over the stacked segment
+        set: one SHARED tile geometry covering the stacked doc space, the
+        per-segment posting windows (docs + per-posting BM25 norm factors,
+        sentinel-padded so every CB-aligned DMA window is in bounds)
+        packed per slot, and the per-slot transposed live masks. Returns
+        the kernel session (plan builders consult it via
+        ``ctx.mesh_kernel``) or None when the kernel can't run (pallas
+        off / non-TPU backend without interpret mode)."""
+        from elasticsearch_tpu.ops.aggs import _pallas_mode
+
+        mode = _pallas_mode()
+        if not mode:
+            return None
+        if self._kernel is False:
+            return None
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        if self._kernel is None:
+            try:
+                geom = psc.tile_geometry(max(self.nd_pad, psc.LANE))
+                n_rows = max(s.block_docs.shape[0] for s in self.segments) \
+                    + psc.CB_MAX
+                docs = np.full((self.n_slots, n_rows, psc.LANE),
+                               self.nd_pad, np.int32)
+                frac = np.zeros((self.n_slots, n_rows, psc.LANE), np.float32)
+                live_t = np.zeros(
+                    (self.n_slots, geom.n_tiles * psc.LANE, geom.tile_sub),
+                    np.float32)
+                meta = {}
+                for i, seg in enumerate(self.segments):
+                    f = seg._block_frac()
+                    bmin, bmax = psc.block_min_max(
+                        seg.block_docs, seg.block_tfs, seg.nd_pad)
+                    dp, fp = psc.pad_segment_blocks(seg.block_docs, f,
+                                                    seg.nd_pad)
+                    docs[i, : dp.shape[0]] = dp
+                    frac[i, : fp.shape[0]] = fp
+                    live = np.zeros(geom.nd_pad, np.float32)
+                    live[: seg.nd_pad] = seg.live.astype(np.float32)
+                    live_t[i] = psc.build_live_t(live, geom)
+                    meta[id(seg)] = (bmin, bmax)
+                self._seg_staged["k_docs"] = jax.device_put(
+                    docs, self._sharding)
+                self._seg_staged["k_frac"] = jax.device_put(
+                    frac, self._sharding)
+                self._seg_staged["k_live_t"] = jax.device_put(
+                    live_t, self._sharding)
+                self._kernel = {"geom": geom, "meta": meta}
+            except Exception:  # noqa: BLE001 — plane stays scatter
+                self._kernel = False
+                return None
+        return dict(self._kernel, mode=mode)
+
+    def ensure_kernel_live(self, sub: int) -> str:
+        """Per-sub live-mask layout for a shrunk tile geometry (dense-term
+        queries — the geometry ladder); mirrors Segment.kernel_live_t_for
+        but over the stacked slot axis."""
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        key = f"k_live_t_{sub}"
+        if key not in self._seg_staged:
+            geom = psc.tile_geometry(self._kernel["geom"].nd_pad, sub)
+            live_t = np.zeros(
+                (self.n_slots, geom.n_tiles * psc.LANE, geom.tile_sub),
+                np.float32)
+            for i, seg in enumerate(self.segments):
+                live = np.zeros(geom.nd_pad, np.float32)
+                live[: seg.nd_pad] = seg.live.astype(np.float32)
+                live_t[i] = psc.build_live_t(live, geom)
+            self._seg_staged[key] = jax.device_put(live_t, self._sharding)
+        return key
+
+    def harmonize_kernel_nodes(self, plans: List[PlanNode]) -> int:
+        """Finalize every deferred mesh kernel node so table shapes agree
+        across the whole segment set: one (tile_sub, t_pad, cb) for each
+        aligned node group, chosen by the geometry ladder collectively
+        (a dense term on ANY shard shrinks everyone's tile). Returns the
+        number of kernel node groups finalized; raises
+        PlanStructureMismatch when no shared geometry exists (caller
+        retries with scatter nodes)."""
+        from elasticsearch_tpu.index.segment import next_pow2
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+        from elasticsearch_tpu.search.plan import PallasScoreTermsNode
+
+        groups: List[List[PlanNode]] = []
+
+        def walk(nodes):
+            if all(isinstance(n, PallasScoreTermsNode) for n in nodes):
+                groups.append(list(nodes))
+            kids = [n.children() for n in nodes]
+            if len({len(ks) for ks in kids}) != 1:
+                raise PlanStructureMismatch("tree arity diverges")
+            for child_set in zip(*kids):
+                walk(list(child_set))
+
+        walk(plans)
+        if not groups:
+            return 0
+        session = self._kernel
+        if not isinstance(session, dict):
+            raise PlanStructureMismatch("kernel plane not staged")
+        geom = session["geom"]
+        tps = psc.tiles_per_step_default()
+        for nodes in groups:
+            if any(n._mesh_lanes is None for n in nodes):
+                raise PlanStructureMismatch(
+                    "kernel/scatter node mix across shards")
+            t_pad = max(next_pow2(max(len(n._mesh_lanes), 1))
+                        for n in nodes)
+            sub = geom.tile_sub
+            while True:
+                g = geom if sub == geom.tile_sub else psc.tile_geometry(
+                    geom.nd_pad, sub)
+                try:
+                    tables = [psc.build_tile_tables(
+                        n._mesh_lanes, n._mesh_bmin, n._mesh_bmax, g,
+                        t_pad=t_pad) for n in nodes]
+                    break
+                except ValueError:
+                    # covering window exceeded the kernel bound somewhere
+                    # (or malformed ranges at the ladder floor)
+                    if sub <= 32 or g.tile_sub < sub:
+                        raise PlanStructureMismatch(
+                            "no shared kernel geometry for this query")
+                    sub //= 2
+            cb = max(t[3] for t in tables)
+            live_key = ("k_live_t" if g.tile_sub == geom.tile_sub
+                        else self.ensure_kernel_live(g.tile_sub))
+            for n, (rl, rh, w, _cb) in zip(nodes, tables):
+                n.finalize_mesh(rl, rh, w, cb=cb, sub=g.tile_sub,
+                                live_key=live_key, tiles_per_step=tps)
+        return len(groups)
 
     def ensure_sort_column(self, field: str, order: str, missing) -> Optional[
             Tuple[str, str]]:
@@ -733,8 +975,8 @@ class MeshPlanExecutor:
             return self._ensure_keyword_sort_column(
                 name, ords, order, missing)
         big = np.float32(3.0e38)
-        keys = np.zeros((self.n_dev, self.nd1), np.float32)
-        raws = np.zeros((self.n_dev, self.nd1), np.float32)
+        keys = np.zeros((self.n_slots, self.nd1), np.float32)
+        raws = np.zeros((self.n_slots, self.nd1), np.float32)
         for i, seg in enumerate(self.segments):
             if field == "_doc":
                 if seg.nd_pad > (1 << 24):
@@ -786,8 +1028,8 @@ class MeshPlanExecutor:
             fill = np.float64(big if order == "desc" else -big)
         else:
             fill = np.float64(-big if order == "desc" else big)
-        keys = np.zeros((self.n_dev, self.nd1), np.float32)
-        raws = np.zeros((self.n_dev, self.nd1), np.float32)
+        keys = np.zeros((self.n_slots, self.nd1), np.float32)
+        raws = np.zeros((self.n_slots, self.nd1), np.float32)
         for i, (seg, ocol) in enumerate(zip(self.segments, ords)):
             if ocol is None:
                 raw = np.full(seg.nd_pad, fill)
@@ -821,7 +1063,7 @@ class MeshPlanExecutor:
         name = f"mslice.{smax}.{sid}.{num_shards}"
         if name in self._seg_staged:
             return name
-        out = np.zeros((self.n_dev, self.nd1), bool)
+        out = np.zeros((self.n_slots, self.nd1), bool)
         for i, seg in enumerate(self.segments):
             resolved = resolve_slice(slice_spec, shard_of_device[i],
                                      num_shards)
@@ -864,27 +1106,29 @@ class MeshPlanExecutor:
         if len(plans) != len(self.segments):
             raise ValueError("one plan per staged shard required")
         local_pads = [s.nd_pad for s in self.segments]
-        stacked = stack_plans(plans, local_pads, self.nd1, self.n_dev)
+        stacked = stack_plans(plans, local_pads, self.nd1, self.n_slots)
         key_parts = [plans[0].key(), _shapes_sig(stacked)]
         stacked_pf: List[np.ndarray] = []
         stacked_rs: List[np.ndarray] = []
         pf_tpl = rs_tpl = None
         if pf_plans:
             stacked_pf = stack_plans(pf_plans, local_pads, self.nd1,
-                                     self.n_dev)
+                                     self.n_slots)
             pf_tpl = _strip_plan(pf_plans[0])
             key_parts += ["pf:" + pf_plans[0].key(), _shapes_sig(stacked_pf)]
         if rs_plans:
             stacked_rs = stack_plans(rs_plans, local_pads, self.nd1,
-                                     self.n_dev)
+                                     self.n_slots)
             rs_tpl = _strip_plan(rs_plans[0])
             key_parts += ["rs:" + rs_plans[0].key(), _shapes_sig(stacked_rs)]
         key = ("|".join(key_parts)
-               + f"|k{k}|n{self.n_dev}|s{sort_keys}|v{with_views}"
+               + f"|k{k}|n{self.n_dev}|p{self.slots_per_dev}"
+               + f"|s{sort_keys}|v{with_views}"
                + f"|f{sorted(features)}|sl{slice_col}|r{rescore_static}")
         run = _mesh_query_program(
             self.mesh,
             _TemplateHolder(_strip_plan(plans[0]), key, pf_tpl, rs_tpl), k,
+            spd=self.slots_per_dev,
             sort_keys=sort_keys, with_views=with_views, features=features,
             slice_col=slice_col, rescore_static=rescore_static)
         staged_plan = [jax.device_put(a, self._sharding) for a in stacked]

@@ -185,8 +185,10 @@ def register_all(c) -> None:
     r("GET", "/_cluster/stats", lambda n, q: (200, n.cluster_stats()))
     r("GET", "/_cluster/settings", _get_cluster_settings)
     r("PUT", "/_cluster/settings", lambda n, q: (200, n.put_cluster_settings(q.json_body({}))))
-    r("POST", "/_cluster/reroute", lambda n, q: (200, {"acknowledged": True,
-                                                       "state": n.cluster_service.state.to_dict()}))
+    r("POST", "/_cluster/reroute", lambda n, q: (200, n.reroute(
+        q.json_body({}) or {},
+        dry_run=q.bool_param("dry_run", False),
+        explain=q.bool_param("explain", False))))
     r("GET", "/_cluster/allocation/explain", _allocation_explain)
     r("GET", "/_nodes", lambda n, q: (200, n.node_info()))
     r("GET", "/_nodes/stats", lambda n, q: (200, n.node_stats()))
@@ -432,7 +434,7 @@ def _index_doc(node, req, force_create: bool = False):
                        routing=routing, refresh=req.param("refresh"),
                        pipeline=req.param("pipeline"),
                        wait_for_active_shards=req.param("wait_for_active_shards"),
-                       **kw)
+                       parent=parent, **kw)
     _record_parent(node, req, r.get("_id"), parent)
     _record_doc_type(node, req)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))
@@ -458,7 +460,8 @@ def _index_doc_auto_id(node, req):
     r = node.index_doc(req.param("index"), None, body,
                        routing=routing, refresh=req.param("refresh"),
                        pipeline=req.param("pipeline"),
-                       wait_for_active_shards=req.param("wait_for_active_shards"))
+                       wait_for_active_shards=req.param("wait_for_active_shards"),
+                       parent=parent)
     _record_parent(node, req, r.get("_id"), parent)
     _record_doc_type(node, req)
     _echo_type(req, _forced_refresh(req, _write_shards_header(node, req, r)))

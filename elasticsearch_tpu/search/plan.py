@@ -216,10 +216,18 @@ class PallasScoreTermsNode(PlanNode):
     (search/query/QueryPhase.java:272). Chosen by score_terms_node when
     every lane is default-constant BM25 and the segment staged kernel
     arrays; the query carries per-(tile, lane) covering-block windows
-    computed host-side from per-block doc ranges."""
+    computed host-side from per-block doc ranges.
+
+    Mesh form: ``mesh_deferred`` builds the node with the per-shard lane
+    set but NO tables; the mesh executor's ``harmonize_kernel_nodes``
+    calls ``finalize_mesh`` with the geometry shared by every shard so the
+    stacked tables have identical shapes and ONE trace serves all devices
+    (the reference runs the same BulkScorer loop on every shard — this is
+    that property on a TPU mesh)."""
 
     def __init__(self, row_lo, row_hi, kweights, min_match, *, cb: int,
-                 sub: int, interpret: bool, live_key: str = "k_live_t"):
+                 sub: int, interpret: bool, live_key: str = "k_live_t",
+                 tiles_per_step: int = 1):
         self.row_lo = row_lo  # [n_tiles, t_pad] i32
         self.row_hi = row_hi
         self.kweights = kweights  # [1, t_pad] f32
@@ -233,23 +241,67 @@ class PallasScoreTermsNode(PlanNode):
         # live-mask layout key in the segment device dict: the geometry
         # ladder stages per-sub variants for dense-term queries
         self.live_key = live_key
+        self.tiles_per_step = tiles_per_step
+        self._mesh_lanes = None
+        self._mesh_bmin = None
+        self._mesh_bmax = None
+
+    @classmethod
+    def mesh_deferred(cls, lanes, bmin, bmax, min_match, *,
+                      interpret: bool) -> "PallasScoreTermsNode":
+        """Node for the MESH plane with table building deferred: lanes are
+        shard-local, but table geometry (tile count, t_pad, cb, sub) must
+        be uniform across the whole stacked segment set and is only known
+        once every shard's plan exists. ``bmin``/``bmax`` are the shard
+        segment's per-block doc ranges (tile-size independent)."""
+        self = cls.__new__(cls)
+        self.row_lo = self.row_hi = self.kweights = None
+        self.min_match = np.float32(min_match)
+        self.cb = self.sub = self.t_pad = self.n_tiles = None
+        self.interpret = interpret
+        self.with_counts = min_match > 1
+        self.live_key = "k_live_t"
+        self.tiles_per_step = 1
+        self._mesh_lanes = list(lanes)
+        self._mesh_bmin = bmin
+        self._mesh_bmax = bmax
+        return self
+
+    def finalize_mesh(self, row_lo, row_hi, kweights, *, cb: int, sub: int,
+                      live_key: str, tiles_per_step: int = 1) -> None:
+        self.row_lo = row_lo
+        self.row_hi = row_hi
+        self.kweights = kweights
+        self.cb = cb
+        self.sub = sub
+        self.t_pad = int(row_lo.shape[1])
+        self.n_tiles = int(row_lo.shape[0])
+        self.live_key = live_key
+        self.tiles_per_step = tiles_per_step
 
     def key(self):
         return (f"pterms[{self.n_tiles},{self.t_pad},{self.cb},{self.sub},"
-                f"{self.with_counts},{self.interpret},{self.live_key}]")
+                f"{self.with_counts},{self.interpret},{self.live_key},"
+                f"{self.tiles_per_step}]")
 
     def trace_statics(self):
         return (self.cb, self.sub, self.t_pad, self.with_counts,
-                self.interpret)
+                self.interpret, self.live_key, self.tiles_per_step)
 
     def arrays(self):
+        if self.row_lo is None:
+            # a mesh_deferred node escaped harmonization — refuse to trace
+            # a half-built plan (callers treat this as "no plan form")
+            raise NotImplementedError(
+                "mesh pallas node used before finalize_mesh")
         return [self.row_lo, self.row_hi, self.kweights, self.min_match]
 
     def pad_kinds(self):
-        # "x": not stackable onto a mesh template (2-D per-query tables);
-        # the mesh executor rejects plans containing it and the host
-        # per-shard path runs instead
-        return ["x", "x", "x", "s"]
+        # "k": kernel tables — stackable onto a mesh template only when
+        # every shard's tables share one shape (harmonize_kernel_nodes
+        # guarantees it for mesh-built plans; host-built per-segment
+        # geometries differ and fail the stack, keeping the host path)
+        return ["k", "k", "k", "s"]
 
     def emit(self, ctx):
         from elasticsearch_tpu.ops import pallas_scoring as psc
@@ -260,7 +312,8 @@ class PallasScoreTermsNode(PlanNode):
             row_lo, row_hi, kweights,
             t_pad=self.t_pad, cb=self.cb, sub=self.sub,
             dense=True, with_counts=self.with_counts,
-            interpret=self.interpret)
+            interpret=self.interpret,
+            tiles_per_step=self.tiles_per_step)
         nd = ctx.nd1 - 1
         scores = psc.dense_to_flat(outs[0], self.sub)[:nd]
         scores = jnp.concatenate([scores, jnp.zeros(1, jnp.float32)])

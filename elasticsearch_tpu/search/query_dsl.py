@@ -181,6 +181,14 @@ def score_terms_node(segment, weighted_terms, min_match=1, ctx=None) -> P.PlanNo
     node = None
     if not getattr(ctx, "for_mesh", False):
         node = _pallas_score_terms_node(segment, arrs, min_match)
+    elif getattr(ctx, "mesh_kernel", None) is not None:
+        # mesh plane with the tile kernel staged: build the stackable
+        # (deferred-geometry) kernel node; the executor harmonizes table
+        # shapes across shards before stacking. Ineligible lane sets fall
+        # through to the scatter node — a cross-shard pallas/scatter mix
+        # then fails structure checks and the caller retries all-scatter.
+        node = _mesh_pallas_score_terms_node(segment, arrs, min_match,
+                                             ctx.mesh_kernel)
     if node is not None:
         return node
     return P.ScoreTermsNode(
@@ -239,7 +247,30 @@ def _pallas_score_terms_node(segment, arrs, min_match):
     return P.PallasScoreTermsNode(
         row_lo, row_hi, kweights, min_match,
         cb=cb, sub=g.tile_sub, interpret=(mode == "interpret"),
-        live_key=live_key)
+        live_key=live_key, tiles_per_step=psc.tiles_per_step_default())
+
+
+def _mesh_pallas_score_terms_node(segment, arrs, min_match, session):
+    """Stackable tile-kernel node for the MESH data plane. ``session`` is
+    the executor's staged-kernel context ({geom, meta: {id(segment):
+    (bmin, bmax)}, mode}). Same lane eligibility rules as the host path
+    (_pallas_score_terms_node), but an EMPTY lane set stays on the kernel:
+    a term missing from one shard's dictionary must not flip that shard's
+    node type (the skeleton must match across the mesh)."""
+    from elasticsearch_tpu.ops import pallas_scoring as psc
+
+    lanes = arrs["lanes_meta"]
+    if not all(ok for _, _, _, ok in lanes):
+        return None
+    if not all(w > 0 for _, _, w, _ in lanes):
+        return None  # see _pallas_score_terms_node: score>0 match rule
+    meta = session["meta"].get(id(segment))
+    if meta is None:
+        return None  # segment not part of the staged mesh set
+    qlanes = [psc.QueryLane(s, c, w) for s, c, w, _ in lanes]
+    return P.PallasScoreTermsNode.mesh_deferred(
+        qlanes, meta[0], meta[1], min_match,
+        interpret=(session["mode"] == "interpret"))
 
 
 def _numeric_csr(segment, field):

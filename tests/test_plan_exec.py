@@ -37,11 +37,11 @@ MAPPING = {
 
 @pytest.fixture(autouse=True)
 def _scatter_plans(monkeypatch):
-    """This module tests the MESH-stacked plan path; pallas tile-kernel
-    nodes are (for now) explicitly non-stackable and served by the host
-    per-shard fallback, so pin plan building to the scatter nodes.
-    (_pallas_mode reads ES_TPU_PALLAS at call time — import order is
-    irrelevant.)"""
+    """Most of this module pins the SCATTER mesh formulation so its
+    parity tests stay deterministic and fast; TestMeshPallasPlane below
+    overrides to "interpret" to exercise the tile kernel INSIDE the mesh
+    program. (_pallas_mode reads ES_TPU_PALLAS at call time — import
+    order is irrelevant.)"""
     monkeypatch.setenv("ES_TPU_PALLAS", "off")
 
 
@@ -590,6 +590,202 @@ class TestMeshFeatureParity:
         got, want = self._both(pair, body)
         assert got["hits"]["total"] == want["hits"]["total"]
         assert got["terminated_early"] == want["terminated_early"] is True
+
+
+class TestMeshPallasPlane:
+    """The tentpole contract: the Pallas tile kernel IS the mesh
+    program's scorer (one fast plane for distributed queries). Asserts
+    mesh-vs-host parity for scores / top-k order / aggregations with the
+    kernel serving (``_plane == "mesh_pallas"``, no silent fallback),
+    including the PACKED case (segments > devices via slot unroll)."""
+
+    MAPPING = {"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "integer"},
+        "price": {"type": "float"},
+    }}
+
+    @pytest.fixture(autouse=True)
+    def _kernel_plans(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+
+    def _mk(self, name, mesh, shards=3, batches=((0, 60),)):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        idx = IndexService(name, Settings({
+            "index.number_of_shards": shards,
+            "index.search.mesh": mesh,
+            "index.refresh_interval": -1,
+        }), mapping=self.MAPPING)
+        rng = np.random.RandomState(17)
+        vocab = [f"w{i}" for i in range(10)]
+        tags = ["amber", "blue", "coral"]
+        for lo, hi in batches:
+            for d in range(lo, hi):
+                doc = {"body": " ".join(vocab[rng.randint(len(vocab))]
+                                        for _ in range(6)),
+                       "tag": tags[d % 3], "price": d * 0.5}
+                if d % 7 != 0:
+                    doc["n"] = int(rng.randint(0, 40))
+                idx.index_doc(str(d), doc)
+            idx.refresh()  # each batch seals one segment per shard
+        return idx
+
+    @pytest.fixture()
+    def pair(self):
+        mesh_idx = self._mk("meshpal", True)
+        host_idx = self._mk("hostpal", False)
+        yield mesh_idx, host_idx
+        mesh_idx.close()
+        host_idx.close()
+
+    @pytest.fixture()
+    def packed_pair(self):
+        # 5 shards x 2 sealed segments = 10 (shard, segment) pairs on the
+        # 8-device mesh: the packed regime (slots_per_dev == 2)
+        mesh_idx = self._mk("meshpalpk", True, shards=5,
+                            batches=((0, 50), (100, 140)))
+        host_idx = self._mk("hostpalpk", False, shards=5,
+                            batches=((0, 50), (100, 140)))
+        yield mesh_idx, host_idx
+        mesh_idx.close()
+        host_idx.close()
+
+    @staticmethod
+    def _check(mesh_idx, host_idx, body, plane="mesh_pallas"):
+        before = (mesh_idx._mesh_search.pallas_query_total
+                  if mesh_idx._mesh_search is not None else 0)
+        got = mesh_idx.search(dict(body))
+        want = host_idx.search(dict(body))
+        assert got["_plane"] == plane, (got["_plane"], body)
+        if plane == "mesh_pallas":
+            assert (mesh_idx._mesh_search.pallas_query_total
+                    == before + 1), "kernel plane did not serve the query"
+        assert got["hits"]["total"] == want["hits"]["total"], body
+        # same score sequence; doc identity may permute within EXACT
+        # ties (same contract as TestMeshPlanParity)
+        gs = [h.get("_score") for h in got["hits"]["hits"]]
+        ws = [h.get("_score") for h in want["hits"]["hits"]]
+        assert len(gs) == len(ws), body
+        for a, b in zip(gs, ws):
+            if a is None or b is None:
+                assert a == b, body
+            else:
+                assert abs(a - b) < 1e-5, (body, gs, ws)
+        gids = [h["_id"] for h in got["hits"]["hits"]]
+        wids = [h["_id"] for h in want["hits"]["hits"]]
+        assert ({i for i, s in zip(gids, gs) if gs.count(s) == 1}
+                == {i for i, s in zip(wids, ws) if ws.count(s) == 1}), body
+        if "aggs" in body:
+            assert got["aggregations"] == want["aggregations"], body
+        return got, want
+
+    def test_match_parity_on_kernel_plane(self, pair):
+        self._check(*pair, {"query": {"match": {"body": "w1 w4"}},
+                            "size": 10})
+
+    def test_bool_with_filter_and_aggs(self, pair):
+        self._check(*pair, {
+            "query": {"bool": {"must": [{"match": {"body": "w2 w5"}}],
+                               "filter": [{"range": {"n": {"gte": 5}}}]}},
+            "size": 10,
+            "aggs": {"tags": {"terms": {"field": "tag"},
+                              "aggs": {"avg_n": {"avg": {"field": "n"}}}},
+                     "price_stats": {"stats": {"field": "price"}}},
+        })
+
+    def test_rare_term_stays_on_kernel_plane(self, pair):
+        mesh_idx, host_idx = pair
+        # present on exactly one shard's dictionary: absent shards keep
+        # the kernel node with an empty lane set (same skeleton)
+        for idx in pair:
+            idx.index_doc("rare", {"body": "zzz_rare_token w1"})
+            idx.refresh()
+        got, _ = self._check(mesh_idx, host_idx,
+                             {"query": {"match": {"body": "zzz_rare_token"}},
+                              "size": 5})
+        assert got["hits"]["total"] == 1
+        assert got["hits"]["hits"][0]["_id"] == "rare"
+
+    def test_min_should_match_counts(self, pair):
+        self._check(*pair, {
+            "query": {"bool": {
+                "should": [{"term": {"body": "w0"}},
+                           {"term": {"body": "w3"}},
+                           {"term": {"body": "w9"}}],
+                "minimum_should_match": 2}},
+            "size": 10})
+
+    def test_match_all_uses_scatter_mesh(self, pair):
+        # no terms node -> nothing for the kernel to score; the query
+        # still runs on the mesh (scatter formulation)
+        self._check(*pair, {"query": {"match_all": {}},
+                            "sort": [{"price": "desc"}], "size": 8},
+                    plane="mesh")
+
+    def test_packed_segments_exceed_devices(self, packed_pair):
+        mesh_idx, host_idx = packed_pair
+        got, _ = self._check(mesh_idx, host_idx,
+                             {"query": {"match": {"body": "w1 w4"}},
+                              "size": 10,
+                              "aggs": {"tags": {"terms": {"field": "tag"}}}})
+        ms = mesh_idx._mesh_search
+        ex = ms._executor
+        assert len(ms._pairs) > ex.n_dev, "corpus must exceed device count"
+        assert ex.slots_per_dev >= 2
+        assert ex.n_slots == ex.slots_per_dev * ex.n_dev
+
+    def test_packed_post_filter_terminate_after(self, packed_pair):
+        mesh_idx, host_idx = packed_pair
+        self._check(mesh_idx, host_idx,
+                    {"query": {"match": {"body": "w3"}},
+                     "post_filter": {"term": {"tag": "blue"}}, "size": 10})
+        # terminate_after caps per SHARD while slots are SEGMENTS
+        body = {"query": {"match": {"body": "w1"}},
+                "terminate_after": 3, "size": 5}
+        got = mesh_idx.search(dict(body))
+        want = host_idx.search(dict(body))
+        assert got["_plane"] == "mesh_pallas"
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert got["terminated_early"] == want["terminated_early"]
+
+    def test_plane_override_scatter(self):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        idx = IndexService("meshpalovr", Settings({
+            "index.number_of_shards": 3,
+            "index.search.mesh": True,
+            "index.search.mesh.plane": "scatter",
+            "index.refresh_interval": -1,
+        }), mapping=self.MAPPING)
+        for d in range(30):
+            idx.index_doc(str(d), {"body": f"w{d % 5} w1"})
+        idx.refresh()
+        r = idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
+        assert r["_plane"] == "mesh"  # override keeps the kernel out
+        assert idx._mesh_search.pallas_query_total == 0
+        idx.close()
+
+    def test_packing_limit_falls_back_to_host(self):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        idx = IndexService("meshpallim", Settings({
+            "index.number_of_shards": 5,
+            "index.search.mesh": True,
+            "index.search.mesh.max_slots_per_device": 1,
+            "index.refresh_interval": -1,
+        }), mapping=self.MAPPING)
+        for batch in range(2):
+            for d in range(batch * 40, batch * 40 + 40):
+                idx.index_doc(str(d), {"body": f"w{d % 5} w1"})
+            idx.refresh()  # 10 segments > 8 devices * 1 slot
+        r = idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
+        assert r["_plane"] == "host"
+        idx.close()
 
 
 class TestExecutionPlaneObservability:

@@ -4,8 +4,18 @@ executed verbatim against this engine's HTTP surface (SURVEY section 4.6.4
 
 Runner: elasticsearch_tpu/testing/yaml_runner.py
 (ESClientYamlSuiteTestCase.java analog). The allowlist below is every
-reference file this engine currently passes end-to-end; it only grows —
-a file dropping out of the list is a compatibility regression.
+reference file this engine passes end-to-end; it only grows — a file
+dropping out of the list is a compatibility regression.
+
+Beyond the per-file allowlist, ``test_full_suite_floor`` (slow-marked)
+sweeps the ENTIRE corpus and pins the verified passing COUNT at the
+round-5 reviewer's independent sweep result (~117 of 254: 101 passing
+under sweep + 16 allowlisted files that only timed out under sweep
+contention). The round-5 conformance work (commit 6566772) claimed 125
+files but never grew this pin, leaving the extra files without a
+regression guard — the floor closes that gap and PRINTS the passing set
+so it can be promoted into the explicit allowlist when the reference
+checkout is available.
 
 Requires the reference checkout at /root/reference (skipped when absent,
 e.g. in a standalone distribution of this repo).
@@ -16,6 +26,10 @@ import os
 import pytest
 
 BASE = "/root/reference/rest-api-spec/src/main/resources/rest-api-spec"
+
+# the floor the full-suite sweep must not regress below (round-5 VERDICT:
+# 101 sweep-passing + 16 allowlisted-but-contended = 117 verified)
+FULL_SUITE_FLOOR = 117
 
 pytestmark = pytest.mark.skipif(
     not os.path.isdir(BASE), reason="reference rest-api-spec not available")
@@ -144,3 +158,41 @@ def test_yaml_file(conformance, rel):
     executed = conformance.run_file(os.path.join(BASE, "test", rel))
     assert executed, f"no tests executed in {rel}"
     conformance.wipe()
+
+
+@pytest.mark.slow
+def test_full_suite_floor(conformance):
+    """Sweep every reference YAML file; the passing count is pinned at
+    FULL_SUITE_FLOOR and no allowlisted file may fail. Prints the full
+    passing set (run with -s) so newly-passing files can be promoted
+    into PASSING with a name-level guard."""
+    test_root = os.path.join(BASE, "test")
+    all_files = []
+    for dirpath, _dirs, files in os.walk(test_root):
+        for fn in sorted(files):
+            if fn.endswith(".yml") or fn.endswith(".yaml"):
+                all_files.append(os.path.relpath(
+                    os.path.join(dirpath, fn), test_root))
+    passed, failed = [], []
+    for rel in sorted(all_files):
+        try:
+            if conformance.run_file(os.path.join(test_root, rel)):
+                passed.append(rel)
+            else:
+                failed.append(rel)
+        except Exception:  # noqa: BLE001 — a failing file, not a harness bug
+            failed.append(rel)
+        finally:
+            try:
+                conformance.wipe()
+            except Exception:  # noqa: BLE001
+                pass
+    print(f"\nYAML full-suite sweep: {len(passed)}/{len(all_files)} passing")
+    for rel in passed:
+        print(f"  PASS {rel}")
+    allowlist_regressions = sorted(set(PASSING) & set(failed))
+    assert not allowlist_regressions, (
+        f"allowlisted files regressed: {allowlist_regressions}")
+    assert len(passed) >= FULL_SUITE_FLOOR, (
+        f"full-suite passing count {len(passed)} dropped below the "
+        f"pinned floor {FULL_SUITE_FLOOR}")
