@@ -117,11 +117,18 @@ class ClusterNode:
                  data: bool = True, attrs: Optional[Dict[str, str]] = None,
                  awareness_attributes: Optional[List[str]] = None,
                  min_master_nodes: int = 1,
-                 settings: Optional[Settings] = None):
+                 settings: Optional[Settings] = None,
+                 data_path: Optional[str] = None):
         self.name = name
         self.node_id = name  # stable, human-readable ids make tests clear
         self.master_eligible = master_eligible
         self.data = data
+        # durable shard storage (translog + store under
+        # <data_path>/<index>/<shard>): a SIGKILLed node restarted over
+        # the same path replays acked writes from the translog
+        # (crash-recovery contract; None = in-memory shards, the
+        # historical test default)
+        self.data_path = data_path
         # transport resilience knobs (common/settings.py registry): per-
         # attempt request deadlines, the RetryableAction-style backoff
         # policies, and the per-node connection health tracker
@@ -940,9 +947,20 @@ class ClusterNode:
         for (index, sid), copy in wanted.items():
             shard = self.shards.get((index, sid))
             if shard is None:
+                shard_path = (os.path.join(self.data_path, index, str(sid))
+                              if self.data_path else None)
                 shard = IndexShard(index, sid, self._mapper_for(index),
+                                   data_path=shard_path,
                                    primary=copy.primary)
-                shard.start_fresh()
+                if shard_path and (
+                        shard.engine.store.read_commit() is not None
+                        or os.path.exists(os.path.join(
+                            shard_path, "translog", "translog.ckp"))):
+                    # restart over an existing data path: store load +
+                    # translog replay bring back every acked write
+                    shard.recover_from_store()
+                else:
+                    shard.start_fresh()
                 if copy.primary:
                     from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
 
@@ -1631,9 +1649,11 @@ class ClusterClient:
             self.response_collector.add_response_time(
                 node_id, time.monotonic() - t0)
             return resp
-        except NodeNotConnectedException:
-            # timed-out/unreachable copy: penalize its rank so adaptive
-            # replica selection reroutes reads away from it
+        except Exception:
+            # unreachable copy OR a remote query-phase failure: penalize
+            # its rank either way, so adaptive replica selection reroutes
+            # reads away from a copy that keeps erroring (a corrupt
+            # replica must not stay first in every failover walk)
             self.response_collector.on_failure(
                 node_id, time.monotonic() - t0)
             raise
@@ -1712,8 +1732,27 @@ class ClusterClient:
                     pass
 
     def search(self, index: str, body: Optional[dict] = None) -> dict:
-        """Scatter-gather across one STARTED copy per shard (§3.2)."""
+        """Scatter-gather across one STARTED copy per shard (§3.2).
+
+        Per-shard isolation (AbstractSearchAsyncAction.onShardFailure):
+        a copy that fails — connection loss OR a query-phase exception on
+        the remote shard — fails over to the next ranked copy; a shard
+        with no surviving copy becomes a failures[] entry and the
+        response degrades to partial results (HTTP 200, _shards.failed),
+        unless allow_partial_search_results=false."""
+        from elasticsearch_tpu.common.errors import (
+            SearchPhaseExecutionException,
+        )
+        from elasticsearch_tpu.search.service import (
+            allow_partial_results,
+            shard_failure_entry,
+        )
+
         body = body or {}
+        if "allow_partial_search_results" not in body and \
+                not S.SEARCH_ALLOW_PARTIAL_RESULTS.get(self.node.settings):
+            body = dict(body)
+            body["allow_partial_search_results"] = False
         md = self.node.indices_meta.get(index)
         if md is None:
             raise IndexNotFoundException(index)
@@ -1732,6 +1771,7 @@ class ClusterClient:
             started = self.response_collector.order_copies(started)
             shard_count += 1
             resp = None
+            last_error = None
             for copy in started:
                 try:
                     resp = self._timed_request(
@@ -1741,15 +1781,39 @@ class ClusterClient:
                     break
                 except NodeNotConnectedException:
                     continue
+                except Exception as e:  # noqa: BLE001 — shard-level failure
+                    from elasticsearch_tpu.index.index_service import (
+                        _is_request_error,
+                    )
+
+                    if _is_request_error(e):
+                        raise  # 4xx validation: keeps its own status
+                    # the remote query phase executed and failed; record
+                    # it and try the next copy (the failure may be
+                    # copy-local — a corrupt segment on one replica)
+                    last_error = e
+                    continue
             if resp is None:
-                failures.append({"shard": sid, "index": index,
-                                 "reason": "no available shard copy"})
+                if last_error is not None:
+                    failures.append(shard_failure_entry(
+                        index, sid, last_error))
+                else:
+                    failures.append({"shard": sid, "index": index,
+                                     "reason": "no available shard copy"})
                 continue
             total += resp["total"]
             if resp["max_score"] is not None:
                 max_score = (resp["max_score"] if max_score is None
                              else max(max_score, resp["max_score"]))
             all_hits.extend(resp["hits"])
+        # NOTE: unlike the single-node path, all-shards-unavailable stays
+        # a degraded 200 here — the RED-shard contract (PR 2): a cluster
+        # serving through an outage reports the failed shards loudly in
+        # _shards rather than erroring reads that might still match docs
+        # on recovering copies moments later
+        if failures and not allow_partial_results(body):
+            raise SearchPhaseExecutionException(
+                "query", "Partial shards failure", failures)
         from elasticsearch_tpu.search.service import (
             multi_pass_sort,
             normalize_sort,

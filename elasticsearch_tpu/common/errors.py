@@ -9,6 +9,17 @@ body (``type``, ``reason``, nested ``caused_by``).
 from __future__ import annotations
 
 
+def es_type_name(class_name: str) -> str:
+    """CamelCase -> snake_case, mirroring ES "type" strings like
+    "index_not_found_exception"."""
+    out = []
+    for i, ch in enumerate(class_name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
 class ElasticsearchTpuException(Exception):
     """Base for all engine errors; carries an HTTP status."""
 
@@ -21,15 +32,7 @@ class ElasticsearchTpuException(Exception):
 
     @property
     def error_type(self) -> str:
-        # CamelCase -> snake_case, mirroring ES "type" strings like
-        # "index_not_found_exception".
-        name = type(self).__name__
-        out = []
-        for i, ch in enumerate(name):
-            if ch.isupper() and i > 0:
-                out.append("_")
-            out.append(ch.lower())
-        return "".join(out)
+        return es_type_name(type(self).__name__)
 
     def to_dict(self) -> dict:
         err = {"type": self.error_type, "reason": self.reason}
@@ -152,6 +155,16 @@ class EsRejectedExecutionException(ElasticsearchTpuException):
 
 class TaskCancelledException(ElasticsearchTpuException):
     status_code = 400
+
+
+class TranslogCorruptedException(ElasticsearchTpuException):
+    """Unreadable translog data at or below the checkpointed seqno —
+    acked (possibly committed) operations cannot be replayed (ES:
+    TranslogCorruptedException). A torn FINAL line of the newest
+    generation is NOT this: that is an unacked in-flight append cut by a
+    crash, tolerated by recovery."""
+
+    status_code = 500
 
 
 class SearchPhaseExecutionException(ElasticsearchTpuException):

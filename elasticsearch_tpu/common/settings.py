@@ -385,6 +385,21 @@ SEARCH_MAX_BUCKETS = Setting.int_setting(
 SEARCH_KEEPALIVE = Setting.time_setting(
     "search.default_keep_alive", "5m", dynamic=True
 )
+SEARCH_DEFAULT_TIMEOUT = Setting.time_setting(
+    # query-phase deadline applied when a request carries no `timeout`
+    # param (SearchService.DEFAULT_SEARCH_TIMEOUT_SETTING); None = no
+    # timeout. Expired deadlines return accumulated hits with
+    # timed_out: true — they do not error (The Tail at Scale degradation)
+    "search.default_search_timeout", None, dynamic=True
+)
+SEARCH_ALLOW_PARTIAL_RESULTS = Setting.bool_setting(
+    # TransportSearchAction.SHARD_COUNT... analog of
+    # search.default_allow_partial_results: whether shard failures /
+    # expired timeouts degrade to partial results (true) or fail the
+    # request with search_phase_execution_exception (false); a request's
+    # allow_partial_search_results param overrides
+    "search.default_allow_partial_results", True, dynamic=True
+)
 BREAKER_TOTAL_LIMIT = Setting.str_setting(
     "indices.breaker.total.limit", "70%", dynamic=True
 )
@@ -479,6 +494,8 @@ NODE_SETTINGS = [
     SEARCH_DEFAULT_SIZE,
     SEARCH_MAX_BUCKETS,
     SEARCH_KEEPALIVE,
+    SEARCH_DEFAULT_TIMEOUT,
+    SEARCH_ALLOW_PARTIAL_RESULTS,
     BREAKER_TOTAL_LIMIT,
     BREAKER_REQUEST_LIMIT,
     BREAKER_FIELDDATA_LIMIT,
@@ -566,11 +583,30 @@ INDEX_SEARCH_MESH_PLANE = Setting.str_setting(
     "index.search.mesh.plane", "auto",
     choices={"auto", "pallas", "scatter"}, scope=Scope.INDEX
 )
+INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN = Setting.time_setting(
+    # plane-health quarantine: after a mesh_pallas / mesh plane failure
+    # (compile error, OOM, runtime fault) the plane is benched for this
+    # index and queries serve from the next rung of the ladder; after
+    # the cooldown one query probes the plane again
+    "index.search.plane_quarantine.cooldown", "60s", scope=Scope.INDEX,
+    dynamic=True
+)
+INDEX_SEARCH_SLOWLOG_WARN = Setting.time_setting(
+    "index.search.slowlog.threshold.query.warn", None, scope=Scope.INDEX,
+    dynamic=True
+)
+INDEX_SEARCH_SLOWLOG_INFO = Setting.time_setting(
+    "index.search.slowlog.threshold.query.info", None, scope=Scope.INDEX,
+    dynamic=True
+)
 
 INDEX_SETTINGS = [
     INDEX_SEARCH_MESH,
     INDEX_SEARCH_MESH_MAX_SLOTS,
     INDEX_SEARCH_MESH_PLANE,
+    INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN,
+    INDEX_SEARCH_SLOWLOG_WARN,
+    INDEX_SEARCH_SLOWLOG_INFO,
     INDEX_NUMBER_OF_SHARDS,
     INDEX_NUMBER_OF_REPLICAS,
     INDEX_REFRESH_INTERVAL,

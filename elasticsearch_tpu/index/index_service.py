@@ -20,6 +20,8 @@ from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
 from elasticsearch_tpu.common.errors import (
     DocumentMissingException,
     IllegalArgumentException,
+    SearchPhaseExecutionException,
+    TaskCancelledException,
 )
 from elasticsearch_tpu.common.settings import (
     INDEX_NUMBER_OF_REPLICAS,
@@ -313,7 +315,8 @@ class IndexService:
     # Search (scatter -> merge -> fetch; §3.2 of SURVEY.md)
     # ------------------------------------------------------------------
 
-    def _try_mesh_search(self, body: dict, k: int) -> Optional[dict]:
+    def _try_mesh_search(self, body: dict, k: int,
+                         deadline=None) -> Optional[dict]:
         """Mesh query phase + host fetch phase. None = ineligible."""
         import time as _time
 
@@ -324,7 +327,7 @@ class IndexService:
             from elasticsearch_tpu.parallel.plan_exec import IndexMeshSearch
 
             self._mesh_search = IndexMeshSearch(self)
-        out = self._mesh_search.query(body, max(k, 1))
+        out = self._mesh_search.query(body, max(k, 1), deadline=deadline)
         if out is None:
             return None
         from_ = int(body.get("from", 0) or 0)
@@ -361,10 +364,14 @@ class IndexService:
 
     def search(self, body: Optional[dict] = None,
                preference_shards: Optional[List[int]] = None,
-               pinned_segments: Optional[Dict[int, list]] = None) -> dict:
+               pinned_segments: Optional[Dict[int, list]] = None,
+               deadline=None) -> dict:
         """pinned_segments: {shard_id: [PinnedSegmentView]} from an open
         scroll context — bypasses the request cache, can_match, and the
-        mesh plane (all keyed to the LIVE segment set)."""
+        mesh plane (all keyed to the LIVE segment set).
+        deadline: SearchDeadline threaded from the coordinator — expiry
+        degrades to partial results (timed_out: true), cancellation
+        raises TaskCancelledException at the next checkpoint."""
         from elasticsearch_tpu.index.request_cache import (
             RequestCache,
             cacheable,
@@ -373,7 +380,19 @@ class IndexService:
 
         t0 = time.monotonic()
         body = body or {}
+        if deadline is None and body.get("timeout") is not None:
+            # direct IndexService.search callers get the same timeout
+            # contract as the coordinator path
+            from elasticsearch_tpu.search.cancellation import (
+                SearchDeadline,
+                parse_search_timeout,
+            )
+
+            deadline = SearchDeadline(parse_search_timeout(body))
         cache_key = None
+        # (a cached COMPLETE response is always valid under a deadline;
+        # only the put below filters — partial/timed-out responses must
+        # not poison the cache)
         if (self._request_cache_enabled and preference_shards is None
                 and pinned_segments is None and cacheable(body)):
             epochs = [shard_epoch(self.shards[sid])
@@ -385,21 +404,32 @@ class IndexService:
                     cached["took"] = int((time.monotonic() - t0) * 1000)
                     return cached
         resp = self._search_uncached(body, preference_shards,
-                                     pinned_segments)
-        if cache_key is not None:
+                                     pinned_segments, deadline=deadline)
+        if (cache_key is not None and not resp.get("timed_out")
+                and not resp["_shards"].get("failed")):
             self.request_cache.put(cache_key, resp)
         return resp
 
     def _search_uncached(self, body: dict,
                          preference_shards: Optional[List[int]] = None,
                          pinned_segments: Optional[Dict[int, list]] = None,
-                         ) -> dict:
+                         deadline=None) -> dict:
+        from elasticsearch_tpu.search.cancellation import (
+            TimeExceededException,
+        )
+        from elasticsearch_tpu.search.service import (
+            allow_partial_results,
+            shard_failure_entry,
+        )
+
         t0 = time.monotonic()
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size")) if body.get("size") is not None else 10
         k = from_ + size
         shard_ids = preference_shards or sorted(self.shards)
         sort_spec = normalize_sort(body.get("sort"))
+        allow_partial = allow_partial_results(body)
+        timed_out = False
 
         # mesh data plane: eligible searches over all shards run as ONE
         # multi-device program (query + DFS-free scoring + global top-k
@@ -408,7 +438,14 @@ class IndexService:
         # the LIVE segment set.
         if (self._mesh_enabled and preference_shards is None
                 and pinned_segments is None and not body.get("scroll")):
-            mesh_resp = self._try_mesh_search(body, k)
+            try:
+                mesh_resp = self._try_mesh_search(body, k, deadline=deadline)
+            except TimeExceededException:
+                # deadline expired inside the mesh plane: the host loop
+                # below breaks at its first checkpoint and reports the
+                # accumulated (empty) partial result
+                mesh_resp = None
+                timed_out = True
             if mesh_resp is not None:
                 return mesh_resp
         self._host_query_total += 1
@@ -435,17 +472,47 @@ class IndexService:
             active_ids = [shard_ids[0]]
             skipped -= 1
         for sid in active_ids:
+            if timed_out or (deadline is not None and deadline.expired):
+                # accumulated shard results stand; the fan-out stops
+                timed_out = True
+                if deadline is not None:
+                    deadline.timed_out = True
+                break
             try:
                 shard_results.append(
                     self.shards[sid].searcher.query(
                         body, size_hint=max(k, 1),
                         segments=(pinned_segments.get(sid, [])
-                                  if pinned_segments is not None else None))
+                                  if pinned_segments is not None else None),
+                        deadline=deadline)
                 )
-            except Exception:
-                # per-shard failure tolerance comes with the replicated path;
-                # single-copy shards surface the error to the caller
-                raise
+            except TaskCancelledException:
+                raise  # _tasks/_cancel: a clean request-level error
+            except TimeExceededException:
+                timed_out = True
+                break
+            except Exception as e:  # noqa: BLE001 — per-shard isolation
+                if _is_request_error(e):
+                    # request-level validation (parse/mapping/argument):
+                    # deterministic on every shard — surface it with its
+                    # own 4xx status instead of masking it as failures
+                    raise
+                # one bad shard (corrupt segment, injected fault, compile
+                # error) becomes a failures[] entry + _shards.failed, not
+                # a 500 (AbstractSearchAsyncAction.onShardFailure)
+                failures.append(shard_failure_entry(self.name, sid, e))
+        timed_out = timed_out or any(r.timed_out for r in shard_results)
+        if failures and not shard_results and not timed_out:
+            # every shard failed: no results to degrade to
+            # (SearchPhaseExecutionException "all shards failed")
+            raise SearchPhaseExecutionException(
+                "query", "all shards failed", failures)
+        if not allow_partial and (failures or timed_out):
+            raise SearchPhaseExecutionException(
+                "query",
+                "Partial shards failure"
+                + (" (request timed out)" if timed_out else ""),
+                failures)
         total = sum(r.total_hits for r in shard_results)
         max_score = None
         for r in shard_results:
@@ -475,16 +542,20 @@ class IndexService:
         if collapse_field:
             from elasticsearch_tpu.search.service import expand_collapsed_hits
 
-            expand_collapsed_hits(hits, refs_window, collapse_body, body,
-                                  self.search)
+            expand_collapsed_hits(
+                hits, refs_window, collapse_body, body,
+                lambda sub: self.search(sub, deadline=deadline))
         took = int((time.monotonic() - t0) * 1000)
         resp = {
             "took": took,
-            "timed_out": False,
+            "timed_out": timed_out,
             "_plane": "host",
             "_shards": {
+                # shards the deadline cut before they ran count successful
+                # (they did not fail — the reference reports responded +
+                # unreached alike against the timeout flag)
                 "total": len(shard_ids),
-                "successful": len(shard_results) + skipped,
+                "successful": len(shard_ids) - len(failures),
                 "skipped": skipped,
                 "failed": len(failures),
             },
@@ -573,6 +644,12 @@ class IndexService:
                 "scatter_segments_total": sum(
                     s["search"]["planes"]["scatter_segments_total"]
                     for s in shard_stats.values()),
+                # plane-health quarantine (docs/RESILIENCE.md): per-plane
+                # fault counters + which planes are currently benched
+                **(self._mesh_search.plane_health.stats()
+                   if self._mesh_search is not None else
+                   {"plane_failures_total": {"mesh_pallas": 0, "mesh": 0},
+                    "plane_quarantined": []}),
             },
         }
         if groups:
@@ -637,6 +714,17 @@ class IndexService:
             self._refresh_stop.set()
         for shard in self.shards.values():
             shard.close()
+
+
+def _is_request_error(exc: Exception) -> bool:
+    """True for 4xx engine exceptions — request-level validation errors
+    (malformed query, unmapped field, bad argument) that every shard
+    would raise identically; the reference rejects these on the
+    coordinator before the fan-out, so they keep their own status."""
+    from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+
+    return (isinstance(exc, ElasticsearchTpuException)
+            and exc.status_code < 500)
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

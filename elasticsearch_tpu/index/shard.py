@@ -158,6 +158,7 @@ class IndexShard:
         self.searcher = ShardSearcher(
             shard_id, self.engine, mapper_service,
             slowlog_warn_s=slowlog_warn_s, slowlog_info_s=slowlog_info_s,
+            index_name=index_name,
         )
         self._lock = threading.RLock()
 

@@ -15,8 +15,13 @@ host-side and never touches the TPU path.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Iterator, List, Optional
+
+from elasticsearch_tpu.common.errors import TranslogCorruptedException
+
+logger = logging.getLogger("elasticsearch_tpu.index.translog")
 
 
 class TranslogOp:
@@ -74,6 +79,10 @@ class Translog:
         self.max_seqno: int = ckp.get("max_seqno", -1)
         # ops at or below this seqno are in a committed segment set
         self.committed_seqno: int = ckp.get("committed_seqno", -1)
+        # generations found unreadable below their tail (see _read_gen):
+        # surfaced in stats(), retained until fully committed
+        self.corrupt_generations: set = set()
+        self._trim_torn_tail()
         self._writer = open(self._gen_path(self.generation), "a", encoding="utf-8")
         self._ops_since_sync = 0
 
@@ -134,7 +143,14 @@ class Translog:
 
     def mark_committed(self, seqno: int) -> None:
         """Engine flushed a commit covering ops <= seqno; trim old generations
-        whose ops are all committed (CombinedDeletionPolicy analog)."""
+        whose ops are all committed (CombinedDeletionPolicy analog).
+
+        A generation that cannot be READ is never silently skipped (the
+        old behavior retained it forever, masking the corruption): it is
+        recorded in ``corrupt_generations`` / stats() with a warning, and
+        deleted only once EVERYTHING ever logged is committed — an
+        unreadable file can hide ops, so the conservative bound is the
+        checkpoint's own max_seqno."""
         self.committed_seqno = max(self.committed_seqno, seqno)
         self.sync()
         # trim: delete generations strictly older than current whose max op
@@ -145,46 +161,178 @@ class Translog:
                 continue
             try:
                 ops = list(self._read_gen(gen))
-            except (OSError, json.JSONDecodeError):
+            except OSError:
+                continue
+            except TranslogCorruptedException:
+                if gen not in self.corrupt_generations:
+                    self.corrupt_generations.add(gen)
+                    logger.warning(
+                        "[%s] translog generation [%d] is corrupt; "
+                        "retained until its seqno range is fully committed",
+                        self.directory, gen)
+                if self.committed_seqno >= self.max_seqno:
+                    os.remove(path)
+                    self.corrupt_generations.discard(gen)
                 continue
             if not ops or all(op.seqno <= self.committed_seqno for op in ops):
                 os.remove(path)
+                self.corrupt_generations.discard(gen)
 
-    def _read_gen(self, gen: int) -> Iterator[TranslogOp]:
+    def _trim_torn_tail(self) -> None:
+        """Cut a benign torn final line off the newest generation BEFORE
+        reopening it for append: the writer opens in append mode, so a
+        crash-cut fragment left in place would have the next acked op
+        CONCATENATED onto it — one unparseable merged line that silently
+        swallows the new op (or, once buried mid-file, fails recovery of
+        everything). Only the case _read_gen would tolerate is trimmed;
+        a tear that could hide checkpointed ops, or any unreadable line
+        before the tail, is left intact so recovery raises
+        TranslogCorruptedException instead of destroying the evidence."""
+        path = self._gen_path(self.generation)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return
+        if not data or data.endswith(b"\n"):
+            return
+        head, _sep, tail = data.rpartition(b"\n")
+        try:
+            json.loads(tail.decode("utf-8"))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            pass
+        else:
+            # a COMPLETE op missing only its newline (crash between the
+            # json write and the terminator): finish the line instead of
+            # dropping a durable op
+            with open(path, "ab") as f:
+                f.write(b"\n")
+                f.flush()
+                os.fsync(f.fileno())
+            return
+        last_seqno = -1
+        any_read = False
+        intact = True
+        for line in head.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                d = json.loads(line.decode("utf-8"))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                intact = False  # damage before the tail: don't touch
+                break
+            last_seqno = d.get("seq_no", -1)
+            any_read = True
+        if not (intact and self._benign_torn_tail(self.generation,
+                                                  last_seqno, any_read)):
+            return
+        with open(path, "ab") as f:
+            f.truncate(len(head) + len(_sep))
+            f.flush()
+            os.fsync(f.fileno())
+        logger.warning(
+            "[%s] translog generation [%d] had a truncated final line "
+            "(crash mid-append); trimmed, replay resumes at seqno [%d]",
+            self.directory, self.generation, last_seqno)
+
+    def _benign_torn_tail(self, gen: int, last_seqno: int,
+                          any_read: bool) -> bool:
+        """THE safety invariant shared by trim-at-open and replay: a torn
+        final line is benign only when nothing checkpointed can sit
+        beyond the tear — every op at or below the committed seqno was
+        already read from this generation, or the generation holds no
+        readable op at all (a rolled file whose only append was the torn,
+        never-acked one)."""
+        return (last_seqno >= self.committed_seqno
+                or (not any_read and gen > 1))
+
+    def _read_gen(self, gen: int,
+                  tolerate_tail: bool = False) -> Iterator[TranslogOp]:
+        """Ops of one generation file, in log order.
+
+        ``tolerate_tail`` (the NEWEST generation during recovery): a
+        crash mid-append leaves a partial final JSON line — replay stops
+        there with a warning, because the torn op was never acked. Any
+        OTHER unreadable line — mid-file, an older generation, or a tail
+        whose loss would swallow ops at or below the checkpointed
+        committed seqno — raises ``TranslogCorruptedException``: acked
+        data is gone and recovery must not pretend otherwise."""
         with open(self._gen_path(gen), encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    yield TranslogOp.from_dict(json.loads(line))
+            lines = f.read().split("\n")
+        last_seqno = -1
+        any_read = False
+        for i, raw in enumerate(lines):
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                is_tail = all(not rest.strip() for rest in lines[i + 1:])
+                if tolerate_tail and is_tail and self._benign_torn_tail(
+                        gen, last_seqno, any_read):
+                    logger.warning(
+                        "[%s] translog generation [%d] has a truncated "
+                        "final line (crash mid-append); replay stops at "
+                        "seqno [%d]", self.directory, gen, last_seqno)
+                    return
+                raise TranslogCorruptedException(
+                    f"translog generation [{gen}] unreadable at line "
+                    f"[{i + 1}]"
+                    + ("" if is_tail else " (mid-file)")
+                    + (f"; ops at or below the checkpointed seqno "
+                       f"[{self.committed_seqno}] may be lost"
+                       if last_seqno < self.committed_seqno else ""))
+            op = TranslogOp.from_dict(d)
+            last_seqno = op.seqno
+            any_read = True
+            yield op
 
-    def snapshot(self, from_seqno: int = 0) -> List[TranslogOp]:
+    def snapshot(self, from_seqno: int = 0,
+                 on_corruption: str = "raise") -> List[TranslogOp]:
         """All retained ops with seqno >= from_seqno, in log order.
-        (Translog.newSnapshot — used by recovery phase2 and resync.)"""
+        (Translog.newSnapshot — used by recovery phase2 and resync.)
+        ``on_corruption``: "raise" (recovery must fail loudly) or "skip"
+        (observability paths keep serving the readable generations)."""
         self._writer.flush()
         out: List[TranslogOp] = []
         for gen in range(1, self.generation + 1):
             if not os.path.exists(self._gen_path(gen)):
                 continue
-            for op in self._read_gen(gen):
-                if op.seqno >= from_seqno:
-                    out.append(op)
+            try:
+                for op in self._read_gen(
+                        gen, tolerate_tail=gen == self.generation):
+                    if op.seqno >= from_seqno:
+                        out.append(op)
+            except TranslogCorruptedException:
+                self.corrupt_generations.add(gen)
+                if on_corruption == "raise":
+                    raise
         return out
 
     def uncommitted_ops(self) -> List[TranslogOp]:
         return self.snapshot(self.committed_seqno + 1)
 
     def stats(self) -> dict:
-        n_ops = len(self.snapshot(0))
+        ops = self.snapshot(0, on_corruption="skip")
         size = sum(
             os.path.getsize(self._gen_path(g))
             for g in range(1, self.generation + 1)
             if os.path.exists(self._gen_path(g))
         )
+        retained = [g for g in range(1, self.generation + 1)
+                    if os.path.exists(self._gen_path(g))]
         return {
-            "operations": n_ops,
+            "operations": len(ops),
             "size_in_bytes": size,
-            "uncommitted_operations": len(self.uncommitted_ops()),
+            "uncommitted_operations": len(
+                [op for op in ops if op.seqno > self.committed_seqno]),
             "generation": self.generation,
+            # retention observability: a corrupt old generation must be
+            # VISIBLE, not silently pinned (mark_committed docstring)
+            "earliest_retained_generation": min(retained,
+                                                default=self.generation),
+            "corrupt_generations": sorted(self.corrupt_generations),
         }
 
     def close(self) -> None:

@@ -787,14 +787,35 @@ class Node:
         pinned = None
         if scroll and clusters is None:
             pinned = self._pin_scroll_segments(pairs)
+        # deadline + degradation policy: the request's `timeout` (or
+        # search.default_search_timeout) bounds the query phase; the
+        # registered task's cancellation trips the same checkpoints
+        # (_tasks/_cancel). allow_partial_search_results defaults from
+        # search.default_allow_partial_results.
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_ALLOW_PARTIAL_RESULTS,
+        )
+        from elasticsearch_tpu.search.cancellation import (
+            SearchDeadline,
+            parse_search_timeout,
+        )
+
+        if "allow_partial_search_results" not in body:
+            if not SEARCH_ALLOW_PARTIAL_RESULTS.get(self.settings):
+                body = dict(body)
+                body["allow_partial_search_results"] = False
         task = self.tasks.register("indices:data/read/search", f"search [{expression}]")
+        deadline = SearchDeadline(parse_search_timeout(body, self.settings),
+                                  task)
         try:
             if len(pairs) == 1 and pairs[0][0] == "" and clusters is None:
                 resp = pairs[0][1].search(
                     body, pinned_segments=(pinned or {}).get(
-                        pairs[0][1].name) if pinned else None)
+                        pairs[0][1].name) if pinned else None,
+                    deadline=deadline)
             else:
-                resp = self._multi_index_search(pairs, body, pinned=pinned)
+                resp = self._multi_index_search(pairs, body, pinned=pinned,
+                                                deadline=deadline)
                 if clusters is not None:
                     resp["_clusters"] = clusters
         finally:
@@ -912,17 +933,26 @@ class Node:
         return body
 
     def _multi_index_search(self, pairs: List[tuple], body: dict,
-                            pinned=None) -> dict:
+                            pinned=None, deadline=None) -> dict:
         """Cross-index search: fan out, merge like cross-shard merge.
         ``pairs`` are (display_prefix, IndexService) — the prefix carries
         the remote-cluster alias into hit ``_index`` values (CCS).
         ``pinned``: {index_name: {shard_id: [segment views]}} from an
         open scroll context."""
+        from elasticsearch_tpu.common.errors import (
+            SearchPhaseExecutionException,
+            TaskCancelledException,
+        )
         from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggregations
+        from elasticsearch_tpu.search.cancellation import (
+            TimeExceededException,
+        )
         from elasticsearch_tpu.search.service import (
+            allow_partial_results,
             fetch_hits,
             merge_refs,
             normalize_sort,
+            shard_failure_entry,
         )
 
         t0 = time.monotonic()
@@ -939,15 +969,43 @@ class Node:
         max_score = None
         views = []
         n_shards = 0
+        n_ok = 0
+        failures = []
+        timed_out = False
         for prefix, svc in pairs:
             display = f"{prefix}{svc.name}"
             svc_pins = (pinned or {}).get(svc.name)
             for sid in sorted(svc.shards):
                 n_shards += 1
-                res = svc.shards[sid].searcher.query(
-                    body, size_hint=max(k, 1),
-                    segments=(svc_pins.get(sid, [])
-                              if svc_pins is not None else None))
+                if timed_out or (deadline is not None and deadline.expired):
+                    # accumulated shard results stand; remaining shards
+                    # are skipped under the expired deadline
+                    timed_out = True
+                    if deadline is not None:
+                        deadline.timed_out = True
+                    continue
+                try:
+                    res = svc.shards[sid].searcher.query(
+                        body, size_hint=max(k, 1),
+                        segments=(svc_pins.get(sid, [])
+                                  if svc_pins is not None else None),
+                        deadline=deadline)
+                except TaskCancelledException:
+                    raise
+                except TimeExceededException:
+                    timed_out = True
+                    continue
+                except Exception as e:  # noqa: BLE001 — per-shard isolation
+                    from elasticsearch_tpu.index.index_service import (
+                        _is_request_error,
+                    )
+
+                    if _is_request_error(e):
+                        raise  # 4xx validation: keeps its own status
+                    failures.append(shard_failure_entry(display, sid, e))
+                    continue
+                n_ok += 1
+                timed_out = timed_out or res.timed_out
                 total += res.total_hits
                 if res.max_score is not None:
                     max_score = (res.max_score if max_score is None
@@ -956,6 +1014,15 @@ class Node:
                     ref.shard_id = (display, ref.shard_id)
                     all_refs.append(ref)
                 views.extend(res.agg_views)
+        if failures and n_ok == 0 and not timed_out:
+            raise SearchPhaseExecutionException(
+                "query", "all shards failed", failures)
+        if not allow_partial_results(body) and (failures or timed_out):
+            raise SearchPhaseExecutionException(
+                "query",
+                "Partial shards failure"
+                + (" (request timed out)" if timed_out else ""),
+                failures)
         shard_map = {}
         for prefix, svc in pairs:
             for sid, shard in svc.shards.items():
@@ -993,14 +1060,19 @@ class Node:
             # ExpandSearchPhase across all clusters/indices of the request
             expand_collapsed_hits(
                 hits, refs, collapse_body, body,
-                lambda sub: self._multi_index_search(pairs, sub))
+                lambda sub: self._multi_index_search(pairs, sub,
+                                                     deadline=deadline))
         resp = {
             "took": int((time.monotonic() - t0) * 1000),
-            "timed_out": False,
-            "_shards": {"total": n_shards, "successful": n_shards, "skipped": 0,
-                        "failed": 0},
+            "timed_out": timed_out,
+            "_shards": {"total": n_shards,
+                        "successful": n_shards - len(failures),
+                        "skipped": 0,
+                        "failed": len(failures)},
             "hits": {"total": total, "max_score": max_score, "hits": hits},
         }
+        if failures:
+            resp["_shards"]["failures"] = failures
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         if agg_specs:
             resp["aggregations"] = run_aggregations(agg_specs, views)

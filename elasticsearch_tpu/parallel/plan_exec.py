@@ -27,6 +27,8 @@ action/search/AbstractSearchAsyncAction.java + SearchTransportService
 from __future__ import annotations
 
 import functools
+import logging
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -43,6 +45,45 @@ class PlanStructureMismatch(Exception):
     """Per-shard plans for the same query diverged structurally (e.g. a
     field exists on one shard only with a different similarity) — the
     caller falls back to the host-merge path."""
+
+
+_plane_logger = logging.getLogger("elasticsearch_tpu.parallel.plane")
+
+
+class PlaneHealth:
+    """Per-index execution-plane failure tracking + quarantine.
+
+    A mesh_pallas / mesh plane that RAISES (compile error, device OOM,
+    runtime fault — as opposed to a clean PlanStructureMismatch shape
+    fallback) is benched for ``cooldown_s``: queries serve from the next
+    rung of the ladder without re-paying the failure, and after the
+    cooldown the next query probes the plane again. Counters export via
+    _stats planes (`plane_failures_total`, `plane_quarantined`)."""
+
+    PLANES = ("mesh_pallas", "mesh")
+
+    def __init__(self, cooldown_s: float = 60.0):
+        self.cooldown_s = float(cooldown_s)
+        self.failures_total: Dict[str, int] = {p: 0 for p in self.PLANES}
+        self._quarantined_until: Dict[str, float] = {}
+
+    def record_failure(self, plane: str) -> None:
+        self.failures_total[plane] = self.failures_total.get(plane, 0) + 1
+        self._quarantined_until[plane] = _time.monotonic() + self.cooldown_s
+
+    def available(self, plane: str) -> bool:
+        return _time.monotonic() >= self._quarantined_until.get(plane, 0.0)
+
+    def quarantined(self) -> List[str]:
+        now = _time.monotonic()
+        return [p for p, until in sorted(self._quarantined_until.items())
+                if now < until]
+
+    def stats(self) -> dict:
+        return {
+            "plane_failures_total": dict(self.failures_total),
+            "plane_quarantined": self.quarantined(),
+        }
 
 
 def _check_same_structure(plans: List[PlanNode]) -> None:
@@ -426,11 +467,16 @@ class IndexMeshSearch:
         # pallas = kernel or host (never the scatter mesh); scatter =
         # never build kernel plans (index.search.mesh.plane)
         self.plane_pref = "auto"
+        quarantine_cooldown = 60.0
         if settings is not None:
             self.max_slots = settings.get_int(
                 "index.search.mesh.max_slots_per_device", 4)
             self.plane_pref = settings.get_str(
                 "index.search.mesh.plane", "auto")
+            quarantine_cooldown = settings.get_time(
+                "index.search.plane_quarantine.cooldown", 60.0)
+        # plane-health quarantine (index.search.plane_quarantine.cooldown)
+        self.plane_health = PlaneHealth(quarantine_cooldown)
 
     def _mesh_or_default(self) -> Mesh:
         if self._mesh is None:
@@ -555,9 +601,13 @@ class IndexMeshSearch:
         oriented = anchor if order == "desc" else -anchor
         return float(np.clip(oriented, -big, big))
 
-    def query(self, body: dict, k: int):
+    def query(self, body: dict, k: int, deadline=None):
         """Returns {total, refs, max_score, aggregations,
-        terminated_early} or None if ineligible."""
+        terminated_early} or None if ineligible.
+        deadline: SearchDeadline — checkpointed between staging steps and
+        plane attempts (timeout raises TimeExceededException for the
+        caller's partial-result path; cancellation raises
+        TaskCancelledException)."""
         from elasticsearch_tpu.search.aggregations import (
             SegmentView,
             parse_aggs,
@@ -582,8 +632,18 @@ class IndexMeshSearch:
         if any(getattr(self.svc.shards[s].engine, "index_sort", None)
                for s in self.svc.shards):
             return None  # index-sorted early termination beats top-k
+        if deadline is not None:
+            deadline.checkpoint()
         if not self._ensure_staged():
             return None
+        if deadline is not None:
+            deadline.checkpoint()  # staging can compile/transfer
+        settings = getattr(self.svc, "settings", None)
+        if settings is not None:
+            # the cooldown is a DYNAMIC index setting: re-read per query
+            # so a live settings update takes effect without a restart
+            self.plane_health.cooldown_s = settings.get_time(
+                "index.search.plane_quarantine.cooldown", 60.0)
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         sort_keys, sort_spec = self._sort_plan(body)
         if sort_keys == "fallback":
@@ -641,18 +701,35 @@ class IndexMeshSearch:
         # for distributed queries — the reference runs the same BulkScorer
         # hot loop on every shard), falling back to the scatter mesh when
         # the kernel can't serve this query shape, then to the host path.
+        # A plane under quarantine (plane_health) is skipped outright —
+        # its last failure already paid the cost — and probed again once
+        # the cooldown elapses.
+        from elasticsearch_tpu.common.errors import TaskCancelledException
+        from elasticsearch_tpu.search.cancellation import (
+            TimeExceededException,
+        )
+        from elasticsearch_tpu.testing.disruption import on_plane_execute
+
         kernel_session = None
-        if self.plane_pref in ("auto", "pallas"):
+        if (self.plane_pref in ("auto", "pallas")
+                and self.plane_health.available("mesh_pallas")):
             kernel_session = self._executor.ensure_kernel()
         attempts = []
         if kernel_session is not None:
-            attempts.append(kernel_session)
-        if self.plane_pref != "pallas" or kernel_session is None:
-            attempts.append(None)
+            attempts.append(("mesh_pallas", kernel_session))
+        if (self.plane_pref != "pallas"
+                and self.plane_health.available("mesh")):
+            # plane=pallas pins "kernel or host": when the kernel is
+            # unavailable OR quarantined, the ladder's next rung is the
+            # host path, never the scatter mesh the operator excluded
+            attempts.append(("mesh", None))
         outs = None
         used_pallas = False
-        for session in attempts:
+        for plane, session in attempts:
+            if deadline is not None:
+                deadline.checkpoint()
             try:
+                on_plane_execute(self.svc.name, plane)
                 plans = []
                 pf_plans = [] if pf_qb is not None else None
                 rs_plans = [] if rs_qb is not None else None
@@ -687,7 +764,19 @@ class IndexMeshSearch:
                     rescore_static=rescore_static)
                 break
             except (PlanStructureMismatch, NotImplementedError):
-                continue  # next plane (or host fallback)
+                continue  # shape ineligibility: next plane (no penalty)
+            except (TaskCancelledException, TimeExceededException):
+                raise
+            except Exception:  # noqa: BLE001 — plane fault, not a shape miss
+                # compile error / device OOM / runtime fault (or injected
+                # PlaneFailScheme): bench the plane for the cooldown and
+                # serve this query from the next rung
+                _plane_logger.warning(
+                    "[%s] execution plane [%s] failed; quarantined for "
+                    "%.1fs", self.svc.name, plane,
+                    self.plane_health.cooldown_s, exc_info=True)
+                self.plane_health.record_failure(plane)
+                continue
         if outs is None:
             return None
         keys, slots, docs, total, scores, raws, seg_counts = outs[:7]

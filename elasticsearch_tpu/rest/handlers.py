@@ -681,6 +681,13 @@ def _search_body(req):
     for p in ("size", "from"):
         if req.param(p) is not None:
             body[p] = int(req.param(p))
+    # query-phase fault-tolerance params (RestSearchAction): a deadline
+    # on the query phase and the partial-results degradation policy
+    if req.param("timeout") is not None:
+        body["timeout"] = req.param("timeout")
+    if req.param("allow_partial_search_results") is not None:
+        body["allow_partial_search_results"] = req.bool_param(
+            "allow_partial_search_results")
     if req.param("sort") is not None:
         sort = []
         for part in req.param("sort").split(","):

@@ -1,0 +1,84 @@
+"""Search deadlines + cooperative cancellation checkpoints.
+
+Role model: the reference's query-phase timeout plumbing —
+``SearchContext.timeout()`` checked by ``CancellableBulkScorer`` /
+``QueryPhase``'s timeout runnable (search/query/QueryPhase.java:265),
+and ``CancellableTask`` checks from ``SearchService`` — plus the
+Dean & Barroso "Tail at Scale" contract: a fan-out bounded by a deadline
+returns what it has accumulated instead of stalling on stragglers.
+
+One ``SearchDeadline`` is created per search request (node.search) and
+threaded down through the coordinator fan-out, the per-shard query
+phase, and the mesh plane ladder. Execution calls ``checkpoint()``
+between units of work (shards, segments, plan/staging steps):
+
+- a cancelled task raises ``TaskCancelledException`` (propagates to the
+  REST layer as a clean error — the ``_tasks/_cancel`` contract);
+- an expired timeout raises ``TimeExceededException``, an INTERNAL
+  control-flow signal callers catch at the nearest partial-result
+  boundary and convert into ``timed_out: true`` with accumulated hits.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class TimeExceededException(Exception):
+    """Internal: the search deadline expired. Never surfaces to a
+    client — the catcher returns partial results with timed_out=true
+    (QueryPhase.TimeExceededException semantics)."""
+
+
+class SearchDeadline:
+    """Deadline + cancellation checkpoints for one search request.
+
+    ``timeout_s``: None = no time bound. ``task``: the registered
+    tasks/task_manager.Task whose cancellation trips the same
+    checkpoints. The object is shared across the request's shards, so
+    ``timed_out`` records whether ANY checkpoint expired (the response's
+    top-level flag).
+    """
+
+    def __init__(self, timeout_s: Optional[float] = None, task=None):
+        self.expires_at = (time.monotonic() + timeout_s
+                           if timeout_s is not None and timeout_s > 0
+                           else None)
+        self.task = task
+        self.timed_out = False
+        self.checkpoints = 0
+
+    @property
+    def expired(self) -> bool:
+        return (self.expires_at is not None
+                and time.monotonic() >= self.expires_at)
+
+    def checkpoint(self) -> None:
+        """Between-units check: raises TaskCancelledException (cancel
+        wins over timeout — the caller asked the work to STOP, not to
+        degrade) or TimeExceededException."""
+        self.checkpoints += 1
+        if self.task is not None:
+            self.task.ensure_not_cancelled()
+        if self.expired:
+            self.timed_out = True
+            raise TimeExceededException()
+
+
+def parse_search_timeout(body: dict, settings=None) -> Optional[float]:
+    """Resolve a request's query-phase timeout in seconds: the `timeout`
+    body/param value ("50ms", "2s", bare millis int) or the node's
+    `search.default_search_timeout`; None = unbounded."""
+    from elasticsearch_tpu.common.units import parse_time_value
+
+    raw = (body or {}).get("timeout")
+    if raw is not None:
+        if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+            return float(raw) / 1000.0  # bare number = millis, like ES
+        return parse_time_value(raw, "timeout")
+    if settings is not None:
+        from elasticsearch_tpu.common.settings import SEARCH_DEFAULT_TIMEOUT
+
+        return SEARCH_DEFAULT_TIMEOUT.get(settings)
+    return None

@@ -69,6 +69,9 @@ class ShardQueryResult:
     profile: Optional[List[dict]] = None
     # set (true/false) only when terminate_after was requested
     terminated_early: Optional[bool] = None
+    # the shard's deadline expired mid-scan: refs/total cover only the
+    # segments finished before the cut (partial, reported timed_out)
+    timed_out: bool = False
 
 
 import logging
@@ -100,8 +103,10 @@ class ShardSearcher:
 
     def __init__(self, shard_id: int, engine, mapper_service,
                  slowlog_warn_s: Optional[float] = None,
-                 slowlog_info_s: Optional[float] = None):
+                 slowlog_info_s: Optional[float] = None,
+                 index_name: str = ""):
         self.shard_id = shard_id
+        self.index_name = index_name
         self.engine = engine
         self.mapper_service = mapper_service
         self.ctx = ShardQueryContext(mapper_service, engine=engine)
@@ -151,12 +156,19 @@ class ShardSearcher:
     # ------------------------------------------------------------------
 
     def query(self, source: dict, size_hint: Optional[int] = None,
-              segments=None) -> ShardQueryResult:
+              segments=None, deadline=None) -> ShardQueryResult:
         """segments: optional explicit segment list (point-in-time views
         pinned by an open scroll context — search/internal/ScrollContext);
-        None searches the engine's current NRT segment set."""
+        None searches the engine's current NRT segment set.
+        deadline: optional SearchDeadline — checkpointed between segments;
+        expiry stops the scan and returns the accumulated partial result
+        with timed_out=True, cancellation raises TaskCancelledException."""
+        from elasticsearch_tpu.testing.disruption import on_shard_search
+
         t0 = time.monotonic()
         self.query_total += 1
+        # query-path fault injection (SearchDelayScheme / SearchFailScheme)
+        on_shard_search(self.index_name, self.shard_id)
         source = source or {}
         self.record_query_groups(source.get("stats"))
         from_ = int(source.get("from", 0) or 0)
@@ -199,8 +211,21 @@ class ShardSearcher:
         agg_specs = parse_aggs(source.get("aggs") or source.get("aggregations"))
         profile_shards = []
 
+        timed_out = False
         for seg in (segments if segments is not None
                     else self.engine.searchable_segments()):
+            if deadline is not None:
+                from elasticsearch_tpu.search.cancellation import (
+                    TimeExceededException,
+                )
+
+                try:
+                    deadline.checkpoint()
+                except TimeExceededException:
+                    # accumulated segments stand; the scan stops here
+                    # (QueryPhase timeout contract: partial + timed_out)
+                    timed_out = True
+                    break
             t_seg = time.monotonic()
             dev = seg.device_arrays()
             node = qb.to_plan(self.ctx, seg)
@@ -308,7 +333,8 @@ class ShardSearcher:
             # accurate while terminated_early is reported.
             terminated_early = True
         result = ShardQueryResult(self.shard_id, total, refs, max_score, agg_views,
-                                  terminated_early=terminated_early)
+                                  terminated_early=terminated_early,
+                                  timed_out=timed_out)
         if profile:
             result.profile = profile_shards
         took = time.monotonic() - t0
@@ -893,6 +919,40 @@ def normalize_sort(sort_body) -> Optional[List[Tuple[str, str, Any]]]:
     if len(out) == 1 and out[0][0] == "_score":
         return None  # plain relevance
     return out
+
+
+def allow_partial_results(body: dict) -> bool:
+    """Request-level allow_partial_search_results. The coordinator
+    injects the node default (`search.default_allow_partial_results`)
+    when the request leaves it unset; bare shard-level callers default
+    to the reference's true."""
+    v = (body or {}).get("allow_partial_search_results")
+    if v is None:
+        return True
+    if isinstance(v, str):
+        return v.lower() != "false"
+    return bool(v)
+
+
+def shard_failure_entry(index: str, shard_id, exc: Exception,
+                        node: Optional[str] = None) -> dict:
+    """One failures[] entry (ShardSearchFailure.toXContent shape): the
+    per-shard exception serialized with its type + reason so a partial
+    response still explains WHICH shard failed and why."""
+    from elasticsearch_tpu.common.errors import (
+        ElasticsearchTpuException,
+        es_type_name,
+    )
+
+    if isinstance(exc, ElasticsearchTpuException):
+        reason = {"type": exc.error_type, "reason": exc.reason}
+    else:
+        reason = {"type": es_type_name(type(exc).__name__),
+                  "reason": str(exc)}
+    entry = {"shard": shard_id, "index": index, "reason": reason}
+    if node is not None:
+        entry["node"] = node
+    return entry
 
 
 def merge_refs(refs: List[DocRef], sort_spec, k: int) -> List[DocRef]:

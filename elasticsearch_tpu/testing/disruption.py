@@ -181,6 +181,129 @@ class UnresponsiveNode(DisruptionScheme):
             f"(injected)")
 
 
+# ---------------------------------------------------------------------------
+# Shard-search disruption (query-path fault injection)
+# ---------------------------------------------------------------------------
+#
+# Transport schemes above disrupt DELIVERIES between nodes; these disrupt
+# the shard-local query phase itself (the reference's
+# SearchService-level fault injection via MockSearchService /
+# ShardSearchFailure tests). They install into a process-global registry
+# consulted by ``ShardSearcher.query`` and the mesh plane ladder, so the
+# single-node path — where shard execution is a method call, not an RPC —
+# is injectable too.
+
+_SEARCH_SCHEMES: list = []
+
+
+class ShardSearchScheme:
+    """Base for query-path schemes. ``indices``/``shards`` filter which
+    (index, shard) executions the scheme touches (None = any)."""
+
+    def __init__(self, indices: Optional[Iterable[str]] = None,
+                 shards: Optional[Iterable[int]] = None):
+        self.indices = set(indices) if indices else None
+        self.shards = set(shards) if shards is not None else None
+        self.hits = 0
+
+    def install(self) -> "ShardSearchScheme":
+        _SEARCH_SCHEMES.append(self)
+        return self
+
+    def remove(self) -> None:
+        if self in _SEARCH_SCHEMES:
+            _SEARCH_SCHEMES.remove(self)
+
+    def applies(self, index: str, shard_id) -> bool:
+        if self.indices is not None and index not in self.indices:
+            return False
+        if self.shards is not None and shard_id not in self.shards:
+            return False
+        return True
+
+    def on_search(self, index: str, shard_id: int) -> None:
+        """Effect hook for the per-shard query phase."""
+
+    def on_plane(self, index: str, plane: str) -> None:
+        """Effect hook for a mesh execution plane (mesh_pallas / mesh)."""
+
+
+def clear_search_disruptions() -> None:
+    del _SEARCH_SCHEMES[:]
+
+
+def on_shard_search(index: str, shard_id: int) -> None:
+    """Called by ShardSearcher.query before segment execution; runs every
+    installed matching scheme in installation order."""
+    if not _SEARCH_SCHEMES:
+        return
+    for scheme in list(_SEARCH_SCHEMES):
+        if scheme.applies(index, shard_id):
+            scheme.on_search(index, shard_id)
+
+
+def on_plane_execute(index: str, plane: str) -> None:
+    """Called by the mesh plane ladder right before executing on a plane
+    (``plane`` in {"mesh_pallas", "mesh"}) — an injected raise here is
+    indistinguishable from a compile/runtime fault on that plane."""
+    if not _SEARCH_SCHEMES:
+        return
+    for scheme in list(_SEARCH_SCHEMES):
+        # shard filters don't apply: the mesh plane executes ALL shards
+        # as one program
+        if scheme.indices is None or index in scheme.indices:
+            scheme.on_plane(index, plane)
+
+
+class SearchDelayScheme(ShardSearchScheme):
+    """Every matching shard search stalls ``seconds`` before executing —
+    the straggler-shard generator for timeout/cancellation tests (the
+    `timeout=50ms` acceptance path)."""
+
+    def __init__(self, seconds: float, **filters):
+        super().__init__(**filters)
+        self.seconds = float(seconds)
+
+    def on_search(self, index, shard_id) -> None:
+        import time
+
+        self.hits += 1
+        time.sleep(self.seconds)
+
+
+class SearchFailScheme(ShardSearchScheme):
+    """Every matching shard search raises (a per-shard query-phase
+    exception — must become a failures[] entry + _shards.failed, never a
+    500, unless allow_partial_search_results=false)."""
+
+    def __init__(self, exception: Optional[Exception] = None, **filters):
+        super().__init__(**filters)
+        self.exception = exception
+
+    def on_search(self, index, shard_id) -> None:
+        self.hits += 1
+        if self.exception is not None:
+            raise self.exception
+        raise RuntimeError(
+            f"[{index}][{shard_id}] query phase failed (injected)")
+
+
+class PlaneFailScheme(ShardSearchScheme):
+    """An execution plane of the mesh ladder raises on use: the analog of
+    a Pallas compile failure / device OOM. ``planes``: which rungs fault
+    ("mesh_pallas", "mesh"). Drives the plane-health quarantine."""
+
+    def __init__(self, planes: Sequence[str] = ("mesh_pallas",), **filters):
+        super().__init__(**filters)
+        self.planes = set(planes)
+
+    def on_plane(self, index, plane) -> None:
+        if plane in self.planes:
+            self.hits += 1
+            raise RuntimeError(
+                f"[{index}] plane [{plane}] fault (injected)")
+
+
 class ActionBlackhole(DisruptionScheme):
     """Requests matching the action patterns vanish: the delivery blocks
     until the caller's deadline (MockTransportService's request

@@ -24,8 +24,12 @@ from elasticsearch_tpu.transport.tcp import TcpTransportHub  # noqa: E402
 def main():
     name = sys.argv[1]
     port = int(sys.argv[2])
+    # optional durable data path: shards persist translog + store there,
+    # so a SIGKILLed worker restarted over the same path recovers every
+    # acked write (crash-recovery tests)
+    data_path = sys.argv[3] if len(sys.argv) > 3 else None
     hub = TcpTransportHub(port=port)
-    node = ClusterNode(name, hub)
+    node = ClusterNode(name, hub, data_path=data_path)
     client = ClusterClient(node)
     out = sys.stdout
 
@@ -68,6 +72,11 @@ def main():
                 reply({"ok": True,
                        "result": client.search(cmd["index"],
                                                cmd.get("body"))})
+            elif op == "seq_stats":
+                stats = {
+                    f"{idx}:{sh}": shard.seq_no_stats()
+                    for (idx, sh), shard in node.shards.items()}
+                reply({"ok": True, "result": stats})
             elif op == "check_nodes":
                 reply({"ok": True, "departed": node.check_nodes()})
             elif op == "check_master":

@@ -1,0 +1,484 @@
+"""Query-path fault tolerance: shard-failure isolation, partial results,
+timeouts, cancellation, and plane-health quarantine.
+
+Mirrors the reference's SearchWithFailuresIT / SearchTimeoutIT /
+SearchCancellationIT suites (server/src/test/.../search/), driven here by
+the shard-search disruption schemes in testing/disruption.py — the
+query-path analog of the transport schemes PR 2 introduced.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    SearchPhaseExecutionException,
+    TaskCancelledException,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.testing.disruption import (
+    PlaneFailScheme,
+    SearchDelayScheme,
+    SearchFailScheme,
+    clear_search_disruptions,
+)
+
+MAPPING = {"properties": {
+    "body": {"type": "text", "analyzer": "whitespace"},
+    "n": {"type": "integer"},
+}}
+
+
+@pytest.fixture(autouse=True)
+def _clean_schemes():
+    yield
+    clear_search_disruptions()
+
+
+def make_index(name, shards=3, mesh=False, extra=None):
+    settings = {"index.number_of_shards": shards,
+                "index.search.mesh": mesh,
+                "index.refresh_interval": -1}
+    settings.update(extra or {})
+    idx = IndexService(name, Settings(settings), mapping=MAPPING)
+    for d in range(30):
+        idx.index_doc(str(d), {"body": f"w{d % 5} w1", "n": d})
+    idx.refresh()
+    return idx
+
+
+@pytest.fixture()
+def idx():
+    svc = make_index("ftol")
+    yield svc
+    svc.close()
+
+
+class TestShardFailureIsolation:
+    """Tentpole (1): an exception in one shard yields a failures[] entry
+    and _shards.failed >= 1 instead of a 500."""
+
+    def test_one_failed_shard_degrades_to_partial(self, idx):
+        baseline = idx.search({"query": {"match": {"body": "w1"}},
+                               "size": 30})
+        assert baseline["_shards"]["failed"] == 0
+        fail = SearchFailScheme(indices=["ftol"], shards=[1]).install()
+        r = idx.search({"query": {"match": {"body": "w1"}}, "size": 30})
+        assert fail.hits == 1
+        assert r["_shards"]["failed"] == 1
+        assert r["_shards"]["successful"] == 2
+        entry = r["_shards"]["failures"][0]
+        assert entry["shard"] == 1 and entry["index"] == "ftol"
+        assert "injected" in entry["reason"]["reason"]
+        # surviving shards' results are intact and correct
+        assert 0 < r["hits"]["total"] < baseline["hits"]["total"]
+        shard1_ids = {str(d) for d in range(30)
+                      if idx._route(str(d)) == 1}
+        got_ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert not got_ids & shard1_ids
+        assert got_ids == {h["_id"] for h in baseline["hits"]["hits"]
+                           if h["_id"] not in shard1_ids}
+
+    def test_typed_failure_reason(self, idx):
+        from elasticsearch_tpu.common.errors import (
+            QueryPhaseExecutionException,
+        )
+
+        SearchFailScheme(QueryPhaseExecutionException("shard blew up"),
+                         indices=["ftol"], shards=[0]).install()
+        r = idx.search({"query": {"match_all": {}}})
+        reason = r["_shards"]["failures"][0]["reason"]
+        assert reason["type"] == "query_phase_execution_exception"
+        assert reason["reason"] == "shard blew up"
+
+    def test_allow_partial_false_raises(self, idx):
+        SearchFailScheme(indices=["ftol"], shards=[1]).install()
+        with pytest.raises(SearchPhaseExecutionException) as ei:
+            idx.search({"query": {"match_all": {}},
+                        "allow_partial_search_results": False})
+        failed = ei.value.to_dict()["error"]["failed_shards"]
+        assert [f["shard"] for f in failed] == [1]
+
+    def test_all_shards_failed_raises(self, idx):
+        SearchFailScheme(indices=["ftol"]).install()
+        with pytest.raises(SearchPhaseExecutionException) as ei:
+            idx.search({"query": {"match_all": {}}})
+        assert "all shards failed" in ei.value.reason
+
+    def test_failed_response_not_cached(self, idx):
+        # size=0 responses are request-cache eligible; a partial response
+        # must not be served to later callers
+        body = {"query": {"match": {"body": "w1"}}, "size": 0}
+        fail = SearchFailScheme(indices=["ftol"], shards=[1]).install()
+        r1 = idx.search(dict(body))
+        assert r1["_shards"]["failed"] == 1
+        fail.remove()
+        r2 = idx.search(dict(body))
+        assert r2["_shards"]["failed"] == 0
+
+
+class TestSearchViaNodeAndRest:
+    @pytest.fixture()
+    def node(self):
+        from elasticsearch_tpu.node import Node
+
+        n = Node(Settings({"node.name": "ft-node"}))
+        n.create_index("ftr", {
+            "settings": {"index": {"number_of_shards": 3,
+                                   "search": {"mesh": False},
+                                   "refresh_interval": -1}},
+            "mappings": MAPPING,
+        })
+        for d in range(30):
+            n.index_doc("ftr", str(d), {"body": f"w{d % 5} w1", "n": d})
+        n.indices["ftr"].refresh()
+        yield n
+        n.close()
+
+    def test_rest_partial_is_200_with_failed_shards(self, node):
+        from elasticsearch_tpu.rest.controller import RestController
+
+        rc = RestController(node)
+        SearchFailScheme(indices=["ftr"], shards=[2]).install()
+        status, payload = rc.dispatch(
+            "GET", "/ftr/_search", {}, b'{"query": {"match_all": {}}}')
+        assert status == 200
+        assert payload["_shards"]["failed"] == 1
+        assert payload["_shards"]["failures"][0]["shard"] == 2
+
+    def test_rest_allow_partial_false_param(self, node):
+        from elasticsearch_tpu.rest.controller import RestController
+
+        rc = RestController(node)
+        SearchFailScheme(indices=["ftr"], shards=[2]).install()
+        status, payload = rc.dispatch(
+            "GET", "/ftr/_search",
+            {"allow_partial_search_results": "false"},
+            b'{"query": {"match_all": {}}}')
+        assert status == 500
+        assert (payload["error"]["type"]
+                == "search_phase_execution_exception")
+
+    def test_default_allow_partial_setting(self):
+        from elasticsearch_tpu.node import Node
+
+        n = Node(Settings({"search.default_allow_partial_results": False}))
+        n.create_index("strict", {
+            "settings": {"index": {"number_of_shards": 2,
+                                   "search": {"mesh": False},
+                                   "refresh_interval": -1}}})
+        n.index_doc("strict", "1", {"body": "x"})
+        n.indices["strict"].refresh()
+        SearchFailScheme(indices=["strict"], shards=[0]).install()
+        with pytest.raises(SearchPhaseExecutionException):
+            n.search("strict", {"query": {"match_all": {}}})
+        n.close()
+
+    def test_multi_index_fanout_isolates_failures(self, node):
+        node.create_index("ftr2", {
+            "settings": {"index": {"number_of_shards": 2,
+                                   "search": {"mesh": False},
+                                   "refresh_interval": -1}},
+            "mappings": MAPPING,
+        })
+        for d in range(10):
+            node.index_doc("ftr2", f"b{d}", {"body": "w1"})
+        node.indices["ftr2"].refresh()
+        SearchFailScheme(indices=["ftr2"], shards=[0]).install()
+        r = node.search("ftr,ftr2", {"query": {"match": {"body": "w1"}},
+                                     "size": 50})
+        assert r["_shards"]["total"] == 5
+        assert r["_shards"]["failed"] == 1
+        assert r["_shards"]["failures"][0]["index"] == "ftr2"
+        # ftr's 30 hits all present; ftr2 degraded to its surviving shard
+        assert sum(h["_index"] == "ftr" for h in r["hits"]["hits"]) == 30
+
+
+class TestTimeout:
+    """Tentpole (2a): the `timeout` request param bounds the query phase;
+    expiry returns accumulated hits with timed_out: true."""
+
+    def test_timeout_returns_partial_with_flag(self, idx):
+        # shard 0 completes; the straggler trips the deadline at its next
+        # checkpoint; remaining shards are skipped
+        SearchDelayScheme(0.3, indices=["ftol"], shards=[1]).install()
+        t0 = time.monotonic()
+        r = idx.search({"query": {"match": {"body": "w1"}}, "size": 30,
+                        "timeout": "50ms"})
+        took = time.monotonic() - t0
+        assert r["timed_out"] is True
+        assert r["_shards"]["failed"] == 0
+        # shard 0's accumulated hits survive the cut
+        shard0_ids = {str(d) for d in range(30) if idx._route(str(d)) == 0}
+        assert {h["_id"] for h in r["hits"]["hits"]} >= shard0_ids
+        # within ~2 checkpoints of the deadline: one 0.3s stall, not 2x
+        assert took < 0.9, took
+
+    def test_no_timeout_by_default(self, idx):
+        SearchDelayScheme(0.05, indices=["ftol"]).install()
+        r = idx.search({"query": {"match": {"body": "w1"}}, "size": 30})
+        assert r["timed_out"] is False
+        assert r["hits"]["total"] == 30
+
+    def test_timeout_with_partial_disallowed_raises(self, idx):
+        SearchDelayScheme(0.2, indices=["ftol"], shards=[0]).install()
+        with pytest.raises(SearchPhaseExecutionException) as ei:
+            idx.search({"query": {"match_all": {}}, "timeout": "20ms",
+                        "allow_partial_search_results": False})
+        assert "timed out" in ei.value.reason
+
+    def test_default_search_timeout_setting(self):
+        from elasticsearch_tpu.node import Node
+
+        n = Node(Settings({"search.default_search_timeout": "30ms"}))
+        n.create_index("deft", {
+            "settings": {"index": {"number_of_shards": 2,
+                                   "search": {"mesh": False},
+                                   "refresh_interval": -1}}})
+        for d in range(8):
+            n.index_doc("deft", str(d), {"body": "w1"})
+        n.indices["deft"].refresh()
+        SearchDelayScheme(0.15, indices=["deft"]).install()
+        r = n.search("deft", {"query": {"match_all": {}}})
+        assert r["timed_out"] is True
+        n.close()
+
+
+class TestCancellation:
+    """Tentpole (2b): _tasks registration + _tasks/{id}/_cancel trips the
+    same checkpoints as the timeout."""
+
+    @pytest.fixture()
+    def node(self):
+        from elasticsearch_tpu.node import Node
+
+        n = Node(Settings({"node.name": "cx-node"}))
+        n.create_index("cx", {
+            "settings": {"index": {"number_of_shards": 3,
+                                   "search": {"mesh": False},
+                                   "refresh_interval": -1}},
+            "mappings": MAPPING,
+        })
+        for d in range(30):
+            n.index_doc("cx", str(d), {"body": f"w{d % 5} w1"})
+        n.indices["cx"].refresh()
+        yield n
+        n.close()
+
+    def _start_search(self, node, errs, done):
+        def run():
+            try:
+                done.append(node.search("cx",
+                                        {"query": {"match": {"body": "w1"}}}))
+            except Exception as e:  # noqa: BLE001 — collected for asserts
+                errs.append(e)
+        t = threading.Thread(target=run)
+        t.start()
+        return t
+
+    def _wait_for_task(self, node, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            tasks = node.tasks.list_tasks(actions="*search*")
+            entries = tasks["nodes"][node.node_id]["tasks"]
+            if entries:
+                return next(iter(entries))
+            time.sleep(0.005)
+        raise AssertionError("search task never appeared in _tasks")
+
+    def test_running_search_listed_and_cancellable(self, node):
+        SearchDelayScheme(0.15, indices=["cx"]).install()
+        errs, done = [], []
+        t = self._start_search(node, errs, done)
+        task_id = self._wait_for_task(node)
+        listed = node.tasks.list_tasks(actions="*search*")
+        entry = listed["nodes"][node.node_id]["tasks"][task_id]
+        assert entry["action"] == "indices:data/read/search"
+        assert entry["cancellable"] is True
+        node.tasks.cancel(task_id, "test cancel")
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert done == [], "cancelled search returned a response"
+        assert isinstance(errs[0], TaskCancelledException)
+        assert "test cancel" in errs[0].reason
+        # the finished task is unregistered
+        assert not node.tasks.list_tasks(
+            actions="*search*")["nodes"][node.node_id]["tasks"]
+
+    def test_cancel_via_rest(self, node):
+        from elasticsearch_tpu.rest.controller import RestController
+
+        rc = RestController(node)
+        SearchDelayScheme(0.15, indices=["cx"]).install()
+        errs, done = [], []
+        t = self._start_search(node, errs, done)
+        task_id = self._wait_for_task(node)
+        status, payload = rc.dispatch(
+            "POST", f"/_tasks/{task_id}/_cancel", {}, b"")
+        assert status == 200
+        assert task_id in payload["nodes"][node.node_id]["tasks"]
+        t.join(timeout=10)
+        assert isinstance(errs[0], TaskCancelledException)
+        # the cancellation error serializes cleanly for REST callers
+        assert errs[0].to_dict()["error"]["type"] == "task_cancelled_exception"
+
+    def test_uncancelled_search_unaffected(self, node):
+        r = node.search("cx", {"query": {"match": {"body": "w1"}},
+                               "size": 30})
+        assert r["hits"]["total"] == 30
+        assert r["timed_out"] is False
+
+
+class TestPlaneQuarantine:
+    """Tentpole (3): a plane fault (compile error / OOM / injected)
+    quarantines the plane for the cooldown, serves from the next rung,
+    and probes recovery after the cooldown; counters export in _stats."""
+
+    def _mk(self, name, cooldown="1500ms"):
+        idx = make_index(name, shards=3, mesh=True, extra={
+            "index.search.plane_quarantine.cooldown": cooldown})
+        # pre-warm the host fallback compile so the post-fault assertions
+        # don't race the cooldown window
+        idx.search({"query": {"match": {"body": "w1"}}, "size": 5,
+                    "profile": True})
+        return idx
+
+    def test_mesh_fault_quarantines_then_recovers(self):
+        idx = self._mk("pqmesh")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        assert idx.search(dict(body))["_plane"] == "mesh"
+        scheme = PlaneFailScheme(planes=("mesh",),
+                                 indices=["pqmesh"]).install()
+        t_fault = time.monotonic()
+        r = idx.search(dict(body))
+        assert r["_plane"] == "host", "fault must fall to the next rung"
+        assert r["hits"]["total"] == 30
+        planes = idx.stats()["total"]["search"]["planes"]
+        assert planes["plane_failures_total"]["mesh"] == 1
+        assert planes["plane_quarantined"] == ["mesh"]
+        scheme.remove()
+        # still benched inside the cooldown
+        r = idx.search(dict(body, size=6))
+        assert r["_plane"] == "host"
+        assert idx.stats()["total"]["search"]["planes"][
+            "plane_failures_total"]["mesh"] == 1, "no re-paid failure"
+        time.sleep(max(0.0, t_fault + 1.6 - time.monotonic()))
+        r = idx.search(dict(body, size=7))
+        assert r["_plane"] == "mesh", "plane must recover after cooldown"
+        assert idx.stats()["total"]["search"]["planes"][
+            "plane_quarantined"] == []
+        idx.close()
+
+    def test_pallas_fault_serves_from_mesh_rung(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = self._mk("pqpal")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        PlaneFailScheme(planes=("mesh_pallas",),
+                        indices=["pqpal"]).install()
+        r = idx.search(dict(body))
+        # same query, same ladder walk: the scatter mesh serves it
+        assert r["_plane"] == "mesh"
+        assert r["hits"]["total"] == 30
+        planes = idx.stats()["total"]["search"]["planes"]
+        assert planes["plane_failures_total"]["mesh_pallas"] == 1
+        assert planes["plane_quarantined"] == ["mesh_pallas"]
+        idx.close()
+
+    def test_pallas_pref_quarantine_skips_scatter(self, monkeypatch):
+        # index.search.mesh.plane=pallas pins "kernel or host": a
+        # quarantined kernel must fall to the HOST rung, never to the
+        # scatter mesh the operator excluded
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("pqpin", shards=3, mesh=True, extra={
+            "index.search.mesh.plane": "pallas",
+            "index.search.plane_quarantine.cooldown": "60s"})
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        PlaneFailScheme(planes=("mesh_pallas",),
+                        indices=["pqpin"]).install()
+        r = idx.search(dict(body))
+        assert r["_plane"] == "host", r["_plane"]
+        assert r["hits"]["total"] == 30
+        clear_search_disruptions()
+        r = idx.search(dict(body, size=6))  # still benched: host again
+        assert r["_plane"] == "host"
+        idx.close()
+
+    def test_pallas_recovers_after_cooldown(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = self._mk("pqpal2", cooldown="300ms")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        idx.search(dict(body))  # stage + compile both mesh planes
+        scheme = PlaneFailScheme(planes=("mesh_pallas",),
+                                 indices=["pqpal2"]).install()
+        t_fault = time.monotonic()
+        assert idx.search(dict(body))["_plane"] == "mesh"
+        scheme.remove()
+        time.sleep(max(0.0, t_fault + 0.4 - time.monotonic()))
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        idx.close()
+
+
+class TestMultinodeFanout:
+    """Tentpole (1b): the clustered scatter-gather isolates per-shard
+    query failures the same way (failures[] + partial, failover across
+    copies first)."""
+
+    def test_remote_shard_failure_degrades(self):
+        from elasticsearch_tpu.cluster.multinode import (
+            ClusterClient,
+            ClusterNode,
+        )
+        from elasticsearch_tpu.transport.local import TransportHub
+
+        hub = TransportHub()
+        nodes = [ClusterNode(f"node-{i}", hub) for i in range(2)]
+        nodes[0].bootstrap_cluster()
+        nodes[1].join("node-0")
+        client = ClusterClient(nodes[0])
+        nodes[0].create_index("mn", {"index": {"number_of_shards": 2,
+                                               "number_of_replicas": 0}})
+        for i in range(20):
+            client.index("mn", str(i), {"n": i})
+        client.refresh("mn")
+        baseline = client.search("mn", {"size": 20})
+        assert baseline["_shards"]["failed"] == 0
+        SearchFailScheme(indices=["mn"], shards=[0]).install()
+        r = client.search("mn", {"size": 20})
+        assert r["_shards"]["failed"] == 1
+        assert r["_shards"]["failures"][0]["shard"] == 0
+        assert 0 < r["hits"]["total"] < baseline["hits"]["total"]
+        with pytest.raises(SearchPhaseExecutionException):
+            client.search("mn", {"size": 20,
+                                 "allow_partial_search_results": False})
+        for n in nodes:
+            n.close()
+
+    def test_default_allow_partial_setting_applies(self):
+        from elasticsearch_tpu.cluster.multinode import (
+            ClusterClient,
+            ClusterNode,
+        )
+        from elasticsearch_tpu.transport.local import TransportHub
+
+        hub = TransportHub()
+        node = ClusterNode("node-0", hub, settings=Settings(
+            {"search.default_allow_partial_results": False}))
+        node.bootstrap_cluster()
+        client = ClusterClient(node)
+        node.create_index("mns", {"index": {"number_of_shards": 2,
+                                            "number_of_replicas": 0}})
+        for i in range(8):
+            client.index("mns", str(i), {"n": i})
+        client.refresh("mns")
+        SearchFailScheme(indices=["mns"], shards=[0]).install()
+        with pytest.raises(SearchPhaseExecutionException):
+            client.search("mns", {"size": 20})
+        # an explicit request-level true overrides the strict default
+        r = client.search("mns", {"size": 20,
+                                  "allow_partial_search_results": True})
+        assert r["_shards"]["failed"] == 1
+        node.close()
