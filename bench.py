@@ -404,6 +404,16 @@ def run_measurement() -> dict:
     if kernel_metrics is not None:
         extra_configs = run_extra_configs(
             jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax, cb_run, rng)
+        # cross-query micro-batching sweep (ISSUE 5 acceptance config)
+        try:
+            extra_configs["batched_qps"] = run_batched_qps_config(
+                jax, jnp, psc, corpus, dev, geom, frac, bmin, bmax)
+        except Exception as e:  # noqa: BLE001 — recorded, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            extra_configs["batched_qps"] = {
+                "error": f"{type(e).__name__}: {e}"}
         # the mesh-path config: distributed scoring on the tile kernel
         # (acceptance: within 2x of the single-chip pallas p50)
         try:
@@ -553,6 +563,16 @@ def run_measurement() -> dict:
             # second independent estimate bounds run-to-run dispersion
             "p50_second_estimate_ms": round(p50_2, 3),
             "qps_per_chip": round(1000.0 / p50, 1),
+            # cross-query micro-batching headline (q_batch=8 sweep point;
+            # the full sweep is configs.batched_qps)
+            "qps_per_chip_batched": (
+                (extra_configs or {}).get("batched_qps", {})
+                .get("q_batch_8", {}).get("qps_per_chip_batched")
+                if isinstance(extra_configs, dict) else None),
+            "bytes_per_query_mb_batched": (
+                (extra_configs or {}).get("batched_qps", {})
+                .get("q_batch_8", {}).get("bytes_per_query_mb_batched")
+                if isinstance(extra_configs, dict) else None),
             "cpu_numpy_p50_ms": round(cpu_p50, 3),
             "legacy_scatter_p50_ms": (round(legacy_p50, 3)
                                       if legacy_p50 else None),
@@ -764,6 +784,145 @@ def run_extra_configs(jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax,
     except Exception as e:  # noqa: BLE001
         out["rescore_top1000"] = {"error": f"{type(e).__name__}: {e}"}
 
+    return out
+
+
+def run_batched_qps_config(jax, jnp, psc, corpus, dev, geom, frac,
+                           bmin, bmax):
+    """Cross-query micro-batching sweep (ISSUE 5): q_batch in {1,4,8,16}
+    on the 1M-doc corpus, one batched ``score_tiles`` launch per batch
+    over UNION tables + the per-query fused top-k, every member
+    recall-gated against the numpy oracle.
+
+    Query mix: 3 terms per query drawn ZIPFIAN from a 1000-term hot
+    query vocabulary — the production property the batching exploits
+    (concurrent queries share hot terms, so the union lane count grows
+    sublinearly in Q and the shared posting-window DMA amortizes). The
+    estimator is the min-of-3 marginal method of estimator_note (the
+    r05 rescore_top1000 one-sided-spread fix applies here too: these
+    numbers gate an acceptance criterion and must not be
+    ramp-down-noise-dominated)."""
+    import numpy as np
+
+    rng = np.random.RandomState(11)
+    # zipf over a hot query vocabulary (rank 50..1049 of the corpus
+    # zipf, i.e. realistic mid-frequency search terms)
+    qvocab = np.arange(50, 1050)
+    ranks = np.arange(1, len(qvocab) + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / (1.0 / ranks).sum()
+
+    def draw_query():
+        return list(np.unique(rng.choice(qvocab, 3, p=probs)))
+
+    def lanes_for(terms):
+        return [psc.QueryLane(int(corpus["term_block_start"][t]),
+                              int(corpus["n_blocks_per_term"][t]),
+                              idf(int(corpus["term_df"][t])))
+                for t in terms]
+
+    def time_min3(fn):
+        """min-of-3 marginal estimate after a sustained re-warm (see
+        estimator_note: marginal noise is one-sided)."""
+        for _ in range(2):
+            fn()
+        o = None
+        for _ in range(200):
+            o = fn()
+        np.asarray(o[0])
+        ests = sorted(measure_marginal(lambda _q: fn(), [None])
+                      for _ in range(3))
+        return ests[0] * 1000, (ests[-1] - ests[0]) * 1000
+
+    out = {"query_mix": ("3 zipfian terms per query from a 1000-term "
+                         "hot vocabulary; batches drawn independently")}
+    nd_pad = corpus["nd_pad"]
+    base_qps = None
+    for q_batch in (1, 4, 8, 16):
+        n_batches = 8
+        batches = [[draw_query() for _ in range(q_batch)]
+                   for _ in range(n_batches)]
+        staged, t_pad_run, cb_run = [], 8, 8
+        tables = []
+        for batch in batches:
+            rl, rh, w, cbr = psc.build_tile_tables_batched(
+                [lanes_for(ts) for ts in batch], bmin, bmax, geom)
+            tables.append((rl, rh, w))
+            t_pad_run = max(t_pad_run, rl.shape[1])
+            cb_run = max(cb_run, cbr)
+        # one shape bucket per q_batch: pad every batch's tables to the
+        # run-wide (t_pad, cb) so the sweep compiles once per Q
+        for rl, rh, w in tables:
+            if rl.shape[1] < t_pad_run:
+                pad = t_pad_run - rl.shape[1]
+                rl = np.pad(rl, ((0, 0), (0, pad)))
+                rh = np.pad(rh, ((0, 0), (0, pad)))
+                w = np.pad(w, ((0, 0), (0, pad)))
+            staged.append((jnp.asarray(rl), jnp.asarray(rh),
+                           jnp.asarray(w)))
+
+        @jax.jit
+        def _batched_fused(docs, frac_d, live_t, rl, rh, w,
+                           t_pad=t_pad_run, cb=cb_run, qb=q_batch):
+            ts_, td_, th_ = psc.score_tiles(
+                docs, frac_d, live_t, rl, rh, w,
+                t_pad=t_pad, cb=cb, sub=geom.tile_sub, k=K, q_batch=qb)
+            return psc.merge_tile_topk_batched(ts_, td_, th_, K)
+
+        cycle = {"i": 0}
+
+        def run_batch():
+            q = staged[cycle["i"] % len(staged)]
+            cycle["i"] += 1
+            return _batched_fused(dev["docs"], dev["frac"], dev["live_t"],
+                                  *q)
+
+        # recall gate: EVERY member of the first batch vs the numpy
+        # oracle (acceptance requires 1.0 across the batch)
+        top_s, top_d, _hits = run_batch()
+        top_s = np.asarray(top_s)
+        top_d = np.asarray(top_d)
+        recall_min = 1.0
+        for q, terms in enumerate(batches[0]):
+            ref = psc.reference_scores(
+                corpus["block_docs"], frac, lanes_for(terms), nd_pad)
+            ref = np.where(corpus["live1"][:nd_pad], ref[:nd_pad], 0.0)
+            expect_i = np.argpartition(-ref, K)[:K]
+            expect_i = expect_i[np.argsort(-ref[expect_i])]
+            np.testing.assert_allclose(
+                top_s[q], ref[expect_i], rtol=1e-3)
+            recall = len(set(top_d[q].tolist())
+                         & set(expect_i.tolist())) / K
+            recall_min = min(recall_min, recall)
+        cycle["i"] = 0
+        p50_launch, spread = time_min3(run_batch)
+        per_query = p50_launch / q_batch
+        qps = q_batch * 1000.0 / p50_launch
+        # HBM traffic per launch: the union posting windows (shared by
+        # the whole batch) + live mask + per-query top-k outputs
+        launch_bytes = (
+            geom.n_tiles * t_pad_run * (2 * cb_run) * BLOCK * (4 + 4)
+            + geom.n_tiles * geom.tile_w * 4
+            + geom.n_tiles * q_batch * (2 * K + 1) * 4
+        )
+        entry = {
+            "p50_ms_per_launch": round(p50_launch, 3),
+            "p50_spread_ms": round(spread, 3),
+            "p50_ms_per_query": round(per_query, 4),
+            "qps_per_chip_batched": round(qps, 1),
+            "union_t_pad": t_pad_run,
+            "cb": cb_run,
+            "bytes_per_query_mb_batched": round(
+                launch_bytes / q_batch / 1e6, 2),
+            "recall_at_10": recall_min,
+        }
+        if q_batch == 1:
+            base_qps = qps
+        elif base_qps:
+            entry["qps_speedup_vs_q1"] = round(qps / base_qps, 2)
+        out[f"q_batch_{q_batch}"] = entry
+        log(f"batched_qps q={q_batch}: {p50_launch:.3f} ms/launch "
+            f"({per_query:.3f} ms/query, {qps:.0f} qps, "
+            f"t_pad={t_pad_run}, recall={recall_min})")
     return out
 
 

@@ -470,6 +470,26 @@ def _validate_tiles_per_step(v):
             f"[search.pallas.tiles_per_step]: must be one of 1, 2, 4, 8")
 
 
+# --- cross-query micro-batching (search/batching.py; docs/BATCHING.md) ---
+
+SEARCH_BATCH_ENABLED = Setting.bool_setting(
+    # amortize one corpus-stream pass of the Pallas scoring plane across
+    # concurrent compatible queries (mesh_pallas + host-pallas rungs);
+    # false = every query executes unbatched
+    "search.batch.enabled", True, dynamic=True
+)
+SEARCH_BATCH_WINDOW_MS = Setting.float_setting(
+    # how long the first query of a concurrent burst waits for peers
+    # before dispatching (milliseconds). Only paid under concurrency — a
+    # lone query never waits.
+    "search.batch.window_ms", 0.2, min_value=0.0, dynamic=True
+)
+SEARCH_BATCH_MAX_QUERIES = Setting.int_setting(
+    # batch size bound (the kernel's q_batch): per-query VMEM
+    # accumulators and the per-tile top-k loop grow linearly with this
+    "search.batch.max_queries", 16, min_value=1, max_value=64, dynamic=True
+)
+
 SEARCH_PALLAS_TILES_PER_STEP = Setting(
     # TPU-specific DMA buffering toggle: tiles folded into one grid step
     # of the tile-scoring kernel (ops/pallas_scoring.py) so their posting-
@@ -513,6 +533,9 @@ NODE_SETTINGS = [
     RECOVERY_RETRY_DELAY_NETWORK,
     RECOVERY_MAX_RETRIES,
     RECOVERY_ACTION_TIMEOUT,
+    SEARCH_BATCH_ENABLED,
+    SEARCH_BATCH_WINDOW_MS,
+    SEARCH_BATCH_MAX_QUERIES,
     SEARCH_PALLAS_TILES_PER_STEP,
 ]
 

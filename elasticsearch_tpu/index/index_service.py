@@ -98,6 +98,19 @@ class IndexService:
         # (index.search.mesh: true default; false = host merge only)
         self._mesh_search = None
         self._mesh_enabled = settings.get_bool("index.search.mesh", True)
+        # cross-query micro-batching (search/batching.py; docs/BATCHING.md):
+        # concurrent compatible searches share one batched kernel launch on
+        # the mesh_pallas / host-pallas rungs. A query with no concurrency
+        # takes the unbatched path with zero added latency.
+        from elasticsearch_tpu.search.batching import BatchStats, MicroBatcher
+
+        self.batch_stats = BatchStats()
+        self._batcher = MicroBatcher(
+            window_s=settings.get_float("search.batch.window_ms", 0.2)
+            / 1000.0,
+            max_queries=settings.get_int("search.batch.max_queries", 16),
+            enabled=settings.get_bool("search.batch.enabled", True),
+            stats=self.batch_stats)
         # shard request cache (IndicesRequestCache.java:64): size==0
         # (agg/count) responses cached against the shards' visibility
         # epochs; index.requests.cache.enable gates it (default on)
@@ -403,17 +416,45 @@ class IndexService:
                 if cached is not None:
                     cached["took"] = int((time.monotonic() - t0) * 1000)
                     return cached
-        resp = self._search_uncached(body, preference_shards,
+        resp = self._search_dispatch(body, preference_shards,
                                      pinned_segments, deadline=deadline)
         if (cache_key is not None and not resp.get("timed_out")
                 and not resp["_shards"].get("failed")):
             self.request_cache.put(cache_key, resp)
         return resp
 
-    def _search_uncached(self, body: dict,
+    def _search_dispatch(self, body: dict,
                          preference_shards: Optional[List[int]] = None,
                          pinned_segments: Optional[Dict[int, list]] = None,
                          deadline=None) -> dict:
+        """Route the query phase through the cross-query micro-batcher
+        when eligible (search/batching.py): a concurrent burst of
+        compatible queries shares one batched kernel launch; a lone query
+        takes the unbatched path with zero added latency."""
+        from elasticsearch_tpu.search.batching import batchable_body
+
+        if (not self._batcher.enabled or preference_shards is not None
+                or pinned_segments is not None or body.get("scroll")
+                or not batchable_body(body)):
+            return self._search_uncached(body, preference_shards,
+                                         pinned_segments, deadline=deadline)
+        return self._batcher.run(
+            self.name, (body, deadline),
+            single_fn=lambda it: self._search_uncached(
+                it[0], deadline=it[1]),
+            batch_fn=lambda items: self.search_batch(
+                [it[0] for it in items], [it[1] for it in items]))
+
+    def _search_uncached(self, body: dict,
+                         preference_shards: Optional[List[int]] = None,
+                         pinned_segments: Optional[Dict[int, list]] = None,
+                         deadline=None, score_caches: Optional[dict] = None,
+                         skip_mesh: bool = False) -> dict:
+        """score_caches: {(shard_id, segment_name): (scores, matched)}
+        from a cross-query batched kernel launch (search_batch) — cached
+        segments skip plan execution inside ShardSearcher.query.
+        skip_mesh: the query already went through the batch's plane
+        ladder; don't re-probe the mesh plane per member."""
         from elasticsearch_tpu.search.cancellation import (
             TimeExceededException,
         )
@@ -436,7 +477,8 @@ class IndexService:
         # merge in-XLA); fallback is the per-shard host merge below.
         # Pinned (scroll) searches stay on the host path: the mesh stages
         # the LIVE segment set.
-        if (self._mesh_enabled and preference_shards is None
+        if (self._mesh_enabled and not skip_mesh
+                and preference_shards is None
                 and pinned_segments is None and not body.get("scroll")):
             try:
                 mesh_resp = self._try_mesh_search(body, k, deadline=deadline)
@@ -479,12 +521,17 @@ class IndexService:
                     deadline.timed_out = True
                 break
             try:
+                shard_cache = None
+                if score_caches:
+                    shard_cache = {
+                        name: pair for (s, name), pair
+                        in score_caches.items() if s == sid}
                 shard_results.append(
                     self.shards[sid].searcher.query(
                         body, size_hint=max(k, 1),
                         segments=(pinned_segments.get(sid, [])
                                   if pinned_segments is not None else None),
-                        deadline=deadline)
+                        deadline=deadline, score_cache=shard_cache)
                 )
             except TaskCancelledException:
                 raise  # _tasks/_cancel: a clean request-level error
@@ -585,6 +632,201 @@ class IndexService:
             )
         return resp
 
+    # ------------------------------------------------------------------
+    # Cross-query micro-batching (search/batching.py; docs/BATCHING.md)
+    # ------------------------------------------------------------------
+
+    def search_batch(self, bodies: List[dict],
+                     deadlines: Optional[list] = None) -> list:
+        """Execute Q concurrent search requests as one micro-batch.
+
+        Returns one entry per member: the response dict, or the
+        exception that member alone should raise (cancellation, request
+        error) — peers are never failed by one member's fate.
+
+        Plane ladder, mirroring the serial path:
+        1. an expired member is served its partial (timed_out) result
+           individually and a cancelled member gets its
+           TaskCancelledException — both are DROPPED from the batch;
+        2. mesh_pallas rung: eligible batches run as ONE batched kernel
+           launch inside the mesh program (IndexMeshSearch.query_batch);
+           a batch-wide plane fault feeds the PlaneHealth quarantine
+           ONCE and the batch falls to the next rung;
+        3. host-pallas rung: one batched launch per segment feeds each
+           member's normal per-query pipeline via score caches;
+        4. members ineligible for any shared launch execute serially.
+        """
+        from elasticsearch_tpu.search.batching import batchable_body
+        from elasticsearch_tpu.search.cancellation import (
+            TimeExceededException,
+        )
+
+        n = len(bodies)
+        deadlines = list(deadlines) if deadlines else [None] * n
+        results: list = [None] * n
+        live: List[int] = []
+        for i, body in enumerate(bodies):
+            dl = deadlines[i]
+            if dl is not None:
+                try:
+                    dl.checkpoint()
+                except TaskCancelledException as e:
+                    # _tasks/_cancel of one member: its own clean error,
+                    # the batch proceeds without it
+                    results[i] = e
+                    continue
+                except TimeExceededException:
+                    # expired before dispatch: serve its accumulated
+                    # (empty) partial result — the serial path hits the
+                    # same checkpoint immediately and reports timed_out
+                    results[i] = self._batch_member_single(body, dl)
+                    continue
+            if not batchable_body(body):
+                results[i] = self._batch_member_single(body, dl)
+                continue
+            live.append(i)
+        if len(live) < 2:
+            for i in live:
+                results[i] = self._batch_member_single(bodies[i],
+                                                       deadlines[i])
+            return results
+
+        live_bodies = [bodies[i] for i in live]
+        # rung 1: batched mesh_pallas launch (one program, Q queries).
+        # A plane fault inside quarantines mesh_pallas exactly once.
+        mesh_out = None
+        if (self._mesh_enabled and len(self.shards) >= 2):
+            if self._mesh_search is None:
+                from elasticsearch_tpu.parallel.plan_exec import (
+                    IndexMeshSearch,
+                )
+
+                self._mesh_search = IndexMeshSearch(self)
+            mesh_out = self._mesh_search.query_batch(live_bodies)
+        if mesh_out is not None:
+            for j, i in enumerate(live):
+                try:
+                    results[i] = self._mesh_batch_response(
+                        bodies[i], mesh_out[j])
+                except Exception as e:  # noqa: BLE001 — per-member fetch
+                    results[i] = e
+            self.batch_stats.note_batch(len(live))
+            return results
+
+        # rung 2: host-pallas batched scoring, then each member's normal
+        # per-query pipeline on top of its cached score vectors
+        caches, launches = self._host_batch_scores(live_bodies)
+        for j, i in enumerate(live):
+            results[i] = self._batch_member_single(
+                bodies[i], deadlines[i], score_caches=caches[j] or None,
+                skip_mesh=bool(caches[j]))
+        # count only the members that actually shared a launch — kernel-
+        # ineligible members executed fully serially and must not inflate
+        # the batching-coverage telemetry
+        shared = sum(1 for c in caches if c)
+        if launches and shared:
+            self.batch_stats.note_batch(shared)
+        return results
+
+    def _batch_member_single(self, body, deadline, score_caches=None,
+                             skip_mesh=False):
+        """One member's serial execution inside a batch: exceptions are
+        captured as that member's result instead of failing its peers."""
+        try:
+            return self._search_uncached(
+                body, deadline=deadline, score_caches=score_caches,
+                skip_mesh=skip_mesh)
+        except Exception as e:  # noqa: BLE001 — per-member isolation
+            return e
+
+    def _host_batch_scores(self, bodies: List[dict]):
+        """Per-segment batched kernel launches for the host rung.
+
+        Returns ([per-member {(shard_id, seg_name): (scores, matched)}],
+        n_launches). A member whose plan on a segment isn't a pure
+        kernel-scored disjunction simply gets no cache entry there and
+        executes that segment serially — per-query semantics are owned
+        by the normal pipeline either way."""
+        from elasticsearch_tpu.search.batching import (
+            batched_segment_scores,
+            counts_safe_for_union,
+        )
+        from elasticsearch_tpu.search.plan import PallasScoreTermsNode
+        from elasticsearch_tpu.search.query_dsl import parse_query
+
+        caches: List[dict] = [dict() for _ in bodies]
+        launches = 0
+        qbs = []
+        for body in bodies:
+            try:
+                qbs.append(parse_query(body.get("query")))
+            except Exception:  # noqa: BLE001 — parse errors surface with
+                # their proper status when the member executes serially
+                qbs.append(None)
+        for sid in sorted(self.shards):
+            shard = self.shards[sid]
+            ctx = shard.searcher.ctx
+            for seg in shard.engine.searchable_segments():
+                if seg.num_docs == 0:
+                    continue
+                plans = []
+                for qb in qbs:
+                    node = None
+                    if qb is not None:
+                        try:
+                            p = qb.to_plan(ctx, seg)
+                            if (isinstance(p, PallasScoreTermsNode)
+                                    and getattr(p, "_host_lanes", None)
+                                    and counts_safe_for_union(p)):
+                                node = p
+                        except Exception:  # noqa: BLE001 — serial path
+                            # owns this member's error shape
+                            node = None
+                    plans.append(node)
+                idxs = [i for i, p in enumerate(plans) if p is not None]
+                if len(idxs) < 2:
+                    continue  # nothing to amortize on this segment
+                try:
+                    outs = batched_segment_scores(
+                        seg, [plans[i] for i in idxs])
+                except Exception:  # noqa: BLE001 — batched launch fault:
+                    # every member still serves serially (and a kernel
+                    # fault on the serial path feeds its own quarantine)
+                    outs = None
+                if outs is None:
+                    continue
+                launches += 1
+                for j, i in enumerate(idxs):
+                    caches[i][(sid, seg.name)] = outs[j]
+        return caches, launches
+
+    def _mesh_batch_response(self, body: dict, out: dict) -> dict:
+        """Assemble one member's full response from its slice of a
+        batched mesh launch (same shape as _try_mesh_search)."""
+        import time as _time
+
+        from elasticsearch_tpu.search.service import fetch_hits
+
+        t0 = _time.monotonic()
+        from_ = int(body.get("from", 0) or 0)
+        size = int(body.get("size")) if body.get("size") is not None else 10
+        refs = out["refs"]
+        refs_window = (refs[from_: from_ + size] if size >= 0
+                       else refs[from_:])
+        hits = fetch_hits(refs_window, self.shards, body, self.name)
+        return {
+            "took": int((_time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            # per-query truth: every member of the batch was scored by
+            # the batched mesh_pallas launch
+            "_plane": out.get("plane", "mesh_pallas"),
+            "_shards": {"total": len(self.shards),
+                        "successful": len(self.shards),
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": out["total"], "max_score": out["max_score"],
+                     "hits": hits},
+        }
+
     def count(self, body: Optional[dict] = None) -> dict:
         body = dict(body or {})
         body["size"] = 0
@@ -651,6 +893,11 @@ class IndexService:
                    {"plane_failures_total": {"mesh_pallas": 0, "mesh": 0},
                     "plane_quarantined": []}),
             },
+            # cross-query micro-batching (docs/BATCHING.md): how much of
+            # the traffic shared batched kernel launches, the dispatched
+            # batch-size distribution, and how often a leader paid the
+            # collection window
+            "batch": self.batch_stats.as_dict(),
         }
         if groups:
             search["groups"] = groups

@@ -67,6 +67,32 @@ class Node:
         # (the env var is process-global — the last-constructed Node wins)
         os.environ["ES_TPU_PALLAS_TPS"] = str(
             int(SEARCH_PALLAS_TILES_PER_STEP.get(settings)))
+        # cross-query micro-batching knobs are DYNAMIC (docs/BATCHING.md):
+        # a cluster-settings update must reach every index's live batcher
+        # (an operator disabling batching mid-incident can't wait for a
+        # restart) — apply_settings fires these on PUT _cluster/settings
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_BATCH_ENABLED,
+            SEARCH_BATCH_MAX_QUERIES,
+            SEARCH_BATCH_WINDOW_MS,
+        )
+
+        def _batchers(apply):
+            def consume(value):
+                for svc in self.indices.values():
+                    apply(svc._batcher, value)
+            return consume
+
+        self.cluster_settings.add_settings_update_consumer(
+            SEARCH_BATCH_ENABLED,
+            _batchers(lambda b, v: setattr(b, "enabled", bool(v))))
+        self.cluster_settings.add_settings_update_consumer(
+            SEARCH_BATCH_WINDOW_MS,
+            _batchers(lambda b, v: setattr(b, "window_s",
+                                           float(v) / 1000.0)))
+        self.cluster_settings.add_settings_update_consumer(
+            SEARCH_BATCH_MAX_QUERIES,
+            _batchers(lambda b, v: setattr(b, "max_queries", int(v))))
         self.data_path = data_path or PATH_DATA.get(settings)
         self.persistent_path = data_path is not None or "path.data" in settings
         # secure settings from the encrypted keystore (KeyStoreWrapper):
@@ -186,6 +212,18 @@ class Node:
                 aliases.setdefault(a, spec or {})
         merged_settings = merged_settings.merged_with(settings)
         _merge_mapping_dicts(merged_mappings, mappings)
+        # node-level micro-batching config (search.batch.* — node scope,
+        # docs/BATCHING.md) seeds each index's batcher at lowest
+        # precedence, with the CURRENT dynamic cluster settings on top:
+        # an index created after PUT _cluster/settings {search.batch.*}
+        # must honor the live value, not the node file's (the update
+        # consumers only reach batchers alive at update time)
+        state = self.cluster_service.state
+        cluster_dynamic = state.persistent_settings.merged_with(
+            state.transient_settings).filtered_by_prefix("search.batch.")
+        merged_settings = self.settings.filtered_by_prefix(
+            "search.batch.").merged_with(cluster_dynamic).merged_with(
+            merged_settings)
 
         self.index_scoped_settings.validate(merged_settings, allow_unknown=True)
         svc = IndexService(name, merged_settings, merged_mappings,

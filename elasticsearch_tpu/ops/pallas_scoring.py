@@ -246,13 +246,76 @@ def build_tile_tables(
     return row_lo, row_hi, weights, cb_req
 
 
+def union_query_lanes(
+    lane_sets: Sequence[Sequence[QueryLane]],
+) -> Tuple[List[QueryLane], np.ndarray]:
+    """Merge Q per-query lane sets into one union lane set plus a
+    per-query weight matrix — the host half of cross-query micro-batching
+    (ISSUE 5): the union's DMA windows are fetched ONCE per tile and a
+    query participates in lane j iff weights[q, j] > 0 (its live-lane
+    mask), so a short query in the batch never scores another query's
+    terms. Lanes are keyed by their posting run (block_start, block_count)
+    — two queries naming the same term share one lane, which is where the
+    bandwidth amortization comes from under zipfian traffic."""
+    union: List[QueryLane] = []
+    index: dict = {}
+    rows: List[dict] = []
+    for lanes in lane_sets:
+        row: dict = {}
+        for lane in lanes:
+            if lane.block_count <= 0 or lane.weight <= 0.0:
+                continue
+            key = (lane.block_start, lane.block_count)
+            j = index.get(key)
+            if j is None:
+                j = len(union)
+                index[key] = j
+                # build coverage with weight 1.0: the union lane is live
+                # whenever ANY member uses it
+                union.append(QueryLane(lane.block_start, lane.block_count,
+                                       1.0))
+            row[j] = row.get(j, 0.0) + float(lane.weight)
+        rows.append(row)
+    t_pad = next_pow2(max(len(union), 1))
+    weights = np.zeros((len(lane_sets), t_pad), dtype=np.float32)
+    for q, row in enumerate(rows):
+        for j, w in row.items():
+            weights[q, j] = w
+    return union, weights
+
+
+def build_tile_tables_batched(
+    lane_sets: Sequence[Sequence[QueryLane]],
+    bmin: np.ndarray,
+    bmax: np.ndarray,
+    geom: TileGeometry,
+    t_pad: Optional[int] = None,
+    cb: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Batched form of build_tile_tables: one shared (row_lo, row_hi)
+    covering the UNION of Q queries' term lanes plus a [Q, t_pad] weight
+    matrix (zero = lane dead for that query). Same geometry-ladder
+    contract as the single-query form: raises ValueError when the union's
+    covering window exceeds the kernel bound at this tile size."""
+    union, weights = union_query_lanes(lane_sets)
+    t_pad = max(t_pad or 0, weights.shape[1])
+    row_lo, row_hi, _w1, cb_req = build_tile_tables(
+        union, bmin, bmax, geom, t_pad=t_pad, cb=cb)
+    if weights.shape[1] < t_pad:
+        weights = np.concatenate(
+            [weights,
+             np.zeros((weights.shape[0], t_pad - weights.shape[1]),
+                      np.float32)], axis=1)
+    return row_lo, row_hi, weights, cb_req
+
+
 # ----------------------------------------------------------------------
 # The kernel
 # ----------------------------------------------------------------------
 
 
 def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
-                 with_counts: bool, tps: int = 1):
+                 with_counts: bool, tps: int = 1, q_batch: int = 1):
     """Kernel body. Mosaic constraints shape the formulation:
 
     - only lane-collapsing reshapes ((cb,128) -> (1, cb*128)) lower; the
@@ -275,6 +338,19 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
     tile i+1's windows while the MXU works tile i, and the fixed per-step
     dispatch cost (which dominates the kernel — see module docstring) is
     paid once per tps tiles.
+
+    ``q_batch`` (cross-query micro-batching, ISSUE 5): the tables cover
+    the UNION of Q concurrent queries' term lanes and ``weights`` is
+    [Q, t_pad]. The per-(tile, lane) posting windows are DMA'd ONCE and
+    the lane's weight-free contribution matrix (one one-hot build + MXU
+    matmul pair) is computed ONCE; each query then folds it in with a
+    single f32 scale-add against its own weight — zero weight is the
+    per-query live-lane mask, so a query never scores lanes it didn't
+    ask for (and its match COUNTS only count its own lanes). Per-query
+    state is a [Q*LANE, sub] scratch accumulator, and the top-k variant
+    emits per-query candidate rows. q_batch == 1 keeps the historical
+    single-query formulation bit-for-bit (weights folded into the
+    one-hot before the matmul), so the unbatched path is untouched.
     """
     w = sub * LANE
     # two consecutive cb-aligned DMA windows per lane; each processes its
@@ -300,10 +376,11 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
             tile = jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti)
             base = tile * jnp.int32(w)
             # scratch accumulators persist across grid steps (and tiles
-            # within a step): reset first
-            acc_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
+            # within a step): reset first (rows [q*LANE, (q+1)*LANE) hold
+            # query q's transposed tile accumulator)
+            acc_ref[...] = jnp.zeros((q_batch * LANE, sub), jnp.float32)
             if with_counts:
-                cnt_ref[...] = jnp.zeros((LANE, sub), jnp.float32)
+                cnt_ref[...] = jnp.zeros((q_batch * LANE, sub), jnp.float32)
             for j in range(t_pad):
                 rlo = rowlo_ref[tile, j]
                 rhi = rowhi_ref[tile, j]
@@ -344,7 +421,8 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
                             safe, jnp.int32(LANE - 1)), jnp.int32(-1))
                         hi_row = hi.reshape(1, rows)
                         lo_row = lo.reshape(1, rows)
-                        wf_row = (frac * wj).reshape(1, rows)
+                        wf_row = ((frac * wj).reshape(1, rows)
+                                  if q_batch == 1 else None)
                         ohT = jnp.where(
                             lax.broadcasted_iota(
                                 jnp.int32, (sub, rows), 0) == hi_row,
@@ -359,77 +437,141 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
                         # bf16-exact).
                         lane_iota = lax.broadcasted_iota(
                             jnp.int32, (LANE, rows), 0)
-                        wf_hi = wf_row.astype(jnp.bfloat16).astype(jnp.float32)
-                        wf_lo = wf_row - wf_hi
-                        lov_hi = jnp.where(lane_iota == lo_row, wf_hi,
-                                           jnp.float32(0.0))
-                        lov_lo = jnp.where(lane_iota == lo_row, wf_lo,
-                                           jnp.float32(0.0))
-                        acc_ref[...] = acc_ref[...] + lax.dot_general(
-                            lov_hi, ohT, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) + lax.dot_general(
-                            lov_lo, ohT, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-                        if with_counts:
-                            lovT1 = jnp.where(lane_iota == lo_row,
-                                              jnp.float32(1.0),
-                                              jnp.float32(0.0))
-                            cnt_ref[...] = cnt_ref[...] + lax.dot_general(
-                                lovT1, ohT, (((1,), (1,)), ((), ())),
+                        if q_batch == 1:
+                            wf_hi = wf_row.astype(jnp.bfloat16).astype(
+                                jnp.float32)
+                            wf_lo = wf_row - wf_hi
+                            lov_hi = jnp.where(lane_iota == lo_row, wf_hi,
+                                               jnp.float32(0.0))
+                            lov_lo = jnp.where(lane_iota == lo_row, wf_lo,
+                                               jnp.float32(0.0))
+                            acc_ref[...] = acc_ref[...] + lax.dot_general(
+                                lov_hi, ohT, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) + lax.dot_general(
+                                lov_lo, ohT, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-            accT = acc_ref[...]
-            cntT = cnt_ref[...] if with_counts else None
-            # (LANE, sub) transposed live slab for THIS tile; tps==1 keeps
-            # the historical full-block access pattern
+                            if with_counts:
+                                lovT1 = jnp.where(lane_iota == lo_row,
+                                                  jnp.float32(1.0),
+                                                  jnp.float32(0.0))
+                                cnt_ref[...] = cnt_ref[...] + lax.dot_general(
+                                    lovT1, ohT, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+                        else:
+                            # batched: the lane's weight-free contribution
+                            # matrix is built ONCE (same two-pass bf16
+                            # error compensation, applied to frac alone —
+                            # the f32 weight multiplies after the dot, so
+                            # precision matches the single-query path);
+                            # each query folds it in with one scale-add,
+                            # which is how one DMA + one MXU pass serve
+                            # the whole batch
+                            f_row = frac.reshape(1, rows)
+                            f_hi = f_row.astype(jnp.bfloat16).astype(
+                                jnp.float32)
+                            f_lo = f_row - f_hi
+                            lov_hi = jnp.where(lane_iota == lo_row, f_hi,
+                                               jnp.float32(0.0))
+                            lov_lo = jnp.where(lane_iota == lo_row, f_lo,
+                                               jnp.float32(0.0))
+                            contrib = lax.dot_general(
+                                lov_hi, ohT, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) + lax.dot_general(
+                                lov_lo, ohT, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+                            if with_counts:
+                                lovT1 = jnp.where(lane_iota == lo_row,
+                                                  jnp.float32(1.0),
+                                                  jnp.float32(0.0))
+                                ccontrib = lax.dot_general(
+                                    lovT1, ohT, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+                            for q in range(q_batch):
+                                wq = w_ref[q, j]
+                                qs = pl.ds(q * LANE, LANE)
+                                acc_ref[qs, :] = (acc_ref[qs, :]
+                                                  + wq * contrib)
+                                if with_counts:
+                                    # weight > 0 is the per-query live-
+                                    # lane mask: a dead lane must not
+                                    # count toward minimum_should_match
+                                    cnt_ref[qs, :] = cnt_ref[qs, :] + \
+                                        jnp.where(wq > jnp.float32(0.0),
+                                                  ccontrib,
+                                                  jnp.float32(0.0))
+            # (LANE, sub) transposed live slab for THIS tile (shared by
+            # every query of the batch); tps==1 keeps the historical
+            # full-block access pattern
             if tps == 1:
                 live = live_ref[...] > jnp.float32(0.0)
             else:
                 live = live_ref[pl.ds(ti * LANE, LANE), :] > jnp.float32(0.0)
-            if dense:
-                sc = jnp.where(live, accT, jnp.float32(0.0))
-                if tps == 1:
-                    outs[0][...] = sc
-                    if with_counts:
-                        outs[1][...] = jnp.where(live, cntT, jnp.float32(0.0))
+            for q in range(q_batch):
+                if q_batch == 1:
+                    accT = acc_ref[...]
+                    cntT = cnt_ref[...] if with_counts else None
                 else:
-                    outs[0][pl.ds(ti * LANE, LANE), :] = sc
-                    if with_counts:
-                        outs[1][pl.ds(ti * LANE, LANE), :] = jnp.where(
-                            live, cntT, jnp.float32(0.0))
-                continue
-            out_s, out_d, out_h = outs
-            matched = (accT > jnp.float32(0.0)) & live
-            hits = jnp.sum(jnp.where(matched, jnp.float32(1.0),
-                                     jnp.float32(0.0)))
-            # float literals must be explicit f32: a weak python -inf traces
-            # as an f64 scalar inside the kernel and crashes the TPU compiler
-            ninf = jnp.float32(NEG_INF)
-            masked = jnp.where(matched, accT, ninf)
-            # local doc id at accT[lane, s] is s*128 + lane
-            lin = (lax.broadcasted_iota(jnp.int32, (LANE, sub), 1)
-                   * jnp.int32(LANE)
-                   + lax.broadcasted_iota(jnp.int32, (LANE, sub), 0))
-            outv_s = jnp.full((1, k), NEG_INF, jnp.float32)
-            outv_d = jnp.full((1, k), -1, jnp.int32)
-            k_iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
-            for i in range(k):
-                mx = jnp.max(masked)
-                sel = jnp.where(masked == mx, lin, jnp.int32(w))
-                idx = jnp.min(sel)
-                outv_s = jnp.where(k_iota == jnp.int32(i), mx, outv_s)
-                outv_d = jnp.where(
-                    k_iota == jnp.int32(i),
-                    jnp.where(mx == ninf, jnp.int32(-1), base + idx),
-                    outv_d)
-                masked = jnp.where(lin == idx, ninf, masked)
-            if tps == 1:
-                out_h[...] = hits.reshape(1, 1, 1)
-                out_s[...] = outv_s.reshape(1, 1, k)
-                out_d[...] = outv_d.reshape(1, 1, k)
-            else:
-                out_h[pl.ds(ti, 1)] = hits.reshape(1, 1, 1)
-                out_s[pl.ds(ti, 1)] = outv_s.reshape(1, 1, k)
-                out_d[pl.ds(ti, 1)] = outv_d.reshape(1, 1, k)
+                    accT = acc_ref[pl.ds(q * LANE, LANE), :]
+                    cntT = (cnt_ref[pl.ds(q * LANE, LANE), :]
+                            if with_counts else None)
+                if dense:
+                    sc = jnp.where(live, accT, jnp.float32(0.0))
+                    if q_batch == 1:
+                        if tps == 1:
+                            outs[0][...] = sc
+                            if with_counts:
+                                outs[1][...] = jnp.where(live, cntT,
+                                                         jnp.float32(0.0))
+                        else:
+                            outs[0][pl.ds(ti * LANE, LANE), :] = sc
+                            if with_counts:
+                                outs[1][pl.ds(ti * LANE, LANE), :] = jnp.where(
+                                    live, cntT, jnp.float32(0.0))
+                    else:
+                        outs[0][pl.ds(q, 1), pl.ds(ti * LANE, LANE), :] = \
+                            sc[None]
+                        if with_counts:
+                            outs[1][pl.ds(q, 1), pl.ds(ti * LANE, LANE), :] = \
+                                jnp.where(live, cntT, jnp.float32(0.0))[None]
+                    continue
+                out_s, out_d, out_h = outs
+                matched = (accT > jnp.float32(0.0)) & live
+                hits = jnp.sum(jnp.where(matched, jnp.float32(1.0),
+                                         jnp.float32(0.0)))
+                # float literals must be explicit f32: a weak python -inf
+                # traces as an f64 scalar inside the kernel and crashes the
+                # TPU compiler
+                ninf = jnp.float32(NEG_INF)
+                masked = jnp.where(matched, accT, ninf)
+                # local doc id at accT[lane, s] is s*128 + lane
+                lin = (lax.broadcasted_iota(jnp.int32, (LANE, sub), 1)
+                       * jnp.int32(LANE)
+                       + lax.broadcasted_iota(jnp.int32, (LANE, sub), 0))
+                outv_s = jnp.full((1, k), NEG_INF, jnp.float32)
+                outv_d = jnp.full((1, k), -1, jnp.int32)
+                k_iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
+                for i in range(k):
+                    mx = jnp.max(masked)
+                    sel = jnp.where(masked == mx, lin, jnp.int32(w))
+                    idx = jnp.min(sel)
+                    outv_s = jnp.where(k_iota == jnp.int32(i), mx, outv_s)
+                    outv_d = jnp.where(
+                        k_iota == jnp.int32(i),
+                        jnp.where(mx == ninf, jnp.int32(-1), base + idx),
+                        outv_d)
+                    masked = jnp.where(lin == idx, ninf, masked)
+                if q_batch > 1:
+                    out_h[pl.ds(ti, 1), pl.ds(q, 1)] = hits.reshape(1, 1, 1)
+                    out_s[pl.ds(ti, 1), pl.ds(q, 1)] = outv_s.reshape(1, 1, k)
+                    out_d[pl.ds(ti, 1), pl.ds(q, 1)] = outv_d.reshape(1, 1, k)
+                elif tps == 1:
+                    out_h[...] = hits.reshape(1, 1, 1)
+                    out_s[...] = outv_s.reshape(1, 1, k)
+                    out_d[...] = outv_d.reshape(1, 1, k)
+                else:
+                    out_h[pl.ds(ti, 1)] = hits.reshape(1, 1, 1)
+                    out_s[pl.ds(ti, 1)] = outv_s.reshape(1, 1, k)
+                    out_d[pl.ds(ti, 1)] = outv_d.reshape(1, 1, k)
 
     return kernel
 
@@ -444,7 +586,7 @@ def _compiler_params():
 @functools.partial(
     jax.jit,
     static_argnames=("t_pad", "cb", "sub", "k", "dense", "with_counts",
-                     "interpret", "tiles_per_step"),
+                     "interpret", "tiles_per_step", "q_batch"),
 )
 def score_tiles(
     docs_padded,  # [n_blocks + CB_MAX, LANE] i32 (pad_segment_blocks)
@@ -452,7 +594,7 @@ def score_tiles(
     live_t,  # [n_tiles * LANE, sub] f32 (1.0 = live; build_live_t)
     row_lo,  # [n_tiles, t_pad] i32
     row_hi,  # [n_tiles, t_pad] i32
-    weights,  # [1, t_pad] f32
+    weights,  # [q_batch, t_pad] f32 ([1, t_pad] unbatched)
     *,
     t_pad: int,
     cb: int,
@@ -462,26 +604,37 @@ def score_tiles(
     with_counts: bool = False,
     interpret: bool = False,
     tiles_per_step: int = 1,
+    q_batch: int = 1,
 ):
     """Run the tile-scoring kernel over a segment.
 
-    top-k variant (dense=False): returns (tile_scores [n_tiles, 1, k] f32,
-    tile_docs [n_tiles, 1, k] i32 (-1 = empty), tile_hits [n_tiles, 1, 1]).
+    top-k variant (dense=False): returns (tile_scores [n_tiles, q_batch, k]
+    f32, tile_docs [n_tiles, q_batch, k] i32 (-1 = empty), tile_hits
+    [n_tiles, q_batch, 1]) — q_batch is 1 for a single query, preserving
+    the historical shapes.
 
     dense variant (dense=True): returns scores [n_tiles*LANE, sub] f32 in
     the kernel's transposed tile layout (dense_to_flat -> [nd_pad]) and,
     with_counts, match counts of the same shape (for minimum_should_match
-    / conjunction masking).
+    / conjunction masking). With q_batch > 1 both gain a leading [q_batch]
+    axis.
 
     tiles_per_step > 1 coarsens the grid: each step owns that many
     consecutive tiles, double-buffering their DMA windows against compute
     and amortizing the fixed per-grid-step cost that dominates this kernel
     (the output layouts are unchanged). Clamped down to a divisor of
     n_tiles.
+
+    q_batch > 1 is cross-query micro-batching (ISSUE 5): row_lo/row_hi
+    cover the UNION of the batch's term lanes (build_tile_tables_batched)
+    and weights carries one row per query (0 = lane dead for that query).
+    Corpus bytes stream ONCE per tile for the whole batch; per-query cost
+    reduces to one scale-add per live lane plus the per-tile top-k loop.
     """
     n_tiles = row_lo.shape[0]
     w = sub * LANE
     k = min(k, w)
+    q_batch = max(1, int(q_batch))
     tps = max(1, int(tiles_per_step))
     while n_tiles % tps:
         tps //= 2
@@ -518,41 +671,60 @@ def score_tiles(
     # the SMEM spec needs an explicit index map: the auto-generated default
     # returns weak python-int zeros, which trace to i64 under x64 and fail
     # mosaic legalization on real hardware (interpret mode doesn't catch it)
-    in_specs.append(pl.BlockSpec((1, t_pad), lambda t, rlo, rhi: (zero(), zero()),
+    in_specs.append(pl.BlockSpec((q_batch, t_pad),
+                                 lambda t, rlo, rhi: (zero(), zero()),
                                  memory_space=pltpu.SMEM))
     operands.append(weights)
 
     if dense:
-        out_specs = [
-            pl.BlockSpec((tps * LANE, sub), lambda t, rlo, rhi: (t, zero()))]
-        out_shape = [jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32)]
-        if with_counts:
-            out_specs.append(
+        if q_batch == 1:
+            out_specs = [
                 pl.BlockSpec((tps * LANE, sub),
-                             lambda t, rlo, rhi: (t, zero())))
-            out_shape.append(
-                jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32))
+                             lambda t, rlo, rhi: (t, zero()))]
+            out_shape = [
+                jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32)]
+            if with_counts:
+                out_specs.append(
+                    pl.BlockSpec((tps * LANE, sub),
+                                 lambda t, rlo, rhi: (t, zero())))
+                out_shape.append(
+                    jax.ShapeDtypeStruct((n_tiles * LANE, sub), jnp.float32))
+        else:
+            # per-query dense slabs: the leading q axis rides whole in
+            # every block (only the last two dims face mosaic's
+            # divisibility-or-full-dim rule, and those are unchanged)
+            out_specs = [
+                pl.BlockSpec((q_batch, tps * LANE, sub),
+                             lambda t, rlo, rhi: (zero(), t, zero()))]
+            out_shape = [jax.ShapeDtypeStruct(
+                (q_batch, n_tiles * LANE, sub), jnp.float32)]
+            if with_counts:
+                out_specs.append(
+                    pl.BlockSpec((q_batch, tps * LANE, sub),
+                                 lambda t, rlo, rhi: (zero(), t, zero())))
+                out_shape.append(jax.ShapeDtypeStruct(
+                    (q_batch, n_tiles * LANE, sub), jnp.float32))
     else:
         # 3D outputs: the last two dims of each block equal the array dims,
         # satisfying mosaic's (8, 128)-divisibility-or-full-dim rule for
-        # small per-tile outputs
+        # small per-tile outputs (the middle dim is the per-query row)
         out_specs = [
-            pl.BlockSpec((tps, 1, k),
+            pl.BlockSpec((tps, q_batch, k),
                          lambda t, rlo, rhi: (t, zero(), zero())),
-            pl.BlockSpec((tps, 1, k),
+            pl.BlockSpec((tps, q_batch, k),
                          lambda t, rlo, rhi: (t, zero(), zero())),
-            pl.BlockSpec((tps, 1, 1),
+            pl.BlockSpec((tps, q_batch, 1),
                          lambda t, rlo, rhi: (t, zero(), zero())),
         ]
         out_shape = [
-            jax.ShapeDtypeStruct((n_tiles, 1, k), jnp.float32),
-            jax.ShapeDtypeStruct((n_tiles, 1, k), jnp.int32),
-            jax.ShapeDtypeStruct((n_tiles, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, q_batch, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, q_batch, k), jnp.int32),
+            jax.ShapeDtypeStruct((n_tiles, q_batch, 1), jnp.float32),
         ]
 
-    scratch_shapes = [pltpu.VMEM((LANE, sub), jnp.float32)]
+    scratch_shapes = [pltpu.VMEM((q_batch * LANE, sub), jnp.float32)]
     if with_counts:
-        scratch_shapes.append(pltpu.VMEM((LANE, sub), jnp.float32))
+        scratch_shapes.append(pltpu.VMEM((q_batch * LANE, sub), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_tiles // tps,),
@@ -560,7 +732,8 @@ def score_tiles(
         out_specs=out_specs,
         scratch_shapes=scratch_shapes,
     )
-    kernel = _make_kernel(t_pad, cb, sub, k, dense, with_counts, tps)
+    kernel = _make_kernel(t_pad, cb, sub, k, dense, with_counts, tps,
+                          q_batch)
     kwargs = {}
     params = _compiler_params()
     if params is not None and not interpret:
@@ -584,6 +757,21 @@ def merge_tile_topk(tile_scores, tile_docs, tile_hits, k: int):
     kk = min(k, flat_s.shape[0])
     top_s, top_i = lax.top_k(flat_s, kk)
     return top_s, flat_d[top_i], jnp.sum(tile_hits).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_tile_topk_batched(tile_scores, tile_docs, tile_hits, k: int):
+    """Per-query merge of a batched top-k launch: tile_scores/tile_docs
+    are [n_tiles, Q, k]; returns (top_s [Q, k'], top_d [Q, k'],
+    hits [Q] i32) with k' = min(k, n_tiles*k)."""
+    n_tiles, q, kk_in = tile_scores.shape
+    flat_s = tile_scores.transpose(1, 0, 2).reshape(q, -1)
+    flat_d = tile_docs.transpose(1, 0, 2).reshape(q, -1)
+    kk = min(k, flat_s.shape[1])
+    top_s, top_i = lax.top_k(flat_s, kk)
+    top_d = jnp.take_along_axis(flat_d, top_i, axis=1)
+    hits = jnp.sum(tile_hits.reshape(n_tiles, q), axis=0).astype(jnp.int32)
+    return top_s, top_d, hits
 
 
 def build_live_t(live: np.ndarray, geom: TileGeometry) -> np.ndarray:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
 import time as _time
 from typing import Dict, List, Optional, Tuple
 
@@ -48,6 +49,15 @@ class PlanStructureMismatch(Exception):
 
 
 _plane_logger = logging.getLogger("elasticsearch_tpu.parallel.plane")
+
+# Two mesh programs in flight at once interleave their collective
+# rendezvous on the multi-device CPU backend (all_gather participants
+# from different run_ids wait on each other — observed as a hang when
+# concurrent REST threads each launch a shard_map program). A single
+# chip executes programs serially anyway, so serializing mesh-program
+# EXECUTION process-wide costs nothing on TPU and makes concurrent
+# search traffic safe everywhere. Compilation/staging stay unlocked.
+_MESH_EXEC_LOCK = threading.Lock()
 
 
 class PlaneHealth:
@@ -424,6 +434,65 @@ def _shapes_sig(arrays) -> str:
     return ";".join(f"{a.shape}{a.dtype}" for a in arrays)
 
 
+@functools.lru_cache(maxsize=32)
+def _mesh_batched_kernel_program(mesh: Mesh, spd: int, q_batch: int,
+                                 kk: int, t_pad: int, cb: int, sub: int,
+                                 tps: int, interpret: bool):
+    """One compiled scatter-gather serving Q CONCURRENT queries (ISSUE 5
+    cross-query micro-batching on the mesh_pallas rung): per slot, ONE
+    batched ``score_tiles`` launch streams the slot's posting windows
+    once and emits per-query per-tile top-k candidates; the per-query
+    pools merge locally, then over ICI via one all_gather — the same
+    collective shape as _mesh_query_program's merge, with a leading
+    query axis instead of a leading 1."""
+    from elasticsearch_tpu.ops import pallas_scoring as psc
+
+    def per_device(kd, kf, lt, rl, rh, w):
+        dev = jax.lax.axis_index("shards")
+        cand_s, cand_d, cand_slot = [], [], []
+        hits = None
+        for i in range(spd):
+            ts_, td_, th_ = psc.score_tiles(
+                kd[i], kf[i], lt[i], rl[i], rh[i], w[i],
+                t_pad=t_pad, cb=cb, sub=sub, k=kk, interpret=interpret,
+                tiles_per_step=tps, q_batch=q_batch)
+            s_i, d_i, h_i = psc.merge_tile_topk_batched(ts_, td_, th_, kk)
+            cand_s.append(s_i)  # [Q, kk']
+            cand_d.append(d_i)
+            cand_slot.append(
+                jnp.zeros(s_i.shape, jnp.int32)
+                + (dev.astype(jnp.int32) * jnp.int32(spd) + jnp.int32(i)))
+            hits = h_i if hits is None else hits + h_i
+        cs = jnp.concatenate(cand_s, axis=1)
+        cd = jnp.concatenate(cand_d, axis=1)
+        cslot = jnp.concatenate(cand_slot, axis=1)
+        total = jax.lax.psum(hits, "shards")  # [Q]
+        all_s = jax.lax.all_gather(cs, "shards")  # [n_dev, Q, spd*kk']
+        all_d = jax.lax.all_gather(cd, "shards")
+        all_slot = jax.lax.all_gather(cslot, "shards")
+        pool_s = all_s.transpose(1, 0, 2).reshape(q_batch, -1)
+        pool_d = all_d.transpose(1, 0, 2).reshape(q_batch, -1)
+        pool_slot = all_slot.transpose(1, 0, 2).reshape(q_batch, -1)
+        top_s, top_i = jax.lax.top_k(pool_s, min(kk, pool_s.shape[1]))
+        top_d = jnp.take_along_axis(pool_d, top_i, axis=1)
+        top_slot = jnp.take_along_axis(pool_slot, top_i, axis=1)
+        return top_s[None], top_d[None], top_slot[None], total[None]
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(PS("shards"),) * 6,
+        out_specs=(PS("shards"),) * 4,
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(kd, kf, lt, rl, rh, w):
+        outs = mapped(kd, kf, lt, rl, rh, w)
+        return tuple(o[0] for o in outs)  # replicated: row 0 == row i
+
+    return run
+
+
 class IndexMeshSearch:
     """Routes an index's production query phase through the mesh.
 
@@ -458,6 +527,10 @@ class IndexMeshSearch:
         # queries whose scoring ran on the tile kernel inside the mesh
         # program (the unified fast plane) vs the XLA scatter formulation
         self.pallas_query_total = 0
+        # cross-query micro-batching on the mesh_pallas rung
+        # (query_batch): launches and member-queries served batched
+        self.batched_launch_total = 0
+        self.batched_query_total = 0
         settings = getattr(index_service, "settings", None)
         # packing limit: segments are packed max_slots-deep per device
         # before the index falls back to the host path (registered as
@@ -858,6 +931,194 @@ class IndexMeshSearch:
                 # the response's _plane marker and the planes counters
                 "plane": "mesh_pallas" if used_pallas else "mesh"}
 
+    # request keys the BATCHED mesh_pallas program covers: plain
+    # relevance-ranked queries (the high-QPS traffic shape the batching
+    # exists for). Anything richer falls to the host-batched rung, whose
+    # per-query pipeline covers the full request surface.
+    BATCHABLE_KEYS = frozenset({
+        "query", "size", "from", "timeout",
+        "allow_partial_search_results", "stats",
+    })
+
+    def query_batch(self, bodies: List[dict]) -> Optional[list]:
+        """Cross-query micro-batching on the mesh_pallas rung: Q
+        concurrent queries scored by ONE batched kernel launch inside
+        one shard_map program (per-tile DMA windows fetched once for the
+        whole batch — see ops/pallas_scoring.score_tiles q_batch).
+
+        Returns one {total, refs, max_score, plane} dict per member, or
+        None when the batch can't run here (callers fall to the
+        host-batched rung). A plane FAULT quarantines mesh_pallas
+        exactly ONCE for the whole batch — not Q times."""
+        from elasticsearch_tpu.index.segment import next_pow2
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+        from elasticsearch_tpu.search.plan import PallasScoreTermsNode
+        from elasticsearch_tpu.search.query_dsl import (
+            ShardQueryContext,
+            parse_query,
+        )
+        from elasticsearch_tpu.search.service import DocRef
+        from elasticsearch_tpu.testing.disruption import on_plane_execute
+
+        if self.plane_pref not in ("auto", "pallas"):
+            return None
+        if not self.plane_health.available("mesh_pallas"):
+            return None
+        if len(self.svc.shards) < 2:
+            return None
+        for body in bodies:
+            body = body or {}
+            if not isinstance(body.get("query"), dict):
+                return None
+            if any(key not in self.BATCHABLE_KEYS for key in body):
+                return None
+        if any(getattr(self.svc.shards[s].engine, "index_sort", None)
+               for s in self.svc.shards):
+            return None
+        if not self._ensure_staged():
+            return None
+        session = self._executor.ensure_kernel()
+        if session is None:
+            return None
+        q_batch = len(bodies)
+        ks = []
+        for body in bodies:
+            from_ = int(body.get("from", 0) or 0)
+            size = (int(body.get("size"))
+                    if body.get("size") is not None else 10)
+            ks.append(max(from_ + size, 1))
+        # bucket the compiled-program key: batch size is set by arrival
+        # timing (2..max_queries) and kk by the members' size params, so
+        # raw values would compile a fresh shard_map+kernel program per
+        # combination. Pad q_batch to the next power of two (extra weight
+        # rows are all-zero = dead queries) and kk likewise — at most
+        # ~4x4 program variants instead of one per traffic pattern.
+        kk = next_pow2(max(ks))
+        q_pad = next_pow2(q_batch)
+        geom = session["geom"]
+        n_pairs = len(self._pairs)
+        # per-member, per-slot kernel lane sets via the same deferred
+        # plan builder the serial mesh path uses — the plan must be
+        # EXACTLY one kernel-scored disjunction (no wrapper nodes).
+        # Built OUTSIDE the fault-recording try: a malformed member body
+        # (parse/mapping error) is a REQUEST error the serial path owns
+        # with its own 4xx, never a plane fault to quarantine on — same
+        # split as the serial ladder, which parses before its attempts.
+        try:
+            lane_sets = [[None] * q_batch for _ in range(n_pairs)]
+            for q, body in enumerate(bodies):
+                qb = parse_query(body.get("query"))
+                for slot, (sid, seg) in enumerate(self._pairs):
+                    shard = self.svc.shards[sid]
+                    ctx = ShardQueryContext(shard.mapper_service,
+                                            engine=shard.engine)
+                    ctx.for_mesh = True
+                    ctx.mesh_kernel = session
+                    plan = qb.to_plan(ctx, seg)
+                    if (not isinstance(plan, PallasScoreTermsNode)
+                            or plan._mesh_lanes is None
+                            or plan.with_counts):
+                        # minimum_should_match > 1 needs the dense-counts
+                        # variant the fused top-k kernel doesn't emit
+                        return None
+                    lane_sets[slot][q] = plan._mesh_lanes
+        except Exception:  # noqa: BLE001 — request-shaped error: serial
+            # execution surfaces it per member with the right status
+            return None
+        try:
+            on_plane_execute(self.svc.name, "mesh_pallas")
+            # shared batched tables: per-slot unions on ONE collective
+            # geometry (a dense union on ANY slot shrinks everyone's
+            # tile); build_tile_tables_batched owns the union/pad
+            # contract — same code the host rung runs
+            t_pad = max(
+                next_pow2(max(len(psc.union_query_lanes(
+                    lane_sets[slot])[0]), 1))
+                for slot in range(n_pairs))
+            sub = geom.tile_sub
+            while True:
+                g = geom if sub == geom.tile_sub else psc.tile_geometry(
+                    geom.nd_pad, sub)
+                try:
+                    tables = []
+                    for slot, (sid, seg) in enumerate(self._pairs):
+                        bmin, bmax = session["meta"][id(seg)]
+                        tables.append(psc.build_tile_tables_batched(
+                            lane_sets[slot], bmin, bmax, g, t_pad=t_pad))
+                    break
+                except ValueError:
+                    if sub <= 32 or g.tile_sub < sub:
+                        return None  # no shared geometry: host rung
+                    sub //= 2
+            cb = max(t[3] for t in tables)
+            live_key = ("k_live_t" if g.tile_sub == geom.tile_sub
+                        else self._executor.ensure_kernel_live(g.tile_sub))
+            n_slots = self._executor.n_slots
+            n_tiles = tables[0][0].shape[0]
+            rl = np.zeros((n_slots, n_tiles, t_pad), np.int32)
+            rh = np.zeros((n_slots, n_tiles, t_pad), np.int32)
+            w_all = np.zeros((n_slots, q_pad, t_pad), np.float32)
+            for slot in range(n_pairs):
+                rl[slot] = tables[slot][0]
+                rh[slot] = tables[slot][1]
+                w_all[slot, : q_batch] = tables[slot][2]
+            # filler slots/queries keep zero tables/weights: their live
+            # masks are all-dead and zero weights score nothing
+            tps = psc.tiles_per_step_default()
+            run = _mesh_batched_kernel_program(
+                self._executor.mesh, self._executor.slots_per_dev,
+                q_pad, kk, t_pad, cb, g.tile_sub, tps,
+                session["mode"] == "interpret")
+            sharding = self._executor._sharding
+            staged = self._executor._seg_staged
+            with _MESH_EXEC_LOCK:
+                outs = run(staged["k_docs"], staged["k_frac"],
+                           staged[live_key],
+                           jax.device_put(rl, sharding),
+                           jax.device_put(rh, sharding),
+                           jax.device_put(w_all, sharding))
+                # async dispatch: completion inside the lock (see above)
+                jax.block_until_ready(outs)
+            keys, docs, slots, totals = (np.asarray(o) for o in outs)
+        except (PlanStructureMismatch, NotImplementedError):
+            return None  # shape ineligibility: next rung, no penalty
+        except Exception:  # noqa: BLE001 — plane fault, not a shape miss
+            # batch-wide fault: bench the plane ONCE (not Q times) and
+            # let the caller serve the members from the next rung
+            _plane_logger.warning(
+                "[%s] batched execution plane [mesh_pallas] failed; "
+                "quarantined for %.1fs", self.svc.name,
+                self.plane_health.cooldown_s, exc_info=True)
+            self.plane_health.record_failure("mesh_pallas")
+            return None
+        self.query_total += q_batch
+        self.pallas_query_total += q_batch
+        self.batched_launch_total += 1
+        self.batched_query_total += q_batch
+        results = []
+        for q, body in enumerate(bodies):
+            # per-shard search stats stay attributed per MEMBER (the
+            # batch is an execution detail, not a stats unit)
+            for sid in self.svc.shards:
+                searcher = self.svc.shards[sid].searcher
+                searcher.query_total += 1
+                searcher.record_query_groups((body or {}).get("stats"))
+            refs = []
+            max_score = None
+            for key, slot, d in zip(keys[q][: ks[q]], slots[q][: ks[q]],
+                                    docs[q][: ks[q]]):
+                if key == -np.inf or d < 0:
+                    continue
+                sid, seg = self._pairs[int(slot)]
+                score = float(key)
+                refs.append(DocRef(sid, seg.name, int(d), score, ()))
+                if max_score is None:
+                    max_score = score
+            results.append({"total": int(totals[q]), "refs": refs,
+                            "max_score": max_score,
+                            "plane": "mesh_pallas"})
+        return results
+
 
 class MeshPlanExecutor:
     """Stage N sealed segments onto a device mesh once; run any query
@@ -1225,6 +1486,11 @@ class MeshPlanExecutor:
         staged_rs = [jax.device_put(a, self._sharding) for a in stacked_rs]
         jscalars = {name: jnp.float32(v)
                     for name, v in (scalars or {}).items()}
-        outs = run(self._seg_staged, staged_plan, staged_pf, staged_rs,
-                   jscalars)
+        with _MESH_EXEC_LOCK:
+            outs = run(self._seg_staged, staged_plan, staged_pf, staged_rs,
+                       jscalars)
+            # dispatch is async: the collectives execute after run()
+            # returns, so completion must happen INSIDE the lock (the
+            # caller fetches the results immediately anyway)
+            jax.block_until_ready(outs)
         return outs

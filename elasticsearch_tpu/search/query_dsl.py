@@ -244,10 +244,15 @@ def _pallas_score_terms_node(segment, arrs, min_match):
             sub //= 2
     live_key = ("k_live_t" if g.tile_sub == geom.tile_sub
                 else segment.kernel_live_t_for(g.tile_sub))
-    return P.PallasScoreTermsNode(
+    node = P.PallasScoreTermsNode(
         row_lo, row_hi, kweights, min_match,
         cb=cb, sub=g.tile_sub, interpret=(mode == "interpret"),
         live_key=live_key, tiles_per_step=psc.tiles_per_step_default())
+    # the cross-query micro-batcher (search/batching.py) unions lane sets
+    # across concurrent queries and re-derives shared tables, so the node
+    # keeps its lane list alongside the already-built single-query tables
+    node._host_lanes = qlanes
+    return node
 
 
 def _mesh_pallas_score_terms_node(segment, arrs, min_match, session):

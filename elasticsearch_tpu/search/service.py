@@ -156,13 +156,20 @@ class ShardSearcher:
     # ------------------------------------------------------------------
 
     def query(self, source: dict, size_hint: Optional[int] = None,
-              segments=None, deadline=None) -> ShardQueryResult:
+              segments=None, deadline=None,
+              score_cache: Optional[Dict[str, Tuple]] = None,
+              ) -> ShardQueryResult:
         """segments: optional explicit segment list (point-in-time views
         pinned by an open scroll context — search/internal/ScrollContext);
         None searches the engine's current NRT segment set.
         deadline: optional SearchDeadline — checkpointed between segments;
         expiry stops the scan and returns the accumulated partial result
-        with timed_out=True, cancellation raises TaskCancelledException."""
+        with timed_out=True, cancellation raises TaskCancelledException.
+        score_cache: {segment_name: (scores [nd1] f32, matched [nd1]
+        bool)} precomputed by a cross-query batched kernel launch
+        (search/batching.py) — a cached segment skips plan execution and
+        feeds the identical per-query downstream pipeline (min_score,
+        selection, aggs, post_filter, rescore)."""
         from elasticsearch_tpu.testing.disruption import on_shard_search
 
         t0 = time.monotonic()
@@ -228,17 +235,27 @@ class ShardSearcher:
                     break
             t_seg = time.monotonic()
             dev = seg.device_arrays()
-            node = qb.to_plan(self.ctx, seg)
-            used_pallas = _plan_uses_pallas(node)
-            if used_pallas:
+            cached = (score_cache.get(seg.name)
+                      if score_cache and not profile else None)
+            if cached is not None:
+                # scored by a batched kernel launch shared with the other
+                # members of this query's micro-batch (the batched analog
+                # of the pallas plane below)
+                scores, matched = cached
                 self.pallas_segments_total += 1
+                t_build = t_exec = time.monotonic()
             else:
-                self.scatter_segments_total += 1
-            t_build = time.monotonic()
-            scores_d, matched_d = P.execute(dev, node)
-            scores = np.asarray(scores_d)
-            matched = np.asarray(matched_d)
-            t_exec = time.monotonic()
+                node = qb.to_plan(self.ctx, seg)
+                used_pallas = _plan_uses_pallas(node)
+                if used_pallas:
+                    self.pallas_segments_total += 1
+                else:
+                    self.scatter_segments_total += 1
+                t_build = time.monotonic()
+                scores_d, matched_d = P.execute(dev, node)
+                scores = np.asarray(scores_d)
+                matched = np.asarray(matched_d)
+                t_exec = time.monotonic()
             live1 = np.concatenate([seg.live, np.zeros(1, bool)])
             matched = matched & live1
             if min_score is not None:

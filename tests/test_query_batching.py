@@ -1,0 +1,544 @@
+"""Cross-query micro-batching on the Pallas scoring plane (ISSUE 5).
+
+Covers the three layers:
+- kernel: ``score_tiles(q_batch=Q)`` over union tables scores every
+  member of a heterogeneous batch exactly like Q serial launches
+  (dense + fused-top-k variants, minimum_should_match counts);
+- service: ``IndexService.search_batch`` parity with serial execution
+  for mixed term counts / k / min_score / aggs, per-member deadline
+  expiry and ``_tasks/_cancel`` isolation, PlaneFailScheme quarantining
+  the mesh_pallas plane exactly once per batch;
+- scheduler: ``MicroBatcher`` groups only under real concurrency (a
+  lone query takes the unbatched path with no window wait), seals at
+  max_queries, and delivers per-member exceptions.
+
+Everything runs the kernel in interpret mode on the CPU backend — the
+same semantics the compiled TPU path executes (tests/test_pallas_scoring
+idiom).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import TaskCancelledException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.search.batching import (
+    BatchStats,
+    MicroBatcher,
+    batchable_body,
+)
+from elasticsearch_tpu.search.cancellation import SearchDeadline
+from elasticsearch_tpu.testing.disruption import (
+    PlaneFailScheme,
+    clear_search_disruptions,
+)
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text", "analyzer": "whitespace"},
+        "n": {"type": "integer"},
+        "tag": {"type": "keyword"},
+    }
+}
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernel(monkeypatch):
+    monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+    yield
+    clear_search_disruptions()
+
+
+def build_index(n_shards=3, n_docs=120, seed=0, **extra_settings):
+    idx = IndexService(
+        f"batching-{n_shards}s", Settings({
+            "index.number_of_shards": n_shards,
+            "index.refresh_interval": -1, **extra_settings}),
+        mapping=MAPPING)
+    rng = np.random.RandomState(seed)
+    vocab = [f"t{i}" for i in range(15)]
+    tags = ["red", "green", "blue"]
+    for d in range(n_docs):
+        toks = [vocab[rng.randint(len(vocab))]
+                for _ in range(rng.randint(3, 9))]
+        idx.index_doc(str(d), {"body": " ".join(toks), "n": d,
+                               "tag": tags[d % 3]})
+    idx.refresh()
+    return idx
+
+
+# heterogeneous member mix: different term counts, k, min_score, aggs,
+# minimum_should_match — the batch must reproduce each serially
+HETERO_BODIES = [
+    {"query": {"match": {"body": "t0 t1"}}, "size": 5},
+    {"query": {"match": {"body": "t1"}}, "size": 3},
+    {"query": {"match": {"body": "t2 t3 t4"}}, "size": 7,
+     "min_score": 0.1},
+    {"query": {"match": {"body": "t0 t5"}}, "size": 4,
+     "aggs": {"tags": {"terms": {"field": "tag"}}}},
+    {"query": {"match": {"body": {"query": "t0 t1 t2",
+                                  "minimum_should_match": 2}}},
+     "size": 5},
+]
+
+
+def assert_member_parity(idx, body, got):
+    want = idx._search_uncached(dict(body), skip_mesh=True)
+    assert got["hits"]["total"] == want["hits"]["total"], body
+    assert ([h["_id"] for h in got["hits"]["hits"]]
+            == [h["_id"] for h in want["hits"]["hits"]]), body
+    for g, w in zip(got["hits"]["hits"], want["hits"]["hits"]):
+        if g["_score"] is not None:
+            assert abs(g["_score"] - w["_score"]) < 1e-5, (g, w)
+    if "aggs" in body:
+        assert got["aggregations"] == want["aggregations"], body
+
+
+class TestKernelBatch:
+    """Direct q_batch kernel parity against the scatter oracle."""
+
+    def _corpus(self, rng, nd=1500, vocab=20):
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        nd_pad = psc.next_pow2(nd)
+        bd, bt, starts, counts = [], [], [], []
+        for _ in range(vocab):
+            df = rng.randint(1, 300)
+            docs = np.sort(rng.choice(nd, size=min(df, nd),
+                                      replace=False)).astype(np.int32)
+            tfs = rng.randint(1, 5, size=len(docs)).astype(np.float32)
+            nb = -(-len(docs) // psc.LANE)
+            starts.append(len(bd))
+            counts.append(nb)
+            for i in range(nb):
+                d = np.full(psc.LANE, nd_pad, np.int32)
+                f = np.zeros(psc.LANE, np.float32)
+                chunk = docs[i * psc.LANE:(i + 1) * psc.LANE]
+                d[: len(chunk)] = chunk
+                f[: len(chunk)] = tfs[i * psc.LANE:(i + 1) * psc.LANE]
+                bd.append(d)
+                bt.append(f)
+        return np.stack(bd), np.stack(bt), starts, counts, nd_pad
+
+    def test_batched_dense_and_topk_match_serial(self):
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        rng = np.random.RandomState(3)
+        block_docs, block_tfs, starts, counts, nd_pad = self._corpus(rng)
+        doc_len = np.full(nd_pad + 1, 10.0, np.float32)
+        frac = psc.compute_block_frac(block_docs, block_tfs, doc_len, 10.0)
+        bmin, bmax = psc.block_min_max(block_docs, block_tfs, nd_pad)
+        dp, fp = psc.pad_segment_blocks(block_docs, frac, nd_pad)
+        live = np.ones(nd_pad, np.float32)
+        live[1400:] = 0.0
+        geom = psc.tile_geometry(nd_pad, tile_sub=4)
+        live_t = psc.build_live_t(live, geom)
+        # heterogeneous lane sets, incl. a shared term (lane dedup) and
+        # different term counts
+        lane_sets = [
+            [psc.QueryLane(starts[0], counts[0], 1.3),
+             psc.QueryLane(starts[3], counts[3], 0.7)],
+            [psc.QueryLane(starts[3], counts[3], 2.0)],
+            [psc.QueryLane(starts[5], counts[5], 0.4),
+             psc.QueryLane(starts[7], counts[7], 1.1),
+             psc.QueryLane(starts[9], counts[9], 0.9)],
+        ]
+        q_n = len(lane_sets)
+        rl, rh, weights, cb = psc.build_tile_tables_batched(
+            lane_sets, bmin, bmax, geom)
+        args = (jnp.asarray(dp), jnp.asarray(fp), jnp.asarray(live_t),
+                jnp.asarray(rl), jnp.asarray(rh), jnp.asarray(weights))
+        kw = dict(t_pad=rl.shape[1], cb=cb, sub=geom.tile_sub,
+                  interpret=True, q_batch=q_n)
+        dense, counts_out = psc.score_tiles(*args, dense=True,
+                                            with_counts=True, **kw)
+        ts_, td_, th_ = psc.score_tiles(*args, k=10, **kw)
+        top_s, top_d, hits = psc.merge_tile_topk_batched(ts_, td_, th_, 10)
+        for q, lanes in enumerate(lane_sets):
+            ref = psc.reference_scores(block_docs, frac, lanes, nd_pad)
+            ref = np.where(live[:nd_pad] > 0, ref[:nd_pad], 0.0)
+            got = np.asarray(psc.dense_to_flat(dense[q], geom.tile_sub))
+            got = got[:nd_pad] * (live[:nd_pad] > 0)
+            np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+            expect = np.sort(ref[ref > 0])[::-1][:10]
+            got_s = np.asarray(top_s[q])
+            got_s = got_s[got_s > -np.inf]
+            np.testing.assert_allclose(got_s, expect[: len(got_s)],
+                                       rtol=2e-5)
+            assert int(hits[q]) == int((ref > 0).sum())
+            # per-query live-lane mask: counts only count the member's
+            # own lanes (never another query's)
+            cnt = np.asarray(psc.dense_to_flat(counts_out[q],
+                                               geom.tile_sub))[:nd_pad]
+            assert cnt.max() <= len(lanes) + 1e-6
+
+    def test_union_lanes_dedup_and_masks(self):
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        a = psc.QueryLane(0, 2, 1.5)
+        b = psc.QueryLane(4, 1, 0.5)
+        union, weights = psc.union_query_lanes([[a, b], [a], []])
+        assert len(union) == 2
+        assert weights.shape[0] == 3
+        np.testing.assert_allclose(weights[0, :2], [1.5, 0.5])
+        np.testing.assert_allclose(weights[1, :2], [1.5, 0.0])
+        assert (weights[2] == 0).all()
+
+
+class TestSearchBatchParity:
+    def test_heterogeneous_batch_matches_serial(self):
+        idx = build_index(n_shards=2)
+        try:
+            out = idx.search_batch([dict(b) for b in HETERO_BODIES])
+            for body, got in zip(HETERO_BODIES, out):
+                assert isinstance(got, dict), got
+                assert_member_parity(idx, body, got)
+            stats = idx.batch_stats.as_dict()
+            assert stats["batched_query_total"] == len(HETERO_BODIES)
+            assert stats["batch_size_histogram"] == {
+                str(len(HETERO_BODIES)): 1}
+        finally:
+            idx.close()
+
+    def test_mesh_pallas_batched_rung(self):
+        idx = build_index(n_shards=3)
+        try:
+            bodies = [
+                {"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                {"query": {"match": {"body": "t1 t2"}}, "size": 3},
+                {"query": {"match": {"body": "t3"}}, "size": 6},
+            ]
+            out = idx.search_batch([dict(b) for b in bodies])
+            for body, got in zip(bodies, out):
+                assert isinstance(got, dict), got
+                # _plane reports per-query truth: every member was
+                # scored by the batched mesh_pallas launch
+                assert got["_plane"] == "mesh_pallas", got
+                assert_member_parity(idx, body, got)
+            assert idx._mesh_search.batched_launch_total == 1
+            assert idx._mesh_search.batched_query_total == 3
+            assert idx.batch_stats.as_dict()["batched_query_total"] == 3
+        finally:
+            idx.close()
+
+    def test_single_shard_uses_host_rung(self):
+        idx = build_index(n_shards=1)
+        try:
+            bodies = [
+                {"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                {"query": {"match": {"body": "t2"}}, "size": 5},
+            ]
+            out = idx.search_batch([dict(b) for b in bodies])
+            for body, got in zip(bodies, out):
+                assert isinstance(got, dict)
+                assert got["_plane"] == "host"
+                assert_member_parity(idx, body, got)
+            assert idx.batch_stats.as_dict()["batched_query_total"] == 2
+        finally:
+            idx.close()
+
+    def test_unbatchable_member_executes_serially_in_batch(self):
+        idx = build_index(n_shards=2)
+        try:
+            bodies = [
+                {"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                {"query": {"match": {"body": "t1"}}, "size": 5},
+                # profile is not batchable: still answered, serially
+                {"query": {"match": {"body": "t2"}}, "profile": True},
+            ]
+            out = idx.search_batch([dict(b) for b in bodies])
+            assert all(isinstance(r, dict) for r in out)
+            assert "profile" in out[2]
+            assert_member_parity(idx, bodies[0], out[0])
+        finally:
+            idx.close()
+
+
+class TestBatchFaultTolerance:
+    def test_expired_member_partial_while_peers_complete(self):
+        idx = build_index(n_shards=2)
+        try:
+            expired = SearchDeadline(1e-9)
+            time.sleep(0.01)
+            out = idx.search_batch(
+                [{"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                 {"query": {"match": {"body": "t1 t2"}}, "size": 5}],
+                [expired, None])
+            assert isinstance(out[0], dict)
+            assert out[0]["timed_out"] is True
+            assert out[0]["hits"]["hits"] == []  # partial: nothing ran
+            assert isinstance(out[1], dict)
+            assert out[1]["timed_out"] is False
+            assert out[1]["hits"]["hits"]
+        finally:
+            idx.close()
+
+    def test_cancelled_member_does_not_cancel_batch(self):
+        idx = build_index(n_shards=2)
+        try:
+            class _CancelledTask:
+                def ensure_not_cancelled(self):
+                    raise TaskCancelledException("task cancelled")
+
+            dl = SearchDeadline(None, task=_CancelledTask())
+            out = idx.search_batch(
+                [{"query": {"match": {"body": "t0"}}, "size": 5},
+                 {"query": {"match": {"body": "t1 t2"}}, "size": 5}],
+                [dl, None])
+            assert isinstance(out[0], TaskCancelledException)
+            assert isinstance(out[1], dict)
+            assert out[1]["hits"]["hits"]
+        finally:
+            idx.close()
+
+    def test_plane_fault_quarantines_once_per_batch(self):
+        idx = build_index(n_shards=3)
+        try:
+            scheme = PlaneFailScheme(planes=["mesh_pallas"]).install()
+            out = idx.search_batch(
+                [{"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                 {"query": {"match": {"body": "t1 t2"}}, "size": 5},
+                 {"query": {"match": {"body": "t3"}}, "size": 5}])
+            # every member still answered (host rung), one quarantine
+            for r in out:
+                assert isinstance(r, dict), r
+                assert r["_plane"] == "host"
+                assert r["hits"]["total"] > 0
+            ph = idx._mesh_search.plane_health
+            assert ph.failures_total["mesh_pallas"] == 1  # not Q times
+            assert scheme.hits == 1
+            assert "mesh_pallas" in ph.quarantined()
+        finally:
+            idx.close()
+
+    def test_duplicate_term_msm_member_matches_serial(self):
+        """Review regression: a repeated term under operator:and counts
+        each duplicate lane serially, but the union dedupes the posting
+        run — such members must execute serially, not lose all hits."""
+        idx = build_index(n_shards=1)
+        try:
+            dup = {"query": {"match": {"body": {
+                "query": "t1 t1", "operator": "and"}}}, "size": 5}
+            peer = {"query": {"match": {"body": "t2"}}, "size": 5}
+            serial = idx._search_uncached(dict(dup), skip_mesh=True)
+            out = idx.search_batch([dict(dup), dict(peer)])
+            assert isinstance(out[0], dict)
+            assert out[0]["hits"]["total"] == serial["hits"]["total"]
+            assert out[0]["hits"]["total"] > 0
+        finally:
+            idx.close()
+
+    def test_malformed_member_is_request_error_not_plane_fault(self):
+        """Review regression: a malformed body in a batch is that
+        member's 4xx, never a mesh_pallas quarantine."""
+        idx = build_index(n_shards=3)
+        try:
+            out = idx.search_batch(
+                [{"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                 {"query": {"nosuch_query": {}}, "size": 5}])
+            assert isinstance(out[0], dict)
+            assert isinstance(out[1], Exception)
+            ph = idx._mesh_search.plane_health
+            assert ph.failures_total["mesh_pallas"] == 0
+            assert ph.available("mesh_pallas")
+        finally:
+            idx.close()
+
+    def test_batch_settings_dynamic_via_cluster_settings(self):
+        """Review regression: search.batch.* are dynamic — a cluster
+        settings update must reach existing indices' live batchers."""
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings())
+        node.create_index("dyn", {"settings": {"number_of_shards": 1}})
+        batcher = node.indices["dyn"]._batcher
+        assert batcher.enabled is True
+        node.put_cluster_settings({"transient": {
+            "search.batch.enabled": False,
+            "search.batch.max_queries": 5,
+            "search.batch.window_ms": 1.5}})
+        assert batcher.enabled is False
+        assert batcher.max_queries == 5
+        assert abs(batcher.window_s - 0.0015) < 1e-9
+
+    def test_stats_block_exported(self):
+        idx = build_index(n_shards=2)
+        try:
+            idx.search_batch(
+                [{"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                 {"query": {"match": {"body": "t1"}}, "size": 5}])
+            batch = idx.stats()["primaries"]["search"]["batch"]
+            assert batch["batched_query_total"] == 2
+            assert batch["batch_size_histogram"] == {"2": 1}
+            assert "batch_window_waits_total" in batch
+        finally:
+            idx.close()
+
+
+@pytest.mark.slow
+class TestPackedMeshBatchedBurst:
+    """The dryrun_multichip phase-3 assertion as a test: a PACKED mesh
+    corpus (segments > devices, slot packing) serves a concurrent burst
+    via ONE batched mesh_pallas launch."""
+
+    def test_packed_corpus_burst_one_launch(self):
+        idx = IndexService("packed-burst", Settings({
+            "index.number_of_shards": 8,
+            "index.refresh_interval": -1}), mapping=MAPPING)
+        try:
+            rng = np.random.RandomState(5)
+            vocab = [f"t{i}" for i in range(15)]
+            for batch in range(2):  # two refreshes: 2 segments/shard
+                for d in range(batch * 64, (batch + 1) * 64):
+                    toks = [vocab[rng.randint(len(vocab))]
+                            for _ in range(rng.randint(3, 9))]
+                    idx.index_doc(str(d), {"body": " ".join(toks),
+                                           "n": d, "tag": "x"})
+                idx.refresh()
+            import jax
+
+            n_pairs = sum(
+                1 for sid in idx.shards
+                for seg in idx.shards[sid].engine.searchable_segments()
+                if seg.num_docs > 0)
+            assert n_pairs > len(jax.devices()), "corpus must pack slots"
+            burst = [
+                {"query": {"match": {"body": "t0 t1"}}, "size": 5},
+                {"query": {"match": {"body": "t2"}}, "size": 4},
+                {"query": {"match": {"body": "t3 t4 t5"}}, "size": 6},
+                {"query": {"match": {"body": "t1 t6"}}, "size": 5},
+            ]
+            out = idx.search_batch([dict(b) for b in burst])
+            assert idx._mesh_search.batched_launch_total == 1
+            assert (idx.batch_stats.as_dict()["batched_query_total"]
+                    == len(burst))
+            for body, got in zip(burst, out):
+                assert isinstance(got, dict), got
+                assert got["_plane"] == "mesh_pallas", got
+                assert_member_parity(idx, body, got)
+        finally:
+            idx.close()
+
+
+class TestMicroBatcher:
+    def test_no_concurrency_goes_direct(self):
+        stats = BatchStats()
+        mb = MicroBatcher(window_s=0.5, max_queries=8, stats=stats)
+        t0 = time.monotonic()
+        out = mb.run("k", 1, single_fn=lambda x: x * 10,
+                     batch_fn=lambda items: [x * 100 for x in items])
+        assert out == 10  # unbatched path
+        assert time.monotonic() - t0 < 0.25  # no window paid
+        assert stats.as_dict()["batch_window_waits_total"] == 0
+
+    def test_concurrent_submissions_batch(self):
+        stats = BatchStats()
+        mb = MicroBatcher(window_s=0.3, max_queries=8, stats=stats)
+        start = threading.Barrier(3)
+        results = {}
+
+        def slow_single(x):
+            # keep the inflight slot occupied long enough that the other
+            # two submissions demonstrably overlap and form one group
+            time.sleep(0.15)
+            return ("single", x)
+
+        def worker(i):
+            start.wait()
+            results[i] = mb.run(
+                "k", i, single_fn=slow_single,
+                batch_fn=lambda items: [("batch", x) for x in items])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # one thread won the no-concurrency race and went direct; the
+        # other two met in one batch
+        kinds = sorted(kind for kind, _ in results.values())
+        assert kinds.count("batch") >= 2
+        for i in range(3):
+            assert results[i][1] == i
+        assert stats.as_dict()["batch_window_waits_total"] == 1
+
+    def test_full_group_seals_at_max_queries(self):
+        mb = MicroBatcher(window_s=5.0, max_queries=2)
+        blocker = threading.Event()
+        results = {}
+
+        def occupy():
+            mb.run("other", 0,
+                   single_fn=lambda x: blocker.wait(5.0),
+                   batch_fn=lambda items: [None for _ in items])
+
+        def worker(i):
+            results[i] = mb.run(
+                "k", i, single_fn=lambda x: ("single", x),
+                batch_fn=lambda items: [("batch", x) for x in items])
+
+        t0 = threading.Thread(target=occupy)
+        t0.start()
+        time.sleep(0.05)  # occupy() holds the inflight slot
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(2)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        blocker.set()
+        t0.join(10.0)
+        # the full group dispatched WITHOUT waiting the 5s window
+        assert time.monotonic() - t_start < 4.0
+        assert results[0] == ("batch", 0)
+        assert results[1] == ("batch", 1)
+
+    def test_member_exception_isolated(self):
+        mb = MicroBatcher(window_s=0.2, max_queries=4)
+        start = threading.Barrier(2)
+        outcomes = {}
+
+        def batch_fn(items):
+            return [ValueError(f"boom-{x}") if x == 1 else ("ok", x)
+                    for x in items]
+
+        def worker(i):
+            start.wait()
+            try:
+                outcomes[i] = mb.run("k", i,
+                                     single_fn=lambda x: ("ok", x),
+                                     batch_fn=batch_fn)
+            except ValueError as e:
+                outcomes[i] = e
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # whichever member carried x == 1 got ITS error; the other
+        # member's result is intact (one went direct if it won the race)
+        vals = list(outcomes.values())
+        assert any(v == ("ok", 0) for v in vals)
+        assert any(isinstance(v, ValueError) or v == ("ok", 1)
+                   for v in vals if v != ("ok", 0))
+
+    def test_batchable_body_filter(self):
+        assert batchable_body({"query": {"match": {"body": "x"}}})
+        assert batchable_body({"query": {"term": {"tag": "a"}},
+                               "size": 3, "min_score": 0.5,
+                               "aggs": {"t": {"terms": {"field": "tag"}}}})
+        assert not batchable_body({})  # no query
+        assert not batchable_body({"query": {"match_all": {}},
+                                   "profile": True})
+        assert not batchable_body({"query": {"match": {"b": "x"}},
+                                   "collapse": {"field": "tag"}})
