@@ -425,6 +425,22 @@ def run_measurement() -> dict:
             traceback.print_exc(file=sys.stderr)
             extra_configs["mesh_pallas_packed"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        # ISSUE 6 acceptance configs: bit-packed postings codec and
+        # block-max pruned scoring (each recall-gated vs the RAW oracle)
+        try:
+            packed_cfg, pruned_cfg = run_codec_pruning_configs(
+                jax, jnp, psc, corpus, dev, geom, frac, bmin, bmax,
+                cb_run, term_sets)
+            extra_configs["packed_postings"] = packed_cfg
+            extra_configs["pruned_scoring"] = pruned_cfg
+        except Exception as e:  # noqa: BLE001 — recorded, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            extra_configs["packed_postings"] = {
+                "error": f"{type(e).__name__}: {e}"}
+            extra_configs["pruned_scoring"] = {
+                "error": f"{type(e).__name__}: {e}"}
 
     # ---------------- timings: legacy scatter path (r03) ----------------
     legacy_p50 = legacy_p50_2 = None
@@ -538,6 +554,32 @@ def run_measurement() -> dict:
                   "tunnel's fixed ~70ms per-sync overhead (its "
                   "block_until_ready does not await completion, so naive "
                   "per-call timing is meaningless on this backend)")
+        # ISSUE 6: the headline reports the best codec/pruning mode that
+        # PASSED its recall gate (recall@10 == 1.0 vs the raw oracle) —
+        # and says which mode produced it. Raw exhaustive remains the
+        # floor: a failed gate or slower config can never claim it.
+        headline_mode = {"config": "main", "postings_codec": "raw",
+                         "pruning": False}
+        if isinstance(extra_configs, dict):
+            for cfg_name, mode in (
+                    ("packed_postings",
+                     {"postings_codec": "packed", "pruning": False}),
+                    ("pruned_scoring",
+                     {"postings_codec": "packed", "pruning": True})):
+                cfg = extra_configs.get(cfg_name)
+                if not isinstance(cfg, dict):
+                    continue
+                cfg_p50 = cfg.get("p50_ms")
+                if (cfg.get("recall_at_10") == 1.0
+                        and isinstance(cfg_p50, (int, float))
+                        and cfg_p50 < p50):
+                    p50 = cfg_p50
+                    p50_2 = cfg_p50 + cfg.get("p50_spread_ms", 0.0)
+                    headline_mode = dict(mode, config=cfg_name)
+                    bq = cfg.get("bytes_per_query_mb_pruned",
+                                 cfg.get("bytes_per_query_mb_packed"))
+                    if bq is not None:
+                        bytes_per_query = bq * 1e6
     else:
         p50, p50_2 = legacy_p50, legacy_p50_2
         path = "xla_scatter_fallback"
@@ -547,6 +589,8 @@ def run_measurement() -> dict:
         extra_configs = {"skipped": "kernel path unavailable"}
         stage = None
         recall = 1.0
+        headline_mode = {"config": "main", "postings_codec": "raw",
+                         "pruning": False}
         method = ("legacy XLA scatter program, marginal batch timing")
 
     hbm_gbps = bytes_per_query / (p50 / 1000) / 1e9
@@ -559,6 +603,9 @@ def run_measurement() -> dict:
         "extra": {
             "backend": platform,
             "path": path,
+            # which postings codec / pruning mode produced the headline
+            # value (ISSUE 6): only recall-gated configs may claim it
+            "headline_mode": headline_mode,
             # marginal batch timing cannot observe per-query tails; a
             # second independent estimate bounds run-to-run dispersion
             "p50_second_estimate_ms": round(p50_2, 3),
@@ -924,6 +971,220 @@ def run_batched_qps_config(jax, jnp, psc, corpus, dev, geom, frac,
             f"({per_query:.3f} ms/query, {qps:.0f} qps, "
             f"t_pad={t_pad_run}, recall={recall_min})")
     return out
+
+
+def run_codec_pruning_configs(jax, jnp, psc, corpus, dev, geom, frac,
+                              bmin, bmax, cb_run, term_sets):
+    """ISSUE 6 configs on the 1M corpus, same query mix as the headline:
+
+    - ``packed_postings``: the bit-packed postings codec — one i32 word
+      per posting, decoded in-kernel — exhaustive scoring. Halves the
+      posting-window HBM bytes the kernel is bandwidth-bound on.
+    - ``pruned_scoring``: block-max pruned top-k over the packed corpus
+      (probe pass seeds the threshold, rest tiles skip when their summed
+      block-max bound cannot beat it; the threshold never leaves the
+      device — no per-query D2H sync).
+
+    Both recall-gate EVERY measured aspect against the RAW numpy oracle
+    (quantization is lossy by ~2.7e-4 absolute; the gate is what decides
+    whether the codec/pruning mode may claim the headline)."""
+    import numpy as np
+
+    out_packed, out_pruned = {}, {}
+    nd_pad = corpus["nd_pad"]
+    n_gate = 8  # queries recall-gated per config
+
+    def lanes_for(terms):
+        return [psc.QueryLane(int(corpus["term_block_start"][t]),
+                              int(corpus["n_blocks_per_term"][t]),
+                              idf(int(corpus["term_df"][t])))
+                for t in terms]
+
+    def time_min3(fn):
+        for _ in range(2):
+            fn()
+        o = None
+        for _ in range(200):
+            o = fn()
+        np.asarray(o[0])
+        ests = sorted(measure_marginal(lambda _q: fn(), [None])
+                      for _ in range(3))
+        return ests[0] * 1000, (ests[-1] - ests[0]) * 1000
+
+    def recall_gate(top_s, top_d, terms):
+        """Measured (recall@10, max score error) vs the RAW oracle.
+
+        Never raises: the gate's job is to MEASURE — a failed gate
+        demotes the config from headline contention (recall < 1.0),
+        it must not crash the config into an error dict. The score
+        tolerance carries an ABSOLUTE term: quantization error is
+        absolute (~(k1+1)/2^13), so a relative-only check would flag
+        legitimately low-scoring queries."""
+        qb_pad = 1
+        nb = sum(int(corpus["n_blocks_per_term"][t]) for t in terms)
+        while qb_pad < nb:
+            qb_pad *= 2
+        ref_s, ref_i = numpy_reference_query(
+            corpus, make_query_legacy(corpus, terms, qb_pad))
+        got_s = np.asarray(top_s).reshape(-1)
+        got_d = np.asarray(top_d).reshape(-1)
+        err = float(np.abs(got_s - ref_s).max())
+        tol = 2e-3 * float(np.abs(ref_s).max()) + 4 * psc.PACK_FRAC_SCALE
+        recall = len(set(got_d.tolist()) & set(ref_i.tolist())) / K
+        if err > tol:
+            recall = min(recall, 0.0)  # scores off the rails: fail gate
+        return recall, err
+
+    # ---- staging: the packed corpus (one word per posting) ----
+    t0 = time.perf_counter()
+    pk = psc.pack_segment_blocks(corpus["block_docs"], frac, nd_pad)
+    dev_pk = jnp.asarray(pk)
+    dev_pk.block_until_ready()
+    stage_s = time.perf_counter() - t0
+    raw_bytes = int(dev["docs"].size * 4 + dev["frac"].size * 4)
+    packed_bytes = int(pk.nbytes)
+    log(f"packed staging: {packed_bytes / 1e6:.0f} MB (raw "
+        f"{raw_bytes / 1e6:.0f} MB) in {stage_s:.1f}s")
+
+    timed_terms = term_sets[WARMUP:]
+    tables = []
+    for ts in timed_terms:
+        rl, rh, w, _ = psc.build_tile_tables(
+            lanes_for(ts), bmin, bmax, geom, t_pad=4, cb=cb_run)
+        tables.append((rl, rh, w))
+    staged_kq = [(jnp.asarray(rl), jnp.asarray(rh), jnp.asarray(w))
+                 for rl, rh, w in tables]
+
+    # ---- config: packed_postings (exhaustive, packed codec) ----
+    try:
+        @jax.jit
+        def _packed_fused(pkc, live_t, rl, rh, w):
+            ts_, td_, th_ = psc.score_tiles(
+                pkc, None, live_t, rl, rh, w,
+                t_pad=4, cb=cb_run, sub=geom.tile_sub, k=K,
+                codec="packed")
+            return psc.merge_tile_topk(ts_, td_, th_, K)
+
+        cycle = {"i": 0}
+
+        def run_packed():
+            q = staged_kq[cycle["i"] % len(staged_kq)]
+            cycle["i"] += 1
+            return _packed_fused(dev_pk, dev["live_t"], *q)
+
+        recall_min, err_max = 1.0, 0.0
+        for i in range(n_gate):
+            top_s, top_d, _h = _packed_fused(dev_pk, dev["live_t"],
+                                             *staged_kq[i])
+            recall, err = recall_gate(top_s, top_d, timed_terms[i])
+            recall_min = min(recall_min, recall)
+            err_max = max(err_max, err)
+        cycle["i"] = 0
+        p50p, spreadp = time_min3(run_packed)
+        # posting windows stream as ONE word (4 B) instead of 8 B
+        bytes_packed = (
+            geom.n_tiles * 4 * (2 * cb_run) * BLOCK * 4
+            + geom.n_tiles * geom.tile_w * 4
+            + geom.n_tiles * (2 * K + 1) * 4)
+        out_packed = {
+            "p50_ms": round(p50p, 3),
+            "p50_spread_ms": round(spreadp, 3),
+            "recall_at_10": recall_min,
+            "max_score_abs_err_vs_raw": round(err_max, 6),
+            "bytes_per_query_mb_packed": round(bytes_packed / 1e6, 2),
+            "postings_bytes_staged_mb": round(packed_bytes / 1e6, 1),
+            "postings_bytes_staged_raw_mb": round(raw_bytes / 1e6, 1),
+            "stage_seconds": round(stage_s, 2),
+            "note": ("bit-packed postings decoded in-kernel: half the "
+                     "posting-window HBM bytes and half the staged "
+                     "posting bytes; recall measured vs the RAW oracle "
+                     "(frac quantized to 12 bits over (0, k1+1))"),
+        }
+        log(f"packed_postings: {p50p:.3f} ms, recall={recall_min}")
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        out_packed = {"error": f"{type(e).__name__}: {e}"}
+
+    # ---- config: pruned_scoring (block-max pruning over packed) ----
+    try:
+        probe = 8
+        bfmax = psc.block_frac_max(
+            psc.dequantize_frac(psc.quantize_frac(frac)))
+        plans = []
+        for (rl, rh, w) in tables:
+            plan = psc.plan_pruned_tiles(rl, rh, w, bfmax,
+                                         probe_tiles=probe)
+            assert plan is not None, "corpus too small to prune"
+            plans.append(plan)
+        staged_pr = [
+            tuple(jnp.asarray(x) for x in (
+                p["rl_probe"], p["rh_probe"], p["tid_probe"],
+                p["rl_rest"], p["rh_rest"], p["tid_rest"],
+                p["bounds_rest"], t[2]))
+            for p, t in zip(plans, tables)]
+
+        def run_pruned_q(q):
+            (rlp, rhp, tidp, rlr, rhr, tidr, br, w) = q
+            return psc.score_tiles_pruned(
+                dev_pk, None, dev["live_t"], rlp, rhp, tidp,
+                rlr, rhr, tidr, br, w,
+                t_pad=4, cb=cb_run, sub=geom.tile_sub, k=K,
+                codec="packed")
+
+        cycle = {"i": 0}
+
+        def run_pruned():
+            q = staged_pr[cycle["i"] % len(staged_pr)]
+            cycle["i"] += 1
+            return run_pruned_q(q)
+
+        recall_min, err_max = 1.0, 0.0
+        scored_total = 0
+        tiles_total = 0
+        for i in range(n_gate):
+            top_s, top_d, _h, scored = run_pruned_q(staged_pr[i])
+            recall, err = recall_gate(top_s, top_d, timed_terms[i])
+            recall_min = min(recall_min, recall)
+            err_max = max(err_max, err)
+            scored_total += int(scored)
+            tiles_total += geom.n_tiles
+        pruned_fraction = 1.0 - scored_total / max(tiles_total, 1)
+        cycle["i"] = 0
+        p50r, spreadr = time_min3(run_pruned)
+        scored_avg = scored_total / n_gate
+        # only SCORED tiles stream their posting windows + live slabs
+        bytes_pruned = scored_avg * (
+            4 * (2 * cb_run) * BLOCK * 4 + geom.tile_w * 4) \
+            + geom.n_tiles * (2 * K + 1) * 4 * 2
+        out_pruned = {
+            "p50_ms": round(p50r, 3),
+            "p50_spread_ms": round(spreadr, 3),
+            "recall_at_10": recall_min,
+            "max_score_abs_err_vs_raw": round(err_max, 6),
+            "probe_tiles": probe,
+            "tiles_scored_avg": round(scored_avg, 1),
+            "tiles_total": geom.n_tiles,
+            "tiles_pruned_fraction": round(pruned_fraction, 3),
+            "tiles_pruned_total": tiles_total - scored_total,
+            "bytes_per_query_mb_pruned": round(bytes_pruned / 1e6, 2),
+            "note": ("block-max pruned top-k over the packed corpus: "
+                     "the probe pass scores the 8 highest-bound tiles, "
+                     "the rest run only if their bound beats the "
+                     "running k-th score (threshold computed on-device "
+                     "— no per-query host sync); under pruning hit "
+                     "totals are a lower bound (WAND semantics)"),
+        }
+        log(f"pruned_scoring: {p50r:.3f} ms, recall={recall_min}, "
+            f"scored {scored_avg:.1f}/{geom.n_tiles} tiles")
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        out_pruned = {"error": f"{type(e).__name__}: {e}"}
+
+    return out_packed, out_pruned
 
 
 def run_mesh_pallas_config(jax, jnp, lax, psc, corpus, term_sets,

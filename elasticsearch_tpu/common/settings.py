@@ -352,10 +352,19 @@ class AbstractScopedSettings:
         self._listeners.append((setting, consumer))
 
     def apply_settings(self, old: Settings, new: Settings) -> None:
-        """Fire update consumers for settings whose value changed."""
+        """Fire update consumers for settings whose value changed.
+
+        The raw string participates alongside the typed value: an
+        EXPLICIT update to a value that happens to equal the setting's
+        default (e.g. flipping a node-file-enabled boolean back off via
+        PUT _cluster/settings) must still reach consumers — the typed
+        comparison alone reads absent-and-default == explicit-default
+        and would swallow it. Consumers are idempotent setters, so the
+        extra fires are harmless."""
         for setting, consumer in self._listeners:
             before, after = setting.get(old), setting.get(new)
-            if before != after:
+            if before != after or old.get(setting.key) != new.get(
+                    setting.key):
                 consumer(after)
 
 
@@ -499,6 +508,51 @@ SEARCH_PALLAS_TILES_PER_STEP = Setting(
     validator=_validate_tiles_per_step,
 )
 
+# --- postings codec + block-max pruned scoring (docs/PRUNING.md) ---
+
+SEARCH_PALLAS_POSTINGS_CODEC = Setting.str_setting(
+    # node-wide default postings representation for the tile-scoring
+    # kernel's HBM staging: "raw" = (docs i32, frac f32) pairs
+    # (historical, bit-exact); "packed" = one bit-packed i32 word per
+    # posting (half the staged bytes AND half the per-query posting DMA
+    # traffic; frac quantized to 12 bits — see docs/PRUNING.md for the
+    # parity trade-off). Exported via ES_TPU_PALLAS_CODEC at startup;
+    # index.search.pallas.postings_codec overrides per index.
+    "search.pallas.postings_codec", "raw", choices={"raw", "packed"},
+)
+
+
+def _validate_probe_tiles(v):
+    # probe counts are shape-bucketed into the compiled pruned program;
+    # powers of two keep the variant count bounded. NB the probe/rest
+    # subset sizes need not divide search.pallas.tiles_per_step — the
+    # kernel clamps tps down to a divisor per launch, so small probe
+    # values (2, 4) quietly reduce the DMA double-buffering depth of the
+    # pruned passes (see score_tiles).
+    if v not in (2, 4, 8, 16, 32):
+        raise IllegalArgumentException(
+            f"Failed to parse value [{v}] for setting "
+            f"[search.pallas.pruning.probe_tiles]: must be one of "
+            f"2, 4, 8, 16, 32")
+
+
+SEARCH_PALLAS_PRUNING_ENABLED = Setting.bool_setting(
+    # block-max pruned top-k scoring on the mesh_pallas rung: skip tiles
+    # whose summed per-(tile, term) upper-bound impact cannot beat the
+    # running k-th score. Under pruning hit TOTALS become a documented
+    # lower bound (WAND semantics) — default off; exact-total consumers
+    # and dense-output queries (aggs, counts, sort) always run
+    # exhaustively regardless.
+    "search.pallas.pruning.enabled", False, dynamic=True
+)
+SEARCH_PALLAS_PRUNING_PROBE_TILES = Setting(
+    # how many highest-bound tiles the probe pass scores unconditionally
+    # to seed the pruning threshold (the block-size knob of the pruned
+    # program; bigger = better threshold, less pruning headroom)
+    "search.pallas.pruning.probe_tiles", 8, int,
+    validator=_validate_probe_tiles, dynamic=True,
+)
+
 NODE_SETTINGS = [
     CLUSTER_NAME,
     NODE_NAME,
@@ -537,6 +591,9 @@ NODE_SETTINGS = [
     SEARCH_BATCH_WINDOW_MS,
     SEARCH_BATCH_MAX_QUERIES,
     SEARCH_PALLAS_TILES_PER_STEP,
+    SEARCH_PALLAS_POSTINGS_CODEC,
+    SEARCH_PALLAS_PRUNING_ENABLED,
+    SEARCH_PALLAS_PRUNING_PROBE_TILES,
 ]
 
 # --- index-scoped ---
@@ -606,6 +663,14 @@ INDEX_SEARCH_MESH_PLANE = Setting.str_setting(
     "index.search.mesh.plane", "auto",
     choices={"auto", "pallas", "scatter"}, scope=Scope.INDEX
 )
+INDEX_SEARCH_PALLAS_POSTINGS_CODEC = Setting.str_setting(
+    # per-index override of the kernel-plane postings representation
+    # ("default" follows the node-wide search.pallas.postings_codec);
+    # consulted when segments/mesh tables stage, so a change applies to
+    # stagings performed AFTER it (docs/PRUNING.md)
+    "index.search.pallas.postings_codec", "default",
+    choices={"default", "raw", "packed"}, scope=Scope.INDEX
+)
 INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN = Setting.time_setting(
     # plane-health quarantine: after a mesh_pallas / mesh plane failure
     # (compile error, OOM, runtime fault) the plane is benched for this
@@ -627,6 +692,7 @@ INDEX_SETTINGS = [
     INDEX_SEARCH_MESH,
     INDEX_SEARCH_MESH_MAX_SLOTS,
     INDEX_SEARCH_MESH_PLANE,
+    INDEX_SEARCH_PALLAS_POSTINGS_CODEC,
     INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN,
     INDEX_SEARCH_SLOWLOG_WARN,
     INDEX_SEARCH_SLOWLOG_INFO,

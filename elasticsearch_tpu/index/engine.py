@@ -341,7 +341,19 @@ class Engine:
 
     def searchable_segments(self) -> List[Segment]:
         with self._lock:
-            return [s for s in self.segments if s.live_doc_count > 0 or s.num_docs == 0]
+            segs = [s for s in self.segments
+                    if s.live_doc_count > 0 or s.num_docs == 0]
+            codec = getattr(self, "postings_codec", None)
+            if codec is not None:
+                for s in segs:
+                    # index-setting preference for the kernel staging
+                    # (index.search.pallas.postings_codec); consulted
+                    # once at the segment's lazy device staging, so a
+                    # changed setting applies to segments staged AFTER
+                    # the change (docs/PRUNING.md)
+                    if getattr(s, "postings_codec", None) != codec:
+                        s.postings_codec = codec
+            return segs
 
     @property
     def num_docs(self) -> int:

@@ -78,6 +78,11 @@ class IndexService:
                                indexing_slowlog_source_chars=idx_slow_source)
             if gc_deletes is not None:
                 shard.engine.gc_deletes = gc_deletes
+            # postings codec preference for the tile-kernel staging
+            # (index.search.pallas.postings_codec; docs/PRUNING.md):
+            # "default" follows the node-wide ES_TPU_PALLAS_CODEC export
+            shard.engine.postings_codec = settings.get_str(
+                "index.search.pallas.postings_codec", "default")
             # slice resolution is shard-count-aware (SliceBuilder)
             shard.searcher.num_shards = self.num_shards
             shard.searcher.max_slices = settings.get_int(
@@ -364,6 +369,11 @@ class IndexService:
         }
         if out.get("terminated_early") is not None:
             resp["terminated_early"] = bool(out["terminated_early"])
+        if out.get("pruned") is not None:
+            # block-max pruned scoring served the query phase: surface
+            # the tile economy (and the gte-total semantics marker) next
+            # to _plane so bench/tests can assert pruning actually fired
+            resp["_pruned"] = out["pruned"]
         if out["aggregations"] is not None:
             resp["aggregations"] = out["aggregations"]
         if body.get("suggest"):
@@ -814,7 +824,7 @@ class IndexService:
         refs_window = (refs[from_: from_ + size] if size >= 0
                        else refs[from_:])
         hits = fetch_hits(refs_window, self.shards, body, self.name)
-        return {
+        resp = {
             "took": int((_time.monotonic() - t0) * 1000),
             "timed_out": False,
             # per-query truth: every member of the batch was scored by
@@ -826,6 +836,9 @@ class IndexService:
             "hits": {"total": out["total"], "max_score": out["max_score"],
                      "hits": hits},
         }
+        if out.get("pruned") is not None:
+            resp["_pruned"] = out["pruned"]
+        return resp
 
     def count(self, body: Optional[dict] = None) -> dict:
         body = dict(body or {})
@@ -892,6 +905,34 @@ class IndexService:
                    if self._mesh_search is not None else
                    {"plane_failures_total": {"mesh_pallas": 0, "mesh": 0},
                     "plane_quarantined": []}),
+                # block-max pruned scoring + postings codec observability
+                # (docs/PRUNING.md): queries served pruned, the tile
+                # economy, and what representation the postings stream as
+                "pruned_query_total": (
+                    self._mesh_search.pruned_query_total
+                    if self._mesh_search is not None else 0),
+                "tiles_scored_total": (
+                    self._mesh_search.tiles_scored_total
+                    if self._mesh_search is not None else 0),
+                "tiles_pruned_total": (
+                    self._mesh_search.tiles_pruned_total
+                    if self._mesh_search is not None else 0),
+                "postings_codec": (
+                    self._mesh_search._executor.postings_codec
+                    if self._mesh_search is not None
+                    and self._mesh_search._executor is not None
+                    else None),
+                # staged posting bytes: the mesh-plane staging plus every
+                # shard segment's host-plane kernel staging (raw stages
+                # 8 B/posting, packed 4 B — the restage cost ROADMAP
+                # item 3 tracks shrinks with it)
+                "postings_bytes_staged": (
+                    (self._mesh_search._executor.postings_bytes_staged
+                     if self._mesh_search is not None
+                     and self._mesh_search._executor is not None else 0)
+                    + sum(int(getattr(seg, "kernel_postings_bytes", 0))
+                          for sh in self.shards.values()
+                          for seg in sh.engine.searchable_segments())),
             },
             # cross-query micro-batching (docs/BATCHING.md): how much of
             # the traffic shared batched kernel launches, the dispatched

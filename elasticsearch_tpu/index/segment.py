@@ -321,7 +321,7 @@ class Segment:
                 "live": jnp.asarray(self.live),
                 "live1": jnp.asarray(live1),
             }
-        if "k_docs" not in self._device:
+        if "k_docs" not in self._device and "k_packed" not in self._device:
             # lazy: the pallas mode may turn on after the first staging
             # (ES_TPU_PALLAS flips in tests; backend selection at runtime)
             self._stage_kernel_arrays()
@@ -340,19 +340,37 @@ class Segment:
         frac = self._block_frac()
         bmin, bmax = psc.block_min_max(self.block_docs, self.block_tfs,
                                        self.nd_pad)
-        dp, fp = psc.pad_segment_blocks(self.block_docs, frac, self.nd_pad)
+        # postings codec (ISSUE 6, docs/PRUNING.md): "packed" stages ONE
+        # bit-packed i32 word per posting instead of the (docs i32,
+        # frac f32) pair — half the staged postings bytes AND half the
+        # per-query posting-window DMA traffic. Preference order: the
+        # per-segment stamp (engine inherits the index setting), else
+        # the node default (ES_TPU_PALLAS_CODEC), demoted to raw when
+        # the doc space exceeds the packed word's doc capacity.
+        codec = psc.resolve_postings_codec(
+            getattr(self, "postings_codec", None), self.nd_pad)
         # stage fully, then publish atomically: a concurrent search thread
         # must never observe k_docs without k_frac/k_live_t (dict.update
         # of a prebuilt dict is atomic under the GIL), and kernel_geom is
         # the eligibility signal so it is set LAST
         staged = {
-            "k_docs": jnp.asarray(dp),
-            "k_frac": jnp.asarray(fp),
             "k_live_t": jnp.asarray(
                 psc.build_live_t(self.live.astype(np.float32), geom)),
         }
+        if codec == "packed":
+            pk = psc.pack_segment_blocks(self.block_docs, frac,
+                                         self.nd_pad)
+            staged["k_packed"] = jnp.asarray(pk)
+            self.kernel_postings_bytes = int(pk.nbytes)
+        else:
+            dp, fp = psc.pad_segment_blocks(self.block_docs, frac,
+                                            self.nd_pad)
+            staged["k_docs"] = jnp.asarray(dp)
+            staged["k_frac"] = jnp.asarray(fp)
+            self.kernel_postings_bytes = int(dp.nbytes + fp.nbytes)
         self.kernel_bmin = bmin
         self.kernel_bmax = bmax
+        self.kernel_codec = codec
         self._device.update(staged)
         self.kernel_geom = geom
 
@@ -833,7 +851,8 @@ class PinnedSegmentView:
             live1 = np.concatenate([self.live, np.zeros(1, dtype=bool)])
             self._pin_device["live"] = jnp.asarray(self.live)
             self._pin_device["live1"] = jnp.asarray(live1)
-        if "k_docs" in base and "k_live_t" not in self._pin_device:
+        if (("k_docs" in base or "k_packed" in base)
+                and "k_live_t" not in self._pin_device):
             self._pin_device["k_live_t"] = self._build_pinned_live_t(
                 self._seg.kernel_geom.tile_sub)
         # shared immutable arrays come from the live segment; every

@@ -67,6 +67,15 @@ class Node:
         # (the env var is process-global — the last-constructed Node wins)
         os.environ["ES_TPU_PALLAS_TPS"] = str(
             int(SEARCH_PALLAS_TILES_PER_STEP.get(settings)))
+        # node-wide postings-codec default for the kernel staging
+        # (search.pallas.postings_codec; per-index override via
+        # index.search.pallas.postings_codec — docs/PRUNING.md)
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_PALLAS_POSTINGS_CODEC,
+        )
+
+        os.environ["ES_TPU_PALLAS_CODEC"] = str(
+            SEARCH_PALLAS_POSTINGS_CODEC.get(settings))
         # cross-query micro-batching knobs are DYNAMIC (docs/BATCHING.md):
         # a cluster-settings update must reach every index's live batcher
         # (an operator disabling batching mid-incident can't wait for a
@@ -93,6 +102,12 @@ class Node:
         self.cluster_settings.add_settings_update_consumer(
             SEARCH_BATCH_MAX_QUERIES,
             _batchers(lambda b, v: setattr(b, "max_queries", int(v))))
+        # (block-max pruning knobs are dynamic too, but they need
+        # EXPLICITNESS — an override must clear when the cluster key is
+        # removed so the index's own Settings win again — which the
+        # value-only consumer callback can't see; put_cluster_settings
+        # syncs svc.pruning_*_override from the committed merged
+        # settings instead. docs/PRUNING.md)
         self.data_path = data_path or PATH_DATA.get(settings)
         self.persistent_path = data_path is not None or "path.data" in settings
         # secure settings from the encrypted keystore (KeyStoreWrapper):
@@ -212,18 +227,21 @@ class Node:
                 aliases.setdefault(a, spec or {})
         merged_settings = merged_settings.merged_with(settings)
         _merge_mapping_dicts(merged_mappings, mappings)
-        # node-level micro-batching config (search.batch.* — node scope,
-        # docs/BATCHING.md) seeds each index's batcher at lowest
-        # precedence, with the CURRENT dynamic cluster settings on top:
-        # an index created after PUT _cluster/settings {search.batch.*}
+        # node-level micro-batching + pallas-plane config (search.batch.*
+        # / search.pallas.* — node scope, docs/BATCHING.md +
+        # docs/PRUNING.md) seeds each index at lowest precedence, with
+        # the CURRENT dynamic cluster settings on top: an index created
+        # after PUT _cluster/settings {search.batch.*, search.pallas.*}
         # must honor the live value, not the node file's (the update
-        # consumers only reach batchers alive at update time)
+        # consumers only reach batchers alive at update time; the pruning
+        # knobs are re-read per query from the index's Settings map)
         state = self.cluster_service.state
-        cluster_dynamic = state.persistent_settings.merged_with(
-            state.transient_settings).filtered_by_prefix("search.batch.")
-        merged_settings = self.settings.filtered_by_prefix(
-            "search.batch.").merged_with(cluster_dynamic).merged_with(
-            merged_settings)
+        for prefix in ("search.batch.", "search.pallas."):
+            cluster_dynamic = state.persistent_settings.merged_with(
+                state.transient_settings).filtered_by_prefix(prefix)
+            merged_settings = self.settings.filtered_by_prefix(
+                prefix).merged_with(cluster_dynamic).merged_with(
+                merged_settings)
 
         self.index_scoped_settings.validate(merged_settings, allow_unknown=True)
         svc = IndexService(name, merged_settings, merged_mappings,
@@ -1566,6 +1584,27 @@ class Node:
         # dynamic remote-cluster registration (search.remote.<alias>.seeds)
         self.remote_clusters.apply_settings(
             state.persistent_settings.merged_with(state.transient_settings))
+        # block-max pruning overrides (docs/PRUNING.md): win over each
+        # index's creation-time Settings while EXPLICITLY set in the
+        # cluster settings, and clear back to None (index settings win
+        # again) when absent — synced here from the committed state
+        # because the value-only update consumers can't see explicitness
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_PALLAS_PRUNING_ENABLED,
+            SEARCH_PALLAS_PRUNING_PROBE_TILES,
+        )
+
+        committed = state.persistent_settings.merged_with(
+            state.transient_settings)
+        for setting, attr in (
+                (SEARCH_PALLAS_PRUNING_ENABLED,
+                 "pruning_enabled_override"),
+                (SEARCH_PALLAS_PRUNING_PROBE_TILES,
+                 "pruning_probe_override")):
+            explicit = committed.get(setting.key) is not None
+            value = setting.get(committed) if explicit else None
+            for svc in self.indices.values():
+                setattr(svc, attr, value)
         return {
             "acknowledged": True,
             "persistent": state.persistent_settings.as_nested_dict(),

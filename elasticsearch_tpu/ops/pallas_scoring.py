@@ -91,6 +91,102 @@ def tiles_per_step_default() -> int:
 
 
 # ----------------------------------------------------------------------
+# Packed postings codec (ISSUE 6: break the bandwidth wall)
+#
+# The raw layout streams 8 bytes/posting (doc i32 + frac f32) out of HBM
+# for every covering window — BENCH_r05 measured the kernel bandwidth-
+# bound on exactly that traffic. The packed codec bit-packs each posting
+# into ONE i32 word:
+#
+#     word = (doc << PACK_FRAC_BITS) | frac_q        (frac_q in [1, 4095])
+#
+# and the kernel unpacks it in VMEM with one logical shift + one mask +
+# one i32->f32 convert before the existing two-pass scoring — half the
+# posting bytes per query (the Lucene analog: the FOR/bit-packed postings
+# codec of index/codec, SURVEY §2.3/§6, inverted for lane-parallel
+# decode). frac quantizes linearly over (0, K1+1) — BM25's frac =
+# tf(k1+1)/(tf + k1*norm) is strictly below k1+1 for any tf/norm, so the
+# scale is a static constant and no per-segment metadata rides along.
+# frac_q == 0 is the invalid/padding marker (exactly the frac > 0.0 rule
+# the raw kernel keys on), so real postings clamp to frac_q >= 1.
+#
+# Lossiness: |dequant(q) - frac| <= PACK_FRAC_SCALE/2 (~2.7e-4 absolute,
+# ~16x tighter than the bf16 rounding the two-pass compensation exists
+# for). Whether that reorders near-tied top-10 ranks is corpus-dependent,
+# which is why the codec is settings-gated (raw default) and bench gates
+# every packed config on measured recall@10 == 1.0 vs the RAW oracle.
+# ----------------------------------------------------------------------
+
+PACK_FRAC_BITS = 12
+PACK_FRAC_MASK = (1 << PACK_FRAC_BITS) - 1
+PACK_MAX_FRAC = float(K1) + 1.0  # strict upper bound of BM25 frac
+PACK_FRAC_SCALE = PACK_MAX_FRAC / PACK_FRAC_MASK
+# doc ids must fit the remaining bits (sentinels store doc 0 + frac_q 0)
+PACKED_DOC_CAP = 1 << (32 - PACK_FRAC_BITS)
+
+
+def packed_codec_ok(nd_pad: int) -> bool:
+    """The packed word holds 32 - PACK_FRAC_BITS doc bits: real doc ids
+    are < nd_pad, so any nd_pad <= 2^20 fits (the 1M bench corpus is
+    exactly the boundary); larger doc spaces stay on the raw codec."""
+    return nd_pad <= PACKED_DOC_CAP
+
+
+def quantize_frac(frac: np.ndarray) -> np.ndarray:
+    """frac f32 -> 12-bit code; 0 stays 0 (invalid marker), real postings
+    clamp to [1, PACK_FRAC_MASK] so frac > 0 survives the round trip."""
+    q = np.rint(frac / np.float32(PACK_FRAC_SCALE)).astype(np.int64)
+    q = np.clip(q, 1, PACK_FRAC_MASK)
+    return np.where(frac > 0.0, q, 0).astype(np.int32)
+
+
+def dequantize_frac(q: np.ndarray) -> np.ndarray:
+    """The exact f32 values the kernel's in-VMEM decode produces (the
+    oracle for packed-parity tests)."""
+    return (q.astype(np.float32) * np.float32(PACK_FRAC_SCALE)).astype(
+        np.float32)
+
+
+def pack_segment_blocks(block_docs: np.ndarray, block_frac: np.ndarray,
+                        sentinel: int,
+                        q: Optional[np.ndarray] = None) -> np.ndarray:
+    """Bit-pack (docs, frac) into one padded i32 word array — the packed
+    analog of pad_segment_blocks (CB_MAX all-zero sentinel rows keep the
+    double-window DMA in bounds; word 0 decodes to frac 0 = invalid).
+    ``q``: precomputed quantize_frac(block_frac), for callers that also
+    need the codes (block-max bounds) — quantization is a full-corpus
+    pass and should run once per staging."""
+    if not packed_codec_ok(int(sentinel)):
+        raise ValueError(
+            f"doc space {sentinel} exceeds the packed codec's "
+            f"{32 - PACK_FRAC_BITS}-bit doc capacity")
+    if q is None:
+        q = quantize_frac(block_frac.astype(np.float32))
+    docs = np.where(q > 0, block_docs, 0).astype(np.int64)
+    words = ((docs.astype(np.uint32) << PACK_FRAC_BITS)
+             | q.astype(np.uint32)).view(np.int32)
+    pad = np.zeros((CB_MAX, LANE), dtype=np.int32)
+    return np.concatenate([words, pad])
+
+
+def resolve_postings_codec(pref, nd_pad: int) -> str:
+    """Effective codec for a segment staging: the explicit preference
+    (index setting / caller), else the node-wide default exported via
+    ES_TPU_PALLAS_CODEC (search.pallas.postings_codec), demoted to raw
+    when the doc space exceeds the packed word's doc capacity."""
+    import os
+
+    codec = pref
+    if codec in (None, "default"):
+        codec = os.environ.get("ES_TPU_PALLAS_CODEC", "raw")
+    if codec not in ("raw", "packed"):
+        codec = "raw"
+    if codec == "packed" and not packed_codec_ok(nd_pad):
+        codec = "raw"
+    return codec
+
+
+# ----------------------------------------------------------------------
 # Host-side geometry: which docs does tile t get from term lane j?
 # ----------------------------------------------------------------------
 
@@ -310,12 +406,103 @@ def build_tile_tables_batched(
 
 
 # ----------------------------------------------------------------------
+# Block-max pruning (ISSUE 6): per-(tile, lane) upper-bound impacts
+# ----------------------------------------------------------------------
+
+
+def block_frac_max(block_frac: np.ndarray) -> np.ndarray:
+    """Per-block max posting impact factor [n_blocks] f32 — the block-max
+    metadata of WAND/MaxScore (SURVEY §6), computed at table-build time.
+
+    The max is taken over ALL real postings regardless of the live mask:
+    deletes mutate ``Segment.live`` in place after staging, and a bound
+    that ignored a since-deleted doc could undercount — keeping tombstoned
+    postings in the bound is conservative (a too-high bound only scores a
+    tile it could have skipped, never skips one it needed).
+
+    For the packed codec pass the DEQUANTIZED frac (dequantize_frac of
+    quantize_frac): rounding can lift a posting up to half a step ABOVE
+    its raw value, and the bound must dominate what the kernel actually
+    decodes."""
+    return block_frac.max(axis=1).astype(np.float32)
+
+
+def tile_lane_ub(row_lo: np.ndarray, row_hi: np.ndarray,
+                 bfmax: np.ndarray) -> np.ndarray:
+    """Per-(tile, lane) upper-bound frac over the tile's covering block
+    window [row_lo, row_hi) — a superset of the tile's real postings, so
+    max over it upper-bounds any in-tile posting's frac. [n_tiles, t_pad]
+    f32 (0 for empty windows / dead lanes).
+
+    Vectorized (this runs per slot per pruned query): windows are short
+    (<= the covering bucket), so a padded gather over [n_tiles,
+    max_window] per lane beats per-window Python slicing."""
+    n_tiles, t_pad = row_lo.shape
+    out = np.zeros((n_tiles, t_pad), np.float32)
+    n_blocks = len(bfmax)
+    for j in range(t_pad):
+        lo = row_lo[:, j].astype(np.int64)
+        hi = row_hi[:, j].astype(np.int64)
+        wmax = int((hi - lo).max()) if n_tiles else 0
+        if wmax <= 0:
+            continue
+        idx = lo[:, None] + np.arange(wmax)[None, :]
+        valid = idx < hi[:, None]
+        vals = np.where(valid,
+                        bfmax[np.minimum(idx, n_blocks - 1)], 0.0)
+        out[:, j] = vals.max(axis=1)
+    return out
+
+
+def plan_pruned_tiles(row_lo: np.ndarray, row_hi: np.ndarray,
+                      weights: np.ndarray, bfmax: np.ndarray,
+                      probe_tiles: int = 8,
+                      ub: Optional[np.ndarray] = None) -> Optional[dict]:
+    """Host half of block-max pruned scoring: order tiles by their summed
+    block-max score bound and split them into a PROBE set (scored
+    unconditionally, seeds the running top-k threshold) and a REST set
+    (scored only if its bound can still beat the threshold — decided
+    on-device, see score_tiles_pruned). Returns None when the tile count
+    is too small to prune (callers run the exhaustive kernel).
+
+    ``weights`` is the [Q, t_pad] per-query weight matrix (a single query
+    passes its [1, t_pad] row); bounds[t, q] = sum_j w[q, j] * ub[t, j]
+    upper-bounds ANY doc's score for query q within tile t — the
+    tile-granular WAND invariant the pruning tests property-check.
+
+    ``ub`` lets callers supply precomputed (cached) per-(tile, lane)
+    bounds — a lane's column depends only on (segment, geometry, posting
+    run), so it is invariant across queries naming the same term
+    (MeshPlanExecutor.tile_lane_ub_cached)."""
+    n_tiles = row_lo.shape[0]
+    probe = max(1, min(int(probe_tiles), n_tiles))
+    if n_tiles - probe <= 0:
+        return None
+    if ub is None:
+        ub = tile_lane_ub(row_lo, row_hi, bfmax)
+    bounds = (ub @ weights.T).astype(np.float32)  # [n_tiles, Q]
+    order = np.argsort(-bounds.max(axis=1), kind="stable").astype(np.int32)
+    sel_p, sel_r = order[:probe], order[probe:]
+    return {
+        "tid_probe": sel_p,
+        "rl_probe": np.ascontiguousarray(row_lo[sel_p]),
+        "rh_probe": np.ascontiguousarray(row_hi[sel_p]),
+        "tid_rest": sel_r,
+        "rl_rest": np.ascontiguousarray(row_lo[sel_r]),
+        "rh_rest": np.ascontiguousarray(row_hi[sel_r]),
+        "bounds_rest": np.ascontiguousarray(bounds[sel_r]),
+        "n_tiles": n_tiles,
+    }
+
+
+# ----------------------------------------------------------------------
 # The kernel
 # ----------------------------------------------------------------------
 
 
 def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
-                 with_counts: bool, tps: int = 1, q_batch: int = 1):
+                 with_counts: bool, tps: int = 1, q_batch: int = 1,
+                 codec: str = "raw", with_sel: bool = False):
     """Kernel body. Mosaic constraints shape the formulation:
 
     - only lane-collapsing reshapes ((cb,128) -> (1, cb*128)) lower; the
@@ -351,29 +538,95 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
     emits per-query candidate rows. q_batch == 1 keeps the historical
     single-query formulation bit-for-bit (weights folded into the
     one-hot before the matmul), so the unbatched path is untouched.
+
+    ``codec`` (bit-packed postings, ISSUE 6): "packed" DMAs ONE i32 word
+    per posting — (doc << PACK_FRAC_BITS) | frac_q — and decodes it in
+    VMEM with a logical shift + mask + i32->f32 convert before the
+    unchanged two-pass scoring, halving the posting-window HBM traffic
+    the kernel is bound on. "raw" keeps the historical (docs, frac) pair
+    layout untouched.
+
+    ``with_sel`` (block-max pruned scoring, ISSUE 6): the grid runs over
+    an arbitrary SUBSET of tiles named by a third scalar-prefetch array
+    ``tile_ids`` (row tables arrive pre-gathered in subset order). A
+    subset row whose windows are all empty (row_lo == row_hi == 0 — how
+    the pruned orchestration marks a skipped tile at runtime) writes
+    empty candidate rows without paying the top-k extraction, and its
+    window DMAs collapse onto block 0 (consecutive identical block
+    indices are not re-fetched by the pipeline), so a pruned tile costs
+    neither bandwidth nor MXU work.
     """
     w = sub * LANE
     # two consecutive cb-aligned DMA windows per lane; each processes its
     # cb rows independently so its whole compute block can be skipped
     rows = cb * LANE
+    packed = codec == "packed"
+    stride = 2 if packed else 4
 
-    def kernel(rowlo_ref, rowhi_ref, *refs):
+    def kernel(*all_refs):
+        if with_sel:
+            rowlo_ref, rowhi_ref, tid_ref = all_refs[:3]
+            refs = all_refs[3:]
+        else:
+            rowlo_ref, rowhi_ref = all_refs[:2]
+            refs = all_refs[2:]
+
         def dref(j, ti, half):
-            return refs[4 * (j * tps + ti) + 2 * half]
+            return refs[stride * (j * tps + ti) + 2 * half]
 
         def fref(j, ti, half):
-            return refs[4 * (j * tps + ti) + 2 * half + 1]
+            return refs[stride * (j * tps + ti) + 2 * half + 1]
 
-        base_in = 4 * t_pad * tps
-        live_ref = refs[base_in]
-        w_ref = refs[base_in + 1]
+        def pref(j, ti, half):
+            return refs[stride * (j * tps + ti) + half]
+
+        base_in = stride * t_pad * tps
+        n_live = tps if with_sel else 1
+        live_refs = refs[base_in: base_in + n_live]
+        w_ref = refs[base_in + n_live]
         n_outs = (1 + int(with_counts)) if dense else 3
-        outs = refs[base_in + 2: base_in + 2 + n_outs]
-        acc_ref = refs[base_in + 2 + n_outs]
-        cnt_ref = refs[base_in + 3 + n_outs] if with_counts else None
+        outs = refs[base_in + n_live + 1: base_in + n_live + 1 + n_outs]
+        acc_ref = refs[base_in + n_live + 1 + n_outs]
+        cnt_ref = (refs[base_in + n_live + 2 + n_outs]
+                   if with_counts else None)
         t = pl.program_id(0)
+
+        def tile_topk(accT, live, base):
+            """Per-(tile, query) fused top-k extraction (the historical
+            inline form, factored so the sel-mode branch shares it)."""
+            matched = (accT > jnp.float32(0.0)) & live
+            hits = jnp.sum(jnp.where(matched, jnp.float32(1.0),
+                                     jnp.float32(0.0)))
+            # float literals must be explicit f32: a weak python -inf
+            # traces as an f64 scalar inside the kernel and crashes the
+            # TPU compiler
+            ninf = jnp.float32(NEG_INF)
+            masked = jnp.where(matched, accT, ninf)
+            # local doc id at accT[lane, s] is s*128 + lane
+            lin = (lax.broadcasted_iota(jnp.int32, (LANE, sub), 1)
+                   * jnp.int32(LANE)
+                   + lax.broadcasted_iota(jnp.int32, (LANE, sub), 0))
+            outv_s = jnp.full((1, k), NEG_INF, jnp.float32)
+            outv_d = jnp.full((1, k), -1, jnp.int32)
+            k_iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
+            for i in range(k):
+                mx = jnp.max(masked)
+                sel = jnp.where(masked == mx, lin, jnp.int32(w))
+                idx = jnp.min(sel)
+                outv_s = jnp.where(k_iota == jnp.int32(i), mx, outv_s)
+                outv_d = jnp.where(
+                    k_iota == jnp.int32(i),
+                    jnp.where(mx == ninf, jnp.int32(-1), base + idx),
+                    outv_d)
+                masked = jnp.where(lin == idx, ninf, masked)
+            return hits, outv_s, outv_d
+
         for ti in range(tps):
-            tile = jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti)
+            pos = jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti)
+            # with_sel: the grid position indexes the pre-gathered row
+            # tables; the REAL tile id (doc base, live-mask row) comes
+            # from the prefetched selection array
+            tile = tid_ref[pos] if with_sel else pos
             base = tile * jnp.int32(w)
             # scratch accumulators persist across grid steps (and tiles
             # within a step): reset first (rows [q*LANE, (q+1)*LANE) hold
@@ -382,8 +635,8 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
             if with_counts:
                 cnt_ref[...] = jnp.zeros((q_batch * LANE, sub), jnp.float32)
             for j in range(t_pad):
-                rlo = rowlo_ref[tile, j]
-                rhi = rowhi_ref[tile, j]
+                rlo = rowlo_ref[pos, j]
+                rhi = rowhi_ref[pos, j]
                 # aligned first row actually DMA'd (mirrors lane_map below)
                 sb = lax.div(rlo, jnp.int32(cb)) * jnp.int32(cb)
                 wj = w_ref[0, j]
@@ -400,8 +653,23 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
                     @pl.when(needed)
                     def _(j=j, ti=ti, half=half, start=start, rlo=rlo,
                           rhi=rhi, wj=wj, base=base):
-                        docs = dref(j, ti, half)[...]
-                        frac = fref(j, ti, half)[...]
+                        if packed:
+                            # in-VMEM decode: one logical shift + one mask
+                            # + one i32->f32 convert per window — the DMA
+                            # streamed HALF the bytes of the raw layout.
+                            # shift_right_logical: doc 20 bits + frac 12
+                            # bits fills the word, so the sign bit can be
+                            # set and an arithmetic shift would smear it
+                            word = pref(j, ti, half)[...]
+                            docs = lax.shift_right_logical(
+                                word, jnp.int32(PACK_FRAC_BITS))
+                            fq = jnp.bitwise_and(
+                                word, jnp.int32(PACK_FRAC_MASK))
+                            frac = fq.astype(jnp.float32) * jnp.float32(
+                                PACK_FRAC_SCALE)
+                        else:
+                            docs = dref(j, ti, half)[...]
+                            frac = fref(j, ti, half)[...]
                         blk = start + lax.broadcasted_iota(
                             jnp.int32, (cb, LANE), 0)
                         local = docs - base
@@ -499,13 +767,49 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
                                         jnp.where(wq > jnp.float32(0.0),
                                                   ccontrib,
                                                   jnp.float32(0.0))
+            if with_sel:
+                # sel mode serves the fused top-k only. A runtime-skipped
+                # tile (all windows empty — the pruned orchestration
+                # zeroed its row table) writes empty candidate rows and
+                # pays neither the live-mask DMA nor the top-k loop.
+                scored = rowhi_ref[pos, 0] > rowlo_ref[pos, 0]
+                for j in range(1, t_pad):
+                    scored = jnp.logical_or(
+                        scored, rowhi_ref[pos, j] > rowlo_ref[pos, j])
+                out_s, out_d, out_h = outs
+
+                @pl.when(jnp.logical_not(scored))
+                def _(ti=ti):
+                    for q in range(q_batch):
+                        out_h[pl.ds(ti, 1), pl.ds(q, 1)] = jnp.zeros(
+                            (1, 1, 1), jnp.float32)
+                        out_s[pl.ds(ti, 1), pl.ds(q, 1)] = jnp.full(
+                            (1, 1, k), NEG_INF, jnp.float32)
+                        out_d[pl.ds(ti, 1), pl.ds(q, 1)] = jnp.full(
+                            (1, 1, k), -1, jnp.int32)
+
+                @pl.when(scored)
+                def _(ti=ti, base=base):
+                    live = live_refs[ti][...] > jnp.float32(0.0)
+                    for q in range(q_batch):
+                        accT = (acc_ref[...] if q_batch == 1
+                                else acc_ref[pl.ds(q * LANE, LANE), :])
+                        hits, outv_s, outv_d = tile_topk(accT, live, base)
+                        out_h[pl.ds(ti, 1), pl.ds(q, 1)] = \
+                            hits.reshape(1, 1, 1)
+                        out_s[pl.ds(ti, 1), pl.ds(q, 1)] = \
+                            outv_s.reshape(1, 1, k)
+                        out_d[pl.ds(ti, 1), pl.ds(q, 1)] = \
+                            outv_d.reshape(1, 1, k)
+                continue
             # (LANE, sub) transposed live slab for THIS tile (shared by
             # every query of the batch); tps==1 keeps the historical
             # full-block access pattern
             if tps == 1:
-                live = live_ref[...] > jnp.float32(0.0)
+                live = live_refs[0][...] > jnp.float32(0.0)
             else:
-                live = live_ref[pl.ds(ti * LANE, LANE), :] > jnp.float32(0.0)
+                live = live_refs[0][pl.ds(ti * LANE, LANE), :] \
+                    > jnp.float32(0.0)
             for q in range(q_batch):
                 if q_batch == 1:
                     accT = acc_ref[...]
@@ -535,31 +839,7 @@ def _make_kernel(t_pad: int, cb: int, sub: int, k: int, dense: bool,
                                 jnp.where(live, cntT, jnp.float32(0.0))[None]
                     continue
                 out_s, out_d, out_h = outs
-                matched = (accT > jnp.float32(0.0)) & live
-                hits = jnp.sum(jnp.where(matched, jnp.float32(1.0),
-                                         jnp.float32(0.0)))
-                # float literals must be explicit f32: a weak python -inf
-                # traces as an f64 scalar inside the kernel and crashes the
-                # TPU compiler
-                ninf = jnp.float32(NEG_INF)
-                masked = jnp.where(matched, accT, ninf)
-                # local doc id at accT[lane, s] is s*128 + lane
-                lin = (lax.broadcasted_iota(jnp.int32, (LANE, sub), 1)
-                       * jnp.int32(LANE)
-                       + lax.broadcasted_iota(jnp.int32, (LANE, sub), 0))
-                outv_s = jnp.full((1, k), NEG_INF, jnp.float32)
-                outv_d = jnp.full((1, k), -1, jnp.int32)
-                k_iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
-                for i in range(k):
-                    mx = jnp.max(masked)
-                    sel = jnp.where(masked == mx, lin, jnp.int32(w))
-                    idx = jnp.min(sel)
-                    outv_s = jnp.where(k_iota == jnp.int32(i), mx, outv_s)
-                    outv_d = jnp.where(
-                        k_iota == jnp.int32(i),
-                        jnp.where(mx == ninf, jnp.int32(-1), base + idx),
-                        outv_d)
-                    masked = jnp.where(lin == idx, ninf, masked)
+                hits, outv_s, outv_d = tile_topk(accT, live, base)
                 if q_batch > 1:
                     out_h[pl.ds(ti, 1), pl.ds(q, 1)] = hits.reshape(1, 1, 1)
                     out_s[pl.ds(ti, 1), pl.ds(q, 1)] = outv_s.reshape(1, 1, k)
@@ -586,11 +866,12 @@ def _compiler_params():
 @functools.partial(
     jax.jit,
     static_argnames=("t_pad", "cb", "sub", "k", "dense", "with_counts",
-                     "interpret", "tiles_per_step", "q_batch"),
+                     "interpret", "tiles_per_step", "q_batch", "codec"),
 )
 def score_tiles(
-    docs_padded,  # [n_blocks + CB_MAX, LANE] i32 (pad_segment_blocks)
-    frac_padded,  # [n_blocks + CB_MAX, LANE] f32
+    docs_padded,  # [n_blocks + CB_MAX, LANE] i32 (pad_segment_blocks);
+    # codec="packed": the packed word array (pack_segment_blocks)
+    frac_padded,  # [n_blocks + CB_MAX, LANE] f32; codec="packed": None
     live_t,  # [n_tiles * LANE, sub] f32 (1.0 = live; build_live_t)
     row_lo,  # [n_tiles, t_pad] i32
     row_hi,  # [n_tiles, t_pad] i32
@@ -605,6 +886,9 @@ def score_tiles(
     interpret: bool = False,
     tiles_per_step: int = 1,
     q_batch: int = 1,
+    codec: str = "raw",
+    tile_ids=None,  # [n_sel] i32: score ONLY these tiles (row tables
+    # pre-gathered in the same order); fused top-k variant only
 ):
     """Run the tile-scoring kernel over a segment.
 
@@ -630,7 +914,21 @@ def score_tiles(
     and weights carries one row per query (0 = lane dead for that query).
     Corpus bytes stream ONCE per tile for the whole batch; per-query cost
     reduces to one scale-add per live lane plus the per-tile top-k loop.
+
+    codec="packed" streams the bit-packed posting words instead of the
+    (docs, frac) pair — HALF the posting bytes — and decodes in-kernel
+    (pass the pack_segment_blocks array as docs_padded, frac_padded
+    None). tile_ids scores an arbitrary tile SUBSET (block-max pruning,
+    ISSUE 6): row_lo/row_hi arrive pre-gathered in subset order, outputs
+    have one candidate row per subset entry, and a runtime-zeroed row
+    (row_lo == row_hi == 0) is skipped without DMA or compute.
     """
+    with_sel = tile_ids is not None
+    if with_sel and (dense or with_counts):
+        # dense / match-count consumers need every tile's output —
+        # pruning's exhaustive-fallback contract lives one level up
+        raise ValueError(
+            "tile-subset scoring serves the fused top-k variant only")
     n_tiles = row_lo.shape[0]
     w = sub * LANE
     k = min(k, w)
@@ -651,7 +949,13 @@ def score_tiles(
         # lax.div (truncating) == floor-div for the non-negative row indices;
         # jnp's // lowers to a floor_divide jaxpr the mosaic index_map
         # rejects. half=0/1 selects the first/second cb-aligned window of
-        # tile t*tps + ti.
+        # tile t*tps + ti (sel mode: the SUBSET position — tables arrive
+        # pre-gathered, so position-indexing is correct there too).
+        if with_sel:
+            return lambda t, rlo, rhi, tid: (
+                lax.div(rlo[jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti),
+                            j],
+                        jnp.int32(cb)) + jnp.int32(half), zero())
         return lambda t, rlo, rhi: (
             lax.div(rlo[jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti), j],
                     jnp.int32(cb)) + jnp.int32(half), zero())
@@ -663,16 +967,34 @@ def score_tiles(
             for half in (0, 1):
                 in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, ti, half)))
                 operands.append(docs_padded)
-                in_specs.append(pl.BlockSpec((cb, LANE), lane_map(j, ti, half)))
-                operands.append(frac_padded)
-    in_specs.append(
-        pl.BlockSpec((tps * LANE, sub), lambda t, rlo, rhi: (t, zero())))
-    operands.append(live_t)
+                if codec != "packed":
+                    in_specs.append(
+                        pl.BlockSpec((cb, LANE), lane_map(j, ti, half)))
+                    operands.append(frac_padded)
+    if with_sel:
+        # per-tile live slabs indexed by the REAL tile id from the
+        # prefetched selection (a runtime-redirected skipped tile reads
+        # row 0 — consecutive identical indices are not re-fetched)
+        for ti in range(tps):
+            in_specs.append(pl.BlockSpec(
+                (LANE, sub),
+                (lambda t, rlo, rhi, tid, ti=ti:
+                 (tid[jnp.int32(t) * jnp.int32(tps) + jnp.int32(ti)],
+                  zero()))))
+            operands.append(live_t)
+    else:
+        in_specs.append(
+            pl.BlockSpec((tps * LANE, sub),
+                         lambda t, rlo, rhi: (t, zero())))
+        operands.append(live_t)
     # the SMEM spec needs an explicit index map: the auto-generated default
     # returns weak python-int zeros, which trace to i64 under x64 and fail
     # mosaic legalization on real hardware (interpret mode doesn't catch it)
-    in_specs.append(pl.BlockSpec((q_batch, t_pad),
-                                 lambda t, rlo, rhi: (zero(), zero()),
+    if with_sel:
+        smem_map = lambda t, rlo, rhi, tid: (zero(), zero())  # noqa: E731
+    else:
+        smem_map = lambda t, rlo, rhi: (zero(), zero())  # noqa: E731
+    in_specs.append(pl.BlockSpec((q_batch, t_pad), smem_map,
                                  memory_space=pltpu.SMEM))
     operands.append(weights)
 
@@ -708,13 +1030,14 @@ def score_tiles(
         # 3D outputs: the last two dims of each block equal the array dims,
         # satisfying mosaic's (8, 128)-divisibility-or-full-dim rule for
         # small per-tile outputs (the middle dim is the per-query row)
+        if with_sel:
+            out_map = lambda t, rlo, rhi, tid: (t, zero(), zero())  # noqa: E731
+        else:
+            out_map = lambda t, rlo, rhi: (t, zero(), zero())  # noqa: E731
         out_specs = [
-            pl.BlockSpec((tps, q_batch, k),
-                         lambda t, rlo, rhi: (t, zero(), zero())),
-            pl.BlockSpec((tps, q_batch, k),
-                         lambda t, rlo, rhi: (t, zero(), zero())),
-            pl.BlockSpec((tps, q_batch, 1),
-                         lambda t, rlo, rhi: (t, zero(), zero())),
+            pl.BlockSpec((tps, q_batch, k), out_map),
+            pl.BlockSpec((tps, q_batch, k), out_map),
+            pl.BlockSpec((tps, q_batch, 1), out_map),
         ]
         out_shape = [
             jax.ShapeDtypeStruct((n_tiles, q_batch, k), jnp.float32),
@@ -726,25 +1049,27 @@ def score_tiles(
     if with_counts:
         scratch_shapes.append(pltpu.VMEM((q_batch * LANE, sub), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3 if with_sel else 2,
         grid=(n_tiles // tps,),
         in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=scratch_shapes,
     )
     kernel = _make_kernel(t_pad, cb, sub, k, dense, with_counts, tps,
-                          q_batch)
+                          q_batch, codec, with_sel)
     kwargs = {}
     params = _compiler_params()
     if params is not None and not interpret:
         kwargs["compiler_params"] = params
+    prefetch = ((row_lo, row_hi, jnp.asarray(tile_ids, jnp.int32))
+                if with_sel else (row_lo, row_hi))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=tuple(out_shape),
         interpret=interpret,
         **kwargs,
-    )(row_lo, row_hi, *operands)
+    )(*prefetch, *operands)
     return out
 
 
@@ -772,6 +1097,95 @@ def merge_tile_topk_batched(tile_scores, tile_docs, tile_hits, k: int):
     top_d = jnp.take_along_axis(flat_d, top_i, axis=1)
     hits = jnp.sum(tile_hits.reshape(n_tiles, q), axis=0).astype(jnp.int32)
     return top_s, top_d, hits
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("t_pad", "cb", "sub", "k", "q_batch", "q_real",
+                     "codec", "interpret", "tiles_per_step"),
+)
+def score_tiles_pruned(
+    docs_padded,  # raw: padded docs; packed: the packed word array
+    frac_padded,  # raw: padded frac; packed: None
+    live_t,
+    rl_probe, rh_probe, tid_probe,  # plan_pruned_tiles outputs
+    rl_rest, rh_rest, tid_rest,
+    bounds_rest,  # [n_rest, q_batch] f32 per-(tile, query) score bounds
+    weights,  # [q_batch, t_pad] f32
+    *,
+    t_pad: int,
+    cb: int,
+    sub: int,
+    k: int = 10,
+    q_batch: int = 1,
+    q_real: Optional[int] = None,
+    codec: str = "raw",
+    interpret: bool = False,
+    tiles_per_step: int = 1,
+):
+    """Block-max pruned top-k scoring (ISSUE 6) — ONE compiled program,
+    no host round-trip (the bench backend pays a fixed ~70 ms per D2H
+    sync, so a host-side threshold exchange would drown the win):
+
+    1. PROBE pass: score the ``probe`` highest-bound tiles (host-ordered
+       by plan_pruned_tiles) and merge their candidates — the k-th best
+       score per query is the running threshold theta_q (a lower bound on
+       the FINAL k-th score, since the candidate pool only grows).
+    2. In-program gate: a rest tile survives iff ANY real member's bound
+       can still beat its threshold (bounds[t, q] >= theta_q — per-query
+       thresholds over the union lanes, so batching composes without
+       cross-member leakage). Non-survivors get their row-table windows
+       ZEROED at runtime: the sel-mode kernel then skips their compute
+       and their window DMAs collapse onto block 0 (scalar-prefetch row
+       tables are runtime values — this is where the bytes are saved).
+    3. REST pass over the (masked) remaining tiles; both passes' pools
+       merge per query.
+
+    Correctness invariant (property-tested): a pruned tile's bound is an
+    upper bound on any of its docs' scores, and it is pruned only when
+    strictly below theta_q <= final k-th score — so no true top-k doc is
+    ever skipped. Match totals only count SCORED tiles: under pruning
+    ``hits`` is a documented lower bound (WAND semantics), which is why
+    exact-total consumers take the exhaustive path.
+
+    q_real: how many leading rows of ``weights`` are real members (the
+    rest are power-of-two padding); padded members never hold tiles
+    alive. Returns (top_s [Q, k'], top_d [Q, k'], hits [Q] i32,
+    tiles_scored i32 scalar).
+    """
+    if q_real is None:
+        q_real = q_batch
+    kw = dict(t_pad=t_pad, cb=cb, sub=sub, k=k, interpret=interpret,
+              tiles_per_step=tiles_per_step, q_batch=q_batch, codec=codec)
+    ts1, td1, th1 = score_tiles(
+        docs_padded, frac_padded, live_t, rl_probe, rh_probe, weights,
+        tile_ids=tid_probe, **kw)
+    s1, d1, h1 = merge_tile_topk_batched(ts1, td1, th1, k)
+    if s1.shape[1] >= k:
+        kth = s1[:, k - 1]
+    else:
+        # fewer candidate slots than k: no threshold can be claimed
+        kth = jnp.full((q_batch,), -jnp.inf, jnp.float32)
+    # padding members (q >= q_real) must never keep a tile alive: their
+    # bounds are 0 (all-zero weights) and 0 >= -inf would pin every tile
+    theta = jnp.where(jnp.arange(q_batch) < q_real, kth,
+                      jnp.float32(np.inf))
+    survive = jnp.any(bounds_rest >= theta[None, :], axis=1)  # [n_rest]
+    rl2 = jnp.where(survive[:, None], rl_rest, jnp.int32(0))
+    rh2 = jnp.where(survive[:, None], rh_rest, jnp.int32(0))
+    tid2 = jnp.where(survive, tid_rest, jnp.int32(0))
+    ts2, td2, th2 = score_tiles(
+        docs_padded, frac_padded, live_t, rl2, rh2, weights,
+        tile_ids=tid2, **kw)
+    s2, d2, h2 = merge_tile_topk_batched(ts2, td2, th2, k)
+    pool_s = jnp.concatenate([s1, s2], axis=1)
+    pool_d = jnp.concatenate([d1, d2], axis=1)
+    top_s, top_i = lax.top_k(pool_s, min(k, pool_s.shape[1]))
+    top_d = jnp.take_along_axis(pool_d, top_i, axis=1)
+    hits = h1 + h2
+    tiles_scored = (jnp.int32(tid_probe.shape[0])
+                    + jnp.sum(survive.astype(jnp.int32)))
+    return top_s, top_d, hits, tiles_scored
 
 
 def build_live_t(live: np.ndarray, geom: TileGeometry) -> np.ndarray:

@@ -437,25 +437,34 @@ def _shapes_sig(arrays) -> str:
 @functools.lru_cache(maxsize=32)
 def _mesh_batched_kernel_program(mesh: Mesh, spd: int, q_batch: int,
                                  kk: int, t_pad: int, cb: int, sub: int,
-                                 tps: int, interpret: bool):
+                                 tps: int, interpret: bool,
+                                 codec: str = "raw"):
     """One compiled scatter-gather serving Q CONCURRENT queries (ISSUE 5
     cross-query micro-batching on the mesh_pallas rung): per slot, ONE
     batched ``score_tiles`` launch streams the slot's posting windows
     once and emits per-query per-tile top-k candidates; the per-query
     pools merge locally, then over ICI via one all_gather — the same
     collective shape as _mesh_query_program's merge, with a leading
-    query axis instead of a leading 1."""
+    query axis instead of a leading 1. codec="packed" streams the
+    bit-packed posting words (one corpus operand instead of two)."""
     from elasticsearch_tpu.ops import pallas_scoring as psc
 
-    def per_device(kd, kf, lt, rl, rh, w):
+    packed = codec == "packed"
+
+    def per_device(*args):
+        if packed:
+            kp, lt, rl, rh, w = args
+        else:
+            kd, kf, lt, rl, rh, w = args
         dev = jax.lax.axis_index("shards")
         cand_s, cand_d, cand_slot = [], [], []
         hits = None
         for i in range(spd):
+            corpus = (kp[i], None) if packed else (kd[i], kf[i])
             ts_, td_, th_ = psc.score_tiles(
-                kd[i], kf[i], lt[i], rl[i], rh[i], w[i],
+                corpus[0], corpus[1], lt[i], rl[i], rh[i], w[i],
                 t_pad=t_pad, cb=cb, sub=sub, k=kk, interpret=interpret,
-                tiles_per_step=tps, q_batch=q_batch)
+                tiles_per_step=tps, q_batch=q_batch, codec=codec)
             s_i, d_i, h_i = psc.merge_tile_topk_batched(ts_, td_, th_, kk)
             cand_s.append(s_i)  # [Q, kk']
             cand_d.append(d_i)
@@ -478,16 +487,147 @@ def _mesh_batched_kernel_program(mesh: Mesh, spd: int, q_batch: int,
         top_slot = jnp.take_along_axis(pool_slot, top_i, axis=1)
         return top_s[None], top_d[None], top_slot[None], total[None]
 
+    n_in = 5 if packed else 6
     mapped = shard_map(
         per_device, mesh=mesh,
-        in_specs=(PS("shards"),) * 6,
+        in_specs=(PS("shards"),) * n_in,
         out_specs=(PS("shards"),) * 4,
         check_vma=False,
     )
 
     @jax.jit
-    def run(kd, kf, lt, rl, rh, w):
-        outs = mapped(kd, kf, lt, rl, rh, w)
+    def run(*args):
+        outs = mapped(*args)
+        return tuple(o[0] for o in outs)  # replicated: row 0 == row i
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_batched_pruned_program(mesh: Mesh, spd: int, q_batch: int,
+                                 kk: int, t_pad: int,
+                                 cb: int, sub: int, tps: int,
+                                 interpret: bool, codec: str,
+                                 probe: int, n_rest: int):
+    """Block-max pruned batched scoring on the mesh (ISSUE 6), ONE
+    compiled program with NO host round-trip:
+
+    - probe pass: every slot scores its ``probe`` highest-bound tiles
+      (host-ordered); the per-query candidate pools merge over ICI via
+      all_gather — the k-th best merged score is the GLOBAL running
+      threshold theta_q, identical on every device (deterministic merge
+      of a replicated pool).
+    - rest pass: each slot keeps only the rest tiles whose per-(tile,
+      query) bound can still beat theta (a tile survives when ANY real
+      member needs it — per-member thresholds over the union lanes, no
+      cross-member leakage); non-survivors get their runtime row tables
+      zeroed, which the sel-mode kernel turns into skipped DMA + compute.
+    - both pools merge per query over ICI; totals are the psum of SCORED
+      tiles' match counts (a documented lower bound under pruning).
+
+    ``q_real`` (how many leading weight rows are real members — the rest
+    are power-of-two padding) and ``slot_real`` (1 for staged segment
+    slots, 0 for replication filler) are RUNTIME operands, not cache
+    keys: arrival-timing-dependent batch sizes must not compile a
+    program variant each, and filler slots must not inflate the tile
+    counters (their bounds would otherwise survive any -inf threshold).
+
+    Returns (top_s [Q, kk], top_d, top_slot, total [Q],
+    tiles_scored scalar, tiles_total scalar)."""
+    from elasticsearch_tpu.ops import pallas_scoring as psc
+
+    packed = codec == "packed"
+
+    def per_device(*args):
+        if packed:
+            (kp, lt, rl_p, rh_p, tid_p, rl_r, rh_r, tid_r, bounds_r,
+             w, slot_real, q_real) = args
+        else:
+            (kd, kf, lt, rl_p, rh_p, tid_p, rl_r, rh_r, tid_r, bounds_r,
+             w, slot_real, q_real) = args
+        dev = jax.lax.axis_index("shards")
+        kw = dict(t_pad=t_pad, cb=cb, sub=sub, k=kk, interpret=interpret,
+                  tiles_per_step=tps, q_batch=q_batch, codec=codec)
+
+        def slot_pass(i, rl, rh, tid):
+            corpus = (kp[i], None) if packed else (kd[i], kf[i])
+            ts_, td_, th_ = psc.score_tiles(
+                corpus[0], corpus[1], lt[i], rl, rh, w[i],
+                tile_ids=tid, **kw)
+            s_i, d_i, h_i = psc.merge_tile_topk_batched(ts_, td_, th_, kk)
+            slot = (jnp.zeros(s_i.shape, jnp.int32)
+                    + (dev.astype(jnp.int32) * jnp.int32(spd)
+                       + jnp.int32(i)))
+            return s_i, d_i, slot, h_i
+
+        def gather_pool(cand):
+            cs = jnp.concatenate([c[0] for c in cand], axis=1)
+            cd = jnp.concatenate([c[1] for c in cand], axis=1)
+            cslot = jnp.concatenate([c[2] for c in cand], axis=1)
+            all_s = jax.lax.all_gather(cs, "shards")
+            all_d = jax.lax.all_gather(cd, "shards")
+            all_slot = jax.lax.all_gather(cslot, "shards")
+            return (all_s.transpose(1, 0, 2).reshape(q_batch, -1),
+                    all_d.transpose(1, 0, 2).reshape(q_batch, -1),
+                    all_slot.transpose(1, 0, 2).reshape(q_batch, -1))
+
+        probe_out = [slot_pass(i, rl_p[i], rh_p[i], tid_p[i])
+                     for i in range(spd)]
+        hits = sum(o[3] for o in probe_out[1:]) + probe_out[0][3]
+        pool_s, pool_d, pool_slot = gather_pool(probe_out)
+        # global running threshold: k-th best of the merged probe pool
+        # (replicated — every device computes the identical theta)
+        kth_s, _ = jax.lax.top_k(pool_s, min(kk, pool_s.shape[1]))
+        if kth_s.shape[1] >= kk:
+            kth = kth_s[:, kk - 1]
+        else:
+            kth = jnp.full((q_batch,), -jnp.inf, jnp.float32)
+        theta = jnp.where(jnp.arange(q_batch) < q_real, kth,
+                          jnp.float32(np.inf))
+        # filler slots (slot_real == 0) must never survive: their -inf
+        # bounds would pass a member's -inf threshold and inflate the
+        # counters (their tables are all-zero, so scoring them is only
+        # an accounting bug — but the pruned fraction is this feature's
+        # headline observable)
+        real_mask = slot_real > jnp.int32(0)  # [spd]
+        survive = (jnp.any(bounds_r >= theta[None, None, :], axis=2)
+                   & real_mask[:, None])
+        rest_out = []
+        for i in range(spd):
+            sv = survive[i]
+            rl2 = jnp.where(sv[:, None], rl_r[i], jnp.int32(0))
+            rh2 = jnp.where(sv[:, None], rh_r[i], jnp.int32(0))
+            tid2 = jnp.where(sv, tid_r[i], jnp.int32(0))
+            rest_out.append(slot_pass(i, rl2, rh2, tid2))
+        hits = hits + sum(o[3] for o in rest_out[1:]) + rest_out[0][3]
+        rs, rd, rslot = gather_pool(rest_out)
+        pool_s = jnp.concatenate([pool_s, rs], axis=1)
+        pool_d = jnp.concatenate([pool_d, rd], axis=1)
+        pool_slot = jnp.concatenate([pool_slot, rslot], axis=1)
+        top_s, top_i = jax.lax.top_k(pool_s, min(kk, pool_s.shape[1]))
+        top_d = jnp.take_along_axis(pool_d, top_i, axis=1)
+        top_slot = jnp.take_along_axis(pool_slot, top_i, axis=1)
+        total = jax.lax.psum(hits, "shards")
+        n_real = jnp.sum(slot_real)
+        scored = jax.lax.psum(
+            n_real * jnp.int32(probe)
+            + jnp.sum(survive.astype(jnp.int32)), "shards")
+        tiles_total = jax.lax.psum(
+            n_real * jnp.int32(probe + n_rest), "shards")
+        return (top_s[None], top_d[None], top_slot[None], total[None],
+                scored[None], tiles_total[None])
+
+    n_in = 11 if packed else 12
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(PS("shards"),) * n_in + (PS(),),
+        out_specs=(PS("shards"),) * 6,
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(*args):
+        outs = mapped(*args)
         return tuple(o[0] for o in outs)  # replicated: row 0 == row i
 
     return run
@@ -531,6 +671,11 @@ class IndexMeshSearch:
         # (query_batch): launches and member-queries served batched
         self.batched_launch_total = 0
         self.batched_query_total = 0
+        # block-max pruned scoring observability (docs/PRUNING.md):
+        # queries served by the pruned program, and its tile economy
+        self.pruned_query_total = 0
+        self.tiles_scored_total = 0
+        self.tiles_pruned_total = 0
         settings = getattr(index_service, "settings", None)
         # packing limit: segments are packed max_slots-deep per device
         # before the index falls back to the host path (registered as
@@ -578,11 +723,53 @@ class IndexMeshSearch:
         # live mask in place, which must invalidate the staged live1
         key = tuple((sid, id(seg), seg.live_doc_count) for sid, seg in pairs)
         if key != self._staged_key:
+            settings = getattr(self.svc, "settings", None)
+            codec = (settings.get_str(
+                "index.search.pallas.postings_codec", "default")
+                if settings is not None else None)
             self._executor = MeshPlanExecutor([seg for _, seg in pairs],
-                                              mesh)
+                                              mesh, postings_codec=codec)
             self._pairs = pairs
             self._staged_key = key
         return True
+
+    @staticmethod
+    def _needs_counts(q) -> bool:
+        """Cheap body-level pre-check for the Q==1 pruned fast path:
+        queries carrying minimum_should_match / operator clauses are
+        likely to need the dense-counts kernel variant, which query_batch
+        rejects AFTER building every shard's plan — skipping them here
+        avoids paying that planning twice (false positives only cost the
+        fast path, never correctness)."""
+        if isinstance(q, dict):
+            return any(k in ("minimum_should_match", "operator")
+                       or IndexMeshSearch._needs_counts(v)
+                       for k, v in q.items())
+        if isinstance(q, list):
+            return any(IndexMeshSearch._needs_counts(v) for v in q)
+        return False
+
+    def _pruning_config(self):
+        """(enabled, probe_tiles) from the live settings — block-max
+        pruned scoring is dynamic (search.pallas.pruning.*): a PUT
+        _cluster/settings update lands as per-index overrides (Node's
+        update consumers), which win over the index's creation-time
+        Settings map (docs/PRUNING.md)."""
+        settings = getattr(self.svc, "settings", None)
+        enabled = getattr(self.svc, "pruning_enabled_override", None)
+        if enabled is None:
+            if settings is None:
+                return False, 8
+            enabled = settings.get_bool(
+                "search.pallas.pruning.enabled", False)
+        probe = getattr(self.svc, "pruning_probe_override", None)
+        if probe is None:
+            probe = (settings.get_int(
+                "search.pallas.pruning.probe_tiles", 8)
+                if settings is not None else 8)
+        if probe not in (2, 4, 8, 16, 32):
+            probe = 8
+        return bool(enabled), probe
 
     def _sort_plan(self, body: dict):
         """Resolve the request's sort to staged mesh key columns.
@@ -717,6 +904,27 @@ class IndexMeshSearch:
             # so a live settings update takes effect without a restart
             self.plane_health.cooldown_s = settings.get_time(
                 "index.search.plane_quarantine.cooldown", 60.0)
+        pruning_on, _probe = self._pruning_config()
+        if (pruning_on and isinstance(body.get("query"), dict)
+                and all(key in self.BATCHABLE_KEYS for key in body)
+                and int(body.get("size", 10) if body.get("size")
+                        is not None else 10) > 0
+                and not self._needs_counts(body.get("query"))
+                and self.plane_pref in ("auto", "pallas")
+                and self.plane_health.available("mesh_pallas")):
+            # block-max pruned single-query fast path (docs/PRUNING.md):
+            # a plain relevance-ranked query rides the batched rung's
+            # pruned program with Q == 1, skipping tiles whose bound
+            # cannot beat the running top-k threshold. Anything needing
+            # every tile's dense output (aggs, sort, counts, rescore)
+            # fails the key filter above and executes exhaustively.
+            out = self.query_batch([body], deadline=deadline)
+            if out is not None:
+                r = out[0]
+                return {"total": r["total"], "refs": r["refs"],
+                        "max_score": r["max_score"], "aggregations": None,
+                        "terminated_early": None, "plane": r["plane"],
+                        "pruned": r.get("pruned")}
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         sort_keys, sort_spec = self._sort_plan(body)
         if sort_keys == "fallback":
@@ -940,7 +1148,8 @@ class IndexMeshSearch:
         "allow_partial_search_results", "stats",
     })
 
-    def query_batch(self, bodies: List[dict]) -> Optional[list]:
+    def query_batch(self, bodies: List[dict],
+                    deadline=None) -> Optional[list]:
         """Cross-query micro-batching on the mesh_pallas rung: Q
         concurrent queries scored by ONE batched kernel launch inside
         one shard_map program (per-tile DMA windows fetched once for the
@@ -949,7 +1158,13 @@ class IndexMeshSearch:
         Returns one {total, refs, max_score, plane} dict per member, or
         None when the batch can't run here (callers fall to the
         host-batched rung). A plane FAULT quarantines mesh_pallas
-        exactly ONCE for the whole batch — not Q times."""
+        exactly ONCE for the whole batch — not Q times.
+
+        deadline: SearchDeadline of the SINGLE-query pruned fast path
+        (IndexMeshSearch.query routes through here with Q == 1) —
+        checkpointed before table building and before the launch, same
+        contract as the serial ladder. Batch callers (search_batch)
+        handle per-member deadlines themselves and pass None."""
         from elasticsearch_tpu.index.segment import next_pow2
         from elasticsearch_tpu.ops import pallas_scoring as psc
         from elasticsearch_tpu.search.plan import PallasScoreTermsNode
@@ -1025,24 +1240,62 @@ class IndexMeshSearch:
         except Exception:  # noqa: BLE001 — request-shaped error: serial
             # execution surfaces it per member with the right status
             return None
+        pruning, probe = self._pruning_config()
+        if pruning and any(
+                int((b or {}).get("size", 10)
+                    if (b or {}).get("size") is not None else 10) <= 0
+                for b in bodies):
+            # a size:0 member is a total/count-only consumer (_count,
+            # agg-less counts): exact totals are the contract
+            # (docs/PRUNING.md), so the batch runs exhaustively
+            pruning = False
+        codec = session.get("codec", "raw")
+        pruned_stats = None
+        from elasticsearch_tpu.common.errors import TaskCancelledException
+        from elasticsearch_tpu.search.cancellation import (
+            TimeExceededException,
+        )
+
+        if deadline is not None:
+            deadline.checkpoint()
         try:
             on_plane_execute(self.svc.name, "mesh_pallas")
             # shared batched tables: per-slot unions on ONE collective
             # geometry (a dense union on ANY slot shrinks everyone's
             # tile); build_tile_tables_batched owns the union/pad
             # contract — same code the host rung runs
-            t_pad = max(
-                next_pow2(max(len(psc.union_query_lanes(
-                    lane_sets[slot])[0]), 1))
-                for slot in range(n_pairs))
+            unions = [psc.union_query_lanes(lane_sets[slot])[0]
+                      for slot in range(n_pairs)]
+            t_pad = max(next_pow2(max(len(u), 1)) for u in unions)
             sub = geom.tile_sub
+            if pruning:
+                # pruning wants enough tiles to split probe/rest: shrink
+                # the tile until the doc space yields at least 2*probe
+                # tiles (the 1M bench corpus already has 64 at the
+                # default tile — only small corpora shrink). Floor the
+                # shrink at sub=8 on real hardware (mosaic sublane
+                # granularity; interpret mode has no such constraint),
+                # and if even the floor can't yield enough tiles, keep
+                # the ORIGINAL geometry and run exhaustively — the
+                # ladder's geometry must never degrade for a pruning
+                # attempt that then doesn't happen.
+                sub_floor = 1 if session["mode"] == "interpret" else 8
+                sub_p = sub
+                while (sub_p > sub_floor and psc.tile_geometry(
+                        geom.nd_pad, sub_p).n_tiles < 2 * probe):
+                    sub_p //= 2
+                if psc.tile_geometry(geom.nd_pad,
+                                     sub_p).n_tiles >= 2 * probe:
+                    sub = sub_p
+                else:
+                    pruning = False  # corpus too small to prune here
             while True:
                 g = geom if sub == geom.tile_sub else psc.tile_geometry(
                     geom.nd_pad, sub)
                 try:
                     tables = []
                     for slot, (sid, seg) in enumerate(self._pairs):
-                        bmin, bmax = session["meta"][id(seg)]
+                        bmin, bmax = session["meta"][id(seg)][:2]
                         tables.append(psc.build_tile_tables_batched(
                             lane_sets[slot], bmin, bmax, g, t_pad=t_pad))
                     break
@@ -1065,23 +1318,102 @@ class IndexMeshSearch:
             # filler slots/queries keep zero tables/weights: their live
             # masks are all-dead and zero weights score nothing
             tps = psc.tiles_per_step_default()
-            run = _mesh_batched_kernel_program(
-                self._executor.mesh, self._executor.slots_per_dev,
-                q_pad, kk, t_pad, cb, g.tile_sub, tps,
-                session["mode"] == "interpret")
             sharding = self._executor._sharding
             staged = self._executor._seg_staged
-            with _MESH_EXEC_LOCK:
-                outs = run(staged["k_docs"], staged["k_frac"],
-                           staged[live_key],
-                           jax.device_put(rl, sharding),
-                           jax.device_put(rh, sharding),
-                           jax.device_put(w_all, sharding))
-                # async dispatch: completion inside the lock (see above)
-                jax.block_until_ready(outs)
-            keys, docs, slots, totals = (np.asarray(o) for o in outs)
+            corpus = ((staged["k_packed"],) if codec == "packed"
+                      else (staged["k_docs"], staged["k_frac"]))
+            plans_p = None
+            if pruning and n_tiles > probe:
+                # per-slot block-max pruning plans (host side: order
+                # tiles by bound, split probe/rest) — the threshold
+                # exchange itself stays on-device in the program
+                plans_p = []
+                for slot in range(n_pairs):
+                    seg = self._pairs[slot][1]
+                    bfmax = session["meta"][id(seg)][2]
+                    ub = self._executor.tile_lane_ub_cached(
+                        seg, unions[slot], rl[slot], rh[slot], bfmax,
+                        g.tile_sub)
+                    plan = psc.plan_pruned_tiles(
+                        rl[slot], rh[slot], w_all[slot], bfmax, probe,
+                        ub=ub)
+                    if plan is None:
+                        plans_p = None
+                        break
+                    plans_p.append(plan)
+            if plans_p is not None:
+                n_rest = n_tiles - probe
+                rl_p = np.zeros((n_slots, probe, t_pad), np.int32)
+                rh_p = np.zeros((n_slots, probe, t_pad), np.int32)
+                tid_p = np.zeros((n_slots, probe), np.int32)
+                rl_r = np.zeros((n_slots, n_rest, t_pad), np.int32)
+                rh_r = np.zeros((n_slots, n_rest, t_pad), np.int32)
+                tid_r = np.zeros((n_slots, n_rest), np.int32)
+                bounds_r = np.full((n_slots, n_rest, q_pad), -np.inf,
+                                   np.float32)
+                for slot, plan in enumerate(plans_p):
+                    rl_p[slot] = plan["rl_probe"]
+                    rh_p[slot] = plan["rh_probe"]
+                    tid_p[slot] = plan["tid_probe"]
+                    rl_r[slot] = plan["rl_rest"]
+                    rh_r[slot] = plan["rh_rest"]
+                    tid_r[slot] = plan["tid_rest"]
+                    bounds_r[slot] = plan["bounds_rest"]
+                run = _mesh_batched_pruned_program(
+                    self._executor.mesh, self._executor.slots_per_dev,
+                    q_pad, kk, t_pad, cb, g.tile_sub, tps,
+                    session["mode"] == "interpret", codec, probe, n_rest)
+                slot_real = np.zeros(n_slots, np.int32)
+                slot_real[:n_pairs] = 1
+                args = corpus + (
+                    staged[live_key],
+                    jax.device_put(rl_p, sharding),
+                    jax.device_put(rh_p, sharding),
+                    jax.device_put(tid_p, sharding),
+                    jax.device_put(rl_r, sharding),
+                    jax.device_put(rh_r, sharding),
+                    jax.device_put(tid_r, sharding),
+                    jax.device_put(bounds_r, sharding),
+                    jax.device_put(w_all, sharding),
+                    jax.device_put(slot_real, sharding),
+                    jnp.int32(q_batch))
+                if deadline is not None:
+                    # a first call compiles the pruned program (seconds):
+                    # honor the deadline before committing to the launch
+                    deadline.checkpoint()
+                with _MESH_EXEC_LOCK:
+                    outs = run(*args)
+                    jax.block_until_ready(outs)
+                keys, docs, slots, totals, scored, tiles_total = (
+                    np.asarray(o) for o in outs)
+                pruned_stats = {
+                    "tiles_scored": int(scored),
+                    "tiles_pruned": int(tiles_total) - int(scored),
+                }
+            else:
+                run = _mesh_batched_kernel_program(
+                    self._executor.mesh, self._executor.slots_per_dev,
+                    q_pad, kk, t_pad, cb, g.tile_sub, tps,
+                    session["mode"] == "interpret", codec)
+                args = corpus + (staged[live_key],
+                                 jax.device_put(rl, sharding),
+                                 jax.device_put(rh, sharding),
+                                 jax.device_put(w_all, sharding))
+                if deadline is not None:
+                    deadline.checkpoint()
+                with _MESH_EXEC_LOCK:
+                    outs = run(*args)
+                    # async dispatch: completion inside the lock (above)
+                    jax.block_until_ready(outs)
+                keys, docs, slots, totals = (np.asarray(o) for o in outs)
         except (PlanStructureMismatch, NotImplementedError):
             return None  # shape ineligibility: next rung, no penalty
+        except (TaskCancelledException, TimeExceededException):
+            # deadline/cancel tripped a checkpoint (single-query fast
+            # path): the PR-4 contract — partial/timed_out or a clean
+            # cancellation error — belongs to the caller, never a
+            # quarantine
+            raise
         except Exception:  # noqa: BLE001 — plane fault, not a shape miss
             # batch-wide fault: bench the plane ONCE (not Q times) and
             # let the caller serve the members from the next rung
@@ -1093,8 +1425,16 @@ class IndexMeshSearch:
             return None
         self.query_total += q_batch
         self.pallas_query_total += q_batch
-        self.batched_launch_total += 1
-        self.batched_query_total += q_batch
+        if q_batch > 1:
+            # the Q==1 pruned fast path is not cross-query batching: it
+            # must not inflate the batching-adoption telemetry
+            # (docs/BATCHING.md counts launch-SHARING members only)
+            self.batched_launch_total += 1
+            self.batched_query_total += q_batch
+        if pruned_stats is not None:
+            self.pruned_query_total += q_batch
+            self.tiles_scored_total += pruned_stats["tiles_scored"]
+            self.tiles_pruned_total += pruned_stats["tiles_pruned"]
         results = []
         for q, body in enumerate(bodies):
             # per-shard search stats stay attributed per MEMBER (the
@@ -1114,9 +1454,17 @@ class IndexMeshSearch:
                 refs.append(DocRef(sid, seg.name, int(d), score, ()))
                 if max_score is None:
                     max_score = score
-            results.append({"total": int(totals[q]), "refs": refs,
-                            "max_score": max_score,
-                            "plane": "mesh_pallas"})
+            result = {"total": int(totals[q]), "refs": refs,
+                      "max_score": max_score, "plane": "mesh_pallas"}
+            if pruned_stats is not None:
+                # per-query debug marker (the response's _pruned field):
+                # under pruning `total` counts matches in SCORED tiles
+                # only — a documented lower bound, which the marker's
+                # total_relation records (WAND semantics, docs/PRUNING.md;
+                # the ES6 response shape keeps hits.total a bare int)
+                result["pruned"] = dict(pruned_stats,
+                                        total_relation="gte")
+            results.append(result)
         return results
 
 
@@ -1131,13 +1479,21 @@ class MeshPlanExecutor:
     segments per shard) stays on the mesh plane instead of silently
     falling back to the host path."""
 
-    def __init__(self, segments: List, mesh: Optional[Mesh] = None):
+    def __init__(self, segments: List, mesh: Optional[Mesh] = None,
+                 postings_codec: Optional[str] = None):
         from elasticsearch_tpu.parallel.distributed import stack_shard_arrays
         from elasticsearch_tpu.parallel.mesh import shard_mesh
 
         self.mesh = mesh or shard_mesh()
         self.n_dev = self.mesh.devices.size
         self.segments = segments
+        # postings codec preference for the kernel-plane staging
+        # (index.search.pallas.postings_codec; resolved against the doc
+        # space at ensure_kernel time — docs/PRUNING.md)
+        self.postings_codec_pref = postings_codec
+        # staged posting bytes + effective codec, exported via _stats
+        self.postings_bytes_staged = 0
+        self.postings_codec = "raw"
         self.slots_per_dev = max(1, -(-len(segments) // self.n_dev))
         self.n_slots = self.slots_per_dev * self.n_dev
         stacked = stack_shard_arrays(segments, self.n_slots)
@@ -1156,6 +1512,11 @@ class MeshPlanExecutor:
         # lazily-staged tile-kernel plane (ensure_kernel): False =
         # unavailable, dict = {geom, meta: {id(seg): (bmin, bmax)}, mode}
         self._kernel = None
+        # per-(segment, geometry, lane posting-run) block-max bound
+        # columns for pruning (invariant across queries — under zipfian
+        # traffic the same hot terms recompute identical columns);
+        # lifetime bounded by this executor (rebuilt on segment change)
+        self._ub_cache: Dict[tuple, np.ndarray] = {}
 
     # ------------------------------------------------------------------
     # Tile-kernel plane staging (the unified fast plane)
@@ -1182,11 +1543,20 @@ class MeshPlanExecutor:
         if self._kernel is None:
             try:
                 geom = psc.tile_geometry(max(self.nd_pad, psc.LANE))
+                # codec resolution against the STACKED doc space: every
+                # slot's doc ids must fit the packed word's doc bits
+                codec = psc.resolve_postings_codec(
+                    self.postings_codec_pref, geom.nd_pad)
                 n_rows = max(s.block_docs.shape[0] for s in self.segments) \
                     + psc.CB_MAX
-                docs = np.full((self.n_slots, n_rows, psc.LANE),
-                               self.nd_pad, np.int32)
-                frac = np.zeros((self.n_slots, n_rows, psc.LANE), np.float32)
+                if codec == "packed":
+                    packed = np.zeros((self.n_slots, n_rows, psc.LANE),
+                                      np.int32)
+                else:
+                    docs = np.full((self.n_slots, n_rows, psc.LANE),
+                                   self.nd_pad, np.int32)
+                    frac = np.zeros((self.n_slots, n_rows, psc.LANE),
+                                    np.float32)
                 live_t = np.zeros(
                     (self.n_slots, geom.n_tiles * psc.LANE, geom.tile_sub),
                     np.float32)
@@ -1195,25 +1565,69 @@ class MeshPlanExecutor:
                     f = seg._block_frac()
                     bmin, bmax = psc.block_min_max(
                         seg.block_docs, seg.block_tfs, seg.nd_pad)
-                    dp, fp = psc.pad_segment_blocks(seg.block_docs, f,
-                                                    seg.nd_pad)
-                    docs[i, : dp.shape[0]] = dp
-                    frac[i, : fp.shape[0]] = fp
+                    if codec == "packed":
+                        fq = psc.quantize_frac(f)  # one pass serves both
+                        pk = psc.pack_segment_blocks(seg.block_docs, f,
+                                                     seg.nd_pad, q=fq)
+                        packed[i, : pk.shape[0]] = pk
+                        # bounds must dominate the DEQUANTIZED values the
+                        # kernel decodes (rounding can lift a posting up
+                        # to half a quantization step)
+                        bfmax = psc.block_frac_max(
+                            psc.dequantize_frac(fq))
+                    else:
+                        dp, fp = psc.pad_segment_blocks(seg.block_docs, f,
+                                                        seg.nd_pad)
+                        docs[i, : dp.shape[0]] = dp
+                        frac[i, : fp.shape[0]] = fp
+                        bfmax = psc.block_frac_max(f)
                     live = np.zeros(geom.nd_pad, np.float32)
                     live[: seg.nd_pad] = seg.live.astype(np.float32)
                     live_t[i] = psc.build_live_t(live, geom)
-                    meta[id(seg)] = (bmin, bmax)
-                self._seg_staged["k_docs"] = jax.device_put(
-                    docs, self._sharding)
-                self._seg_staged["k_frac"] = jax.device_put(
-                    frac, self._sharding)
+                    meta[id(seg)] = (bmin, bmax, bfmax)
+                if codec == "packed":
+                    self._seg_staged["k_packed"] = jax.device_put(
+                        packed, self._sharding)
+                    self.postings_bytes_staged = int(packed.nbytes)
+                else:
+                    self._seg_staged["k_docs"] = jax.device_put(
+                        docs, self._sharding)
+                    self._seg_staged["k_frac"] = jax.device_put(
+                        frac, self._sharding)
+                    self.postings_bytes_staged = int(docs.nbytes
+                                                     + frac.nbytes)
                 self._seg_staged["k_live_t"] = jax.device_put(
                     live_t, self._sharding)
-                self._kernel = {"geom": geom, "meta": meta}
+                self.postings_codec = codec
+                self._kernel = {"geom": geom, "meta": meta,
+                                "codec": codec}
             except Exception:  # noqa: BLE001 — plane stays scatter
                 self._kernel = False
                 return None
         return dict(self._kernel, mode=mode)
+
+    def tile_lane_ub_cached(self, seg, union_lanes, row_lo, row_hi,
+                            bfmax, sub: int) -> np.ndarray:
+        """Per-(tile, lane) block-max bounds with per-lane caching: a
+        lane's column depends only on (segment, tile geometry, posting
+        run) — row windows come deterministically from the run's
+        per-block doc ranges — so repeat queries on hot terms reuse it
+        instead of re-gathering on the query hot path."""
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+
+        n_tiles, t_pad = row_lo.shape
+        ub = np.zeros((n_tiles, t_pad), np.float32)
+        for j, lane in enumerate(union_lanes):
+            key = (id(seg), sub, lane.block_start, lane.block_count)
+            col = self._ub_cache.get(key)
+            if col is None or col.shape[0] != n_tiles:
+                if len(self._ub_cache) > 4096:  # runaway-vocab backstop
+                    self._ub_cache.clear()
+                col = psc.tile_lane_ub(row_lo[:, j: j + 1],
+                                       row_hi[:, j: j + 1], bfmax)[:, 0]
+                self._ub_cache[key] = col
+            ub[:, j] = col
+        return ub
 
     def ensure_kernel_live(self, sub: int) -> str:
         """Per-sub live-mask layout for a shrunk tile geometry (dense-term

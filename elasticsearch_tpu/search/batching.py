@@ -318,17 +318,24 @@ def batched_segment_scores(segment, nodes: Sequence) -> Optional[
     live_key = ("k_live_t" if g.tile_sub == geom.tile_sub
                 else segment.kernel_live_t_for(g.tile_sub))
     dev = segment.device_arrays()
-    if "k_docs" not in dev:
-        return None
+    codec = getattr(segment, "kernel_codec", "raw")
+    if codec == "packed":
+        if "k_packed" not in dev:
+            return None
+        corpus = (dev["k_packed"], None)
+    else:
+        if "k_docs" not in dev:
+            return None
+        corpus = (dev["k_docs"], dev["k_frac"])
     with_counts = any(n.with_counts for n in nodes)
     interpret = bool(nodes[0].interpret)
     outs = psc.score_tiles(
-        dev["k_docs"], dev["k_frac"], dev[live_key],
+        corpus[0], corpus[1], dev[live_key],
         row_lo, row_hi, weights,
         t_pad=row_lo.shape[1], cb=cb, sub=g.tile_sub,
         dense=True, with_counts=with_counts, interpret=interpret,
         tiles_per_step=psc.tiles_per_step_default(),
-        q_batch=q_pad)
+        q_batch=q_pad, codec=codec)
     nd = segment.nd_pad
     scores_all = np.asarray(_flat_batch(outs[0]))[:, :nd]
     counts_all = (np.asarray(_flat_batch(outs[1]))[:, :nd]

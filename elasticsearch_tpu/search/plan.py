@@ -227,7 +227,7 @@ class PallasScoreTermsNode(PlanNode):
 
     def __init__(self, row_lo, row_hi, kweights, min_match, *, cb: int,
                  sub: int, interpret: bool, live_key: str = "k_live_t",
-                 tiles_per_step: int = 1):
+                 tiles_per_step: int = 1, codec: str = "raw"):
         self.row_lo = row_lo  # [n_tiles, t_pad] i32
         self.row_hi = row_hi
         self.kweights = kweights  # [1, t_pad] f32
@@ -242,13 +242,17 @@ class PallasScoreTermsNode(PlanNode):
         # ladder stages per-sub variants for dense-term queries
         self.live_key = live_key
         self.tiles_per_step = tiles_per_step
+        # postings codec the segment staged (docs/PRUNING.md): "packed"
+        # reads the bit-packed word array and decodes in-kernel
+        self.codec = codec
         self._mesh_lanes = None
         self._mesh_bmin = None
         self._mesh_bmax = None
 
     @classmethod
     def mesh_deferred(cls, lanes, bmin, bmax, min_match, *,
-                      interpret: bool) -> "PallasScoreTermsNode":
+                      interpret: bool,
+                      codec: str = "raw") -> "PallasScoreTermsNode":
         """Node for the MESH plane with table building deferred: lanes are
         shard-local, but table geometry (tile count, t_pad, cb, sub) must
         be uniform across the whole stacked segment set and is only known
@@ -262,6 +266,7 @@ class PallasScoreTermsNode(PlanNode):
         self.with_counts = min_match > 1
         self.live_key = "k_live_t"
         self.tiles_per_step = 1
+        self.codec = codec
         self._mesh_lanes = list(lanes)
         self._mesh_bmin = bmin
         self._mesh_bmax = bmax
@@ -282,11 +287,12 @@ class PallasScoreTermsNode(PlanNode):
     def key(self):
         return (f"pterms[{self.n_tiles},{self.t_pad},{self.cb},{self.sub},"
                 f"{self.with_counts},{self.interpret},{self.live_key},"
-                f"{self.tiles_per_step}]")
+                f"{self.tiles_per_step},{self.codec}]")
 
     def trace_statics(self):
         return (self.cb, self.sub, self.t_pad, self.with_counts,
-                self.interpret, self.live_key, self.tiles_per_step)
+                self.interpret, self.live_key, self.tiles_per_step,
+                self.codec)
 
     def arrays(self):
         if self.row_lo is None:
@@ -307,13 +313,17 @@ class PallasScoreTermsNode(PlanNode):
         from elasticsearch_tpu.ops import pallas_scoring as psc
 
         row_lo, row_hi, kweights, min_match = ctx.take(4)
+        if self.codec == "packed":
+            corpus = (ctx.seg["k_packed"], None)
+        else:
+            corpus = (ctx.seg["k_docs"], ctx.seg["k_frac"])
         outs = psc.score_tiles(
-            ctx.seg["k_docs"], ctx.seg["k_frac"], ctx.seg[self.live_key],
+            corpus[0], corpus[1], ctx.seg[self.live_key],
             row_lo, row_hi, kweights,
             t_pad=self.t_pad, cb=self.cb, sub=self.sub,
             dense=True, with_counts=self.with_counts,
             interpret=self.interpret,
-            tiles_per_step=self.tiles_per_step)
+            tiles_per_step=self.tiles_per_step, codec=self.codec)
         nd = ctx.nd1 - 1
         scores = psc.dense_to_flat(outs[0], self.sub)[:nd]
         scores = jnp.concatenate([scores, jnp.zeros(1, jnp.float32)])

@@ -247,7 +247,8 @@ def _pallas_score_terms_node(segment, arrs, min_match):
     node = P.PallasScoreTermsNode(
         row_lo, row_hi, kweights, min_match,
         cb=cb, sub=g.tile_sub, interpret=(mode == "interpret"),
-        live_key=live_key, tiles_per_step=psc.tiles_per_step_default())
+        live_key=live_key, tiles_per_step=psc.tiles_per_step_default(),
+        codec=getattr(segment, "kernel_codec", "raw"))
     # the cross-query micro-batcher (search/batching.py) unions lane sets
     # across concurrent queries and re-derives shared tables, so the node
     # keeps its lane list alongside the already-built single-query tables
@@ -275,7 +276,8 @@ def _mesh_pallas_score_terms_node(segment, arrs, min_match, session):
     qlanes = [psc.QueryLane(s, c, w) for s, c, w, _ in lanes]
     return P.PallasScoreTermsNode.mesh_deferred(
         qlanes, meta[0], meta[1], min_match,
-        interpret=(session["mode"] == "interpret"))
+        interpret=(session["mode"] == "interpret"),
+        codec=session.get("codec", "raw"))
 
 
 def _numeric_csr(segment, field):
