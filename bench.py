@@ -441,6 +441,22 @@ def run_measurement() -> dict:
                 "error": f"{type(e).__name__}: {e}"}
             extra_configs["pruned_scoring"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        # ISSUE 7 acceptance configs: dense-vector kNN on the MXU +
+        # hybrid BM25 ∪ kNN ranking (recall-gated vs the numpy oracle)
+        try:
+            knn_cfg, hybrid_cfg = run_knn_configs(
+                jax, jnp, psc, corpus, dev, geom, frac, bmin, bmax,
+                term_sets)
+            extra_configs["knn_top10"] = knn_cfg
+            extra_configs["hybrid_rrf"] = hybrid_cfg
+        except Exception as e:  # noqa: BLE001 — recorded, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            extra_configs["knn_top10"] = {
+                "error": f"{type(e).__name__}: {e}"}
+            extra_configs["hybrid_rrf"] = {
+                "error": f"{type(e).__name__}: {e}"}
 
     # ---------------- timings: legacy scatter path (r03) ----------------
     legacy_p50 = legacy_p50_2 = None
@@ -620,6 +636,22 @@ def run_measurement() -> dict:
                 (extra_configs or {}).get("batched_qps", {})
                 .get("q_batch_8", {}).get("bytes_per_query_mb_batched")
                 if isinstance(extra_configs, dict) else None),
+            # dense-vector plane headlines (ISSUE 7): exact kNN top-10
+            # p50 on the MXU (recall-gated) and hybrid BM25 ∪ kNN RRF
+            # throughput — None when the config errored or failed its
+            # recall gate (configs.knn_top10 / configs.hybrid_rrf carry
+            # the detail either way)
+            "vector_top10_p50": (
+                (extra_configs or {}).get("knn_top10", {}).get("p50_ms")
+                if isinstance(extra_configs, dict)
+                and (extra_configs.get("knn_top10", {})
+                     .get("recall_at_10") == 1.0) else None),
+            "hybrid_qps_per_chip": (
+                (extra_configs or {}).get("hybrid_rrf", {})
+                .get("qps_per_chip")
+                if isinstance(extra_configs, dict)
+                and (extra_configs.get("hybrid_rrf", {})
+                     .get("fused_recall_at_10") == 1.0) else None),
             "cpu_numpy_p50_ms": round(cpu_p50, 3),
             "legacy_scatter_p50_ms": (round(legacy_p50, 3)
                                       if legacy_p50 else None),
@@ -971,6 +1003,235 @@ def run_batched_qps_config(jax, jnp, psc, corpus, dev, geom, frac,
             f"({per_query:.3f} ms/query, {qps:.0f} qps, "
             f"t_pad={t_pad_run}, recall={recall_min})")
     return out
+
+
+def run_knn_configs(jax, jnp, psc, corpus, dev, geom, frac, bmin, bmax,
+                    term_sets):
+    """ISSUE 7 acceptance configs — the dense-vector plane on the MXU:
+
+    - ``knn_top10``: exhaustive exact kNN over a 1M x d=128 bf16
+      embedding corpus (cosine), one ``knn_score_tiles`` MXU launch +
+      fused per-tile top-10. Recall@10 gated against the exact f32
+      numpy oracle over the same bf16-rounded vectors; min-of-3
+      marginal estimator (r05 methodology). Headline:
+      ``vector_top10_p50``.
+    - ``hybrid_rrf``: BM25 top-10 (tile kernel) + kNN top-10 (MXU)
+      fused by reciprocal-rank fusion — the latency is both device
+      launches chained (marginal) plus the measured host fusion cost.
+      Gated on the fused id list matching the oracle-side fusion.
+      Headline: ``hybrid_qps_per_chip``.
+    """
+    import numpy as np
+
+    import ml_dtypes
+
+    from elasticsearch_tpu.ops import pallas_knn as pkn
+
+    D = 128
+    METRIC = "cosine"
+    RRF_C = 60
+    nd_pad = corpus["nd_pad"]
+    rng = np.random.RandomState(23)
+
+    t0 = time.perf_counter()
+    # 1M x 128 embeddings, generated + bf16-rounded in chunks to bound
+    # peak host memory (standard_normal materializes f64)
+    vecs = np.empty((N_DOCS, D), np.float32)
+    for lo in range(0, N_DOCS, 100_000):
+        hi = min(lo + 100_000, N_DOCS)
+        chunk = rng.standard_normal((hi - lo, D)).astype(np.float32)
+        vecs[lo:hi] = chunk.astype(ml_dtypes.bfloat16).astype(np.float32)
+    geom_k = pkn.knn_geometry(nd_pad, pkn.pad_dims(D))
+    d_pad = pkn.pad_dims(D)
+    emb_host = np.zeros((geom_k.nd_pad, d_pad), ml_dtypes.bfloat16)
+    emb_host[:N_DOCS, :D] = vecs.astype(ml_dtypes.bfloat16)
+    inv_norms = np.zeros(geom_k.nd_pad, np.float32)
+    norms = np.sqrt(np.einsum("ij,ij->i", vecs, vecs))
+    inv_norms[:N_DOCS] = np.where(norms > 0, 1.0 / norms, 0.0)
+    scale_host = inv_norms.reshape(-1, 1)
+    mask_host = np.zeros((geom_k.nd_pad, 1), np.float32)
+    mask_host[:N_DOCS] = 1.0
+    emb_d = jnp.asarray(emb_host)
+    scale_d = jnp.asarray(scale_host)
+    mask_d = jnp.asarray(mask_host)
+    log(f"knn corpus staged in {time.perf_counter() - t0:.1f}s "
+        f"({emb_host.nbytes / 1e6:.0f} MB bf16, tile_sub="
+        f"{geom_k.tile_sub}, n_tiles={geom_k.n_tiles})")
+
+    # query mix: a random doc's embedding + gaussian noise — neighbors
+    # exist (recall is meaningful) without being degenerate self-matches
+    def draw_qvec():
+        base = vecs[rng.randint(N_DOCS)]
+        return (base + 0.25 * rng.standard_normal(D).astype(np.float32))
+
+    n_queries = WARMUP + 24
+    qvecs = [draw_qvec() for _ in range(n_queries)]
+    staged_q = [jnp.asarray(pkn.normalize_query(q, METRIC, d_pad)
+                            .reshape(1, d_pad)) for q in qvecs]
+
+    @jax.jit
+    def knn_query(qrow):
+        ts, td = pkn.knn_score_tiles(
+            emb_d, scale_d, mask_d, qrow,
+            sub=geom_k.tile_sub, k=K, q_batch=1)
+        return pkn.merge_knn_topk(ts, td, K)
+
+    def oracle_knn(q):
+        s = vecs @ pkn.normalize_query(q, METRIC, d_pad)[:D]
+        s = s * inv_norms[:N_DOCS] * np.float32(0.5) + np.float32(0.5)
+        idx = np.argpartition(-s, K)[:K]
+        return idx[np.argsort(-s[idx], kind="stable")], s
+
+    def time_min3(fn, arg_cycle):
+        """min-of-3 marginal estimate after a sustained re-warm (the
+        r05 estimator: marginal noise is one-sided)."""
+        cycle = {"i": 0}
+
+        def call(_q=None):
+            a = arg_cycle[cycle["i"] % len(arg_cycle)]
+            cycle["i"] += 1
+            return fn(a)
+
+        o = None
+        for _ in range(200):
+            o = call()
+        np.asarray(o[0])
+        ests = sorted(measure_marginal(call, [None]) for _ in range(3))
+        return ests[0] * 1000, (ests[-1] - ests[0]) * 1000
+
+    # ---- knn_top10 ----
+    top_s, top_d = knn_query(staged_q[0])
+    top_s, top_d = np.asarray(top_s)[0], np.asarray(top_d)[0]
+    recall_min, err_max = 1.0, 0.0
+    for i in range(8):
+        got_s, got_d = (np.asarray(o) for o in knn_query(staged_q[i]))
+        ref_i, ref_s = oracle_knn(qvecs[i])
+        recall = len(set(got_d[0].tolist()) & set(ref_i.tolist())) / K
+        recall_min = min(recall_min, recall)
+        err_max = max(err_max, float(np.max(np.abs(
+            np.sort(got_s[0]) - np.sort(ref_s[ref_i])))))
+    p50k, spreadk = time_min3(knn_query, staged_q[WARMUP:])
+    # HBM per query: the bf16 embedding stream + scale/mask columns +
+    # tiny per-tile candidate outputs
+    knn_bytes = (geom_k.nd_pad * d_pad * 2 + geom_k.nd_pad * 2 * 4
+                 + geom_k.n_tiles * K * 2 * 4)
+    knn_cfg = {
+        "p50_ms": round(p50k, 3),
+        "p50_spread_ms": round(spreadk, 3),
+        "qps_per_chip": round(1000.0 / p50k, 1),
+        "recall_at_10": recall_min,
+        "max_abs_score_err": round(err_max, 8),
+        "n_docs": N_DOCS,
+        "dims": D,
+        "metric": METRIC,
+        "storage": "bf16",
+        "tile_sub": geom_k.tile_sub,
+        "bytes_per_query_mb": round(knn_bytes / 1e6, 2),
+        "hbm_gb_per_s_estimate": round(
+            knn_bytes / (p50k / 1000) / 1e9, 1),
+        "note": ("exhaustive exact kNN on the MXU (no ANN graph): one "
+                 "tiled [W, d] @ [d, Q] matmul per doc tile with fused "
+                 "per-tile top-10; recall gated vs the exact f32 numpy "
+                 "oracle over the same bf16-rounded vectors"),
+    }
+    log(f"knn_top10: {p50k:.3f} ms, recall={recall_min}")
+
+    # ---- hybrid_rrf: BM25 launch + kNN launch + host RRF fusion ----
+    qb_pad = 8
+    t_pad_run = cb_run = None
+    bm25_staged = []
+    for ts_ in term_sets[:n_queries]:
+        lanes = [psc.QueryLane(int(corpus["term_block_start"][t]),
+                               int(corpus["n_blocks_per_term"][t]),
+                               idf(int(corpus["term_df"][t])))
+                 for t in ts_]
+        rl, rh, w, cbr = psc.build_tile_tables(lanes, bmin, bmax, geom)
+        t_pad_run = max(t_pad_run or 8, rl.shape[1])
+        cb_run = max(cb_run or 8, cbr)
+        bm25_staged.append((rl, rh, w))
+    bm25_dev = []
+    for rl, rh, w in bm25_staged:
+        if rl.shape[1] < t_pad_run:
+            pad = t_pad_run - rl.shape[1]
+            rl = np.pad(rl, ((0, 0), (0, pad)))
+            rh = np.pad(rh, ((0, 0), (0, pad)))
+            w = np.pad(w, ((0, 0), (0, pad)))
+        bm25_dev.append((jnp.asarray(rl), jnp.asarray(rh), jnp.asarray(w)))
+
+    @jax.jit
+    def hybrid_query(rl, rh, w, qrow):
+        ts_, td_, th_ = psc.score_tiles(
+            dev["docs"], dev["frac"], dev["live_t"], rl, rh, w,
+            t_pad=t_pad_run, cb=cb_run, sub=geom.tile_sub, k=K)
+        bs, bd, _ = psc.merge_tile_topk(ts_, td_, th_, K)
+        kts, ktd = pkn.knn_score_tiles(
+            emb_d, scale_d, mask_d, qrow,
+            sub=geom_k.tile_sub, k=K, q_batch=1)
+        ks_, kd_ = pkn.merge_knn_topk(kts, ktd, K)
+        return bs, bd, ks_[0], kd_[0]
+
+    def rrf_fuse(bm25_docs, knn_docs):
+        scores = {}
+        for r, d_ in enumerate(bm25_docs):
+            if d_ >= 0:
+                scores[int(d_)] = scores.get(int(d_), 0.0) \
+                    + 1.0 / (RRF_C + r + 1)
+        for r, d_ in enumerate(knn_docs):
+            if d_ >= 0:
+                scores[int(d_)] = scores.get(int(d_), 0.0) \
+                    + 1.0 / (RRF_C + r + 1)
+        return [d_ for d_, _s in sorted(scores.items(),
+                                        key=lambda kv: (-kv[1], kv[0]))][:K]
+
+    # gate: kernel-side fusion must equal oracle-side fusion
+    hybrid_recall = 1.0
+    for i in range(4):
+        outs = hybrid_query(*bm25_dev[i], staged_q[i])
+        _bs, bd, _ks, kd = (np.asarray(o) for o in outs)
+        q0 = make_query_legacy(corpus, term_sets[i], qb_pad)
+        _ref_s, ref_bm = numpy_reference_query(corpus, q0)
+        ref_knn, _ = oracle_knn(qvecs[i])
+        got = rrf_fuse(bd, kd)
+        want = rrf_fuse(ref_bm, ref_knn)
+        hybrid_recall = min(hybrid_recall,
+                            len(set(got) & set(want)) / K)
+
+    def hybrid_call(i):
+        rl, rh, w = bm25_dev[i % len(bm25_dev)]
+        return hybrid_query(rl, rh, w, staged_q[i % len(staged_q)])
+
+    cyc = {"i": 0}
+
+    def hybrid_fn(_arg):
+        cyc["i"] += 1
+        return hybrid_call(cyc["i"])
+
+    p50h, spreadh = time_min3(hybrid_fn, [None])
+    # host fusion cost (numpy over 2*K candidates) measured separately:
+    # the marginal estimator must stay device-only (one D2H per batch)
+    outs = [np.asarray(o) for o in hybrid_call(0)]
+    t0 = time.perf_counter()
+    for _ in range(200):
+        rrf_fuse(outs[1], outs[3])
+    fuse_ms = (time.perf_counter() - t0) / 200 * 1000
+    p50_total = p50h + fuse_ms
+    hybrid_cfg = {
+        "p50_ms": round(p50_total, 3),
+        "p50_spread_ms": round(spreadh, 3),
+        "device_p50_ms": round(p50h, 3),
+        "host_fusion_ms": round(fuse_ms, 4),
+        "qps_per_chip": round(1000.0 / p50_total, 1),
+        "fused_recall_at_10": hybrid_recall,
+        "rank_constant": RRF_C,
+        "window": K,
+        "note": ("BM25 tile-kernel launch + kNN MXU launch chained on "
+                 "device, RRF-fused host-side over 2*10 candidates; "
+                 "gated on the fused id list matching oracle-side "
+                 "fusion of the two exact reference rankings"),
+    }
+    log(f"hybrid_rrf: {p50_total:.3f} ms ({p50h:.3f} device + "
+        f"{fuse_ms:.4f} fuse), fused_recall={hybrid_recall}")
+    return knn_cfg, hybrid_cfg
 
 
 def run_codec_pruning_configs(jax, jnp, psc, corpus, dev, geom, frac,

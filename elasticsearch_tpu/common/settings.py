@@ -553,6 +553,33 @@ SEARCH_PALLAS_PRUNING_PROBE_TILES = Setting(
     validator=_validate_probe_tiles, dynamic=True,
 )
 
+# --- dense-vector kNN retrieval on the MXU (docs/VECTOR.md) ---
+
+
+def _validate_knn_tile_sub(v):
+    # tile sublane counts the kNN kernel's geometry helper honors; the
+    # doc space and the VMEM budget may still shrink the effective tile
+    if v not in (8, 16, 32, 64, 128):
+        raise IllegalArgumentException(
+            f"Failed to parse value [{v}] for setting "
+            f"[search.knn.tile_sub]: must be one of 8, 16, 32, 64, 128")
+
+
+SEARCH_KNN_ENABLED = Setting.bool_setting(
+    # serve eligible kNN queries from the mesh MXU program
+    # (ops/pallas_knn.py); false = every vector query runs the host
+    # plan-node rung (exact same scores, no MXU batching)
+    "search.knn.enabled", True, dynamic=True
+)
+SEARCH_KNN_TILE_SUB = Setting(
+    # doc-tile sublane count of the kNN kernel: W = tile_sub * 128 docs
+    # per grid step. Bigger tiles amortize the fixed per-step dispatch
+    # cost; the geometry helper shrinks the tile when the f32-converted
+    # embedding block would overflow VMEM (high-dimensional fields)
+    "search.knn.tile_sub", 64, int,
+    validator=_validate_knn_tile_sub, dynamic=True,
+)
+
 NODE_SETTINGS = [
     CLUSTER_NAME,
     NODE_NAME,
@@ -594,6 +621,8 @@ NODE_SETTINGS = [
     SEARCH_PALLAS_POSTINGS_CODEC,
     SEARCH_PALLAS_PRUNING_ENABLED,
     SEARCH_PALLAS_PRUNING_PROBE_TILES,
+    SEARCH_KNN_ENABLED,
+    SEARCH_KNN_TILE_SUB,
 ]
 
 # --- index-scoped ---
@@ -640,6 +669,13 @@ INDEX_QUERY_DEFAULT_FIELD = Setting.str_setting(
 )
 INDEX_MAPPING_TOTAL_FIELDS_LIMIT = Setting.int_setting(
     "index.mapping.total_fields.limit", 1000, min_value=1, scope=Scope.INDEX, dynamic=True
+)
+INDEX_MAPPING_DENSE_VECTOR_MAX_DIMS = Setting.int_setting(
+    # upper bound on a dense_vector field's [dims] (validated at mapping
+    # compile): staged embedding bytes grow linearly with dims, and the
+    # kNN kernel's VMEM tile shrinks with them (docs/VECTOR.md)
+    "index.mapping.dense_vector.max_dims", 1024, min_value=1,
+    scope=Scope.INDEX,
 )
 
 # --- mesh data plane (parallel/plan_exec.py; docs/MESH.md) ---
@@ -706,6 +742,7 @@ INDEX_SETTINGS = [
     INDEX_TRANSLOG_FLUSH_THRESHOLD,
     INDEX_QUERY_DEFAULT_FIELD,
     INDEX_MAPPING_TOTAL_FIELDS_LIMIT,
+    INDEX_MAPPING_DENSE_VECTOR_MAX_DIMS,
 ]
 
 

@@ -52,7 +52,9 @@ class IndexService:
         from elasticsearch_tpu.index.similarity import SimilarityService
         self.mapper_service = MapperService(
             self.analyzers, mapping,
-            similarity_service=SimilarityService(settings))
+            similarity_service=SimilarityService(settings),
+            dense_vector_max_dims=settings.get_int(
+                "index.mapping.dense_vector.max_dims", 1024))
         self.data_path = data_path
         from elasticsearch_tpu.index.index_sort import parse_index_sort
         self.index_sort = parse_index_sort(settings, self.mapper_service)
@@ -385,6 +387,181 @@ class IndexService:
                 body["suggest"], self.shards, self.mapper_service)
         return resp
 
+    def _try_mesh_knn(self, body: dict, spec: dict, k: int,
+                      deadline=None) -> Optional[dict]:
+        """kNN query phase on the mesh_pallas MXU plane + host fetch
+        phase. None = ineligible (callers run the host plan-node rung —
+        the same ladder shape as _try_mesh_search). Response assembly is
+        shared with the batched form (_mesh_batch_response) so the
+        serial and batched kNN shapes can never diverge."""
+        if self._mesh_search is None:
+            from elasticsearch_tpu.parallel.plan_exec import IndexMeshSearch
+
+            self._mesh_search = IndexMeshSearch(self)
+        out = self._mesh_search.query_knn(spec, max(k, 1),
+                                          deadline=deadline,
+                                          stats=body.get("stats"))
+        if out is None:
+            return None
+        return self._mesh_batch_response(body, out)
+
+    def _search_hybrid(self, body: dict, deadline=None) -> dict:
+        """Hybrid ranking: the lexical ``query`` and the ``knn`` section
+        each retrieve a top-``window`` candidate list through their own
+        full plane ladder (mesh_pallas → host, deadlines/cancellation/
+        partial results intact), then fuse:
+
+        - ``rank: {rrf: {...}}`` — reciprocal rank fusion,
+          score = Σ_sides 1 / (rank_constant + rank)  (the reference's
+          RRF retriever);
+        - default — convex score fusion, score = lexical score +
+          knn_boost * knn score (the reference's additive knn+query
+          combination; per-side ``boost`` weights the blend).
+
+        The fused total is a LOWER BOUND (the union's exact count is
+        not computed) — surfaced via the response's ``_total_relation``
+        marker, which the REST layer renders as the
+        track_total_hits-style ``{"value", "relation": "gte"}`` object.
+        """
+        import time as _time
+
+        t0 = _time.monotonic()
+        spec = body["knn"]
+        if not isinstance(spec, dict) or "field" not in spec \
+                or "query_vector" not in spec:
+            raise IllegalArgumentException(
+                "[knn] must be an object with [field] and [query_vector]")
+        rank = body.get("rank")
+        rrf = None
+        if rank is not None:
+            if not isinstance(rank, dict) or set(rank) != {"rrf"}:
+                raise IllegalArgumentException(
+                    "[rank] supports exactly one method: [rrf]")
+            rrf = dict(rank.get("rrf") or {})
+            unknown = set(rrf) - {"rank_constant", "window_size",
+                                  "rank_window_size"}
+            if unknown:
+                # strict parsing, same contract as the knn clause: a
+                # misspelled tuning knob must 400, never silently
+                # fall back to defaults
+                raise IllegalArgumentException(
+                    f"[rrf] unknown parameter(s) {sorted(unknown)}")
+            if "window_size" not in rrf and "rank_window_size" in rrf:
+                # the reference's 8.x name for the same knob
+                rrf["window_size"] = rrf["rank_window_size"]
+            if int(rrf.get("rank_constant", 60)) < 1:
+                raise IllegalArgumentException(
+                    "[rank_constant] must be >= 1")
+            if int(rrf.get("window_size", 1)) < 1:
+                raise IllegalArgumentException(
+                    "[window_size] must be >= 1")
+        from_ = int(body.get("from", 0) or 0)
+        size = int(body.get("size")) if body.get("size") is not None else 10
+        k = max(from_ + size, 1)
+        knn_k = int(spec.get("k", 10) or 10)
+        window = max(k, knn_k)
+        if rrf is not None:
+            window = max(window, int(rrf.get("window_size", window)))
+        rank_constant = int(rrf.get("rank_constant", 60)) if rrf else 60
+        knn_boost = float(spec.get("boost", 1.0))
+
+        # the knn side must fetch hits with the SAME source filtering /
+        # fetch options as the lexical side: a hit found only by the
+        # vector ranking would otherwise leak fields the request's
+        # _source spec withheld
+        passthrough = ("timeout", "allow_partial_search_results", "stats",
+                       "_source", "docvalue_fields", "stored_fields",
+                       "script_fields", "highlight", "version")
+        lex_body = {key: v for key, v in body.items()
+                    if key not in ("knn", "rank", "from", "size")}
+        lex_body["size"] = window
+        knn_body = {"query": {"knn": {key: v for key, v in spec.items()
+                                      if key != "boost"}},
+                    "size": window}
+        for key in passthrough:
+            if key in body:
+                knn_body[key] = body[key]
+        lex_resp = self._search_uncached(lex_body, deadline=deadline)
+        knn_resp = self._search_uncached(knn_body, deadline=deadline)
+
+        def ranked(resp):
+            return {h["_id"]: (i + 1, h)
+                    for i, h in enumerate(resp["hits"]["hits"])}
+
+        lex_hits, knn_hits = ranked(lex_resp), ranked(knn_resp)
+        if rrf is None:
+            # convex (additive) fusion follows the reference's knn+query
+            # semantics: only the k GLOBAL nearest neighbors contribute
+            # a vector score — a doc ranked past k by similarity gets 0
+            # from the knn side even though the window fetched more
+            knn_hits = {doc_id: (r, h) for doc_id, (r, h)
+                        in knn_hits.items() if r <= knn_k}
+        fused = []
+        for doc_id in set(lex_hits) | set(knn_hits):
+            lex_rank, lex_hit = lex_hits.get(doc_id, (None, None))
+            knn_rank, knn_hit = knn_hits.get(doc_id, (None, None))
+            if rrf is not None:
+                score = sum(1.0 / (rank_constant + r)
+                            for r in (lex_rank, knn_rank) if r is not None)
+            else:
+                score = ((lex_hit["_score"] or 0.0)
+                         if lex_hit is not None else 0.0) \
+                    + knn_boost * ((knn_hit["_score"] or 0.0)
+                                   if knn_hit is not None else 0.0)
+            hit = dict(lex_hit if lex_hit is not None else knn_hit)
+            hit["_score"] = float(score)
+            hit.pop("sort", None)
+            fused.append(hit)
+        fused.sort(key=lambda h: (-h["_score"], h["_id"]))
+        page = fused[from_: from_ + size] if size >= 0 else fused[from_:]
+
+        # shard header: both sides query the SAME shards, so merge the
+        # failure sets dedup'd by shard id — failed == len(failures) and
+        # successful + failed == total stay internally consistent even
+        # when a shard failed on both sides
+        shards = dict(lex_resp["_shards"])
+        seen = set()
+        failures = []
+        for f in (list(lex_resp["_shards"].get("failures") or [])
+                  + list(knn_resp["_shards"].get("failures") or [])):
+            key = (f.get("index"), f.get("shard"))
+            if key not in seen:
+                seen.add(key)
+                failures.append(f)
+        shards["failed"] = len(failures)
+        shards["successful"] = max(
+            int(shards.get("total", len(self.shards))) - len(failures), 0)
+        shards.pop("failures", None)
+        if failures:
+            shards["failures"] = failures
+        total = max(int(lex_resp["hits"]["total"]),
+                    int(knn_resp["hits"]["total"]))
+        resp = {
+            "took": int((_time.monotonic() - t0) * 1000),
+            "timed_out": bool(lex_resp.get("timed_out")
+                              or knn_resp.get("timed_out")),
+            "_plane": knn_resp.get("_plane", "host"),
+            # per-side execution-plane observability + fusion mode
+            "_hybrid": {"lexical_plane": lex_resp.get("_plane", "host"),
+                        "knn_plane": knn_resp.get("_plane", "host"),
+                        "fusion": "rrf" if rrf is not None else "convex"},
+            # union count not computed: the fused total is a documented
+            # lower bound (REST renders {"value", "relation": "gte"})
+            "_total_relation": "gte",
+            "_shards": shards,
+            "hits": {"total": total,
+                     "max_score": (page[0]["_score"] if page else None),
+                     "hits": page},
+        }
+        # aggregations/suggest are request-level features orthogonal to
+        # the ranking fusion: they are computed by the LEXICAL side
+        # (whose window query saw the full matched set) and ride the
+        # fused response unchanged — docs/VECTOR.md
+        for key in ("aggregations", "suggest"):
+            if key in lex_resp:
+                resp[key] = lex_resp[key]
+        return resp
+
     def search(self, body: Optional[dict] = None,
                preference_shards: Optional[List[int]] = None,
                pinned_segments: Optional[Dict[int, list]] = None,
@@ -473,6 +650,29 @@ class IndexService:
             shard_failure_entry,
         )
 
+        body = body or {}
+        if body.get("knn") is not None:
+            # top-level ``knn`` section (the reference's knn search
+            # surface): alone it is a pure vector search — normalize to
+            # the ``knn`` query clause so the whole pipeline (plane
+            # ladder, deadlines, partial results, fetch) serves it;
+            # combined with ``query`` it is HYBRID ranking (RRF or
+            # convex fusion) — see docs/VECTOR.md
+            if not isinstance(body["knn"], dict):
+                raise IllegalArgumentException(
+                    "[knn] must be an object with [field] and "
+                    "[query_vector]")
+            if body.get("query") is not None:
+                return self._search_hybrid(body, deadline=deadline)
+            body = dict(body)
+            spec = body.pop("knn")
+            if body.pop("rank", None) is not None:
+                raise IllegalArgumentException(
+                    "[rank] requires both [query] and [knn] sections")
+            body["query"] = {"knn": spec}
+            if body.get("size") is None and spec.get("k") is not None:
+                body["size"] = int(spec["k"])
+
         t0 = time.monotonic()
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size")) if body.get("size") is not None else 10
@@ -491,7 +691,13 @@ class IndexService:
                 and preference_shards is None
                 and pinned_segments is None and not body.get("scroll")):
             try:
-                mesh_resp = self._try_mesh_search(body, k, deadline=deadline)
+                knn_clause = _pure_knn_mesh_clause(body)
+                if knn_clause is not None:
+                    mesh_resp = self._try_mesh_knn(body, knn_clause, k,
+                                                   deadline=deadline)
+                else:
+                    mesh_resp = self._try_mesh_search(body, k,
+                                                      deadline=deadline)
             except TimeExceededException:
                 # deadline expired inside the mesh plane: the host loop
                 # below breaks at its first checkpoint and reports the
@@ -695,6 +901,18 @@ class IndexService:
                 results[i] = self._batch_member_single(body, dl)
                 continue
             live.append(i)
+
+        # pure-kNN members split off onto the kNN MXU plane: the batched
+        # dense-matmul launch streams the embedding matrix once for the
+        # whole vector burst (IndexMeshSearch.query_knn_batch); members
+        # it can't serve fall back to their serial pipeline one by one
+        from elasticsearch_tpu.search.batching import knn_batch_spec
+
+        knn_live = [i for i in live if knn_batch_spec(bodies[i])]
+        if knn_live:
+            live = [i for i in live if i not in set(knn_live)]
+            self._dispatch_knn_batch(bodies, deadlines, knn_live, results)
+
         if len(live) < 2:
             for i in live:
                 results[i] = self._batch_member_single(bodies[i],
@@ -737,6 +955,62 @@ class IndexService:
         if launches and shared:
             self.batch_stats.note_batch(shared)
         return results
+
+    @staticmethod
+    def _knn_member_body(body) -> dict:
+        """The serial path's top-level-knn size normalization (size
+        defaults to the spec's k), applied to a batch member so a
+        request returns the SAME hit count whether or not it happened
+        to share a batch window."""
+        body = dict(body or {})
+        spec = body.get("knn")
+        if (isinstance(spec, dict) and body.get("query") is None
+                and body.get("size") is None
+                and spec.get("k") is not None):
+            body["size"] = int(spec["k"])
+        return body
+
+    def _dispatch_knn_batch(self, bodies, deadlines, knn_live, results):
+        """Serve a burst of pure-kNN members: one batched MXU launch
+        when they target the same field and the mesh plane is up, else
+        per-member serial execution (which still rides the serial kNN
+        ladder). Fills ``results`` in place."""
+        from elasticsearch_tpu.search.batching import knn_batch_spec
+
+        specs = [knn_batch_spec(bodies[i]) for i in knn_live]
+        norm_bodies = {i: self._knn_member_body(bodies[i])
+                       for i in knn_live}
+        ks = []
+        for i in knn_live:
+            body = norm_bodies[i]
+            from_ = int(body.get("from", 0) or 0)
+            size = (int(body.get("size"))
+                    if body.get("size") is not None else 10)
+            ks.append(max(from_ + size, 1))
+        mesh_out = None
+        if (self._mesh_enabled and len(self.shards) >= 2
+                and len(knn_live) >= 2
+                and len({str(s.get("field")) for s in specs}) == 1):
+            if self._mesh_search is None:
+                from elasticsearch_tpu.parallel.plan_exec import (
+                    IndexMeshSearch,
+                )
+
+                self._mesh_search = IndexMeshSearch(self)
+            mesh_out = self._mesh_search.query_knn_batch(
+                specs, ks,
+                stats=[norm_bodies[i].get("stats") for i in knn_live])
+        if mesh_out is not None:
+            for j, i in enumerate(knn_live):
+                try:
+                    results[i] = self._mesh_batch_response(
+                        norm_bodies[i], mesh_out[j])
+                except Exception as e:  # noqa: BLE001 — per-member fetch
+                    results[i] = e
+            self.batch_stats.note_batch(len(knn_live))
+            return
+        for i in knn_live:
+            results[i] = self._batch_member_single(bodies[i], deadlines[i])
 
     def _batch_member_single(self, body, deadline, score_caches=None,
                              skip_mesh=False):
@@ -908,6 +1182,11 @@ class IndexService:
                 # block-max pruned scoring + postings codec observability
                 # (docs/PRUNING.md): queries served pruned, the tile
                 # economy, and what representation the postings stream as
+                # dense-vector retrieval (docs/VECTOR.md): kNN queries
+                # served by the mesh MXU program
+                "knn_query_total": (
+                    self._mesh_search.knn_query_total
+                    if self._mesh_search is not None else 0),
                 "pruned_query_total": (
                     self._mesh_search.pruned_query_total
                     if self._mesh_search is not None else 0),
@@ -1002,6 +1281,21 @@ class IndexService:
             self._refresh_stop.set()
         for shard in self.shards.values():
             shard.close()
+
+
+def _pure_knn_mesh_clause(body: dict) -> Optional[dict]:
+    """The knn spec when this request is a plain top-k vector search the
+    mesh kNN program can serve whole, else None. The eligibility rules
+    (sole knn clause, simple body keys, default boost — a non-default
+    boost stays on the host rung for byte-parity) are SHARED with the
+    batched dispatcher so the serial and batched paths can never drift
+    (search/batching.knn_batch_spec)."""
+    from elasticsearch_tpu.search.batching import knn_batch_spec
+
+    q = body.get("query")
+    if not (isinstance(q, dict) and set(q) == {"knn"}):
+        return None  # here only the already-normalized clause form runs
+    return knn_batch_spec(body)
 
 
 def _is_request_error(exc: Exception) -> bool:

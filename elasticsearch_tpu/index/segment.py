@@ -91,6 +91,21 @@ class OrdinalColumn:
 
 
 @dataclass
+class VectorColumn:
+    """Dense-vector doc values: one fixed-dimension embedding per doc.
+
+    ``vectors`` is the bf16-rounded HOST mirror kept as f32 (every value
+    sits exactly on the bf16 grid — what the device staging stores as
+    real bf16 and the MXU kNN kernel decodes), so numpy oracles and the
+    kernel score identical bits. See ops/pallas_knn.py / docs/VECTOR.md."""
+
+    vectors: np.ndarray  # [nd_pad, dims] f32, bf16-grid values, 0 = missing
+    exists: np.ndarray  # [nd_pad] bool
+    dims: int
+    count: int  # docs carrying a vector
+
+
+@dataclass
 class NestedContext:
     """A nested path's sub-segment + the join to parent docs.
 
@@ -152,6 +167,7 @@ class Segment:
         nested: Optional[Dict[str, NestedContext]] = None,
         shapes: Optional[Dict[str, Dict[int, list]]] = None,
         parents: Optional[List[Optional[str]]] = None,
+        vector_columns: Optional[Dict[str, "VectorColumn"]] = None,
     ):
         self.name = name
         self.num_docs = num_docs
@@ -179,6 +195,10 @@ class Segment:
         self.numeric_columns = numeric_columns
         self.ordinal_columns = ordinal_columns
         self.geo_columns = geo_columns
+        # dense_vector embeddings (field -> VectorColumn); staged to the
+        # device lazily by ensure_vector_staged (bf16 matrix + metric
+        # scale columns for the kNN planes)
+        self.vector_columns = vector_columns or {}
         self.exists_masks = exists_masks  # field -> [nd_pad] bool
         # term_id -> {local_doc: np.ndarray positions} for phrase queries
         self.positions = positions or {}
@@ -418,6 +438,45 @@ class Segment:
                 self.norms[row], self.field_avgdl(field))
         return frac
 
+    def ensure_vector_staged(self, field: str, metric: str = "cosine"):
+        """Lazily stage a dense_vector field's kNN arrays to the device
+        and return their device-dict keys: (emb bf16 [nd_pad, d_pad],
+        inverse-norm f32 [nd_pad] — the cosine scale column, staged only
+        when the metric needs it, exists1 bool [nd_pad + 1]) plus the
+        padded dim count, or None when no doc of this segment carries
+        the field. The arrays are immutable (deletes ride the live mask
+        applied outside the plan), so no restage hook is needed."""
+        col = self.vector_columns.get(field)
+        if col is None:
+            return None
+        emb_key = f"k_vec_{field}"
+        norm_key = f"k_vecnorm_{field}"
+        exists_key = f"k_vecexists_{field}"
+        self.device_arrays()  # ensure the base staging dict exists
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops import pallas_knn as pkn
+
+        if emb_key not in self._device:
+            d_pad = pkn.pad_dims(col.dims)
+            emb = np.zeros((self.nd_pad, d_pad), np.float32)
+            emb[:, : col.dims] = col.vectors
+            exists1 = np.zeros(self.nd_pad + 1, bool)
+            exists1[: self.nd_pad] = col.exists
+            # publish atomically-enough (dict.update under the GIL): a
+            # concurrent reader must never see emb without its mask
+            self._device.update({
+                emb_key: jnp.asarray(emb, jnp.bfloat16),
+                exists_key: jnp.asarray(exists1),
+            })
+        if metric == "cosine" and norm_key not in self._device:
+            # only cosine reads the inverse-norm column — a dot_product
+            # field skips the norm pass and the staged bytes entirely
+            inv = pkn.vector_scale_column(col.vectors, "cosine")[:, 0]
+            self._device[norm_key] = jnp.asarray(inv)
+        d_pad = int(self._device[emb_key].shape[1])
+        return emb_key, norm_key, exists_key, d_pad
+
     def device_column(self, key: str, build) -> Any:
         """Cached device staging for a doc-value array (build() -> np array)."""
         if key not in self.dev_cache:
@@ -447,6 +506,9 @@ class Segment:
             total += c.flat_values.nbytes + c.flat_docs.nbytes + c.first_value.nbytes
         for c in self.ordinal_columns.values():
             total += c.flat_ords.nbytes + c.flat_docs.nbytes + c.first_ord.nbytes
+        for c in self.vector_columns.values():
+            # device staging is bf16: half the host mirror's f32 bytes
+            total += c.vectors.nbytes // 2 + c.exists.nbytes
         return total
 
     def stats(self) -> dict:
@@ -490,6 +552,9 @@ class SegmentBuilder:
         self.numeric_values: Dict[str, List[Tuple[int, float]]] = {}
         self.string_values: Dict[str, List[Tuple[int, str]]] = {}
         self.geo_values: Dict[str, List[Tuple[int, float, float]]] = {}
+        # dense_vector field -> {doc: [dims] float list} (+ dims per field)
+        self.vector_values: Dict[str, Dict[int, list]] = {}
+        self.vector_dims: Dict[str, int] = {}
         # geo_shape field -> {doc: [raw GeoJSON/WKT values]}
         self.shape_values: Dict[str, Dict[int, list]] = {}
         self.field_docs: Dict[str, set] = {}
@@ -541,6 +606,10 @@ class SegmentBuilder:
             self.field_docs.setdefault(field_name, set()).add(doc)
             self.shape_values.setdefault(field_name, {}).setdefault(
                 doc, []).extend(vals)
+        for field_name, vec in getattr(parsed, "vector_values", {}).items():
+            self.field_docs.setdefault(field_name, set()).add(doc)
+            self.vector_values.setdefault(field_name, {})[doc] = vec
+            self.vector_dims[field_name] = len(vec)
         for field_name, pairs in getattr(parsed, "range_values", {}).items():
             # two parallel numeric columns stay aligned: both appended once
             # per value, in the same order (stable doc sort in seal())
@@ -628,6 +697,10 @@ class SegmentBuilder:
         self.shape_values = {
             f: {int(inv[d]): vals for d, vals in per_doc.items()}
             for f, per_doc in self.shape_values.items()
+        }
+        self.vector_values = {
+            f: {int(inv[d]): vec for d, vec in per_doc.items()}
+            for f, per_doc in self.vector_values.items()
         }
         for entry in self.nested_builders.values():
             entry["parent_of"] = [int(inv[d]) for d in entry["parent_of"]]
@@ -761,6 +834,24 @@ class SegmentBuilder:
             geo_columns[f] = GeoColumn(lat, lon, flat_docs, first_lat, first_lon,
                                        exists, n_vals)
 
+        # --- dense_vector columns ---
+        vector_columns: Dict[str, VectorColumn] = {}
+        if self.vector_values:
+            from elasticsearch_tpu.ops.pallas_knn import bf16_round
+
+            for f, per_doc in self.vector_values.items():
+                dims = self.vector_dims[f]
+                vecs = np.zeros((nd_pad, dims), np.float32)
+                exists = np.zeros(nd_pad, dtype=bool)
+                for doc, vec in per_doc.items():
+                    vecs[doc] = vec
+                    exists[doc] = True
+                # round to the bf16 grid ONCE at seal: the host mirror,
+                # the numpy oracle and the device bf16 staging all see
+                # the same values (docs/VECTOR.md storage contract)
+                vector_columns[f] = VectorColumn(
+                    bf16_round(vecs), exists, dims, len(per_doc))
+
         # --- exists masks ---
         exists_masks = {}
         for f, docs in self.field_docs.items():
@@ -810,6 +901,7 @@ class SegmentBuilder:
             nested=nested,
             shapes={f: dict(per_doc) for f, per_doc in self.shape_values.items()},
             parents=list(self.parents),
+            vector_columns=vector_columns,
         )
 
 
@@ -871,6 +963,19 @@ class PinnedSegmentView:
             self._pin_device[key] = self._build_pinned_live_t(sub)
             self._merged[key] = self._pin_device[key]
         return key
+
+    def ensure_vector_staged(self, field: str, metric: str = "cosine"):
+        """Vector stagings are immutable (the pin only freezes the live
+        mask), so the view shares the live segment's arrays — but they
+        must be copied into the view's merged dict, which a plan built
+        AFTER device_arrays() was captured reads from."""
+        keys = self._seg.ensure_vector_staged(field, metric)
+        if keys is not None:
+            base = self._seg.device_arrays()
+            for key in keys[:3]:
+                if key in base:
+                    self._merged[key] = base[key]
+        return keys
 
     def _build_pinned_live_t(self, sub: int):
         import jax.numpy as jnp

@@ -27,6 +27,7 @@ from elasticsearch_tpu.index.segment import (
     NumericColumn,
     OrdinalColumn,
     Segment,
+    VectorColumn,
 )
 
 
@@ -144,6 +145,11 @@ class Store:
             arrays[f"geo.{f}.first_lat"] = col.first_lat
             arrays[f"geo.{f}.first_lon"] = col.first_lon
             arrays[f"geo.{f}.exists"] = col.exists
+        for f, col in seg.vector_columns.items():
+            # the bf16-grid f32 host mirror persists as-is: reloading it
+            # reproduces the exact device bf16 staging (docs/VECTOR.md)
+            arrays[f"vec.{f}.vectors"] = col.vectors
+            arrays[f"vec.{f}.exists"] = col.exists
         for f, mask in seg.exists_masks.items():
             arrays[f"exists.{f}"] = mask
         np.savez(os.path.join(d, "arrays.npz"), **arrays)
@@ -161,6 +167,10 @@ class Store:
                 for f, c in seg.ordinal_columns.items()
             },
             "geo_fields": {f: c.count for f, c in seg.geo_columns.items()},
+            "vector_fields": {
+                f: {"dims": c.dims, "count": c.count}
+                for f, c in seg.vector_columns.items()
+            },
             "doc_ids": seg.doc_ids,
             "routings": seg.routings,
             # legacy _parent values (alongside routing; rebuilds the
@@ -289,6 +299,14 @@ class Store:
         exists_masks = {
             k[len("exists."):]: data[k] for k in data.files if k.startswith("exists.")
         }
+        vector_columns: Dict[str, VectorColumn] = {}
+        for f_name, info in (meta.get("vector_fields") or {}).items():
+            vector_columns[f_name] = VectorColumn(
+                data[f"vec.{f_name}.vectors"],
+                data[f"vec.{f_name}.exists"],
+                int(info["dims"]),
+                int(info["count"]),
+            )
 
         seg = Segment(
             name=meta["name"],
@@ -315,6 +333,7 @@ class Store:
             shapes={f: {int(doc): vals for doc, vals in per_doc.items()}
                     for f, per_doc in (meta.get("shapes") or {}).items()},
             parents=meta.get("parents"),
+            vector_columns=vector_columns,
         )
         live_path = os.path.join(d, "live.npy")
         if os.path.exists(live_path):

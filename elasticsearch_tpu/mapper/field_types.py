@@ -686,6 +686,79 @@ class JoinFieldType(FieldType):
         return None  # handled specially in DocumentMapper._index_single
 
 
+class DenseVectorFieldType(FieldType):
+    """dense_vector: a fixed-dimension float embedding per document
+    (the reference grew this in 7.x — DenseVectorFieldMapper; the 8.x
+    ``similarity`` mapping param picks the kNN metric). Values are NOT
+    inverted-index terms or scalar doc values: they land in a dedicated
+    per-segment ``[nd_pad, dims]`` column stored bf16 on device and
+    scored by the MXU kNN kernel (ops/pallas_knn.py). See
+    docs/VECTOR.md."""
+
+    type_name = "dense_vector"
+    indexable = False
+    has_doc_values = False
+
+    SIMILARITIES = ("cosine", "dot_product")
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        dims = self.params.get("dims")
+        if dims is None:
+            raise MapperParsingException(
+                f"Field [{name}] of type [dense_vector] misses required "
+                f"parameter [dims]")
+        try:
+            self.dims = int(dims)
+        except (TypeError, ValueError):
+            raise MapperParsingException(
+                f"Field [{name}]: [dims] must be an integer, got "
+                f"[{dims!r}]") from None
+        if self.dims < 1:
+            raise MapperParsingException(
+                f"Field [{name}]: [dims] must be a positive integer, got "
+                f"[{self.dims}]")
+        self.similarity = self.params.get("similarity", "cosine")
+        if self.similarity not in self.SIMILARITIES:
+            raise MapperParsingException(
+                f"Field [{name}]: unknown [similarity] "
+                f"[{self.similarity}]; expected one of "
+                f"{list(self.SIMILARITIES)}")
+
+    def parse_vector(self, value) -> List[float]:
+        """Validate one document's vector: a list of exactly ``dims``
+        finite numbers. Anything else is a 400 at index time."""
+        if not isinstance(value, (list, tuple)):
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}] of type "
+                f"[dense_vector]: expected an array of {self.dims} "
+                f"numbers, got [{value!r}]")
+        if len(value) != self.dims:
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}]: the [dims] of the "
+                f"vector [{len(value)}] does not match the mapping "
+                f"[{self.dims}]")
+        out = []
+        for v in value:
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise MapperParsingException(
+                    f"failed to parse field [{self.name}] of type "
+                    f"[dense_vector]: non-numeric element [{v!r}]")
+            f = float(v)
+            if math.isnan(f) or math.isinf(f):
+                raise MapperParsingException(
+                    f"failed to parse field [{self.name}]: non-finite "
+                    f"vector element")
+            out.append(f)
+        return out
+
+    def index_terms(self, value, analyzers):
+        return []
+
+    def doc_value(self, value):
+        return None
+
+
 class PercolatorFieldType(FieldType):
     """percolator: stores a query DSL object for inverse search
     (modules/percolator — PercolatorFieldMapper). The query lives in
@@ -801,6 +874,7 @@ FIELD_TYPES = {
     for t in [
         GeoShapeFieldType,
         CompletionFieldType,
+        DenseVectorFieldType,
         PercolatorFieldType,
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,

@@ -49,6 +49,10 @@ class ParsedDocument:
     shape_values: Dict[str, List[Any]] = field(default_factory=dict)
     # range fields: field -> list[(lo, hi)] inclusive float bounds
     range_values: Dict[str, List[Tuple[float, float]]] = field(default_factory=dict)
+    # dense vectors: field -> ONE [dims] float list per doc (the mapper
+    # rejects multiple vectors per field per document, like the
+    # reference's DenseVectorFieldMapper)
+    vector_values: Dict[str, List[float]] = field(default_factory=dict)
     # fields present (for exists query — the reference's _field_names field)
     field_names: List[str] = field(default_factory=list)
     # dynamic mapping update produced while parsing, or None
@@ -64,10 +68,14 @@ class DocumentMapper:
     """A compiled mapping for one index: flat field-path -> FieldType."""
 
     def __init__(self, mapping: dict, analyzers: AnalysisRegistry,
-                 total_fields_limit: int = 1000):
+                 total_fields_limit: int = 1000,
+                 dense_vector_max_dims: int = 1024):
         self.mapping = mapping  # the raw {"properties": {...}} tree
         self.analyzers = analyzers
         self.total_fields_limit = total_fields_limit
+        # index.mapping.dense_vector.max_dims — validated at mapping
+        # compile so an oversized field rejects at put-mapping time
+        self.dense_vector_max_dims = dense_vector_max_dims
         self.fields: Dict[str, FieldType] = {}
         self._object_paths: set = set()
         # nested object paths ("type": "nested") -> their mapping params
@@ -99,10 +107,30 @@ class DocumentMapper:
                 self._compile(path + ".", params["properties"])
                 continue
             ft = create_field_type(path, params)
+            self._check_vector_dims(ft)
             self.fields[path] = ft
             for sub_name, sub_params in (params.get("fields") or {}).items():
                 sub_path = f"{path}.{sub_name}"
+                if (sub_params or {}).get("type") == "dense_vector":
+                    # multi-field value fan-out splits arrays into
+                    # elements, which can never carry a whole vector —
+                    # reject at compile instead of silently indexing
+                    # nothing (and bypassing the max_dims bound)
+                    raise MapperParsingException(
+                        f"Field [{sub_path}]: [dense_vector] cannot be "
+                        f"used in multi-fields")
                 self.fields[sub_path] = create_field_type(sub_path, sub_params)
+
+    def _check_vector_dims(self, ft: FieldType) -> None:
+        from elasticsearch_tpu.mapper.field_types import DenseVectorFieldType
+
+        if (isinstance(ft, DenseVectorFieldType)
+                and ft.dims > self.dense_vector_max_dims):
+            raise IllegalArgumentException(
+                f"The number of dimensions for field [{ft.name}] "
+                f"[{ft.dims}] exceeds "
+                f"[index.mapping.dense_vector.max_dims] "
+                f"[{self.dense_vector_max_dims}]")
 
     def field_type(self, path: str) -> Optional[FieldType]:
         return self.fields.get(path)
@@ -134,7 +162,7 @@ class DocumentMapper:
         out.field_names = sorted(
             set(out.terms) | set(out.numeric_values) | set(out.string_values)
             | set(out.geo_values) | set(out.range_values)
-            | set(out.shape_values)
+            | set(out.shape_values) | set(out.vector_values)
         )
         return out
 
@@ -232,7 +260,7 @@ class DocumentMapper:
             sub.field_names = sorted(
                 set(sub.terms) | set(sub.numeric_values) | set(sub.string_values)
                 | set(sub.geo_values) | set(sub.range_values)
-                | set(sub.shape_values)
+                | set(sub.shape_values) | set(sub.vector_values)
             )
             out.nested.setdefault(path, []).append(sub)
             if params_n.get("include_in_parent") or params_n.get("include_in_root"):
@@ -246,6 +274,16 @@ class DocumentMapper:
                               "geo_values", "range_values", "shape_values"):
                     for f, vals in getattr(inc, store).items():
                         getattr(out, store).setdefault(f, []).extend(vals)
+                for f, vec in inc.vector_values.items():
+                    # one vector per field per (parent) doc — two nested
+                    # objects carrying the same dense_vector path cannot
+                    # both flatten onto the root
+                    if f in out.vector_values:
+                        raise MapperParsingException(
+                            f"Field [{f}] of type [dense_vector] doesn't "
+                            f"support indexing multiple values for the "
+                            f"same field in one document")
+                    out.vector_values[f] = vec
         if dynamic == "true" and not sub_new:
             new_props.pop(key, None)
 
@@ -274,6 +312,18 @@ class DocumentMapper:
             self._index_value(ft, ft.null_value, out)
 
     def _index_value(self, ft: FieldType, value: Any, out: ParsedDocument) -> None:
+        from elasticsearch_tpu.mapper.field_types import DenseVectorFieldType
+
+        if isinstance(ft, DenseVectorFieldType):
+            # the WHOLE array is one value — it must not be split into
+            # elements like a multi-valued field; one vector per doc
+            if ft.name in out.vector_values:
+                raise MapperParsingException(
+                    f"Field [{ft.name}] of type [dense_vector] doesn't "
+                    f"support indexing multiple values for the same "
+                    f"field in one document")
+            out.vector_values[ft.name] = ft.parse_vector(value)
+            return
         values = value if isinstance(value, list) else [value]
         for v in values:
             if v is None:
@@ -359,15 +409,18 @@ class MapperService:
     """
 
     def __init__(self, analyzers: AnalysisRegistry, mapping: Optional[dict] = None,
-                 total_fields_limit: int = 1000, similarity_service=None):
+                 total_fields_limit: int = 1000, similarity_service=None,
+                 dense_vector_max_dims: int = 1024):
         self.analyzers = analyzers
         self.total_fields_limit = total_fields_limit
+        self.dense_vector_max_dims = dense_vector_max_dims
         if similarity_service is None:
             from elasticsearch_tpu.index.similarity import SimilarityService
             similarity_service = SimilarityService()
         self.similarity_service = similarity_service
         self._mapping = copy.deepcopy(mapping) if mapping else {"properties": {}}
-        self._mapper = DocumentMapper(self._mapping, analyzers, total_fields_limit)
+        self._mapper = DocumentMapper(self._mapping, analyzers, total_fields_limit,
+                                      dense_vector_max_dims)
         self._validate_similarities()
 
     def _validate_similarities(self) -> None:
@@ -413,7 +466,8 @@ class MapperService:
             if meta_key in new_mapping:
                 merged[meta_key] = new_mapping[meta_key]
         # recompile validates the merged tree
-        self._mapper = DocumentMapper(merged, self.analyzers, self.total_fields_limit)
+        self._mapper = DocumentMapper(merged, self.analyzers, self.total_fields_limit,
+                                      self.dense_vector_max_dims)
         self._mapping = merged
         self._validate_similarities()
 

@@ -236,7 +236,7 @@ class Node:
         # consumers only reach batchers alive at update time; the pruning
         # knobs are re-read per query from the index's Settings map)
         state = self.cluster_service.state
-        for prefix in ("search.batch.", "search.pallas."):
+        for prefix in ("search.batch.", "search.pallas.", "search.knn."):
             cluster_dynamic = state.persistent_settings.merged_with(
                 state.transient_settings).filtered_by_prefix(prefix)
             merged_settings = self.settings.filtered_by_prefix(
@@ -1590,6 +1590,8 @@ class Node:
         # again) when absent — synced here from the committed state
         # because the value-only update consumers can't see explicitness
         from elasticsearch_tpu.common.settings import (
+            SEARCH_KNN_ENABLED,
+            SEARCH_KNN_TILE_SUB,
             SEARCH_PALLAS_PRUNING_ENABLED,
             SEARCH_PALLAS_PRUNING_PROBE_TILES,
         )
@@ -1600,7 +1602,12 @@ class Node:
                 (SEARCH_PALLAS_PRUNING_ENABLED,
                  "pruning_enabled_override"),
                 (SEARCH_PALLAS_PRUNING_PROBE_TILES,
-                 "pruning_probe_override")):
+                 "pruning_probe_override"),
+                # kNN plane knobs share the explicitness contract: the
+                # cluster-level value wins while set, and clearing it
+                # hands control back to the index's own Settings
+                (SEARCH_KNN_ENABLED, "knn_enabled_override"),
+                (SEARCH_KNN_TILE_SUB, "knn_tile_sub_override")):
             explicit = committed.get(setting.key) is not None
             value = setting.get(committed) if explicit else None
             for svc in self.indices.values():

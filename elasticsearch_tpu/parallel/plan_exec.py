@@ -633,6 +633,68 @@ def _mesh_batched_pruned_program(mesh: Mesh, spd: int, q_batch: int,
     return run
 
 
+@functools.lru_cache(maxsize=32)
+def _mesh_knn_program(mesh: Mesh, spd: int, q_pad: int, kk: int,
+                      sub: int, d_pad: int, nd_knn: int,
+                      interpret: bool):
+    """One compiled scatter-gather serving Q concurrent kNN queries on
+    the MXU (ROADMAP item 4): per slot, ONE ``knn_score_tiles`` launch
+    streams the slot's bf16 embedding matrix once for the whole batch
+    and emits per-query per-tile top-k candidates; pools merge locally,
+    then over ICI via one all_gather — the same collective shape as
+    ``_mesh_batched_kernel_program``, with the posting windows replaced
+    by a dense matmul. The match total (live docs carrying the vector
+    field) is query-independent: it is the psum of the staged mask
+    sums, not a kernel output."""
+    from elasticsearch_tpu.ops import pallas_knn as pkn
+
+    def per_device(emb, scale, mask, qv):
+        dev = jax.lax.axis_index("shards")
+        cand_s, cand_d, cand_slot = [], [], []
+        count = None
+        for i in range(spd):
+            ts, td = pkn.knn_score_tiles(
+                emb[i], scale[i], mask[i], qv,
+                sub=sub, k=kk, q_batch=q_pad, interpret=interpret)
+            s_i, d_i = pkn.merge_knn_topk(ts, td, kk)  # [q_pad, kk']
+            cand_s.append(s_i)
+            cand_d.append(d_i)
+            cand_slot.append(
+                jnp.zeros(s_i.shape, jnp.int32)
+                + (dev.astype(jnp.int32) * jnp.int32(spd) + jnp.int32(i)))
+            c = jnp.sum(mask[i]).astype(jnp.int32)
+            count = c if count is None else count + c
+        cs = jnp.concatenate(cand_s, axis=1)
+        cd = jnp.concatenate(cand_d, axis=1)
+        cslot = jnp.concatenate(cand_slot, axis=1)
+        total = jax.lax.psum(count, "shards")  # scalar, replicated
+        all_s = jax.lax.all_gather(cs, "shards")
+        all_d = jax.lax.all_gather(cd, "shards")
+        all_slot = jax.lax.all_gather(cslot, "shards")
+        pool_s = all_s.transpose(1, 0, 2).reshape(q_pad, -1)
+        pool_d = all_d.transpose(1, 0, 2).reshape(q_pad, -1)
+        pool_slot = all_slot.transpose(1, 0, 2).reshape(q_pad, -1)
+        top_s, top_i = jax.lax.top_k(pool_s, min(kk, pool_s.shape[1]))
+        top_d = jnp.take_along_axis(pool_d, top_i, axis=1)
+        top_slot = jnp.take_along_axis(pool_slot, top_i, axis=1)
+        totals = jnp.full((q_pad,), total, jnp.int32)
+        return top_s[None], top_d[None], top_slot[None], totals[None]
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(PS("shards"), PS("shards"), PS("shards"), PS()),
+        out_specs=(PS("shards"),) * 4,
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(*args):
+        outs = mapped(*args)
+        return tuple(o[0] for o in outs)  # replicated: row 0 == row i
+
+    return run
+
+
 class IndexMeshSearch:
     """Routes an index's production query phase through the mesh.
 
@@ -671,6 +733,9 @@ class IndexMeshSearch:
         # (query_batch): launches and member-queries served batched
         self.batched_launch_total = 0
         self.batched_query_total = 0
+        # dense-vector retrieval on the MXU (docs/VECTOR.md): queries
+        # whose kNN side ran the mesh kNN program
+        self.knn_query_total = 0
         # block-max pruned scoring observability (docs/PRUNING.md):
         # queries served by the pruned program, and its tile economy
         self.pruned_query_total = 0
@@ -770,6 +835,175 @@ class IndexMeshSearch:
         if probe not in (2, 4, 8, 16, 32):
             probe = 8
         return bool(enabled), probe
+
+    def _knn_config(self):
+        """(enabled, tile_sub preference) from the live settings —
+        search.knn.* is dynamic (same override pattern as pruning: a
+        PUT _cluster/settings update lands as per-index overrides that
+        win over creation-time Settings; docs/VECTOR.md)."""
+        from elasticsearch_tpu.ops.pallas_knn import (
+            DEFAULT_KNN_SUB,
+            VALID_KNN_SUBS,
+        )
+
+        settings = getattr(self.svc, "settings", None)
+        enabled = getattr(self.svc, "knn_enabled_override", None)
+        if enabled is None:
+            enabled = (settings.get_bool("search.knn.enabled", True)
+                       if settings is not None else True)
+        sub = getattr(self.svc, "knn_tile_sub_override", None)
+        if sub is None:
+            sub = (settings.get_int("search.knn.tile_sub",
+                                    DEFAULT_KNN_SUB)
+                   if settings is not None else DEFAULT_KNN_SUB)
+        if sub not in VALID_KNN_SUBS:
+            sub = DEFAULT_KNN_SUB
+        return bool(enabled), int(sub)
+
+    def query_knn(self, spec: dict, k: int, deadline=None,
+                  stats=None) -> Optional[dict]:
+        """One kNN query on the mesh MXU plane (the Q == 1 form of
+        query_knn_batch). Returns {total, refs, max_score, plane} or
+        None when ineligible (callers run the host plan-node rung)."""
+        out = self.query_knn_batch([spec], [max(k, 1)], deadline=deadline,
+                                   stats=[stats])
+        return out[0] if out is not None else None
+
+    def query_knn_batch(self, specs: List[dict], ks: List[int],
+                        deadline=None,
+                        stats: Optional[list] = None) -> Optional[list]:
+        """Cross-query micro-batching on the kNN MXU plane: Q concurrent
+        vector queries against ONE dense_vector field scored by ONE
+        batched ``knn_score_tiles`` launch inside one shard_map program —
+        the embedding matrix streams out of HBM once for the whole batch
+        (the q_batch contract the MicroBatcher feeds, exactly like the
+        BM25 rung). Returns one {total, refs, max_score, plane} dict per
+        member, or None when the batch can't run here. A plane FAULT
+        quarantines mesh_pallas exactly ONCE for the whole batch.
+        ``stats``: one request-body "stats" groups list per member (the
+        per-shard group counters must not depend on which plane served
+        the query)."""
+        from elasticsearch_tpu.index.segment import next_pow2
+        from elasticsearch_tpu.mapper.field_types import DenseVectorFieldType
+        from elasticsearch_tpu.ops import pallas_knn as pkn
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+        from elasticsearch_tpu.search.service import DocRef
+        from elasticsearch_tpu.testing.disruption import on_plane_execute
+
+        if self.plane_pref not in ("auto", "pallas"):
+            return None
+        if not self.plane_health.available("mesh_pallas"):
+            return None
+        if len(self.svc.shards) < 2:
+            return None
+        enabled, sub_pref = self._knn_config()
+        if not enabled:
+            return None
+        # field uniformity + request validation OUTSIDE the fault-
+        # recording try: a malformed spec (unknown field, wrong dims) is
+        # a REQUEST error the serial path owns with its own 4xx, never a
+        # plane fault to quarantine on (same split as query_batch)
+        try:
+            fields = {str(spec["field"]) for spec in specs}
+            if len(fields) != 1:
+                return None
+            field = next(iter(fields))
+            ft = self.svc.mapper_service.field_type(field)
+            if not isinstance(ft, DenseVectorFieldType):
+                return None
+            for spec in specs:
+                qv = spec["query_vector"]
+                if (not isinstance(qv, (list, tuple))
+                        or len(qv) != ft.dims
+                        or any(isinstance(v, bool)
+                               or not isinstance(v, (int, float))
+                               or not np.isfinite(v) for v in qv)):
+                    # incl. NaN/inf: the serial path owns the 400 (a
+                    # NaN would poison scores and drive the kernel's
+                    # tie-select past the doc range)
+                    return None
+        except (KeyError, TypeError):
+            return None
+        if deadline is not None:
+            deadline.checkpoint()
+        if not self._ensure_staged():
+            return None
+        session = self._executor.ensure_knn(field, ft.dims, ft.similarity)
+        if session is None:
+            return None
+        q_batch = len(specs)
+        q_pad = next_pow2(q_batch)
+        kk = next_pow2(max(max(ks), 1))
+        d_pad = session["d_pad"]
+        nd_knn = session["nd_pad"]
+        g = psc.tile_geometry(nd_knn,
+                              pkn.knn_tile_sub(nd_knn, d_pad, sub_pref))
+        qmat = np.zeros((q_pad, d_pad), np.float32)
+        for q, spec in enumerate(specs):
+            qmat[q] = pkn.normalize_query(
+                np.asarray(spec["query_vector"], np.float32),
+                ft.similarity, d_pad)
+        from elasticsearch_tpu.common.errors import TaskCancelledException
+        from elasticsearch_tpu.search.cancellation import (
+            TimeExceededException,
+        )
+
+        try:
+            on_plane_execute(self.svc.name, "mesh_pallas")
+            run = _mesh_knn_program(
+                self._executor.mesh, self._executor.slots_per_dev,
+                q_pad, kk, g.tile_sub, d_pad, nd_knn,
+                session["mode"] == "interpret")
+            args = (session["emb"], session["scale"], session["mask"],
+                    jnp.asarray(qmat))
+            if deadline is not None:
+                # a first call compiles the program (seconds): honor the
+                # deadline before committing to the launch
+                deadline.checkpoint()
+            with _MESH_EXEC_LOCK:
+                outs = run(*args)
+                # async dispatch: completion inside the lock
+                jax.block_until_ready(outs)
+            keys, docs, slots, totals = (np.asarray(o) for o in outs)
+        except (PlanStructureMismatch, NotImplementedError):
+            return None  # shape ineligibility: next rung, no penalty
+        except (TaskCancelledException, TimeExceededException):
+            raise  # PR-4 contract: the caller owns partial/cancel
+        except Exception:  # noqa: BLE001 — plane fault, not a shape miss
+            _plane_logger.warning(
+                "[%s] kNN execution plane [mesh_pallas] failed; "
+                "quarantined for %.1fs", self.svc.name,
+                self.plane_health.cooldown_s, exc_info=True)
+            self.plane_health.record_failure("mesh_pallas")
+            return None
+        self.query_total += q_batch
+        self.pallas_query_total += q_batch
+        self.knn_query_total += q_batch
+        if q_batch > 1:
+            self.batched_launch_total += 1
+            self.batched_query_total += q_batch
+        results = []
+        for q in range(q_batch):
+            for sid in self.svc.shards:
+                searcher = self.svc.shards[sid].searcher
+                searcher.query_total += 1
+                searcher.record_query_groups(
+                    stats[q] if stats is not None else None)
+            refs = []
+            max_score = None
+            for key, slot, d in zip(keys[q][: ks[q]], slots[q][: ks[q]],
+                                    docs[q][: ks[q]]):
+                if key == -np.inf or d < 0:
+                    continue
+                sid, seg = self._pairs[int(slot)]
+                score = float(key)
+                refs.append(DocRef(sid, seg.name, int(d), score, ()))
+                if max_score is None:
+                    max_score = score
+            results.append({"total": int(totals[q]), "refs": refs,
+                            "max_score": max_score,
+                            "plane": "mesh_pallas"})
+        return results
 
     def _sort_plan(self, body: dict):
         """Resolve the request's sort to staged mesh key columns.
@@ -1512,6 +1746,9 @@ class MeshPlanExecutor:
         # lazily-staged tile-kernel plane (ensure_kernel): False =
         # unavailable, dict = {geom, meta: {id(seg): (bmin, bmax)}, mode}
         self._kernel = None
+        # lazily-staged kNN plane per dense_vector field (ensure_knn):
+        # field -> False | {emb, scale, mask, d_pad, nd_pad, metric}
+        self._knn: Dict[str, object] = {}
         # per-(segment, geometry, lane posting-run) block-max bound
         # columns for pruning (invariant across queries — under zipfian
         # traffic the same hot terms recompute identical columns);
@@ -1605,6 +1842,70 @@ class MeshPlanExecutor:
                 self._kernel = False
                 return None
         return dict(self._kernel, mode=mode)
+
+    def ensure_knn(self, field: str, dims: int,
+                   metric: str) -> Optional[dict]:
+        """Stage a dense_vector field's kNN plane over the stacked
+        segment set: per-slot bf16 embedding matrices [n_slots, nd_pad,
+        d_pad], the metric scale columns (cosine inverse norms / ones)
+        and the live∧has-vector mask columns — packed on the SAME
+        collective geometry as the postings staging, so the kNN program
+        reuses the executor's mesh/sharding/slot mapping verbatim.
+        Deletes are honored through the mask: IndexMeshSearch rebuilds
+        the executor (and with it this staging) whenever any segment's
+        live_doc_count changes. Returns the session dict or None when
+        the kernel can't run here."""
+        from elasticsearch_tpu.ops.aggs import _pallas_mode
+
+        mode = _pallas_mode()
+        if not mode:
+            return None
+        entry = self._knn.get(field)
+        if entry is False:
+            return None
+        if entry is None:
+            try:
+                import ml_dtypes
+
+                from elasticsearch_tpu.ops import pallas_knn as pkn
+                from elasticsearch_tpu.ops import pallas_scoring as psc
+
+                d_pad = pkn.pad_dims(dims)
+                nd_knn = max(self.nd_pad, psc.LANE)
+                emb = np.zeros((self.n_slots, nd_knn, d_pad),
+                               ml_dtypes.bfloat16)
+                scale = np.zeros((self.n_slots, nd_knn, 1), np.float32)
+                mask = np.zeros((self.n_slots, nd_knn, 1), np.float32)
+                for i, seg in enumerate(self.segments):
+                    col = seg.vector_columns.get(field)
+                    if col is None:
+                        continue  # slot stays dead (mask all-zero)
+                    if col.dims != dims:
+                        raise ValueError(
+                            f"segment [{seg.name}] stores [{field}] at "
+                            f"dims={col.dims}, mapping says {dims}")
+                    # the host mirror is already on the bf16 grid: the
+                    # astype below is exact
+                    emb[i, : col.vectors.shape[0], : dims] = \
+                        col.vectors.astype(ml_dtypes.bfloat16)
+                    sc = pkn.vector_scale_column(col.vectors, metric)
+                    live = seg.live[: col.vectors.shape[0]]
+                    m = (col.exists & live).astype(np.float32)
+                    scale[i, : sc.shape[0]] = sc
+                    mask[i, : m.shape[0], 0] = m
+                entry = {
+                    "emb": jax.device_put(emb, self._sharding),
+                    "scale": jax.device_put(scale, self._sharding),
+                    "mask": jax.device_put(mask, self._sharding),
+                    "d_pad": d_pad,
+                    "nd_pad": nd_knn,
+                    "metric": metric,
+                }
+                self._knn[field] = entry
+            except Exception:  # noqa: BLE001 — plane stays host
+                self._knn[field] = False
+                return None
+        return dict(entry, mode=mode)
 
     def tile_lane_ub_cached(self, seg, union_lanes, row_lo, row_hi,
                             bfmax, sub: int) -> np.ndarray:

@@ -688,6 +688,17 @@ def _search_body(req):
     if req.param("allow_partial_search_results") is not None:
         body["allow_partial_search_results"] = req.bool_param(
             "allow_partial_search_results")
+    if req.param("track_total_hits") is not None:
+        # boolean OR the reference's integer-threshold form; an explicit
+        # false is the default behavior, so the key is simply not set
+        # (setting it would needlessly demote the request off the
+        # batchable fast path)
+        raw = req.param("track_total_hits")
+        try:
+            body["track_total_hits"] = int(raw)
+        except (TypeError, ValueError):
+            if req.bool_param("track_total_hits"):
+                body["track_total_hits"] = True
     if req.param("sort") is not None:
         sort = []
         for part in req.param("sort").split(","):
@@ -708,7 +719,38 @@ def _search(node, req):
     resp = node.search(req.param("index", "_all"), body,
                        scroll=req.param("scroll"))
     _echo_hit_types(node, resp)
+    _render_total_hits(resp, body)
     return 200, resp
+
+
+def _render_total_hits(resp, body) -> None:
+    """track_total_hits-style REST surfacing of inexact totals: the 6.x
+    response keeps ``hits.total`` a bare int, but block-max pruned
+    scoring (docs/PRUNING.md) and hybrid kNN fusion (docs/VECTOR.md)
+    report LOWER BOUNDS — previously visible only through the
+    response-internal ``_pruned``/``_total_relation`` markers. Whenever
+    the total is inexact, or the request explicitly asked with
+    ``track_total_hits``, it renders as the modern object form
+    ``{"value": N, "relation": "eq"|"gte"}``. Passing
+    ``track_total_hits: true`` (or the reference's integer-threshold
+    form — totals here are exact whenever the count ran exhaustively,
+    so any positive threshold is satisfied) also forces the EXACT
+    total: the key is outside the pruned fast path's allowed body keys,
+    so such requests execute exhaustively by construction."""
+    hits = (resp or {}).get("hits")
+    if not isinstance(hits, dict) or not isinstance(hits.get("total"), int):
+        return
+    relation = "eq"
+    pruned = resp.get("_pruned")
+    if isinstance(pruned, dict) and pruned.get("total_relation"):
+        relation = str(pruned["total_relation"])
+    elif resp.get("_total_relation") == "gte":
+        relation = "gte"
+    tth = (body or {}).get("track_total_hits")
+    opted_in = tth is True or (isinstance(tth, int)
+                               and not isinstance(tth, bool) and tth > 0)
+    if relation != "eq" or opted_in:
+        hits["total"] = {"value": hits["total"], "relation": relation}
 
 
 def _echo_hit_types(node, resp):
@@ -746,7 +788,14 @@ def _msearch(node, req):
         header.setdefault("index", req.param("index", "_all"))
         searches.append((header, body))
         i += 2
-    return 200, node.msearch(searches)
+    resp = node.msearch(searches)
+    # the same inexact-total rendering as _search, per entry (a pruned
+    # or hybrid member's gte lower bound must not present as exact)
+    for (header, body), entry in zip(searches,
+                                     resp.get("responses") or []):
+        if isinstance(entry, dict):
+            _render_total_hits(entry, body)
+    return 200, resp
 
 
 def _count(node, req):

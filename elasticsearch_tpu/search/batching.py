@@ -61,7 +61,59 @@ _BATCHABLE_KEYS = frozenset({
     "terminate_after", "rescore", "search_after", "track_scores",
     "_source", "docvalue_fields", "stored_fields", "script_fields",
     "highlight", "version",
+    # NB track_total_hits is deliberately NOT batchable: the mesh
+    # batched rung rejects whole batches containing any unknown key, so
+    # one flagged member would demote its 15 peers off the mesh_pallas
+    # launch — it runs solo instead (exhaustive either way)
 })
+
+
+# pure-kNN request shapes the batched kNN MXU launch covers (the body
+# either carries the top-level `knn` section alone or the bare `knn`
+# query clause); hybrid (query + knn) requests run serially — each side
+# then rides its own plane's batching
+_KNN_BATCHABLE_KEYS = frozenset({
+    "knn", "query", "size", "from", "timeout",
+    "allow_partial_search_results", "stats", "_source",
+})
+
+
+# knn spec parameters the parser accepts (search/query_dsl.KnnQueryBuilder
+# strict-parses the same set): the mesh gate must reject anything else so
+# an unknown parameter gets the SAME 400 whichever plane is healthy
+_KNN_SPEC_KEYS = frozenset({
+    "field", "query_vector", "k", "num_candidates", "filter", "boost",
+    "_name",
+})
+
+
+def _knn_shaped(body: dict) -> Optional[dict]:
+    """The knn spec of a knn-SHAPED request (top-level section with no
+    lexical query, or the sole knn query clause), eligible or not."""
+    if isinstance(body.get("knn"), dict) and body.get("query") is None:
+        return body["knn"]
+    q = body.get("query")
+    if (isinstance(q, dict) and set(q) == {"knn"}
+            and isinstance(q["knn"], dict) and "knn" not in body):
+        return q["knn"]
+    return None
+
+
+def knn_batch_spec(body: Optional[dict]) -> Optional[dict]:
+    """The knn spec when this request is a pure top-k vector search a
+    batched kNN launch could serve (same shape the mesh program covers),
+    else None."""
+    body = body or {}
+    if any(key not in _KNN_BATCHABLE_KEYS for key in body):
+        return None
+    spec = _knn_shaped(body)
+    if spec is None or float(spec.get("boost", 1.0)) != 1.0:
+        return None
+    if spec.get("filter"):
+        return None  # filtered kNN runs the host plan rung (exact)
+    if any(key not in _KNN_SPEC_KEYS for key in spec):
+        return None  # unknown parameter: the parser owns the 400
+    return spec
 
 
 def batchable_body(body: Optional[dict]) -> bool:
@@ -70,8 +122,15 @@ def batchable_body(body: Optional[dict]) -> bool:
     later, per query, by the plan builder — an ineligible member simply
     executes serially inside the batch.)"""
     body = body or {}
+    if _knn_shaped(body) is not None:
+        # pure kNN: batchable only when the MXU launch covers it — a
+        # filtered/boosted/malformed spec runs SOLO rather than joining
+        # the lexical batch and demoting its peers off the mesh rung
+        return knn_batch_spec(body) is not None
     if not isinstance(body.get("query"), dict):
         return False  # match_all / missing query: nothing to amortize
+    if body.get("knn") is not None:
+        return False  # hybrid: each side batches on its own plane
     return all(key in _BATCHABLE_KEYS for key in body)
 
 

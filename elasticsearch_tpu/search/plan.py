@@ -334,6 +334,66 @@ class PallasScoreTermsNode(PlanNode):
         return scores, scores > 0.0
 
 
+class KnnScoreNode(PlanNode):
+    """Dense-vector similarity scoring against a staged embedding matrix
+    (the host rung of the kNN plane ladder; the mesh_pallas rung runs
+    the MXU kernel in ops/pallas_knn.py with identical arithmetic).
+
+    score = (dot(x, q) * scale) / 2 + 1/2 with q pre-normalized for
+    cosine and scale the staged per-doc inverse norm (ones for
+    dot_product) — the reference's (1 + sim) / 2 convention. Every live
+    doc carrying the vector field "matches"; ranking is the whole query.
+
+    The embedding matrix is segment-local device state (ctx.seg keys
+    staged by Segment.ensure_vector_staged), NOT a plan array — so the
+    node cannot stack onto a mesh template (pad kind "x"): the generic
+    mesh path cleanly mismatches and the dedicated kNN mesh program
+    (IndexMeshSearch.query_knn) owns the distributed form."""
+
+    def __init__(self, field: str, qvec, metric: str, boost: float,
+                 emb_key: str, norm_key: str, exists_key: str):
+        self.field = field
+        self.qvec = qvec  # [1, d_pad] f32 (normalize_query row)
+        self.metric = metric
+        self.boost = np.float32(boost)
+        self.emb_key = emb_key
+        self.norm_key = norm_key
+        self.exists_key = exists_key
+
+    def key(self):
+        return (f"knn[{self.field},{self.metric},{self.qvec.shape[1]},"
+                f"{self.emb_key}]")
+
+    def trace_statics(self):
+        return (self.field, self.metric, self.emb_key)
+
+    def arrays(self):
+        return [self.qvec, self.boost]
+
+    def pad_kinds(self):
+        # "x": segment-keyed device state can't stack onto a mesh
+        # template — the executor raises PlanStructureMismatch and the
+        # ladder serves this query from the host (or the kNN program)
+        return ["x", "s"]
+
+    def emit(self, ctx):
+        qvec, boost = ctx.take(2)
+        emb = ctx.seg[self.emb_key].astype(jnp.float32)  # [nd_pad, d_pad]
+        # same contraction shape + HIGHEST precision as the MXU kernel so
+        # host and mesh rungs score identical bits (dryrun phase 5 gate)
+        s = jax.lax.dot_general(
+            emb, qvec, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)[:, 0]
+        if self.metric == "cosine":
+            s = s * ctx.seg[self.norm_key]
+        s = s * jnp.float32(0.5) + jnp.float32(0.5)
+        scores = jnp.concatenate([s, jnp.zeros(1, jnp.float32)])
+        matched = ctx.seg[self.exists_key]
+        return jnp.where(matched, scores * boost,
+                         jnp.float32(0.0)).astype(jnp.float32), matched
+
+
 class PhraseScoreNode(PlanNode):
     """Pre-verified phrase matches (host position intersection) scored by
     the field's similarity over the phrase frequency — MatchPhraseQuery

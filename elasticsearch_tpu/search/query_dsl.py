@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
     ParsingException,
     QueryShardException,
 )
@@ -337,6 +338,88 @@ class MatchNoneQueryBuilder(QueryBuilder):
 
     def to_plan(self, ctx, segment):
         return P.MatchNoneNode()
+
+
+class KnnQueryBuilder(QueryBuilder):
+    """Dense-vector kNN clause: score every live doc carrying the field
+    by its embedding similarity to ``query_vector`` (the mapped field's
+    ``similarity`` picks the metric). Mirrors the reference's knn search
+    surface grown after 6.x (KnnSearchBuilder / the top-level ``knn``
+    request section, which IndexService normalizes into this clause).
+
+    Execution is exhaustive (exact, recall 1.0 — no ANN graph): the
+    mesh_pallas rung scores the staged bf16 embedding matrix with the
+    MXU kernel (ops/pallas_knn.py), the host rung with an identical XLA
+    matmul (plan.KnnScoreNode). ``k`` sizes the result (the top-level
+    knn section defaults the response size to it); ``num_candidates``
+    is accepted for reference-API compatibility only — exhaustive exact
+    scoring makes an ANN candidate bound moot, so it has no effect."""
+
+    name = "knn"
+
+    def __init__(self, field: str, query_vector, k: int = 10,
+                 num_candidates: Optional[int] = None,
+                 filter: Optional[list] = None, **kw):
+        super().__init__(**kw)
+        self.field = field
+        self.query_vector = query_vector
+        self.k = int(k)
+        self.num_candidates = (int(num_candidates)
+                               if num_candidates is not None else None)
+        # pre-filter clauses (the reference's knn `filter`): restrict
+        # WHICH docs may rank — under exhaustive scoring pre- and
+        # post-filtering are equivalent, so they gate the matched mask
+        self.filter = list(filter or [])
+
+    def _field_type(self, ctx):
+        from elasticsearch_tpu.mapper.field_types import DenseVectorFieldType
+
+        ft = ctx.field_type(self.field)
+        if ft is None:
+            raise QueryShardException(
+                f"failed to create query: field [{self.field}] does not "
+                f"exist in the mapping")
+        if not isinstance(ft, DenseVectorFieldType):
+            raise QueryShardException(
+                f"[knn] queries are only supported on [dense_vector] "
+                f"fields; [{self.field}] is [{ft.type_name}]")
+        qv = self.query_vector
+        if (not isinstance(qv, (list, tuple))
+                or len(qv) != ft.dims
+                or any(isinstance(v, bool) or not isinstance(v, (int, float))
+                       or not np.isfinite(v) for v in qv)):
+            # finiteness matters: a NaN query poisons every score and
+            # drives the kernel's tie-select out of the doc range —
+            # reject with the same 400 the index path gives NaN vectors
+            raise IllegalArgumentException(
+                f"[knn] query_vector must be an array of {ft.dims} "
+                f"finite numbers for field [{self.field}]")
+        return ft
+
+    def to_plan(self, ctx, segment):
+        from elasticsearch_tpu.ops import pallas_knn as pkn
+
+        ft = self._field_type(ctx)
+        keys = segment.ensure_vector_staged(self.field, ft.similarity)
+        if keys is None:
+            # no doc of THIS segment carries the field: nothing can match
+            return P.MatchNoneNode()
+        emb_key, norm_key, exists_key, d_pad = keys
+        qvec = pkn.normalize_query(
+            np.asarray(self.query_vector, np.float32), ft.similarity,
+            d_pad).reshape(1, d_pad)
+        node = P.KnnScoreNode(self.field, qvec, ft.similarity, self.boost,
+                              emb_key, norm_key, exists_key)
+        if self.filter:
+            # filtered kNN: the vector score ranks, the filter gates —
+            # exact BoolQuery must+filter semantics (the mesh MXU
+            # program doesn't cover filtered specs: knn_batch_spec
+            # rejects them, so this plan always runs the host rung)
+            node = P.BoolNode(
+                must=[node],
+                filter_=[f.to_plan(ctx, segment) for f in self.filter],
+                should=[], must_not=[], min_should_match=0)
+        return node
 
 
 class MatchQueryBuilder(QueryBuilder):
@@ -1937,6 +2020,30 @@ def parse_query(body) -> QueryBuilder:
             minimum_should_match=params.get("minimum_should_match"),
             analyzer=params.get("analyzer"),
             boost=float(params.get("boost", 1.0)),
+        )
+    if qtype == "knn":
+        if not isinstance(qbody, dict) or "field" not in qbody:
+            raise ParsingException("[knn] requires [field]")
+        if "query_vector" not in qbody:
+            raise ParsingException("[knn] requires [query_vector]")
+        unknown = set(qbody) - {"field", "query_vector", "k",
+                                "num_candidates", "filter", "boost",
+                                "_name"}
+        if unknown:
+            # strict parsing (AbstractQueryBuilder contract): a
+            # misspelled parameter must 400, never silently drop
+            raise ParsingException(
+                f"[knn] unknown parameter(s) {sorted(unknown)}")
+        flt = qbody.get("filter")
+        filters = ([parse_query(f) for f in flt]
+                   if isinstance(flt, list)
+                   else [parse_query(flt)] if flt is not None else [])
+        return KnnQueryBuilder(
+            qbody["field"], qbody["query_vector"],
+            k=int(qbody.get("k", 10) or 10),
+            num_candidates=qbody.get("num_candidates"),
+            filter=filters,
+            boost=float(qbody.get("boost", 1.0)),
         )
     if qtype == "match_phrase":
         field, value, params = _field_and_params(qbody, "query")
