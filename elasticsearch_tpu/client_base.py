@@ -25,7 +25,7 @@ class Client:
         node.rest_controller = self.controller
 
     def perform(self, method: str, path: str, params: Optional[dict] = None,
-                body=None):
+                body=None, headers: Optional[dict] = None):
         if body is None:
             raw = b""
         elif isinstance(body, (bytes, str)):
@@ -33,7 +33,8 @@ class Client:
         else:
             raw = json.dumps(body).encode()
         status, payload = self.controller.dispatch(
-            method, path, {k: str(v) for k, v in (params or {}).items()}, raw
+            method, path, {k: str(v) for k, v in (params or {}).items()}, raw,
+            headers=headers,
         )
         return status, payload
 

@@ -580,6 +580,16 @@ SEARCH_KNN_TILE_SUB = Setting(
     validator=_validate_knn_tile_sub, dynamic=True,
 )
 
+# --- phase-attributed query telemetry (docs/OBSERVABILITY.md) ---
+
+SEARCH_TELEMETRY_ENABLED = Setting.bool_setting(
+    # the always-on phase tracer's kill switch: false stops per-query
+    # span recording (profile/_stats phases/slowlog enrichment go
+    # quiet); the tracer is bounded-overhead either way — this exists
+    # for incident triage, not steady-state tuning
+    "search.telemetry.enabled", True, dynamic=True
+)
+
 NODE_SETTINGS = [
     CLUSTER_NAME,
     NODE_NAME,
@@ -623,6 +633,7 @@ NODE_SETTINGS = [
     SEARCH_PALLAS_PRUNING_PROBE_TILES,
     SEARCH_KNN_ENABLED,
     SEARCH_KNN_TILE_SUB,
+    SEARCH_TELEMETRY_ENABLED,
 ]
 
 # --- index-scoped ---

@@ -61,6 +61,12 @@ class IndexService:
         durability = INDEX_TRANSLOG_DURABILITY.get(settings)
         slowlog_warn = settings.get_time("index.search.slowlog.threshold.query.warn")
         slowlog_info = settings.get_time("index.search.slowlog.threshold.query.info")
+        # index-level search slowlog thresholds for mesh-plane-served
+        # queries (no ShardSearcher runs there); negative = disabled
+        self._slowlog_warn_s = (slowlog_warn if slowlog_warn is not None
+                                and slowlog_warn >= 0 else None)
+        self._slowlog_info_s = (slowlog_info if slowlog_info is not None
+                                and slowlog_info >= 0 else None)
         idx_slow_warn = settings.get_time(
             "index.indexing.slowlog.threshold.index.warn")
         idx_slow_info = settings.get_time(
@@ -118,6 +124,19 @@ class IndexService:
             max_queries=settings.get_int("search.batch.max_queries", 16),
             enabled=settings.get_bool("search.batch.enabled", True),
             stats=self.batch_stats)
+        # phase-attributed query telemetry (search/telemetry.py,
+        # docs/OBSERVABILITY.md): always-on span tracing drained into
+        # per-plane × per-phase histograms; search.telemetry.enabled is
+        # the dynamic kill switch
+        from elasticsearch_tpu.search.telemetry import SearchTelemetry
+
+        self.telemetry = SearchTelemetry()
+        # batch items are (body, deadline, tracer): stamp window-wait +
+        # batch shape onto each member's tracer at dispatch time
+        self._batcher.annotate = self._annotate_batch_member
+        import threading as _threading
+
+        self._stats_lock = _threading.Lock()
         # shard request cache (IndicesRequestCache.java:64): size==0
         # (agg/count) responses cached against the shards' visibility
         # epochs; index.requests.cache.enable gates it (default on)
@@ -335,26 +354,95 @@ class IndexService:
     # Search (scatter -> merge -> fetch; §3.2 of SURVEY.md)
     # ------------------------------------------------------------------
 
+    def _telemetry_enabled(self) -> bool:
+        """search.telemetry.enabled — the dynamic kill switch for the
+        always-on phase tracer (docs/OBSERVABILITY.md). A cluster-level
+        PUT wins while explicitly set (same explicitness contract as
+        search.pallas.pruning.* — synced in put_cluster_settings)."""
+        override = getattr(self, "telemetry_enabled_override", None)
+        if override is not None:
+            return bool(override)
+        return self.settings.get_bool("search.telemetry.enabled", True)
+
+    def _tracer(self):
+        """One QueryTracer per request (NULL_TRACER when the kill switch
+        is off), stamped with the request's X-Opaque-Id so the id
+        survives the batch leader's thread hop."""
+        from elasticsearch_tpu.search.telemetry import get_opaque_id
+
+        tracer = self.telemetry.tracer(self._telemetry_enabled())
+        oid = get_opaque_id()
+        if oid:
+            tracer.annotate("opaque_id", oid)
+        return tracer
+
+    @staticmethod
+    def _annotate_batch_member(item, wait_s: float, batch_size: int,
+                               member_index: int) -> None:
+        """MicroBatcher telemetry hook: items are (body, deadline,
+        tracer, opaque_id) — stamp the collection-window wait onto the
+        member's tracer before the leader dispatches. The LAUNCH sites
+        own batch_size/batch_member_index: only members that actually
+        share a launch report a batch shape, a member that falls to
+        serial execution must not claim one (docs/OBSERVABILITY.md)."""
+        tracer = item[2] if len(item) > 2 else None
+        if tracer is not None and getattr(tracer, "enabled", False):
+            tracer.annotate("batch_window_wait_ms",
+                            round(wait_s * 1000.0, 3))
+
+    def _maybe_search_slowlog(self, took_s: float, body: dict,
+                              plane: str, tracer) -> None:
+        """Search slowlog for mesh-plane-served queries (the host path's
+        per-shard ShardSearcher slowlog never runs there): same logger,
+        same thresholds, enriched with plane + top-3 phase spans + the
+        request's X-Opaque-Id (docs/OBSERVABILITY.md)."""
+        from elasticsearch_tpu.search.service import emit_search_slowlog
+
+        emit_search_slowlog(self._slowlog_warn_s, self._slowlog_info_s,
+                            took_s, "index", self.name, plane, tracer,
+                            body)
+
+    def _finish_query_response(self, resp: dict, body: dict, tracer,
+                               plane: str, took_s: float) -> dict:
+        """One choke point for per-query observability: drain the
+        tracer into the phase histograms, attach the plane-truthful
+        profile section, and emit the (mesh-plane) slowlog line."""
+        self.telemetry.record_query(plane, tracer)
+        if body.get("profile"):
+            prof = resp.setdefault("profile", {"shards": []})
+            prof["plane"] = plane
+            prof["phases"] = tracer.spans()
+            prof["annotations"] = tracer.annotations()
+        if plane != "host":
+            self._maybe_search_slowlog(took_s, body, plane, tracer)
+        return resp
+
     def _try_mesh_search(self, body: dict, k: int,
-                         deadline=None) -> Optional[dict]:
+                         deadline=None, tracer=None) -> Optional[dict]:
         """Mesh query phase + host fetch phase. None = ineligible."""
         import time as _time
 
         from elasticsearch_tpu.search.service import fetch_hits
+        from elasticsearch_tpu.search.telemetry import NULL_TRACER
 
         t0 = _time.monotonic()
+        if tracer is None:
+            tracer = NULL_TRACER
         if self._mesh_search is None:
             from elasticsearch_tpu.parallel.plan_exec import IndexMeshSearch
 
             self._mesh_search = IndexMeshSearch(self)
-        out = self._mesh_search.query(body, max(k, 1), deadline=deadline)
+        out = self._mesh_search.query(body, max(k, 1), deadline=deadline,
+                                      tracer=tracer)
         if out is None:
             return None
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size")) if body.get("size") is not None else 10
         refs = out["refs"]
         refs_window = refs[from_: from_ + size] if size >= 0 else refs[from_:]
+        t_fetch = tracer.start("fetch")
         hits = fetch_hits(refs_window, self.shards, body, self.name)
+        tracer.stop("fetch", t_fetch)
         resp = {
             "took": int((_time.monotonic() - t0) * 1000),
             "timed_out": False,
@@ -385,10 +473,12 @@ class IndexService:
 
             resp["suggest"] = run_suggest(
                 body["suggest"], self.shards, self.mapper_service)
-        return resp
+        return self._finish_query_response(
+            resp, body, tracer, resp["_plane"],
+            _time.monotonic() - t0)
 
     def _try_mesh_knn(self, body: dict, spec: dict, k: int,
-                      deadline=None) -> Optional[dict]:
+                      deadline=None, tracer=None) -> Optional[dict]:
         """kNN query phase on the mesh_pallas MXU plane + host fetch
         phase. None = ineligible (callers run the host plan-node rung —
         the same ladder shape as _try_mesh_search). Response assembly is
@@ -400,10 +490,11 @@ class IndexService:
             self._mesh_search = IndexMeshSearch(self)
         out = self._mesh_search.query_knn(spec, max(k, 1),
                                           deadline=deadline,
-                                          stats=body.get("stats"))
+                                          stats=body.get("stats"),
+                                          tracer=tracer)
         if out is None:
             return None
-        return self._mesh_batch_response(body, out)
+        return self._mesh_batch_response(body, out, tracer=tracer)
 
     def _search_hybrid(self, body: dict, deadline=None) -> dict:
         """Hybrid ranking: the lexical ``query`` and the ``knn`` section
@@ -619,29 +710,39 @@ class IndexService:
         compatible queries shares one batched kernel launch; a lone query
         takes the unbatched path with zero added latency."""
         from elasticsearch_tpu.search.batching import batchable_body
+        from elasticsearch_tpu.search.telemetry import get_opaque_id
 
+        tracer = self._tracer()
         if (not self._batcher.enabled or preference_shards is not None
                 or pinned_segments is not None or body.get("scroll")
                 or not batchable_body(body)):
             return self._search_uncached(body, preference_shards,
-                                         pinned_segments, deadline=deadline)
+                                         pinned_segments, deadline=deadline,
+                                         tracer=tracer)
+        # the member's X-Opaque-Id rides the ITEM: the batch executes on
+        # the leader's thread, whose own request context must not stamp
+        # other members' slowlog lines (NULL_TRACER under the kill
+        # switch carries no annotation to correct it)
         return self._batcher.run(
-            self.name, (body, deadline),
+            self.name, (body, deadline, tracer, get_opaque_id()),
             single_fn=lambda it: self._search_uncached(
-                it[0], deadline=it[1]),
+                it[0], deadline=it[1], tracer=it[2]),
             batch_fn=lambda items: self.search_batch(
-                [it[0] for it in items], [it[1] for it in items]))
+                [it[0] for it in items], [it[1] for it in items],
+                [it[2] for it in items], [it[3] for it in items]))
 
     def _search_uncached(self, body: dict,
                          preference_shards: Optional[List[int]] = None,
                          pinned_segments: Optional[Dict[int, list]] = None,
                          deadline=None, score_caches: Optional[dict] = None,
-                         skip_mesh: bool = False) -> dict:
+                         skip_mesh: bool = False, tracer=None) -> dict:
         """score_caches: {(shard_id, segment_name): (scores, matched)}
         from a cross-query batched kernel launch (search_batch) — cached
         segments skip plan execution inside ShardSearcher.query.
         skip_mesh: the query already went through the batch's plane
-        ladder; don't re-probe the mesh plane per member."""
+        ladder; don't re-probe the mesh plane per member.
+        tracer: this request's QueryTracer (created here when absent);
+        spans attribute to whichever plane ends up serving."""
         from elasticsearch_tpu.search.cancellation import (
             TimeExceededException,
         )
@@ -650,6 +751,8 @@ class IndexService:
             shard_failure_entry,
         )
 
+        if tracer is None:
+            tracer = self._tracer()
         body = body or {}
         if body.get("knn") is not None:
             # top-level ``knn`` section (the reference's knn search
@@ -694,10 +797,12 @@ class IndexService:
                 knn_clause = _pure_knn_mesh_clause(body)
                 if knn_clause is not None:
                     mesh_resp = self._try_mesh_knn(body, knn_clause, k,
-                                                   deadline=deadline)
+                                                   deadline=deadline,
+                                                   tracer=tracer)
                 else:
                     mesh_resp = self._try_mesh_search(body, k,
-                                                      deadline=deadline)
+                                                      deadline=deadline,
+                                                      tracer=tracer)
             except TimeExceededException:
                 # deadline expired inside the mesh plane: the host loop
                 # below breaks at its first checkpoint and reports the
@@ -706,7 +811,8 @@ class IndexService:
                 timed_out = True
             if mesh_resp is not None:
                 return mesh_resp
-        self._host_query_total += 1
+        with self._stats_lock:
+            self._host_query_total += 1
 
         shard_results = []
         failures = []
@@ -747,7 +853,8 @@ class IndexService:
                         body, size_hint=max(k, 1),
                         segments=(pinned_segments.get(sid, [])
                                   if pinned_segments is not None else None),
-                        deadline=deadline, score_cache=shard_cache)
+                        deadline=deadline, score_cache=shard_cache,
+                        tracer=tracer)
                 )
             except TaskCancelledException:
                 raise  # _tasks/_cancel: a clean request-level error
@@ -786,6 +893,7 @@ class IndexService:
         merge_k = max(k, 0)
         if collapse_field:
             merge_k = 0  # keep all candidates; collapsing shrinks the list
+        t_merge = tracer.start("merge")
         all_refs = [ref for r in shard_results for ref in r.refs]
         refs = merge_refs(all_refs, sort_spec, merge_k or len(all_refs))
         if collapse_field:
@@ -799,9 +907,12 @@ class IndexService:
         if agg_specs:
             views = [v for r in shard_results for v in r.agg_views]
             aggregations = run_aggregations(agg_specs, views)
+        tracer.stop("merge", t_merge)
 
+        t_fetch = tracer.start("fetch")
         hits = fetch_hits(refs_window, self.shards, body, self.name,
                           pinned_segments=pinned_segments)
+        tracer.stop("fetch", t_fetch)
         if collapse_field:
             from elasticsearch_tpu.search.service import expand_collapsed_hits
 
@@ -846,14 +957,17 @@ class IndexService:
             resp["suggest"] = run_suggest(
                 body["suggest"], self.shards, self.mapper_service
             )
-        return resp
+        return self._finish_query_response(resp, body, tracer, "host",
+                                           took / 1000.0)
 
     # ------------------------------------------------------------------
     # Cross-query micro-batching (search/batching.py; docs/BATCHING.md)
     # ------------------------------------------------------------------
 
     def search_batch(self, bodies: List[dict],
-                     deadlines: Optional[list] = None) -> list:
+                     deadlines: Optional[list] = None,
+                     tracers: Optional[list] = None,
+                     oids: Optional[list] = None) -> list:
         """Execute Q concurrent search requests as one micro-batch.
 
         Returns one entry per member: the response dict, or the
@@ -876,9 +990,23 @@ class IndexService:
         from elasticsearch_tpu.search.cancellation import (
             TimeExceededException,
         )
+        from elasticsearch_tpu.search.telemetry import (
+            get_opaque_id,
+            set_opaque_id,
+        )
 
         n = len(bodies)
         deadlines = list(deadlines) if deadlines else [None] * n
+        # direct callers (tests, dryrun) pass no tracers: create per-
+        # member ones so batched profile/phase attribution still works
+        tracers = (list(tracers) if tracers
+                   else [self._tracer() for _ in bodies])
+        # every member executes on THIS (the leader's) thread: its own
+        # X-Opaque-Id must be the contextvar while its result is built,
+        # or its slowlog line logs the leader's client id; the leader's
+        # context is restored before returning
+        leader_oid = get_opaque_id()
+        oids = list(oids) if oids else [leader_oid] * n
         results: list = [None] * n
         live: List[int] = []
         for i, body in enumerate(bodies):
@@ -895,10 +1023,14 @@ class IndexService:
                     # expired before dispatch: serve its accumulated
                     # (empty) partial result — the serial path hits the
                     # same checkpoint immediately and reports timed_out
-                    results[i] = self._batch_member_single(body, dl)
+                    set_opaque_id(oids[i])
+                    results[i] = self._batch_member_single(body, dl,
+                                                           tracer=tracers[i])
                     continue
             if not batchable_body(body):
-                results[i] = self._batch_member_single(body, dl)
+                set_opaque_id(oids[i])
+                results[i] = self._batch_member_single(body, dl,
+                                                       tracer=tracers[i])
                 continue
             live.append(i)
 
@@ -911,12 +1043,16 @@ class IndexService:
         knn_live = [i for i in live if knn_batch_spec(bodies[i])]
         if knn_live:
             live = [i for i in live if i not in set(knn_live)]
-            self._dispatch_knn_batch(bodies, deadlines, knn_live, results)
+            self._dispatch_knn_batch(bodies, deadlines, knn_live, results,
+                                     tracers, oids=oids)
 
         if len(live) < 2:
             for i in live:
+                set_opaque_id(oids[i])
                 results[i] = self._batch_member_single(bodies[i],
-                                                       deadlines[i])
+                                                       deadlines[i],
+                                                       tracer=tracers[i])
+            set_opaque_id(leader_oid)
             return results
 
         live_bodies = [bodies[i] for i in live]
@@ -930,30 +1066,43 @@ class IndexService:
                 )
 
                 self._mesh_search = IndexMeshSearch(self)
-            mesh_out = self._mesh_search.query_batch(live_bodies)
+            mesh_out = self._mesh_search.query_batch(
+                live_bodies, tracers=[tracers[i] for i in live])
         if mesh_out is not None:
             for j, i in enumerate(live):
+                set_opaque_id(oids[i])
                 try:
                     results[i] = self._mesh_batch_response(
-                        bodies[i], mesh_out[j])
+                        bodies[i], mesh_out[j], tracer=tracers[i])
                 except Exception as e:  # noqa: BLE001 — per-member fetch
                     results[i] = e
             self.batch_stats.note_batch(len(live))
+            set_opaque_id(leader_oid)
             return results
 
         # rung 2: host-pallas batched scoring, then each member's normal
         # per-query pipeline on top of its cached score vectors
         caches, launches = self._host_batch_scores(live_bodies)
-        for j, i in enumerate(live):
-            results[i] = self._batch_member_single(
-                bodies[i], deadlines[i], score_caches=caches[j] or None,
-                skip_mesh=bool(caches[j]))
         # count only the members that actually shared a launch — kernel-
         # ineligible members executed fully serially and must not inflate
-        # the batching-coverage telemetry
+        # the batching-coverage telemetry (same rule for the batch-shape
+        # annotations below)
         shared = sum(1 for c in caches if c)
+        member_idx = 0
+        for j, i in enumerate(live):
+            set_opaque_id(oids[i])
+            if caches[j]:
+                tr = tracers[i]
+                if tr is not None and getattr(tr, "enabled", False):
+                    tr.annotate("batch_size", shared)
+                    tr.annotate("batch_member_index", member_idx)
+                member_idx += 1
+            results[i] = self._batch_member_single(
+                bodies[i], deadlines[i], score_caches=caches[j] or None,
+                skip_mesh=bool(caches[j]), tracer=tracers[i])
         if launches and shared:
             self.batch_stats.note_batch(shared)
+        set_opaque_id(leader_oid)
         return results
 
     @staticmethod
@@ -970,12 +1119,20 @@ class IndexService:
             body["size"] = int(spec["k"])
         return body
 
-    def _dispatch_knn_batch(self, bodies, deadlines, knn_live, results):
+    def _dispatch_knn_batch(self, bodies, deadlines, knn_live, results,
+                            tracers=None, oids=None):
         """Serve a burst of pure-kNN members: one batched MXU launch
         when they target the same field and the mesh plane is up, else
         per-member serial execution (which still rides the serial kNN
         ladder). Fills ``results`` in place."""
         from elasticsearch_tpu.search.batching import knn_batch_spec
+
+        from elasticsearch_tpu.search.telemetry import set_opaque_id
+
+        if tracers is None:
+            tracers = [None] * len(bodies)
+        if oids is None:
+            oids = [None] * len(bodies)
 
         specs = [knn_batch_spec(bodies[i]) for i in knn_live]
         norm_bodies = {i: self._knn_member_body(bodies[i])
@@ -999,27 +1156,31 @@ class IndexService:
                 self._mesh_search = IndexMeshSearch(self)
             mesh_out = self._mesh_search.query_knn_batch(
                 specs, ks,
-                stats=[norm_bodies[i].get("stats") for i in knn_live])
+                stats=[norm_bodies[i].get("stats") for i in knn_live],
+                tracers=[tracers[i] for i in knn_live])
         if mesh_out is not None:
             for j, i in enumerate(knn_live):
+                set_opaque_id(oids[i])
                 try:
                     results[i] = self._mesh_batch_response(
-                        norm_bodies[i], mesh_out[j])
+                        norm_bodies[i], mesh_out[j], tracer=tracers[i])
                 except Exception as e:  # noqa: BLE001 — per-member fetch
                     results[i] = e
             self.batch_stats.note_batch(len(knn_live))
             return
         for i in knn_live:
-            results[i] = self._batch_member_single(bodies[i], deadlines[i])
+            set_opaque_id(oids[i])
+            results[i] = self._batch_member_single(bodies[i], deadlines[i],
+                                                   tracer=tracers[i])
 
     def _batch_member_single(self, body, deadline, score_caches=None,
-                             skip_mesh=False):
+                             skip_mesh=False, tracer=None):
         """One member's serial execution inside a batch: exceptions are
         captured as that member's result instead of failing its peers."""
         try:
             return self._search_uncached(
                 body, deadline=deadline, score_caches=score_caches,
-                skip_mesh=skip_mesh)
+                skip_mesh=skip_mesh, tracer=tracer)
         except Exception as e:  # noqa: BLE001 — per-member isolation
             return e
 
@@ -1084,20 +1245,28 @@ class IndexService:
                     caches[i][(sid, seg.name)] = outs[j]
         return caches, launches
 
-    def _mesh_batch_response(self, body: dict, out: dict) -> dict:
+    def _mesh_batch_response(self, body: dict, out: dict,
+                             tracer=None) -> dict:
         """Assemble one member's full response from its slice of a
         batched mesh launch (same shape as _try_mesh_search)."""
         import time as _time
 
         from elasticsearch_tpu.search.service import fetch_hits
+        from elasticsearch_tpu.search.telemetry import NULL_TRACER
 
+        if tracer is None:
+            tracer = NULL_TRACER
         t0 = _time.monotonic()
+        t_demux = tracer.start("batch_demux")
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size")) if body.get("size") is not None else 10
         refs = out["refs"]
         refs_window = (refs[from_: from_ + size] if size >= 0
                        else refs[from_:])
+        tracer.stop("batch_demux", t_demux)
+        t_fetch = tracer.start("fetch")
         hits = fetch_hits(refs_window, self.shards, body, self.name)
+        tracer.stop("fetch", t_fetch)
         resp = {
             "took": int((_time.monotonic() - t0) * 1000),
             "timed_out": False,
@@ -1112,7 +1281,8 @@ class IndexService:
         }
         if out.get("pruned") is not None:
             resp["_pruned"] = out["pruned"]
-        return resp
+        return self._finish_query_response(
+            resp, body, tracer, resp["_plane"], _time.monotonic() - t0)
 
     def count(self, body: Optional[dict] = None) -> dict:
         body = dict(body or {})
@@ -1126,27 +1296,19 @@ class IndexService:
     def num_docs(self) -> int:
         return sum(s.num_docs for s in self.shards.values())
 
-    def stats(self) -> dict:
-        """Full CommonStats section set (action/admin/indices/stats) —
-        every section present so metric filtering can subset; untracked
-        counters report zero rather than omitting the section."""
-        shard_stats = {sid: s.stats() for sid, s in self.shards.items()}
-        index_total = sum(s["indexing"]["index_total"]
-                          for s in shard_stats.values())
-        delete_total = sum(s["indexing"]["delete_total"]
-                           for s in shard_stats.values())
-        mem_bytes = sum(s["segments"]["memory_in_bytes"]
-                        for s in shard_stats.values())
+    def search_stats(self, shard_stats: Optional[dict] = None) -> dict:
+        """The ``search`` stats block alone (SearchStats + the TPU-plane
+        extensions) — reused verbatim by ``stats()`` and aggregated
+        across indices into the ``_nodes/stats`` search section
+        (docs/OBSERVABILITY.md)."""
+        if shard_stats is None:
+            shard_stats = {sid: s.stats() for sid, s in self.shards.items()}
         groups: Dict[str, dict] = {}
         for s in shard_stats.values():
             for g, gs in (s["search"].get("groups") or {}).items():
                 agg = groups.setdefault(g, {k: 0 for k in gs})
                 for k, v in gs.items():
                     agg[k] += v
-        fielddata_bytes = sum(
-            sum(seg.breaker_charges.values())
-            for sh in self.shards.values()
-            for seg in sh.engine.searchable_segments())
         search = {
             "open_contexts": 0,
             "query_total": sum(s["search"]["query_total"]
@@ -1178,7 +1340,7 @@ class IndexService:
                 **(self._mesh_search.plane_health.stats()
                    if self._mesh_search is not None else
                    {"plane_failures_total": {"mesh_pallas": 0, "mesh": 0},
-                    "plane_quarantined": []}),
+                    "plane_quarantined": [], "quarantine_events": []}),
                 # block-max pruned scoring + postings codec observability
                 # (docs/PRUNING.md): queries served pruned, the tile
                 # economy, and what representation the postings stream as
@@ -1218,9 +1380,31 @@ class IndexService:
             # batch-size distribution, and how often a leader paid the
             # collection window
             "batch": self.batch_stats.as_dict(),
+            # phase-attributed telemetry (ISSUE 8, docs/OBSERVABILITY.md):
+            # per-plane × per-phase log2 latency histograms, byte/tile
+            # counters, and plane-ladder decision counters with reasons
+            "phases": self.telemetry.phases_dict(),
         }
         if groups:
             search["groups"] = groups
+        return search
+
+    def stats(self) -> dict:
+        """Full CommonStats section set (action/admin/indices/stats) —
+        every section present so metric filtering can subset; untracked
+        counters report zero rather than omitting the section."""
+        shard_stats = {sid: s.stats() for sid, s in self.shards.items()}
+        index_total = sum(s["indexing"]["index_total"]
+                          for s in shard_stats.values())
+        delete_total = sum(s["indexing"]["delete_total"]
+                           for s in shard_stats.values())
+        mem_bytes = sum(s["segments"]["memory_in_bytes"]
+                        for s in shard_stats.values())
+        fielddata_bytes = sum(
+            sum(seg.breaker_charges.values())
+            for sh in self.shards.values()
+            for seg in sh.engine.searchable_segments())
+        search = self.search_stats(shard_stats)
         totals = {
             "docs": {"count": self.num_docs, "deleted": 0},
             "store": {"size_in_bytes": mem_bytes,

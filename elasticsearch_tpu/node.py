@@ -236,7 +236,8 @@ class Node:
         # consumers only reach batchers alive at update time; the pruning
         # knobs are re-read per query from the index's Settings map)
         state = self.cluster_service.state
-        for prefix in ("search.batch.", "search.pallas.", "search.knn."):
+        for prefix in ("search.batch.", "search.pallas.", "search.knn.",
+                       "search.telemetry."):
             cluster_dynamic = state.persistent_settings.merged_with(
                 state.transient_settings).filtered_by_prefix(prefix)
             merged_settings = self.settings.filtered_by_prefix(
@@ -1487,9 +1488,13 @@ class Node:
         }
 
     def node_stats(self) -> dict:
-        indices_stats = {}
-        for name, svc in self.indices.items():
-            indices_stats[name] = svc.stats()["total"]
+        # node-level search section (ISSUE 8, docs/OBSERVABILITY.md):
+        # per-index search blocks — phase histograms, plane/ladder
+        # counters, quarantine events, batching — merged into one view
+        from elasticsearch_tpu.search.telemetry import merge_phase_stats
+
+        search = merge_phase_stats(
+            [svc.search_stats() for svc in self.indices.values()])
         return {
             "cluster_name": self.cluster_service.state.cluster_name,
             "nodes": {
@@ -1497,6 +1502,7 @@ class Node:
                     "name": self.node_name,
                     "indices": {
                         "docs": {"count": sum(s.num_docs for s in self.indices.values())},
+                        "search": search,
                     },
                     "jvm": {"uptime_in_millis": int((time.time() - self.start_time) * 1000)},
                     # monitor probes (OsProbe/ProcessProbe/FsProbe analogs)
@@ -1594,6 +1600,7 @@ class Node:
             SEARCH_KNN_TILE_SUB,
             SEARCH_PALLAS_PRUNING_ENABLED,
             SEARCH_PALLAS_PRUNING_PROBE_TILES,
+            SEARCH_TELEMETRY_ENABLED,
         )
 
         committed = state.persistent_settings.merged_with(
@@ -1607,7 +1614,10 @@ class Node:
                 # cluster-level value wins while set, and clearing it
                 # hands control back to the index's own Settings
                 (SEARCH_KNN_ENABLED, "knn_enabled_override"),
-                (SEARCH_KNN_TILE_SUB, "knn_tile_sub_override")):
+                (SEARCH_KNN_TILE_SUB, "knn_tile_sub_override"),
+                # telemetry kill switch follows the same explicitness
+                # contract (docs/OBSERVABILITY.md)
+                (SEARCH_TELEMETRY_ENABLED, "telemetry_enabled_override")):
             explicit = committed.get(setting.key) is not None
             value = setting.get(committed) if explicit else None
             for svc in self.indices.values():
@@ -1789,17 +1799,83 @@ class Node:
         tgt.refresh()
         return {"acknowledged": True, "shards_acknowledged": True, "index": target}
 
+    HOT_THREADS_INTERVAL_S = 0.05
+
+    @staticmethod
+    def _thread_cpu_seconds() -> dict:
+        """Per-thread CPU time (user+system seconds) via the kernel's
+        per-task accounting: python thread -> its native tid ->
+        /proc/self/task/<tid>/stat fields 14/15. Returns {} on platforms
+        without procfs (the dump then reports stacks without CPU%)."""
+        import os
+        import threading
+
+        out = {}
+        try:
+            tick = os.sysconf("SC_CLK_TCK")
+        except (ValueError, OSError, AttributeError):
+            return out
+        for th in threading.enumerate():
+            tid = getattr(th, "native_id", None)
+            if tid is None:
+                continue
+            try:
+                with open(f"/proc/self/task/{tid}/stat", "rb") as f:
+                    # comm can contain spaces/parens: split AFTER the
+                    # closing paren; utime/stime are then fields 11/12
+                    parts = f.read().rpartition(b")")[2].split()
+                out[th.ident] = (int(parts[11]) + int(parts[12])) / tick
+            except (OSError, IndexError, ValueError):
+                continue
+        return out
+
     def hot_threads(self) -> str:
-        """_nodes/hot_threads (monitor/jvm/HotThreads): stack dump of live
-        threads."""
+        """_nodes/hot_threads (monitor/jvm/HotThreads): REAL per-thread
+        CPU sampling + stacks, busiest first. Two CPU-time snapshots
+        bracket a short sleep; each live thread reports its measured CPU%
+        over the interval, its name, and its current stack — so a waiter
+        stuck on _MESH_EXEC_LOCK (or any other contended lock) is
+        directly visible with 0% CPU and the acquire frame on top."""
         import sys
+        import threading
         import traceback
 
-        out = [f"::: {{{self.node_name}}}{{{self.node_id}}}"]
-        for tid, frame in sys._current_frames().items():
-            out.append(f"\n   thread id [{tid}]:")
-            out.extend("     " + line for line in
-                       traceback.format_stack(frame, limit=8))
+        interval = self.HOT_THREADS_INTERVAL_S
+        cpu0 = self._thread_cpu_seconds()
+        time.sleep(interval)
+        cpu1 = self._thread_cpu_seconds()
+        frames = sys._current_frames()
+        rows = []
+        known = set()
+        for th in threading.enumerate():
+            cpu = max(cpu1.get(th.ident, 0.0) - cpu0.get(th.ident, 0.0),
+                      0.0)
+            rows.append((cpu, th.ident, th.name, th.daemon))
+            known.add(th.ident)
+        # sys._current_frames() also sees threads never registered with
+        # the threading module (C-extension/backend callback threads
+        # running Python code): report them too, CPU unattributed
+        for ident in frames.keys() - known:
+            rows.append((0.0, ident, "<non-threading>", False))
+        rows.sort(key=lambda r: (-r[0], r[2]))
+        out = [
+            f"::: {{{self.node_name}}}{{{self.node_id}}}",
+            f"   Hot threads sampled over {interval * 1000:.0f}ms, "
+            f"{len(rows)} live threads, busiest first:",
+        ]
+        for cpu, ident, name, daemon in rows:
+            pct = cpu / interval * 100.0 if interval else 0.0
+            flags = " (daemon)" if daemon else ""
+            out.append(
+                f"\n   {pct:6.1f}% ({cpu * 1000:.1f}ms out of "
+                f"{interval * 1000:.0f}ms) cpu usage by thread id "
+                f"[{ident}] '{name}'{flags}:")
+            frame = frames.get(ident)
+            if frame is None:
+                out.append("     <no stack available>")
+                continue
+            out.extend("     " + line.rstrip("\n") for line in
+                       traceback.format_stack(frame, limit=12))
         return "\n".join(out)
 
     def put_stored_script(self, script_id: str, body: dict) -> dict:

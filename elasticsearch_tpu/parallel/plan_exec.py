@@ -71,15 +71,27 @@ class PlaneHealth:
     _stats planes (`plane_failures_total`, `plane_quarantined`)."""
 
     PLANES = ("mesh_pallas", "mesh")
+    MAX_EVENTS = 32
 
     def __init__(self, cooldown_s: float = 60.0):
         self.cooldown_s = float(cooldown_s)
         self.failures_total: Dict[str, int] = {p: 0 for p in self.PLANES}
         self._quarantined_until: Dict[str, float] = {}
+        # quarantine event log (docs/OBSERVABILITY.md): wall-clock
+        # timestamps so operators can join a latency regression to the
+        # fault that demoted the plane; capped, oldest dropped
+        self.events: List[dict] = []
 
     def record_failure(self, plane: str) -> None:
         self.failures_total[plane] = self.failures_total.get(plane, 0) + 1
         self._quarantined_until[plane] = _time.monotonic() + self.cooldown_s
+        self.events.append({
+            "plane": plane,
+            "timestamp_ms": int(_time.time() * 1000),
+            "cooldown_s": self.cooldown_s,
+        })
+        if len(self.events) > self.MAX_EVENTS:
+            del self.events[0]
 
     def available(self, plane: str) -> bool:
         return _time.monotonic() >= self._quarantined_until.get(plane, 0.0)
@@ -93,6 +105,7 @@ class PlaneHealth:
         return {
             "plane_failures_total": dict(self.failures_total),
             "plane_quarantined": self.quarantined(),
+            "quarantine_events": list(self.events),
         }
 
 
@@ -717,7 +730,10 @@ class IndexMeshSearch:
     # host-identical reported-total cap. suggest and highlight are
     # host-side phases orthogonal to the query program (fetch/suggest
     # phases), served on the mesh path by the same code as the host path.
-    UNSUPPORTED = ("collapse", "profile")
+    # "profile" is NOT here (ISSUE 8): a profiled query runs on whatever
+    # plane would serve it unprofiled and reports THAT plane's phase
+    # spans — plane-truthful, never plane-demoting (docs/OBSERVABILITY.md).
+    UNSUPPORTED = ("collapse",)
 
     def __init__(self, index_service, mesh: Optional[Mesh] = None):
         self.svc = index_service
@@ -760,6 +776,18 @@ class IndexMeshSearch:
                 "index.search.plane_quarantine.cooldown", 60.0)
         # plane-health quarantine (index.search.plane_quarantine.cooldown)
         self.plane_health = PlaneHealth(quarantine_cooldown)
+        # counter updates must be atomic: concurrent batch leaders /
+        # serial queries increment from different threads (ISSUE 8
+        # stats-consistency contract — docs/OBSERVABILITY.md)
+        self._counter_lock = threading.Lock()
+
+    def _note(self, plane: str, reason: str, n: int = 1) -> None:
+        """Plane-ladder decision counter (search.phases.decisions).
+        ``n``: member count — batch-path decisions count per QUERY so
+        they stay comparable with the serial ladder's counts."""
+        tel = getattr(self.svc, "telemetry", None)
+        if tel is not None:
+            tel.note_decision(plane, reason, n)
 
     def _mesh_or_default(self) -> Mesh:
         if self._mesh is None:
@@ -861,17 +889,18 @@ class IndexMeshSearch:
         return bool(enabled), int(sub)
 
     def query_knn(self, spec: dict, k: int, deadline=None,
-                  stats=None) -> Optional[dict]:
+                  stats=None, tracer=None) -> Optional[dict]:
         """One kNN query on the mesh MXU plane (the Q == 1 form of
         query_knn_batch). Returns {total, refs, max_score, plane} or
         None when ineligible (callers run the host plan-node rung)."""
         out = self.query_knn_batch([spec], [max(k, 1)], deadline=deadline,
-                                   stats=[stats])
+                                   stats=[stats], tracers=[tracer])
         return out[0] if out is not None else None
 
     def query_knn_batch(self, specs: List[dict], ks: List[int],
                         deadline=None,
-                        stats: Optional[list] = None) -> Optional[list]:
+                        stats: Optional[list] = None,
+                        tracers: Optional[list] = None) -> Optional[list]:
         """Cross-query micro-batching on the kNN MXU plane: Q concurrent
         vector queries against ONE dense_vector field scored by ONE
         batched ``knn_score_tiles`` launch inside one shard_map program —
@@ -890,15 +919,26 @@ class IndexMeshSearch:
         from elasticsearch_tpu.search.service import DocRef
         from elasticsearch_tpu.testing.disruption import on_plane_execute
 
+        from elasticsearch_tpu.search.telemetry import (
+            NULL_TRACER,
+            QueryTracer,
+        )
+
         if self.plane_pref not in ("auto", "pallas"):
             return None
         if not self.plane_health.available("mesh_pallas"):
+            self._note("mesh_pallas", "quarantined", len(specs))
             return None
         if len(self.svc.shards) < 2:
             return None
         enabled, sub_pref = self._knn_config()
         if not enabled:
+            self._note("host", "knn_disabled", len(specs))
             return None
+        # shared batch tracer: the launch's phase spans are folded into
+        # every member tracer at the end (each member waited on them)
+        bt = (QueryTracer() if any(getattr(t, "enabled", False)
+                                   for t in (tracers or [])) else NULL_TRACER)
         # field uniformity + request validation OUTSIDE the fault-
         # recording try: a malformed spec (unknown field, wrong dims) is
         # a REQUEST error the serial path owns with its own 4xx, never a
@@ -926,10 +966,13 @@ class IndexMeshSearch:
             return None
         if deadline is not None:
             deadline.checkpoint()
+        t_stage = bt.start("staging")
         if not self._ensure_staged():
+            self._note("host", "knn_staging_unavailable", len(specs))
             return None
         session = self._executor.ensure_knn(field, ft.dims, ft.similarity)
         if session is None:
+            self._note("host", "knn_staging_unavailable", len(specs))
             return None
         q_batch = len(specs)
         q_pad = next_pow2(q_batch)
@@ -943,6 +986,7 @@ class IndexMeshSearch:
             qmat[q] = pkn.normalize_query(
                 np.asarray(spec["query_vector"], np.float32),
                 ft.similarity, d_pad)
+        bt.stop("staging", t_stage)
         from elasticsearch_tpu.common.errors import TaskCancelledException
         from elasticsearch_tpu.search.cancellation import (
             TimeExceededException,
@@ -960,12 +1004,15 @@ class IndexMeshSearch:
                 # a first call compiles the program (seconds): honor the
                 # deadline before committing to the launch
                 deadline.checkpoint()
+            t_kernel = bt.start("kernel")
             with _MESH_EXEC_LOCK:
                 outs = run(*args)
                 # async dispatch: completion inside the lock
                 jax.block_until_ready(outs)
+            bt.stop("kernel", t_kernel)
             keys, docs, slots, totals = (np.asarray(o) for o in outs)
         except (PlanStructureMismatch, NotImplementedError):
+            self._note("mesh_pallas", "shape_mismatch", q_batch)
             return None  # shape ineligibility: next rung, no penalty
         except (TaskCancelledException, TimeExceededException):
             raise  # PR-4 contract: the caller owns partial/cancel
@@ -975,19 +1022,26 @@ class IndexMeshSearch:
                 "quarantined for %.1fs", self.svc.name,
                 self.plane_health.cooldown_s, exc_info=True)
             self.plane_health.record_failure("mesh_pallas")
+            self._note("mesh_pallas", "fault", q_batch)
             return None
-        self.query_total += q_batch
-        self.pallas_query_total += q_batch
-        self.knn_query_total += q_batch
-        if q_batch > 1:
-            self.batched_launch_total += 1
-            self.batched_query_total += q_batch
+        with self._counter_lock:
+            self.query_total += q_batch
+            self.pallas_query_total += q_batch
+            self.knn_query_total += q_batch
+            if q_batch > 1:
+                self.batched_launch_total += 1
+                self.batched_query_total += q_batch
+        self._note("mesh_pallas",
+                   "knn_served_batched" if q_batch > 1 else "knn_served",
+                   q_batch)
+        # the whole batch streams each slot's bf16 embedding matrix once
+        launch_adds = {"embedding_bytes_streamed":
+                       self._executor.n_slots * nd_knn * d_pad * 2}
+        t_merge = bt.start("merge")
         results = []
         for q in range(q_batch):
             for sid in self.svc.shards:
-                searcher = self.svc.shards[sid].searcher
-                searcher.query_total += 1
-                searcher.record_query_groups(
+                self.svc.shards[sid].searcher.note_query(
                     stats[q] if stats is not None else None)
             refs = []
             max_score = None
@@ -1003,6 +1057,17 @@ class IndexMeshSearch:
             results.append({"total": int(totals[q]), "refs": refs,
                             "max_score": max_score,
                             "plane": "mesh_pallas"})
+        bt.stop("merge", t_merge)
+        tel = getattr(self.svc, "telemetry", None)
+        if tel is not None:
+            tel.add_counters(launch_adds)
+        for q, tr in enumerate(tracers or []):
+            if tr is not None and getattr(tr, "enabled", False):
+                tr.merge_from(bt)
+                tr.annotate("batch_size", q_batch)
+                tr.annotate("batch_member_index", q)
+                for key, v in launch_adds.items():
+                    tr.annotate(key, int(v))
         return results
 
     def _sort_plan(self, body: dict):
@@ -1095,13 +1160,16 @@ class IndexMeshSearch:
         oriented = anchor if order == "desc" else -anchor
         return float(np.clip(oriented, -big, big))
 
-    def query(self, body: dict, k: int, deadline=None):
+    def query(self, body: dict, k: int, deadline=None, tracer=None):
         """Returns {total, refs, max_score, aggregations,
         terminated_early} or None if ineligible.
         deadline: SearchDeadline — checkpointed between staging steps and
         plane attempts (timeout raises TimeExceededException for the
         caller's partial-result path; cancellation raises
-        TaskCancelledException)."""
+        TaskCancelledException).
+        tracer: QueryTracer — phase spans (parse_rewrite / plan_build /
+        staging / kernel / merge) recorded against whichever plane ends
+        up serving (docs/OBSERVABILITY.md)."""
         from elasticsearch_tpu.search.aggregations import (
             SegmentView,
             parse_aggs,
@@ -1118,17 +1186,25 @@ class IndexMeshSearch:
             _normalize_rescore,
         )
 
+        from elasticsearch_tpu.search.telemetry import NULL_TRACER
+
+        if tracer is None:
+            tracer = NULL_TRACER
         body = body or {}
         if any(body.get(key) is not None for key in self.UNSUPPORTED):
+            self._note("host", "unsupported_body")
             return None
         if len(self.svc.shards) < 2:
+            self._note("host", "single_shard")
             return None  # single shard: host path is already one program
         if any(getattr(self.svc.shards[s].engine, "index_sort", None)
                for s in self.svc.shards):
+            self._note("host", "index_sorted")
             return None  # index-sorted early termination beats top-k
         if deadline is not None:
             deadline.checkpoint()
         if not self._ensure_staged():
+            self._note("host", "staging_unavailable")
             return None
         if deadline is not None:
             deadline.checkpoint()  # staging can compile/transfer
@@ -1152,16 +1228,19 @@ class IndexMeshSearch:
             # cannot beat the running top-k threshold. Anything needing
             # every tile's dense output (aggs, sort, counts, rescore)
             # fails the key filter above and executes exhaustively.
-            out = self.query_batch([body], deadline=deadline)
+            out = self.query_batch([body], deadline=deadline,
+                                   tracers=[tracer])
             if out is not None:
                 r = out[0]
                 return {"total": r["total"], "refs": r["refs"],
                         "max_score": r["max_score"], "aggregations": None,
                         "terminated_early": None, "plane": r["plane"],
                         "pruned": r.get("pruned")}
+        t_parse = tracer.start("parse_rewrite")
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         sort_keys, sort_spec = self._sort_plan(body)
         if sort_keys == "fallback":
+            self._note("host", "sort_ineligible")
             return None
 
         features = set()
@@ -1170,6 +1249,7 @@ class IndexMeshSearch:
         if min_score is not None:
             ms = float(min_score)
             if float(np.float32(ms)) != ms:
+                self._note("host", "feature_ineligible")
                 return None  # f32 compare could move the cut boundary
             features.add("min_score")
             scalars["min_score"] = ms
@@ -1192,6 +1272,7 @@ class IndexMeshSearch:
             after_key = self._search_after_key(search_after, sort_spec,
                                                sort_keys)
             if after_key is None:
+                self._note("host", "feature_ineligible")
                 return None
             features.add("search_after")
             scalars["search_after"] = after_key
@@ -1201,6 +1282,7 @@ class IndexMeshSearch:
         rescore_specs = _normalize_rescore(body.get("rescore"))
         if rescore_specs and sort_spec is None:
             if len(rescore_specs) != 1:
+                self._note("host", "feature_ineligible")
                 return None  # chained rescorers: host path
             spec = rescore_specs[0]
             rescore_static = (spec["window_size"], spec["score_mode"])
@@ -1212,6 +1294,7 @@ class IndexMeshSearch:
         qb = parse_query(body.get("query"))
         pf_qb = (parse_query(body["post_filter"])
                  if body.get("post_filter") else None)
+        tracer.stop("parse_rewrite", t_parse)
         # plane ladder: try the tile-kernel plane first (one fast plane
         # for distributed queries — the reference runs the same BulkScorer
         # hot loop on every shard), falling back to the scatter mesh when
@@ -1226,9 +1309,11 @@ class IndexMeshSearch:
         from elasticsearch_tpu.testing.disruption import on_plane_execute
 
         kernel_session = None
-        if (self.plane_pref in ("auto", "pallas")
-                and self.plane_health.available("mesh_pallas")):
-            kernel_session = self._executor.ensure_kernel()
+        if self.plane_pref in ("auto", "pallas"):
+            if self.plane_health.available("mesh_pallas"):
+                kernel_session = self._executor.ensure_kernel()
+            else:
+                self._note("mesh_pallas", "quarantined")
         attempts = []
         if kernel_session is not None:
             attempts.append(("mesh_pallas", kernel_session))
@@ -1245,6 +1330,7 @@ class IndexMeshSearch:
                 deadline.checkpoint()
             try:
                 on_plane_execute(self.svc.name, plane)
+                t_plan = tracer.start("plan_build")
                 plans = []
                 pf_plans = [] if pf_qb is not None else None
                 rs_plans = [] if rs_qb is not None else None
@@ -1271,14 +1357,16 @@ class IndexMeshSearch:
                 if session is not None:
                     used_pallas = self._executor.harmonize_kernel_nodes(
                         plans) > 0
+                tracer.stop("plan_build", t_plan)
                 outs = self._executor.execute(
                     plans, k, sort_keys=sort_keys,
                     with_views=bool(agg_specs), pf_plans=pf_plans,
                     rs_plans=rs_plans, scalars=scalars,
                     features=frozenset(features), slice_col=slice_col,
-                    rescore_static=rescore_static)
+                    rescore_static=rescore_static, tracer=tracer)
                 break
             except (PlanStructureMismatch, NotImplementedError):
+                self._note(plane, "shape_mismatch")
                 continue  # shape ineligibility: next plane (no penalty)
             except (TaskCancelledException, TimeExceededException):
                 raise
@@ -1291,9 +1379,12 @@ class IndexMeshSearch:
                     "%.1fs", self.svc.name, plane,
                     self.plane_health.cooldown_s, exc_info=True)
                 self.plane_health.record_failure(plane)
+                self._note(plane, "fault")
                 continue
         if outs is None:
+            self._note("host", "no_mesh_plane")
             return None
+        t_merge = tracer.start("merge")
         keys, slots, docs, total, scores, raws, seg_counts = outs[:7]
         keys = np.asarray(keys)
         scores = np.asarray(scores)
@@ -1312,15 +1403,15 @@ class IndexMeshSearch:
                 by_shard[sid] = by_shard.get(sid, 0) + int(counts[i])
             total = sum(min(c, ta) for c in by_shard.values())
             terminated_early = any(c >= ta for c in by_shard.values())
-        self.query_total += 1
-        if used_pallas:
-            self.pallas_query_total += 1
+        with self._counter_lock:
+            self.query_total += 1
+            if used_pallas:
+                self.pallas_query_total += 1
+        self._note("mesh_pallas" if used_pallas else "mesh", "served")
         # per-shard search stats stay attributed even though the mesh
         # executes all shards as one program (SearchStats semantics)
         for sid in self.svc.shards:
-            searcher = self.svc.shards[sid].searcher
-            searcher.query_total += 1
-            searcher.record_query_groups(body.get("stats"))
+            self.svc.shards[sid].searcher.note_query(body.get("stats"))
         vocab = None
         if sort_keys is not None:
             vocab = (self._executor.sort_meta.get(sort_keys[0])
@@ -1366,6 +1457,7 @@ class IndexMeshSearch:
                     seg, matched_np[i, :nd1], ctxs[sid],
                     scores_np[i, :nd1]))
             aggregations = run_aggregations(agg_specs, views)
+        tracer.stop("merge", t_merge)
         return {"total": total, "refs": refs, "max_score": max_score,
                 "aggregations": aggregations,
                 "terminated_early": terminated_early,
@@ -1377,13 +1469,16 @@ class IndexMeshSearch:
     # relevance-ranked queries (the high-QPS traffic shape the batching
     # exists for). Anything richer falls to the host-batched rung, whose
     # per-query pipeline covers the full request surface.
+    # ("profile" rides along: a profiled member executes identically —
+    # byte-identical hits — and additionally reports its phase spans)
     BATCHABLE_KEYS = frozenset({
         "query", "size", "from", "timeout",
-        "allow_partial_search_results", "stats",
+        "allow_partial_search_results", "stats", "profile",
     })
 
     def query_batch(self, bodies: List[dict],
-                    deadline=None) -> Optional[list]:
+                    deadline=None,
+                    tracers: Optional[list] = None) -> Optional[list]:
         """Cross-query micro-batching on the mesh_pallas rung: Q
         concurrent queries scored by ONE batched kernel launch inside
         one shard_map program (per-tile DMA windows fetched once for the
@@ -1407,11 +1502,16 @@ class IndexMeshSearch:
             parse_query,
         )
         from elasticsearch_tpu.search.service import DocRef
+        from elasticsearch_tpu.search.telemetry import (
+            NULL_TRACER,
+            QueryTracer,
+        )
         from elasticsearch_tpu.testing.disruption import on_plane_execute
 
         if self.plane_pref not in ("auto", "pallas"):
             return None
         if not self.plane_health.available("mesh_pallas"):
+            self._note("mesh_pallas", "quarantined", len(bodies))
             return None
         if len(self.svc.shards) < 2:
             return None
@@ -1424,10 +1524,18 @@ class IndexMeshSearch:
         if any(getattr(self.svc.shards[s].engine, "index_sort", None)
                for s in self.svc.shards):
             return None
+        # shared batch tracer: one set of launch-phase spans, folded into
+        # every member's tracer below (they all waited on the launch)
+        bt = (QueryTracer() if any(getattr(t, "enabled", False)
+                                   for t in (tracers or [])) else NULL_TRACER)
+        t_stage0 = bt.start("staging")
         if not self._ensure_staged():
+            self._note("host", "staging_unavailable", len(bodies))
             return None
         session = self._executor.ensure_kernel()
+        bt.stop("staging", t_stage0)
         if session is None:
+            self._note("host", "staging_unavailable", len(bodies))
             return None
         q_batch = len(bodies)
         ks = []
@@ -1453,6 +1561,7 @@ class IndexMeshSearch:
         # (parse/mapping error) is a REQUEST error the serial path owns
         # with its own 4xx, never a plane fault to quarantine on — same
         # split as the serial ladder, which parses before its attempts.
+        t_plan = bt.start("plan_build")
         try:
             lane_sets = [[None] * q_batch for _ in range(n_pairs)]
             for q, body in enumerate(bodies):
@@ -1474,6 +1583,7 @@ class IndexMeshSearch:
         except Exception:  # noqa: BLE001 — request-shaped error: serial
             # execution surfaces it per member with the right status
             return None
+        bt.stop("plan_build", t_plan)
         pruning, probe = self._pruning_config()
         if pruning and any(
                 int((b or {}).get("size", 10)
@@ -1494,6 +1604,7 @@ class IndexMeshSearch:
             deadline.checkpoint()
         try:
             on_plane_execute(self.svc.name, "mesh_pallas")
+            t_stage = bt.start("staging")
             # shared batched tables: per-slot unions on ONE collective
             # geometry (a dense union on ANY slot shrinks everyone's
             # tile); build_tile_tables_batched owns the union/pad
@@ -1611,18 +1722,33 @@ class IndexMeshSearch:
                     jax.device_put(w_all, sharding),
                     jax.device_put(slot_real, sharding),
                     jnp.int32(q_batch))
+                bt.stop("staging", t_stage)
                 if deadline is not None:
                     # a first call compiles the pruned program (seconds):
                     # honor the deadline before committing to the launch
                     deadline.checkpoint()
+                t_kernel = bt.start("kernel")
                 with _MESH_EXEC_LOCK:
                     outs = run(*args)
                     jax.block_until_ready(outs)
+                bt.stop("kernel", t_kernel)
                 keys, docs, slots, totals, scored, tiles_total = (
                     np.asarray(o) for o in outs)
                 pruned_stats = {
                     "tiles_scored": int(scored),
                     "tiles_pruned": int(tiles_total) - int(scored),
+                }
+                # DMA economy of this launch: every scored tile streams
+                # t_pad cb-block posting windows; pruned tiles skip them
+                wb = 4 if codec == "packed" else 8
+                tile_bytes = t_pad * cb * psc.LANE * wb
+                launch_adds = {
+                    "postings_bytes_streamed":
+                        pruned_stats["tiles_scored"] * tile_bytes,
+                    "postings_bytes_skipped":
+                        pruned_stats["tiles_pruned"] * tile_bytes,
+                    "tiles_scored": pruned_stats["tiles_scored"],
+                    "tiles_pruned": pruned_stats["tiles_pruned"],
                 }
             else:
                 run = _mesh_batched_kernel_program(
@@ -1633,14 +1759,23 @@ class IndexMeshSearch:
                                  jax.device_put(rl, sharding),
                                  jax.device_put(rh, sharding),
                                  jax.device_put(w_all, sharding))
+                bt.stop("staging", t_stage)
                 if deadline is not None:
                     deadline.checkpoint()
+                t_kernel = bt.start("kernel")
                 with _MESH_EXEC_LOCK:
                     outs = run(*args)
                     # async dispatch: completion inside the lock (above)
                     jax.block_until_ready(outs)
+                bt.stop("kernel", t_kernel)
                 keys, docs, slots, totals = (np.asarray(o) for o in outs)
+                wb = 4 if codec == "packed" else 8
+                launch_adds = {
+                    "postings_bytes_streamed":
+                        n_tiles * n_pairs * t_pad * cb * psc.LANE * wb,
+                }
         except (PlanStructureMismatch, NotImplementedError):
+            self._note("mesh_pallas", "shape_mismatch", q_batch)
             return None  # shape ineligibility: next rung, no penalty
         except (TaskCancelledException, TimeExceededException):
             # deadline/cancel tripped a checkpoint (single-query fast
@@ -1656,27 +1791,33 @@ class IndexMeshSearch:
                 "quarantined for %.1fs", self.svc.name,
                 self.plane_health.cooldown_s, exc_info=True)
             self.plane_health.record_failure("mesh_pallas")
+            self._note("mesh_pallas", "fault", q_batch)
             return None
-        self.query_total += q_batch
-        self.pallas_query_total += q_batch
-        if q_batch > 1:
-            # the Q==1 pruned fast path is not cross-query batching: it
-            # must not inflate the batching-adoption telemetry
-            # (docs/BATCHING.md counts launch-SHARING members only)
-            self.batched_launch_total += 1
-            self.batched_query_total += q_batch
-        if pruned_stats is not None:
-            self.pruned_query_total += q_batch
-            self.tiles_scored_total += pruned_stats["tiles_scored"]
-            self.tiles_pruned_total += pruned_stats["tiles_pruned"]
+        with self._counter_lock:
+            self.query_total += q_batch
+            self.pallas_query_total += q_batch
+            if q_batch > 1:
+                # the Q==1 pruned fast path is not cross-query batching:
+                # it must not inflate the batching-adoption telemetry
+                # (docs/BATCHING.md counts launch-SHARING members only)
+                self.batched_launch_total += 1
+                self.batched_query_total += q_batch
+            if pruned_stats is not None:
+                self.pruned_query_total += q_batch
+                self.tiles_scored_total += pruned_stats["tiles_scored"]
+                self.tiles_pruned_total += pruned_stats["tiles_pruned"]
+        self._note("mesh_pallas",
+                   "served_batched" if q_batch > 1 else
+                   ("served_pruned" if pruned_stats is not None
+                    else "served"), q_batch)
+        t_merge = bt.start("merge")
         results = []
         for q, body in enumerate(bodies):
             # per-shard search stats stay attributed per MEMBER (the
             # batch is an execution detail, not a stats unit)
             for sid in self.svc.shards:
-                searcher = self.svc.shards[sid].searcher
-                searcher.query_total += 1
-                searcher.record_query_groups((body or {}).get("stats"))
+                self.svc.shards[sid].searcher.note_query(
+                    (body or {}).get("stats"))
             refs = []
             max_score = None
             for key, slot, d in zip(keys[q][: ks[q]], slots[q][: ks[q]],
@@ -1699,6 +1840,20 @@ class IndexMeshSearch:
                 result["pruned"] = dict(pruned_stats,
                                         total_relation="gte")
             results.append(result)
+        bt.stop("merge", t_merge)
+        # launch-level byte/tile totals fold into the registry ONCE (a
+        # batch must not multiply them); members see them as profile
+        # annotations of the launch they shared
+        tel = getattr(self.svc, "telemetry", None)
+        if tel is not None:
+            tel.add_counters(launch_adds)
+        for q, tr in enumerate(tracers or []):
+            if tr is not None and getattr(tr, "enabled", False):
+                tr.merge_from(bt)
+                tr.annotate("batch_size", q_batch)
+                tr.annotate("batch_member_index", q)
+                for key, v in launch_adds.items():
+                    tr.annotate(key, int(v))
         return results
 
 
@@ -2158,7 +2313,8 @@ class MeshPlanExecutor:
                 scalars: Optional[dict] = None,
                 features: frozenset = frozenset(),
                 slice_col: Optional[str] = None,
-                rescore_static: Optional[Tuple[int, str]] = None):
+                rescore_static: Optional[Tuple[int, str]] = None,
+                tracer=None):
         """plans: one per shard, same query. Returns (top_keys [k],
         top_shard [k], top_doc [k], total, top_score [k], top_raw [k]
         [, matched [n_dev, nd1], scores [n_dev, nd1]]) — doc ids are in
@@ -2170,6 +2326,11 @@ class MeshPlanExecutor:
         weights (compiled once per feature SET, not per value)."""
         if len(plans) != len(self.segments):
             raise ValueError("one plan per staged shard required")
+        if tracer is None:
+            from elasticsearch_tpu.search.telemetry import NULL_TRACER
+
+            tracer = NULL_TRACER
+        t_stage = tracer.start("staging")
         local_pads = [s.nd_pad for s in self.segments]
         stacked = stack_plans(plans, local_pads, self.nd1, self.n_slots)
         key_parts = [plans[0].key(), _shapes_sig(stacked)]
@@ -2201,6 +2362,8 @@ class MeshPlanExecutor:
         staged_rs = [jax.device_put(a, self._sharding) for a in stacked_rs]
         jscalars = {name: jnp.float32(v)
                     for name, v in (scalars or {}).items()}
+        tracer.stop("staging", t_stage)
+        t_kernel = tracer.start("kernel")
         with _MESH_EXEC_LOCK:
             outs = run(self._seg_staged, staged_plan, staged_pf, staged_rs,
                        jscalars)
@@ -2208,4 +2371,5 @@ class MeshPlanExecutor:
             # returns, so completion must happen INSIDE the lock (the
             # caller fetches the results immediately anyway)
             jax.block_until_ready(outs)
+        tracer.stop("kernel", t_kernel)
         return outs

@@ -22,14 +22,31 @@ from elasticsearch_tpu.common.errors import (
 Handler = Callable[..., Tuple[int, Any]]
 
 
+def header_value(headers: Optional[Dict[str, str]], name: str,
+                 default=None):
+    """Case-insensitive lookup in a raw request-header dict (HTTP header
+    names are case-insensitive; clients send X-Opaque-Id in any case)."""
+    lowered = name.lower()
+    for k, v in (headers or {}).items():
+        if k.lower() == lowered:
+            return v
+    return default
+
+
 class RestRequest:
     def __init__(self, method: str, path: str, params: Dict[str, str],
-                 body: Optional[bytes], content_type: Optional[str] = None):
+                 body: Optional[bytes], content_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
         self.method = method
         self.path = path
         self.params = params  # query params + path params merged
         self.raw_body = body or b""
         self.content_type = content_type
+        self.headers = dict(headers or {})
+
+    def header(self, name: str, default=None):
+        """Case-insensitive request-header lookup."""
+        return header_value(self.headers, name, default)
 
     def json_body(self, default=None):
         """Parse the structured request body — despite the historical
@@ -143,12 +160,19 @@ class RestController:
 
     def dispatch(self, method: str, path: str, query: Dict[str, str],
                  body: Optional[bytes],
-                 content_type: Optional[str] = None) -> Tuple[int, Any]:
+                 content_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         from urllib.parse import unquote
 
         from elasticsearch_tpu.common.deprecation import begin_request
+        from elasticsearch_tpu.search.telemetry import set_opaque_id
 
         begin_request()  # per-request Warning-header collector
+        # X-Opaque-Id rides the request context (contextvars copied into
+        # the executor thread below): tasks, slowlog lines, and profile
+        # output read it back to join work to the client that sent it
+        hdrs = headers or {}
+        set_opaque_id(header_value(hdrs, "x-opaque-id"))
 
         path = unquote(path.split("?")[0])
         method_routes = [r for r in self.routes if r.method == method]
@@ -157,7 +181,8 @@ class RestController:
             if path_params is not None:
                 params = dict(query)
                 params.update(path_params)
-                req = RestRequest(method, path, params, body, content_type)
+                req = RestRequest(method, path, params, body, content_type,
+                                  headers=hdrs)
                 inflight = None
                 reserved = False
                 if body and hasattr(self.node, "breaker_service"):

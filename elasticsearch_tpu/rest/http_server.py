@@ -29,7 +29,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(length) if length else b""
         status, payload = self.controller.dispatch(
             method, parsed.path, query, body,
-            content_type=self.headers.get("Content-Type"))
+            content_type=self.headers.get("Content-Type"),
+            headers=dict(self.headers.items()))
         from elasticsearch_tpu.common.deprecation import (
             collect_warnings,
             warning_header_value,
@@ -50,6 +51,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        # echo the client's correlation id back (reference behavior:
+        # X-Opaque-Id is a passthrough header — docs/OBSERVABILITY.md)
+        opaque = self.headers.get("X-Opaque-Id")
+        if opaque:
+            self.send_header("X-Opaque-Id", opaque)
         for w in warnings:
             self.send_header("Warning", warning_header_value(w))
         self.end_headers()

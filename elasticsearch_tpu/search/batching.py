@@ -51,16 +51,19 @@ import numpy as np
 # request-body keys the host batched path understands: batching only
 # replaces the main query's scoring program with a cached per-query score
 # vector — everything else (sort, aggs, post_filter, rescore, fetch-phase
-# options) runs the normal per-query pipeline on top of it. profile is
-# excluded (its per-segment engine/timing breakdown must reflect a real
-# per-query execution), as are scroll/pit/collapse-expansion style keys
-# whose contexts are keyed to a single request.
+# options) runs the normal per-query pipeline on top of it. profile IS
+# batchable (ISSUE 8 plane-truthfulness): a profiled member must run on
+# whatever plane would serve it unprofiled and report THAT plane's phase
+# spans; on the host batched rung the member's per-segment score cache is
+# skipped (ShardSearcher.query) so its engine/timing breakdown still
+# reflects a real per-query execution. scroll/pit/collapse-expansion
+# style keys stay excluded — their contexts are keyed to one request.
 _BATCHABLE_KEYS = frozenset({
     "query", "size", "from", "sort", "aggs", "aggregations", "post_filter",
     "min_score", "timeout", "allow_partial_search_results", "stats",
     "terminate_after", "rescore", "search_after", "track_scores",
     "_source", "docvalue_fields", "stored_fields", "script_fields",
-    "highlight", "version",
+    "highlight", "version", "profile",
     # NB track_total_hits is deliberately NOT batchable: the mesh
     # batched rung rejects whole batches containing any unknown key, so
     # one flagged member would demote its 15 peers off the mesh_pallas
@@ -74,7 +77,7 @@ _BATCHABLE_KEYS = frozenset({
 # then rides its own plane's batching
 _KNN_BATCHABLE_KEYS = frozenset({
     "knn", "query", "size", "from", "timeout",
-    "allow_partial_search_results", "stats", "_source",
+    "allow_partial_search_results", "stats", "_source", "profile",
 })
 
 
@@ -182,13 +185,16 @@ def counts_safe_for_union(node) -> bool:
 
 
 class _Group:
-    __slots__ = ("items", "results", "done", "sealed")
+    __slots__ = ("items", "results", "done", "sealed", "opened_at")
 
     def __init__(self):
         self.items: List[Any] = []
         self.results: Optional[List[Any]] = None
         self.done = threading.Event()
         self.sealed = False
+        # window-wait telemetry anchor (docs/OBSERVABILITY.md): how long
+        # the leader held the group open collecting peers
+        self.opened_at = time.monotonic()
 
 
 class MicroBatcher:
@@ -217,6 +223,12 @@ class MicroBatcher:
         self._cv = threading.Condition()
         self._groups: Dict[Any, _Group] = {}
         self._inflight = 0
+        # optional telemetry hook, called once per member right before
+        # the leader dispatches: annotate(item, window_wait_s,
+        # batch_size, member_index) — IndexService points it at each
+        # member's QueryTracer (docs/OBSERVABILITY.md)
+        self.annotate: Optional[Callable[[Any, float, int, int],
+                                         None]] = None
 
     def run(self, key, item, single_fn: Callable[[Any], Any],
             batch_fn: Callable[[List[Any]], List[Any]]):
@@ -271,6 +283,13 @@ class MicroBatcher:
                     if self._groups.get(key) is group:
                         self._groups.pop(key)
                     items = list(group.items)
+                if self.annotate is not None:
+                    wait_s = time.monotonic() - group.opened_at
+                    for idx, it in enumerate(items):
+                        try:
+                            self.annotate(it, wait_s, len(items), idx)
+                        except Exception:  # noqa: BLE001 — telemetry
+                            pass  # must never fail the query
                 try:
                     if len(items) == 1:
                         # nobody joined: plain unbatched execution

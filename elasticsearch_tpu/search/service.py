@@ -79,6 +79,36 @@ import logging
 _slow_logger = logging.getLogger("elasticsearch_tpu.index.search.slowlog")
 
 
+def _request_opaque_id(tracer=None) -> Optional[str]:
+    """The request's X-Opaque-Id: the tracer annotation when threaded
+    (batch members carry it across the leader's thread hop), else the
+    REST layer's contextvar."""
+    if tracer is not None:
+        oid = getattr(tracer, "_annotations", {}).get("opaque_id")
+        if oid:
+            return str(oid)
+    from elasticsearch_tpu.search.telemetry import get_opaque_id
+
+    return get_opaque_id()
+
+
+def emit_search_slowlog(warn_s, info_s, took_s: float, scope: str,
+                        scope_id, plane: str, tracer, source) -> None:
+    """The ONE search-slowlog line format (docs/OBSERVABILITY.md):
+    shard-level host lines and index-level mesh-plane lines differ only
+    in their scope field. Thresholds: warn wins, None = disabled."""
+    warn = warn_s is not None and took_s >= warn_s
+    info = not warn and info_s is not None and took_s >= info_s
+    if not (warn or info):
+        return
+    log = _slow_logger.warning if warn else _slow_logger.info
+    log("took[%dms], %s[%s], plane[%s], id[%s], phases[%s], source[%s]",
+        int(took_s * 1000), scope, scope_id, plane,
+        _request_opaque_id(tracer) or "",
+        tracer.top_phases() if tracer is not None else "",
+        str(source)[:512])
+
+
 def _plan_uses_pallas(node) -> bool:
     """True when any node of the plan scores through the pallas tile
     kernel (vs the XLA scatter program) — the per-segment engine marker
@@ -105,11 +135,17 @@ class ShardSearcher:
                  slowlog_warn_s: Optional[float] = None,
                  slowlog_info_s: Optional[float] = None,
                  index_name: str = ""):
+        import threading
+
         self.shard_id = shard_id
         self.index_name = index_name
         self.engine = engine
         self.mapper_service = mapper_service
         self.ctx = ShardQueryContext(mapper_service, engine=engine)
+        # counter updates must not lose increments under concurrent
+        # searches (host threads + mesh/batch leaders all attribute
+        # per-shard stats here — docs/OBSERVABILITY.md)
+        self._stats_lock = threading.Lock()
         self.query_total = 0
         self.query_time = 0.0
         self.fetch_total = 0
@@ -135,29 +171,34 @@ class ShardSearcher:
     def record_query_groups(self, groups) -> None:
         """Count one query against each requested stats group (shared by
         the host path and the mesh path)."""
-        for g in groups or []:
-            gs = self.group_stats.setdefault(str(g), {
-                "query_total": 0, "query_time_in_millis": 0,
-                "fetch_total": 0, "fetch_time_in_millis": 0})
-            gs["query_total"] += 1
+        with self._stats_lock:
+            for g in groups or []:
+                gs = self.group_stats.setdefault(str(g), {
+                    "query_total": 0, "query_time_in_millis": 0,
+                    "fetch_total": 0, "fetch_time_in_millis": 0})
+                gs["query_total"] += 1
 
-    def _maybe_slowlog(self, took_s: float, source: dict) -> None:
-        if self.slowlog_warn_s is not None and took_s >= self.slowlog_warn_s:
-            _slow_logger.warning(
-                "took[%dms], shard[%s], source[%s]",
-                int(took_s * 1000), self.shard_id, str(source)[:512],
-            )
-        elif self.slowlog_info_s is not None and took_s >= self.slowlog_info_s:
-            _slow_logger.info(
-                "took[%dms], shard[%s], source[%s]",
-                int(took_s * 1000), self.shard_id, str(source)[:512],
-            )
+    def note_query(self, groups=None) -> None:
+        """Attribute one mesh/batch-served query to this shard's stats
+        (the mesh executes all shards as one program, but per-shard
+        SearchStats stay truthful); lost-increment-safe under the
+        concurrent batch leaders of ISSUE 5/8."""
+        with self._stats_lock:
+            self.query_total += 1
+        self.record_query_groups(groups)
+
+    def _maybe_slowlog(self, took_s: float, source: dict,
+                       tracer=None, plane: str = "host") -> None:
+        emit_search_slowlog(self.slowlog_warn_s, self.slowlog_info_s,
+                            took_s, "shard", self.shard_id, plane,
+                            tracer, source)
 
     # ------------------------------------------------------------------
 
     def query(self, source: dict, size_hint: Optional[int] = None,
               segments=None, deadline=None,
               score_cache: Optional[Dict[str, Tuple]] = None,
+              tracer=None,
               ) -> ShardQueryResult:
         """segments: optional explicit segment list (point-in-time views
         pinned by an open scroll context — search/internal/ScrollContext);
@@ -169,15 +210,23 @@ class ShardSearcher:
         bool)} precomputed by a cross-query batched kernel launch
         (search/batching.py) — a cached segment skips plan execution and
         feeds the identical per-query downstream pipeline (min_score,
-        selection, aggs, post_filter, rescore)."""
+        selection, aggs, post_filter, rescore).
+        tracer: QueryTracer — host-plane phase spans (parse_rewrite,
+        staging, plan_build, kernel, merge) accumulated per segment;
+        always-on and bounded (docs/OBSERVABILITY.md)."""
+        from elasticsearch_tpu.search.telemetry import NULL_TRACER
         from elasticsearch_tpu.testing.disruption import on_shard_search
 
+        if tracer is None:
+            tracer = NULL_TRACER
         t0 = time.monotonic()
-        self.query_total += 1
+        with self._stats_lock:
+            self.query_total += 1
         # query-path fault injection (SearchDelayScheme / SearchFailScheme)
         on_shard_search(self.index_name, self.shard_id)
         source = source or {}
         self.record_query_groups(source.get("stats"))
+        t_parse = tracer.start("parse_rewrite")
         from_ = int(source.get("from", 0) or 0)
         size = int(source.get("size", 10) if source.get("size") is not None else 10)
         k = size_hint if size_hint is not None else from_ + size
@@ -197,6 +246,7 @@ class ShardSearcher:
         k_select = k
         if rescore_specs:
             k_select = max(k, max(r["window_size"] for r in rescore_specs))
+        tracer.stop("parse_rewrite", t_parse)
 
         # sorted-index early termination (QueryPhase.java:107): when the
         # query sort is a prefix of the index sort, segment doc order IS
@@ -234,7 +284,9 @@ class ShardSearcher:
                     timed_out = True
                     break
             t_seg = time.monotonic()
+            t_stage = tracer.start("staging")
             dev = seg.device_arrays()
+            tracer.stop("staging", t_stage)
             cached = (score_cache.get(seg.name)
                       if score_cache and not profile else None)
             if cached is not None:
@@ -242,19 +294,25 @@ class ShardSearcher:
                 # members of this query's micro-batch (the batched analog
                 # of the pallas plane below)
                 scores, matched = cached
-                self.pallas_segments_total += 1
+                with self._stats_lock:
+                    self.pallas_segments_total += 1
                 t_build = t_exec = time.monotonic()
             else:
+                t_plan = tracer.start("plan_build")
                 node = qb.to_plan(self.ctx, seg)
+                tracer.stop("plan_build", t_plan)
                 used_pallas = _plan_uses_pallas(node)
-                if used_pallas:
-                    self.pallas_segments_total += 1
-                else:
-                    self.scatter_segments_total += 1
+                with self._stats_lock:
+                    if used_pallas:
+                        self.pallas_segments_total += 1
+                    else:
+                        self.scatter_segments_total += 1
                 t_build = time.monotonic()
+                t_kernel = tracer.start("kernel")
                 scores_d, matched_d = P.execute(dev, node)
                 scores = np.asarray(scores_d)
                 matched = np.asarray(matched_d)
+                tracer.stop("kernel", t_kernel)
                 t_exec = time.monotonic()
             live1 = np.concatenate([seg.live, np.zeros(1, bool)])
             matched = matched & live1
@@ -275,6 +333,7 @@ class ShardSearcher:
                 _, post_m = P.execute(dev, post_qb.to_plan(self.ctx, seg))
                 matched = matched & np.asarray(post_m)
             total += int(matched[: seg.num_docs].sum())
+            t_merge = tracer.start("merge")
             if collapse_field:
                 seg_refs = self._select_all(seg, scores, matched, sort_spec)
             else:
@@ -283,6 +342,7 @@ class ShardSearcher:
                                         index_sorted=index_sorted)
             if rescore_specs and sort_spec is None:
                 seg_refs = self._rescore(seg, dev, seg_refs, rescore_specs)
+            tracer.stop("merge", t_merge)
             refs.extend(seg_refs)
             if seg_refs and sort_spec is None:
                 m = max(r.score for r in seg_refs)
@@ -326,11 +386,13 @@ class ShardSearcher:
                     }],
                 })
 
+        t_merge = tracer.start("merge")
         if collapse_field:
             refs = merge_refs(refs, sort_spec, len(refs))
             refs = collapse_refs(refs, collapse_field, {self.shard_id: self})[:k]
         else:
             refs = merge_refs(refs, sort_spec, k_select if rescore_specs else k)
+        tracer.stop("merge", t_merge)
         if rescore_specs and sort_spec is None:
             refs.sort(key=lambda r: (-r.score, r.local_doc))
             refs = refs[:k]
@@ -355,8 +417,9 @@ class ShardSearcher:
         if profile:
             result.profile = profile_shards
         took = time.monotonic() - t0
-        self.query_time += took
-        self._maybe_slowlog(took, source)
+        with self._stats_lock:
+            self.query_time += took
+        self._maybe_slowlog(took, source, tracer=tracer, plane="host")
         return result
 
     def _rescore(self, seg, dev, seg_refs: List[DocRef],

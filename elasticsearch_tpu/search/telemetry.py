@@ -1,0 +1,281 @@
+"""Phase-attributed query telemetry (ISSUE 8, docs/OBSERVABILITY.md).
+
+The headline query is bandwidth-bound (~35 GB/s effective, ~21 MB/query),
+and every remaining tuning lever — packed-codec/pruning default flips,
+the ICI serving loop, kNN tile tuning — needs to know WHERE a query's
+sub-millisecond budget goes. The reference spends a whole subsystem on
+exactly this (SURVEY §2.4: profile API, slowlog, node stats); here the
+fast planes are compiled device programs, so the observable unit is the
+PHASE around each program, not Lucene's per-scorer counters.
+
+Three pieces:
+
+- ``QueryTracer``: a low-overhead span tracer threaded through one
+  query's execution. Monotonic clocks, a fixed phase taxonomy
+  (``PHASES``), per-phase ACCUMULATORS (bounded by the taxonomy size —
+  a thousand-segment shard still records at most one accumulator per
+  phase) plus a small preallocated detail ring capped at ``MAX_SPANS``
+  records. ``start``/``stop`` are two dict operations — no allocation
+  beyond the capped ring tuples, no per-posting work, safe to leave
+  always-on in the scoring hot path. ``NULL_TRACER`` is the disabled
+  singleton (``search.telemetry.enabled`` kill switch): every call is a
+  no-op so call sites stay unconditional.
+
+- ``SearchTelemetry``: the per-index registry the tracers drain into —
+  per-plane × per-phase log2-bucket latency histograms, byte counters
+  (postings/embedding bytes staged/streamed/skipped), plane-ladder
+  decision counters with reasons, exported as the ``search.phases``
+  block of ``_stats`` and aggregated into ``_nodes/stats``.
+
+- the ``X-Opaque-Id`` context: the REST layer stamps the request
+  header into a contextvar; the search task, slowlog lines, and profile
+  output read it back so a slow query joins to its client.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Fixed phase taxonomy (docs/OBSERVABILITY.md). Every span a tracer
+# records must use one of these names; the histograms are keyed by them.
+#
+#   parse_rewrite  query DSL parse + coordinator rewrites
+#   plan_build     per-shard plan / kernel lane-table construction
+#   staging        host->device transfer of plan arrays / union tables
+#   kernel         device program dispatch -> block_until_ready
+#                  (includes first-call compilation)
+#   merge          ICI/host top-k merge + DocRef assembly + aggs reduce
+#   batch_demux    micro-batch member demultiplex / response split
+#   fetch          fetch phase (_source, highlight, sort values)
+PHASES = ("parse_rewrite", "plan_build", "staging", "kernel", "merge",
+          "batch_demux", "fetch")
+
+_now_ns = time.monotonic_ns
+
+
+class QueryTracer:
+    """Span tracer for ONE query. Not thread-safe by design — a query's
+    phases execute on one thread (the batch leader records into a batch
+    tracer and ``merge_from`` folds it into each member's)."""
+
+    MAX_SPANS = 32
+    __slots__ = ("enabled", "_acc", "_counts", "_ring", "ring_dropped",
+                 "_annotations")
+
+    def __init__(self):
+        self.enabled = True
+        self._acc: Dict[str, int] = {}      # phase -> accumulated ns
+        self._counts: Dict[str, int] = {}   # phase -> span count
+        self._ring: List[tuple] = []        # capped detail records
+        self.ring_dropped = 0
+        self._annotations: Dict[str, object] = {}
+
+    # -- hot path ------------------------------------------------------
+
+    def start(self, phase: str) -> int:
+        return _now_ns()
+
+    def stop(self, phase: str, t0: int) -> None:
+        dur = _now_ns() - t0
+        self._acc[phase] = self._acc.get(phase, 0) + dur
+        self._counts[phase] = self._counts.get(phase, 0) + 1
+        if len(self._ring) < self.MAX_SPANS:
+            self._ring.append((phase, dur))
+        else:
+            self.ring_dropped += 1
+
+    # -- annotations ---------------------------------------------------
+
+    def annotate(self, key: str, value) -> None:
+        self._annotations[key] = value
+
+    def merge_from(self, other: "QueryTracer") -> None:
+        """Fold a shared (batch) tracer's accumulators into this one —
+        every member of a batched launch is attributed the launch's
+        phase durations (they all waited on it)."""
+        for phase, ns in other._acc.items():
+            self._acc[phase] = self._acc.get(phase, 0) + ns
+            self._counts[phase] = (self._counts.get(phase, 0)
+                                   + other._counts.get(phase, 1))
+        self._annotations.update(other._annotations)
+
+    # -- output --------------------------------------------------------
+
+    def spans(self) -> List[dict]:
+        """Per-phase accumulated spans in taxonomy order (the profile
+        output's ``phases`` array)."""
+        out = []
+        for phase in PHASES:
+            if phase in self._acc:
+                out.append({"phase": phase,
+                            "time_in_nanos": int(self._acc[phase]),
+                            "count": int(self._counts.get(phase, 1))})
+        return out
+
+    def annotations(self) -> dict:
+        out = dict(self._annotations)
+        if self.ring_dropped:
+            out["spans_dropped"] = self.ring_dropped
+        return out
+
+    def top_phases(self, n: int = 3) -> str:
+        """``kernel:0.52ms, staging:0.11ms, merge:0.03ms`` — the slowlog
+        enrichment string."""
+        items = sorted(self._acc.items(), key=lambda kv: -kv[1])[:n]
+        return ", ".join(f"{p}:{ns / 1e6:.2f}ms" for p, ns in items)
+
+
+class _NullTracer:
+    """Disabled tracer: every method a no-op, shared singleton."""
+
+    __slots__ = ()
+    enabled = False
+    ring_dropped = 0
+    _acc: Dict[str, int] = {}
+    _annotations: Dict[str, object] = {}
+
+    def start(self, phase: str) -> int:
+        return 0
+
+    def stop(self, phase: str, t0: int) -> None:
+        pass
+
+    def annotate(self, key: str, value) -> None:
+        pass
+
+    def merge_from(self, other) -> None:
+        pass
+
+    def spans(self) -> List[dict]:
+        return []
+
+    def annotations(self) -> dict:
+        return {}
+
+    def top_phases(self, n: int = 3) -> str:
+        return ""
+
+
+NULL_TRACER = _NullTracer()
+
+
+def _bucket_label(ns: int) -> str:
+    """log2 latency bucket: a duration in [2^(k-1), 2^k) microseconds
+    lands in bucket ``le_2^k`` (``le_1`` = sub-microsecond). Integer
+    bit_length — no float log on the recording path."""
+    us = ns // 1000
+    return f"le_{1 << max(us, 1).bit_length()}" if us > 0 else "le_1"
+
+
+class SearchTelemetry:
+    """Per-index phase-telemetry registry (thread-safe counters).
+
+    Exported as the ``search.phases`` block of ``_stats`` and merged
+    across indices into the ``_nodes/stats`` search section."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (plane, phase) -> {bucket_label: count}
+        self._hist: Dict[tuple, Dict[str, int]] = {}
+        self.counters: Dict[str, int] = {}
+        self.decisions: Dict[str, int] = {}
+        self.queries_recorded = 0
+
+    def tracer(self, enabled: bool = True):
+        return QueryTracer() if enabled else NULL_TRACER
+
+    def record_query(self, plane: str, tracer) -> None:
+        """Fold one finished query's spans into the per-plane × per-phase
+        histograms (launch-level byte/tile totals arrive separately via
+        ``add_counters`` — once per launch, never per member)."""
+        if not getattr(tracer, "enabled", False):
+            return
+        with self._lock:
+            self.queries_recorded += 1
+            for phase, ns in tracer._acc.items():
+                h = self._hist.setdefault((plane, phase), {})
+                b = _bucket_label(ns)
+                h[b] = h.get(b, 0) + 1
+
+    def add_counters(self, mapping: Dict[str, int]) -> None:
+        """Fold LAUNCH-level totals (bytes streamed/skipped, tiles) in
+        exactly once — a batched launch must not multiply its byte
+        counters by the number of members sharing it."""
+        with self._lock:
+            for key, n in mapping.items():
+                total = key if key.endswith("_total") else key + "_total"
+                self.counters[total] = self.counters.get(total, 0) + int(n)
+
+    def note_decision(self, plane: str, reason: str, n: int = 1) -> None:
+        """Plane-ladder decision counter: which plane a query landed on
+        (or was turned away from) and WHY — ``mesh_pallas.served``,
+        ``mesh_pallas.quarantined``, ``host.unsupported_body``, ...
+
+        Units are PER QUERY: a batched launch's decision counts once per
+        member (``n`` = batch size), so batch-path and serial-path counts
+        stay comparable. A query descending the ladder may record more
+        than one decision (``shape_mismatch`` then ``served``), so
+        decision totals are not a partition of ``queries_recorded``."""
+        key = f"{plane}.{reason}"
+        with self._lock:
+            self.decisions[key] = self.decisions.get(key, 0) + int(n)
+
+    def phases_dict(self) -> dict:
+        with self._lock:
+            hist: Dict[str, Dict[str, dict]] = {}
+            for (plane, phase), buckets in self._hist.items():
+                hist.setdefault(plane, {})[phase] = {
+                    b: c for b, c in sorted(
+                        buckets.items(),
+                        key=lambda kv: int(kv[0].split("_")[1]))}
+            return {
+                "taxonomy": list(PHASES),
+                "queries_recorded": self.queries_recorded,
+                "histogram_us": hist,
+                "counters": dict(self.counters),
+                "decisions": dict(sorted(self.decisions.items())),
+            }
+
+
+def merge_phase_stats(blocks: List[dict]) -> dict:
+    """Merge per-index ``search`` stats blocks into one node-level block
+    (histograms/counters sum; scalars sum; lists concatenate except the
+    shared taxonomy; strings keep the first non-null value)."""
+
+    def merge(a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = merge(out[k], v) if k in out else v
+            return out
+        if isinstance(a, bool) or isinstance(b, bool):
+            return a or b
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            return a + b
+        if isinstance(a, list) and isinstance(b, list):
+            return a if a == b else a + b
+        return a if a is not None else b
+
+    out: dict = {}
+    for block in blocks:
+        out = merge(out, block) if out else dict(block)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# X-Opaque-Id request context (Task headers / slowlog / profile join key)
+# ---------------------------------------------------------------------------
+
+_OPAQUE_ID: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "es_tpu_x_opaque_id", default=None)
+
+
+def set_opaque_id(value: Optional[str]) -> None:
+    _OPAQUE_ID.set(value if value else None)
+
+
+def get_opaque_id() -> Optional[str]:
+    return _OPAQUE_ID.get()

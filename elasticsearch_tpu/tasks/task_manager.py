@@ -20,13 +20,19 @@ from elasticsearch_tpu.common.errors import (
 
 class Task:
     def __init__(self, task_id: int, node_id: str, action: str, description: str,
-                 cancellable: bool = True, parent: Optional[str] = None):
+                 cancellable: bool = True, parent: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
         self.task_id = task_id
         self.node_id = node_id
         self.action = action
         self.description = description
         self.cancellable = cancellable
         self.parent = parent
+        # task headers (TaskManager.register copies X-Opaque-Id from the
+        # request thread context): joins a running/slow task back to the
+        # client that issued it (docs/OBSERVABILITY.md)
+        self.headers = {k: v for k, v in (headers or {}).items()
+                        if v is not None}
         self.start_time = time.time()
         self._cancelled = threading.Event()
         self.cancel_reason: Optional[str] = None
@@ -60,6 +66,7 @@ class Task:
             "running_time_in_nanos": int((time.time() - self.start_time) * 1e9),
             "cancellable": self.cancellable,
             "status": self.status or None,
+            "headers": dict(self.headers),
             **({"parent_task_id": self.parent} if self.parent else {}),
         }
 
@@ -72,11 +79,19 @@ class TaskManager:
         self._lock = threading.Lock()
 
     def register(self, action: str, description: str, cancellable: bool = True,
-                 parent: Optional[str] = None) -> Task:
+                 parent: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None) -> Task:
+        if headers is None:
+            # default: lift the request's X-Opaque-Id off the REST
+            # thread context so every registered task carries it
+            from elasticsearch_tpu.search.telemetry import get_opaque_id
+
+            oid = get_opaque_id()
+            headers = {"X-Opaque-Id": oid} if oid else None
         with self._lock:
             self._counter += 1
             task = Task(self._counter, self.node_id, action, description,
-                        cancellable, parent)
+                        cancellable, parent, headers=headers)
             self._tasks[self._counter] = task
             return task
 

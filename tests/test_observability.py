@@ -1,0 +1,463 @@
+"""Phase-attributed query tracing (ISSUE 8, docs/OBSERVABILITY.md).
+
+Covers the four contracts:
+- plane-truthful profile: a "profile": true query is served by the same
+  rung as its unprofiled twin (mesh_pallas / batched / pruned included)
+  with byte-identical hits, and reports that plane's phase spans +
+  annotations;
+- stats-counter correctness under concurrency: a burst of mixed
+  batched/serial/knn traffic leaves every counter summing consistently
+  (no double counts, no lost increments);
+- tracer overhead guard: span count capped, per-phase accumulation
+  bounded by the taxonomy, the hot path fast, and the
+  search.telemetry.enabled kill switch honored (registered + dynamic);
+- MicroBatcher window-wait/batch-shape annotations.
+
+Kernel paths run in interpret mode on the CPU backend (the
+tests/test_pallas_scoring idiom).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.search.batching import MicroBatcher
+from elasticsearch_tpu.search.telemetry import (
+    NULL_TRACER,
+    PHASES,
+    QueryTracer,
+    SearchTelemetry,
+    merge_phase_stats,
+)
+from elasticsearch_tpu.testing.disruption import clear_search_disruptions
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text", "analyzer": "whitespace"},
+        "n": {"type": "integer"},
+        "emb": {"type": "dense_vector", "dims": 8,
+                "similarity": "cosine"},
+    }
+}
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernel(monkeypatch):
+    monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+    yield
+    clear_search_disruptions()
+
+
+def build_index(name="obs", n_shards=2, n_docs=80, seed=0,
+                **extra_settings):
+    idx = IndexService(name, Settings({
+        "index.number_of_shards": n_shards,
+        "index.refresh_interval": -1, **extra_settings}), mapping=MAPPING)
+    rng = np.random.RandomState(seed)
+    vocab = [f"t{i}" for i in range(12)]
+    for d in range(n_docs):
+        toks = [vocab[rng.randint(len(vocab))]
+                for _ in range(rng.randint(3, 9))]
+        idx.index_doc(str(d), {"body": " ".join(toks), "n": d,
+                               "emb": rng.randn(8).tolist()})
+    idx.refresh()
+    return idx
+
+
+def ids(r):
+    return [h["_id"] for h in r["hits"]["hits"]]
+
+
+def scores(r):
+    return [h["_score"] for h in r["hits"]["hits"]]
+
+
+class TestPlaneTruthfulProfile:
+    def test_mesh_pallas_profile_reports_plane_and_phases(self):
+        idx = build_index("obsprof")
+        try:
+            body = {"query": {"match": {"body": "t0 t1"}}, "size": 5}
+            plain = idx.search(dict(body))
+            assert plain["_plane"] == "mesh_pallas", plain["_plane"]
+            prof = idx.search(dict(body, profile=True))
+            # profile never demotes the plane, hits byte-identical
+            assert prof["_plane"] == "mesh_pallas", prof["_plane"]
+            assert ids(prof) == ids(plain)
+            assert scores(prof) == scores(plain)
+            p = prof["profile"]
+            assert p["plane"] == "mesh_pallas"
+            names = {s["phase"] for s in p["phases"]}
+            assert {"staging", "kernel", "merge"} <= names, names
+            assert all(s["time_in_nanos"] >= 0 for s in p["phases"])
+            # mesh-served: one compiled program, no per-segment trees
+            assert p["shards"] == []
+        finally:
+            idx.close()
+
+    def test_pruned_profile_reports_tile_economy(self):
+        idx = build_index("obspruned", n_docs=600, **{
+            "index.search.pallas.postings_codec": "packed",
+            "search.pallas.pruning.enabled": True,
+            "search.pallas.pruning.probe_tiles": 2,
+        })
+        try:
+            body = {"query": {"match": {"body": "t0 t3 t7"}}, "size": 5}
+            plain = idx.search(dict(body))
+            assert plain["_plane"] == "mesh_pallas"
+            assert "_pruned" in plain
+            prof = idx.search(dict(body, profile=True))
+            assert prof["_plane"] == "mesh_pallas"
+            assert "_pruned" in prof
+            assert ids(prof) == ids(plain)
+            assert scores(prof) == scores(plain)
+            ann = prof["profile"]["annotations"]
+            assert ann["tiles_scored"] > 0
+            assert ann["tiles_pruned"] > 0
+            assert ann["postings_bytes_skipped"] > 0
+            assert ann["postings_bytes_streamed"] > 0
+            counters = idx.search_stats()["phases"]["counters"]
+            assert counters["postings_bytes_skipped_total"] > 0
+        finally:
+            idx.close()
+
+    def test_batched_member_profile_reports_batch_shape(self):
+        idx = build_index("obsbatch")
+        try:
+            burst = [dict({"query": {"match": {"body": f"t{i}"}},
+                           "size": 4}, profile=True) for i in range(3)]
+            out = idx.search_batch([dict(b) for b in burst])
+            for j, got in enumerate(out):
+                assert isinstance(got, dict), got
+                assert got["_plane"] == "mesh_pallas", got["_plane"]
+                ann = got["profile"]["annotations"]
+                assert ann["batch_size"] == 3
+                assert ann["batch_member_index"] == j
+                assert got["profile"]["phases"]
+                solo = idx.search({"query": {"match": {"body": f"t{j}"}},
+                                   "size": 4})
+                assert ids(got) == ids(solo), j
+        finally:
+            idx.close()
+
+    def test_host_profile_keeps_segment_tree_plus_phases(self):
+        idx = build_index("obshost", n_shards=1)
+        try:
+            r = idx.search({"query": {"match": {"body": "t1"}},
+                            "size": 5, "profile": True})
+            assert r["_plane"] == "host"
+            p = r["profile"]
+            assert p["plane"] == "host"
+            assert p["shards"], "host profile lost the per-segment tree"
+            assert {s["phase"] for s in p["phases"]} >= {"kernel",
+                                                         "merge"}
+        finally:
+            idx.close()
+
+    def test_opaque_id_joins_task_slowlog_and_profile(self, caplog):
+        import logging
+
+        from elasticsearch_tpu.search.telemetry import set_opaque_id
+
+        idx = build_index("obsoid", n_shards=1, **{
+            "index.search.slowlog.threshold.query.warn": "0s"})
+        try:
+            set_opaque_id("client-7")
+            with caplog.at_level(
+                    logging.WARNING,
+                    logger="elasticsearch_tpu.index.search.slowlog"):
+                r = idx.search({"query": {"match": {"body": "t1"}},
+                                "size": 3, "profile": True})
+            assert r["profile"]["annotations"]["opaque_id"] == "client-7"
+            lines = [rec.getMessage() for rec in caplog.records
+                     if rec.name.endswith("search.slowlog")]
+            assert lines and "id[client-7]" in lines[0], lines
+            assert "plane[host]" in lines[0]
+            assert "phases[" in lines[0]
+        finally:
+            set_opaque_id(None)
+            idx.close()
+
+    def test_batch_member_slowlog_keeps_own_opaque_id(self, caplog):
+        """Kill switch OFF: every member's tracer is NULL_TRACER, so the
+        slowlog falls back to the contextvar — which must be the
+        MEMBER's id while its result is built on the leader's thread,
+        never the leader's own client id."""
+        import logging
+
+        from elasticsearch_tpu.search.telemetry import set_opaque_id
+
+        idx = build_index("obsoidbatch", **{
+            "search.telemetry.enabled": False,
+            "index.search.slowlog.threshold.query.warn": "0s"})
+        try:
+            set_opaque_id("leader-client")
+            bodies = [{"query": {"match": {"body": f"t{i}"}}, "size": 3}
+                      for i in range(3)]
+            with caplog.at_level(
+                    logging.WARNING,
+                    logger="elasticsearch_tpu.index.search.slowlog"):
+                out = idx.search_batch(
+                    bodies, oids=[f"client-{i}" for i in range(3)])
+            assert all(isinstance(r, dict) for r in out)
+            lines = [rec.getMessage() for rec in caplog.records
+                     if rec.name.endswith("search.slowlog")]
+            assert len(lines) == 3, lines
+            for i in range(3):
+                assert any(f"id[client-{i}]" in ln for ln in lines), (
+                    i, lines)
+            assert not any("id[leader-client]" in ln for ln in lines)
+            # the leader's own request context is restored afterwards
+            from elasticsearch_tpu.search.telemetry import get_opaque_id
+            assert get_opaque_id() == "leader-client"
+        finally:
+            set_opaque_id(None)
+            idx.close()
+
+
+class TestCountersUnderConcurrency:
+    def test_mixed_burst_counts_consistently(self):
+        idx = build_index("obsconc", n_docs=100, **{
+            "search.batch.max_queries": 4})
+        try:
+            # prewarm every program shape serially so the concurrent
+            # phase measures counting, not compilation
+            idx.search({"query": {"match": {"body": "t0"}}, "size": 3})
+            idx.search_batch([
+                {"query": {"match": {"body": "t1"}}, "size": 3},
+                {"query": {"match": {"body": "t2"}}, "size": 3}])
+            qv = [0.1] * 8
+            idx.search({"knn": {"field": "emb", "query_vector": qv,
+                                "k": 3}})
+
+            lex = [{"query": {"match": {"body": f"t{i % 6}"}}, "size": 3}
+                   for i in range(8)]
+            knn = [{"knn": {"field": "emb", "query_vector": qv, "k": 3}}
+                   for _ in range(4)]
+            serial = [{"query": {"match": {"body": f"t{i}"}}, "size": 3,
+                       "sort": [{"n": "desc"}]} for i in range(2)]
+            bodies = lex + knn + serial
+            base_recorded = idx.telemetry.queries_recorded
+            mesh = idx._mesh_search
+            base_mesh = mesh.query_total
+            base_knn = mesh.knn_query_total
+            base_host = idx._host_query_total
+
+            errors = []
+
+            def worker(b):
+                try:
+                    r = idx.search(dict(b))
+                    assert isinstance(r, dict)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(b,))
+                       for b in bodies]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60.0)
+            assert not errors, errors
+
+            # every request recorded exactly once in the telemetry
+            assert (idx.telemetry.queries_recorded - base_recorded
+                    == len(bodies))
+            # every request served by exactly one plane: mesh-served +
+            # host-served partition the burst
+            mesh_served = mesh.query_total - base_mesh
+            host_served = idx._host_query_total - base_host
+            assert mesh_served + host_served == len(bodies), (
+                mesh_served, host_served)
+            # every kNN request reached the MXU rung exactly once
+            assert mesh.knn_query_total - base_knn == len(knn)
+            # batch accounting stays internally consistent: the batched
+            # totals equal the histogram's weighted sum
+            bstats = idx.batch_stats.as_dict()
+            hist_sum = sum(int(size) * count for size, count
+                           in bstats["batch_size_histogram"].items())
+            assert bstats["batched_query_total"] == hist_sum
+            # per-shard attribution: each shard saw every query once
+            for sid, shard in idx.shards.items():
+                assert shard.searcher.query_total >= len(bodies), sid
+        finally:
+            idx.close()
+
+
+class TestTracerOverheadGuard:
+    def test_span_ring_capped_and_accumulators_bounded(self):
+        tr = QueryTracer()
+        for i in range(10_000):
+            t0 = tr.start("kernel")
+            tr.stop("kernel", t0)
+        # detail ring capped; accumulators bounded by the taxonomy
+        assert len(tr._ring) == QueryTracer.MAX_SPANS
+        assert tr.ring_dropped == 10_000 - QueryTracer.MAX_SPANS
+        spans = tr.spans()
+        assert len(spans) == 1  # one accumulator per phase, not 10k
+        assert spans[0]["count"] == 10_000
+        assert set(tr._acc) <= set(PHASES) | {"kernel"}
+        assert tr.annotations()["spans_dropped"] == tr.ring_dropped
+
+    def test_hot_loop_is_cheap(self):
+        # generous bound: 20k start/stop pairs (a 5000-segment scan's
+        # worth of spans) must stay far from per-query latency budgets.
+        # This guards against accidental allocation/IO creeping into
+        # the hot path, not against scheduler noise.
+        tr = QueryTracer()
+        t0 = time.perf_counter()
+        for _ in range(20_000):
+            t = tr.start("kernel")
+            tr.stop("kernel", t)
+        took = time.perf_counter() - t0
+        assert took < 1.0, f"tracer hot path took {took:.3f}s for 20k spans"
+
+    def test_null_tracer_is_inert(self):
+        t0 = NULL_TRACER.start("kernel")
+        NULL_TRACER.stop("kernel", t0)
+        NULL_TRACER.annotate("x", 1)
+        assert NULL_TRACER.spans() == []
+        assert NULL_TRACER.annotations() == {}
+        tel = SearchTelemetry()
+        tel.record_query("host", NULL_TRACER)
+        assert tel.queries_recorded == 0
+
+    def test_kill_switch_registered_and_honored(self):
+        from elasticsearch_tpu.common.settings import cluster_settings
+
+        reg = cluster_settings()._settings
+        assert "search.telemetry.enabled" in reg
+        assert reg["search.telemetry.enabled"].dynamic
+        idx = build_index("obskill", n_shards=1, **{
+            "search.telemetry.enabled": False})
+        try:
+            assert idx._tracer() is NULL_TRACER
+            r = idx.search({"query": {"match": {"body": "t1"}},
+                            "size": 3})
+            assert isinstance(r, dict)
+            phases = idx.search_stats()["phases"]
+            assert phases["queries_recorded"] == 0
+            assert phases["histogram_us"] == {}
+            # the dynamic override wins over the creation-time setting
+            idx.telemetry_enabled_override = True
+            idx.search({"query": {"match": {"body": "t1"}}, "size": 3})
+            assert idx.search_stats()["phases"]["queries_recorded"] == 1
+        finally:
+            idx.close()
+
+
+class TestBatchWindowAnnotations:
+    def test_microbatcher_annotate_hook(self):
+        mb = MicroBatcher(window_s=0.05, max_queries=4)
+        seen = {}
+        mb.annotate = (lambda item, wait_s, size, idx:
+                       seen.setdefault(item, (wait_s, size, idx)))
+        start = threading.Barrier(3)
+        results = {}
+
+        def slow_single(x):
+            time.sleep(0.15)
+            return ("single", x)
+
+        def worker(i):
+            start.wait()
+            results[i] = mb.run(
+                "k", i, single_fn=slow_single,
+                batch_fn=lambda items: [("batch", x) for x in items])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        # one went direct (never annotated); the group members carry
+        # wait + shape
+        assert seen, "annotate hook never fired"
+        for item, (wait_s, size, idx) in seen.items():
+            assert wait_s >= 0.0
+            assert size == len(seen)
+            assert 0 <= idx < size
+
+    def test_window_wait_lands_in_profile_annotations(self):
+        idx = build_index("obswait", n_docs=60)
+        try:
+            # prewarm compile so the timed window isn't compilation
+            idx.search_batch([
+                {"query": {"match": {"body": "t1"}}, "size": 3},
+                {"query": {"match": {"body": "t2"}}, "size": 3}])
+            start = threading.Barrier(3)
+            results = {}
+
+            def worker(i):
+                start.wait()
+                results[i] = idx.search(dict(
+                    {"query": {"match": {"body": f"t{i}"}}, "size": 3},
+                    profile=True))
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60.0)
+            waits = [r["profile"]["annotations"].get(
+                "batch_window_wait_ms") for r in results.values()
+                if isinstance(r, dict)]
+            # at least the grouped members carry the window wait
+            assert any(w is not None and w >= 0.0 for w in waits), waits
+        finally:
+            idx.close()
+
+
+class TestQuarantineEvents:
+    def test_fault_records_timestamped_event(self):
+        from elasticsearch_tpu.testing.disruption import PlaneFailScheme
+
+        idx = build_index("obsquar")
+        try:
+            body = {"query": {"match": {"body": "t1"}}, "size": 3}
+            assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+            before_ms = int(time.time() * 1000)
+            scheme = PlaneFailScheme(planes=["mesh_pallas"],
+                                     indices=["obsquar"]).install()
+            try:
+                r = idx.search(dict(body))
+                assert r["_plane"] != "mesh_pallas"
+            finally:
+                clear_search_disruptions()
+            planes = idx.search_stats()["planes"]
+            events = planes["quarantine_events"]
+            assert events, "no quarantine event recorded"
+            ev = events[-1]
+            assert ev["plane"] == "mesh_pallas"
+            assert ev["timestamp_ms"] >= before_ms
+            assert ev["cooldown_s"] > 0
+            # ladder decisions recorded the fault and the fallback
+            decisions = idx.search_stats()["phases"]["decisions"]
+            assert decisions.get("mesh_pallas.fault", 0) >= 1
+        finally:
+            idx.close()
+
+
+class TestNodeStatsMerge:
+    def test_merge_phase_stats_sums_histograms(self):
+        a = {"query_total": 2,
+             "phases": {"taxonomy": list(PHASES), "queries_recorded": 2,
+                        "histogram_us": {"host": {"kernel": {"le_8": 2}}},
+                        "counters": {"x_total": 1}, "decisions": {}}}
+        b = {"query_total": 3,
+             "phases": {"taxonomy": list(PHASES), "queries_recorded": 3,
+                        "histogram_us": {"host": {"kernel": {"le_8": 1,
+                                                             "le_16": 4}}},
+                        "counters": {"x_total": 2}, "decisions": {}}}
+        m = merge_phase_stats([a, b])
+        assert m["query_total"] == 5
+        assert m["phases"]["queries_recorded"] == 5
+        assert m["phases"]["histogram_us"]["host"]["kernel"] == {
+            "le_8": 3, "le_16": 4}
+        assert m["phases"]["counters"]["x_total"] == 3
+        assert m["phases"]["taxonomy"] == list(PHASES)

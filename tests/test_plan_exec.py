@@ -551,13 +551,28 @@ class TestMeshFeatureParity:
         self._same_hits(got, want)
         assert got["aggregations"] == want["aggregations"]
 
-    def test_collapse_and_profile_still_fall_back(self, pair):
-        for extra in ({"collapse": {"field": "tag"}}, {"profile": True}):
-            body = dict({"query": {"match": {"body": "w1"}}, "size": 5},
-                        **extra)
-            got, want = self._both(pair, body, mesh_used=False)
-            assert ([h["_id"] for h in got["hits"]["hits"]]
-                    == [h["_id"] for h in want["hits"]["hits"]])
+    def test_collapse_still_falls_back(self, pair):
+        body = {"query": {"match": {"body": "w1"}}, "size": 5,
+                "collapse": {"field": "tag"}}
+        got, want = self._both(pair, body, mesh_used=False)
+        assert ([h["_id"] for h in got["hits"]["hits"]]
+                == [h["_id"] for h in want["hits"]["hits"]])
+
+    def test_profile_is_plane_truthful(self, pair):
+        """ISSUE 8: "profile": true no longer demotes to the host path —
+        the mesh serves it (mesh_used asserted by _both) and the profile
+        section reports the serving plane + its phase spans, with hits
+        identical to the unprofiled run."""
+        base = {"query": {"match": {"body": "w1"}}, "size": 5}
+        plain, _ = self._both(pair, dict(base))
+        got, want = self._both(pair, dict(base, profile=True))
+        assert ([h["_id"] for h in got["hits"]["hits"]]
+                == [h["_id"] for h in plain["hits"]["hits"]])
+        assert ([h["_score"] for h in got["hits"]["hits"]]
+                == [h["_score"] for h in plain["hits"]["hits"]])
+        prof = got["profile"]
+        assert prof["plane"] == got["_plane"] != "host"
+        assert {s["phase"] for s in prof["phases"]} >= {"kernel", "merge"}
 
     def test_rare_term_stays_on_mesh(self, pair):
         """A term present in only ONE shard's dictionary must not force
@@ -807,9 +822,12 @@ class TestExecutionPlaneObservability:
         # mesh-eligible query
         r1 = idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
         assert r1["_plane"] == "mesh"
-        # host-only query (profile forces the host path)
-        r2 = idx.search({"query": {"match": {"body": "w1"}}, "size": 5,
-                         "profile": True})
+        # host-only query (collapse is mesh-UNSUPPORTED; profile no
+        # longer demotes — ISSUE 8 plane-truthfulness); a profiled host
+        # query still carries the per-segment tree
+        r2 = idx._search_uncached(
+            {"query": {"match": {"body": "w1"}}, "size": 5,
+             "profile": True}, skip_mesh=True)
         assert r2["_plane"] == "host"
         shard_profile = r2["profile"]["shards"][0]
         assert shard_profile["plane"] == "host"

@@ -249,12 +249,19 @@ class TestSearchBatchParity:
             bodies = [
                 {"query": {"match": {"body": "t0 t1"}}, "size": 5},
                 {"query": {"match": {"body": "t1"}}, "size": 5},
-                # profile is not batchable: still answered, serially
+                # collapse is not batchable: still answered, serially
+                {"query": {"match": {"body": "t2"}}, "size": 5,
+                 "collapse": {"field": "tag"}},
+                # profile IS batchable (ISSUE 8 plane-truthfulness): the
+                # member joins the shared launch and reports its batch
+                # shape in the profile annotations
                 {"query": {"match": {"body": "t2"}}, "profile": True},
             ]
             out = idx.search_batch([dict(b) for b in bodies])
             assert all(isinstance(r, dict) for r in out)
-            assert "profile" in out[2]
+            assert out[2]["_plane"] == "host"  # collapse: serial rung
+            assert "profile" in out[3]
+            assert out[3]["profile"]["annotations"].get("batch_size") == 3
             assert_member_parity(idx, bodies[0], out[0])
         finally:
             idx.close()
@@ -538,7 +545,9 @@ class TestMicroBatcher:
                                "size": 3, "min_score": 0.5,
                                "aggs": {"t": {"terms": {"field": "tag"}}}})
         assert not batchable_body({})  # no query
-        assert not batchable_body({"query": {"match_all": {}},
-                                   "profile": True})
+        # profile rides the batch (ISSUE 8): plane-truthful profiling
+        # must not demote the member off the shared launch
+        assert batchable_body({"query": {"match": {"b": "x"}},
+                               "profile": True})
         assert not batchable_body({"query": {"match": {"b": "x"}},
                                    "collapse": {"field": "tag"}})

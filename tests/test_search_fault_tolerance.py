@@ -340,9 +340,10 @@ class TestPlaneQuarantine:
         idx = make_index(name, shards=3, mesh=True, extra={
             "index.search.plane_quarantine.cooldown": cooldown})
         # pre-warm the host fallback compile so the post-fault assertions
-        # don't race the cooldown window
-        idx.search({"query": {"match": {"body": "w1"}}, "size": 5,
-                    "profile": True})
+        # don't race the cooldown window (profile no longer forces the
+        # host path — ISSUE 8 — so pin it explicitly)
+        idx._search_uncached({"query": {"match": {"body": "w1"}},
+                              "size": 5}, skip_mesh=True)
         return idx
 
     def test_mesh_fault_quarantines_then_recovers(self):

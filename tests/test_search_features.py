@@ -267,8 +267,13 @@ class TestProfileTree:
         from elasticsearch_tpu.node import Node
 
         node = Node()
-        node.create_index("prof", {"mappings": {"_doc": {"properties": {
-            "t": {"type": "text"}, "n": {"type": "integer"}}}}})
+        # pin the host path: profile is plane-truthful now (ISSUE 8) and
+        # a mesh-served profile reports phase spans instead of the
+        # per-segment plan tree this test inspects
+        node.create_index("prof", {
+            "settings": {"index": {"search": {"mesh": False}}},
+            "mappings": {"_doc": {"properties": {
+                "t": {"type": "text"}, "n": {"type": "integer"}}}}})
         for i in range(20):
             node.index_doc("prof", str(i),
                            {"t": f"word{i % 3} common", "n": i},
