@@ -277,8 +277,28 @@ def run_measurement() -> dict:
         v.block_until_ready()
     hbm_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
                     for v in dev.values())
+    stage_ms = (time.perf_counter() - t0) * 1000.0
     log(f"staged {hbm_bytes / 1e6:.0f} MB to device in "
-        f"{time.perf_counter() - t0:.1f}s; geom={geom}")
+        f"{stage_ms / 1000.0:.1f}s; geom={geom}")
+    # bench stages the corpus directly (no Segment/IndexService in the
+    # loop), so it registers with the device-memory accountant itself —
+    # the report's staged_bytes_total / restage_amplification read the
+    # same ledger production serves from (ISSUE 9, docs/OBSERVABILITY.md)
+    from elasticsearch_tpu.common import memory as dm
+
+    acct = dm.memory_accountant()
+    _k = dict(reason="initial", duration_ms=stage_ms)
+    acct.register("bench", "corpus", dm.KIND_POSTINGS_RAW, "k_postings",
+                  int(dev["docs"].nbytes + dev["frac"].nbytes
+                      + dev["block_docs"].nbytes
+                      + dev["block_tfs"].nbytes), **_k)
+    acct.register("bench", "corpus", dm.KIND_LIVE_MASK, "live",
+                  int(dev["live_t"].nbytes + dev["live1"].nbytes), **_k)
+    acct.register("bench", "corpus", dm.KIND_SCALE_NORM, "norms",
+                  int(dev["norms"].nbytes), **_k)
+    acct.register("bench", "corpus", dm.KIND_DOC_VALUES, "columns",
+                  int(dev["keyword_ord"].nbytes + dev["numeric"].nbytes),
+                  **_k)
 
     # ---------------- query mix ----------------
     rng = np.random.RandomState(3)
@@ -414,10 +434,23 @@ def run_measurement() -> dict:
             f"falling back to legacy scatter program")
 
     # ---------------- extra configs (same marginal methodology) ----------
+    def stamp_mem(*cfgs):
+        """Stamp the device-memory ledger's view (ISSUE 9) onto each
+        config dict AS IT COMPLETES: staged_bytes_total is the ledger's
+        bench-index bytes at that point, restage_amplification the
+        restaged/logically-changed ratio (non-null once the packed
+        config re-stages the corpus)."""
+        st = dm.memory_accountant().stats("bench")
+        for cfg in cfgs:
+            if isinstance(cfg, dict) and "error" not in cfg:
+                cfg["staged_bytes_total"] = st["staged_bytes_total"]
+                cfg["restage_amplification"] = st["restage_amplification"]
+
     extra_configs = None
     if kernel_metrics is not None:
         extra_configs = run_extra_configs(
             jax, jnp, lax, psc, corpus, dev, geom, bmin, bmax, cb_run, rng)
+        stamp_mem(*extra_configs.values())
         # cross-query micro-batching sweep (ISSUE 5 acceptance config)
         try:
             extra_configs["batched_qps"] = run_batched_qps_config(
@@ -428,6 +461,7 @@ def run_measurement() -> dict:
             traceback.print_exc(file=sys.stderr)
             extra_configs["batched_qps"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        stamp_mem(extra_configs["batched_qps"])
         # the mesh-path config: distributed scoring on the tile kernel
         # (acceptance: within 2x of the single-chip pallas p50)
         try:
@@ -439,6 +473,7 @@ def run_measurement() -> dict:
             traceback.print_exc(file=sys.stderr)
             extra_configs["mesh_pallas_packed"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        stamp_mem(extra_configs["mesh_pallas_packed"])
         # ISSUE 6 acceptance configs: bit-packed postings codec and
         # block-max pruned scoring (each recall-gated vs the RAW oracle)
         try:
@@ -455,6 +490,8 @@ def run_measurement() -> dict:
                 "error": f"{type(e).__name__}: {e}"}
             extra_configs["pruned_scoring"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        stamp_mem(extra_configs["packed_postings"],
+                  extra_configs["pruned_scoring"])
         # ISSUE 7 acceptance configs: dense-vector kNN on the MXU +
         # hybrid BM25 ∪ kNN ranking (recall-gated vs the numpy oracle)
         try:
@@ -471,6 +508,8 @@ def run_measurement() -> dict:
                 "error": f"{type(e).__name__}: {e}"}
             extra_configs["hybrid_rrf"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        stamp_mem(extra_configs["knn_top10"],
+                  extra_configs["hybrid_rrf"])
 
     # ---------------- timings: legacy scatter path (r03) ----------------
     legacy_p50 = legacy_p50_2 = None
@@ -689,6 +728,16 @@ def run_measurement() -> dict:
             "phase_attribution_p50_ms": phase_attribution,
             "n_docs": N_DOCS,
             "recall_at_10": recall,
+            # device-memory ledger view (ISSUE 9): exact bytes the bench
+            # corpus holds staged, and restaged/logically-changed — the
+            # ROADMAP item-3 number (non-null once the packed config
+            # re-staged the corpus in a second layout)
+            "staged_bytes_total": (
+                dm.memory_accountant().stats("bench")
+                ["staged_bytes_total"]),
+            "restage_amplification": (
+                dm.memory_accountant().stats("bench")
+                ["restage_amplification"]),
             "hbm_gb_per_s_estimate": round(hbm_gbps, 1),
             "bytes_per_query_mb": round(bytes_per_query / 1e6, 2),
             "corpus_hbm_mb": round(hbm_bytes / 1e6, 1),
@@ -1085,6 +1134,16 @@ def run_knn_configs(jax, jnp, psc, corpus, dev, geom, frac, bmin, bmax,
     log(f"knn corpus staged in {time.perf_counter() - t0:.1f}s "
         f"({emb_host.nbytes / 1e6:.0f} MB bf16, tile_sub="
         f"{geom_k.tile_sub}, n_tiles={geom_k.n_tiles})")
+    from elasticsearch_tpu.common import memory as dm
+
+    acct = dm.memory_accountant()
+    knn_ms = (time.perf_counter() - t0) * 1000.0
+    acct.register("bench", "knn_corpus", dm.KIND_EMBEDDINGS, "emb",
+                  int(emb_host.nbytes), duration_ms=knn_ms)
+    acct.register("bench", "knn_corpus", dm.KIND_SCALE_NORM, "scale",
+                  int(scale_host.nbytes), duration_ms=knn_ms)
+    acct.register("bench", "knn_corpus", dm.KIND_LIVE_MASK, "mask",
+                  int(mask_host.nbytes), duration_ms=knn_ms)
 
     # query mix: a random doc's embedding + gaussian noise — neighbors
     # exist (recall is meaningful) without being degenerate self-matches
@@ -1334,6 +1393,15 @@ def run_codec_pruning_configs(jax, jnp, psc, corpus, dev, geom, frac,
     packed_bytes = int(pk.nbytes)
     log(f"packed staging: {packed_bytes / 1e6:.0f} MB (raw "
         f"{raw_bytes / 1e6:.0f} MB) in {stage_s:.1f}s")
+    # the packed layout re-stages the SAME logical corpus — a
+    # geometry_change restage in the device-memory ledger, so the
+    # report's restage_amplification reflects a real restage cycle
+    from elasticsearch_tpu.common import memory as dm
+
+    dm.memory_accountant().register(
+        "bench", "corpus", dm.KIND_POSTINGS_PACKED, "k_packed",
+        packed_bytes, reason="geometry_change",
+        duration_ms=stage_s * 1000.0)
 
     timed_terms = term_sets[WARMUP:]
     tables = []

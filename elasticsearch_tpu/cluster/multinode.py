@@ -1596,10 +1596,26 @@ class ClusterNode:
             raise ElasticsearchTpuException("shard not allocated here")
         body = payload["body"] or {}
         from elasticsearch_tpu.search.service import fetch_hits
+        from elasticsearch_tpu.search.telemetry import (
+            get_opaque_id,
+            set_opaque_id,
+        )
 
-        result = shard.searcher.query(body, size_hint=payload.get("k", 10))
-        hits = fetch_hits(result.refs, {shard.shard_id: shard}, body,
-                          payload["index"])
+        # the coordinator's task headers ride the transport hop (the
+        # reference forwards threadContext headers on every internal
+        # action): the data node's slowlog/profile lines join to the
+        # ORIGINATING client's X-Opaque-Id, not to nothing (PR 8 closed
+        # this for single-node only)
+        headers = payload.get("headers") or {}
+        prev_oid = get_opaque_id()
+        set_opaque_id(headers.get("X-Opaque-Id") or prev_oid)
+        try:
+            result = shard.searcher.query(body,
+                                          size_hint=payload.get("k", 10))
+            hits = fetch_hits(result.refs, {shard.shard_id: shard}, body,
+                              payload["index"])
+        finally:
+            set_opaque_id(prev_oid)
         for ref, hit in zip(result.refs, hits):
             hit["_sort_tuple"] = list(ref.sort_values)
         return {
@@ -1756,6 +1772,13 @@ class ClusterClient:
         md = self.node.indices_meta.get(index)
         if md is None:
             raise IndexNotFoundException(index)
+        # coordinator → data-node task headers: the client's X-Opaque-Id
+        # crosses the transport hop with the per-shard query actions so
+        # remote slowlog/profile lines join to the originating client
+        from elasticsearch_tpu.search.telemetry import get_opaque_id
+
+        opaque_id = get_opaque_id()
+        hop_headers = ({"X-Opaque-Id": opaque_id} if opaque_id else None)
         from_ = int(body.get("from", 0) or 0)
         size = int(body.get("size", 10) if body.get("size") is not None else 10)
         k = from_ + size
@@ -1774,10 +1797,12 @@ class ClusterClient:
             last_error = None
             for copy in started:
                 try:
-                    resp = self._timed_request(
-                        copy.node_id, ACTION_QUERY,
-                        {"index": index, "shard": sid, "body": body, "k": max(k, 1)},
-                    )
+                    payload = {"index": index, "shard": sid, "body": body,
+                               "k": max(k, 1)}
+                    if hop_headers:
+                        payload["headers"] = hop_headers
+                    resp = self._timed_request(copy.node_id, ACTION_QUERY,
+                                               payload)
                     break
                 except NodeNotConnectedException:
                     continue

@@ -92,7 +92,13 @@ class ParentBreaker(CircuitBreaker):
         self.children = children
 
     def check_parent(self, label: str) -> None:
-        total = sum(c.used_bytes for c in self.children.values())
+        # the accounting child mirrors the DEVICE-memory ledger (HBM
+        # staging — common/memory.py), a different physical resource
+        # than the host working-set this parent bounds; its own budget
+        # breaker enforces it by LRU-evict + plane demotion, never 429,
+        # so it must not eat the host children's headroom here
+        total = sum(c.used_bytes for name, c in self.children.items()
+                    if name != CircuitBreaker.ACCOUNTING)
         if self.limit_bytes > 0 and total > self.limit_bytes:
             with self._lock:
                 self._trip_count += 1

@@ -580,6 +580,18 @@ SEARCH_KNN_TILE_SUB = Setting(
     validator=_validate_knn_tile_sub, dynamic=True,
 )
 
+# --- device-memory accountant (ISSUE 9, docs/OBSERVABILITY.md) ---
+
+SEARCH_MEMORY_HBM_BUDGET = Setting.bytes_setting(
+    # HBM staging budget for the DeviceMemoryAccountant (0 = unlimited).
+    # Over budget, a new staging first LRU-evicts the coldest staged
+    # scopes (segment tables, mesh executors — both restage lazily),
+    # then DEMOTES to the host rung with plane-ladder decision reason
+    # hbm_budget: queries degrade, never 429/5xx. The accounting breaker
+    # child mirrors the ledger, so the budget also shows as its limit.
+    "search.memory.hbm_budget_bytes", "0b", dynamic=True
+)
+
 # --- phase-attributed query telemetry (docs/OBSERVABILITY.md) ---
 
 SEARCH_TELEMETRY_ENABLED = Setting.bool_setting(
@@ -633,6 +645,7 @@ NODE_SETTINGS = [
     SEARCH_PALLAS_PRUNING_PROBE_TILES,
     SEARCH_KNN_ENABLED,
     SEARCH_KNN_TILE_SUB,
+    SEARCH_MEMORY_HBM_BUDGET,
     SEARCH_TELEMETRY_ENABLED,
 ]
 

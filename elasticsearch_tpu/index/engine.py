@@ -59,8 +59,16 @@ class GetResult:
 
 class Engine:
     def __init__(self, shard_id, mapper_service, translog: Translog,
-                 store=None, segment_prefix: str = "seg", index_sort=None):
+                 store=None, segment_prefix: str = "seg", index_sort=None,
+                 index_name: Optional[str] = None):
         self.shard_id = shard_id
+        # the owning index's name: the device-memory accountant's top
+        # hierarchy level — stamped onto every segment before staging
+        # (see searchable_segments). The split fallback parses the
+        # "index[sid]" shard_id render for direct constructions (tests)
+        # that don't pass the name explicitly
+        self.index_name = (index_name if index_name is not None
+                           else str(shard_id).split("[", 1)[0])
         self.mapper_service = mapper_service
         self.translog = translog
         self.store = store  # index.store.Store or None (transient shard)
@@ -339,20 +347,29 @@ class Engine:
                     )
             return GetResult(False, doc_id)
 
+    def _stamp_owner(self, seg: Segment) -> None:
+        if seg.owner_index != self.index_name:
+            seg.owner_index = self.index_name
+            for nctx in seg.nested.values():
+                self._stamp_owner(nctx.segment)
+
     def searchable_segments(self) -> List[Segment]:
         with self._lock:
             segs = [s for s in self.segments
                     if s.live_doc_count > 0 or s.num_docs == 0]
             codec = getattr(self, "postings_codec", None)
-            if codec is not None:
-                for s in segs:
+            for s in segs:
+                # the device-memory accountant attributes stagings to
+                # the owning index; stamp before any lazy staging runs
+                self._stamp_owner(s)
+                if codec is not None and \
+                        getattr(s, "postings_codec", None) != codec:
                     # index-setting preference for the kernel staging
                     # (index.search.pallas.postings_codec); consulted
                     # once at the segment's lazy device staging, so a
                     # changed setting applies to segments staged AFTER
                     # the change (docs/PRUNING.md)
-                    if getattr(s, "postings_codec", None) != codec:
-                        s.postings_codec = codec
+                    s.postings_codec = codec
             return segs
 
     @property
@@ -492,7 +509,21 @@ class Engine:
                         entry.local_doc = int(remap[entry.local_doc])
             for old_seg in self.segments:
                 old_seg.release_breaker_charges()
+                # segment retirement: give its staged device bytes back
+                # to the ledger (the merged segment restages lazily)
+                old_seg.release_device_staging()
+            # the merge product re-stages the SAME logical corpus the
+            # retired segments held: its first staging is a "refresh"
+            # restage in the lifecycle ring, like the mesh plane
+            # classifies the same merge (Segment.stage_reason_initial)
+            def _mark_restage(seg: Segment) -> None:
+                seg.stage_reason_initial = "refresh"
+                for nctx in seg.nested.values():
+                    _mark_restage(nctx.segment)
+
+            _mark_restage(merged)
             self.segments = [merged] if merged.num_docs else []
+            self._stamp_owner(merged)
 
     def recover_from_translog(self) -> int:
         """Replay uncommitted translog ops (engine open after crash)."""
@@ -536,4 +567,5 @@ class Engine:
     def close(self) -> None:
         for seg in self.segments:
             seg.release_breaker_charges()
+            seg.release_device_staging()
         self.translog.close()

@@ -131,6 +131,15 @@ class IndexService:
         from elasticsearch_tpu.search.telemetry import SearchTelemetry
 
         self.telemetry = SearchTelemetry()
+        # device-memory budget (search.memory.hbm_budget_bytes, ISSUE 9):
+        # the accountant is a process resource — an explicitly-set value
+        # here (node-file seed / direct-service tests) configures it, the
+        # same way node startup and PUT _cluster/settings do
+        if settings.get("search.memory.hbm_budget_bytes") is not None:
+            from elasticsearch_tpu.common.memory import memory_accountant
+
+            memory_accountant().set_budget(
+                settings.get_bytes("search.memory.hbm_budget_bytes", 0))
         # batch items are (body, deadline, tracer): stamp window-wait +
         # batch shape onto each member's tracer at dispatch time
         self._batcher.annotate = self._annotate_batch_member
@@ -1384,6 +1393,11 @@ class IndexService:
             # per-plane × per-phase log2 latency histograms, byte/tile
             # counters, and plane-ladder decision counters with reasons
             "phases": self.telemetry.phases_dict(),
+            # device-memory ledger (ISSUE 9, docs/OBSERVABILITY.md):
+            # per-kind staged bytes (sum EXACTLY to staged_bytes_total),
+            # staging/eviction lifecycle event rings, and the
+            # restage-amplification metric ROADMAP item 3 drives down
+            "memory": _memory_stats(self.name),
         }
         if groups:
             search["groups"] = groups
@@ -1463,8 +1477,23 @@ class IndexService:
     def close(self) -> None:
         if self._refresh_stop is not None:
             self._refresh_stop.set()
+        # structured device-memory releases first (mesh plane, then every
+        # shard's segments via engine.close), then the index-level ledger
+        # backstop — close/delete must return the ledger to baseline
+        # exactly (the leak-check contract, docs/OBSERVABILITY.md)
+        if self._mesh_search is not None:
+            self._mesh_search._drop_staging()
         for shard in self.shards.values():
             shard.close()
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        memory_accountant().release_index(self.name)
+
+
+def _memory_stats(index: Optional[str]) -> dict:
+    from elasticsearch_tpu.common.memory import memory_accountant
+
+    return memory_accountant().stats(index)
 
 
 def _pure_knn_mesh_clause(body: dict) -> Optional[dict]:

@@ -26,6 +26,7 @@ segments of similar size.
 from __future__ import annotations
 
 import bisect
+import itertools
 import json
 import threading
 from dataclasses import dataclass, field
@@ -33,7 +34,21 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu.common.memory import (
+    KIND_BOUND_TABLES,
+    KIND_DOC_VALUES,
+    KIND_EMBEDDINGS,
+    KIND_LIVE_MASK,
+    KIND_POSTINGS_PACKED,
+    KIND_POSTINGS_RAW,
+    KIND_SCALE_NORM,
+)
+
 BLOCK = 128  # posting block width == TPU lane count
+
+# ledger-scope uniquifier (itertools.count.__next__ is atomic under the
+# GIL): see Segment.ledger_scope
+_LEDGER_SEQ = itertools.count(1)
 
 # Field-name separator in composite term keys ("field\x1ftoken").
 FIELD_SEP = "\x1f"
@@ -216,11 +231,36 @@ class Segment:
         # structures (text fielddata); released when the segment is
         # dropped (merge/close) — see release_breaker_charges()
         self.breaker_charges: Dict[str, int] = {}
+        # which index owns this segment (stamped by the engine before
+        # staging; the DeviceMemoryAccountant's top hierarchy level)
+        self.owner_index: Optional[str] = None
+        # per-OBJECT ledger scope: segment names repeat across in-process
+        # cluster nodes (primary + replica copies share "idx_0_seg_N"),
+        # and the accountant keys scopes by string — a shared name would
+        # let one copy's register/release clobber the other's entries
+        self.ledger_scope = f"{name}@{next(_LEDGER_SEQ)}"
+        # how this segment's FIRST staging classifies in the lifecycle
+        # event ring: a merge product carries the same logical corpus as
+        # the segments it retired, so its staging is a restage
+        # ("refresh" — the engine's merge path overrides this), not new
+        # logical bytes; translog-replay/recovery segments stay
+        # "initial" (first staging of that data in this process)
+        self.stage_reason_initial = "initial"
         self._device: Optional[dict] = None
         # generic device-array cache for doc-value columns (key -> jnp array)
         self.dev_cache: Dict[str, Any] = {}
         # guards lazy per-sub live-mask staging vs delete_docs' restage
         self._live_t_lock = threading.Lock()
+        # serializes COLD builds (base/kernel/vector/column stagings):
+        # two queries racing a cold segment would both pay the
+        # multi-second device transfer AND double-register it (the
+        # second "initial" reclassifies as a restage, inflating
+        # restage_amplification with zero actual restaging). Cached
+        # fast paths stay lock-free; never held while taking
+        # _live_t_lock, and the eviction callback
+        # (release_device_staging) never takes it — so the accountant
+        # lock is only ever acquired UNDER it, never the reverse
+        self._device_stage_lock = threading.Lock()
 
     # ------------------------------------------------------------------
 
@@ -246,23 +286,37 @@ class Segment:
             # recursively, one restage per level
             objs = np.nonzero(np.isin(nctx.parent_of, locals_))[0]
             nctx.segment.delete_docs(objs)
-        if self._device is not None:  # restage only the live masks
+        dev = self._device
+        if dev is not None:  # restage only the live masks
+            import time as _time
+
             import jax.numpy as jnp
 
-            self._device["live"] = jnp.asarray(self.live)
-            self._device["live1"] = jnp.asarray(
+            t0 = _time.monotonic()
+            dev["live"] = jnp.asarray(self.live)
+            dev["live1"] = jnp.asarray(
                 np.concatenate([self.live, np.zeros(1, dtype=bool)])
             )
             with self._live_t_lock:
-                if "k_live_t" in self._device:
-                    self._device["k_live_t"] = self._build_live_t_device(
+                if "k_live_t" in dev:
+                    dev["k_live_t"] = self._build_live_t_device(
                         self.kernel_geom.tile_sub)
                 # per-sub variants staged by kernel_live_t_for (dense-term
                 # queries that shrank the tile) restage the same way
-                for key in [k for k in self._device
+                for key in [k for k in dev
                             if k.startswith("k_live_t_")]:
                     sub = int(key.rsplit("_", 1)[1])
-                    self._device[key] = self._build_live_t_device(sub)
+                    dev[key] = self._build_live_t_device(sub)
+            # the live-mask restage is the canonical delete-invalidation
+            # event: the logical change is one tombstone bit per doc, the
+            # restaged bytes are every dependent mask layout
+            from elasticsearch_tpu.common.memory import memory_accountant
+
+            memory_accountant().note_logical_change(
+                self.owner_index or "_unassigned", int(locals_.size))
+            self._account_live_masks(
+                "delete_invalidation",
+                duration_ms=(_time.monotonic() - t0) * 1000.0)
 
     def term_id(self, field_name: str, token: str) -> int:
         key = f"{field_name}{FIELD_SEP}{token}"
@@ -326,36 +380,115 @@ class Segment:
     # Device staging
     # ------------------------------------------------------------------
 
+    def _account(self, kind: str, table: str, nbytes: int,
+                 reason: str = "initial", duration_ms: float = 0.0) -> None:
+        """Register one staged table group with the device-memory
+        accountant (ISSUE 9, docs/OBSERVABILITY.md). The whole segment
+        staging is one LRU-evictable scope: over HBM budget, the
+        accountant drops the coldest segment's arrays (they restage
+        lazily on next use)."""
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        if reason == "initial":
+            # a merge product's first staging is a restage of retired
+            # segments' corpus, not new logical bytes (see
+            # stage_reason_initial) — without this the exact full-corpus
+            # restage ROADMAP item 3 targets would land in the
+            # amplification DENOMINATOR and read as ~0 amplification
+            reason = self.stage_reason_initial
+        memory_accountant().register(
+            self.owner_index or "_unassigned", self.ledger_scope, kind,
+            table,
+            int(nbytes), reason=reason, duration_ms=duration_ms,
+            plane="host", evict=self.release_device_staging)
+
+    def _account_live_masks(self, reason: str,
+                            duration_ms: float = 0.0) -> None:
+        """(Re-)register every staged live-mask layout (live, live1,
+        k_live_t, per-sub variants) — mask mutations restage all
+        dependent layouts at once, one ledger entry per layout so the
+        restaged-bytes accounting is exact."""
+        dev = self._device
+        if dev is None:
+            return
+        # snapshot: concurrent stagers (kernel_live_t_for, vector
+        # staging) add keys to the live dict while we iterate
+        for key, v in list(dev.items()):
+            if key in ("live", "live1") or key.startswith("k_live_t"):
+                self._account(KIND_LIVE_MASK, key, int(v.nbytes),
+                              reason=reason, duration_ms=duration_ms)
+
     def device_arrays(self) -> dict:
         """Stage postings/norms/live-mask to the default device (cached).
         When the pallas scoring kernel is active (TPU, or interpret mode
         in tests) the kernel's tile-layout arrays ride along."""
-        if self._device is None:
-            import jax.numpy as jnp
+        from elasticsearch_tpu.common.memory import memory_accountant
 
-            live1 = np.concatenate([self.live, np.zeros(1, dtype=bool)])
-            self._device = {
-                "block_docs": jnp.asarray(self.block_docs),
-                "block_tfs": jnp.asarray(self.block_tfs),
-                "norms": jnp.asarray(self.norms),
-                "live": jnp.asarray(self.live),
-                "live1": jnp.asarray(live1),
-            }
-        if "k_docs" not in self._device and "k_packed" not in self._device:
+        # capture a LOCAL reference: a concurrent HBM-budget eviction may
+        # null self._device at any point (another thread's try_reserve),
+        # and an in-flight query must keep serving from the dict it
+        # staged — the arrays stay alive through normal refcounting
+        dev = self._device
+        if dev is None:
+            with self._device_stage_lock:
+                dev = self._device  # a racing cold query built it
+                if dev is None:
+                    import time as _time
+
+                    import jax.numpy as jnp
+
+                    t0 = _time.monotonic()
+                    live1 = np.concatenate(
+                        [self.live, np.zeros(1, dtype=bool)])
+                    dev = {
+                        "block_docs": jnp.asarray(self.block_docs),
+                        "block_tfs": jnp.asarray(self.block_tfs),
+                        "norms": jnp.asarray(self.norms),
+                        "live": jnp.asarray(self.live),
+                        "live1": jnp.asarray(live1),
+                    }
+                    self._device = dev
+                    dur = (_time.monotonic() - t0) * 1000.0
+                    self._account(
+                        KIND_POSTINGS_RAW, "base_postings",
+                        self.block_docs.nbytes + self.block_tfs.nbytes,
+                        duration_ms=dur)
+                    self._account(KIND_SCALE_NORM, "norms",
+                                  self.norms.nbytes)
+                    self._account_live_masks("initial")
+        else:
+            memory_accountant().touch(self.owner_index or "_unassigned",
+                                      self.ledger_scope)
+        if "k_docs" not in dev and "k_packed" not in dev:
             # lazy: the pallas mode may turn on after the first staging
             # (ES_TPU_PALLAS flips in tests; backend selection at runtime)
-            self._stage_kernel_arrays()
-        return self._device
+            with self._device_stage_lock:
+                if "k_docs" not in dev and "k_packed" not in dev:
+                    self._stage_kernel_arrays(dev)
+        return dev
 
-    def _stage_kernel_arrays(self) -> None:
+    def _stage_kernel_arrays(self, dev: dict) -> None:
         from elasticsearch_tpu.ops.aggs import _pallas_mode
 
         if not _pallas_mode():
             return
+        import time as _time
+
         import jax.numpy as jnp
 
+        from elasticsearch_tpu.common.memory import memory_accountant
         from elasticsearch_tpu.ops import pallas_scoring as psc
 
+        # HBM budget pressure valve: a MANDATORY staging (the host rung
+        # scores byte-identically to the mesh kernel only through these
+        # tables) — the reservation LRU-evicts colder scopes to make
+        # room but a denial never blocks it; budget DENIAL lives at the
+        # optional mesh-plane staging (ladder reason hbm_budget)
+        memory_accountant().try_reserve(
+            self.owner_index or "_unassigned",
+            self.block_docs.nbytes + self.block_tfs.nbytes,
+            exclude_scope=self.ledger_scope, mandatory=True)
+        t0 = _time.monotonic()
         geom = psc.tile_geometry(self.nd_pad)
         frac = self._block_frac()
         bmin, bmax = psc.block_min_max(self.block_docs, self.block_tfs,
@@ -391,8 +524,18 @@ class Segment:
         self.kernel_bmin = bmin
         self.kernel_bmax = bmax
         self.kernel_codec = codec
-        self._device.update(staged)
+        dev.update(staged)
         self.kernel_geom = geom
+        dur = (_time.monotonic() - t0) * 1000.0
+        self._account(KIND_POSTINGS_PACKED if codec == "packed"
+                      else KIND_POSTINGS_RAW, "k_postings",
+                      self.kernel_postings_bytes, duration_ms=dur)
+        # bmin/bmax stay host-resident but scale with the plane: tracked
+        # under bound_tables so the per-kind sums explain the footprint
+        self._account(KIND_BOUND_TABLES, "k_bounds",
+                      int(bmin.nbytes + bmax.nbytes))
+        self._account(KIND_LIVE_MASK, "k_live_t",
+                      int(staged["k_live_t"].nbytes), duration_ms=dur)
 
     def _build_live_t_device(self, sub: int):
         import jax.numpy as jnp
@@ -412,9 +555,25 @@ class Segment:
         layout changes. Locked against delete_docs' restage so a stale
         mask can never be published after a concurrent delete."""
         key = f"k_live_t_{sub}"
+        dev = self.device_arrays()  # restages if the budget evicted us
+        staged_nbytes = dur = 0
         with self._live_t_lock:
-            if key not in self._device:
-                self._device[key] = self._build_live_t_device(sub)
+            if key not in dev:
+                import time as _time
+
+                t0 = _time.monotonic()
+                arr = self._build_live_t_device(sub)
+                dev[key] = arr
+                staged_nbytes = int(arr.nbytes)
+                dur = (_time.monotonic() - t0) * 1000.0
+        if staged_nbytes:
+            # a shrunk tile is a geometry change: the same mask data
+            # restages in a new layout (docs/OBSERVABILITY.md). Accounted
+            # OUTSIDE _live_t_lock — the budget evictor holds the
+            # accountant lock when it drops stagings, so taking the
+            # accountant lock under _live_t_lock would invert lock order
+            self._account(KIND_LIVE_MASK, key, staged_nbytes,
+                          reason="geometry_change", duration_ms=dur)
         return key
 
     def _block_frac(self) -> np.ndarray:
@@ -452,38 +611,109 @@ class Segment:
         emb_key = f"k_vec_{field}"
         norm_key = f"k_vecnorm_{field}"
         exists_key = f"k_vecexists_{field}"
-        self.device_arrays()  # ensure the base staging dict exists
+        dev = self.device_arrays()  # ensure the base staging dict exists
         import jax.numpy as jnp
 
         from elasticsearch_tpu.ops import pallas_knn as pkn
 
-        if emb_key not in self._device:
-            d_pad = pkn.pad_dims(col.dims)
-            emb = np.zeros((self.nd_pad, d_pad), np.float32)
-            emb[:, : col.dims] = col.vectors
-            exists1 = np.zeros(self.nd_pad + 1, bool)
-            exists1[: self.nd_pad] = col.exists
-            # publish atomically-enough (dict.update under the GIL): a
-            # concurrent reader must never see emb without its mask
-            self._device.update({
-                emb_key: jnp.asarray(emb, jnp.bfloat16),
-                exists_key: jnp.asarray(exists1),
-            })
-        if metric == "cosine" and norm_key not in self._device:
+        if emb_key not in dev:
+            with self._device_stage_lock:
+                if emb_key not in dev:  # racing cold stager built it
+                    self._stage_vector_arrays(dev, col, emb_key,
+                                              exists_key)
+        if metric == "cosine" and norm_key not in dev:
             # only cosine reads the inverse-norm column — a dot_product
             # field skips the norm pass and the staged bytes entirely
-            inv = pkn.vector_scale_column(col.vectors, "cosine")[:, 0]
-            self._device[norm_key] = jnp.asarray(inv)
-        d_pad = int(self._device[emb_key].shape[1])
+            with self._device_stage_lock:
+                if norm_key not in dev:
+                    inv = pkn.vector_scale_column(
+                        col.vectors, "cosine")[:, 0]
+                    dev[norm_key] = jnp.asarray(inv)
+                    self._account(KIND_SCALE_NORM, norm_key,
+                                  int(inv.nbytes))
+        d_pad = int(dev[emb_key].shape[1])
         return emb_key, norm_key, exists_key, d_pad
+
+    def _stage_vector_arrays(self, dev: dict, col, emb_key: str,
+                             exists_key: str) -> None:
+        """Cold-build a dense_vector field's embedding + exists arrays
+        (called under _device_stage_lock — see its init comment)."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.ops import pallas_knn as pkn
+
+        t0 = _time.monotonic()
+        d_pad = pkn.pad_dims(col.dims)
+        # a MANDATORY staging (the host kNN rung reads it): the
+        # reservation may LRU-evict colder scopes but a denial never
+        # blocks it — correctness over budget (docs/OBSERVABILITY.md)
+        memory_accountant().try_reserve(
+            self.owner_index or "_unassigned",
+            self.nd_pad * d_pad * 2, exclude_scope=self.ledger_scope,
+            mandatory=True)
+        emb = np.zeros((self.nd_pad, d_pad), np.float32)
+        emb[:, : col.dims] = col.vectors
+        exists1 = np.zeros(self.nd_pad + 1, bool)
+        exists1[: self.nd_pad] = col.exists
+        # publish atomically-enough (dict.update under the GIL): a
+        # concurrent reader must never see emb without its mask
+        dev.update({
+            emb_key: jnp.asarray(emb, jnp.bfloat16),
+            exists_key: jnp.asarray(exists1),
+        })
+        dur = (_time.monotonic() - t0) * 1000.0
+        self._account(KIND_EMBEDDINGS, emb_key,
+                      int(dev[emb_key].nbytes), duration_ms=dur)
+        self._account(KIND_LIVE_MASK, exists_key, exists1.nbytes,
+                      duration_ms=dur)
 
     def device_column(self, key: str, build) -> Any:
         """Cached device staging for a doc-value array (build() -> np array)."""
-        if key not in self.dev_cache:
-            import jax.numpy as jnp
+        cache = self.dev_cache  # eviction rebinds; serve from our capture
+        if key not in cache:
+            with self._device_stage_lock:
+                if key in cache:  # racing cold stager built it
+                    return cache[key]
+                import time as _time
 
-            self.dev_cache[key] = jnp.asarray(build())
-        return self.dev_cache[key]
+                import jax.numpy as jnp
+
+                t0 = _time.monotonic()
+                cache[key] = jnp.asarray(build())
+                try:
+                    nbytes = int(cache[key].nbytes)
+                except (TypeError, AttributeError):
+                    nbytes = 0  # non-array cache values (slice masks etc.)
+                if nbytes:
+                    self._account(KIND_DOC_VALUES, f"col:{key}", nbytes,
+                                  duration_ms=(_time.monotonic() - t0)
+                                  * 1000.0)
+        return cache[key]
+
+    def release_device_staging(self) -> None:
+        """Drop every cached device staging (HBM eviction / segment
+        retirement): the arrays lazily restage on next use, so this is
+        always safe — in-flight queries keep their captured references
+        alive through normal refcounting. Returns the ledger for this
+        segment to zero.
+
+        Runs as the accountant's eviction callback WITH the accountant
+        lock held, so it must not take _live_t_lock (kernel_live_t_for
+        takes the locks in the opposite order); plain rebinds are atomic
+        under the GIL and concurrent stagers hold their own reference."""
+        self._device = None
+        self.dev_cache = {}
+        # search_stats sums this attribute for postings_bytes_staged
+        self.kernel_postings_bytes = 0
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        memory_accountant().release_scope(
+            self.owner_index or "_unassigned", self.ledger_scope)
+        for nctx in self.nested.values():
+            nctx.segment.release_device_staging()
 
     def release_breaker_charges(self) -> None:
         """The segment is being dropped (merge replaced it / shard close):

@@ -153,7 +153,7 @@ class IndexShard:
         self.engine = Engine(
             f"{index_name}[{shard_id}]", mapper_service, translog, store,
             segment_prefix=f"{index_name}_{shard_id}_seg",
-            index_sort=index_sort,
+            index_sort=index_sort, index_name=index_name,
         )
         self.searcher = ShardSearcher(
             shard_id, self.engine, mapper_service,

@@ -138,6 +138,14 @@ class Node:
 
         # hierarchical memory circuit breakers (indices.breaker.*)
         self.breaker_service = configure_breaker_service(settings)
+        # device-memory accountant budget (search.memory.hbm_budget_bytes,
+        # ISSUE 9): the exact HBM staging ledger is wired in as the real
+        # "accounting" breaker child; over budget, stagings LRU-evict then
+        # demote to the host rung (never 429/5xx) — docs/OBSERVABILITY.md
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        memory_accountant().set_budget(
+            settings.get_bytes("search.memory.hbm_budget_bytes", 0))
         self.indices: Dict[str, IndexService] = {}
         self.ingest = IngestService(self)
         self.tasks = TaskManager(self.node_id)
@@ -1491,10 +1499,18 @@ class Node:
         # node-level search section (ISSUE 8, docs/OBSERVABILITY.md):
         # per-index search blocks — phase histograms, plane/ladder
         # counters, quarantine events, batching — merged into one view
+        from elasticsearch_tpu.common.memory import memory_accountant
         from elasticsearch_tpu.search.telemetry import merge_phase_stats
+        from elasticsearch_tpu.transport.local import (
+            aggregate_transport_stats,
+        )
 
         search = merge_phase_stats(
             [svc.search_stats() for svc in self.indices.values()])
+        # the device-memory ledger is a NODE resource: report the
+        # node-wide view instead of summed per-index blocks (summing
+        # restage_amplification ratios would be meaningless)
+        search["memory"] = memory_accountant().stats(None)
         return {
             "cluster_name": self.cluster_service.state.cluster_name,
             "nodes": {
@@ -1512,6 +1528,12 @@ class Node:
                         self.data_path if self.persistent_path else "."),
                     "thread_pool": self.thread_pool.stats(),
                     "breakers": self.breaker_service.stats(),
+                    # PR-2 transport resilience counters (RetryPolicy
+                    # retries/backoff waits, send timeouts,
+                    # ConnectionHealth fast-fails), aggregated across
+                    # every in-process TransportService — they existed
+                    # but were never exported (docs/RESILIENCE.md)
+                    "transport": aggregate_transport_stats(),
                 }
             },
         }
@@ -1622,6 +1644,19 @@ class Node:
             value = setting.get(committed) if explicit else None
             for svc in self.indices.values():
                 setattr(svc, attr, value)
+        # HBM budget (search.memory.hbm_budget_bytes): the accountant is
+        # a process resource — an explicit cluster-level value wins, and
+        # clearing it reverts to the node-file setting; lowering the
+        # budget LRU-evicts immediately (set_budget → enforce_budget)
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        budget_key = "search.memory.hbm_budget_bytes"
+        if committed.get(budget_key) is not None:
+            memory_accountant().set_budget(
+                committed.get_bytes(budget_key, 0))
+        else:
+            memory_accountant().set_budget(
+                self.settings.get_bytes(budget_key, 0))
         return {
             "acknowledged": True,
             "persistent": state.persistent_settings.as_nested_dict(),

@@ -27,6 +27,7 @@ action/search/AbstractSearchAsyncAction.java + SearchTransportService
 from __future__ import annotations
 
 import functools
+import itertools
 import logging
 import threading
 import time as _time
@@ -776,10 +777,32 @@ class IndexMeshSearch:
                 "index.search.plane_quarantine.cooldown", 60.0)
         # plane-health quarantine (index.search.plane_quarantine.cooldown)
         self.plane_health = PlaneHealth(quarantine_cooldown)
+        # set by _ensure_staged when the HBM budget (not an infra gap)
+        # turned the mesh staging away — exported as the ladder
+        # decision reason so operators can tell demotion from fault.
+        # THREAD-local: concurrent queries each read the reason their
+        # own _ensure_staged call produced (a shared field would let one
+        # thread's reset misattribute another's hbm_budget decision)
+        self._denied = threading.local()
         # counter updates must be atomic: concurrent batch leaders /
         # serial queries increment from different threads (ISSUE 8
         # stats-consistency contract — docs/OBSERVABILITY.md)
         self._counter_lock = threading.Lock()
+        # serializes the executor build/swap in _ensure_staged: two
+        # concurrent first-queries must not both construct a generation
+        # (the loser's staged bytes would leak in the ledger until index
+        # close). _drop_staging deliberately does NOT take this lock —
+        # the accountant invokes it under its own lock and a stager
+        # inside this lock may be waiting on the accountant's.
+        self._stage_lock = threading.Lock()
+
+    @property
+    def staging_denied_reason(self):
+        return getattr(self._denied, "reason", None)
+
+    @staging_denied_reason.setter
+    def staging_denied_reason(self, value) -> None:
+        self._denied.reason = value
 
     def _note(self, plane: str, reason: str, n: int = 1) -> None:
         """Plane-ladder decision counter (search.phases.decisions).
@@ -805,7 +828,38 @@ class IndexMeshSearch:
                     pairs.append((sid, seg))
         return pairs
 
+    def _drop_staging(self) -> None:
+        """HBM-budget eviction callback: drop the staged mesh plane (it
+        restages on the next eligible query — or demotes to the host
+        rung if the budget still can't fit it)."""
+        executor, self._executor = self._executor, None
+        self._staged_key = None
+        if executor is not None:
+            self._evicted_since = True
+            executor.release()
+
+    def _restage_reason(self, old_key, new_key, old_executor,
+                        n_slots_needed: int) -> str:
+        """Classify WHY the mesh plane restages (the staging lifecycle
+        event reason, docs/OBSERVABILITY.md): a slot-geometry change,
+        a segment-set change (refresh/merge), an in-place live-mask
+        invalidation (deletes), or a re-stage after a budget eviction
+        (probe — each executor generation is a fresh ledger scope, so
+        the accountant cannot infer this one itself)."""
+        if old_key is None or old_executor is None:
+            if getattr(self, "_evicted_since", False):
+                self._evicted_since = False
+                return "probe"
+            return "initial"
+        if old_executor.n_slots != n_slots_needed:
+            return "geometry_change"
+        if ({(sid, seg_id) for sid, seg_id, _n in old_key}
+                != {(sid, seg_id) for sid, seg_id, _n in new_key}):
+            return "refresh"
+        return "delete_invalidation"
+
     def _ensure_staged(self) -> bool:
+        self.staging_denied_reason = None
         pairs = self._current_pairs()
         if not pairs:
             return False
@@ -815,16 +869,64 @@ class IndexMeshSearch:
         # live_doc_count participates: deletes mutate a sealed segment's
         # live mask in place, which must invalidate the staged live1
         key = tuple((sid, id(seg), seg.live_doc_count) for sid, seg in pairs)
-        if key != self._staged_key:
-            settings = getattr(self.svc, "settings", None)
-            codec = (settings.get_str(
-                "index.search.pallas.postings_codec", "default")
-                if settings is not None else None)
-            self._executor = MeshPlanExecutor([seg for _, seg in pairs],
-                                              mesh, postings_codec=codec)
-            self._pairs = pairs
-            self._staged_key = key
-        return True
+        # the "or executor is None" leg self-heals any state where the
+        # staged key survived but the executor didn't (an eviction
+        # racing an install): the next query restages instead of being
+        # stuck demoted until the segment set changes
+        if key != self._staged_key or self._executor is None:
+            with self._stage_lock:
+                executor = self._executor
+                if key == self._staged_key and executor is not None:
+                    # another query staged this exact segment set while
+                    # we waited — reuse its generation
+                    executor.touch()
+                    return True
+                from elasticsearch_tpu.common.memory import \
+                    memory_accountant
+
+                n_dev = mesh.devices.size
+                n_slots = max(1, -(-len(pairs) // n_dev)) * n_dev
+                # HBM budget gate (search.memory.hbm_budget_bytes): the
+                # gate uses a cheap per-slot estimate — the ledger
+                # records the EXACT bytes once staged. Denial demotes
+                # this query (and every one until the budget frees) to
+                # the host rung with ladder decision reason hbm_budget
+                # — degrade, never 5xx.
+                estimate = n_slots * max(
+                    seg.block_docs.nbytes + seg.block_tfs.nbytes
+                    + seg.norms.nbytes + seg.nd_pad + 1
+                    for _sid, seg in pairs)
+                if not memory_accountant().try_reserve(self.svc.name,
+                                                       estimate):
+                    self.staging_denied_reason = "hbm_budget"
+                    return False
+                settings = getattr(self.svc, "settings", None)
+                codec = (settings.get_str(
+                    "index.search.pallas.postings_codec", "default")
+                    if settings is not None else None)
+                reason = self._restage_reason(self._staged_key, key,
+                                              self._executor, n_slots)
+                old = self._executor
+                # construct UNARMED (not yet evictable), install, THEN
+                # arm: a budget eviction firing mid-construction would
+                # otherwise run _drop_staging against the PREVIOUS
+                # generation and the install below would pin a staged
+                # key whose executor is gone (see make_evictable)
+                staged = MeshPlanExecutor(
+                    [seg for _, seg in pairs], mesh, postings_codec=codec,
+                    index_name=self.svc.name, stage_reason=reason)
+                staged.pairs = pairs
+                if old is not None:
+                    old.release()
+                self._pairs = pairs
+                self._executor = staged
+                self._staged_key = key
+                staged.make_evictable(self._drop_staging)
+        else:
+            executor = self._executor
+            if executor is not None:
+                executor.touch()
+        return self._executor is not None
 
     @staticmethod
     def _needs_counts(q) -> bool:
@@ -968,11 +1070,17 @@ class IndexMeshSearch:
             deadline.checkpoint()
         t_stage = bt.start("staging")
         if not self._ensure_staged():
+            self._note("host", self.staging_denied_reason
+                       or "knn_staging_unavailable", len(specs))
+            return None
+        executor = self._executor
+        if executor is None:
             self._note("host", "knn_staging_unavailable", len(specs))
             return None
-        session = self._executor.ensure_knn(field, ft.dims, ft.similarity)
+        session = executor.ensure_knn(field, ft.dims, ft.similarity)
         if session is None:
-            self._note("host", "knn_staging_unavailable", len(specs))
+            self._note("host", executor.kernel_denied_reason
+                       or "knn_staging_unavailable", len(specs))
             return None
         q_batch = len(specs)
         q_pad = next_pow2(q_batch)
@@ -995,7 +1103,7 @@ class IndexMeshSearch:
         try:
             on_plane_execute(self.svc.name, "mesh_pallas")
             run = _mesh_knn_program(
-                self._executor.mesh, self._executor.slots_per_dev,
+                executor.mesh, executor.slots_per_dev,
                 q_pad, kk, g.tile_sub, d_pad, nd_knn,
                 session["mode"] == "interpret")
             args = (session["emb"], session["scale"], session["mask"],
@@ -1036,7 +1144,7 @@ class IndexMeshSearch:
                    q_batch)
         # the whole batch streams each slot's bf16 embedding matrix once
         launch_adds = {"embedding_bytes_streamed":
-                       self._executor.n_slots * nd_knn * d_pad * 2}
+                       executor.n_slots * nd_knn * d_pad * 2}
         t_merge = bt.start("merge")
         results = []
         for q in range(q_batch):
@@ -1049,7 +1157,7 @@ class IndexMeshSearch:
                                     docs[q][: ks[q]]):
                 if key == -np.inf or d < 0:
                     continue
-                sid, seg = self._pairs[int(slot)]
+                sid, seg = executor.pairs[int(slot)]
                 score = float(key)
                 refs.append(DocRef(sid, seg.name, int(d), score, ()))
                 if max_score is None:
@@ -1070,7 +1178,7 @@ class IndexMeshSearch:
                     tr.annotate(key, int(v))
         return results
 
-    def _sort_plan(self, body: dict):
+    def _sort_plan(self, body: dict, executor: "MeshPlanExecutor"):
         """Resolve the request's sort to staged mesh key columns.
 
         Returns (sort_keys, sort_spec) — sort_keys None for relevance —
@@ -1096,13 +1204,13 @@ class IndexMeshSearch:
             # the fill participates in the f32 rank key like any value
             if float(np.float32(missing)) != float(missing):
                 return "fallback", None
-        keys = self._executor.ensure_sort_column(field, order, missing)
+        keys = executor.ensure_sort_column(field, order, missing)
         if keys is None:
             return "fallback", None
         return keys, sort_spec
 
     def _search_after_key(self, search_after, sort_spec,
-                          sort_keys) -> Optional[float]:
+                          sort_keys, executor) -> Optional[float]:
         """Map the request's search_after cursor to the oriented-key
         space of the staged rank column (strictly-after == key < value),
         or None when the cursor can't cut exactly on the mesh."""
@@ -1124,7 +1232,7 @@ class IndexMeshSearch:
                 return None  # f32 rounding could move the boundary
             return v
         _field, order, missing = sort_spec[0]
-        meta = self._executor.sort_meta.get(sort_keys[0]) or {}
+        meta = executor.sort_meta.get(sort_keys[0]) or {}
         vocab = meta.get("vocab")
         if vocab is not None:
             if after is None:
@@ -1204,6 +1312,11 @@ class IndexMeshSearch:
         if deadline is not None:
             deadline.checkpoint()
         if not self._ensure_staged():
+            self._note("host", self.staging_denied_reason
+                       or "staging_unavailable")
+            return None
+        executor = self._executor
+        if executor is None:
             self._note("host", "staging_unavailable")
             return None
         if deadline is not None:
@@ -1238,7 +1351,7 @@ class IndexMeshSearch:
                         "pruned": r.get("pruned")}
         t_parse = tracer.start("parse_rewrite")
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
-        sort_keys, sort_spec = self._sort_plan(body)
+        sort_keys, sort_spec = self._sort_plan(body, executor)
         if sort_keys == "fallback":
             self._note("host", "sort_ineligible")
             return None
@@ -1260,8 +1373,8 @@ class IndexMeshSearch:
                     or "id" not in slice_spec or "max" not in slice_spec):
                 return None  # host path owns the error shape
             try:
-                slice_col = self._executor.ensure_slice_column(
-                    slice_spec, [sid for sid, _seg in self._pairs],
+                slice_col = executor.ensure_slice_column(
+                    slice_spec, [sid for sid, _seg in executor.pairs],
                     len(self.svc.shards))
             except Exception:  # noqa: BLE001 — host path owns errors
                 return None
@@ -1270,7 +1383,7 @@ class IndexMeshSearch:
         search_after = body.get("search_after")
         if search_after is not None:
             after_key = self._search_after_key(search_after, sort_spec,
-                                               sort_keys)
+                                               sort_keys, executor)
             if after_key is None:
                 self._note("host", "feature_ineligible")
                 return None
@@ -1311,7 +1424,13 @@ class IndexMeshSearch:
         kernel_session = None
         if self.plane_pref in ("auto", "pallas"):
             if self.plane_health.available("mesh_pallas"):
-                kernel_session = self._executor.ensure_kernel()
+                kernel_session = executor.ensure_kernel()
+                if (kernel_session is None
+                        and executor.kernel_denied_reason):
+                    # HBM budget turned the kernel staging away: the
+                    # ladder's next rung serves (docs/OBSERVABILITY.md)
+                    self._note("mesh_pallas",
+                               executor.kernel_denied_reason)
             else:
                 self._note("mesh_pallas", "quarantined")
         attempts = []
@@ -1335,7 +1454,7 @@ class IndexMeshSearch:
                 pf_plans = [] if pf_qb is not None else None
                 rs_plans = [] if rs_qb is not None else None
                 ctxs = {}
-                for sid, seg in self._pairs:
+                for sid, seg in executor.pairs:
                     shard = self.svc.shards[sid]
                     ctx = ShardQueryContext(shard.mapper_service,
                                             engine=shard.engine)
@@ -1355,10 +1474,10 @@ class IndexMeshSearch:
                         rs_plans.append(rs_qb.to_plan(ctx, seg))
                 used_pallas = False
                 if session is not None:
-                    used_pallas = self._executor.harmonize_kernel_nodes(
+                    used_pallas = executor.harmonize_kernel_nodes(
                         plans) > 0
                 tracer.stop("plan_build", t_plan)
-                outs = self._executor.execute(
+                outs = executor.execute(
                     plans, k, sort_keys=sort_keys,
                     with_views=bool(agg_specs), pf_plans=pf_plans,
                     rs_plans=rs_plans, scalars=scalars,
@@ -1399,7 +1518,7 @@ class IndexMeshSearch:
             ta = int(terminate_after)
             counts = np.asarray(seg_counts)
             by_shard: Dict[int, int] = {}
-            for i, (sid, _seg) in enumerate(self._pairs):
+            for i, (sid, _seg) in enumerate(executor.pairs):
                 by_shard[sid] = by_shard.get(sid, 0) + int(counts[i])
             total = sum(min(c, ta) for c in by_shard.values())
             terminated_early = any(c >= ta for c in by_shard.values())
@@ -1414,7 +1533,7 @@ class IndexMeshSearch:
             self.svc.shards[sid].searcher.note_query(body.get("stats"))
         vocab = None
         if sort_keys is not None:
-            vocab = (self._executor.sort_meta.get(sort_keys[0])
+            vocab = (executor.sort_meta.get(sort_keys[0])
                      or {}).get("vocab")
         refs = []
         max_score = None
@@ -1422,7 +1541,7 @@ class IndexMeshSearch:
                                                np.asarray(docs))):
             if key == -np.inf:
                 continue
-            sid, seg = self._pairs[int(slot)]
+            sid, seg = executor.pairs[int(slot)]
             score = float(scores[i])
             if sort_keys is None:
                 sv = (score,) if rescore_static is not None else ()
@@ -1451,7 +1570,7 @@ class IndexMeshSearch:
             matched_np = np.asarray(outs[7])
             scores_np = np.asarray(outs[8])
             views = []
-            for i, (sid, seg) in enumerate(self._pairs):
+            for i, (sid, seg) in enumerate(executor.pairs):
                 nd1 = seg.nd_pad + 1
                 views.append(SegmentView(
                     seg, matched_np[i, :nd1], ctxs[sid],
@@ -1530,12 +1649,18 @@ class IndexMeshSearch:
                                    for t in (tracers or [])) else NULL_TRACER)
         t_stage0 = bt.start("staging")
         if not self._ensure_staged():
+            self._note("host", self.staging_denied_reason
+                       or "staging_unavailable", len(bodies))
+            return None
+        executor = self._executor
+        if executor is None:
             self._note("host", "staging_unavailable", len(bodies))
             return None
-        session = self._executor.ensure_kernel()
+        session = executor.ensure_kernel()
         bt.stop("staging", t_stage0)
         if session is None:
-            self._note("host", "staging_unavailable", len(bodies))
+            self._note("host", executor.kernel_denied_reason
+                       or "staging_unavailable", len(bodies))
             return None
         q_batch = len(bodies)
         ks = []
@@ -1553,7 +1678,7 @@ class IndexMeshSearch:
         kk = next_pow2(max(ks))
         q_pad = next_pow2(q_batch)
         geom = session["geom"]
-        n_pairs = len(self._pairs)
+        n_pairs = len(executor.pairs)
         # per-member, per-slot kernel lane sets via the same deferred
         # plan builder the serial mesh path uses — the plan must be
         # EXACTLY one kernel-scored disjunction (no wrapper nodes).
@@ -1566,7 +1691,7 @@ class IndexMeshSearch:
             lane_sets = [[None] * q_batch for _ in range(n_pairs)]
             for q, body in enumerate(bodies):
                 qb = parse_query(body.get("query"))
-                for slot, (sid, seg) in enumerate(self._pairs):
+                for slot, (sid, seg) in enumerate(executor.pairs):
                     shard = self.svc.shards[sid]
                     ctx = ShardQueryContext(shard.mapper_service,
                                             engine=shard.engine)
@@ -1639,7 +1764,7 @@ class IndexMeshSearch:
                     geom.nd_pad, sub)
                 try:
                     tables = []
-                    for slot, (sid, seg) in enumerate(self._pairs):
+                    for slot, (sid, seg) in enumerate(executor.pairs):
                         bmin, bmax = session["meta"][id(seg)][:2]
                         tables.append(psc.build_tile_tables_batched(
                             lane_sets[slot], bmin, bmax, g, t_pad=t_pad))
@@ -1650,8 +1775,8 @@ class IndexMeshSearch:
                     sub //= 2
             cb = max(t[3] for t in tables)
             live_key = ("k_live_t" if g.tile_sub == geom.tile_sub
-                        else self._executor.ensure_kernel_live(g.tile_sub))
-            n_slots = self._executor.n_slots
+                        else executor.ensure_kernel_live(g.tile_sub))
+            n_slots = executor.n_slots
             n_tiles = tables[0][0].shape[0]
             rl = np.zeros((n_slots, n_tiles, t_pad), np.int32)
             rh = np.zeros((n_slots, n_tiles, t_pad), np.int32)
@@ -1663,8 +1788,8 @@ class IndexMeshSearch:
             # filler slots/queries keep zero tables/weights: their live
             # masks are all-dead and zero weights score nothing
             tps = psc.tiles_per_step_default()
-            sharding = self._executor._sharding
-            staged = self._executor._seg_staged
+            sharding = executor._sharding
+            staged = executor._seg_staged
             corpus = ((staged["k_packed"],) if codec == "packed"
                       else (staged["k_docs"], staged["k_frac"]))
             plans_p = None
@@ -1674,9 +1799,9 @@ class IndexMeshSearch:
                 # exchange itself stays on-device in the program
                 plans_p = []
                 for slot in range(n_pairs):
-                    seg = self._pairs[slot][1]
+                    seg = executor.pairs[slot][1]
                     bfmax = session["meta"][id(seg)][2]
-                    ub = self._executor.tile_lane_ub_cached(
+                    ub = executor.tile_lane_ub_cached(
                         seg, unions[slot], rl[slot], rh[slot], bfmax,
                         g.tile_sub)
                     plan = psc.plan_pruned_tiles(
@@ -1705,7 +1830,7 @@ class IndexMeshSearch:
                     tid_r[slot] = plan["tid_rest"]
                     bounds_r[slot] = plan["bounds_rest"]
                 run = _mesh_batched_pruned_program(
-                    self._executor.mesh, self._executor.slots_per_dev,
+                    executor.mesh, executor.slots_per_dev,
                     q_pad, kk, t_pad, cb, g.tile_sub, tps,
                     session["mode"] == "interpret", codec, probe, n_rest)
                 slot_real = np.zeros(n_slots, np.int32)
@@ -1752,7 +1877,7 @@ class IndexMeshSearch:
                 }
             else:
                 run = _mesh_batched_kernel_program(
-                    self._executor.mesh, self._executor.slots_per_dev,
+                    executor.mesh, executor.slots_per_dev,
                     q_pad, kk, t_pad, cb, g.tile_sub, tps,
                     session["mode"] == "interpret", codec)
                 args = corpus + (staged[live_key],
@@ -1824,7 +1949,7 @@ class IndexMeshSearch:
                                     docs[q][: ks[q]]):
                 if key == -np.inf or d < 0:
                     continue
-                sid, seg = self._pairs[int(slot)]
+                sid, seg = executor.pairs[int(slot)]
                 score = float(key)
                 refs.append(DocRef(sid, seg.name, int(d), score, ()))
                 if max_score is None:
@@ -1868,14 +1993,37 @@ class MeshPlanExecutor:
     segments per shard) stays on the mesh plane instead of silently
     falling back to the host path."""
 
+    _SCOPE_SEQ = itertools.count(1)
+
     def __init__(self, segments: List, mesh: Optional[Mesh] = None,
-                 postings_codec: Optional[str] = None):
+                 postings_codec: Optional[str] = None,
+                 index_name: Optional[str] = None,
+                 stage_reason: str = "initial"):
         from elasticsearch_tpu.parallel.distributed import stack_shard_arrays
         from elasticsearch_tpu.parallel.mesh import shard_mesh
 
         self.mesh = mesh or shard_mesh()
         self.n_dev = self.mesh.devices.size
         self.segments = segments
+        # device-memory accountant identity (ISSUE 9): one LRU scope per
+        # executor generation; every rebuild is a fresh scope so the old
+        # generation's release is exact (next() is atomic — concurrent
+        # first-queries must never share a scope id)
+        self.index_name = index_name or "_unassigned"
+        self.scope = f"mesh#{next(self._SCOPE_SEQ)}"
+        # (shard_id, segment) per slot — owned by THIS generation so a
+        # query that pinned an executor never reads a concurrently
+        # restaged pair list (IndexMeshSearch._ensure_staged overwrites
+        # with the real shard ids; the positional default serves direct
+        # constructions in tests/bench)
+        self.pairs: List[Tuple[int, object]] = list(enumerate(segments))
+        # armed by the owner via make_evictable AFTER install — a
+        # generation under construction is deliberately not evictable
+        self._evict_cb = None
+        # why this generation staged (initial / refresh /
+        # delete_invalidation / geometry_change) — every table this
+        # executor stages inherits it
+        self._stage_reason = stage_reason
         # postings codec preference for the kernel-plane staging
         # (index.search.pallas.postings_codec; resolved against the doc
         # space at ensure_kernel time — docs/PRUNING.md)
@@ -1885,6 +2033,7 @@ class MeshPlanExecutor:
         self.postings_codec = "raw"
         self.slots_per_dev = max(1, -(-len(segments) // self.n_dev))
         self.n_slots = self.slots_per_dev * self.n_dev
+        t0 = _time.monotonic()
         stacked = stack_shard_arrays(segments, self.n_slots)
         self.nd_pad = stacked.pop("nd_pad")
         self.nd1 = self.nd_pad + 1
@@ -1894,6 +2043,9 @@ class MeshPlanExecutor:
             for name, arr in stacked.items()
         }
         self._sharding = sharding
+        self._account("mesh_slot_tables", "seg_stacked",
+                      sum(int(a.nbytes) for a in stacked.values()),
+                      duration_ms=(_time.monotonic() - t0) * 1000.0)
         # per staged sort column: {"vocab": [terms]|None} — keyword sorts
         # rank by GLOBAL ordinals built over the staged segment set and
         # the caller maps ordinals back to terms for the response
@@ -1901,6 +2053,11 @@ class MeshPlanExecutor:
         # lazily-staged tile-kernel plane (ensure_kernel): False =
         # unavailable, dict = {geom, meta: {id(seg): (bmin, bmax)}, mode}
         self._kernel = None
+        # set when the HBM budget (not a fault) turned a staging away —
+        # the ladder reports the demotion as decision reason hbm_budget.
+        # Thread-local: each query reads the reason from ITS ensure_*
+        # call, not a concurrent thread's reset
+        self._denied = threading.local()
         # lazily-staged kNN plane per dense_vector field (ensure_knn):
         # field -> False | {emb, scale, mask, d_pad, nd_pad, metric}
         self._knn: Dict[str, object] = {}
@@ -1909,6 +2066,55 @@ class MeshPlanExecutor:
         # traffic the same hot terms recompute identical columns);
         # lifetime bounded by this executor (rebuilt on segment change)
         self._ub_cache: Dict[tuple, np.ndarray] = {}
+
+    # ------------------------------------------------------------------
+    # Device-memory accounting (ISSUE 9, docs/OBSERVABILITY.md)
+    # ------------------------------------------------------------------
+
+    @property
+    def kernel_denied_reason(self):
+        return getattr(self._denied, "reason", None)
+
+    @kernel_denied_reason.setter
+    def kernel_denied_reason(self, value) -> None:
+        self._denied.reason = value
+
+    def make_evictable(self, evict) -> None:
+        """Arm the HBM-budget eviction callback — called by the owner
+        AFTER this generation is installed as current. Arming during
+        construction would let another thread's budget reservation evict
+        this scope while the owner's executor pointer still names the
+        PREVIOUS generation: the callback would drop and release the
+        wrong one, and the owner's subsequent install would pin a staged
+        key with no executor behind it (permanent host demotion)."""
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        self._evict_cb = evict
+        memory_accountant().set_evict(self.index_name, self.scope, evict)
+
+    def _account(self, kind: str, table: str, nbytes: int,
+                 reason: Optional[str] = None, duration_ms: float = 0.0,
+                 quiet: bool = False) -> None:
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        memory_accountant().register(
+            self.index_name, self.scope, kind, table, int(nbytes),
+            reason=reason or self._stage_reason, duration_ms=duration_ms,
+            plane="mesh", evict=self._evict_cb, quiet=quiet)
+
+    def release(self) -> int:
+        """This executor generation is being replaced/dropped: return
+        its staged bytes to the ledger. The arrays themselves free when
+        the last in-flight query drops its references (refcounting)."""
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        return memory_accountant().release_scope(self.index_name,
+                                                 self.scope)
+
+    def touch(self) -> None:
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        memory_accountant().touch(self.index_name, self.scope)
 
     # ------------------------------------------------------------------
     # Tile-kernel plane staging (the unified fast plane)
@@ -1925,14 +2131,21 @@ class MeshPlanExecutor:
         off / non-TPU backend without interpret mode)."""
         from elasticsearch_tpu.ops.aggs import _pallas_mode
 
+        # reset FIRST — before every early return: a thread whose last
+        # call was a budget denial must not keep reporting hbm_budget
+        # for what is now a mode gap or staging fault (the reason is
+        # thread-local, so only its own reset clears it)
+        self.kernel_denied_reason = None
         mode = _pallas_mode()
         if not mode:
             return None
         if self._kernel is False:
             return None
+        from elasticsearch_tpu.common.memory import memory_accountant
         from elasticsearch_tpu.ops import pallas_scoring as psc
 
         if self._kernel is None:
+            t0 = _time.monotonic()
             try:
                 geom = psc.tile_geometry(max(self.nd_pad, psc.LANE))
                 # codec resolution against the STACKED doc space: every
@@ -1941,6 +2154,21 @@ class MeshPlanExecutor:
                     self.postings_codec_pref, geom.nd_pad)
                 n_rows = max(s.block_docs.shape[0] for s in self.segments) \
                     + psc.CB_MAX
+                # HBM budget gate: the kernel tables are the big mesh
+                # allocation — over budget (after LRU eviction) the
+                # ladder serves from the scatter mesh / host rung with
+                # decision reason hbm_budget; _kernel stays None so a
+                # freed budget lets a later query stage them
+                # packed: one i32 word/posting; raw: i32 docs + f32 frac
+                word = 4 if codec == "packed" else 8
+                estimate = (self.n_slots * n_rows * psc.LANE * word
+                            + self.n_slots * geom.n_tiles * psc.LANE
+                            * geom.tile_sub * 4)
+                if not memory_accountant().try_reserve(
+                        self.index_name, estimate,
+                        exclude_scope=self.scope):
+                    self.kernel_denied_reason = "hbm_budget"
+                    return None
                 if codec == "packed":
                     packed = np.zeros((self.n_slots, n_rows, psc.LANE),
                                       np.int32)
@@ -1993,6 +2221,17 @@ class MeshPlanExecutor:
                 self.postings_codec = codec
                 self._kernel = {"geom": geom, "meta": meta,
                                 "codec": codec}
+                dur = (_time.monotonic() - t0) * 1000.0
+                self._account("postings_packed" if codec == "packed"
+                              else "postings_raw", "k_postings",
+                              self.postings_bytes_staged,
+                              duration_ms=dur)
+                self._account("live_mask", "k_live_t",
+                              int(live_t.nbytes), duration_ms=dur)
+                # per-segment block min/max/frac-max bound columns stay
+                # host-resident but scale with the staged plane
+                self._account("bound_tables", "k_bounds", sum(
+                    int(b.nbytes) for t in meta.values() for b in t))
             except Exception:  # noqa: BLE001 — plane stays scatter
                 self._kernel = False
                 return None
@@ -2012,6 +2251,10 @@ class MeshPlanExecutor:
         the kernel can't run here."""
         from elasticsearch_tpu.ops.aggs import _pallas_mode
 
+        # reset FIRST — before every early return (same contract as
+        # ensure_kernel: a stale thread-local hbm_budget must not
+        # relabel a mode gap or staging fault)
+        self.kernel_denied_reason = None
         mode = _pallas_mode()
         if not mode:
             return None
@@ -2019,14 +2262,27 @@ class MeshPlanExecutor:
         if entry is False:
             return None
         if entry is None:
+            t0 = _time.monotonic()
             try:
                 import ml_dtypes
 
+                from elasticsearch_tpu.common.memory import (
+                    memory_accountant,
+                )
                 from elasticsearch_tpu.ops import pallas_knn as pkn
                 from elasticsearch_tpu.ops import pallas_scoring as psc
 
                 d_pad = pkn.pad_dims(dims)
                 nd_knn = max(self.nd_pad, psc.LANE)
+                # HBM budget gate (same demotion contract as
+                # ensure_kernel): over budget the kNN batch serves from
+                # the host plan-node rung, reason hbm_budget
+                estimate = self.n_slots * nd_knn * (d_pad * 2 + 8)
+                if not memory_accountant().try_reserve(
+                        self.index_name, estimate,
+                        exclude_scope=self.scope):
+                    self.kernel_denied_reason = "hbm_budget"
+                    return None
                 emb = np.zeros((self.n_slots, nd_knn, d_pad),
                                ml_dtypes.bfloat16)
                 scale = np.zeros((self.n_slots, nd_knn, 1), np.float32)
@@ -2057,6 +2313,13 @@ class MeshPlanExecutor:
                     "metric": metric,
                 }
                 self._knn[field] = entry
+                dur = (_time.monotonic() - t0) * 1000.0
+                self._account("embeddings", f"knn:{field}",
+                              int(emb.nbytes), duration_ms=dur)
+                self._account("scale_norm", f"knn_scale:{field}",
+                              int(scale.nbytes), duration_ms=dur)
+                self._account("live_mask", f"knn_mask:{field}",
+                              int(mask.nbytes), duration_ms=dur)
             except Exception:  # noqa: BLE001 — plane stays host
                 self._knn[field] = False
                 return None
@@ -2073,6 +2336,7 @@ class MeshPlanExecutor:
 
         n_tiles, t_pad = row_lo.shape
         ub = np.zeros((n_tiles, t_pad), np.float32)
+        grew = False
         for j, lane in enumerate(union_lanes):
             key = (id(seg), sub, lane.block_start, lane.block_count)
             col = self._ub_cache.get(key)
@@ -2082,7 +2346,16 @@ class MeshPlanExecutor:
                 col = psc.tile_lane_ub(row_lo[:, j: j + 1],
                                        row_hi[:, j: j + 1], bfmax)[:, 0]
                 self._ub_cache[key] = col
+                grew = True
             ub[:, j] = col
+        if grew:
+            # accumulator-style ledger entry: re-register the cache's
+            # CURRENT total (quiet — per-lane growth is not a staging
+            # lifecycle event, docs/OBSERVABILITY.md)
+            self._account("bound_tables", "ub_cache",
+                          sum(int(c.nbytes)
+                              for c in self._ub_cache.values()),
+                          quiet=True)
         return ub
 
     def ensure_kernel_live(self, sub: int) -> str:
@@ -2093,6 +2366,7 @@ class MeshPlanExecutor:
 
         key = f"k_live_t_{sub}"
         if key not in self._seg_staged:
+            t0 = _time.monotonic()
             geom = psc.tile_geometry(self._kernel["geom"].nd_pad, sub)
             live_t = np.zeros(
                 (self.n_slots, geom.n_tiles * psc.LANE, geom.tile_sub),
@@ -2102,6 +2376,9 @@ class MeshPlanExecutor:
                 live[: seg.nd_pad] = seg.live.astype(np.float32)
                 live_t[i] = psc.build_live_t(live, geom)
             self._seg_staged[key] = jax.device_put(live_t, self._sharding)
+            self._account("live_mask", key, int(live_t.nbytes),
+                          reason="geometry_change",
+                          duration_ms=(_time.monotonic() - t0) * 1000.0)
         return key
 
     def harmonize_kernel_nodes(self, plans: List[PlanNode]) -> int:
@@ -2228,6 +2505,8 @@ class MeshPlanExecutor:
         self._seg_staged[name] = jax.device_put(keys, self._sharding)
         self._seg_staged[name + ".raw"] = jax.device_put(
             raws, self._sharding)
+        self._account("mesh_slot_tables", name,
+                      int(keys.nbytes + raws.nbytes))
         self.sort_meta[name] = {"vocab": None}
         return name, name + ".raw"
 
@@ -2265,6 +2544,8 @@ class MeshPlanExecutor:
         self._seg_staged[name] = jax.device_put(keys, self._sharding)
         self._seg_staged[name + ".raw"] = jax.device_put(
             raws, self._sharding)
+        self._account("mesh_slot_tables", name,
+                      int(keys.nbytes + raws.nbytes))
         self.sort_meta[name] = {"vocab": vocab}
         return name, name + ".raw"
 
@@ -2303,6 +2584,7 @@ class MeshPlanExecutor:
                 seg.dev_cache[cache_key] = mask
             out[i, : mask.shape[0]] = mask
         self._seg_staged[name] = jax.device_put(out, self._sharding)
+        self._account("mesh_slot_tables", name, int(out.nbytes))
         return name
 
     def execute(self, plans: List[PlanNode], k: int,

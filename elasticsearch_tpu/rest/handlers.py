@@ -254,6 +254,7 @@ def register_all(c) -> None:
     r("GET", "/_cat/nodes", _cat_nodes)
     r("GET", "/_cat/shards", _cat_shards)
     r("GET", "/_cat/shards/{index}", _cat_shards)
+    r("GET", "/_cat/staging", _cat_staging)
     r("GET", "/_cat/count", _cat_count)
     r("GET", "/_cat/count/{index}", _cat_count)
     r("GET", "/_cat/aliases", _cat_aliases)
@@ -1711,12 +1712,18 @@ def _cat_indices(node, req):
         md = state.indices[name]
         svc = node.indices.get(name)
         health = "green" if md.num_replicas == 0 else "yellow"
+        deleted = 0
+        store = 0
+        if svc is not None:
+            for shard in svc.shards.values():
+                for seg in shard.engine.segments:
+                    deleted += seg.num_docs - seg.live_doc_count
+                store += shard.stats()["segments"]["memory_in_bytes"]
         rows.append([
             health, md.state, name, svc.uuid if svc else "-",
             md.num_shards, md.num_replicas,
-            svc.num_docs if svc else 0, 0,
-            f"{(sum(s.stats()['segments']['memory_in_bytes'] for s in svc.shards.values()) if svc else 0)}b",
-            "0b",
+            svc.num_docs if svc else 0, deleted,
+            f"{store}b", f"{store}b",
         ])
     return _cat_table(req, rows, [
         "health", "status", "index", "uuid", "pri", "rep", "docs.count",
@@ -1763,10 +1770,32 @@ def _cat_shards(node, req):
         if svc is None:
             continue
         for sid, shard in svc.shards.items():
+            store = shard.stats()["segments"]["memory_in_bytes"]
             rows.append([name, sid, "p", shard.state, shard.num_docs,
-                         "127.0.0.1", node.node_name])
+                         f"{store}b", "127.0.0.1", node.node_name])
     return _cat_table(req, rows, ["index", "shard", "prirep", "state", "docs",
-                                  "ip", "node"])
+                                  "store", "ip", "node"])
+
+
+def _cat_staging(node, req):
+    """_cat/staging (ISSUE 9, docs/OBSERVABILITY.md): the at-a-glance
+    per-(index, segment/plane, kind) view of the device-memory ledger —
+    what is staged in HBM right now, how big, how hot, and whether the
+    budget breaker may evict it."""
+    from elasticsearch_tpu.common.memory import memory_accountant
+
+    rows = []
+    for row in memory_accountant().table():
+        rows.append([
+            row["index"], row["segment"], row["kind"],
+            f"{row['bytes']}b", row["tables"], row["stage_count"],
+            "-" if row["idle_s"] is None else f"{row['idle_s']:.1f}s",
+            "*" if row["evictable"] else "-",
+        ])
+    return _cat_table(req, rows, [
+        "index", "segment", "kind", "bytes", "tables", "stage_count",
+        "idle", "evictable",
+    ])
 
 
 def _cat_count(node, req):

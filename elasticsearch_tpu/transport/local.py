@@ -43,6 +43,7 @@ import json
 import logging
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, Optional, Set, Tuple
 
 from elasticsearch_tpu.common.errors import (
@@ -55,6 +56,35 @@ from elasticsearch_tpu.common.errors import (
 )
 
 logger = logging.getLogger("elasticsearch_tpu.transport")
+
+# every TransportService ever created in this process (weakly held):
+# the PR-2 resilience counters (retries, backoff waits, send timeouts,
+# ConnectionHealth fast-fails) existed per service but were never
+# exported — _nodes/stats aggregates them from here (docs/RESILIENCE.md)
+_ALL_TRANSPORTS: "weakref.WeakSet" = weakref.WeakSet()
+# guards registry mutation vs the stats snapshot: a node starting up
+# concurrently with GET /_nodes/stats would otherwise race the WeakSet
+# iteration ("Set changed size during iteration" -> 500)
+_ALL_TRANSPORTS_LOCK = threading.Lock()
+
+
+def aggregate_transport_stats() -> Dict[str, int]:
+    """Process-wide transport resilience counters, summed over every
+    live TransportService (the in-process hub spawns one per node; a
+    single-node REST process reports zeros). Exported as the
+    ``transport`` block of ``_nodes/stats``."""
+    out: Dict[str, int] = {
+        "services": 0, "requests_sent": 0, "retries": 0, "timeouts": 0,
+        "fast_fails": 0, "failures": 0,
+    }
+    with _ALL_TRANSPORTS_LOCK:
+        services = list(_ALL_TRANSPORTS)
+    for svc in services:
+        out["services"] += 1
+        with svc._stats_lock:
+            for key, v in svc.stats.items():
+                out[key] = out.get(key, 0) + v
+    return out
 
 
 class RemoteActionException(ElasticsearchTpuException):
@@ -299,6 +329,8 @@ class TransportService:
             "fast_fails": 0, "failures": 0,
         }
         hub.register(self)
+        with _ALL_TRANSPORTS_LOCK:
+            _ALL_TRANSPORTS.add(self)
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:

@@ -1,0 +1,528 @@
+"""DeviceMemoryAccountant (ISSUE 9, docs/OBSERVABILITY.md): the exact
+HBM staging ledger, lifecycle events, restage amplification, and the
+budget breaker's LRU-evict → demote (never error) contract.
+
+Mirrors the reference's HierarchyCircuitBreakerService accounting-child
+tests — but the scarce resource here is device staging, so the ledger
+asserts EXACTNESS (per-kind sums == total, close returns to baseline)
+rather than heuristic estimates.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.memory import (
+    KIND_LIVE_MASK,
+    KIND_POSTINGS_RAW,
+    KIND_SCALE_NORM,
+    KINDS,
+    DeviceMemoryAccountant,
+    memory_accountant,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+MAPPING = {"properties": {
+    "body": {"type": "text", "analyzer": "whitespace"},
+    "n": {"type": "integer"},
+}}
+
+
+def _entry_sum(acct):
+    """Recompute the ledger total from the per-kind map — the invariant
+    partner of the incrementally-tracked staged_bytes()."""
+    return sum(acct.staged_bytes_by_kind().values())
+
+
+@pytest.fixture()
+def acct():
+    """A private accountant instance; every test must leave it balanced
+    (register/release net zero) so the shared breaker mirror is clean."""
+    a = DeviceMemoryAccountant()
+    yield a
+    # drain whatever the test left so the accounting-breaker mirror
+    # returns to its pre-test estimate
+    for index in {k[0] for k in a._entries}:
+        a.release_index(index)
+    assert a.staged_bytes() == 0
+
+
+@pytest.fixture()
+def ledger_leak_check():
+    """The ISSUE 9 leak-check fixture: the PROCESS accountant's staged
+    bytes must return EXACTLY to baseline once the test's indices close."""
+    acct = memory_accountant()
+    base = acct.staged_bytes()
+    yield acct
+    assert acct.staged_bytes() == base, (
+        f"device-memory ledger leaked: {acct.staged_bytes()} != {base} "
+        f"baseline after index close")
+
+
+def _mk_index(name, extra=None, docs=40, shards=2):
+    settings = {"index.number_of_shards": shards,
+                "index.refresh_interval": -1}
+    settings.update(extra or {})
+    idx = IndexService(name, Settings(settings), mapping=MAPPING)
+    rng = np.random.RandomState(11)
+    vocab = [f"w{i}" for i in range(8)]
+    for d in range(docs):
+        idx.index_doc(str(d), {
+            "body": " ".join(vocab[rng.randint(len(vocab))]
+                             for _ in range(6)),
+            "n": d})
+    idx.refresh()
+    return idx
+
+
+class TestLedgerExactness:
+    def test_per_kind_sums_to_total(self, acct):
+        acct.register("i", "s1", KIND_POSTINGS_RAW, "t1", 100)
+        acct.register("i", "s1", KIND_LIVE_MASK, "t2", 30)
+        acct.register("i", "s2", KIND_SCALE_NORM, "t3", 7)
+        by_kind = acct.staged_bytes_by_kind()
+        assert sum(by_kind.values()) == acct.staged_bytes() == 137
+        assert by_kind[KIND_POSTINGS_RAW] == 100
+        assert set(by_kind) == set(KINDS)
+        assert acct.staged_bytes("i") == 137
+        assert acct.staged_bytes("other") == 0
+
+    def test_reregister_replaces_not_leaks(self, acct):
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 100)
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 60,
+                      reason="refresh")
+        assert acct.staged_bytes() == 60
+        assert acct.staging_events[-1]["reason"] == "refresh"
+
+    def test_inplace_initial_reclassified_as_restage(self, acct):
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 100)
+        # a call site that says "initial" while bytes are already live
+        # is a restage — the amplification numerator must see it
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 100)
+        assert acct.staging_events[-1]["reason"] == "probe"
+
+    def test_restage_after_release_is_probe(self, acct):
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 100)
+        acct.release_scope("i", "s")
+        assert acct.staged_bytes() == 0
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 100)
+        assert acct.staging_events[-1]["reason"] == "probe"
+
+    def test_release_index_clears_history(self, acct):
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 100)
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 100,
+                      reason="refresh")
+        assert acct.stats("i")["restaged_bytes_total"] == 100
+        acct.release_index("i")
+        assert acct.staged_bytes("i") == 0
+        assert acct.stats("i")["restaged_bytes_total"] == 0
+        # post-delete re-create: a fresh "initial" is initial again
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 50)
+        assert acct.staging_events[-1]["reason"] == "initial"
+
+    def test_event_ring_bounded(self, acct):
+        cap = DeviceMemoryAccountant.MAX_EVENTS
+        for i in range(cap + 10):
+            acct.register("i", "s", KIND_POSTINGS_RAW, f"t{i}", 1)
+        assert len(acct.staging_events) == cap
+        assert acct.events_dropped == 10
+        assert acct.staged_bytes() == cap + 10
+
+    def test_restage_amplification(self, acct):
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 1000)
+        st = acct.stats("i")
+        assert st["bytes_logically_changed_total"] == 1000
+        assert st["restage_amplification"] == 0.0
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 1000,
+                      reason="delete_invalidation")
+        acct.note_logical_change("i", 100)
+        st = acct.stats("i")
+        assert st["restaged_bytes_total"] == 1000
+        assert st["bytes_logically_changed_total"] == 1100
+        assert st["restage_amplification"] == round(1000 / 1100, 4)
+
+    def test_quiet_register_skips_events_and_amplification(self, acct):
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 64, quiet=True)
+        assert acct.staged_bytes() == 64
+        assert not acct.staging_events
+        assert acct.stats("i")["bytes_logically_changed_total"] == 0
+
+
+class TestBudgetBreaker:
+    def test_lru_evicts_coldest_first(self, acct):
+        dropped = []
+        for name, age in (("cold", 3), ("warm", 2), ("hot", 1)):
+            acct.register("i", name, KIND_POSTINGS_RAW, "t", 100,
+                          evict=lambda n=name: dropped.append(n))
+        acct.touch("i", "warm")
+        acct.touch("i", "hot")  # LRU order now: cold < warm < hot
+        acct.budget_bytes = 300
+        assert acct.try_reserve("i", 100)  # needs 100: evicts cold only
+        assert dropped == ["cold"]
+        assert acct.staged_bytes() == 200
+        assert acct.evictions_total == 1
+        assert acct.evicted_bytes_total == 100
+        assert acct.eviction_events[-1]["segment"] == "cold"
+
+    def test_denial_when_nothing_evictable(self, acct):
+        acct.register("i", "pinned", KIND_POSTINGS_RAW, "t", 90)
+        acct.budget_bytes = 100
+        assert not acct.try_reserve("i", 50)
+        assert acct.budget_denials_total == 1
+        assert acct.staged_bytes() == 90  # nothing was dropped
+
+    def test_exclude_scope_protects_the_stager(self, acct):
+        acct.register("i", "me", KIND_POSTINGS_RAW, "t", 80,
+                      evict=lambda: None)
+        acct.budget_bytes = 100
+        # the only evictable scope is the one asking: denied, not evicted
+        assert not acct.try_reserve("i", 80, exclude_scope="me")
+        assert acct.staged_bytes() == 80
+
+    def test_zero_budget_is_unlimited(self, acct):
+        assert acct.try_reserve("i", 10**15)
+        assert acct.budget_denials_total == 0
+
+    def test_set_budget_evicts_immediately_and_mirrors_limit(self, acct):
+        breaker = acct._accounting_breaker()
+        prev_limit = breaker.limit_bytes
+        try:
+            acct.register("i", "s", KIND_POSTINGS_RAW, "t", 500,
+                          evict=lambda: None)
+            acct.set_budget(200)
+            assert breaker.limit_bytes == 200
+            assert acct.staged_bytes() == 0  # over budget: evicted now
+            assert acct.evictions_total == 1
+        finally:
+            acct.set_budget(prev_limit)
+
+    def test_breaker_mirror_tracks_ledger(self, acct):
+        breaker = acct._accounting_breaker()
+        before = breaker.used_bytes
+        acct.register("i", "s", KIND_POSTINGS_RAW, "t", 4096)
+        assert breaker.used_bytes == before + 4096
+        acct.release_scope("i", "s")
+        assert breaker.used_bytes == before
+
+
+class TestServiceLeakCheck:
+    """Every staging site registers; close/delete returns the ledger
+    EXACTLY to baseline (the acceptance-criteria leak check)."""
+
+    @pytest.fixture(autouse=True)
+    def _kernel(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+
+    def test_close_returns_to_baseline(self, ledger_leak_check):
+        acct = ledger_leak_check
+        idx = _mk_index("dmleak")
+        try:
+            idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
+            assert acct.staged_bytes("dmleak") > 0
+            st = idx.search_stats()["memory"]
+            assert (st["staged_bytes_total"]
+                    == sum(st["staged_bytes"].values()) > 0)
+        finally:
+            idx.close()
+        assert acct.staged_bytes("dmleak") == 0
+
+    def test_force_merge_restage_cycle(self, ledger_leak_check):
+        acct = ledger_leak_check
+        idx = _mk_index("dmmerge", shards=1)
+        try:
+            # second segment so the merge actually replaces something
+            for d in range(100, 120):
+                idx.index_doc(str(d), {"body": "w1 w2", "n": d})
+            idx.refresh()
+            idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
+            staged_presplit = acct.staged_bytes("dmmerge")
+            assert staged_presplit > 0
+            idx.force_merge()
+            # retired segments released their staged tables at merge
+            events_before = len(acct.stats("dmmerge")["staging_events"])
+            idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
+            # the merged segment restaged lazily on that query
+            assert acct.staged_bytes("dmmerge") > 0
+            post_merge = acct.stats("dmmerge")["staging_events"][
+                events_before:]
+            assert post_merge
+            # the merge product carries the retired segments' corpus:
+            # its staging must be classified a RESTAGE ("refresh"), so
+            # the full-corpus merge cost lands in the amplification
+            # numerator (ROADMAP item 3's number), not the denominator
+            assert any(e["reason"] == "refresh" for e in post_merge), \
+                [e["reason"] for e in post_merge]
+            st = idx.search_stats()["memory"]
+            assert (st["staged_bytes_total"]
+                    == sum(st["staged_bytes"].values()))
+        finally:
+            idx.close()
+        assert acct.staged_bytes("dmmerge") == 0
+
+    def test_delete_logs_delete_invalidation(self, ledger_leak_check):
+        acct = ledger_leak_check
+        idx = _mk_index("dmdel", shards=1)
+        try:
+            idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
+            idx.delete_doc("3")
+            idx.refresh()  # buffered deletes apply at refresh
+            events = acct.stats("dmdel")["staging_events"]
+            reasons = {e["reason"] for e in events}
+            assert "delete_invalidation" in reasons, reasons
+            st = acct.stats("dmdel")
+            assert st["bytes_logically_changed_total"] > 0
+            assert st["restaged_bytes_total"] > 0
+        finally:
+            idx.close()
+
+    def test_mesh_staging_accounted_and_released(self, ledger_leak_check):
+        acct = ledger_leak_check
+        idx = _mk_index("dmmesh", {"index.search.mesh": True})
+        try:
+            got = idx.search({"query": {"match": {"body": "w1"}},
+                              "size": 5})
+            assert got["_plane"] == "mesh_pallas", got["_plane"]
+            by_kind = acct.stats("dmmesh")["staged_bytes"]
+            assert by_kind["mesh_slot_tables"] > 0, by_kind
+            assert (by_kind["postings_raw"] + by_kind["postings_packed"]
+                    > 0), by_kind
+        finally:
+            idx.close()
+        assert acct.staged_bytes("dmmesh") == 0
+
+
+class TestBudgetDemotion:
+    """Over-budget mesh staging LRU-evicts, then DEMOTES to the host
+    rung with ladder decision reason hbm_budget and byte-identical hits
+    — queries degrade, never error (the acceptance criterion)."""
+
+    @pytest.fixture(autouse=True)
+    def _kernel(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+
+    @pytest.fixture()
+    def budget_guard(self):
+        acct = memory_accountant()
+        yield acct
+        acct.set_budget(0)
+
+    @staticmethod
+    def _same_hits(got, want):
+        gs = [(h["_id"], h["_score"]) for h in got["hits"]["hits"]]
+        ws = [(h["_id"], h["_score"]) for h in want["hits"]["hits"]]
+        assert len(gs) == len(ws)
+        for (gi, gsc), (wi, wsc) in zip(gs, ws):
+            assert abs(gsc - wsc) < 1e-5, (gs, ws)
+        # doc identity may permute only within exact score ties
+        assert sorted(i for i, _ in gs) == sorted(i for i, _ in ws)
+
+    def test_over_budget_demotes_with_identical_hits(self, budget_guard,
+                                                     ledger_leak_check):
+        acct = budget_guard
+        idx = _mk_index("dmbudget", {"index.search.mesh": True})
+        body = {"query": {"match": {"body": "w1 w3"}}, "size": 6}
+        try:
+            baseline = idx.search(dict(body))
+            assert baseline["_plane"] == "mesh_pallas"
+            evictions = acct.evictions_total
+            acct.set_budget(1)
+            assert acct.evictions_total > evictions, (
+                "budget below the ledger must evict immediately")
+            degraded = idx.search(dict(body))
+            assert degraded["_plane"] == "host", degraded["_plane"]
+            self._same_hits(degraded, baseline)
+            decisions = idx.search_stats()["phases"]["decisions"]
+            assert decisions.get("host.hbm_budget", 0) >= 1, decisions
+            assert acct.budget_denials_total > 0
+            # budget restored: the mesh plane restages (probe event) and
+            # serves identical hits again
+            acct.set_budget(0)
+            recovered = idx.search(dict(body))
+            assert recovered["_plane"] == "mesh_pallas"
+            self._same_hits(recovered, baseline)
+            assert any(e["reason"] == "probe"
+                       for e in acct.stats("dmbudget")["staging_events"])
+        finally:
+            idx.close()
+
+    def test_budget_never_errors_over_rest(self, budget_guard):
+        from elasticsearch_tpu.client import Client
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings.EMPTY)
+        client = Client(node)
+        try:
+            for i in range(20):
+                client.index("bidx", str(i), {"body": f"w{i % 4} common"})
+            client.perform("POST", "/bidx/_refresh")
+            status, _ = client.perform(
+                "PUT", "/_cluster/settings",
+                body={"persistent":
+                      {"search.memory.hbm_budget_bytes": "1b"}})
+            assert status == 200
+            status, payload = client.perform(
+                "POST", "/bidx/_search",
+                body={"query": {"match": {"body": "common"}}, "size": 5})
+            assert status == 200, payload  # degrade, never 5xx
+            assert payload["hits"]["total"] == 20
+            # the budget shows as the accounting breaker's limit
+            status, stats = client.perform("GET", "/_nodes/stats")
+            assert status == 200
+            node_block = next(iter(stats["nodes"].values()))
+            acc = node_block["breakers"]["accounting"]
+            assert acc["limit_size_in_bytes"] == 1
+            # clearing the cluster override reverts to the node file
+            status, _ = client.perform(
+                "PUT", "/_cluster/settings",
+                body={"persistent":
+                      {"search.memory.hbm_budget_bytes": None}})
+            assert status == 200
+            assert memory_accountant().budget_bytes == 0
+        finally:
+            node.close()
+
+
+class TestConcurrency:
+    """The satellite contract: a concurrent stage/evict/query burst
+    keeps the incrementally-tracked ledger total exactly equal to the
+    recomputed per-kind entry sum."""
+
+    def test_unit_ledger_consistent_under_hammer(self, acct):
+        stop = threading.Event()
+        errors = []
+
+        def stager(tid):
+            try:
+                i = 0
+                while not stop.is_set():
+                    scope = f"s{tid}_{i % 5}"
+                    acct.register("i", scope, KINDS[i % len(KINDS)],
+                                  f"t{i % 3}", (i % 7 + 1) * 64,
+                                  evict=lambda: None)
+                    if i % 4 == 3:
+                        acct.release_scope("i", scope)
+                    if i % 11 == 10:
+                        acct.try_reserve("i", 128)
+                    i += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def toggler():
+            try:
+                while not stop.is_set():
+                    acct.set_budget(512)
+                    acct.set_budget(0)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=stager, args=(t,))
+                   for t in range(6)] + [threading.Thread(target=toggler)]
+        breaker = acct._accounting_breaker()
+        prev_limit = breaker.limit_bytes
+        for t in threads:
+            t.start()
+        try:
+            import time
+
+            time.sleep(0.5)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+            breaker.limit_bytes = prev_limit
+        assert not errors, errors
+        assert acct.staged_bytes() == _entry_sum(acct)
+        st = acct.stats()
+        assert (st["staged_bytes_total"]
+                == sum(st["staged_bytes"].values()))
+
+    def test_service_queries_under_budget_churn(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        acct = memory_accountant()
+        base = acct.staged_bytes()
+        idx = _mk_index("dmconc", {"index.search.mesh": True}, docs=60,
+                        shards=3)
+        host = _mk_index("dmconchost", {"index.search.mesh": False},
+                         docs=60, shards=3)
+        body = {"query": {"match": {"body": "w1 w2"}}, "size": 6}
+        stop = threading.Event()
+        errors = []
+
+        def querier():
+            try:
+                while not stop.is_set():
+                    got = idx.search(dict(body))
+                    assert got["hits"]["hits"], got
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def churner():
+            try:
+                while not stop.is_set():
+                    acct.set_budget(1)  # evict + deny
+                    acct.set_budget(0)  # restage allowed again
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=querier) for _ in range(4)]
+        threads.append(threading.Thread(target=churner))
+        for t in threads:
+            t.start()
+        try:
+            import time
+
+            time.sleep(1.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            acct.set_budget(0)
+        try:
+            assert not errors, errors
+            assert not any(t.is_alive() for t in threads), (
+                "stage/evict/query burst deadlocked")
+            # the storm is over: ledger total == per-kind entry sum, and
+            # a fresh query still returns correct hits on the fast plane
+            assert acct.staged_bytes() == sum(
+                memory_accountant().staged_bytes_by_kind().values())
+            got = idx.search(dict(body))
+            want = host.search(dict(body))
+            assert got["hits"]["total"] == want["hits"]["total"]
+            gs = [h["_score"] for h in got["hits"]["hits"]]
+            ws = [h["_score"] for h in want["hits"]["hits"]]
+            assert all(abs(a - b) < 1e-5 for a, b in zip(gs, ws))
+        finally:
+            idx.close()
+            host.close()
+        assert acct.staged_bytes() == base
+
+
+class TestCatStaging:
+    def test_cat_staging_renders_ledger(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        from elasticsearch_tpu.client import Client
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings.EMPTY)
+        client = Client(node)
+        try:
+            for i in range(12):
+                client.index("catstg", str(i), {"body": f"w{i % 3}"})
+            client.perform("POST", "/catstg/_refresh")
+            client.perform("POST", "/catstg/_search",
+                           body={"query": {"match": {"body": "w1"}}})
+            status, text = client.perform("GET", "/_cat/staging",
+                                          params={"v": "true"})
+            assert status == 200
+            lines = text.strip().splitlines()
+            assert lines[0].split()[:4] == ["index", "segment", "kind",
+                                            "bytes"]
+            assert any("catstg" in line for line in lines[1:]), text
+            # every rendered byte count is a real ledger row
+            status, plain = client.perform("GET", "/_cat/staging")
+            assert status == 200
+            assert "index" not in plain.splitlines()[0]
+        finally:
+            node.close()

@@ -108,3 +108,40 @@ class TestObservabilityRegistryLint:
         _walk_keys({"phases": {"totally_undocumented_key_xyz": 1}}, keys)
         assert "totally_undocumented_key_xyz" in keys
         assert "totally_undocumented_key_xyz" not in doc
+
+    def test_device_memory_stats_keys_documented(self, exercised_index):
+        # the search.memory block (ISSUE 9): ledger byte sums, staging/
+        # eviction event rings, restage amplification — every exported
+        # key (kind names included) must be in docs/OBSERVABILITY.md
+        doc = _doc_text()
+        mem = exercised_index.search_stats()["memory"]
+        keys: set = set()
+        _walk_keys(mem, keys)
+        missing = sorted(k for k in keys if k not in doc)
+        assert not missing, (
+            f"search.memory keys absent from docs/OBSERVABILITY.md: "
+            f"{missing}")
+        from elasticsearch_tpu.common.memory import KINDS
+
+        for kind in KINDS:
+            assert kind in mem["staged_bytes"], mem["staged_bytes"]
+            assert kind in doc, f"ledger kind [{kind}] undocumented"
+
+    def test_node_breakers_and_transport_keys_documented(self):
+        # _nodes/stats breakers (the accounting child mirrors the device
+        # ledger) and the PR-2 transport resilience counters must stay
+        # documented — OBSERVABILITY.md for the blocks, RESILIENCE.md
+        # carries the transport row-level table
+        from elasticsearch_tpu.common.breaker import breaker_service
+        from elasticsearch_tpu.transport.local import (
+            aggregate_transport_stats,
+        )
+
+        doc = _doc_text()
+        keys: set = set()
+        _walk_keys(breaker_service().stats(), keys)
+        _walk_keys(aggregate_transport_stats(), keys)
+        missing = sorted(k for k in keys if k not in doc)
+        assert not missing, (
+            f"_nodes/stats breakers/transport keys absent from "
+            f"docs/OBSERVABILITY.md: {missing}")
