@@ -85,6 +85,75 @@ RECOVERY_CHUNK_BYTES = 512 * 1024
 # full in-memory snapshot of the shard's files
 RECOVERY_SESSION_MAX_AGE_S = 600.0
 
+# ---------------------------------------------------------------------------
+# Recovery progress registry (_cat/recovery — ISSUE 10 satellite).
+#
+# Target-side ClusterNodes record each peer recovery's live progress here
+# (stage init -> index -> translog -> finalize -> done, file/bytes/ops
+# counts, source -> target), keyed per copy; the REST layer renders the
+# rows like the reference's RecoveryState exposed through
+# RestCatRecoveryAction. Process-global (like the transport stats
+# registry in transport/local.py) so in-one-process clusters and the
+# single-node REST surface share one view; bounded by eviction of done
+# rows beyond a cap.
+# ---------------------------------------------------------------------------
+
+_RECOVERY_PROGRESS: Dict[Tuple[str, int, str], dict] = {}
+_RECOVERY_PROGRESS_LOCK = threading.Lock()
+# total-row cap: finished rows retire first, then the OLDEST stale
+# in-flight ones (a recovery that died mid-pull never reaches "done" —
+# without aging those out the registry would grow per churned copy)
+_RECOVERY_PROGRESS_MAX_ROWS = 128
+
+
+def record_recovery_progress(index: str, shard: int, target: str,
+                             **updates) -> None:
+    """Create/update one copy's recovery-progress row; counters passed
+    as ``add_<field>=n`` increment, plain fields assign."""
+    key = (index, int(shard), target)
+    with _RECOVERY_PROGRESS_LOCK:
+        row = _RECOVERY_PROGRESS.get(key)
+        if row is None:
+            row = _RECOVERY_PROGRESS[key] = {
+                "index": index, "shard": int(shard), "target": target,
+                "source": None, "type": "peer", "stage": "init",
+                "files_total": 0, "files_recovered": 0,
+                "bytes_total": 0, "bytes_recovered": 0,
+                "ops_total": 0, "ops_recovered": 0,
+                "start_ms": int(time.time() * 1000), "stop_ms": None,
+            }
+            # bounded registry: evict finished rows first (oldest
+            # stop_ms), then the oldest stale in-flight rows
+            excess = len(_RECOVERY_PROGRESS) - _RECOVERY_PROGRESS_MAX_ROWS
+            if excess > 0:
+                victims = sorted(
+                    (k for k in _RECOVERY_PROGRESS if k != key),
+                    key=lambda k: (
+                        _RECOVERY_PROGRESS[k]["stage"] != "done",
+                        _RECOVERY_PROGRESS[k]["stop_ms"]
+                        or _RECOVERY_PROGRESS[k]["start_ms"] or 0))
+                for k in victims[:excess]:
+                    _RECOVERY_PROGRESS.pop(k, None)
+        for field, value in updates.items():
+            if field.startswith("add_"):
+                row[field[4:]] = row.get(field[4:], 0) + value
+            else:
+                row[field] = value
+
+
+def recovery_progress_rows() -> List[dict]:
+    """Snapshot of every tracked recovery, in-flight first then by
+    recency — the _cat/recovery row source."""
+    with _RECOVERY_PROGRESS_LOCK:
+        rows = [dict(r) for r in _RECOVERY_PROGRESS.values()]
+    rows.sort(key=lambda r: (r["stage"] == "done", -(r["start_ms"] or 0)))
+    return rows
+
+
+def clear_recovery_progress() -> None:
+    with _RECOVERY_PROGRESS_LOCK:
+        _RECOVERY_PROGRESS.clear()
+
 
 def _time_setting(setting, settings: Settings) -> float:
     """Resolve a time Setting to seconds — Setting.get returns string
@@ -1029,6 +1098,15 @@ class ClusterNode:
         primary_node = self._primary_node(index, sid)
         if primary_node is None or primary_node == self.node_id:
             return
+        # _cat/recovery progress (RecoveryState analog): one row per
+        # copy, updated through every stage of this recovery. A RE-run
+        # (the copy failed and recovers again) resets every counter —
+        # the row describes THIS recovery, not the sum of attempts.
+        record_recovery_progress(
+            index, sid, self.node_id, source=primary_node, type="peer",
+            stage="init", start_ms=int(time.time() * 1000), stop_ms=None,
+            files_total=0, files_recovered=0, bytes_total=0,
+            bytes_recovered=0, ops_total=0, ops_recovered=0)
         # phase1: copy the primary's committed segment files in chunks so
         # a fresh replica doesn't replay the whole history doc-by-doc;
         # any failure falls back to full ops replay (above_seqno = -1)
@@ -1038,6 +1116,8 @@ class ClusterNode:
         except (NodeNotConnectedException, ElasticsearchTpuException,
                 OSError, ValueError):
             above_seqno = -1
+        record_recovery_progress(index, sid, self.node_id,
+                                 stage="translog")
         try:
             resp = self.transport.send_request(
                 primary_node, ACTION_RECOVER, {
@@ -1055,8 +1135,12 @@ class ClusterNode:
         shard = self.shards.get((index, sid))
         if shard is None:
             return
+        record_recovery_progress(index, sid, self.node_id,
+                                 add_ops_total=len(resp["ops"]))
         for op in resp["ops"]:
             self._apply_replicated_op(shard, op)
+            record_recovery_progress(index, sid, self.node_id,
+                                     add_ops_recovered=1)
         shard.refresh()
         # confirm the replay to the primary (recovery finalize) so it can
         # mark this copy in-sync at a checkpoint we actually hold; the
@@ -1066,6 +1150,8 @@ class ClusterNode:
         # caught-up checkpoint and promotes us out of pending-in-sync
         # even if no further writes arrive (reference: pendingInSync wait
         # in markAllocationIdAsInSync)
+        record_recovery_progress(index, sid, self.node_id,
+                                 stage="finalize")
         for _round in range(5):
             fin = None
             try:  # transient faults retry with backoff (RetryableAction)
@@ -1087,9 +1173,15 @@ class ClusterNode:
             # already in the primary's replication group); the engine's
             # seqno staleness guard makes the apply idempotent in either
             # order
+            record_recovery_progress(index, sid, self.node_id,
+                                     add_ops_total=len(fin["ops"]))
             for op in fin["ops"]:
                 self._apply_replicated_op(shard, op)
+                record_recovery_progress(index, sid, self.node_id,
+                                         add_ops_recovered=1)
             shard.refresh()
+        record_recovery_progress(index, sid, self.node_id, stage="done",
+                                 stop_ms=int(time.time() * 1000))
         self._report_started(index, sid)
 
     @staticmethod
@@ -1221,6 +1313,10 @@ class ClusterNode:
             retry=self.recovery_retry)
         if not start.get("files") or start.get("max_seq_no", -1) < 0:
             return -1  # empty primary: nothing to ship, pure ops replay
+        record_recovery_progress(
+            index, sid, self.node_id, stage="index",
+            files_total=len(start["files"]),
+            bytes_total=sum(int(e["size"]) for e in start["files"]))
         try:
             return self._pull_session_files(shard, start, primary_node)
         except BaseException:
@@ -1268,11 +1364,16 @@ class ClusterNode:
                             f"empty non-final chunk for [{rel}]")
                     f.write(data)
                     offset += len(data)
+                    record_recovery_progress(
+                        shard.index_name, shard.shard_id, self.node_id,
+                        add_bytes_recovered=len(data))
                     if chunk.get("eof"):
                         break
             if os.path.getsize(full) != size:
                 raise ElasticsearchTpuException(
                     f"short file [{rel}]: {os.path.getsize(full)} != {size}")
+            record_recovery_progress(shard.index_name, shard.shard_id,
+                                     self.node_id, add_files_recovered=1)
         # install: load the shipped commit (verifies per-segment
         # checksums), rebuild the version map and tombstones — the same
         # path a restarting node uses (IndexShard.recover_from_store)

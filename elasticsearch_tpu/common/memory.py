@@ -110,6 +110,14 @@ class DeviceMemoryAccountant:
         self.evictions_total = 0
         self.evicted_bytes_total = 0
         self.budget_denials_total = 0
+        # device-staging fault model (ISSUE 10, docs/RESILIENCE.md
+        # "Device-plane faults"): classified terminal faults + the
+        # bounded-retry counter, with a bounded event ring so operators
+        # can join a plane demotion to the staging fault that caused it
+        self.staging_retries_total = 0
+        self.staging_faults_transient_total = 0
+        self.staging_faults_deterministic_total = 0
+        self.staging_fault_events: List[dict] = []
         # per-index restage-amplification inputs
         self._restaged: Dict[str, int] = {}
         self._logical: Dict[str, int] = {}
@@ -217,6 +225,49 @@ class DeviceMemoryAccountant:
         amplification."""
         with self._lock:
             self._logical[index] = self._logical.get(index, 0) + int(nbytes)
+
+    def note_staging_retry(self, index: str, kind: str) -> None:
+        """One transient staging fault absorbed by the bounded-retry
+        loop (common/staging.run_staged) — the attempt will re-run."""
+        with self._lock:
+            self.staging_retries_total += 1
+
+    def note_staging_fault(self, index: str, kind: str, *,
+                           transient: bool, retries: int = 0,
+                           plane: str = "host",
+                           error: str = "") -> None:
+        """A TERMINAL staging fault (transient with retries exhausted,
+        or deterministic): the caller rolled back its partial staging
+        and is demoting the plane ladder — record it so
+        ``_stats search.memory`` can tell device pressure from a broken
+        staging site."""
+        with self._lock:
+            if transient:
+                self.staging_faults_transient_total += 1
+            else:
+                self.staging_faults_deterministic_total += 1
+            self._push(self.staging_fault_events, {
+                "index": index or "_unassigned", "kind": kind,
+                "classification": ("transient" if transient
+                                   else "deterministic"),
+                "retries": int(retries), "plane": plane,
+                "error": str(error)[:200],
+                "timestamp_ms": int(time.time() * 1000),
+            })
+
+    def force_evict(self, scopes: int = 1) -> int:
+        """Evict the N coldest evictable scopes regardless of budget —
+        the EvictionStormScheme's lever (testing/disruption.py): drives
+        the LRU evictor under query load so restage-under-pressure
+        paths are exercised deterministically. Returns bytes evicted."""
+        freed = 0
+        with self._lock:
+            for _ in range(max(0, int(scopes))):
+                before = self.evictions_total
+                freed += self._evict_locked(1)  # 1 byte => one scope
+                if self.evictions_total == before:
+                    break  # nothing evictable left
+        return freed
 
     def release_scope(self, index: str, scope: str) -> int:
         """Release every table of one staging owner (segment retirement,
@@ -361,6 +412,7 @@ class DeviceMemoryAccountant:
                 logical = sum(self._logical.values())
                 staging = list(self.staging_events)
                 evictions = list(self.eviction_events)
+                faults = list(self.staging_fault_events)
             else:
                 restaged = self._restaged.get(index, 0)
                 logical = self._logical.get(index, 0)
@@ -368,6 +420,8 @@ class DeviceMemoryAccountant:
                            if e["index"] == index]
                 evictions = [e for e in self.eviction_events
                              if e["index"] == index]
+                faults = [e for e in self.staging_fault_events
+                          if e["index"] == index]
             return {
                 "hbm_budget_bytes": self.budget_bytes,
                 "staged_bytes_total": sum(by_kind.values()),
@@ -382,6 +436,16 @@ class DeviceMemoryAccountant:
                 "evictions_total": self.evictions_total,
                 "evicted_bytes_total": self.evicted_bytes_total,
                 "budget_denials_total": self.budget_denials_total,
+                # classified staging-fault model (ISSUE 10,
+                # docs/RESILIENCE.md): retry/fault counters are
+                # node-global like the eviction counters; the event
+                # ring filters per index
+                "staging_retries_total": self.staging_retries_total,
+                "staging_faults_transient_total":
+                    self.staging_faults_transient_total,
+                "staging_faults_deterministic_total":
+                    self.staging_faults_deterministic_total,
+                "staging_fault_events": faults,
             }
 
     def table(self) -> List[dict]:

@@ -592,6 +592,24 @@ SEARCH_MEMORY_HBM_BUDGET = Setting.bytes_setting(
     "search.memory.hbm_budget_bytes", "0b", dynamic=True
 )
 
+# --- device-staging retry (ISSUE 10, docs/RESILIENCE.md) ---
+
+SEARCH_STAGING_RETRY_MAX_ATTEMPTS = Setting.int_setting(
+    # total attempts for one device staging (HBM transfer group) whose
+    # fault classified TRANSIENT (RESOURCE_EXHAUSTED / transfer error);
+    # deterministic faults (shape/compile) never retry — they demote
+    # the plane ladder immediately and quarantine with reason
+    # staging_fault. 1 = no retries.
+    "search.staging.retry.max_attempts", 3, min_value=1, max_value=10,
+    dynamic=True
+)
+SEARCH_STAGING_RETRY_BACKOFF_MS = Setting.float_setting(
+    # first-retry backoff in milliseconds; doubles per retry
+    # (exponential). Keep small: staging sits on the query path — the
+    # retry only exists to ride out momentary device pressure.
+    "search.staging.retry.backoff_ms", 10.0, min_value=0.0, dynamic=True
+)
+
 # --- phase-attributed query telemetry (docs/OBSERVABILITY.md) ---
 
 SEARCH_TELEMETRY_ENABLED = Setting.bool_setting(
@@ -646,6 +664,8 @@ NODE_SETTINGS = [
     SEARCH_KNN_ENABLED,
     SEARCH_KNN_TILE_SUB,
     SEARCH_MEMORY_HBM_BUDGET,
+    SEARCH_STAGING_RETRY_MAX_ATTEMPTS,
+    SEARCH_STAGING_RETRY_BACKOFF_MS,
     SEARCH_TELEMETRY_ENABLED,
 ]
 

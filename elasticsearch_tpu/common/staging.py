@@ -1,0 +1,172 @@
+"""Device-staging fault model: classification + bounded retry (ISSUE 10).
+
+Role model: the reference's per-layer failure contracts (SURVEY §3.2
+scatter-gather failure handling, §5.8 disruption tests) — every Lucene /
+disk / network touchpoint there classifies its faults and either retries
+or degrades explicitly. The TPU inversion: the fragile boundary is
+**HBM staging** (`device_put` of posting tables, live masks, embedding
+matrices) and kernel launches, which until this module were guarded by
+blanket ``except Exception`` that silently demoted forever.
+
+Two pieces (docs/RESILIENCE.md "Device-plane faults"):
+
+- ``classify_staging_fault``: split device faults into
+
+  * **transient** — RESOURCE_EXHAUSTED / transfer / device-unavailable
+    shapes (and the injected :class:`TransientDeviceError`): the staging
+    is expected to succeed on a retry once pressure clears. Retried with
+    bounded exponential backoff (``search.staging.retry.*``).
+  * **deterministic** — shape/compile/value errors that would recur on
+    every attempt: never retried; the caller demotes the plane ladder
+    immediately and quarantines the plane with reason ``staging_fault``.
+
+- ``run_staged``: the one retry loop every multi-array staging site runs
+  its attempt through. Transient faults sleep
+  ``backoff_ms * 2**attempt`` between attempts (bounded by
+  ``max_attempts``); every retry and terminal fault is recorded on the
+  DeviceMemoryAccountant (``_stats search.memory`` —
+  ``staging_retries_total`` / ``staging_faults_*`` / the
+  ``staging_fault_events`` ring) so operators can tell a device under
+  pressure from a genuinely broken staging site.
+
+The retry knobs are node settings (dynamic, with the explicitness
+contract of ``search.pallas.*``: an explicit cluster-level value wins,
+clearing it reverts to the node file): the node seeds the module-level
+config at startup and ``PUT _cluster/settings`` keeps it live. Staging
+sites read the PROCESS-level config (``staging_retry_config(None)``) —
+an index's create-time Settings snapshot must not freeze the budget
+against later dynamic updates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+DEFAULT_MAX_ATTEMPTS = 3
+DEFAULT_BACKOFF_MS = 10.0
+
+TRANSIENT = "transient"
+DETERMINISTIC = "deterministic"
+
+
+class StagingBail(Exception):
+    """A structural (request/mapping-shaped) inability discovered inside
+    a staging attempt — NOT a device fault. ``run_staged`` re-raises it
+    immediately: no retry, no fault accounting (the caller owns its
+    meaning, e.g. 'this segment set can never stage this field')."""
+
+
+class TransientDeviceError(RuntimeError):
+    """A transient device-plane fault (the RESOURCE_EXHAUSTED / transfer
+    error analog): staging is expected to succeed on retry. Raised by
+    the fault-injection schemes (testing/disruption.py
+    StagingFailScheme) and matched by name/type in classification."""
+
+
+# message markers the XLA runtime uses for pressure/transport faults —
+# these recur only while the device is under pressure, so they retry
+_TRANSIENT_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "unavailable",
+    "deadline_exceeded",
+    "data_loss",
+    "transfer",
+    "connection reset",
+)
+
+
+def classify_staging_fault(exc: BaseException) -> str:
+    """``transient`` or ``deterministic`` (see module docstring).
+
+    Shape/compile errors (ValueError/TypeError and friends) are
+    deterministic — the same arrays re-raise them on every attempt —
+    while allocator/transport shapes (by type or by the XLA runtime's
+    message vocabulary) are transient."""
+    if isinstance(exc, (TransientDeviceError, MemoryError, OSError,
+                        ConnectionError, TimeoutError)):
+        return TRANSIENT
+    if isinstance(exc, (ValueError, TypeError, KeyError, IndexError,
+                        AssertionError, AttributeError)):
+        return DETERMINISTIC
+    msg = str(exc).lower()
+    if any(marker in msg for marker in _TRANSIENT_MARKERS):
+        # XlaRuntimeError and friends carry the grpc-style status name
+        return TRANSIENT
+    return DETERMINISTIC
+
+
+# ---------------------------------------------------------------------------
+# Retry configuration (search.staging.retry.*)
+# ---------------------------------------------------------------------------
+
+_cfg_lock = threading.Lock()
+_max_attempts = DEFAULT_MAX_ATTEMPTS
+_backoff_ms = DEFAULT_BACKOFF_MS
+
+
+def configure_staging_retry(max_attempts: Optional[int] = None,
+                            backoff_ms: Optional[float] = None) -> None:
+    """Set the process-level retry config (node startup + dynamic
+    cluster-settings updates). None leaves a knob unchanged."""
+    global _max_attempts, _backoff_ms
+    with _cfg_lock:
+        if max_attempts is not None:
+            _max_attempts = max(1, int(max_attempts))
+        if backoff_ms is not None:
+            _backoff_ms = max(0.0, float(backoff_ms))
+
+
+def staging_retry_config(settings=None) -> Tuple[int, float]:
+    """(max_attempts, backoff_ms) — an index/node ``Settings`` carrying
+    the keys wins over the process-level config (create_index seeds the
+    prefix so per-index overrides compose like search.pallas.*)."""
+    attempts, backoff = _max_attempts, _backoff_ms
+    if settings is not None:
+        try:
+            attempts = int(settings.get_int(
+                "search.staging.retry.max_attempts", attempts))
+            backoff = float(settings.get_float(
+                "search.staging.retry.backoff_ms", backoff))
+        except (TypeError, ValueError):
+            pass
+    return max(1, attempts), max(0.0, backoff)
+
+
+def run_staged(fn, *, index: str, kind: str, plane: str = "host",
+               settings=None, retry: Optional[Tuple[int, float]] = None):
+    """Run one staging attempt with the classified-recovery contract.
+
+    ``fn`` performs the whole attempt (fault-injection hook included, so
+    a retried attempt re-consults the schemes). Transient faults retry
+    up to ``max_attempts`` total attempts with exponential backoff;
+    deterministic faults raise immediately. The terminal fault (either
+    class) is recorded on the accountant — the CALLER owns rollback of
+    any partially-published arrays and the ladder/quarantine decision —
+    and re-raised."""
+    from elasticsearch_tpu.common.memory import memory_accountant
+
+    max_attempts, backoff_ms = retry or staging_retry_config(settings)
+    acct = memory_accountant()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except StagingBail:
+            raise  # structural inability: the caller's contract, not ours
+        except Exception as e:  # noqa: BLE001 — classified below;
+            # non-Exception BaseExceptions (KeyboardInterrupt) pass
+            cls = classify_staging_fault(e)
+            if cls == TRANSIENT and attempt + 1 < max_attempts:
+                attempt += 1
+                acct.note_staging_retry(index, kind)
+                if backoff_ms > 0:
+                    time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1000.0)
+                continue
+            acct.note_staging_fault(index, kind, transient=(cls == TRANSIENT),
+                                    retries=attempt, plane=plane,
+                                    error=f"{type(e).__name__}: {e}")
+            raise

@@ -763,6 +763,12 @@ class IndexService:
         if tracer is None:
             tracer = self._tracer()
         body = body or {}
+        # device-plane fault injection consult point (ISSUE 10): the
+        # EvictionStormScheme forces the accountant's LRU evictor here,
+        # under real query load
+        from elasticsearch_tpu.testing.disruption import on_query_begin
+
+        on_query_begin(self.name)
         if body.get("knn") is not None:
             # top-level ``knn`` section (the reference's knn search
             # surface): alone it is a pure vector search — normalize to
@@ -1349,6 +1355,8 @@ class IndexService:
                 **(self._mesh_search.plane_health.stats()
                    if self._mesh_search is not None else
                    {"plane_failures_total": {"mesh_pallas": 0, "mesh": 0},
+                    "plane_failures_by_reason": {},
+                    "plane_probes_total": 0,
                     "plane_quarantined": [], "quarantine_events": []}),
                 # block-max pruned scoring + postings codec observability
                 # (docs/PRUNING.md): queries served pruned, the tile

@@ -28,6 +28,7 @@ from __future__ import annotations
 import bisect
 import itertools
 import json
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -433,29 +434,19 @@ class Segment:
             with self._device_stage_lock:
                 dev = self._device  # a racing cold query built it
                 if dev is None:
-                    import time as _time
+                    from elasticsearch_tpu.common.staging import run_staged
 
-                    import jax.numpy as jnp
-
-                    t0 = _time.monotonic()
-                    live1 = np.concatenate(
-                        [self.live, np.zeros(1, dtype=bool)])
-                    dev = {
-                        "block_docs": jnp.asarray(self.block_docs),
-                        "block_tfs": jnp.asarray(self.block_tfs),
-                        "norms": jnp.asarray(self.norms),
-                        "live": jnp.asarray(self.live),
-                        "live1": jnp.asarray(live1),
-                    }
-                    self._device = dev
-                    dur = (_time.monotonic() - t0) * 1000.0
-                    self._account(
-                        KIND_POSTINGS_RAW, "base_postings",
-                        self.block_docs.nbytes + self.block_tfs.nbytes,
-                        duration_ms=dur)
-                    self._account(KIND_SCALE_NORM, "norms",
-                                  self.norms.nbytes)
-                    self._account_live_masks("initial")
+                    # transient device faults retry with bounded backoff
+                    # (search.staging.retry.*); a terminal fault
+                    # propagates — the base staging is MANDATORY for
+                    # this shard's query phase, so the shard-failure
+                    # isolation path (PR 4) owns it: partial results,
+                    # never a 5xx. Nothing publishes or registers until
+                    # the whole group staged (register-then-commit).
+                    dev = run_staged(
+                        self._stage_base_arrays,
+                        index=self.owner_index or "_unassigned",
+                        kind=KIND_POSTINGS_RAW, plane="host")
         else:
             memory_accountant().touch(self.owner_index or "_unassigned",
                                       self.ledger_scope)
@@ -464,7 +455,56 @@ class Segment:
             # (ES_TPU_PALLAS flips in tests; backend selection at runtime)
             with self._device_stage_lock:
                 if "k_docs" not in dev and "k_packed" not in dev:
-                    self._stage_kernel_arrays(dev)
+                    from elasticsearch_tpu.common.staging import run_staged
+
+                    try:
+                        run_staged(
+                            lambda: self._stage_kernel_arrays(dev),
+                            index=self.owner_index or "_unassigned",
+                            kind="postings", plane="host")
+                    except Exception:  # noqa: BLE001 — terminal
+                        # classified staging fault: the kernel tables
+                        # are an OPTIONAL fast plane for the host rung —
+                        # this query's segments score on the scatter
+                        # engine (byte-level parity contract) and the
+                        # next query retries the staging (self-heal once
+                        # the fault clears; docs/RESILIENCE.md)
+                        logging.getLogger(
+                            "elasticsearch_tpu.index.segment").warning(
+                            "[%s] kernel staging failed; segment [%s] "
+                            "scores on the scatter engine this query",
+                            self.owner_index or "_unassigned", self.name,
+                            exc_info=True)
+        return dev
+
+    def _stage_base_arrays(self) -> dict:
+        """One cold-build ATTEMPT of the base staging (under
+        _device_stage_lock, inside run_staged's retry loop)."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
+        t0 = _time.monotonic()
+        live1 = np.concatenate([self.live, np.zeros(1, dtype=bool)])
+        on_device_staging(self.owner_index or "_unassigned",
+                          KIND_POSTINGS_RAW, "base_postings")
+        dev = {
+            "block_docs": jnp.asarray(self.block_docs),
+            "block_tfs": jnp.asarray(self.block_tfs),
+            "norms": jnp.asarray(self.norms),
+            "live": jnp.asarray(self.live),
+            "live1": jnp.asarray(live1),
+        }
+        self._device = dev
+        dur = (_time.monotonic() - t0) * 1000.0
+        self._account(
+            KIND_POSTINGS_RAW, "base_postings",
+            self.block_docs.nbytes + self.block_tfs.nbytes,
+            duration_ms=dur)
+        self._account(KIND_SCALE_NORM, "norms", self.norms.nbytes)
+        self._account_live_masks("initial")
         return dev
 
     def _stage_kernel_arrays(self, dev: dict) -> None:
@@ -505,30 +545,40 @@ class Segment:
         # stage fully, then publish atomically: a concurrent search thread
         # must never observe k_docs without k_frac/k_live_t (dict.update
         # of a prebuilt dict is atomic under the GIL), and kernel_geom is
-        # the eligibility signal so it is set LAST
+        # the eligibility signal so it is set LAST. Register-then-commit
+        # (ISSUE 10): a fault anywhere before dev.update publishes
+        # nothing and registers nothing — the attempt leaves no trace
+        # and run_staged's retry loop re-runs it (hooks re-consulted)
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
+        kind_postings = (KIND_POSTINGS_PACKED if codec == "packed"
+                         else KIND_POSTINGS_RAW)
+        owner = self.owner_index or "_unassigned"
+        on_device_staging(owner, KIND_LIVE_MASK, "k_live_t")
         staged = {
             "k_live_t": jnp.asarray(
                 psc.build_live_t(self.live.astype(np.float32), geom)),
         }
+        on_device_staging(owner, kind_postings, "k_postings")
         if codec == "packed":
             pk = psc.pack_segment_blocks(self.block_docs, frac,
                                          self.nd_pad)
             staged["k_packed"] = jnp.asarray(pk)
-            self.kernel_postings_bytes = int(pk.nbytes)
+            postings_bytes = int(pk.nbytes)
         else:
             dp, fp = psc.pad_segment_blocks(self.block_docs, frac,
                                             self.nd_pad)
             staged["k_docs"] = jnp.asarray(dp)
             staged["k_frac"] = jnp.asarray(fp)
-            self.kernel_postings_bytes = int(dp.nbytes + fp.nbytes)
+            postings_bytes = int(dp.nbytes + fp.nbytes)
+        self.kernel_postings_bytes = postings_bytes
         self.kernel_bmin = bmin
         self.kernel_bmax = bmax
         self.kernel_codec = codec
         dev.update(staged)
         self.kernel_geom = geom
         dur = (_time.monotonic() - t0) * 1000.0
-        self._account(KIND_POSTINGS_PACKED if codec == "packed"
-                      else KIND_POSTINGS_RAW, "k_postings",
+        self._account(kind_postings, "k_postings",
                       self.kernel_postings_bytes, duration_ms=dur)
         # bmin/bmax stay host-resident but scale with the plane: tracked
         # under bound_tables so the per-kind sums explain the footprint
@@ -616,21 +666,41 @@ class Segment:
 
         from elasticsearch_tpu.ops import pallas_knn as pkn
 
+        from elasticsearch_tpu.common.staging import run_staged
+
         if emb_key not in dev:
             with self._device_stage_lock:
                 if emb_key not in dev:  # racing cold stager built it
-                    self._stage_vector_arrays(dev, col, emb_key,
-                                              exists_key)
+                    # transient faults retry with backoff; a terminal
+                    # fault propagates (the host kNN rung needs these
+                    # arrays — shard-failure isolation owns it)
+                    run_staged(
+                        lambda: self._stage_vector_arrays(
+                            dev, col, emb_key, exists_key),
+                        index=self.owner_index or "_unassigned",
+                        kind=KIND_EMBEDDINGS, plane="host")
         if metric == "cosine" and norm_key not in dev:
             # only cosine reads the inverse-norm column — a dot_product
             # field skips the norm pass and the staged bytes entirely
             with self._device_stage_lock:
                 if norm_key not in dev:
-                    inv = pkn.vector_scale_column(
-                        col.vectors, "cosine")[:, 0]
-                    dev[norm_key] = jnp.asarray(inv)
-                    self._account(KIND_SCALE_NORM, norm_key,
-                                  int(inv.nbytes))
+                    def _stage_norm():
+                        from elasticsearch_tpu.testing.disruption import (
+                            on_device_staging,
+                        )
+
+                        on_device_staging(
+                            self.owner_index or "_unassigned",
+                            KIND_SCALE_NORM, norm_key)
+                        inv = pkn.vector_scale_column(
+                            col.vectors, "cosine")[:, 0]
+                        dev[norm_key] = jnp.asarray(inv)
+                        self._account(KIND_SCALE_NORM, norm_key,
+                                      int(inv.nbytes))
+
+                    run_staged(_stage_norm,
+                               index=self.owner_index or "_unassigned",
+                               kind=KIND_SCALE_NORM, plane="host")
         d_pad = int(dev[emb_key].shape[1])
         return emb_key, norm_key, exists_key, d_pad
 
@@ -645,6 +715,8 @@ class Segment:
         from elasticsearch_tpu.common.memory import memory_accountant
         from elasticsearch_tpu.ops import pallas_knn as pkn
 
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
         t0 = _time.monotonic()
         d_pad = pkn.pad_dims(col.dims)
         # a MANDATORY staging (the host kNN rung reads it): the
@@ -654,6 +726,8 @@ class Segment:
             self.owner_index or "_unassigned",
             self.nd_pad * d_pad * 2, exclude_scope=self.ledger_scope,
             mandatory=True)
+        on_device_staging(self.owner_index or "_unassigned",
+                          KIND_EMBEDDINGS, emb_key)
         emb = np.zeros((self.nd_pad, d_pad), np.float32)
         emb[:, : col.dims] = col.vectors
         exists1 = np.zeros(self.nd_pad + 1, bool)
@@ -677,20 +751,37 @@ class Segment:
             with self._device_stage_lock:
                 if key in cache:  # racing cold stager built it
                     return cache[key]
-                import time as _time
 
-                import jax.numpy as jnp
+                def _stage_column():
+                    import time as _time
 
-                t0 = _time.monotonic()
-                cache[key] = jnp.asarray(build())
-                try:
-                    nbytes = int(cache[key].nbytes)
-                except (TypeError, AttributeError):
-                    nbytes = 0  # non-array cache values (slice masks etc.)
-                if nbytes:
-                    self._account(KIND_DOC_VALUES, f"col:{key}", nbytes,
-                                  duration_ms=(_time.monotonic() - t0)
-                                  * 1000.0)
+                    import jax.numpy as jnp
+
+                    from elasticsearch_tpu.testing.disruption import (
+                        on_device_staging,
+                    )
+
+                    t0 = _time.monotonic()
+                    on_device_staging(self.owner_index or "_unassigned",
+                                      KIND_DOC_VALUES, f"col:{key}")
+                    cache[key] = jnp.asarray(build())
+                    try:
+                        nbytes = int(cache[key].nbytes)
+                    except (TypeError, AttributeError):
+                        nbytes = 0  # non-array values (slice masks etc.)
+                    if nbytes:
+                        self._account(
+                            KIND_DOC_VALUES, f"col:{key}", nbytes,
+                            duration_ms=(_time.monotonic() - t0) * 1000.0)
+
+                from elasticsearch_tpu.common.staging import run_staged
+
+                # transient faults retry with backoff; a terminal fault
+                # propagates (the sort/agg consumer needs the column —
+                # shard-failure isolation owns it, PR 4)
+                run_staged(_stage_column,
+                           index=self.owner_index or "_unassigned",
+                           kind=KIND_DOC_VALUES, plane="host")
         return cache[key]
 
     def release_device_staging(self) -> None:

@@ -108,6 +108,30 @@ class Node:
         # value-only consumer callback can't see; put_cluster_settings
         # syncs svc.pruning_*_override from the committed merged
         # settings instead. docs/PRUNING.md)
+        # device-staging retry knobs (search.staging.retry.* — ISSUE 10,
+        # docs/RESILIENCE.md): seed the process-level config from the
+        # node file and keep it live under PUT _cluster/settings (the
+        # explicitness-aware clear is synced in put_cluster_settings)
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_STAGING_RETRY_BACKOFF_MS,
+            SEARCH_STAGING_RETRY_MAX_ATTEMPTS,
+        )
+
+        from elasticsearch_tpu.common.staging import configure_staging_retry
+
+        configure_staging_retry(
+            max_attempts=settings.get_int(
+                "search.staging.retry.max_attempts",
+                SEARCH_STAGING_RETRY_MAX_ATTEMPTS.default),
+            backoff_ms=settings.get_float(
+                "search.staging.retry.backoff_ms",
+                SEARCH_STAGING_RETRY_BACKOFF_MS.default))
+        self.cluster_settings.add_settings_update_consumer(
+            SEARCH_STAGING_RETRY_MAX_ATTEMPTS,
+            lambda v: configure_staging_retry(max_attempts=int(v)))
+        self.cluster_settings.add_settings_update_consumer(
+            SEARCH_STAGING_RETRY_BACKOFF_MS,
+            lambda v: configure_staging_retry(backoff_ms=float(v)))
         self.data_path = data_path or PATH_DATA.get(settings)
         self.persistent_path = data_path is not None or "path.data" in settings
         # secure settings from the encrypted keystore (KeyStoreWrapper):
@@ -244,6 +268,9 @@ class Node:
         # consumers only reach batchers alive at update time; the pruning
         # knobs are re-read per query from the index's Settings map)
         state = self.cluster_service.state
+        # (search.staging.retry.* deliberately NOT seeded per index: the
+        # retry config is process-level — a create-time snapshot in the
+        # index Settings would shadow later dynamic cluster updates)
         for prefix in ("search.batch.", "search.pallas.", "search.knn.",
                        "search.telemetry."):
             cluster_dynamic = state.persistent_settings.merged_with(
@@ -1657,6 +1684,22 @@ class Node:
         else:
             memory_accountant().set_budget(
                 self.settings.get_bytes(budget_key, 0))
+        # device-staging retry knobs (search.staging.retry.*): explicit
+        # cluster values win; clearing them reverts to the node file
+        # (the value-only update consumers can't see explicitness)
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_STAGING_RETRY_BACKOFF_MS,
+            SEARCH_STAGING_RETRY_MAX_ATTEMPTS,
+        )
+
+        from elasticsearch_tpu.common.staging import configure_staging_retry
+
+        for setting, kw in (
+                (SEARCH_STAGING_RETRY_MAX_ATTEMPTS, "max_attempts"),
+                (SEARCH_STAGING_RETRY_BACKOFF_MS, "backoff_ms")):
+            source = (committed if committed.get(setting.key) is not None
+                      else self.settings)
+            configure_staging_retry(**{kw: setting.get(source)})
         return {
             "acknowledged": True,
             "persistent": state.persistent_settings.as_nested_dict(),

@@ -49,6 +49,15 @@ class PlanStructureMismatch(Exception):
     caller falls back to the host-merge path."""
 
 
+from elasticsearch_tpu.common.staging import StagingBail  # noqa: E402
+
+
+class _KnnStructuralError(StagingBail):
+    """A dense_vector field cannot stage on this segment set (dims
+    mismatch vs the mapping): permanent structural inability, never a
+    device fault — ensure_knn pins the field to the host rung."""
+
+
 _plane_logger = logging.getLogger("elasticsearch_tpu.parallel.plane")
 
 # Two mesh programs in flight at once interleave their collective
@@ -67,34 +76,106 @@ class PlaneHealth:
     A mesh_pallas / mesh plane that RAISES (compile error, device OOM,
     runtime fault — as opposed to a clean PlanStructureMismatch shape
     fallback) is benched for ``cooldown_s``: queries serve from the next
-    rung of the ladder without re-paying the failure, and after the
-    cooldown the next query probes the plane again. Counters export via
-    _stats planes (`plane_failures_total`, `plane_quarantined`)."""
+    rung of the ladder without re-paying the failure. After the cooldown
+    the plane is HALF-OPEN: exactly ONE query is admitted as the probe
+    (single-flight — ISSUE 10) while its peers keep serving the healthy
+    rung, so a concurrent burst arriving at cooldown expiry never
+    re-pays the fault N times. The probe's success re-opens the plane;
+    its failure re-benches it for another cooldown. A probe that bails
+    without executing (shape fallback, deadline) releases its admission;
+    a prober that dies silently is covered by a bounded lease
+    (``PROBE_LEASE_S``). Counters export via _stats planes
+    (`plane_failures_total`, `plane_failures_by_reason`,
+    `plane_quarantined`, `plane_probes_total`)."""
 
     PLANES = ("mesh_pallas", "mesh")
     MAX_EVENTS = 32
+    # a probe admission expires after this long if the prober never
+    # reported back (crashed thread) — the backstop, not the contract
+    PROBE_LEASE_S = 30.0
 
     def __init__(self, cooldown_s: float = 60.0):
         self.cooldown_s = float(cooldown_s)
         self.failures_total: Dict[str, int] = {p: 0 for p in self.PLANES}
+        # per-reason fault counters (ISSUE 10): `kernel_fault` = the
+        # compiled program raised; `staging_fault` = a device staging
+        # faulted terminally (classified transient-exhausted or
+        # deterministic — see docs/RESILIENCE.md)
+        self.failures_by_reason: Dict[str, int] = {}
+        self.probes_total = 0
         self._quarantined_until: Dict[str, float] = {}
+        self._probe_until: Dict[str, float] = {}
+        self._lock = threading.Lock()
         # quarantine event log (docs/OBSERVABILITY.md): wall-clock
         # timestamps so operators can join a latency regression to the
         # fault that demoted the plane; capped, oldest dropped
         self.events: List[dict] = []
 
-    def record_failure(self, plane: str) -> None:
-        self.failures_total[plane] = self.failures_total.get(plane, 0) + 1
-        self._quarantined_until[plane] = _time.monotonic() + self.cooldown_s
-        self.events.append({
-            "plane": plane,
-            "timestamp_ms": int(_time.time() * 1000),
-            "cooldown_s": self.cooldown_s,
-        })
-        if len(self.events) > self.MAX_EVENTS:
-            del self.events[0]
+    def record_failure(self, plane: str,
+                       reason: str = "kernel_fault") -> None:
+        with self._lock:
+            self.failures_total[plane] = \
+                self.failures_total.get(plane, 0) + 1
+            self.failures_by_reason[reason] = \
+                self.failures_by_reason.get(reason, 0) + 1
+            self._quarantined_until[plane] = (_time.monotonic()
+                                              + self.cooldown_s)
+            self._probe_until.pop(plane, None)
+            self.events.append({
+                "plane": plane,
+                "reason": reason,
+                "timestamp_ms": int(_time.time() * 1000),
+                "cooldown_s": self.cooldown_s,
+            })
+            if len(self.events) > self.MAX_EVENTS:
+                del self.events[0]
+
+    def admit(self, plane: str) -> str:
+        """Single-flight admission gate for the ladder: ``"open"`` =
+        plane healthy, attempt freely; ``"probe"`` = the caller is THE
+        post-cooldown probe (it must end in note_success /
+        record_failure / release_probe); ``""`` (falsy) = benched, or a
+        peer's probe is in flight — serve the next rung."""
+        now = _time.monotonic()
+        with self._lock:
+            until = self._quarantined_until.get(plane)
+            if until is None:
+                return "open"
+            if now < until:
+                return ""
+            lease = self._probe_until.get(plane, 0.0)
+            if now < lease:
+                return ""  # a peer is probing: single-flight
+            self._probe_until[plane] = now + self.PROBE_LEASE_S
+            self.probes_total += 1
+            return "probe"
+
+    def note_success(self, plane: str) -> None:
+        """The plane served a query to completion: fully re-open it
+        (clears any quarantine + probe lease; no-op when healthy)."""
+        if plane not in self._quarantined_until:
+            return  # lock-free fast path for the healthy hot path
+        with self._lock:
+            self._quarantined_until.pop(plane, None)
+            self._probe_until.pop(plane, None)
+
+    def release_probe(self, plane: str) -> None:
+        """The probe bailed without executing the plane (shape
+        fallback, staging ineligibility, deadline): hand the admission
+        back so the next query may probe. Idempotent; never clears a
+        quarantine record_failure re-armed. An un-consumed admission is
+        also un-COUNTED — ``plane_probes_total`` reports probes that
+        actually reached a verdict (success or failure), so a plane
+        that turned structurally ineligible while benched doesn't grow
+        the counter one admission per query forever."""
+        with self._lock:
+            if self._probe_until.pop(plane, None) is not None:
+                self.probes_total -= 1
 
     def available(self, plane: str) -> bool:
+        """Non-consuming view (stats + cheap pre-checks): False only
+        while benched inside the cooldown. A half-open plane reads as
+        available — use ``admit`` on the serving path."""
         return _time.monotonic() >= self._quarantined_until.get(plane, 0.0)
 
     def quarantined(self) -> List[str]:
@@ -105,6 +186,8 @@ class PlaneHealth:
     def stats(self) -> dict:
         return {
             "plane_failures_total": dict(self.failures_total),
+            "plane_failures_by_reason": dict(self.failures_by_reason),
+            "plane_probes_total": self.probes_total,
             "plane_quarantined": self.quarantined(),
             "quarantine_events": list(self.events),
         }
@@ -795,6 +878,13 @@ class IndexMeshSearch:
         # the accountant invokes it under its own lock and a stager
         # inside this lock may be waiting on the accountant's.
         self._stage_lock = threading.Lock()
+        # staging-fault bench state (ISSUE 10): a terminal (classified)
+        # staging fault benches the mesh staging until this monotonic
+        # deadline; after it, exactly one query probes the restage
+        # (_stage_probing) while peers serve the host rung
+        self._staging_fault_until = 0.0
+        self._staging_faulted = False
+        self._stage_probing = False
 
     @property
     def staging_denied_reason(self):
@@ -860,6 +950,14 @@ class IndexMeshSearch:
 
     def _ensure_staged(self) -> bool:
         self.staging_denied_reason = None
+        # staging-fault backoff (ISSUE 10, docs/RESILIENCE.md): after a
+        # terminal staging fault the mesh staging is benched for the
+        # quarantine cooldown — every query until then demotes to the
+        # host rung (reason staging_fault) instead of re-paying the
+        # multi-second staging attempt per query
+        if _time.monotonic() < self._staging_fault_until:
+            self.staging_denied_reason = "staging_fault"
+            return False
         pairs = self._current_pairs()
         if not pairs:
             return False
@@ -874,6 +972,14 @@ class IndexMeshSearch:
         # racing an install): the next query restages instead of being
         # stuck demoted until the segment set changes
         if key != self._staged_key or self._executor is None:
+            if self._stage_probing:
+                # single-flight restage probe: a post-fault restage
+                # attempt is in flight on a peer — don't pile onto the
+                # lock behind a staging that may fault again; serve the
+                # host rung until the probe commits (racy read: worst
+                # case we wait on the lock like any cold staging)
+                self.staging_denied_reason = "staging_fault"
+                return False
             with self._stage_lock:
                 executor = self._executor
                 if key == self._staged_key and executor is not None:
@@ -881,8 +987,13 @@ class IndexMeshSearch:
                     # we waited — reuse its generation
                     executor.touch()
                     return True
+                if _time.monotonic() < self._staging_fault_until:
+                    # a concurrent attempt faulted while we waited
+                    self.staging_denied_reason = "staging_fault"
+                    return False
                 from elasticsearch_tpu.common.memory import \
                     memory_accountant
+                from elasticsearch_tpu.common.staging import run_staged
 
                 n_dev = mesh.devices.size
                 n_slots = max(1, -(-len(pairs) // n_dev)) * n_dev
@@ -906,21 +1017,59 @@ class IndexMeshSearch:
                     if settings is not None else None)
                 reason = self._restage_reason(self._staged_key, key,
                                               self._executor, n_slots)
+                if self._staging_faulted:
+                    self._stage_probing = True
                 old = self._executor
                 # construct UNARMED (not yet evictable), install, THEN
                 # arm: a budget eviction firing mid-construction would
                 # otherwise run _drop_staging against the PREVIOUS
                 # generation and the install below would pin a staged
-                # key whose executor is gone (see make_evictable)
-                staged = MeshPlanExecutor(
-                    [seg for _, seg in pairs], mesh, postings_codec=codec,
-                    index_name=self.svc.name, stage_reason=reason)
+                # key whose executor is gone (see make_evictable).
+                # The construction is one transactional staging attempt
+                # (register-then-commit: a constructor fault registers
+                # nothing) run through the classified retry loop —
+                # transient device faults back off and retry, terminal
+                # faults bench the staging AND quarantine the kernel
+                # plane with reason staging_fault. The retry budget is
+                # the PROCESS-level config (node file + live cluster
+                # updates via configure_staging_retry) — NOT the index's
+                # create-time Settings snapshot, which would freeze it
+                # against later dynamic updates.
+                try:
+                    staged = run_staged(
+                        lambda: MeshPlanExecutor(
+                            [seg for _, seg in pairs], mesh,
+                            postings_codec=codec,
+                            index_name=self.svc.name,
+                            stage_reason=reason),
+                        index=self.svc.name, kind="mesh_slot_tables",
+                        plane="mesh")
+                except Exception:  # noqa: BLE001 — terminal classified
+                    # staging fault: bench the staging for the cooldown
+                    # and quarantine the plane so _stats planes tells
+                    # staging_fault from kernel_fault (docs/RESILIENCE.md)
+                    _plane_logger.warning(
+                        "[%s] mesh staging failed; serving from the host "
+                        "rung for %.1fs (reason staging_fault)",
+                        self.svc.name, self.plane_health.cooldown_s,
+                        exc_info=True)
+                    self._staging_faulted = True
+                    self._staging_fault_until = (
+                        _time.monotonic() + self.plane_health.cooldown_s)
+                    self.plane_health.record_failure(
+                        "mesh_pallas", reason="staging_fault")
+                    self.staging_denied_reason = "staging_fault"
+                    return False
+                finally:
+                    self._stage_probing = False
                 staged.pairs = pairs
                 if old is not None:
                     old.release()
                 self._pairs = pairs
                 self._executor = staged
                 self._staged_key = key
+                self._staging_faulted = False
+                self._staging_fault_until = 0.0
                 staged.make_evictable(self._drop_staging)
         else:
             executor = self._executor
@@ -1014,23 +1163,41 @@ class IndexMeshSearch:
         ``stats``: one request-body "stats" groups list per member (the
         per-shard group counters must not depend on which plane served
         the query)."""
+        if self.plane_pref not in ("auto", "pallas"):
+            return None
+        # single-flight admission (ISSUE 10): after a quarantine's
+        # cooldown exactly ONE batch probes the plane; peers serve the
+        # healthy rung until the probe commits or fails
+        adm = self.plane_health.admit("mesh_pallas")
+        if not adm:
+            self._note("mesh_pallas", "quarantined", len(specs))
+            return None
+        try:
+            return self._query_knn_batch_admitted(specs, ks, deadline,
+                                                  stats, tracers)
+        finally:
+            if adm == "probe":
+                # idempotent: a served batch already re-opened the plane
+                # (note_success) and a fault re-benched it
+                self.plane_health.release_probe("mesh_pallas")
+
+    def _query_knn_batch_admitted(self, specs, ks, deadline, stats,
+                                  tracers) -> Optional[list]:
         from elasticsearch_tpu.index.segment import next_pow2
         from elasticsearch_tpu.mapper.field_types import DenseVectorFieldType
         from elasticsearch_tpu.ops import pallas_knn as pkn
         from elasticsearch_tpu.ops import pallas_scoring as psc
         from elasticsearch_tpu.search.service import DocRef
-        from elasticsearch_tpu.testing.disruption import on_plane_execute
+        from elasticsearch_tpu.testing.disruption import (
+            on_kernel_launch,
+            on_plane_execute,
+        )
 
         from elasticsearch_tpu.search.telemetry import (
             NULL_TRACER,
             QueryTracer,
         )
 
-        if self.plane_pref not in ("auto", "pallas"):
-            return None
-        if not self.plane_health.available("mesh_pallas"):
-            self._note("mesh_pallas", "quarantined", len(specs))
-            return None
         if len(self.svc.shards) < 2:
             return None
         enabled, sub_pref = self._knn_config()
@@ -1079,8 +1246,15 @@ class IndexMeshSearch:
             return None
         session = executor.ensure_knn(field, ft.dims, ft.similarity)
         if session is None:
-            self._note("host", executor.kernel_denied_reason
-                       or "knn_staging_unavailable", len(specs))
+            reason = executor.kernel_denied_reason
+            self._note("host", reason or "knn_staging_unavailable",
+                       len(specs))
+            if reason == "staging_fault":
+                # a terminal classified staging fault: bench the plane
+                # so peers don't re-pay the staging attempt per query
+                # (the post-cooldown probe restages — docs/RESILIENCE.md)
+                self.plane_health.record_failure("mesh_pallas",
+                                                 reason="staging_fault")
             return None
         q_batch = len(specs)
         q_pad = next_pow2(q_batch)
@@ -1112,6 +1286,7 @@ class IndexMeshSearch:
                 # a first call compiles the program (seconds): honor the
                 # deadline before committing to the launch
                 deadline.checkpoint()
+            on_kernel_launch(self.svc.name, "knn")
             t_kernel = bt.start("kernel")
             with _MESH_EXEC_LOCK:
                 outs = run(*args)
@@ -1132,6 +1307,9 @@ class IndexMeshSearch:
             self.plane_health.record_failure("mesh_pallas")
             self._note("mesh_pallas", "fault", q_batch)
             return None
+        # the launch committed: fully re-open the plane (a probe's
+        # success ends the quarantine — single-flight contract)
+        self.plane_health.note_success("mesh_pallas")
         with self._counter_lock:
             self.query_total += q_batch
             self.pallas_query_total += q_batch
@@ -1419,87 +1597,117 @@ class IndexMeshSearch:
         from elasticsearch_tpu.search.cancellation import (
             TimeExceededException,
         )
-        from elasticsearch_tpu.testing.disruption import on_plane_execute
+        from elasticsearch_tpu.testing.disruption import (
+            on_kernel_launch,
+            on_plane_execute,
+        )
 
+        # single-flight admission per plane (ISSUE 10): "open" attempts
+        # freely; "probe" is the one post-cooldown trial whose admission
+        # must be handed back if it bails without executing; "" skips
+        admissions: Dict[str, str] = {}
         kernel_session = None
         if self.plane_pref in ("auto", "pallas"):
-            if self.plane_health.available("mesh_pallas"):
+            admissions["mesh_pallas"] = self.plane_health.admit(
+                "mesh_pallas")
+            if admissions["mesh_pallas"]:
                 kernel_session = executor.ensure_kernel()
                 if (kernel_session is None
                         and executor.kernel_denied_reason):
-                    # HBM budget turned the kernel staging away: the
-                    # ladder's next rung serves (docs/OBSERVABILITY.md)
-                    self._note("mesh_pallas",
-                               executor.kernel_denied_reason)
+                    # HBM budget / staging fault turned the kernel
+                    # staging away: the ladder's next rung serves
+                    # (docs/OBSERVABILITY.md)
+                    reason = executor.kernel_denied_reason
+                    self._note("mesh_pallas", reason)
+                    if reason == "staging_fault":
+                        self.plane_health.record_failure(
+                            "mesh_pallas", reason="staging_fault")
             else:
                 self._note("mesh_pallas", "quarantined")
         attempts = []
         if kernel_session is not None:
             attempts.append(("mesh_pallas", kernel_session))
-        if (self.plane_pref != "pallas"
-                and self.plane_health.available("mesh")):
-            # plane=pallas pins "kernel or host": when the kernel is
-            # unavailable OR quarantined, the ladder's next rung is the
-            # host path, never the scatter mesh the operator excluded
-            attempts.append(("mesh", None))
+        if self.plane_pref != "pallas":
+            admissions["mesh"] = self.plane_health.admit("mesh")
+            if admissions["mesh"]:
+                # plane=pallas pins "kernel or host": when the kernel is
+                # unavailable OR quarantined, the ladder's next rung is
+                # the host path, never the scatter mesh the operator
+                # excluded
+                attempts.append(("mesh", None))
         outs = None
         used_pallas = False
-        for plane, session in attempts:
-            if deadline is not None:
-                deadline.checkpoint()
-            try:
-                on_plane_execute(self.svc.name, plane)
-                t_plan = tracer.start("plan_build")
-                plans = []
-                pf_plans = [] if pf_qb is not None else None
-                rs_plans = [] if rs_qb is not None else None
-                ctxs = {}
-                for sid, seg in executor.pairs:
-                    shard = self.svc.shards[sid]
-                    ctx = ShardQueryContext(shard.mapper_service,
-                                            engine=shard.engine)
-                    # mesh plans must stack across shards: scorer nodes
-                    # keep one skeleton on every shard, and kernel nodes
-                    # defer table geometry to harmonization below
-                    ctx.for_mesh = True
-                    ctx.mesh_kernel = session
-                    ctxs[sid] = ctx
-                    plans.append(qb.to_plan(ctx, seg))
-                    # post_filter/rescore plans stay on scatter nodes:
-                    # they gate/adjust, the main scorer is the hot loop
-                    ctx.mesh_kernel = None
-                    if pf_qb is not None:
-                        pf_plans.append(pf_qb.to_plan(ctx, seg))
-                    if rs_qb is not None:
-                        rs_plans.append(rs_qb.to_plan(ctx, seg))
-                used_pallas = False
-                if session is not None:
-                    used_pallas = executor.harmonize_kernel_nodes(
-                        plans) > 0
-                tracer.stop("plan_build", t_plan)
-                outs = executor.execute(
-                    plans, k, sort_keys=sort_keys,
-                    with_views=bool(agg_specs), pf_plans=pf_plans,
-                    rs_plans=rs_plans, scalars=scalars,
-                    features=frozenset(features), slice_col=slice_col,
-                    rescore_static=rescore_static, tracer=tracer)
-                break
-            except (PlanStructureMismatch, NotImplementedError):
-                self._note(plane, "shape_mismatch")
-                continue  # shape ineligibility: next plane (no penalty)
-            except (TaskCancelledException, TimeExceededException):
-                raise
-            except Exception:  # noqa: BLE001 — plane fault, not a shape miss
-                # compile error / device OOM / runtime fault (or injected
-                # PlaneFailScheme): bench the plane for the cooldown and
-                # serve this query from the next rung
-                _plane_logger.warning(
-                    "[%s] execution plane [%s] failed; quarantined for "
-                    "%.1fs", self.svc.name, plane,
-                    self.plane_health.cooldown_s, exc_info=True)
-                self.plane_health.record_failure(plane)
-                self._note(plane, "fault")
-                continue
+        try:
+            for plane, session in attempts:
+                if deadline is not None:
+                    deadline.checkpoint()
+                try:
+                    on_plane_execute(self.svc.name, plane)
+                    t_plan = tracer.start("plan_build")
+                    plans = []
+                    pf_plans = [] if pf_qb is not None else None
+                    rs_plans = [] if rs_qb is not None else None
+                    ctxs = {}
+                    for sid, seg in executor.pairs:
+                        shard = self.svc.shards[sid]
+                        ctx = ShardQueryContext(shard.mapper_service,
+                                                engine=shard.engine)
+                        # mesh plans must stack across shards: scorer
+                        # nodes keep one skeleton on every shard, and
+                        # kernel nodes defer table geometry to
+                        # harmonization below
+                        ctx.for_mesh = True
+                        ctx.mesh_kernel = session
+                        ctxs[sid] = ctx
+                        plans.append(qb.to_plan(ctx, seg))
+                        # post_filter/rescore plans stay on scatter
+                        # nodes: they gate/adjust, the main scorer is
+                        # the hot loop
+                        ctx.mesh_kernel = None
+                        if pf_qb is not None:
+                            pf_plans.append(pf_qb.to_plan(ctx, seg))
+                        if rs_qb is not None:
+                            rs_plans.append(rs_qb.to_plan(ctx, seg))
+                    used_pallas = False
+                    if session is not None:
+                        used_pallas = executor.harmonize_kernel_nodes(
+                            plans) > 0
+                    tracer.stop("plan_build", t_plan)
+                    on_kernel_launch(self.svc.name, plane)
+                    outs = executor.execute(
+                        plans, k, sort_keys=sort_keys,
+                        with_views=bool(agg_specs), pf_plans=pf_plans,
+                        rs_plans=rs_plans, scalars=scalars,
+                        features=frozenset(features), slice_col=slice_col,
+                        rescore_static=rescore_static, tracer=tracer)
+                    # the plane served: fully re-open it (ends a probe's
+                    # quarantine — single-flight contract)
+                    self.plane_health.note_success(plane)
+                    break
+                except (PlanStructureMismatch, NotImplementedError):
+                    self._note(plane, "shape_mismatch")
+                    continue  # shape ineligibility: next plane (no penalty)
+                except (TaskCancelledException, TimeExceededException):
+                    raise
+                except Exception:  # noqa: BLE001 — plane fault, not a
+                    # shape miss: compile error / device OOM / runtime
+                    # fault (or injected scheme) — bench the plane for
+                    # the cooldown and serve from the next rung
+                    _plane_logger.warning(
+                        "[%s] execution plane [%s] failed; quarantined "
+                        "for %.1fs", self.svc.name, plane,
+                        self.plane_health.cooldown_s, exc_info=True)
+                    self.plane_health.record_failure(plane)
+                    self._note(plane, "fault")
+                    continue
+        finally:
+            # any probe admission not consumed by note_success /
+            # record_failure (shape fallback, deadline, early bail)
+            # hands its single-flight slot back — idempotent after
+            # either of those
+            for plane, adm in admissions.items():
+                if adm == "probe":
+                    self.plane_health.release_probe(plane)
         if outs is None:
             self._note("host", "no_mesh_plane")
             return None
@@ -1613,6 +1821,22 @@ class IndexMeshSearch:
         checkpointed before table building and before the launch, same
         contract as the serial ladder. Batch callers (search_batch)
         handle per-member deadlines themselves and pass None."""
+        if self.plane_pref not in ("auto", "pallas"):
+            return None
+        # single-flight admission (ISSUE 10): after cooldown exactly
+        # ONE batch probes the benched plane; peers serve the next rung
+        adm = self.plane_health.admit("mesh_pallas")
+        if not adm:
+            self._note("mesh_pallas", "quarantined", len(bodies))
+            return None
+        try:
+            return self._query_batch_admitted(bodies, deadline, tracers)
+        finally:
+            if adm == "probe":
+                self.plane_health.release_probe("mesh_pallas")
+
+    def _query_batch_admitted(self, bodies, deadline,
+                              tracers) -> Optional[list]:
         from elasticsearch_tpu.index.segment import next_pow2
         from elasticsearch_tpu.ops import pallas_scoring as psc
         from elasticsearch_tpu.search.plan import PallasScoreTermsNode
@@ -1625,13 +1849,11 @@ class IndexMeshSearch:
             NULL_TRACER,
             QueryTracer,
         )
-        from elasticsearch_tpu.testing.disruption import on_plane_execute
+        from elasticsearch_tpu.testing.disruption import (
+            on_kernel_launch,
+            on_plane_execute,
+        )
 
-        if self.plane_pref not in ("auto", "pallas"):
-            return None
-        if not self.plane_health.available("mesh_pallas"):
-            self._note("mesh_pallas", "quarantined", len(bodies))
-            return None
         if len(self.svc.shards) < 2:
             return None
         for body in bodies:
@@ -1659,8 +1881,15 @@ class IndexMeshSearch:
         session = executor.ensure_kernel()
         bt.stop("staging", t_stage0)
         if session is None:
-            self._note("host", executor.kernel_denied_reason
-                       or "staging_unavailable", len(bodies))
+            reason = executor.kernel_denied_reason
+            self._note("host", reason or "staging_unavailable",
+                       len(bodies))
+            if reason == "staging_fault":
+                # terminal classified staging fault: quarantine so the
+                # next queries skip straight to the healthy rung and the
+                # post-cooldown probe restages (docs/RESILIENCE.md)
+                self.plane_health.record_failure("mesh_pallas",
+                                                 reason="staging_fault")
             return None
         q_batch = len(bodies)
         ks = []
@@ -1852,6 +2081,7 @@ class IndexMeshSearch:
                     # a first call compiles the pruned program (seconds):
                     # honor the deadline before committing to the launch
                     deadline.checkpoint()
+                on_kernel_launch(self.svc.name, "pruned")
                 t_kernel = bt.start("kernel")
                 with _MESH_EXEC_LOCK:
                     outs = run(*args)
@@ -1887,6 +2117,7 @@ class IndexMeshSearch:
                 bt.stop("staging", t_stage)
                 if deadline is not None:
                     deadline.checkpoint()
+                on_kernel_launch(self.svc.name, "batched")
                 t_kernel = bt.start("kernel")
                 with _MESH_EXEC_LOCK:
                     outs = run(*args)
@@ -1918,6 +2149,9 @@ class IndexMeshSearch:
             self.plane_health.record_failure("mesh_pallas")
             self._note("mesh_pallas", "fault", q_batch)
             return None
+        # the launch committed: fully re-open the plane (a probe's
+        # success ends the quarantine — single-flight contract)
+        self.plane_health.note_success("mesh_pallas")
         with self._counter_lock:
             self.query_total += q_batch
             self.pallas_query_total += q_batch
@@ -2033,11 +2267,26 @@ class MeshPlanExecutor:
         self.postings_codec = "raw"
         self.slots_per_dev = max(1, -(-len(segments) // self.n_dev))
         self.n_slots = self.slots_per_dev * self.n_dev
+        # set by release(): a query pinned to a replaced generation may
+        # still lazily stage tables — those must NOT re-register under
+        # the already-released ledger scope (see _account)
+        self._released = False
+        # serializes the lazy kernel/kNN cold stagings: two concurrent
+        # first-queries must not both pay the transfer (and the loser's
+        # re-registration would misclassify as a restage)
+        self._kernel_stage_lock = threading.Lock()
         t0 = _time.monotonic()
         stacked = stack_shard_arrays(segments, self.n_slots)
         self.nd_pad = stacked.pop("nd_pad")
         self.nd1 = self.nd_pad + 1
         sharding = NamedSharding(self.mesh, PS("shards"))
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
+        # injection point for the base mesh staging (ISSUE 10): a raise
+        # here aborts the constructor with nothing registered — the
+        # owner's run_staged loop retries/classifies
+        on_device_staging(self.index_name, "mesh_slot_tables",
+                          "seg_stacked")
         self._seg_staged = {
             name: jax.device_put(arr, sharding)
             for name, arr in stacked.items()
@@ -2097,6 +2346,15 @@ class MeshPlanExecutor:
                  quiet: bool = False) -> None:
         from elasticsearch_tpu.common.memory import memory_accountant
 
+        if self._released:
+            # a query that pinned this generation before a concurrent
+            # refresh replaced it may lazily stage MORE tables while
+            # finishing: registering them would resurrect the released
+            # scope (ledger bytes backed only by the query's transient
+            # references, with an evict callback that would drop the
+            # CURRENT generation). The arrays free with the query's
+            # references; the ledger stays exact.
+            return
         memory_accountant().register(
             self.index_name, self.scope, kind, table, int(nbytes),
             reason=reason or self._stage_reason, duration_ms=duration_ms,
@@ -2108,6 +2366,7 @@ class MeshPlanExecutor:
         the last in-flight query drops its references (refcounting)."""
         from elasticsearch_tpu.common.memory import memory_accountant
 
+        self._released = True
         return memory_accountant().release_scope(self.index_name,
                                                  self.scope)
 
@@ -2128,7 +2387,16 @@ class MeshPlanExecutor:
         packed per slot, and the per-slot transposed live masks. Returns
         the kernel session (plan builders consult it via
         ``ctx.mesh_kernel``) or None when the kernel can't run (pallas
-        off / non-TPU backend without interpret mode)."""
+        off / non-TPU backend without interpret mode).
+
+        Staging is TRANSACTIONAL (ISSUE 10, docs/RESILIENCE.md): a fault
+        mid-sequence drops every partially-published ``_seg_staged``
+        entry (nothing registers with the accountant until the whole
+        group staged — no orphaned HBM bytes); transient device faults
+        retry with bounded backoff (``search.staging.retry.*``), and a
+        terminal fault sets ``kernel_denied_reason = "staging_fault"``
+        (the caller quarantines the plane) while ``_kernel`` stays None
+        so the post-cooldown probe can restage once the fault clears."""
         from elasticsearch_tpu.ops.aggs import _pallas_mode
 
         # reset FIRST — before every early return: a thread whose last
@@ -2139,21 +2407,21 @@ class MeshPlanExecutor:
         mode = _pallas_mode()
         if not mode:
             return None
-        if self._kernel is False:
-            return None
         from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.common.staging import run_staged
         from elasticsearch_tpu.ops import pallas_scoring as psc
 
         if self._kernel is None:
-            t0 = _time.monotonic()
-            try:
+            with self._kernel_stage_lock:
+                if isinstance(self._kernel, dict):  # a racing cold
+                    return dict(self._kernel, mode=mode)  # stager built it
                 geom = psc.tile_geometry(max(self.nd_pad, psc.LANE))
                 # codec resolution against the STACKED doc space: every
                 # slot's doc ids must fit the packed word's doc bits
                 codec = psc.resolve_postings_codec(
                     self.postings_codec_pref, geom.nd_pad)
-                n_rows = max(s.block_docs.shape[0] for s in self.segments) \
-                    + psc.CB_MAX
+                n_rows = max(s.block_docs.shape[0]
+                             for s in self.segments) + psc.CB_MAX
                 # HBM budget gate: the kernel tables are the big mesh
                 # allocation — over budget (after LRU eviction) the
                 # ladder serves from the scatter mesh / host rung with
@@ -2169,73 +2437,109 @@ class MeshPlanExecutor:
                         exclude_scope=self.scope):
                     self.kernel_denied_reason = "hbm_budget"
                     return None
-                if codec == "packed":
-                    packed = np.zeros((self.n_slots, n_rows, psc.LANE),
-                                      np.int32)
-                else:
-                    docs = np.full((self.n_slots, n_rows, psc.LANE),
-                                   self.nd_pad, np.int32)
-                    frac = np.zeros((self.n_slots, n_rows, psc.LANE),
-                                    np.float32)
-                live_t = np.zeros(
-                    (self.n_slots, geom.n_tiles * psc.LANE, geom.tile_sub),
-                    np.float32)
-                meta = {}
-                for i, seg in enumerate(self.segments):
-                    f = seg._block_frac()
-                    bmin, bmax = psc.block_min_max(
-                        seg.block_docs, seg.block_tfs, seg.nd_pad)
-                    if codec == "packed":
-                        fq = psc.quantize_frac(f)  # one pass serves both
-                        pk = psc.pack_segment_blocks(seg.block_docs, f,
-                                                     seg.nd_pad, q=fq)
-                        packed[i, : pk.shape[0]] = pk
-                        # bounds must dominate the DEQUANTIZED values the
-                        # kernel decodes (rounding can lift a posting up
-                        # to half a quantization step)
-                        bfmax = psc.block_frac_max(
-                            psc.dequantize_frac(fq))
-                    else:
-                        dp, fp = psc.pad_segment_blocks(seg.block_docs, f,
-                                                        seg.nd_pad)
-                        docs[i, : dp.shape[0]] = dp
-                        frac[i, : fp.shape[0]] = fp
-                        bfmax = psc.block_frac_max(f)
-                    live = np.zeros(geom.nd_pad, np.float32)
-                    live[: seg.nd_pad] = seg.live.astype(np.float32)
-                    live_t[i] = psc.build_live_t(live, geom)
-                    meta[id(seg)] = (bmin, bmax, bfmax)
-                if codec == "packed":
-                    self._seg_staged["k_packed"] = jax.device_put(
-                        packed, self._sharding)
-                    self.postings_bytes_staged = int(packed.nbytes)
-                else:
-                    self._seg_staged["k_docs"] = jax.device_put(
-                        docs, self._sharding)
-                    self._seg_staged["k_frac"] = jax.device_put(
-                        frac, self._sharding)
-                    self.postings_bytes_staged = int(docs.nbytes
-                                                     + frac.nbytes)
-                self._seg_staged["k_live_t"] = jax.device_put(
-                    live_t, self._sharding)
-                self.postings_codec = codec
-                self._kernel = {"geom": geom, "meta": meta,
-                                "codec": codec}
-                dur = (_time.monotonic() - t0) * 1000.0
-                self._account("postings_packed" if codec == "packed"
-                              else "postings_raw", "k_postings",
-                              self.postings_bytes_staged,
-                              duration_ms=dur)
-                self._account("live_mask", "k_live_t",
-                              int(live_t.nbytes), duration_ms=dur)
-                # per-segment block min/max/frac-max bound columns stay
-                # host-resident but scale with the staged plane
-                self._account("bound_tables", "k_bounds", sum(
-                    int(b.nbytes) for t in meta.values() for b in t))
-            except Exception:  # noqa: BLE001 — plane stays scatter
-                self._kernel = False
-                return None
+                try:
+                    run_staged(
+                        lambda: self._stage_kernel_plane(geom, codec,
+                                                         n_rows),
+                        index=self.index_name, kind="postings_" + (
+                            "packed" if codec == "packed" else "raw"),
+                        plane="mesh")  # retry: process-level config
+                except Exception:  # noqa: BLE001 — classified terminal
+                    # staging fault (rollback already ran): the caller
+                    # demotes + quarantines; retryable on the probe
+                    _plane_logger.warning(
+                        "[%s] mesh kernel staging failed; plane demotes "
+                        "with reason staging_fault", self.index_name,
+                        exc_info=True)
+                    self.kernel_denied_reason = "staging_fault"
+                    return None
         return dict(self._kernel, mode=mode)
+
+    def _stage_kernel_plane(self, geom, codec: str, n_rows: int) -> None:
+        """One staging ATTEMPT of the kernel plane (runs inside
+        run_staged's retry loop — the injection hooks below re-consult
+        the schemes on every retry). Publishes ``_seg_staged`` entries
+        and ledger registrations only on full success; any fault rolls
+        both back before re-raising."""
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
+        t0 = _time.monotonic()
+        kind_postings = ("postings_packed" if codec == "packed"
+                         else "postings_raw")
+        try:
+            if codec == "packed":
+                packed = np.zeros((self.n_slots, n_rows, psc.LANE),
+                                  np.int32)
+            else:
+                docs = np.full((self.n_slots, n_rows, psc.LANE),
+                               self.nd_pad, np.int32)
+                frac = np.zeros((self.n_slots, n_rows, psc.LANE),
+                                np.float32)
+            live_t = np.zeros(
+                (self.n_slots, geom.n_tiles * psc.LANE, geom.tile_sub),
+                np.float32)
+            meta = {}
+            for i, seg in enumerate(self.segments):
+                f = seg._block_frac()
+                bmin, bmax = psc.block_min_max(
+                    seg.block_docs, seg.block_tfs, seg.nd_pad)
+                if codec == "packed":
+                    fq = psc.quantize_frac(f)  # one pass serves both
+                    pk = psc.pack_segment_blocks(seg.block_docs, f,
+                                                 seg.nd_pad, q=fq)
+                    packed[i, : pk.shape[0]] = pk
+                    # bounds must dominate the DEQUANTIZED values the
+                    # kernel decodes (rounding can lift a posting up
+                    # to half a quantization step)
+                    bfmax = psc.block_frac_max(psc.dequantize_frac(fq))
+                else:
+                    dp, fp = psc.pad_segment_blocks(seg.block_docs, f,
+                                                    seg.nd_pad)
+                    docs[i, : dp.shape[0]] = dp
+                    frac[i, : fp.shape[0]] = fp
+                    bfmax = psc.block_frac_max(f)
+                live = np.zeros(geom.nd_pad, np.float32)
+                live[: seg.nd_pad] = seg.live.astype(np.float32)
+                live_t[i] = psc.build_live_t(live, geom)
+                meta[id(seg)] = (bmin, bmax, bfmax)
+            on_device_staging(self.index_name, kind_postings, "k_postings")
+            if codec == "packed":
+                self._seg_staged["k_packed"] = jax.device_put(
+                    packed, self._sharding)
+                self.postings_bytes_staged = int(packed.nbytes)
+            else:
+                self._seg_staged["k_docs"] = jax.device_put(
+                    docs, self._sharding)
+                self._seg_staged["k_frac"] = jax.device_put(
+                    frac, self._sharding)
+                self.postings_bytes_staged = int(docs.nbytes + frac.nbytes)
+            on_device_staging(self.index_name, "live_mask", "k_live_t")
+            self._seg_staged["k_live_t"] = jax.device_put(
+                live_t, self._sharding)
+        except BaseException:
+            # transactional rollback: no partially-published table may
+            # survive the attempt (a half-staged plane would serve a
+            # later query with missing arrays) and nothing was
+            # registered with the accountant yet — no orphaned bytes
+            for key in ("k_packed", "k_docs", "k_frac", "k_live_t"):
+                self._seg_staged.pop(key, None)
+            self.postings_bytes_staged = 0
+            raise
+        # commit: publish the session, THEN register the exact bytes
+        # (register-then-commit — the ledger never holds bytes for a
+        # generation that failed to install)
+        self.postings_codec = codec
+        self._kernel = {"geom": geom, "meta": meta, "codec": codec}
+        dur = (_time.monotonic() - t0) * 1000.0
+        self._account(kind_postings, "k_postings",
+                      self.postings_bytes_staged, duration_ms=dur)
+        self._account("live_mask", "k_live_t", int(live_t.nbytes),
+                      duration_ms=dur)
+        # per-segment block min/max/frac-max bound columns stay
+        # host-resident but scale with the staged plane
+        self._account("bound_tables", "k_bounds", sum(
+            int(b.nbytes) for t in meta.values() for b in t))
 
     def ensure_knn(self, field: str, dims: int,
                    metric: str) -> Optional[dict]:
@@ -2262,68 +2566,103 @@ class MeshPlanExecutor:
         if entry is False:
             return None
         if entry is None:
-            t0 = _time.monotonic()
-            try:
-                import ml_dtypes
+            from elasticsearch_tpu.common.staging import run_staged
 
-                from elasticsearch_tpu.common.memory import (
-                    memory_accountant,
-                )
-                from elasticsearch_tpu.ops import pallas_knn as pkn
-                from elasticsearch_tpu.ops import pallas_scoring as psc
-
-                d_pad = pkn.pad_dims(dims)
-                nd_knn = max(self.nd_pad, psc.LANE)
-                # HBM budget gate (same demotion contract as
-                # ensure_kernel): over budget the kNN batch serves from
-                # the host plan-node rung, reason hbm_budget
-                estimate = self.n_slots * nd_knn * (d_pad * 2 + 8)
-                if not memory_accountant().try_reserve(
-                        self.index_name, estimate,
-                        exclude_scope=self.scope):
-                    self.kernel_denied_reason = "hbm_budget"
+            with self._kernel_stage_lock:
+                entry = self._knn.get(field)
+                if isinstance(entry, dict):  # racing cold stager built it
+                    return dict(entry, mode=mode)
+                if entry is False:
                     return None
-                emb = np.zeros((self.n_slots, nd_knn, d_pad),
-                               ml_dtypes.bfloat16)
-                scale = np.zeros((self.n_slots, nd_knn, 1), np.float32)
-                mask = np.zeros((self.n_slots, nd_knn, 1), np.float32)
-                for i, seg in enumerate(self.segments):
-                    col = seg.vector_columns.get(field)
-                    if col is None:
-                        continue  # slot stays dead (mask all-zero)
-                    if col.dims != dims:
-                        raise ValueError(
-                            f"segment [{seg.name}] stores [{field}] at "
-                            f"dims={col.dims}, mapping says {dims}")
-                    # the host mirror is already on the bf16 grid: the
-                    # astype below is exact
-                    emb[i, : col.vectors.shape[0], : dims] = \
-                        col.vectors.astype(ml_dtypes.bfloat16)
-                    sc = pkn.vector_scale_column(col.vectors, metric)
-                    live = seg.live[: col.vectors.shape[0]]
-                    m = (col.exists & live).astype(np.float32)
-                    scale[i, : sc.shape[0]] = sc
-                    mask[i, : m.shape[0], 0] = m
-                entry = {
-                    "emb": jax.device_put(emb, self._sharding),
-                    "scale": jax.device_put(scale, self._sharding),
-                    "mask": jax.device_put(mask, self._sharding),
-                    "d_pad": d_pad,
-                    "nd_pad": nd_knn,
-                    "metric": metric,
-                }
-                self._knn[field] = entry
-                dur = (_time.monotonic() - t0) * 1000.0
-                self._account("embeddings", f"knn:{field}",
-                              int(emb.nbytes), duration_ms=dur)
-                self._account("scale_norm", f"knn_scale:{field}",
-                              int(scale.nbytes), duration_ms=dur)
-                self._account("live_mask", f"knn_mask:{field}",
-                              int(mask.nbytes), duration_ms=dur)
-            except Exception:  # noqa: BLE001 — plane stays host
-                self._knn[field] = False
-                return None
+                try:
+                    entry = run_staged(
+                        lambda: self._stage_knn_plane(field, dims, metric),
+                        index=self.index_name, kind="embeddings",
+                        plane="mesh")  # retry: process-level config
+                except _KnnStructuralError:
+                    # a REQUEST/mapping-shaped inability (dims mismatch
+                    # across segments): permanent for this segment set,
+                    # never a device fault — plane stays host quietly
+                    self._knn[field] = False
+                    return None
+                except Exception:  # noqa: BLE001 — classified terminal
+                    # staging fault (rollback ran): demote + quarantine;
+                    # the entry stays None so the probe restages
+                    _plane_logger.warning(
+                        "[%s] mesh kNN staging failed for [%s]; plane "
+                        "demotes with reason staging_fault",
+                        self.index_name, field, exc_info=True)
+                    self.kernel_denied_reason = "staging_fault"
+                    return None
+                if entry is None:  # hbm_budget denial inside the attempt
+                    return None
         return dict(entry, mode=mode)
+
+    def _stage_knn_plane(self, field: str, dims: int,
+                         metric: str) -> Optional[dict]:
+        """One staging ATTEMPT of a dense_vector field's kNN plane
+        (inside run_staged's retry loop). Returns the session entry, or
+        None on an HBM-budget denial; register-then-commit like
+        _stage_kernel_plane."""
+        import ml_dtypes
+
+        from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.ops import pallas_knn as pkn
+        from elasticsearch_tpu.ops import pallas_scoring as psc
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
+        t0 = _time.monotonic()
+        d_pad = pkn.pad_dims(dims)
+        nd_knn = max(self.nd_pad, psc.LANE)
+        # HBM budget gate (same demotion contract as ensure_kernel):
+        # over budget the kNN batch serves from the host plan-node
+        # rung, reason hbm_budget
+        estimate = self.n_slots * nd_knn * (d_pad * 2 + 8)
+        if not memory_accountant().try_reserve(
+                self.index_name, estimate, exclude_scope=self.scope):
+            self.kernel_denied_reason = "hbm_budget"
+            return None
+        emb = np.zeros((self.n_slots, nd_knn, d_pad), ml_dtypes.bfloat16)
+        scale = np.zeros((self.n_slots, nd_knn, 1), np.float32)
+        mask = np.zeros((self.n_slots, nd_knn, 1), np.float32)
+        for i, seg in enumerate(self.segments):
+            col = seg.vector_columns.get(field)
+            if col is None:
+                continue  # slot stays dead (mask all-zero)
+            if col.dims != dims:
+                raise _KnnStructuralError(
+                    f"segment [{seg.name}] stores [{field}] at "
+                    f"dims={col.dims}, mapping says {dims}")
+            # the host mirror is already on the bf16 grid: the
+            # astype below is exact
+            emb[i, : col.vectors.shape[0], : dims] = \
+                col.vectors.astype(ml_dtypes.bfloat16)
+            sc = pkn.vector_scale_column(col.vectors, metric)
+            live = seg.live[: col.vectors.shape[0]]
+            m = (col.exists & live).astype(np.float32)
+            scale[i, : sc.shape[0]] = sc
+            mask[i, : m.shape[0], 0] = m
+        on_device_staging(self.index_name, "embeddings", f"knn:{field}")
+        # all three device transfers must land before anything
+        # publishes: a fault between them leaves only unreferenced
+        # arrays for the GC (nothing in _seg_staged / the ledger)
+        entry = {
+            "emb": jax.device_put(emb, self._sharding),
+            "scale": jax.device_put(scale, self._sharding),
+            "mask": jax.device_put(mask, self._sharding),
+            "d_pad": d_pad,
+            "nd_pad": nd_knn,
+            "metric": metric,
+        }
+        self._knn[field] = entry
+        dur = (_time.monotonic() - t0) * 1000.0
+        self._account("embeddings", f"knn:{field}",
+                      int(emb.nbytes), duration_ms=dur)
+        self._account("scale_norm", f"knn_scale:{field}",
+                      int(scale.nbytes), duration_ms=dur)
+        self._account("live_mask", f"knn_mask:{field}",
+                      int(mask.nbytes), duration_ms=dur)
+        return entry
 
     def tile_lane_ub_cached(self, seg, union_lanes, row_lo, row_hi,
                             bfmax, sub: int) -> np.ndarray:
@@ -2366,6 +2705,10 @@ class MeshPlanExecutor:
 
         key = f"k_live_t_{sub}"
         if key not in self._seg_staged:
+            from elasticsearch_tpu.testing.disruption import (
+                on_device_staging,
+            )
+
             t0 = _time.monotonic()
             geom = psc.tile_geometry(self._kernel["geom"].nd_pad, sub)
             live_t = np.zeros(
@@ -2375,6 +2718,9 @@ class MeshPlanExecutor:
                 live = np.zeros(geom.nd_pad, np.float32)
                 live[: seg.nd_pad] = seg.live.astype(np.float32)
                 live_t[i] = psc.build_live_t(live, geom)
+            # a raise here lands in the calling launch's fault handler
+            # (per-sub mask variants stage inside the launch try)
+            on_device_staging(self.index_name, "live_mask", key)
             self._seg_staged[key] = jax.device_put(live_t, self._sharding)
             self._account("live_mask", key, int(live_t.nbytes),
                           reason="geometry_change",

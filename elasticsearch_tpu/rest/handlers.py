@@ -1890,12 +1890,42 @@ def _cat_allocation(node, req):
 
 
 def _cat_recovery(node, req):
+    """_cat/recovery (ISSUE 10 satellite): per shard copy, the local
+    store recoveries plus live/finished PEER recoveries from the
+    multinode recovery sessions (stage init → index → translog →
+    finalize → done, file/byte/op progress, source → target) — the
+    RecoveryState surface of RestCatRecoveryAction."""
+    from elasticsearch_tpu.cluster.multinode import recovery_progress_rows
+
+    def pct(done, total):
+        if not total:
+            return "100.0%" if done == total else "0.0%"
+        return f"{min(done / total, 1.0) * 100:.1f}%"
+
     rows = []
     for name, svc in node.indices.items():
         for sid, shard in svc.shards.items():
-            rows.append([name, sid, "0ms", "store", "done", "-", "-", "100%"])
-    return _cat_table(req, rows, ["index", "shard", "time", "type", "stage",
-                                  "source_node", "target_node", "files_percent"])
+            rows.append([name, sid, "0ms", "store", "done", "-",
+                         node.node_name, 0, "100.0%", "0b", "100.0%",
+                         0, 0, "100.0%"])
+    now_ms = int(time.time() * 1000)
+    for r in recovery_progress_rows():
+        took_ms = (r["stop_ms"] or now_ms) - (r["start_ms"] or now_ms)
+        rows.append([
+            r["index"], r["shard"], f"{max(took_ms, 0)}ms", r["type"],
+            r["stage"], r["source"] or "-", r["target"],
+            r["files_total"],
+            pct(r["files_recovered"], r["files_total"]),
+            f"{r['bytes_total']}b",
+            pct(r["bytes_recovered"], r["bytes_total"]),
+            r["ops_total"], r["ops_recovered"],
+            pct(r["ops_recovered"], r["ops_total"]),
+        ])
+    return _cat_table(req, rows, [
+        "index", "shard", "time", "type", "stage", "source_node",
+        "target_node", "files", "files_percent", "bytes",
+        "bytes_percent", "translog_ops", "translog_ops_recovered",
+        "translog_ops_percent"])
 
 
 def _cat_thread_pool(node, req):

@@ -1,0 +1,529 @@
+"""Deterministic chaos soak (ISSUE 10): every scheme family at once.
+
+Role model: the reference's disruption ITs (§5.8 —
+DiscoveryWithServiceDisruptionsIT, RecoveryWhileUnderLoadIT): drive real
+concurrent load while injectable faults bite every layer, then assert
+the standing invariants instead of scenario-specific outcomes. Here the
+layers are the ones THIS system has: the transport hubs (PR 2 schemes),
+the shard/plane query path (PR 4 schemes), and — new in this issue —
+the device staging/launch boundary (StagingFailScheme /
+KernelLaunchFailScheme / EvictionStormScheme).
+
+``ChaosSoak`` composes all three families under concurrent bulk-ingest
+and zipfian search on a packed multi-shard corpus, with a pinned seed so
+every run injects the identical fault schedule. Invariants, checked
+every round and at the end:
+
+- **no acked-write loss** — every acked index/delete is visible after
+  refresh, on the in-process index AND across the 2-node cluster with
+  transport drops biting (replication retry + recovery compensate);
+- **oracle-identical hits** — the disrupted index answers byte-identical
+  (ids AND scores) to an undisrupted oracle index holding the same
+  corpus: plane demotions degrade latency, never results (the chaos
+  index pins ``index.search.mesh.plane: pallas`` so every rung on the
+  ladder — mesh_pallas or host — shares the byte-identity contract);
+- **ledger leak-free** — the per-kind device-memory ledger returns
+  EXACTLY to its pre-fault snapshot after scheme removal plus one
+  healing query (a mid-staging fault strands no orphaned HBM bytes);
+- **restage amplification bounded** — storms of forced evictions may
+  restage, but the restaged/logically-changed ratio stays under the
+  configured bound;
+- **zero 5xx while any copy survives** — no search raises and no shard
+  fails on the in-process path; the cluster path always converges to a
+  complete answer.
+
+The tier-1 smoke runs a small seeded soak; the full soak (more rounds,
+heavier drop rates) is slow-marked. ``dryrun_multichip`` phase 8 runs
+the device-scheme subset against the real mesh.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.testing import disruption as dis
+
+# the device/search scheme families the soak cycles through, one entry
+# per round (modulo): (constructor name, kwargs builder) — deterministic
+# in the round index, no RNG involved in the schedule itself
+_ROUND_SCHEMES = (
+    ("staging_transient", lambda idx: dis.StagingFailScheme(
+        kinds=["postings"], transient=True, times=2, indices=[idx])),
+    ("launch_fail", lambda idx: dis.KernelLaunchFailScheme(
+        rungs=("mesh_pallas", "batched"), times=1, indices=[idx])),
+    ("eviction_storm", lambda idx: dis.EvictionStormScheme(
+        period=3, indices=[idx])),
+    ("staging_transient_live", lambda idx: dis.StagingFailScheme(
+        kinds=["live_mask"], transient=True, times=1, indices=[idx])),
+    ("staging_deterministic_mesh", lambda idx: dis.StagingFailScheme(
+        kinds=["mesh_slot_tables"], transient=False, times=1,
+        indices=[idx])),
+)
+
+# tight transport deadlines so injected drops resolve in test time
+_CLUSTER_SETTINGS = Settings({
+    "transport.request.timeout": "3s",
+    "transport.retry.max_attempts": 4,
+    "transport.retry.initial_backoff": "20ms",
+    "transport.retry.max_backoff": "200ms",
+    "discovery.zen.publish_timeout": "2s",
+    "cluster.replication.timeout": "600ms",
+    "indices.recovery.retry_delay_network": "20ms",
+    "indices.recovery.internal_action_timeout": "2s",
+})
+
+
+class ChaosSoakViolation(AssertionError):
+    """One of the standing invariants failed under the soak."""
+
+
+class ChaosSoak:
+    def __init__(self, seed: int = 0, rounds: int = 2,
+                 docs_per_round: int = 24, searches_per_round: int = 6,
+                 search_threads: int = 2, shards: int = 3,
+                 seed_docs: int = 48, with_cluster: bool = True,
+                 cluster_drop_p: float = 0.15,
+                 amplification_bound: float = 200.0,
+                 quarantine_cooldown: str = "150ms",
+                 index: str = "chaos"):
+        self.seed = int(seed)
+        self.rounds = int(rounds)
+        self.docs_per_round = int(docs_per_round)
+        self.searches_per_round = int(searches_per_round)
+        self.search_threads = int(search_threads)
+        self.shards = int(shards)
+        self.seed_docs = int(seed_docs)
+        self.with_cluster = bool(with_cluster)
+        self.cluster_drop_p = float(cluster_drop_p)
+        self.amplification_bound = float(amplification_bound)
+        self.quarantine_cooldown = quarantine_cooldown
+        self.index = index
+        self.oracle_index = index + "_oracle"
+        self.vocab = [f"w{i}" for i in range(16)]
+
+    # -- deterministic inputs -------------------------------------------
+
+    def schedule(self) -> List[List[str]]:
+        """Per-round scheme names — pure function of (seed, rounds), so
+        two soaks with the same seed inject identically."""
+        rng = random.Random(self.seed)
+        plan = []
+        for r in range(self.rounds):
+            base = _ROUND_SCHEMES[r % len(_ROUND_SCHEMES)][0]
+            extra = _ROUND_SCHEMES[rng.randrange(len(_ROUND_SCHEMES))][0]
+            # search-plane family (PR 4) rides every round
+            plan.append(sorted({base, extra}) + ["search_delay"])
+        return plan
+
+    def _schemes_for(self, names: List[str]) -> List:
+        by_name = dict(_ROUND_SCHEMES)
+        schemes = []
+        for name in names:
+            if name == "search_delay":
+                schemes.append(dis.SearchDelayScheme(
+                    0.002, indices=[self.index]))
+            else:
+                schemes.append(by_name[name](self.index))
+        return schemes
+
+    def _doc(self, rng: np.random.RandomState, d: int) -> dict:
+        n_toks = 3 + int(rng.randint(6))
+        toks = [self.vocab[self._zipf_term(rng)] for _ in range(n_toks)]
+        return {"body": " ".join(toks), "n": int(d)}
+
+    def _zipf_term(self, rng: np.random.RandomState) -> int:
+        return min(int(rng.zipf(1.4)) - 1, len(self.vocab) - 1)
+
+    def _query(self, rng: np.random.RandomState) -> dict:
+        terms = " ".join(self.vocab[self._zipf_term(rng)]
+                         for _ in range(1 + int(rng.randint(2))))
+        return {"query": {"match": {"body": terms}}, "size": 10}
+
+    # -- targets ---------------------------------------------------------
+
+    def _mk_index(self, name: str):
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        return IndexService(name, Settings({
+            "index.number_of_shards": self.shards,
+            "index.search.mesh": True,
+            # kernel-or-host ladder: every rung shares the byte-identity
+            # contract (the scatter mesh is a different formulation)
+            "index.search.mesh.plane": "pallas",
+            "index.search.plane_quarantine.cooldown":
+                self.quarantine_cooldown,
+            "index.refresh_interval": -1,
+        }), mapping={"properties": {
+            "body": {"type": "text", "analyzer": "whitespace"},
+            "n": {"type": "integer"},
+        }})
+
+    # -- invariant helpers ----------------------------------------------
+
+    @staticmethod
+    def _hits_key(resp) -> list:
+        return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+    def _assert_parity(self, svc, oracle, bodies: List[dict],
+                       report: dict) -> None:
+        for body in bodies:
+            got = svc.search(dict(body))
+            want = oracle.search(dict(body))
+            if got["_shards"]["failed"]:
+                raise ChaosSoakViolation(
+                    f"shard failures on the disrupted index: "
+                    f"{got['_shards']}")
+            if got["hits"]["total"] != want["hits"]["total"] or \
+                    self._hits_key(got) != self._hits_key(want):
+                raise ChaosSoakViolation(
+                    f"hits diverged from the undisrupted oracle for "
+                    f"{body!r}:\n got[{got['_plane']}]: "
+                    f"{self._hits_key(got)}\nwant[{want['_plane']}]: "
+                    f"{self._hits_key(want)}")
+            report["parity_checked"] += 1
+            report["planes_seen"].add(got["_plane"])
+
+    @staticmethod
+    def _kind_bytes(index_name: str) -> Dict[str, int]:
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        return memory_accountant().staged_bytes_by_kind(index_name)
+
+    # -- the soak --------------------------------------------------------
+
+    def run(self) -> dict:
+        """Run the soak; returns the report dict or raises
+        :class:`ChaosSoakViolation` with the first broken invariant."""
+        report: dict = {
+            "seed": self.seed, "rounds": self.rounds,
+            "schedule": self.schedule(),
+            "acked_writes": 0, "acked_deletes": 0,
+            "searches_under_fault": 0, "search_errors": [],
+            "parity_checked": 0, "planes_seen": set(),
+            "scheme_hits": {}, "cluster": None,
+        }
+        rng = np.random.RandomState(self.seed)
+        svc = self._mk_index(self.index)
+        oracle = self._mk_index(self.oracle_index)
+        cluster = None
+        try:
+            # seed corpus + warm the fast plane on both indices
+            doc_id = 0
+            live_ids: List[str] = []
+            for _ in range(self.seed_docs):
+                doc = self._doc(rng, doc_id)
+                svc.index_doc(str(doc_id), doc)
+                oracle.index_doc(str(doc_id), doc)
+                live_ids.append(str(doc_id))
+                doc_id += 1
+            svc.refresh()
+            oracle.refresh()
+            warm_body = {"query": {"match": {"body": self.vocab[0]}},
+                         "size": 10}
+            svc.search(dict(warm_body))
+            oracle.search(dict(warm_body))
+
+            if self.with_cluster:
+                cluster = self._start_cluster()
+
+            for rnd, names in enumerate(report["schedule"]):
+                schemes = self._schemes_for(names)
+                for s in schemes:
+                    s.install()
+                net = self._install_net_schemes(cluster)
+                try:
+                    self._round(rnd, rng, svc, oracle, cluster,
+                                live_ids, doc_id, report)
+                    doc_id += self.docs_per_round
+                finally:
+                    for i, s in enumerate(schemes):
+                        s.remove()
+                        # names[i] keys the hit counts: two schemes of
+                        # one class in a round must not overwrite
+                        report["scheme_hits"][
+                            f"r{rnd}:{names[i]}"] = s.hits
+                    for s in net:
+                        s.remove()
+                # barrier: seal the round's writes and verify
+                svc.refresh()
+                oracle.refresh()
+                self._verify_round(svc, oracle, rng, live_ids, report)
+            # ---- frozen-corpus phase: ledger leak-freedom -------------
+            self._verify_ledger_and_recovery(svc, oracle, warm_body,
+                                             report)
+            if cluster is not None:
+                self._verify_cluster(cluster, report)
+            report["planes_seen"] = sorted(report["planes_seen"])
+            return report
+        finally:
+            dis.clear_search_disruptions()
+            if cluster is not None:
+                self._stop_cluster(cluster)
+            svc.close()
+            oracle.close()
+
+    # -- round execution -------------------------------------------------
+
+    def _round(self, rnd: int, rng, svc, oracle, cluster, live_ids,
+               doc_base: int, report: dict) -> None:
+        errors: List[str] = []
+        # pre-generate all inputs on the seeded rng (threads must not
+        # pull from a shared rng in nondeterministic order)
+        docs = [(doc_base + i, self._doc(rng, doc_base + i))
+                for i in range(self.docs_per_round)]
+        delete_pick = (live_ids[int(rng.randint(len(live_ids)))]
+                       if live_ids else None)
+        queries = [[self._query(rng)
+                    for _ in range(self.searches_per_round)]
+                   for _ in range(self.search_threads)]
+
+        def writer():
+            try:
+                for d, doc in docs:
+                    svc.index_doc(str(d), doc)
+                    oracle.index_doc(str(d), doc)
+                    live_ids.append(str(d))
+                    report["acked_writes"] += 1
+                    if cluster is not None:
+                        self._cluster_write(cluster, str(d), doc, report)
+                if delete_pick is not None:
+                    svc.delete_doc(delete_pick)
+                    oracle.delete_doc(delete_pick)
+                    live_ids.remove(delete_pick)
+                    report["acked_deletes"] += 1
+            except Exception as e:  # noqa: BLE001 — a lost ack IS the bug
+                errors.append(f"writer: {type(e).__name__}: {e}")
+
+        # per-thread counters, summed after join: a shared
+        # read-modify-write from concurrent searchers can lose updates
+        searched = [0] * self.search_threads
+
+        def searcher(tid: int):
+            for body in queries[tid]:
+                try:
+                    r = svc.search(dict(body))
+                    if r["_shards"]["failed"]:
+                        errors.append(
+                            f"searcher{tid}: shard failures {r['_shards']}")
+                    searched[tid] += 1
+                except Exception as e:  # noqa: BLE001 — zero-5xx invariant
+                    errors.append(
+                        f"searcher{tid}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=writer, name="chaos-writer")]
+        threads += [threading.Thread(target=searcher, args=(t,),
+                                     name=f"chaos-search{t}")
+                    for t in range(self.search_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        report["searches_under_fault"] += sum(searched)
+        if errors:
+            report["search_errors"] = errors
+            raise ChaosSoakViolation(
+                f"round {rnd} broke the zero-5xx/no-ack-loss invariant: "
+                f"{errors[:4]}")
+
+    def _verify_round(self, svc, oracle, rng, live_ids,
+                      report: dict) -> None:
+        # no acked-write loss: every acked write (minus acked deletes)
+        # is visible on both indices
+        body = {"query": {"match_all": {}}, "size": 0}
+        got = svc.search(dict(body))["hits"]["total"]
+        want = oracle.search(dict(body))["hits"]["total"]
+        if got != len(live_ids) or want != len(live_ids):
+            raise ChaosSoakViolation(
+                f"acked-write loss: disrupted={got} oracle={want} "
+                f"acked_live={len(live_ids)}")
+        # byte-identical hits vs the oracle on a seeded query set
+        self._assert_parity(
+            svc, oracle, [self._query(rng) for _ in range(4)], report)
+
+    # -- frozen-corpus ledger + self-heal phase -------------------------
+
+    def _verify_ledger_and_recovery(self, svc, oracle, warm_body,
+                                    report: dict) -> None:
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        time.sleep(0.2)  # let the last quarantine cooldown lapse
+
+        def heal(target):
+            """Restage every scope a query can lazily stage: one query
+            on the mesh rung (executor tables) and one pinned to the
+            host rung (per-segment base + kernel tables) — the ledger
+            snapshot below must only contain deterministically-healed
+            scopes."""
+            r = target.search(dict(warm_body))
+            target._search_uncached(dict(warm_body), skip_mesh=True)
+            return r
+
+        # healing queries restage everything the fault rounds evicted,
+        # and must land back on the fast plane
+        healed = heal(svc)
+        heal(oracle)
+        if healed["_plane"] != "mesh_pallas":
+            raise ChaosSoakViolation(
+                f"index stranded off its fast plane after faults "
+                f"cleared: _plane={healed['_plane']}")
+        snap = {self.index: self._kind_bytes(self.index),
+                self.oracle_index: self._kind_bytes(self.oracle_index)}
+        # one more all-families fault burst over the FROZEN corpus
+        burst = [
+            dis.StagingFailScheme(kinds=["mesh_slot_tables"],
+                                  transient=False, times=1,
+                                  indices=[self.index]),
+            dis.KernelLaunchFailScheme(rungs=("mesh_pallas", "batched"),
+                                       times=1, indices=[self.index]),
+            dis.EvictionStormScheme(period=2, indices=[self.index]),
+            dis.SearchDelayScheme(0.001, indices=[self.index]),
+        ]
+        for s in burst:
+            s.install()
+        try:
+            for _ in range(4):
+                r = svc.search(dict(warm_body))
+                if r["_shards"]["failed"]:
+                    raise ChaosSoakViolation(
+                        f"faults leaked into shard failures: "
+                        f"{r['_shards']}")
+        finally:
+            for s in burst:
+                s.remove()
+                report["scheme_hits"][f"burst:{type(s).__name__}"] = s.hits
+        time.sleep(0.2)  # quarantine cooldown (150ms default)
+        healed = heal(svc)
+        heal(oracle)
+        if healed["_plane"] != "mesh_pallas":
+            raise ChaosSoakViolation(
+                f"post-burst healing query did not return to the fast "
+                f"plane: _plane={healed['_plane']}")
+        for name, before in snap.items():
+            after = self._kind_bytes(name)
+            if after != before:
+                raise ChaosSoakViolation(
+                    f"ledger leak on [{name}]: per-kind bytes did not "
+                    f"return to the pre-burst snapshot\n before={before}"
+                    f"\n after={after}")
+        stats = memory_accountant().stats(self.index)
+        amp = stats["restage_amplification"]
+        report["restage_amplification"] = amp
+        report["ledger_bytes"] = {k: v for k, v in
+                                  snap[self.index].items() if v}
+        if amp is not None and amp > self.amplification_bound:
+            raise ChaosSoakViolation(
+                f"restage amplification unbounded under the soak: "
+                f"{amp} > {self.amplification_bound}")
+
+    # -- transport-layer (PR 2) side: 2-node cluster ---------------------
+
+    def _start_cluster(self):
+        from elasticsearch_tpu.cluster.multinode import (
+            ClusterClient,
+            ClusterNode,
+        )
+        from elasticsearch_tpu.transport.local import TransportHub
+
+        hub = TransportHub()
+        nodes = {n: ClusterNode(n, hub, settings=_CLUSTER_SETTINGS)
+                 for n in ("cn1", "cn2")}
+        nodes["cn1"].bootstrap_cluster()
+        nodes["cn2"].join("cn1")
+        nodes["cn1"].create_index(
+            self.index + "_tx",
+            {"index": {"number_of_shards": 1, "number_of_replicas": 1}},
+            {"properties": {"body": {"type": "text",
+                                     "analyzer": "whitespace"}}})
+        self._wait_cluster_started(nodes)
+        return {"hub": hub, "nodes": nodes,
+                "client": ClusterClient(nodes["cn1"]), "acked": []}
+
+    def _wait_cluster_started(self, nodes, attempts: int = 80) -> None:
+        from elasticsearch_tpu.cluster.state import ShardRoutingState
+
+        master = nodes["cn1"]
+        for _ in range(attempts):
+            try:
+                master.reroute()
+            except Exception:  # noqa: BLE001 — disruption may bite
+                pass
+            routing = master.routing.get(self.index + "_tx", {})
+            copies = [c for cs in routing.values() for c in cs]
+            if copies and all(c.state == ShardRoutingState.STARTED
+                              for c in copies):
+                return
+            time.sleep(0.05)
+        raise ChaosSoakViolation("cluster copies never all STARTED")
+
+    def _install_net_schemes(self, cluster) -> List:
+        if cluster is None:
+            return []
+        return [
+            dis.NetworkDrop(self.cluster_drop_p,
+                            seed=self.seed).apply_to(cluster["hub"]),
+            dis.NetworkDelay(0.002).apply_to(cluster["hub"]),
+        ]
+
+    def _cluster_write(self, cluster, doc_id: str, doc: dict,
+                       report: dict) -> None:
+        """A write is only counted once ACKED; transient transport
+        errors retry (the reference client contract). An acked write
+        that later vanishes is the invariant violation."""
+        last = None
+        for _ in range(6):
+            try:
+                cluster["client"].index(self.index + "_tx", doc_id,
+                                        {"body": doc["body"]})
+                cluster["acked"].append(doc_id)
+                return
+            except Exception as e:  # noqa: BLE001 — retry transients
+                last = e
+                time.sleep(0.05)
+        raise ChaosSoakViolation(
+            f"cluster write never acked for [{doc_id}]: {last}")
+
+    def _verify_cluster(self, cluster, report: dict) -> None:
+        client = cluster["client"]
+        last = None
+        for _ in range(40):
+            try:
+                client.refresh(self.index + "_tx")
+                res = client.search(self.index + "_tx", {
+                    "query": {"match_all": {}}, "size": 0})
+                if res["_shards"]["failed"]:
+                    raise ChaosSoakViolation(
+                        f"cluster search failed shards with both copies "
+                        f"alive: {res['_shards']}")
+                if res["hits"]["total"] != len(cluster["acked"]):
+                    raise ChaosSoakViolation(
+                        f"acked-write loss on the cluster: "
+                        f"{res['hits']['total']} != "
+                        f"{len(cluster['acked'])} acked")
+                report["cluster"] = {
+                    "acked": len(cluster["acked"]),
+                    "visible": res["hits"]["total"],
+                }
+                return
+            except ChaosSoakViolation:
+                raise
+            except Exception as e:  # noqa: BLE001 — drops may still bite
+                last = e
+                time.sleep(0.1)
+        raise ChaosSoakViolation(
+            f"cluster never answered a clean search after healing: {last}")
+
+    def _stop_cluster(self, cluster) -> None:
+        cluster["hub"].clear_disruptions()
+        for node in cluster["nodes"].values():
+            close = getattr(node, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass

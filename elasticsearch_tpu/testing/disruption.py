@@ -227,6 +227,23 @@ class ShardSearchScheme:
     def on_plane(self, index: str, plane: str) -> None:
         """Effect hook for a mesh execution plane (mesh_pallas / mesh)."""
 
+    def on_staging(self, index: str, kind: str, table: str) -> None:
+        """Effect hook for a device STAGING boundary (ISSUE 10): called
+        right before each staging site's device transfer group, with the
+        accountant kind (postings_raw/postings_packed/live_mask/
+        embeddings/mesh_slot_tables/doc_values) and the table name — an
+        injected raise here is indistinguishable from a ``device_put``
+        fault mid-sequence."""
+
+    def on_launch(self, index: str, rung: str) -> None:
+        """Effect hook for a kernel LAUNCH, per rung (mesh_pallas /
+        batched / pruned / knn) — finer-grained than ``on_plane``, which
+        fires once per plane attempt before any staging."""
+
+    def on_query(self, index: str) -> None:
+        """Effect hook at query dispatch (before any plane/shard work) —
+        the EvictionStormScheme's consult point."""
+
 
 def clear_search_disruptions() -> None:
     del _SEARCH_SCHEMES[:]
@@ -253,6 +270,39 @@ def on_plane_execute(index: str, plane: str) -> None:
         # as one program
         if scheme.indices is None or index in scheme.indices:
             scheme.on_plane(index, plane)
+
+
+def on_device_staging(index: str, kind: str, table: str) -> None:
+    """Called by every device staging site (Segment cold builds,
+    MeshPlanExecutor.ensure_kernel/ensure_knn, doc-value columns)
+    immediately before its device transfer group; runs inside the
+    site's retry loop so a retried attempt re-consults the schemes."""
+    if not _SEARCH_SCHEMES:
+        return
+    for scheme in list(_SEARCH_SCHEMES):
+        if scheme.indices is None or index in scheme.indices:
+            scheme.on_staging(index, kind, table)
+
+
+def on_kernel_launch(index: str, rung: str) -> None:
+    """Called right before each compiled-program launch, with the rung
+    actually launching (``mesh_pallas`` serial / ``mesh`` scatter /
+    ``batched`` / ``pruned`` / ``knn``) — an injected raise here lands
+    in the plane ladder's fault handler (quarantine, next rung)."""
+    if not _SEARCH_SCHEMES:
+        return
+    for scheme in list(_SEARCH_SCHEMES):
+        if scheme.indices is None or index in scheme.indices:
+            scheme.on_launch(index, rung)
+
+
+def on_query_begin(index: str) -> None:
+    """Called once per search dispatch (IndexService)."""
+    if not _SEARCH_SCHEMES:
+        return
+    for scheme in list(_SEARCH_SCHEMES):
+        if scheme.indices is None or index in scheme.indices:
+            scheme.on_query(index)
 
 
 class SearchDelayScheme(ShardSearchScheme):
@@ -302,6 +352,117 @@ class PlaneFailScheme(ShardSearchScheme):
             self.hits += 1
             raise RuntimeError(
                 f"[{index}] plane [{plane}] fault (injected)")
+
+
+class StagingFailScheme(ShardSearchScheme):
+    """A device STAGING boundary faults (ISSUE 10): the Nth matching
+    device transfer inside ``ensure_kernel`` / ``ensure_knn`` /
+    ``Segment._stage_kernel_arrays`` / doc-value column staging raises,
+    selectable by ledger kind and by error class.
+
+    ``kinds``: accountant kinds to match (``postings`` matches both
+    ``postings_raw`` and ``postings_packed``); None = any.
+    ``nth``: skip the first nth-1 matching staging calls.
+    ``times``: raise on at most this many calls, then go inert (None =
+    every matching call while installed) — ``times=1`` with
+    ``transient=True`` is the "one transient RESOURCE_EXHAUSTED, then
+    clean" shape the bounded-retry path must absorb.
+    ``transient``: raise :class:`TransientDeviceError` (retryable);
+    False raises ``ValueError`` (deterministic — immediate demotion +
+    quarantine, never retried).
+    """
+
+    def __init__(self, kinds=None, nth: int = 1,
+                 times: Optional[int] = None, transient: bool = True,
+                 **filters):
+        super().__init__(**filters)
+        self.kinds = set(kinds) if kinds else None
+        self.nth = max(1, int(nth))
+        self.times = times
+        self.transient = bool(transient)
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def _kind_matches(self, kind: str) -> bool:
+        if self.kinds is None:
+            return True
+        return kind in self.kinds or (
+            "postings" in self.kinds and kind.startswith("postings"))
+
+    def on_staging(self, index, kind, table) -> None:
+        if not self._kind_matches(kind):
+            return
+        with self._lock:
+            self.calls += 1
+            if self.calls < self.nth:
+                return
+            if self.times is not None and self.hits >= self.times:
+                return
+            self.hits += 1
+        if self.transient:
+            from elasticsearch_tpu.common.staging import (
+                TransientDeviceError,
+            )
+
+            raise TransientDeviceError(
+                f"[{index}] RESOURCE_EXHAUSTED staging [{kind}/{table}] "
+                f"(injected transient)")
+        raise ValueError(
+            f"[{index}] shape error staging [{kind}/{table}] "
+            f"(injected deterministic)")
+
+
+class KernelLaunchFailScheme(ShardSearchScheme):
+    """A compiled-program LAUNCH faults, per rung: ``mesh_pallas``
+    (serial kernel plane), ``mesh`` (scatter), ``batched``, ``pruned``,
+    ``knn``. Lands in the plane ladder's fault handler — quarantine
+    once, serve from the next rung. ``times``: at most N raises, then
+    inert (None = always while installed)."""
+
+    def __init__(self, rungs: Sequence[str] = ("mesh_pallas",),
+                 times: Optional[int] = None, **filters):
+        super().__init__(**filters)
+        self.rungs = set(rungs)
+        self.times = times
+        self._lock = threading.Lock()
+
+    def on_launch(self, index, rung) -> None:
+        if rung not in self.rungs:
+            return
+        with self._lock:
+            if self.times is not None and self.hits >= self.times:
+                return
+            self.hits += 1
+        raise RuntimeError(
+            f"[{index}] kernel launch [{rung}] fault (injected)")
+
+
+class EvictionStormScheme(ShardSearchScheme):
+    """Force the DeviceMemoryAccountant's LRU evictor under query load:
+    every ``period``-th matching query dispatch evicts the ``scopes``
+    coldest evictable staging scopes, driving the restage-under-pressure
+    paths (lazy restage, ``probe`` lifecycle events, ladder demotions)
+    without configuring a byte budget."""
+
+    def __init__(self, period: int = 1, scopes: int = 1, **filters):
+        super().__init__(**filters)
+        self.period = max(1, int(period))
+        self.scopes = max(1, int(scopes))
+        self.evicted_bytes = 0
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def on_query(self, index) -> None:
+        with self._lock:
+            self.calls += 1
+            if self.calls % self.period:
+                return
+            self.hits += 1
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        freed = memory_accountant().force_evict(self.scopes)
+        with self._lock:  # concurrent searchers must not lose updates
+            self.evicted_bytes += freed
 
 
 class ActionBlackhole(DisruptionScheme):

@@ -1,0 +1,69 @@
+"""Deterministic chaos soak (ISSUE 10 acceptance).
+
+All three scheme families — transport (PR 2), search/plane (PR 4), and
+device staging/launch (this issue) — active simultaneously under
+concurrent bulk-ingest + zipfian search on a packed multi-shard corpus,
+asserting the standing invariants every round: no acked-write loss,
+hits byte-identical to an undisrupted oracle, ledger leak-free,
+restage amplification bounded, zero 5xx while any copy survives.
+
+Fast seeded smoke in tier-1; the full soak is slow-marked.
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing.chaos import ChaosSoak
+
+SMOKE_SEED = 1007
+
+
+class TestChaosSoakSmoke:
+    @pytest.fixture(autouse=True)
+    def _interpret(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+
+    def test_schedule_is_deterministic_under_pinned_seed(self):
+        a = ChaosSoak(seed=SMOKE_SEED, rounds=4).schedule()
+        b = ChaosSoak(seed=SMOKE_SEED, rounds=4).schedule()
+        assert a == b
+        assert ChaosSoak(seed=SMOKE_SEED + 1, rounds=4).schedule() != a \
+            or True  # different seeds may coincide; determinism is the claim
+        # every round composes at least one device/search scheme plus
+        # the PR-4 search-delay family
+        assert all("search_delay" in r for r in a)
+
+    def test_smoke_all_families(self):
+        soak = ChaosSoak(seed=SMOKE_SEED, rounds=2, docs_per_round=18,
+                         searches_per_round=5, search_threads=2,
+                         shards=3, seed_docs=36, with_cluster=True,
+                         index="chaos_smoke")
+        report = soak.run()
+        # faults actually bit: at least one scheme fired somewhere
+        assert sum(report["scheme_hits"].values()) >= 1, report
+        assert report["acked_writes"] == 2 * 18
+        assert report["searches_under_fault"] == 2 * 2 * 5
+        assert report["search_errors"] == []
+        assert report["parity_checked"] >= 8
+        # the fast plane served at least part of the traffic and the
+        # soak ended back on it (asserted inside run — planes_seen is
+        # the observability breadcrumb)
+        assert "mesh_pallas" in report["planes_seen"], report
+        # transport side: every acked write visible, none lost
+        assert report["cluster"] is not None
+        assert report["cluster"]["visible"] == report["cluster"]["acked"]
+        amp = report["restage_amplification"]
+        assert amp is None or amp < soak.amplification_bound
+
+
+@pytest.mark.slow
+class TestChaosSoakFull:
+    def test_full_soak(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        soak = ChaosSoak(seed=2024, rounds=5, docs_per_round=40,
+                         searches_per_round=10, search_threads=3,
+                         shards=4, seed_docs=80, with_cluster=True,
+                         cluster_drop_p=0.3, index="chaos_full")
+        report = soak.run()
+        assert report["search_errors"] == []
+        assert report["cluster"]["visible"] == report["cluster"]["acked"]
+        assert "mesh_pallas" in report["planes_seen"]

@@ -1,0 +1,454 @@
+"""Device-plane fault injection + transactional staging (ISSUE 10).
+
+The device serving planes get the same explicit failure contract the
+transport/query layers got in PR 2/4: staging faults classify
+transient (bounded retry w/ backoff) vs deterministic (immediate ladder
+demotion + quarantine with reason ``staging_fault``), a fault
+mid-staging rolls back every partial registration (ledger leak-free),
+and the post-cooldown quarantine probe is SINGLE-FLIGHT — N concurrent
+queries arriving after cooldown pay the fault exactly once.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.memory import memory_accountant
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.staging import (
+    TransientDeviceError,
+    classify_staging_fault,
+    run_staged,
+    staging_retry_config,
+)
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.testing.disruption import (
+    EvictionStormScheme,
+    KernelLaunchFailScheme,
+    PlaneFailScheme,
+    StagingFailScheme,
+    clear_search_disruptions,
+)
+
+MAPPING = {"properties": {
+    "body": {"type": "text", "analyzer": "whitespace"},
+    "vec": {"type": "dense_vector", "dims": 8},
+    "n": {"type": "integer"},
+}}
+
+
+@pytest.fixture(autouse=True)
+def _clean_schemes():
+    clear_search_disruptions()
+    yield
+    clear_search_disruptions()
+
+
+def make_index(name, shards=3, cooldown="150ms", plane="pallas",
+               vectors=False):
+    idx = IndexService(name, Settings({
+        "index.number_of_shards": shards,
+        "index.search.mesh": True,
+        "index.search.mesh.plane": plane,
+        "index.search.plane_quarantine.cooldown": cooldown,
+        "index.refresh_interval": -1,
+    }), mapping=MAPPING)
+    for d in range(30):
+        doc = {"body": f"w{d % 5} common", "n": d}
+        if vectors:
+            doc["vec"] = [float((d + j) % 7) for j in range(8)]
+        idx.index_doc(str(d), doc)
+    idx.refresh()
+    return idx
+
+
+class TestClassification:
+    def test_transient_shapes(self):
+        assert classify_staging_fault(TransientDeviceError("x")) \
+            == "transient"
+        assert classify_staging_fault(MemoryError()) == "transient"
+        assert classify_staging_fault(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory while "
+                         "allocating")) == "transient"
+        assert classify_staging_fault(
+            RuntimeError("transfer to device failed")) == "transient"
+
+    def test_deterministic_shapes(self):
+        assert classify_staging_fault(ValueError("bad shape")) \
+            == "deterministic"
+        assert classify_staging_fault(TypeError("x")) == "deterministic"
+        assert classify_staging_fault(
+            RuntimeError("Mosaic lowering failed")) == "deterministic"
+
+
+class TestRunStaged:
+    def test_transient_retries_then_succeeds(self):
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise TransientDeviceError("RESOURCE_EXHAUSTED")
+            return "ok"
+
+        before = memory_accountant().staging_retries_total
+        out = run_staged(fn, index="t", kind="postings_raw",
+                         retry=(3, 0.0))
+        assert out == "ok"
+        assert len(attempts) == 3
+        assert memory_accountant().staging_retries_total == before + 2
+
+    def test_transient_exhaustion_records_fault(self):
+        acct = memory_accountant()
+        before = acct.staging_faults_transient_total
+
+        def fn():
+            raise TransientDeviceError("RESOURCE_EXHAUSTED")
+
+        with pytest.raises(TransientDeviceError):
+            run_staged(fn, index="t", kind="postings_raw", retry=(2, 0.0))
+        assert acct.staging_faults_transient_total == before + 1
+        ev = acct.staging_fault_events[-1]
+        assert ev["classification"] == "transient"
+        assert ev["retries"] == 1
+
+    def test_deterministic_never_retries(self):
+        acct = memory_accountant()
+        attempts = []
+        before = acct.staging_faults_deterministic_total
+
+        def fn():
+            attempts.append(1)
+            raise ValueError("shape")
+
+        with pytest.raises(ValueError):
+            run_staged(fn, index="t", kind="live_mask", retry=(5, 0.0))
+        assert len(attempts) == 1
+        assert acct.staging_faults_deterministic_total == before + 1
+
+    def test_config_reads_settings_and_defaults(self):
+        s = Settings({"search.staging.retry.max_attempts": 5,
+                      "search.staging.retry.backoff_ms": 2.5})
+        assert staging_retry_config(s) == (5, 2.5)
+        attempts, backoff = staging_retry_config(None)
+        assert attempts >= 1 and backoff >= 0.0
+
+
+class TestStagingRetrySettings:
+    def test_cluster_override_wins_and_clears(self):
+        """Explicitness-aware dynamic updates (like search.pallas.*):
+        an explicit cluster value wins, clearing it reverts to the
+        node-file setting."""
+        from elasticsearch_tpu.common.staging import (
+            configure_staging_retry,
+            staging_retry_config,
+        )
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings({"search.staging.retry.max_attempts": 4,
+                              "search.staging.retry.backoff_ms": 5.0}))
+        try:
+            assert staging_retry_config() == (4, 5.0)
+            node.put_cluster_settings({"transient": {
+                "search.staging.retry.max_attempts": 7}})
+            assert staging_retry_config()[0] == 7
+            node.put_cluster_settings({"transient": {
+                "search.staging.retry.max_attempts": None}})
+            assert staging_retry_config()[0] == 4  # node file wins again
+        finally:
+            node.close()
+            configure_staging_retry(max_attempts=3, backoff_ms=10.0)
+
+    def test_rejects_out_of_range(self):
+        from elasticsearch_tpu.common.errors import (
+            IllegalArgumentException,
+        )
+        from elasticsearch_tpu.common.settings import (
+            SEARCH_STAGING_RETRY_MAX_ATTEMPTS,
+        )
+
+        with pytest.raises(IllegalArgumentException):
+            SEARCH_STAGING_RETRY_MAX_ATTEMPTS.get(
+                Settings({"search.staging.retry.max_attempts": 0}))
+
+
+class TestTransientRetryAbsorbsFault:
+    """A transient staging fault under the retry budget is INVISIBLE to
+    the ladder: the query serves from the fast plane, first try."""
+
+    def test_mesh_staging_transient_absorbed(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("dfretry")
+        scheme = StagingFailScheme(kinds=["postings"], transient=True,
+                                   times=2, indices=["dfretry"]).install()
+        retries_before = memory_accountant().staging_retries_total
+        r = idx.search({"query": {"match": {"body": "w1"}}, "size": 5})
+        assert r["_plane"] == "mesh_pallas", r["_plane"]
+        assert r["_shards"]["failed"] == 0
+        assert scheme.hits == 2
+        assert memory_accountant().staging_retries_total \
+            == retries_before + 2
+        planes = idx.stats()["total"]["search"]["planes"]
+        assert planes["plane_failures_total"].get("mesh_pallas", 0) == 0
+        idx.close()
+
+
+class TestStagingLeakFreedom:
+    """Satellite: a deterministic fault at each kind boundary rolls the
+    per-kind ledger back EXACTLY to the pre-attempt snapshot, demotes
+    with reason staging_fault, and the next unfaulted query self-heals
+    back onto the fast plane."""
+
+    def _snapshot(self, name):
+        return memory_accountant().staged_bytes_by_kind(name)
+
+    def _run_kind_case(self, monkeypatch, name, kinds, faulted_kinds,
+                       expect_demote="host"):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index(name)
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        # pre-warm the host rung compile so assertions don't race
+        idx._search_uncached(dict(body), skip_mesh=True)
+        snap = self._snapshot(name)
+        scheme = StagingFailScheme(kinds=kinds, transient=False,
+                                   indices=[name]).install()
+        t_fault = time.monotonic()
+        r = idx.search(dict(body))
+        assert scheme.hits >= 1, f"scheme never consulted for {kinds}"
+        assert r["_plane"] == expect_demote, (r["_plane"], kinds)
+        assert r["_shards"]["failed"] == 0
+        after = self._snapshot(name)
+        for kind in faulted_kinds:
+            assert after[kind] == snap[kind], (
+                f"kind [{kind}] leaked bytes after a mid-staging fault: "
+                f"{after[kind]} != {snap[kind]}")
+        planes = idx.stats()["total"]["search"]["planes"]
+        assert planes["plane_failures_by_reason"].get(
+            "staging_fault", 0) >= 1, planes
+        decisions = idx.search_stats()["phases"]["decisions"]
+        assert any(k.endswith(".staging_fault") for k in decisions), \
+            decisions
+        # fault clears: the next query (post-cooldown) self-heals back
+        # onto the fast plane and stages real bytes
+        scheme.remove()
+        time.sleep(max(0.0, t_fault + 0.25 - time.monotonic()))
+        r = idx.search(dict(body, size=6))
+        assert r["_plane"] == "mesh_pallas", (
+            f"index stranded off its fast plane after the {kinds} fault "
+            f"cleared: {r['_plane']}")
+        healed = self._snapshot(name)
+        for kind in faulted_kinds:
+            assert healed[kind] >= snap[kind]
+        idx.close()
+        for kind, nbytes in self._snapshot(name).items():
+            assert nbytes == 0, (kind, nbytes)
+
+    def test_mesh_slot_tables_boundary(self, monkeypatch):
+        # constructor-level fault: NOTHING may register
+        self._run_kind_case(monkeypatch, "dfslot",
+                            ["mesh_slot_tables"],
+                            ["mesh_slot_tables", "postings_raw",
+                             "live_mask"])
+
+    def test_postings_boundary(self, monkeypatch):
+        # ensure_kernel fault AFTER the base executor staged: the
+        # postings/live_mask tables roll back; seg_stacked legitimately
+        # stays (it committed)
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("dfpost")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        idx._search_uncached(dict(body), skip_mesh=True)
+        snap = self._snapshot("dfpost")
+        scheme = StagingFailScheme(kinds=["postings"], transient=False,
+                                   indices=["dfpost"]).install()
+        t_fault = time.monotonic()
+        r = idx.search(dict(body))
+        assert r["_plane"] == "host"
+        assert r["_shards"]["failed"] == 0
+        ms = idx._mesh_search
+        ex = ms._executor
+        assert ex is not None
+        # no half-staged executor generation: the kernel keys rolled back
+        for key in ("k_packed", "k_docs", "k_frac", "k_live_t"):
+            assert key not in ex._seg_staged, key
+        after = self._snapshot("dfpost")
+        for kind in ("postings_packed", "bound_tables"):
+            assert after[kind] == snap[kind], kind
+        scheme.remove()
+        time.sleep(max(0.0, t_fault + 0.25 - time.monotonic()))
+        r = idx.search(dict(body, size=6))
+        assert r["_plane"] == "mesh_pallas", r["_plane"]
+        idx.close()
+
+    def test_live_mask_boundary(self, monkeypatch):
+        self._run_kind_case(monkeypatch, "dflive", ["live_mask"],
+                            ["live_mask"])
+
+    def test_embeddings_boundary(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("dfemb", vectors=True)
+        body = {"knn": {"field": "vec", "query_vector": [1.0] * 8,
+                        "k": 5}}
+        idx._search_uncached(dict(body), skip_mesh=True)  # host warm
+        snap = self._snapshot("dfemb")
+        scheme = StagingFailScheme(kinds=["embeddings"], transient=False,
+                                   indices=["dfemb"]).install()
+        t_fault = time.monotonic()
+        # the mesh kNN staging faults; the segment-level host staging
+        # already holds its (committed) embedding bytes — only the MESH
+        # scope's attempt must roll back
+        r = idx.search(dict(body))
+        assert r["_plane"] == "host"
+        assert r["_shards"]["failed"] == 0
+        after = self._snapshot("dfemb")
+        assert after["embeddings"] == snap["embeddings"], (
+            f"mesh kNN staging leaked embedding bytes: "
+            f"{after['embeddings']} != {snap['embeddings']}")
+        assert after["scale_norm"] == snap["scale_norm"]
+        scheme.remove()
+        time.sleep(max(0.0, t_fault + 0.25 - time.monotonic()))
+        r = idx.search(dict(body))
+        assert r["_plane"] == "mesh_pallas", r["_plane"]
+        assert self._snapshot("dfemb")["embeddings"] \
+            > snap["embeddings"]
+        idx.close()
+
+    def test_doc_values_boundary(self, monkeypatch):
+        # host-rung sort column: transient fault absorbed by the retry
+        # (the column is mandatory for the consumer), ledger exact
+        monkeypatch.setenv("ES_TPU_PALLAS", "off")
+        idx = make_index("dfcol", plane="auto")
+        # a range clause stages its numeric doc-value columns lazily
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "w1"}}],
+            "filter": [{"range": {"n": {"gte": 3}}}]}}, "size": 5}
+        snap = self._snapshot("dfcol")
+        scheme = StagingFailScheme(kinds=["doc_values"], transient=True,
+                                   times=1, indices=["dfcol"]).install()
+        r = idx._search_uncached(dict(body), skip_mesh=True)
+        assert scheme.hits == 1
+        assert r["_shards"]["failed"] == 0
+        assert self._snapshot("dfcol")["doc_values"] \
+            > snap["doc_values"]
+        idx.close()
+
+
+class TestKernelLaunchFail:
+    def test_rung_selective_fault_quarantines(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("dflaunch")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        idx._search_uncached(dict(body), skip_mesh=True)
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        scheme = KernelLaunchFailScheme(rungs=("mesh_pallas",), times=1,
+                                        indices=["dflaunch"]).install()
+        r = idx.search(dict(body))
+        assert r["_plane"] == "host"
+        assert scheme.hits == 1
+        planes = idx.stats()["total"]["search"]["planes"]
+        assert planes["plane_failures_total"]["mesh_pallas"] == 1
+        assert planes["plane_failures_by_reason"].get(
+            "kernel_fault", 0) == 1
+        idx.close()
+
+
+class TestEvictionStorm:
+    def test_forced_eviction_restages_byte_identically(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("dfstorm")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        baseline = idx.search(dict(body))
+        assert baseline["_plane"] == "mesh_pallas"
+        acct = memory_accountant()
+        ev_before = acct.evictions_total
+        scheme = EvictionStormScheme(period=1,
+                                     indices=["dfstorm"]).install()
+        r = idx.search(dict(body))
+        assert acct.evictions_total > ev_before
+        assert scheme.hits >= 1
+        assert r["_shards"]["failed"] == 0
+        assert [(h["_id"], h["_score"]) for h in r["hits"]["hits"]] == \
+            [(h["_id"], h["_score"]) for h in baseline["hits"]["hits"]]
+        scheme.remove()
+        r = idx.search(dict(body))
+        assert [(h["_id"], h["_score"]) for h in r["hits"]["hits"]] == \
+            [(h["_id"], h["_score"]) for h in baseline["hits"]["hits"]]
+        idx.close()
+
+
+class TestSingleFlightProbe:
+    """Satellite: after quarantine cooldown, N concurrent queries make
+    exactly ONE probe attempt; peers serve the healthy rung."""
+
+    def test_one_probe_for_concurrent_burst(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("dfprobe", cooldown="200ms")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        idx._search_uncached(dict(body), skip_mesh=True)  # host warm
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        scheme = PlaneFailScheme(planes=("mesh_pallas",),
+                                 indices=["dfprobe"]).install()
+        t_fault = time.monotonic()
+        assert idx.search(dict(body))["_plane"] == "host"
+        health = idx._mesh_search.plane_health
+        assert health.failures_total["mesh_pallas"] == 1
+        # scheme STAYS installed: the probe will fail again. Wait out
+        # the cooldown, then fire a concurrent burst — single-flight
+        # means the fault is paid exactly ONCE more.
+        time.sleep(max(0.0, t_fault + 0.3 - time.monotonic()))
+        n = 6
+        barrier = threading.Barrier(n)
+        results, errors = [], []
+
+        def worker():
+            barrier.wait()
+            try:
+                results.append(idx._search_uncached(dict(body)))
+            except Exception as e:  # noqa: BLE001 — zero-5xx contract
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(results) == n
+        assert all(r["_plane"] == "host" for r in results)
+        assert all(r["hits"]["total"] == results[0]["hits"]["total"]
+                   for r in results)
+        assert scheme.hits == 2, (
+            f"post-cooldown herd re-paid the fault {scheme.hits - 1} "
+            f"times; single-flight allows exactly 1 probe")
+        assert health.failures_total["mesh_pallas"] == 2
+        assert health.probes_total == 1
+        # probe success path: remove the scheme, wait, serve again
+        scheme.remove()
+        time.sleep(0.3)
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        assert health.quarantined() == []
+        assert idx.stats()["total"]["search"]["planes"][
+            "plane_probes_total"] == 2
+        idx.close()
+
+    def test_probe_released_when_plane_bails_cleanly(self, monkeypatch):
+        """A probe that can't execute (staging says no) must hand its
+        admission back instead of wedging the plane half-open for the
+        whole lease."""
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        idx = make_index("dfrel", cooldown="100ms")
+        body = {"query": {"match": {"body": "w1"}}, "size": 5}
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        health = idx._mesh_search.plane_health
+        health.record_failure("mesh_pallas")
+        time.sleep(0.15)
+        # bench the STAGING too: the admitted probe bails pre-launch
+        idx._mesh_search._staging_fault_until = time.monotonic() + 0.2
+        r = idx.search(dict(body))
+        assert r["_plane"] == "host"
+        # admission handed back: once staging heals, the NEXT query may
+        # probe (a leaked lease would block it for PROBE_LEASE_S)
+        time.sleep(0.25)
+        assert idx.search(dict(body))["_plane"] == "mesh_pallas"
+        idx.close()

@@ -574,6 +574,72 @@ class TestClusterSmoke:
                  "wait_for_active_shards": None, "term": 1})
 
 
+class TestCatRecovery:
+    """_cat/recovery (ISSUE 10 satellite): peer-recovery progress per
+    shard copy — stage, files/bytes/ops counts, source → target —
+    surfaced from the multinode recovery sessions and rendered like the
+    other _cat endpoints."""
+
+    def test_peer_recovery_progress_recorded_and_rendered(self):
+        from elasticsearch_tpu.cluster.multinode import (
+            clear_recovery_progress,
+            recovery_progress_rows,
+        )
+
+        clear_recovery_progress()
+        hub, nodes = cluster(names=("n1", "n2"))
+        nodes["n1"].create_index(
+            "catrec", {"index": {"number_of_shards": 1,
+                                 "number_of_replicas": 0}},
+            {"properties": {"msg": {"type": "text"}}})
+        client = ClusterClient(nodes["n1"])
+        for i in range(15):
+            client.index("catrec", str(i), {"msg": f"doc {i}"})
+        primary = nodes["n1"]._primary_node("catrec", 0)
+        nodes[primary].shards[("catrec", 0)].flush()
+
+        def mutate():
+            md = nodes["n1"].indices_meta["catrec"]
+            md.settings = md.settings.merged_with(
+                Settings({"index.number_of_replicas": 1}))
+        nodes["n1"]._submit_state_update(mutate)
+        wait_started(nodes, "catrec")
+        rows = [r for r in recovery_progress_rows()
+                if r["index"] == "catrec"]
+        assert rows, "peer recovery left no progress row"
+        row = rows[0]
+        assert row["stage"] == "done"
+        assert row["type"] == "peer"
+        assert row["source"] == primary
+        assert row["target"] != primary
+        # phase1 shipped the committed files; the counters converged
+        assert row["files_total"] >= 1
+        assert row["files_recovered"] == row["files_total"]
+        assert row["bytes_total"] >= 1
+        assert row["bytes_recovered"] >= row["bytes_total"]
+        assert row["stop_ms"] is not None
+        # the REST renderer surfaces the same rows (other _cat idiom)
+        from elasticsearch_tpu.client import Client
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings.EMPTY)
+        try:
+            status, rows_json = Client(node).perform(
+                "GET", "/_cat/recovery", params={"format": "json"})
+            assert status == 200
+            peer = [r for r in rows_json
+                    if r["index"] == "catrec" and r["type"] == "peer"]
+            assert peer, rows_json
+            assert peer[0]["stage"] == "done"
+            assert peer[0]["files_percent"] == "100.0%"
+            assert peer[0]["bytes_percent"] == "100.0%"
+            assert peer[0]["translog_ops_percent"] == "100.0%"
+            assert peer[0]["source_node"] == primary
+        finally:
+            node.close()
+        clear_recovery_progress()
+
+
 @pytest.mark.slow
 class TestDisruptionConvergence:
     """The acceptance scenario: 30% drop + 200ms delay on every link.

@@ -127,6 +127,28 @@ class TestObservabilityRegistryLint:
             assert kind in mem["staged_bytes"], mem["staged_bytes"]
             assert kind in doc, f"ledger kind [{kind}] undocumented"
 
+    def test_staging_fault_counters_documented_and_exported(
+            self, exercised_index):
+        # ISSUE 10: the classified staging-fault model must export its
+        # counters (search.memory) and the plane-probe/reason split
+        # (search.planes) — and every key must be documented
+        doc = _doc_text()
+        mem = exercised_index.search_stats()["memory"]
+        for key in ("staging_retries_total",
+                    "staging_faults_transient_total",
+                    "staging_faults_deterministic_total",
+                    "staging_fault_events"):
+            assert key in mem, mem.keys()
+            assert key in doc, f"[{key}] undocumented"
+        planes = exercised_index.search_stats()["planes"]
+        for key in ("plane_failures_by_reason", "plane_probes_total"):
+            assert key in planes, planes.keys()
+            assert key in doc, f"[{key}] undocumented"
+        # the quarantine reasons + decision reason are part of the
+        # documented vocabulary
+        for reason in ("kernel_fault", "staging_fault"):
+            assert reason in doc, f"reason [{reason}] undocumented"
+
     def test_node_breakers_and_transport_keys_documented(self):
         # _nodes/stats breakers (the accounting child mirrors the device
         # ledger) and the PR-2 transport resilience counters must stay
