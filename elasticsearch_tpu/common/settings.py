@@ -498,6 +498,57 @@ SEARCH_BATCH_MAX_QUERIES = Setting.int_setting(
     # accumulators and the per-tile top-k loop grow linearly with this
     "search.batch.max_queries", 16, min_value=1, max_value=64, dynamic=True
 )
+SEARCH_BATCH_MAX_WINDOW_MS = Setting.float_setting(
+    # upper bound of the ADAPTIVE batch window (docs/OVERLOAD.md): under
+    # admission-queue pressure the effective window widens linearly from
+    # search.batch.window_ms toward this bound, trading p50 for
+    # throughput; observable via the batch_window_effective_ms gauge
+    "search.batch.max_window_ms", 5.0, min_value=0.0, dynamic=True
+)
+
+# --- multi-tenant overload control (search/admission.py;
+# docs/OVERLOAD.md) ---
+
+SEARCH_QUEUE_SIZE = Setting.int_setting(
+    # bounded search admission queue depth, consulted at IndexService
+    # dispatch BEFORE any staging/launch work (the reference's search
+    # threadpool queue_size); overflow rejects with HTTP 429
+    # es_rejected_execution_exception + a drain-rate-derived Retry-After
+    "search.queue.size", 1000, min_value=1, dynamic=True
+)
+SEARCH_ADMISSION_ENABLED = Setting.bool_setting(
+    # the overload-control plane's kill switch: false admits everything
+    # unconditionally (no queueing, no brownout, no rejection)
+    "search.admission.enabled", True, dynamic=True
+)
+SEARCH_ADMISSION_MAX_CONCURRENT = Setting.int_setting(
+    # in-flight search bound per index; 0 = auto (max(16, 3*cores/2+1),
+    # mirroring the search threadpool sizing). Arrivals over the bound
+    # queue and drain by weighted deficit-round-robin over tenants.
+    "search.admission.max_concurrent", 0, min_value=0, dynamic=True
+)
+SEARCH_ADMISSION_WEIGHTS = Setting.str_setting(
+    # per-tenant DRR weights, "tenantA:4,tenantB:1" (tenant = the
+    # request's X-Opaque-Id; unlisted tenants weigh 1)
+    "search.admission.weights", "", dynamic=True
+)
+SEARCH_ADMISSION_BROWNOUT_PRUNED = Setting.float_setting(
+    # brownout step 1 threshold (queue pressure = queued/capacity):
+    # force pruned/gte-totals eligibility before queueing deeper
+    "search.admission.brownout.pruned_threshold", 0.25, min_value=0.0,
+    dynamic=True
+)
+SEARCH_ADMISSION_BROWNOUT_RESCORE = Setting.float_setting(
+    # brownout step 2 threshold: shed the rescore phase
+    "search.admission.brownout.rescore_threshold", 0.5, min_value=0.0,
+    dynamic=True
+)
+SEARCH_ADMISSION_BROWNOUT_FEATURES = Setting.float_setting(
+    # brownout step 3 threshold: shed aggs/suggest (responses marked
+    # _degraded); step 4 — rejection — is the queue-overflow 429
+    "search.admission.brownout.features_threshold", 0.75, min_value=0.0,
+    dynamic=True
+)
 
 SEARCH_PALLAS_TILES_PER_STEP = Setting(
     # TPU-specific DMA buffering toggle: tiles folded into one grid step
@@ -657,6 +708,14 @@ NODE_SETTINGS = [
     SEARCH_BATCH_ENABLED,
     SEARCH_BATCH_WINDOW_MS,
     SEARCH_BATCH_MAX_QUERIES,
+    SEARCH_BATCH_MAX_WINDOW_MS,
+    SEARCH_QUEUE_SIZE,
+    SEARCH_ADMISSION_ENABLED,
+    SEARCH_ADMISSION_MAX_CONCURRENT,
+    SEARCH_ADMISSION_WEIGHTS,
+    SEARCH_ADMISSION_BROWNOUT_PRUNED,
+    SEARCH_ADMISSION_BROWNOUT_RESCORE,
+    SEARCH_ADMISSION_BROWNOUT_FEATURES,
     SEARCH_PALLAS_TILES_PER_STEP,
     SEARCH_PALLAS_POSTINGS_CODEC,
     SEARCH_PALLAS_PRUNING_ENABLED,

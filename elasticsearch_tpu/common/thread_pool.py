@@ -18,6 +18,8 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
@@ -55,6 +57,21 @@ class PoolStats:
 _STOP = object()  # worker shutdown sentinel
 
 
+def estimate_retry_after(completions, waiting: int) -> float:
+    """Seconds until ``waiting`` work items have plausibly drained one
+    slot, from a ring of recent completion timestamps (monotonic
+    seconds): the Retry-After a 429 carries, clamped [1, 30] and
+    defaulting to 1s without enough signal. Shared by the thread-pool
+    executors and the search admission plane so both 429 sources a
+    client sees stay consistent (docs/OVERLOAD.md)."""
+    now = time.monotonic()
+    recent = [t for t in completions if now - t <= 5.0]
+    if len(recent) < 2:
+        return 1.0
+    rate = len(recent) / max(now - recent[0], 1e-6)
+    return min(max(waiting / rate, 1.0), 30.0)
+
+
 class _Executor:
     """Fixed worker pool over a bounded queue (EsThreadPoolExecutor).
     Workers start lazily on the first submit and block on the queue (no
@@ -72,6 +89,11 @@ class _Executor:
         self._completed = 0
         self._shut = False
         self._workers: list = []
+        # recent completion timestamps: the observed drain rate behind
+        # the Retry-After a rejection carries (docs/OVERLOAD.md) — a
+        # client backing off proportionally to the real overload instead
+        # of a fixed guess
+        self._completions: deque = deque(maxlen=64)
 
     def _ensure_workers(self) -> None:
         with self._lock:
@@ -101,6 +123,7 @@ class _Executor:
                 with self._lock:
                     self._active -= 1
                     self._completed += 1
+                    self._completions.append(time.monotonic())
 
     def submit(self, fn: Callable[[], Any]) -> Future:
         """Enqueue; raises EsRejectedExecutionException when the bounded
@@ -119,10 +142,24 @@ class _Executor:
                 self._queue.put_nowait((fn, future))
             except queue.Full:
                 self._rejected += 1
-                raise EsRejectedExecutionException(
+                exc = EsRejectedExecutionException(
                     f"rejected execution on [{self.name}]: queue capacity "
-                    f"[{self.queue_size}] is full") from None
+                    f"[{self.queue_size}] is full")
+                exc.retry_after_s = estimate_retry_after(
+                    self._completions, self._queue.qsize())
+                raise exc from None
         return future
+
+    def resize_queue(self, queue_size: int) -> None:
+        """Dynamic queue-depth update (search.queue.size): stdlib Queue
+        checks maxsize at put time, so mutating it under the queue's
+        own mutex retargets the bound for every later submit; already-
+        queued work is never dropped by a shrink."""
+        queue_size = max(1, int(queue_size))
+        with self._queue.mutex:
+            self._queue.maxsize = queue_size
+            self._queue.not_full.notify_all()
+        self.queue_size = queue_size
 
     def stats(self) -> PoolStats:
         with self._lock:

@@ -131,6 +131,18 @@ class IndexService:
         from elasticsearch_tpu.search.telemetry import SearchTelemetry
 
         self.telemetry = SearchTelemetry()
+        # multi-tenant overload control (search/admission.py, ISSUE 12,
+        # docs/OVERLOAD.md): bounded admission queue + per-tenant DRR +
+        # the brownout ladder, consulted at dispatch before any
+        # staging/launch work; also sizes the batcher's ADAPTIVE window
+        from elasticsearch_tpu.search.admission import (
+            SearchAdmissionController,
+        )
+
+        self.admission = SearchAdmissionController(name, settings)
+        self._batcher.window_fn = (
+            lambda: self.admission.effective_batch_window_s(
+                self._batcher.window_s))
         # device-memory budget (search.memory.hbm_budget_bytes, ISSUE 9):
         # the accountant is a process resource — an explicitly-set value
         # here (node-file seed / direct-service tests) configures it, the
@@ -706,7 +718,11 @@ class IndexService:
         resp = self._search_dispatch(body, preference_shards,
                                      pinned_segments, deadline=deadline)
         if (cache_key is not None and not resp.get("timed_out")
-                and not resp["_shards"].get("failed")):
+                and not resp["_shards"].get("failed")
+                and not resp.get("_degraded")):
+            # browned-out responses (shed aggs/rescore, forced pruning)
+            # must not poison the cache: once pressure drains the same
+            # body must serve full-precision, full-feature again
             self.request_cache.put(cache_key, resp)
         return resp
 
@@ -714,6 +730,39 @@ class IndexService:
                          preference_shards: Optional[List[int]] = None,
                          pinned_segments: Optional[Dict[int, list]] = None,
                          deadline=None) -> dict:
+        """Overload-control choke point (search/admission.py, ISSUE 12):
+        every top-level search acquires an admission slot here BEFORE
+        any staging/launch work. Overflow raises the 429 rejection; a
+        deadline that expired while queued is shed pre-execution and
+        serves its partial timed-out response; admitted queries execute
+        shaped by the brownout ladder (forced pruning eligibility /
+        shed rescore / shed aggs+suggest, marked ``_degraded``)."""
+        from elasticsearch_tpu.search.service import expired_queue_response
+
+        token = self.admission.acquire(deadline=deadline)
+        if token.shed_expired:
+            if deadline is not None:
+                deadline.timed_out = True
+            return expired_queue_response(self.name, len(self.shards),
+                                          body)
+        try:
+            shaped, degraded = self.admission.apply_brownout(body, token)
+            resp = self._admitted_dispatch(shaped, preference_shards,
+                                           pinned_segments,
+                                           deadline=deadline)
+            if degraded and isinstance(resp, dict):
+                # the degradation marker ALSO keeps the response out of
+                # the request cache (IndexService.search): a browned-out
+                # response must never be replayed after pressure drains
+                resp["_degraded"] = degraded
+            return resp
+        finally:
+            self.admission.release(token)
+
+    def _admitted_dispatch(self, body: dict,
+                           preference_shards: Optional[List[int]] = None,
+                           pinned_segments: Optional[Dict[int, list]]
+                           = None, deadline=None) -> dict:
         """Route the query phase through the cross-query micro-batcher
         when eligible (search/batching.py): a concurrent burst of
         compatible queries shares one batched kernel launch; a lone query
@@ -1397,6 +1446,11 @@ class IndexService:
             # batch-size distribution, and how often a leader paid the
             # collection window
             "batch": self.batch_stats.as_dict(),
+            # multi-tenant overload control (ISSUE 12, docs/OVERLOAD.md):
+            # admission queue occupancy, admitted/rejected/expired
+            # counters, brownout ladder state + per-step shed counts,
+            # the computed Retry-After, and per-tenant accounting
+            "admission": self.admission.stats_dict(),
             # phase-attributed telemetry (ISSUE 8, docs/OBSERVABILITY.md):
             # per-plane × per-phase log2 latency histograms, byte/tile
             # counters, and plane-ladder decision counters with reasons
@@ -1485,6 +1539,9 @@ class IndexService:
     def close(self) -> None:
         if self._refresh_stop is not None:
             self._refresh_stop.set()
+        # wake queued admission waiters with a clean rejection so no
+        # caller hangs on a closing index
+        self.admission.shutdown()
         # structured device-memory releases first (mesh plane, then every
         # shard's segments via engine.close), then the index-level ledger
         # backstop — close/delete must return the ledger to baseline

@@ -157,7 +157,14 @@ class Node:
         # handler work on the action's pool; full queues reject with 429
         from elasticsearch_tpu.common.thread_pool import ThreadPool
 
-        self.thread_pool = ThreadPool()
+        # search.queue.size bounds BOTH backpressure points the same way
+        # (docs/OVERLOAD.md): the REST-layer search executor queue here
+        # and each index's admission queue (search/admission.py) — and
+        # a dynamic update below retargets the live pool too, so the
+        # contract survives PUT _cluster/settings mid-incident
+        self.thread_pool = ThreadPool(overrides={
+            "search": {"queue_size": settings.get_int(
+                "search.queue.size", 1000)}})
         from elasticsearch_tpu.common.breaker import configure_breaker_service
 
         # hierarchical memory circuit breakers (indices.breaker.*)
@@ -272,7 +279,8 @@ class Node:
         # retry config is process-level — a create-time snapshot in the
         # index Settings would shadow later dynamic cluster updates)
         for prefix in ("search.batch.", "search.pallas.", "search.knn.",
-                       "search.telemetry."):
+                       "search.telemetry.", "search.queue.",
+                       "search.admission."):
             cluster_dynamic = state.persistent_settings.merged_with(
                 state.transient_settings).filtered_by_prefix(prefix)
             merged_settings = self.settings.filtered_by_prefix(
@@ -1671,6 +1679,23 @@ class Node:
             value = setting.get(committed) if explicit else None
             for svc in self.indices.values():
                 setattr(svc, attr, value)
+        # overload-control knobs (search.queue.* / search.admission.* /
+        # search.batch.max_window_ms — ISSUE 12, docs/OVERLOAD.md) share
+        # the explicitness contract: each live admission controller
+        # installs the committed cluster settings' EXPLICIT keys as
+        # overrides; a cleared key hands control back to the index's own
+        # Settings map. (The controller reads its config live, so no
+        # value-only update consumers are needed.)
+        for svc in self.indices.values():
+            svc.admission.set_cluster_overrides(committed)
+        # the REST search pool's queue moves with the same key (the
+        # "both backpressure points" contract, docs/OVERLOAD.md):
+        # explicit cluster value wins, clearing reverts to the node file
+        qsize_key = "search.queue.size"
+        qsize_src = (committed if committed.get(qsize_key) is not None
+                     else self.settings)
+        self.thread_pool.executor("search").resize_queue(
+            qsize_src.get_int(qsize_key, 1000))
         # HBM budget (search.memory.hbm_budget_bytes): the accountant is
         # a process resource — an explicit cluster-level value wins, and
         # clearing it reverts to the node-file setting; lowering the

@@ -1103,9 +1103,20 @@ class IndexMeshSearch:
         enabled = getattr(self.svc, "pruning_enabled_override", None)
         if enabled is None:
             if settings is None:
-                return False, 8
-            enabled = settings.get_bool(
-                "search.pallas.pruning.enabled", False)
+                enabled = False
+            else:
+                enabled = settings.get_bool(
+                    "search.pallas.pruning.enabled", False)
+        # brownout step 1 (ISSUE 12, docs/OVERLOAD.md): under admission-
+        # queue pressure the overload plane forces pruned / gte-totals
+        # eligibility — cheaper tiles before shedding features — and
+        # releases it as the queue drains
+        adm = getattr(self.svc, "admission", None)
+        if not enabled and adm is not None \
+                and adm.brownout_forces_pruning:
+            enabled = True
+        if settings is None:
+            return bool(enabled), 8
         probe = getattr(self.svc, "pruning_probe_override", None)
         if probe is None:
             probe = (settings.get_int(

@@ -10,6 +10,7 @@ reference's {"error": {...}, "status": N} shape.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import re
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -20,6 +21,29 @@ from elasticsearch_tpu.common.errors import (
 )
 
 Handler = Callable[..., Tuple[int, Any]]
+
+# response-header side channel (the deprecation Warning-collector
+# pattern): dispatch seeds a mutable dict per request; anything on the
+# request path may set a header (Retry-After on 429 rejections —
+# docs/OVERLOAD.md); the HTTP front door drains it into the response
+_resp_headers_var: "contextvars.ContextVar[Optional[dict]]" = \
+    contextvars.ContextVar("estpu_response_headers", default=None)
+
+
+def begin_response_headers() -> None:
+    _resp_headers_var.set({})
+
+
+def set_response_header(name: str, value: str) -> None:
+    headers = _resp_headers_var.get()
+    if headers is not None:
+        headers[name] = value
+
+
+def collect_response_headers() -> Dict[str, str]:
+    out = dict(_resp_headers_var.get() or {})
+    _resp_headers_var.set({})
+    return out
 
 
 def header_value(headers: Optional[Dict[str, str]], name: str,
@@ -168,6 +192,7 @@ class RestController:
         from elasticsearch_tpu.search.telemetry import set_opaque_id
 
         begin_request()  # per-request Warning-header collector
+        begin_response_headers()  # Retry-After etc. (docs/OVERLOAD.md)
         # X-Opaque-Id rides the request context (contextvars copied into
         # the executor thread below): tasks, slowlog lines, and profile
         # output read it back to join work to the client that sent it
@@ -216,6 +241,19 @@ class RestController:
                         _executor_for(method, route.pattern),
                         lambda: ctx.run(route.handler, self.node, req))
                 except ElasticsearchTpuException as e:
+                    # 429 backpressure contract (docs/OVERLOAD.md): a
+                    # rejection carrying a drain-rate-derived
+                    # retry_after_s renders it as the Retry-After header
+                    # (never in the reference-shaped error body)
+                    retry_after = getattr(e, "retry_after_s", None)
+                    if retry_after is not None:
+                        from elasticsearch_tpu.search.admission import (
+                            retry_after_header_value,
+                        )
+
+                        set_response_header(
+                            "Retry-After",
+                            retry_after_header_value(retry_after))
                     return e.status_code, e.to_dict()
                 except Exception as e:  # uncaught -> 500, reference behavior
                     return 500, {

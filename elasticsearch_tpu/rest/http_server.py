@@ -56,6 +56,14 @@ class _Handler(BaseHTTPRequestHandler):
         opaque = self.headers.get("X-Opaque-Id")
         if opaque:
             self.send_header("X-Opaque-Id", opaque)
+        # dispatch-collected response headers (rest/controller.py):
+        # Retry-After on 429 rejections (docs/OVERLOAD.md)
+        from elasticsearch_tpu.rest.controller import (
+            collect_response_headers,
+        )
+
+        for name, value in collect_response_headers().items():
+            self.send_header(name, value)
         for w in warnings:
             self.send_header("Warning", warning_header_value(w))
         self.end_headers()

@@ -145,10 +145,18 @@ class BatchStats:
         self.batched_query_total = 0
         self.batch_window_waits_total = 0
         self.batch_size_histogram: Dict[int, int] = {}
+        # last collection window a leader actually used, in ms — the
+        # adaptive-window observability gauge (docs/OVERLOAD.md): widens
+        # under admission-queue pressure, narrows back as it drains
+        self.batch_window_effective_ms = 0.0
 
     def note_window_wait(self) -> None:
         with self._lock:
             self.batch_window_waits_total += 1
+
+    def note_effective_window(self, window_s: float) -> None:
+        with self._lock:
+            self.batch_window_effective_ms = round(window_s * 1000.0, 4)
 
     def note_batch(self, size: int) -> None:
         """One batched dispatch of ``size`` members served via a shared
@@ -163,6 +171,7 @@ class BatchStats:
             return {
                 "batched_query_total": self.batched_query_total,
                 "batch_window_waits_total": self.batch_window_waits_total,
+                "batch_window_effective_ms": self.batch_window_effective_ms,
                 "batch_size_histogram": {
                     str(size): count for size, count
                     in sorted(self.batch_size_histogram.items())},
@@ -229,6 +238,12 @@ class MicroBatcher:
         # member's QueryTracer (docs/OBSERVABILITY.md)
         self.annotate: Optional[Callable[[Any, float, int, int],
                                          None]] = None
+        # adaptive collection window (docs/OVERLOAD.md): when set, the
+        # leader sizes its wait from this callable instead of window_s —
+        # IndexService points it at the admission controller, which
+        # widens the window with queue pressure (bounded by
+        # search.batch.max_window_ms). A lone query still never waits.
+        self.window_fn: Optional[Callable[[], float]] = None
 
     def run(self, key, item, single_fn: Callable[[Any], Any],
             batch_fn: Callable[[List[Any]], List[Any]]):
@@ -267,7 +282,14 @@ class MicroBatcher:
                 return single_fn(item)
             if leader:
                 self.stats.note_window_wait()
-                deadline = time.monotonic() + self.window_s
+                window_s = self.window_s
+                if self.window_fn is not None:
+                    try:
+                        window_s = max(float(self.window_fn()), 0.0)
+                    except Exception:  # noqa: BLE001 — sizing is
+                        pass  # advisory; never fail the query
+                self.stats.note_effective_window(window_s)
+                deadline = time.monotonic() + window_s
                 with self._cv:
                     while (not group.sealed
                            and len(group.items) < self.max_queries):

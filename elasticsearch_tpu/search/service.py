@@ -1014,6 +1014,35 @@ def allow_partial_results(body: dict) -> bool:
     return bool(v)
 
 
+def expired_queue_response(index_name: str, n_shards: int,
+                           body: dict) -> dict:
+    """The partial response for a search whose deadline expired while
+    it was still QUEUED in the admission plane (docs/OVERLOAD.md): it
+    is shed before execution — no staging, no launch, no shard work —
+    and serves the same timed-out degradation the query phase would
+    have produced at its first checkpoint (the PR-4 contract). Shards
+    count successful: none failed, none ran. allow_partial_search_
+    results=false keeps its error contract instead."""
+    if not allow_partial_results(body):
+        from elasticsearch_tpu.common.errors import (
+            SearchPhaseExecutionException,
+        )
+
+        raise SearchPhaseExecutionException(
+            "query",
+            "Partial shards failure (request timed out in the search "
+            "admission queue)", [])
+    return {
+        "took": 0,
+        "timed_out": True,
+        "_plane": "none",
+        "_degraded": ["expired_in_queue"],
+        "_shards": {"total": n_shards, "successful": n_shards,
+                    "skipped": 0, "failed": 0},
+        "hits": {"total": 0, "max_score": None, "hits": []},
+    }
+
+
 def shard_failure_entry(index: str, shard_id, exc: Exception,
                         node: Optional[str] = None) -> dict:
     """One failures[] entry (ShardSearchFailure.toXContent shape): the

@@ -88,6 +88,7 @@ class ChaosSoak:
                  docs_per_round: int = 24, searches_per_round: int = 6,
                  search_threads: int = 2, shards: int = 3,
                  seed_docs: int = 48, with_cluster: bool = True,
+                 with_overload: bool = True,
                  cluster_drop_p: float = 0.15,
                  amplification_bound: float = 200.0,
                  quarantine_cooldown: str = "150ms",
@@ -100,6 +101,13 @@ class ChaosSoak:
         self.shards = int(shards)
         self.seed_docs = int(seed_docs)
         self.with_cluster = bool(with_cluster)
+        self.with_overload = bool(with_overload)
+        # the overload phase's admission shape: one effective slot
+        # (max_concurrent - block_slots), a small bounded queue, and
+        # pinned synthetic occupancy at capacity so every arrival that
+        # cannot take the free slot gets a clean 429 (docs/OVERLOAD.md)
+        self.overload_queue_size = 8
+        self.overload_max_concurrent = 2
         self.cluster_drop_p = float(cluster_drop_p)
         self.amplification_bound = float(amplification_bound)
         self.quarantine_cooldown = quarantine_cooldown
@@ -147,10 +155,10 @@ class ChaosSoak:
 
     # -- targets ---------------------------------------------------------
 
-    def _mk_index(self, name: str):
+    def _mk_index(self, name: str, overload: bool = False):
         from elasticsearch_tpu.index.index_service import IndexService
 
-        return IndexService(name, Settings({
+        settings = {
             "index.number_of_shards": self.shards,
             "index.search.mesh": True,
             # kernel-or-host ladder: every rung shares the byte-identity
@@ -159,10 +167,19 @@ class ChaosSoak:
             "index.search.plane_quarantine.cooldown":
                 self.quarantine_cooldown,
             "index.refresh_interval": -1,
-        }), mapping={"properties": {
-            "body": {"type": "text", "analyzer": "whitespace"},
-            "n": {"type": "integer"},
-        }})
+        }
+        if overload:
+            # tight admission shape so the QueuePressureScheme phase
+            # exercises real rejections (the oracle stays unbounded)
+            settings["search.queue.size"] = self.overload_queue_size
+            settings["search.admission.max_concurrent"] = \
+                self.overload_max_concurrent
+        return IndexService(name, Settings(settings),
+                            mapping={"properties": {
+                                "body": {"type": "text",
+                                         "analyzer": "whitespace"},
+                                "n": {"type": "integer"},
+                            }})
 
     # -- invariant helpers ----------------------------------------------
 
@@ -179,7 +196,13 @@ class ChaosSoak:
                 raise ChaosSoakViolation(
                     f"shard failures on the disrupted index: "
                     f"{got['_shards']}")
-            if got["hits"]["total"] != want["hits"]["total"] or \
+            # hit ids AND scores are byte-identical under every
+            # degradation mode; TOTALS are only comparable outside
+            # brownout (forced pruning reports a documented gte lower
+            # bound — docs/OVERLOAD.md / docs/PRUNING.md)
+            exact_total = not (got.get("_pruned") or got.get("_degraded"))
+            if (exact_total
+                    and got["hits"]["total"] != want["hits"]["total"]) or \
                     self._hits_key(got) != self._hits_key(want):
                 raise ChaosSoakViolation(
                     f"hits diverged from the undisrupted oracle for "
@@ -206,10 +229,10 @@ class ChaosSoak:
             "acked_writes": 0, "acked_deletes": 0,
             "searches_under_fault": 0, "search_errors": [],
             "parity_checked": 0, "planes_seen": set(),
-            "scheme_hits": {}, "cluster": None,
+            "scheme_hits": {}, "cluster": None, "overload": None,
         }
         rng = np.random.RandomState(self.seed)
-        svc = self._mk_index(self.index)
+        svc = self._mk_index(self.index, overload=self.with_overload)
         oracle = self._mk_index(self.oracle_index)
         cluster = None
         try:
@@ -254,6 +277,9 @@ class ChaosSoak:
                 svc.refresh()
                 oracle.refresh()
                 self._verify_round(svc, oracle, rng, live_ids, report)
+            # ---- frozen-corpus phase: overload under transport faults -
+            if self.with_overload:
+                self._verify_overload(svc, oracle, rng, cluster, report)
             # ---- frozen-corpus phase: ledger leak-freedom -------------
             self._verify_ledger_and_recovery(svc, oracle, warm_body,
                                              report)
@@ -345,6 +371,122 @@ class ChaosSoak:
         # byte-identical hits vs the oracle on a seeded query set
         self._assert_parity(
             svc, oracle, [self._query(rng) for _ in range(4)], report)
+
+    # -- frozen-corpus overload phase (ISSUE 12, docs/OVERLOAD.md) ------
+
+    def _verify_overload(self, svc, oracle, rng, cluster,
+                         report: dict) -> None:
+        """Overload + transport faults over the frozen corpus: pinned
+        synthetic occupancy at queue capacity plus one blocked slot
+        forces every arrival that cannot take the free slot into a
+        clean 429 while admitted queries keep serving. Invariants:
+
+        - zero 5xx: every offered query ends in a complete answer or
+          an es_rejected_execution_exception carrying retry_after_s;
+        - admitted-query hits (ids AND scores) stay byte-identical to
+          the undisrupted oracle — brownout may shed features and
+          report gte totals, never wrong hits;
+        - no silent drops: rejected == offered − admitted, client-side
+          AND in the controller's exact counters.
+        """
+        from elasticsearch_tpu.common.errors import (
+            EsRejectedExecutionException,
+        )
+
+        queries = [self._query(rng) for _ in range(
+            self.searches_per_round * 2)]
+        # oracle answers pre-computed serially: the corpus is frozen, so
+        # admitted hits under pressure must match these — ids exactly
+        # always; scores exactly except under forced pruning, whose
+        # different accumulation order shifts float32 results by an ulp
+        # (ids and ranking stay exact; docs/PRUNING.md)
+        want = {i: self._hits_key(oracle.search(dict(body)))
+                for i, body in enumerate(queries)}
+
+        def hits_match(resp, expect) -> bool:
+            got = self._hits_key(resp)
+            if [h[0] for h in got] != [h[0] for h in expect]:
+                return False
+            if resp.get("_pruned") or resp.get("_degraded"):
+                return bool(np.allclose([h[1] for h in got],
+                                        [h[1] for h in expect],
+                                        rtol=2e-5, atol=1e-6))
+            return got == expect
+        base = svc.admission.stats_dict()
+        schemes = [dis.QueuePressureScheme(
+            occupancy=self.overload_queue_size, block_slots=1,
+            drain_delay_s=0.001, indices=[self.index]).install()]
+        net = self._install_net_schemes(cluster)
+        counts = {"offered": 0, "admitted": 0, "rejected": 0}
+        errors: List[str] = []
+        lock = threading.Lock()
+
+        def hammer(tid: int):
+            for i, body in enumerate(queries):
+                with lock:
+                    counts["offered"] += 1
+                try:
+                    r = svc.search(dict(body))
+                    if r["_shards"]["failed"]:
+                        errors.append(
+                            f"overload{tid}: failed shards {r['_shards']}")
+                    elif not hits_match(r, want[i]):
+                        errors.append(
+                            f"overload{tid}: admitted hits diverged for "
+                            f"{body!r}: {self._hits_key(r)} != {want[i]}")
+                    with lock:
+                        counts["admitted"] += 1
+                except EsRejectedExecutionException as e:
+                    if getattr(e, "retry_after_s", None) is None:
+                        errors.append(
+                            f"overload{tid}: 429 without retry_after_s")
+                    with lock:
+                        counts["rejected"] += 1
+                except Exception as e:  # noqa: BLE001 — zero-5xx
+                    errors.append(
+                        f"overload{tid}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=hammer, args=(t,),
+                                    name=f"chaos-overload{t}")
+                   for t in range(max(self.search_threads, 2) + 1)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            for s in schemes:
+                report["scheme_hits"][f"overload:{type(s).__name__}"] = \
+                    s.hits
+                s.remove()
+            for s in net:
+                s.remove()
+        if errors:
+            raise ChaosSoakViolation(
+                f"overload phase broke an invariant: {errors[:4]}")
+        if counts["rejected"] != counts["offered"] - counts["admitted"]:
+            raise ChaosSoakViolation(
+                f"silent drops under overload: {counts}")
+        if counts["rejected"] == 0:
+            raise ChaosSoakViolation(
+                f"overload phase never rejected — the pinned occupancy "
+                f"did not bite: {counts}")
+        after = svc.admission.stats_dict()
+        delta_adm = after["admitted_total"] - base["admitted_total"]
+        delta_rej = after["rejected_total"] - base["rejected_total"]
+        if (delta_adm != counts["admitted"]
+                or delta_rej != counts["rejected"]):
+            raise ChaosSoakViolation(
+                f"admission counters drifted from the client's truth: "
+                f"counters admitted={delta_adm} rejected={delta_rej} vs "
+                f"{counts}")
+        # pressure drained: the ladder steps back down and subsequent
+        # queries are full-precision again (checked via _assert_parity
+        # exact totals in the recovery phase below)
+        svc.admission.refresh_level()
+        report["overload"] = dict(
+            counts, brownout_transitions=after["brownout_transitions"],
+            retry_after_s=after["retry_after_s"])
 
     # -- frozen-corpus ledger + self-heal phase -------------------------
 

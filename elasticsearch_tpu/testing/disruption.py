@@ -465,6 +465,54 @@ class EvictionStormScheme(ShardSearchScheme):
             self.evicted_bytes += freed
 
 
+class QueuePressureScheme(ShardSearchScheme):
+    """Synthetic pressure on the search ADMISSION plane (ISSUE 12,
+    docs/OVERLOAD.md): the overload analog of the staging/launch fault
+    schemes. Consulted by ``SearchAdmissionController`` at every
+    acquire/release:
+
+    ``occupancy``: synthetic queued entries pinned onto the admission
+    queue — raises queue pressure (driving the brownout ladder) and
+    counts toward the overflow check, so ``occupancy >= search.queue
+    .size`` forces every arrival that cannot take a free slot into a
+    clean 429.
+    ``block_slots``: concurrency slots withheld from the controller's
+    ``max_concurrent`` — arrivals queue (and drain by DRR) as if that
+    much capacity were busy elsewhere.
+    ``drain_delay_s``: added to every release, slowing the observed
+    drain rate (stretches the computed Retry-After).
+    """
+
+    def __init__(self, occupancy: int = 0, block_slots: int = 0,
+                 drain_delay_s: float = 0.0, **filters):
+        super().__init__(**filters)
+        self.occupancy = max(0, int(occupancy))
+        self.block_slots = max(0, int(block_slots))
+        self.drain_delay_s = float(drain_delay_s)
+
+
+def queue_pressure(index: str, count_hit: bool = True):
+    """(occupancy, blocked_slots, drain_delay_s) summed over the
+    installed matching :class:`QueuePressureScheme`s. ``count_hit``:
+    admission's acquire consults count as scheme hits; bookkeeping
+    consults (level refresh, window sizing) do not."""
+    if not _SEARCH_SCHEMES:
+        return 0, 0, 0.0
+    occ = blocked = 0
+    delay = 0.0
+    for scheme in list(_SEARCH_SCHEMES):
+        if not isinstance(scheme, QueuePressureScheme):
+            continue
+        if scheme.indices is not None and index not in scheme.indices:
+            continue
+        if count_hit:
+            scheme.hits += 1
+        occ += scheme.occupancy
+        blocked += scheme.block_slots
+        delay = max(delay, scheme.drain_delay_s)
+    return occ, blocked, delay
+
+
 class ActionBlackhole(DisruptionScheme):
     """Requests matching the action patterns vanish: the delivery blocks
     until the caller's deadline (MockTransportService's request

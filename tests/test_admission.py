@@ -1,0 +1,684 @@
+"""Multi-tenant overload control (ISSUE 12, docs/OVERLOAD.md):
+bounded admission + 429/Retry-After, DRR tenant fairness, the brownout
+ladder (fire in order, recover in reverse), the adaptive batch window,
+pre-execution shedding of deadline-expired queue entries, and exact
+counters under a concurrent mixed burst (the PR-8 concurrency idiom)."""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.errors import EsRejectedExecutionException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.search.telemetry import set_opaque_id
+from elasticsearch_tpu.testing import disruption as dis
+
+MAPPING = {"properties": {
+    "body": {"type": "text", "analyzer": "whitespace"},
+    "n": {"type": "integer"},
+}}
+
+
+def build_index(name="adm", shards=2, **extra):
+    # host plane: SearchDelayScheme's per-shard stall (the deterministic
+    # service-time generator these tests meter admission with) fires on
+    # the host path; admission itself is plane-agnostic — it sits at
+    # dispatch before the ladder
+    settings = {"index.number_of_shards": shards,
+                "index.search.mesh": False,
+                "index.refresh_interval": -1}
+    settings.update(extra)
+    idx = IndexService(name, Settings(settings), mapping=MAPPING)
+    for d in range(12):
+        idx.index_doc(str(d), {"body": f"w{d % 3} common", "n": d})
+    idx.refresh()
+    idx.search({"query": {"match": {"body": "common"}}})  # warm planes
+    return idx
+
+
+@pytest.fixture(autouse=True)
+def _clean_schemes():
+    yield
+    dis.clear_search_disruptions()
+    set_opaque_id(None)
+
+
+QUERY = {"query": {"match": {"body": "common"}}, "size": 5}
+
+
+class TestBoundedAdmission:
+    def test_queue_full_rejects_429_with_retry_after(self):
+        idx = build_index(**{"search.admission.max_concurrent": 1,
+                             "search.queue.size": 2})
+        slow = dis.SearchDelayScheme(0.25, indices=["adm"]).install()
+        results = {"ok": 0, "rej": 0, "retry_after": None, "exc": None}
+        lock = threading.Lock()
+
+        def worker():
+            try:
+                idx.search(dict(QUERY))
+                with lock:
+                    results["ok"] += 1
+            except EsRejectedExecutionException as e:
+                with lock:
+                    results["rej"] += 1
+                    results["retry_after"] = getattr(e, "retry_after_s",
+                                                     None)
+                    results["exc"] = e
+
+        try:
+            threads = [threading.Thread(target=worker) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            slow.remove()
+            idx.close()
+        assert results["ok"] >= 1 and results["rej"] >= 1
+        assert results["ok"] + results["rej"] == 8
+        # the reference-shaped 429 body: type + reason naming the
+        # queue capacity; Retry-After rides as an attribute, never a
+        # timeout, never a 5xx
+        exc = results["exc"]
+        assert exc.status_code == 429
+        err = exc.to_dict()["error"]
+        assert err["type"] == "es_rejected_execution_exception"
+        assert "queue capacity [2]" in err["reason"]
+        assert results["retry_after"] is not None
+        assert results["retry_after"] >= 1.0
+
+    def test_rest_429_contract_and_retry_after_header(self):
+        from elasticsearch_tpu.client import Client
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.controller import (
+            collect_response_headers,
+        )
+
+        node = Node(Settings({"cluster.name": "adm-rest"}))
+        try:
+            c = Client(node)
+            c.index("ridx", "1", {"body": "hello"})
+            node.indices["ridx"].refresh()
+            qp = dis.QueuePressureScheme(
+                occupancy=2000, block_slots=10_000,
+                indices=["ridx"]).install()
+            status, payload = c.search(
+                "ridx", {"query": {"match": {"body": "hello"}}})
+            headers = collect_response_headers()
+            assert status == 429
+            assert payload["status"] == 429
+            assert payload["error"]["type"] == \
+                "es_rejected_execution_exception"
+            assert "queue capacity" in payload["error"]["reason"]
+            # the error body stays reference-shaped: the retry hint is
+            # the HTTP header, not a body field
+            assert "retry_after_s" not in payload["error"]
+            assert int(headers["Retry-After"]) >= 1
+            qp.remove()
+            status, _ = c.search(
+                "ridx", {"query": {"match": {"body": "hello"}}})
+            assert status == 200
+        finally:
+            node.close()
+
+    def test_msearch_rejects_per_entry_peers_unaffected(self):
+        from elasticsearch_tpu.client import Client
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings({"cluster.name": "adm-ms"}))
+        try:
+            c = Client(node)
+            c.index("hot", "1", {"body": "hello"})
+            c.index("cold", "1", {"body": "hello"})
+            node.indices["hot"].refresh()
+            node.indices["cold"].refresh()
+            qp = dis.QueuePressureScheme(
+                occupancy=2000, block_slots=10_000,
+                indices=["hot"]).install()
+            body = (b'{"index": "hot"}\n'
+                    b'{"query": {"match": {"body": "hello"}}}\n'
+                    b'{"index": "cold"}\n'
+                    b'{"query": {"match": {"body": "hello"}}}\n')
+            status, payload = c.perform("POST", "/_msearch", None, body)
+            qp.remove()
+            # the PR-4 partial-failure contract: one rejected member is
+            # that member's 429 entry, its peer completes normally
+            assert status == 200
+            entries = payload["responses"]
+            assert entries[0]["status"] == 429
+            assert entries[0]["error"]["type"] == \
+                "es_rejected_execution_exception"
+            assert entries[1]["hits"]["total"] == 1
+        finally:
+            node.close()
+
+    def test_bulk_path_untouched_under_pressure(self):
+        from elasticsearch_tpu.client import Client
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings({"cluster.name": "adm-bulk"}))
+        try:
+            c = Client(node)
+            c.index("bidx", "1", {"body": "x"})
+            qp = dis.QueuePressureScheme(
+                occupancy=2000, block_slots=10_000,
+                indices=["bidx"]).install()
+            status, payload = c.bulk(
+                '{"index": {"_index": "bidx", "_id": "2"}}\n'
+                '{"body": "y"}\n')
+            qp.remove()
+            assert status == 200 and payload["errors"] is False
+        finally:
+            node.close()
+
+
+class TestTenantFairness:
+    def test_drr_keeps_light_tenant_interleaved(self):
+        """A zipfian-hot tenant floods the queue; the light tenant's
+        entries still dequeue round-robin — between any two light-tenant
+        admissions at most (weight ratio + immediate-admit slack) hot
+        queries pass, so the light tenant's p99 is bounded by its own
+        queue, not the hot tenant's."""
+        idx = build_index(**{"search.admission.max_concurrent": 1,
+                             "search.queue.size": 100})
+        slow = dis.SearchDelayScheme(0.01, indices=["adm"]).install()
+        hot_n, light_n = 18, 4
+        started = threading.Barrier(hot_n + light_n + 1)
+
+        def client(tenant):
+            set_opaque_id(tenant)
+            started.wait()
+            idx.search(dict(QUERY))
+
+        threads = [threading.Thread(target=client, args=("hot",))
+                   for _ in range(hot_n)]
+        threads += [threading.Thread(target=client, args=("light",))
+                    for _ in range(light_n)]
+        try:
+            for t in threads:
+                t.start()
+            started.wait()  # release the burst at once
+            for t in threads:
+                t.join()
+        finally:
+            slow.remove()
+        log = list(idx.admission.admission_log)
+        idx.close()
+        light_pos = [i for i, t in enumerate(log) if t == "light"]
+        assert len(light_pos) == light_n
+        # DRR: equal weights alternate hot/light while both queues are
+        # non-empty. The burst races admission, so allow slack for
+        # entries admitted before the light queue formed — but the last
+        # light query must land well before the hot flood finishes.
+        assert light_pos[-1] <= 2 * light_n + 6, log
+
+    def test_weighted_tenant_gets_proportional_share(self):
+        idx = build_index(**{"search.admission.max_concurrent": 1,
+                             "search.queue.size": 100,
+                             "search.admission.weights": "vip:3"})
+        slow = dis.SearchDelayScheme(0.005, indices=["adm"]).install()
+        started = threading.Barrier(13)
+
+        def client(tenant):
+            set_opaque_id(tenant)
+            started.wait()
+            idx.search(dict(QUERY))
+
+        threads = [threading.Thread(target=client, args=("vip",))
+                   for _ in range(9)]
+        threads += [threading.Thread(target=client, args=("std",))
+                    for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            started.wait()
+            for t in threads:
+                t.join()
+        finally:
+            slow.remove()
+        log = list(idx.admission.admission_log)
+        stats = idx.admission.stats_dict()
+        idx.close()
+        assert stats["tenants"]["vip"]["admitted_total"] == 9
+        assert stats["tenants"]["std"]["admitted_total"] == 3
+        # weight 3 serves up to 3 vip entries per std entry once both
+        # queues formed: std never waits behind more than 3 + slack vips
+        std_pos = [i for i, t in enumerate(log) if t == "std"]
+        gaps = [b - a for a, b in zip(std_pos, std_pos[1:])]
+        assert all(g <= 5 for g in gaps), log
+
+
+class TestQueueDisplacement:
+    def test_hot_tenant_cannot_monopolize_the_queue(self):
+        """Fair-share queue displacement: the overflow check is tenant-
+        aware — when the queue is full of a hot tenant's entries, a
+        light tenant's arrival displaces the hot tenant's newest entry
+        (which gets the clean 429) instead of being rejected itself."""
+        idx = build_index(**{"search.admission.max_concurrent": 1,
+                             "search.queue.size": 4})
+        slow = dis.SearchDelayScheme(0.05, indices=["adm"]).install()
+        outcome = {"light_ok": 0, "light_rej": 0, "hot_rej": 0}
+        lock = threading.Lock()
+
+        def hot():
+            set_opaque_id("hot")
+            try:
+                idx.search(dict(QUERY))
+            except EsRejectedExecutionException:
+                with lock:
+                    outcome["hot_rej"] += 1
+
+        def light():
+            set_opaque_id("light")
+            time.sleep(0.08)  # arrive AFTER the hot flood filled the queue
+            try:
+                idx.search(dict(QUERY))
+                with lock:
+                    outcome["light_ok"] += 1
+            except EsRejectedExecutionException:
+                with lock:
+                    outcome["light_rej"] += 1
+
+        threads = [threading.Thread(target=hot) for _ in range(8)]
+        threads.append(threading.Thread(target=light))
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            slow.remove()
+        stats = idx.admission.stats_dict()
+        idx.close()
+        # the light tenant got in by displacing a hot entry — the hot
+        # tenant ate the 429s, the light tenant served
+        assert outcome["light_ok"] == 1 and outcome["light_rej"] == 0, \
+            (outcome, stats["tenants"])
+        assert outcome["hot_rej"] >= 1
+        assert stats["tenants"]["light"]["admitted_total"] == 1
+        assert stats["tenants"]["hot"]["rejected_total"] \
+            == outcome["hot_rej"]
+
+
+class TestBrownoutLadder:
+    AGG_BODY = {"query": {"match": {"body": "common"}}, "size": 3,
+                "aggs": {"by": {"terms": {"field": "body"}}},
+                "suggest": {"s": {"text": "common",
+                                  "term": {"field": "body"}}}}
+
+    def test_steps_fire_in_order_and_recover_in_reverse(self):
+        idx = build_index(**{"search.queue.size": 100})
+        try:
+            oracle = idx.search(dict(self.AGG_BODY))
+            assert "aggregations" in oracle and "suggest" in oracle
+            levels_up, levels_down = [], []
+            # pressure rises through the thresholds: 0.25 / 0.5 / 0.75
+            for occ in (0, 30, 60, 90):
+                qp = dis.QueuePressureScheme(
+                    occupancy=occ, indices=["adm"]).install()
+                levels_up.append(idx.admission.refresh_level())
+                qp.remove()
+            for occ in (90, 60, 30, 0):
+                qp = dis.QueuePressureScheme(
+                    occupancy=occ, indices=["adm"]).install()
+                levels_down.append(idx.admission.refresh_level())
+                qp.remove()
+            assert levels_up == [0, 1, 2, 3]
+            assert levels_down == [3, 2, 1, 0]
+            tr = idx.admission.stats_dict()["brownout_transitions"]
+            assert tr["enter"] == {"1": 1, "2": 1, "3": 1}
+            assert tr["exit"] == {"1": 1, "2": 1, "3": 1}
+        finally:
+            idx.close()
+
+    def test_sheds_rescore_then_features_marked_and_counted(self):
+        idx = build_index(**{"search.queue.size": 100})
+        try:
+            body = dict(self.AGG_BODY)
+            body["rescore"] = {"window_size": 5, "query": {
+                "rescore_query": {"match": {"body": "w1"}}}}
+            # level 2: rescore shed, aggs/suggest kept
+            qp = dis.QueuePressureScheme(occupancy=60,
+                                         indices=["adm"]).install()
+            r2 = idx.search(dict(body))
+            qp.remove()
+            assert "rescore" in r2["_degraded"]
+            assert "forced_pruned" in r2["_degraded"]
+            assert "aggregations" in r2 and "suggest" in r2
+            # level 3: aggs + suggest shed too
+            qp = dis.QueuePressureScheme(occupancy=90,
+                                         indices=["adm"]).install()
+            r3 = idx.search(dict(body))
+            qp.remove()
+            assert {"rescore", "aggs", "suggest"} <= set(r3["_degraded"])
+            assert "aggregations" not in r3 and "suggest" not in r3
+            stats = idx.admission.stats_dict()
+            assert stats["brownout"]["shed_rescore_total"] == 2
+            assert stats["brownout"]["shed_features_total"] == 2
+            assert stats["brownout"]["forced_pruned_total"] >= 2
+        finally:
+            idx.close()
+
+    def test_recovery_returns_full_precision_byte_identical(self):
+        """The acceptance invariant: a drained queue returns subsequent
+        queries to full-precision, full-feature responses, byte-
+        identical to the unloaded oracle — including through the
+        request cache (a browned-out response must not be replayed)."""
+        idx = build_index(**{"search.queue.size": 100})
+        try:
+            oracle = idx.search(dict(self.AGG_BODY))
+            qp = dis.QueuePressureScheme(occupancy=90,
+                                         indices=["adm"]).install()
+            degraded = idx.search(dict(self.AGG_BODY))
+            assert degraded.get("_degraded")
+            assert "aggregations" not in degraded
+            qp.remove()
+            idx.admission.refresh_level()
+            healed = idx.search(dict(self.AGG_BODY))
+            assert "_degraded" not in healed
+            key = lambda r: ([(h["_id"], h["_score"])  # noqa: E731
+                              for h in r["hits"]["hits"]],
+                             r["hits"]["total"], r.get("aggregations"),
+                             r.get("suggest"))
+            assert key(healed) == key(oracle)
+        finally:
+            idx.close()
+
+    def test_brownout_forces_pruning_eligibility(self):
+        """Step 1: the mesh plane's pruning config reads the forced
+        flag while pressure is above the pruned threshold and releases
+        it when the queue drains."""
+        from elasticsearch_tpu.parallel.plan_exec import IndexMeshSearch
+
+        idx = build_index(shards=3, **{"search.queue.size": 100})
+        try:
+            if idx._mesh_search is None:
+                idx._mesh_search = IndexMeshSearch(idx)
+            enabled, _probe = idx._mesh_search._pruning_config()
+            assert enabled is False
+            qp = dis.QueuePressureScheme(occupancy=30,
+                                         indices=["adm"]).install()
+            idx.admission.refresh_level()
+            enabled, _probe = idx._mesh_search._pruning_config()
+            assert enabled is True
+            qp.remove()
+            idx.admission.refresh_level()
+            enabled, _probe = idx._mesh_search._pruning_config()
+            assert enabled is False
+        finally:
+            idx.close()
+
+
+class TestAdaptiveBatchWindow:
+    def test_window_widens_with_pressure_and_narrows_back(self):
+        idx = build_index(**{"search.queue.size": 100,
+                             "search.batch.window_ms": 0.2})
+        try:
+            base_s = idx._batcher.window_s
+            assert idx.admission.effective_batch_window_s(base_s) == \
+                pytest.approx(base_s)
+            qp = dis.QueuePressureScheme(occupancy=50,
+                                         indices=["adm"]).install()
+            widened = idx.admission.effective_batch_window_s(base_s)
+            assert widened > base_s
+            # bounded by search.batch.max_window_ms (default 5ms)
+            assert widened <= 0.005 + 1e-9
+            qp2 = dis.QueuePressureScheme(occupancy=1000,
+                                          indices=["adm"]).install()
+            assert idx.admission.effective_batch_window_s(base_s) == \
+                pytest.approx(0.005)
+            qp2.remove()
+            qp.remove()
+            assert idx.admission.effective_batch_window_s(base_s) == \
+                pytest.approx(base_s)
+        finally:
+            idx.close()
+
+    def test_effective_window_gauge_exported(self):
+        """The leader records the window it actually used — the
+        batch_window_effective_ms gauge beside batch_size_histogram."""
+        idx = build_index(**{"search.batch.window_ms": 0.5})
+        try:
+            qp = dis.QueuePressureScheme(occupancy=50,
+                                         indices=["adm"]).install()
+            barrier = threading.Barrier(4)
+
+            def worker():
+                barrier.wait()
+                idx.search(dict(QUERY))
+
+            threads = [threading.Thread(target=worker) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            qp.remove()
+            gauge = idx.batch_stats.as_dict()["batch_window_effective_ms"]
+            # the histogram records batches; the gauge records the
+            # widened window whenever a leader collected one
+            if idx.batch_stats.as_dict()["batch_window_waits_total"]:
+                assert gauge > 0.5
+        finally:
+            idx.close()
+
+
+class TestExpiredQueueShedding:
+    def test_deadline_expired_entry_shed_before_execution(self):
+        idx = build_index(shards=1,
+                          **{"search.admission.max_concurrent": 1,
+                             "search.queue.size": 10})
+        # counts every query that actually reaches execution
+        probe = dis.SearchDelayScheme(0.0, indices=["adm"]).install()
+        slow = dis.SearchDelayScheme(0.3, indices=["adm"]).install()
+        out = {}
+
+        def occupier():
+            idx.search(dict(QUERY))
+
+        def expiring():
+            time.sleep(0.05)  # let the occupier take the slot
+            out["resp"] = idx.search(dict(QUERY, timeout="50ms"))
+
+        t0 = threading.Thread(target=occupier)
+        t1 = threading.Thread(target=expiring)
+        try:
+            t0.start()
+            t1.start()
+            t0.join()
+            t1.join()
+        finally:
+            slow.remove()
+        executed = probe.hits
+        probe.remove()
+        stats = idx.admission.stats_dict()
+        idx.close()
+        resp = out["resp"]
+        # shed PRE-execution: timed-out partial response, zero hits,
+        # and the query never reached the shard/plane path
+        assert resp["timed_out"] is True
+        assert resp["hits"]["hits"] == []
+        assert resp["_degraded"] == ["expired_in_queue"]
+        assert stats["expired_in_queue_total"] == 1
+        assert executed == 1  # only the occupier executed
+        assert stats["admitted_total"] == 2  # warm-up + occupier
+
+    def test_expired_shed_honors_allow_partial_false(self):
+        from elasticsearch_tpu.common.errors import (
+            SearchPhaseExecutionException,
+        )
+
+        idx = build_index(**{"search.admission.max_concurrent": 1,
+                             "search.queue.size": 10})
+        slow = dis.SearchDelayScheme(0.3, indices=["adm"]).install()
+        out = {}
+
+        def occupier():
+            idx.search(dict(QUERY))
+
+        def expiring():
+            time.sleep(0.05)
+            try:
+                idx.search(dict(QUERY, timeout="50ms",
+                                allow_partial_search_results=False))
+            except Exception as e:  # noqa: BLE001 — asserted below
+                out["exc"] = e
+
+        t0 = threading.Thread(target=occupier)
+        t1 = threading.Thread(target=expiring)
+        try:
+            t0.start()
+            t1.start()
+            t0.join()
+            t1.join()
+        finally:
+            slow.remove()
+            idx.close()
+        assert isinstance(out.get("exc"), SearchPhaseExecutionException)
+
+
+class TestExactCountersUnderBurst:
+    def test_admitted_rejected_expired_partition_offered(self):
+        """PR-8 concurrency idiom: a mixed burst across tenants; every
+        offered query ends in exactly one of admitted / rejected /
+        expired-in-queue, globally and per tenant."""
+        idx = build_index(**{"search.admission.max_concurrent": 2,
+                             "search.queue.size": 6})
+        base = idx.admission.stats_dict()
+        slow = dis.SearchDelayScheme(0.02, indices=["adm"]).install()
+        n_threads, per_thread = 6, 4
+        counts = [dict(ok=0, rej=0) for _ in range(n_threads)]
+
+        def client(tid):
+            set_opaque_id(f"tenant{tid % 3}")
+            for i in range(per_thread):
+                try:
+                    body = dict(QUERY)
+                    if (tid + i) % 5 == 0:
+                        body["timeout"] = "30ms"
+                    r = idx.search(body)
+                    assert not r["_shards"]["failed"]
+                    counts[tid]["ok"] += 1
+                except EsRejectedExecutionException:
+                    counts[tid]["rej"] += 1
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_threads)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            slow.remove()
+        stats = idx.admission.stats_dict()
+        idx.close()
+        offered = n_threads * per_thread
+        client_ok = sum(c["ok"] for c in counts)
+        client_rej = sum(c["rej"] for c in counts)
+        assert client_ok + client_rej == offered
+        # a shed (expired-in-queue) query returns a timed-out partial
+        # response, so it counts ok client-side but expired in stats
+        # (deltas: build_index's warm-up search admitted once already)
+        d_admitted = stats["admitted_total"] - base["admitted_total"]
+        d_expired = (stats["expired_in_queue_total"]
+                     - base["expired_in_queue_total"])
+        d_rejected = stats["rejected_total"] - base["rejected_total"]
+        assert d_admitted + d_expired == client_ok
+        assert d_rejected == client_rej
+        assert stats["in_flight"] == 0 and stats["queued"] == 0
+        per_tenant = stats["tenants"]
+        assert sum(b["admitted_total"] for b in per_tenant.values()) \
+            == stats["admitted_total"]
+        assert sum(b["rejected_total"] for b in per_tenant.values()) \
+            == stats["rejected_total"]
+
+
+class TestAdmissionConfig:
+    def test_dynamic_cluster_override_and_explicit_clear(self):
+        """search.queue.* / search.admission.* follow the explicitness
+        contract: an explicit cluster value wins over the index's
+        creation-time Settings; clearing it hands control back."""
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings({"cluster.name": "adm-dyn"}))
+        try:
+            node.create_index("dyn", {"settings": {
+                "number_of_shards": 1}})
+            adm = node.indices["dyn"].admission
+            assert adm._queue_size() == 1000
+            node.put_cluster_settings({"transient": {
+                "search.queue.size": 7,
+                "search.admission.max_concurrent": 3}})
+            assert adm._queue_size() == 7
+            assert adm._max_concurrent() == 3
+            # an index created AFTER the update is seeded with the live
+            # value (create_index seeding, like search.batch.*)
+            node.create_index("dyn2", {"settings": {
+                "number_of_shards": 1}})
+            assert node.indices["dyn2"].admission._queue_size() == 7
+            node.put_cluster_settings({"transient": {
+                "search.queue.size": None,
+                "search.admission.max_concurrent": None}})
+            assert adm._queue_size() == 1000
+        finally:
+            node.close()
+
+    def test_rest_search_pool_sized_from_queue_setting(self):
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings({"cluster.name": "adm-pool",
+                              "search.queue.size": 123}))
+        try:
+            pool = node.thread_pool.executor("search")
+            assert pool.queue_size == 123
+            # both backpressure points move together under a dynamic
+            # update, and an explicit clear reverts to the node file
+            node.put_cluster_settings({"transient": {
+                "search.queue.size": 77}})
+            assert pool.queue_size == 77
+            assert pool._queue.maxsize == 77
+            node.put_cluster_settings({"transient": {
+                "search.queue.size": None}})
+            assert pool.queue_size == 123
+        finally:
+            node.close()
+
+    def test_disabled_admission_is_inert(self):
+        idx = build_index(**{"search.admission.enabled": False,
+                             "search.admission.max_concurrent": 1,
+                             "search.queue.size": 1})
+        qp = dis.QueuePressureScheme(occupancy=2000, block_slots=10_000,
+                                     indices=["adm"]).install()
+        try:
+            r = idx.search(dict(QUERY))
+            assert r["hits"]["hits"]
+            assert "_degraded" not in r
+            assert idx.admission.stats_dict()["rejected_total"] == 0
+        finally:
+            qp.remove()
+            idx.close()
+
+    def test_stats_block_shape(self):
+        idx = build_index()
+        try:
+            block = idx.search_stats()["admission"]
+            for key in ("queue_capacity", "queued", "in_flight",
+                        "admitted_total", "rejected_total",
+                        "expired_in_queue_total", "brownout_level",
+                        "brownout", "brownout_transitions",
+                        "retry_after_s", "drain_rate_qps", "tenants"):
+                assert key in block, key
+            # node-level merge: the block sums across indices
+            from elasticsearch_tpu.search.telemetry import (
+                merge_phase_stats,
+            )
+
+            merged = merge_phase_stats([idx.search_stats(),
+                                        idx.search_stats()])
+            assert merged["admission"]["admitted_total"] == \
+                2 * block["admitted_total"]
+        finally:
+            idx.close()

@@ -53,6 +53,15 @@ class TestChaosSoakSmoke:
         assert report["cluster"]["visible"] == report["cluster"]["acked"]
         amp = report["restage_amplification"]
         assert amp is None or amp < soak.amplification_bound
+        # overload leg (ISSUE 12): under pinned queue pressure +
+        # transport faults, every offered query ended in a complete
+        # answer or a clean 429 — rejected == offered − admitted with
+        # exact counters (no silent drops), asserted inside run; the
+        # report carries the accounting breadcrumb
+        ov = report["overload"]
+        assert ov is not None, report
+        assert ov["rejected"] >= 1
+        assert ov["rejected"] == ov["offered"] - ov["admitted"]
 
 
 @pytest.mark.slow

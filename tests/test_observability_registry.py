@@ -24,12 +24,14 @@ def _doc_text():
         return f.read()
 
 
-def _walk_keys(obj, out, skip_subtrees=("groups",),
+def _walk_keys(obj, out, skip_subtrees=("groups", "tenants"),
                split_subtrees=("decisions",)):
     """Collect every dict key in the response, skipping log2 bucket
     labels (``le_*``), numeric keys (batch-size histogram buckets), and
-    the user-named ``groups`` subtree; ``decisions`` keys are
-    ``<plane>.<reason>`` compounds — each part collects separately."""
+    the user-named ``groups``/``tenants`` subtrees (tenant keys are
+    client-chosen X-Opaque-Id values — docs/OVERLOAD.md); ``decisions``
+    keys are ``<plane>.<reason>`` compounds — each part collects
+    separately."""
     if isinstance(obj, dict):
         for k, v in obj.items():
             ks = str(k)
@@ -99,8 +101,36 @@ class TestObservabilityRegistryLint:
         _walk_keys(exercised_index.search_stats(), keys)
         for known in ("phases", "histogram_us", "counters", "decisions",
                       "taxonomy", "queries_recorded", "planes", "batch",
-                      "quarantine_events", "plane_failures_total"):
+                      "quarantine_events", "plane_failures_total",
+                      "admission", "brownout_level"):
             assert known in keys, f"lint walk no longer reaches [{known}]"
+
+    def test_admission_block_exported_and_documented(self, exercised_index):
+        # ISSUE 12 (docs/OVERLOAD.md): the `search.admission` block —
+        # queue gauges, admitted/rejected/expired counters, brownout
+        # ladder state + per-step shed counts, Retry-After — exported in
+        # _stats and merged into _nodes/stats, every key documented
+        doc = _doc_text()
+        adm = exercised_index.search_stats()["admission"]
+        for key in ("queue_capacity", "queued", "in_flight",
+                    "admitted_total", "rejected_total",
+                    "expired_in_queue_total", "brownout_level",
+                    "brownout", "brownout_transitions", "retry_after_s",
+                    "drain_rate_qps", "tenants"):
+            assert key in adm, adm.keys()
+            assert key in doc, f"[{key}] undocumented"
+        for step in ("forced_pruned_total", "shed_rescore_total",
+                     "shed_features_total"):
+            assert step in adm["brownout"], adm["brownout"]
+            assert step in doc, f"[{step}] undocumented"
+        # the exercised traffic was admitted and accounted
+        assert adm["admitted_total"] >= 2
+        assert "_anonymous" in adm["tenants"]
+        # batch block: the adaptive-window gauge rides beside the
+        # batch-size histogram
+        batch = exercised_index.search_stats()["batch"]
+        assert "batch_window_effective_ms" in batch
+        assert "batch_window_effective_ms" in doc
 
     def test_lint_catches_undocumented_key(self):
         doc = _doc_text()

@@ -85,3 +85,40 @@ class TestSettingsRegistryLint:
                     "search.default_allow_partial_results",
                     "index.search.plane_quarantine.cooldown"):
             assert key in registered, key
+
+    def test_overload_control_settings_registered_and_dynamic(self):
+        # ISSUE 12 (docs/OVERLOAD.md): every overload-control knob is
+        # registered AND dynamic — operators must be able to resize the
+        # queue / retune the brownout ladder mid-incident via
+        # PUT _cluster/settings (explicitness-aware overrides), and
+        # create_index seeds them per index like search.batch.*
+        registry = cluster_settings()
+        for key in ("search.queue.size",
+                    "search.admission.enabled",
+                    "search.admission.max_concurrent",
+                    "search.admission.weights",
+                    "search.admission.brownout.pruned_threshold",
+                    "search.admission.brownout.rescore_threshold",
+                    "search.admission.brownout.features_threshold",
+                    "search.batch.max_window_ms"):
+            assert registry.is_registered(key), key
+            assert registry.is_dynamic(key), f"[{key}] must be dynamic"
+
+    def test_overload_settings_seeded_by_create_index(self):
+        # the admission controller reads its config from the index's
+        # Settings map: node-file values must reach indices created
+        # later (the search.batch.* seeding contract)
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings({"cluster.name": "lint-seed",
+                              "search.queue.size": 41,
+                              "search.admission.max_concurrent": 5}))
+        try:
+            node.create_index("seeded", {"settings": {
+                "number_of_shards": 1}})
+            adm = node.indices["seeded"].admission
+            assert adm._queue_size() == 41
+            assert adm._max_concurrent() == 5
+        finally:
+            node.close()
