@@ -631,6 +631,17 @@ SEARCH_KNN_TILE_SUB = Setting(
     validator=_validate_knn_tile_sub, dynamic=True,
 )
 
+# --- fused on-device aggregations (ISSUE 13, docs/AGGS.md) ---
+
+SEARCH_AGGS_FUSED = Setting.bool_setting(
+    # reduce eligible aggregation bodies INSIDE the mesh program (the
+    # columnar doc-values plane) instead of shipping per-slot matched
+    # masks to the host; false = every agg runs the host reduce.
+    # Results are byte-identical either way (the engineered-exact
+    # envelope in docs/AGGS.md gates eligibility structurally).
+    "search.aggs.fused", True, dynamic=True
+)
+
 # --- device-memory accountant (ISSUE 9, docs/OBSERVABILITY.md) ---
 
 SEARCH_MEMORY_HBM_BUDGET = Setting.bytes_setting(
@@ -722,6 +733,7 @@ NODE_SETTINGS = [
     SEARCH_PALLAS_PRUNING_PROBE_TILES,
     SEARCH_KNN_ENABLED,
     SEARCH_KNN_TILE_SUB,
+    SEARCH_AGGS_FUSED,
     SEARCH_MEMORY_HBM_BUDGET,
     SEARCH_STAGING_RETRY_MAX_ATTEMPTS,
     SEARCH_STAGING_RETRY_BACKOFF_MS,
@@ -810,6 +822,14 @@ INDEX_SEARCH_PALLAS_POSTINGS_CODEC = Setting.str_setting(
     "index.search.pallas.postings_codec", "default",
     choices={"default", "raw", "packed"}, scope=Scope.INDEX
 )
+INDEX_SEARCH_AGGS_FUSED = Setting.str_setting(
+    # per-index override of the fused on-device aggregation plane
+    # ("default" follows the node-wide search.aggs.fused; an EXPLICIT
+    # cluster-level search.aggs.fused still wins while set — the
+    # put_cluster_settings explicitness contract, docs/AGGS.md)
+    "index.search.aggs.fused", "default",
+    choices={"default", "true", "false"}, scope=Scope.INDEX, dynamic=True
+)
 INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN = Setting.time_setting(
     # plane-health quarantine: after a mesh_pallas / mesh plane failure
     # (compile error, OOM, runtime fault) the plane is benched for this
@@ -832,6 +852,7 @@ INDEX_SETTINGS = [
     INDEX_SEARCH_MESH_MAX_SLOTS,
     INDEX_SEARCH_MESH_PLANE,
     INDEX_SEARCH_PALLAS_POSTINGS_CODEC,
+    INDEX_SEARCH_AGGS_FUSED,
     INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN,
     INDEX_SEARCH_SLOWLOG_WARN,
     INDEX_SEARCH_SLOWLOG_INFO,

@@ -965,13 +965,18 @@ class IndexService:
 
             refs = collapse_refs(refs, collapse_field, self.shards)[: max(k, 0)]
         refs_window = refs[from_: from_ + size] if size >= 0 else refs[from_:]
+        tracer.stop("merge", t_merge)
 
         aggregations = None
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         if agg_specs:
+            # host-path agg execution gets its own phase span (ISSUE 13:
+            # the `aggregate` taxonomy entry) so phase_attribution_p50_ms
+            # can show what the fused plane removes
+            t_agg = tracer.start("aggregate")
             views = [v for r in shard_results for v in r.agg_views]
             aggregations = run_aggregations(agg_specs, views)
-        tracer.stop("merge", t_merge)
+            tracer.stop("aggregate", t_agg)
 
         t_fetch = tracer.start("fetch")
         hits = fetch_hits(refs_window, self.shards, body, self.name,
@@ -1343,6 +1348,10 @@ class IndexService:
             "hits": {"total": out["total"], "max_score": out["max_score"],
                      "hits": hits},
         }
+        if out.get("aggregations") is not None:
+            # fused on-device aggregations computed inside the batched
+            # launch (ISSUE 13, docs/AGGS.md)
+            resp["aggregations"] = out["aggregations"]
         if out.get("pruned") is not None:
             resp["_pruned"] = out["pruned"]
         return self._finish_query_response(
@@ -1415,6 +1424,19 @@ class IndexService:
                 "knn_query_total": (
                     self._mesh_search.knn_query_total
                     if self._mesh_search is not None else 0),
+                # fused on-device aggregations (ISSUE 13, docs/AGGS.md):
+                # agg'd queries whose whole agg set reduced inside the
+                # mesh program vs those that fell back to the host
+                # reduce, per documented reason
+                "agg_fused_query_total": (
+                    self._mesh_search.agg_fused_query_total
+                    if self._mesh_search is not None else 0),
+                "agg_host_fallback_total": (
+                    self._mesh_search.agg_host_fallback_total
+                    if self._mesh_search is not None else 0),
+                "agg_host_fallback_by_reason": (
+                    dict(self._mesh_search.agg_host_fallback_by_reason)
+                    if self._mesh_search is not None else {}),
                 "pruned_query_total": (
                     self._mesh_search.pruned_query_total
                     if self._mesh_search is not None else 0),

@@ -279,8 +279,8 @@ class Node:
         # retry config is process-level — a create-time snapshot in the
         # index Settings would shadow later dynamic cluster updates)
         for prefix in ("search.batch.", "search.pallas.", "search.knn.",
-                       "search.telemetry.", "search.queue.",
-                       "search.admission."):
+                       "search.aggs.", "search.telemetry.",
+                       "search.queue.", "search.admission."):
             cluster_dynamic = state.persistent_settings.merged_with(
                 state.transient_settings).filtered_by_prefix(prefix)
             merged_settings = self.settings.filtered_by_prefix(
@@ -1653,6 +1653,7 @@ class Node:
         # again) when absent — synced here from the committed state
         # because the value-only update consumers can't see explicitness
         from elasticsearch_tpu.common.settings import (
+            SEARCH_AGGS_FUSED,
             SEARCH_KNN_ENABLED,
             SEARCH_KNN_TILE_SUB,
             SEARCH_PALLAS_PRUNING_ENABLED,
@@ -1672,6 +1673,10 @@ class Node:
                 # hands control back to the index's own Settings
                 (SEARCH_KNN_ENABLED, "knn_enabled_override"),
                 (SEARCH_KNN_TILE_SUB, "knn_tile_sub_override"),
+                # fused on-device aggregations (ISSUE 13, docs/AGGS.md):
+                # same explicitness contract — the cluster value wins
+                # while set, clearing reverts to index/node settings
+                (SEARCH_AGGS_FUSED, "aggs_fused_override"),
                 # telemetry kill switch follows the same explicitness
                 # contract (docs/OBSERVABILITY.md)
                 (SEARCH_TELEMETRY_ENABLED, "telemetry_enabled_override")):

@@ -336,7 +336,8 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
                         with_views: bool = False,
                         features: frozenset = frozenset(),
                         slice_col: Optional[str] = None,
-                        rescore_static: Optional[Tuple[int, str]] = None):
+                        rescore_static: Optional[Tuple[int, str]] = None,
+                        agg_static: tuple = ()):
     """One compiled scatter-gather program covering the collector-chain
     semantics of the reference's query phase (QueryPhase.java:179-268) as
     fused mask stages:
@@ -363,6 +364,11 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
     rescore_static: (window_size, score_mode) — QueryRescorer's window
     pass over the per-slot (== per-segment, matching the host's
     per-segment window) top candidates; weights are traced scalars.
+    agg_static: fused-aggregation descriptors (search/fused_aggs.py) —
+    each slot's agg-visible matched mask reduces into tiny per-spec
+    partial accumulators INSIDE this program (same launch as scoring;
+    the masks never leave the device), returned sharded per slot like
+    the views. Mutually exclusive with with_views.
     """
     plan = holder.plan
     pf_plan = holder.pf_plan
@@ -455,8 +461,16 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
         loc_raw = None
         if sort_keys is not None:
             loc_raw = seg[sort_keys[1]][loc_docs]
+        agg_parts = ()
+        if agg_static:
+            from elasticsearch_tpu.search.fused_aggs import (
+                emit_agg_partials,
+            )
+
+            agg_parts = tuple(emit_agg_partials(agg_static, seg,
+                                                agg_matched))
         return (loc_keys, loc_docs, loc_scores, loc_raw, local_count,
-                agg_matched, scores)
+                agg_matched, scores, agg_parts)
 
     def per_device(seg, plan_arrays, pf_arrays, rs_arrays, scalars):
         dev = jax.lax.axis_index("shards")
@@ -502,12 +516,18 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
         if with_views:
             outs.extend([jnp.stack([o[5] for o in slot_out]),
                          jnp.stack([o[6] for o in slot_out])])
+        if agg_static:
+            n_agg = len(slot_out[0][7])
+            outs.extend(jnp.stack([o[7][j] for o in slot_out])
+                        for j in range(n_agg))
         return tuple(outs)
 
-    # 6 replicated merge outputs; local_count (index 6) and the optional
-    # views stay SHARDED (one row per device)
+    # 6 replicated merge outputs; local_count (index 6), the optional
+    # views, and the fused-agg partials stay SHARDED (a row per slot)
+    from elasticsearch_tpu.search.fused_aggs import n_agg_outputs
+
     n_merged = 6
-    n_out = 7 + (2 if with_views else 0)
+    n_out = 7 + (2 if with_views else 0) + n_agg_outputs(agg_static)
     mapped = shard_map(
         per_device, mesh=mesh,
         in_specs=(PS("shards"), PS("shards"), PS("shards"), PS("shards"),
@@ -596,6 +616,111 @@ def _mesh_batched_kernel_program(mesh: Mesh, spd: int, q_batch: int,
     def run(*args):
         outs = mapped(*args)
         return tuple(o[0] for o in outs)  # replicated: row 0 == row i
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_batched_dense_agg_program(mesh: Mesh, spd: int, q_batch: int,
+                                    kk: int, t_pad: int, cb: int, sub: int,
+                                    tps: int, interpret: bool, codec: str,
+                                    agg_statics: tuple, nd1: int):
+    """The batched mesh program for agg-carrying bursts (ISSUE 13):
+    ONE dense ``score_tiles`` launch streams each slot's posting
+    windows once for the whole batch, and the SAME pass both ranks and
+    aggregates — per member, the dense score vector yields the matched
+    mask on device, the mask reduces the staged doc-value columns into
+    per-spec partial accumulators (search/fused_aggs.py), and hits
+    merge with the serial mesh program's exact collector semantics
+    (per-slot ``lax.top_k`` over doc-ordered dense scores, pool concat
+    in slot order, ICI all_gather, global top-k — byte-identical ties
+    to the host path). ``agg_statics``: one fused-agg descriptor tuple
+    per member (empty = member carries no aggs); heterogeneous bodies
+    compile per combination, bucketed by the same q_pad/kk shape keys
+    as the fused-top-k program. Aggs force this exhaustive dense form —
+    pruning never composes with aggregations (docs/PRUNING.md)."""
+    from elasticsearch_tpu.ops import pallas_scoring as psc
+    from elasticsearch_tpu.search.fused_aggs import emit_agg_partials
+
+    packed = codec == "packed"
+
+    def per_device(*args):
+        if packed:
+            kp, lt, rl, rh, w, cols = args
+        else:
+            kd, kf, lt, rl, rh, w, cols = args
+        dev = jax.lax.axis_index("shards")
+        cand_s, cand_d, cand_slot = [], [], []
+        counts = None
+        agg_parts = None
+        for i in range(spd):
+            corpus = (kp[i], None) if packed else (kd[i], kf[i])
+            dense = psc.score_tiles(
+                corpus[0], corpus[1], lt[i], rl[i], rh[i], w[i],
+                t_pad=t_pad, cb=cb, sub=sub, dense=True,
+                interpret=interpret, tiles_per_step=tps,
+                q_batch=q_batch, codec=codec)[0]
+            rows = dense.shape[1] // psc.LANE
+            flat = dense.reshape(q_batch, rows, psc.LANE, sub).transpose(
+                0, 1, 3, 2).reshape(q_batch, -1)[:, : nd1 - 1]
+            # sentinel column: dead like the serial program's live1 tail
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((q_batch, 1), jnp.float32)], axis=1)
+            matched = flat > 0.0  # [Q, nd1] (live folded in-kernel)
+            masked = jnp.where(matched, flat, -jnp.inf)
+            s_i, d_i = jax.lax.top_k(masked, min(kk, masked.shape[1]))
+            cand_s.append(s_i)
+            cand_d.append(d_i)
+            cand_slot.append(
+                jnp.zeros(s_i.shape, jnp.int32)
+                + (dev.astype(jnp.int32) * jnp.int32(spd) + jnp.int32(i)))
+            c = jnp.sum(matched.astype(jnp.int32), axis=1)  # [Q]
+            counts = c if counts is None else counts + c
+            cols_i = {name: a[i] for name, a in cols.items()}
+            slot_parts = []
+            for q in range(q_batch):
+                if agg_statics[q]:
+                    slot_parts.extend(emit_agg_partials(
+                        agg_statics[q], cols_i, matched[q]))
+            if agg_parts is None:
+                agg_parts = [[p] for p in slot_parts]
+            else:
+                for j, p in enumerate(slot_parts):
+                    agg_parts[j].append(p)
+        cs = jnp.concatenate(cand_s, axis=1)
+        cd = jnp.concatenate(cand_d, axis=1)
+        cslot = jnp.concatenate(cand_slot, axis=1)
+        total = jax.lax.psum(counts, "shards")  # [Q]
+        all_s = jax.lax.all_gather(cs, "shards")
+        all_d = jax.lax.all_gather(cd, "shards")
+        all_slot = jax.lax.all_gather(cslot, "shards")
+        pool_s = all_s.transpose(1, 0, 2).reshape(q_batch, -1)
+        pool_d = all_d.transpose(1, 0, 2).reshape(q_batch, -1)
+        pool_slot = all_slot.transpose(1, 0, 2).reshape(q_batch, -1)
+        top_s, top_i = jax.lax.top_k(pool_s, min(kk, pool_s.shape[1]))
+        top_d = jnp.take_along_axis(pool_d, top_i, axis=1)
+        top_slot = jnp.take_along_axis(pool_slot, top_i, axis=1)
+        outs = [top_s[None], top_d[None], top_slot[None], total[None]]
+        if agg_parts:
+            outs.extend(jnp.stack(parts) for parts in agg_parts)
+        return tuple(outs)
+
+    from elasticsearch_tpu.search.fused_aggs import n_agg_outputs
+
+    n_agg_out = sum(n_agg_outputs(s) for s in agg_statics)
+    n_in = 6 if packed else 7
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(PS("shards"),) * n_in,
+        out_specs=(PS("shards"),) * (4 + n_agg_out),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def run(*args):
+        outs = mapped(*args)
+        # merged outputs replicated; agg partials stay sharded per slot
+        return tuple(o[0] for o in outs[:4]) + tuple(outs[4:])
 
     return run
 
@@ -836,6 +961,13 @@ class IndexMeshSearch:
         # dense-vector retrieval on the MXU (docs/VECTOR.md): queries
         # whose kNN side ran the mesh kNN program
         self.knn_query_total = 0
+        # fused on-device aggregations (ISSUE 13, docs/AGGS.md):
+        # queries whose whole agg set reduced inside the mesh program,
+        # vs agg'd mesh queries that fell back to the host reduce over
+        # device views — per documented reason (docs/OBSERVABILITY.md)
+        self.agg_fused_query_total = 0
+        self.agg_host_fallback_total = 0
+        self.agg_host_fallback_by_reason: Dict[str, int] = {}
         # block-max pruned scoring observability (docs/PRUNING.md):
         # queries served by the pruned program, and its tile economy
         self.pruned_query_total = 0
@@ -1125,6 +1257,52 @@ class IndexMeshSearch:
         if probe not in (2, 4, 8, 16, 32):
             probe = 8
         return bool(enabled), probe
+
+    def _fused_aggs_enabled(self) -> bool:
+        """search.aggs.fused resolution (docs/AGGS.md): an explicit
+        cluster-level override wins (put_cluster_settings syncs it with
+        the search.pallas.* explicitness contract), then the index's
+        index.search.aggs.fused ("default" follows the node), then the
+        seeded node default (on)."""
+        override = getattr(self.svc, "aggs_fused_override", None)
+        if override is not None:
+            return bool(override)
+        settings = getattr(self.svc, "settings", None)
+        if settings is None:
+            return True
+        idx = settings.get_str("index.search.aggs.fused", "default")
+        if idx in ("true", "false"):
+            return idx == "true"
+        return settings.get_bool("search.aggs.fused", True)
+
+    def _note_agg_fallback(self, reason: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self.agg_host_fallback_total += n
+            self.agg_host_fallback_by_reason[reason] = \
+                self.agg_host_fallback_by_reason.get(reason, 0) + n
+
+    def _resolve_fused_aggs(self, agg_specs, executor):
+        """(FusedAggPlan | None, fallback reason | None) for a mesh-
+        served query's agg set — all-or-nothing (docs/AGGS.md). A
+        terminal doc-value staging fault demotes the AGGS (not the
+        query) to the host reduce (reason ``staging_fault``, classified
+        inside resolve_fused_aggs around the staging step only): the
+        scoring launch proceeds either way."""
+        if not self._fused_aggs_enabled():
+            return None, "disabled"
+        from elasticsearch_tpu.search.fused_aggs import resolve_fused_aggs
+
+        try:
+            return resolve_fused_aggs(agg_specs, executor)
+        except Exception:  # noqa: BLE001 — defensive: an unexpected
+            # RESOLUTION error (not a device fault — those classify as
+            # staging_fault inside resolve_fused_aggs) must degrade to
+            # the host reduce, visibly labeled as a resolver defect
+            # rather than device-fault telemetry
+            _plane_logger.warning(
+                "[%s] fused-agg resolution raised; aggregations serve "
+                "from the host reduce", self.svc.name, exc_info=True)
+            return None, "resolve_error"
 
     def _knn_config(self):
         """(enabled, tile_sub preference) from the live settings —
@@ -1544,6 +1722,19 @@ class IndexMeshSearch:
         if sort_keys == "fallback":
             self._note("host", "sort_ineligible")
             return None
+        # fused on-device aggregations (ISSUE 13, docs/AGGS.md): when
+        # every spec is fused-eligible the agg reduction rides INSIDE
+        # the mesh program (doc-value columns staged per slot, ledger
+        # kind doc_values) and the [n_slots, nd1] matched masks never
+        # cross to the host; otherwise the previous with_views host
+        # reduce serves, counted per fallback reason
+        agg_plan = None
+        agg_reason = None
+        if agg_specs:
+            t_aggstage = tracer.start("staging")
+            agg_plan, agg_reason = self._resolve_fused_aggs(agg_specs,
+                                                            executor)
+            tracer.stop("staging", t_aggstage)
 
         features = set()
         scalars: Dict[str, float] = {}
@@ -1687,10 +1878,13 @@ class IndexMeshSearch:
                     on_kernel_launch(self.svc.name, plane)
                     outs = executor.execute(
                         plans, k, sort_keys=sort_keys,
-                        with_views=bool(agg_specs), pf_plans=pf_plans,
+                        with_views=bool(agg_specs) and agg_plan is None,
+                        pf_plans=pf_plans,
                         rs_plans=rs_plans, scalars=scalars,
                         features=frozenset(features), slice_col=slice_col,
-                        rescore_static=rescore_static, tracer=tracer)
+                        rescore_static=rescore_static, tracer=tracer,
+                        agg_static=(agg_plan.statics
+                                    if agg_plan is not None else ()))
                     # the plane served: fully re-open it (ends a probe's
                     # quarantine — single-flight contract)
                     self.plane_health.note_success(plane)
@@ -1784,18 +1978,39 @@ class IndexMeshSearch:
             refs.append(DocRef(sid, seg.name, int(d), score, sv))
             if max_score is None and sort_spec is None:
                 max_score = score
+        tracer.stop("merge", t_merge)
         aggregations = None
         if agg_specs:
-            matched_np = np.asarray(outs[7])
-            scores_np = np.asarray(outs[8])
-            views = []
-            for i, (sid, seg) in enumerate(executor.pairs):
-                nd1 = seg.nd_pad + 1
-                views.append(SegmentView(
-                    seg, matched_np[i, :nd1], ctxs[sid],
-                    scores_np[i, :nd1]))
-            aggregations = run_aggregations(agg_specs, views)
-        tracer.stop("merge", t_merge)
+            t_agg = tracer.start("aggregate")
+            if agg_plan is not None:
+                from elasticsearch_tpu.search.fused_aggs import (
+                    finalize_fused,
+                )
+
+                agg_outs = [np.asarray(o) for o in outs[7:]]
+                aggregations = finalize_fused(agg_plan, agg_outs,
+                                              len(executor.pairs))
+                with self._counter_lock:
+                    self.agg_fused_query_total += 1
+                tel = getattr(self.svc, "telemetry", None)
+                if tel is not None:
+                    # doc-value column bytes the fused launch read in
+                    # place of the host round-trip (docs/AGGS.md)
+                    tel.add_counters({
+                        "doc_values_bytes_streamed":
+                            agg_plan.staged_bytes(executor._seg_staged)})
+            else:
+                matched_np = np.asarray(outs[7])
+                scores_np = np.asarray(outs[8])
+                views = []
+                for i, (sid, seg) in enumerate(executor.pairs):
+                    nd1 = seg.nd_pad + 1
+                    views.append(SegmentView(
+                        seg, matched_np[i, :nd1], ctxs[sid],
+                        scores_np[i, :nd1]))
+                aggregations = run_aggregations(agg_specs, views)
+                self._note_agg_fallback(agg_reason or "field_ineligible")
+            tracer.stop("aggregate", t_agg)
         return {"total": total, "refs": refs, "max_score": max_score,
                 "aggregations": aggregations,
                 "terminated_early": terminated_early,
@@ -1871,7 +2086,12 @@ class IndexMeshSearch:
             body = body or {}
             if not isinstance(body.get("query"), dict):
                 return None
-            if any(key not in self.BATCHABLE_KEYS for key in body):
+            # agg bodies no longer fail the key filter (ISSUE 13): an
+            # agg-carrying member rides the batched DENSE program when
+            # its whole agg set is fused-eligible (resolved below)
+            if any(key not in self.BATCHABLE_KEYS
+                   and key not in ("aggs", "aggregations")
+                   for key in body):
                 return None
         if any(getattr(self.svc.shards[s].engine, "index_sort", None)
                for s in self.svc.shards):
@@ -1949,7 +2169,50 @@ class IndexMeshSearch:
             # execution surfaces it per member with the right status
             return None
         bt.stop("plan_build", t_plan)
+        # fused aggs for batched members (ISSUE 13, docs/AGGS.md):
+        # ALL-or-nothing per batch — if any agg'd member's set is not
+        # fused-eligible the whole batch falls to the host rung (whose
+        # per-member pipeline owns the full agg surface); heterogeneous
+        # eligible bodies each reduce their own specs in the shared
+        # dense launch (member isolation)
+        member_agg_plans = [None] * q_batch
+        agg_members = [bool((b or {}).get("aggs")
+                            or (b or {}).get("aggregations"))
+                       for b in bodies]
+        if any(agg_members):
+            if not self._fused_aggs_enabled():
+                self._note_agg_fallback("disabled", sum(agg_members))
+                return None
+            from elasticsearch_tpu.search.aggregations import parse_aggs
+
+            t_aggstage = bt.start("staging")
+            try:
+                for q, body in enumerate(bodies):
+                    if not agg_members[q]:
+                        continue
+                    body = body or {}
+                    try:
+                        specs = parse_aggs(body.get("aggs")
+                                           or body.get("aggregations"))
+                    except Exception:  # noqa: BLE001 — request error:
+                        # serial execution surfaces the member's 400
+                        return None
+                    plan, reason = self._resolve_fused_aggs(specs,
+                                                            executor)
+                    if plan is None:
+                        self._note_agg_fallback(
+                            reason or "field_ineligible")
+                        return None
+                    member_agg_plans[q] = plan
+            finally:
+                bt.stop("staging", t_aggstage)
+        has_aggs = any(p is not None for p in member_agg_plans)
         pruning, probe = self._pruning_config()
+        if has_aggs:
+            # pruning x aggs mutual exclusion (docs/PRUNING.md): WAND-
+            # skipped tiles would corrupt buckets — agg batches always
+            # run the exhaustive dense formulation
+            pruning = False
         if pruning and any(
                 int((b or {}).get("size", 10)
                     if (b or {}).get("size") is not None else 10) <= 0
@@ -2116,6 +2379,49 @@ class IndexMeshSearch:
                     "tiles_scored": pruned_stats["tiles_scored"],
                     "tiles_pruned": pruned_stats["tiles_pruned"],
                 }
+            elif has_aggs:
+                # agg-carrying batch: ONE dense launch both ranks and
+                # aggregates — the posting windows and the doc-value
+                # columns stream once for the whole burst, the matched
+                # masks reduce on device (ISSUE 13, docs/AGGS.md)
+                agg_statics = tuple(
+                    (member_agg_plans[q].statics
+                     if q < q_batch and member_agg_plans[q] is not None
+                     else ())
+                    for q in range(q_pad))
+                agg_keys = sorted({key for p in member_agg_plans
+                                   if p is not None
+                                   for key in p.column_keys()})
+                agg_cols = {key: staged[key] for key in agg_keys}
+                run = _mesh_batched_dense_agg_program(
+                    executor.mesh, executor.slots_per_dev,
+                    q_pad, kk, t_pad, cb, g.tile_sub, tps,
+                    session["mode"] == "interpret", codec,
+                    agg_statics, executor.nd1)
+                args = corpus + (staged[live_key],
+                                 jax.device_put(rl, sharding),
+                                 jax.device_put(rh, sharding),
+                                 jax.device_put(w_all, sharding),
+                                 agg_cols)
+                bt.stop("staging", t_stage)
+                if deadline is not None:
+                    deadline.checkpoint()
+                on_kernel_launch(self.svc.name, "batched")
+                t_kernel = bt.start("kernel")
+                with _MESH_EXEC_LOCK:
+                    outs = run(*args)
+                    jax.block_until_ready(outs)
+                bt.stop("kernel", t_kernel)
+                keys, docs, slots, totals = (np.asarray(o)
+                                             for o in outs[:4])
+                agg_raw = [np.asarray(o) for o in outs[4:]]
+                wb = 4 if codec == "packed" else 8
+                launch_adds = {
+                    "postings_bytes_streamed":
+                        n_tiles * n_pairs * t_pad * cb * psc.LANE * wb,
+                    "doc_values_bytes_streamed":
+                        sum(int(staged[key].nbytes) for key in agg_keys),
+                }
             else:
                 run = _mesh_batched_kernel_program(
                     executor.mesh, executor.slots_per_dev,
@@ -2180,6 +2486,27 @@ class IndexMeshSearch:
                    "served_batched" if q_batch > 1 else
                    ("served_pruned" if pruned_stats is not None
                     else "served"), q_batch)
+        member_aggs = [None] * q_batch
+        if has_aggs:
+            from elasticsearch_tpu.search.fused_aggs import (
+                finalize_fused,
+                n_agg_outputs,
+            )
+
+            t_aggf = bt.start("aggregate")
+            pos = 0
+            for q in range(q_batch):
+                plan = member_agg_plans[q]
+                if plan is None:
+                    continue
+                n = n_agg_outputs(plan.statics)
+                member_aggs[q] = finalize_fused(
+                    plan, agg_raw[pos: pos + n], n_pairs)
+                pos += n
+            bt.stop("aggregate", t_aggf)
+            with self._counter_lock:
+                self.agg_fused_query_total += sum(
+                    1 for p in member_agg_plans if p is not None)
         t_merge = bt.start("merge")
         results = []
         for q, body in enumerate(bodies):
@@ -2201,6 +2528,8 @@ class IndexMeshSearch:
                     max_score = score
             result = {"total": int(totals[q]), "refs": refs,
                       "max_score": max_score, "plane": "mesh_pallas"}
+            if member_aggs[q] is not None:
+                result["aggregations"] = member_aggs[q]
             if pruned_stats is not None:
                 # per-query debug marker (the response's _pruned field):
                 # under pruning `total` counts matches in SCORED tiles
@@ -2862,7 +3191,10 @@ class MeshPlanExecutor:
         self._seg_staged[name] = jax.device_put(keys, self._sharding)
         self._seg_staged[name + ".raw"] = jax.device_put(
             raws, self._sharding)
-        self._account("mesh_slot_tables", name,
+        # sort key columns are doc-values-plane tables (ISSUE 13): they
+        # derive from the same sealed columns the fused aggs stage, so
+        # they account under the doc_values ledger kind (docs/AGGS.md)
+        self._account("doc_values", name,
                       int(keys.nbytes + raws.nbytes))
         self.sort_meta[name] = {"vocab": None}
         return name, name + ".raw"
@@ -2901,7 +3233,7 @@ class MeshPlanExecutor:
         self._seg_staged[name] = jax.device_put(keys, self._sharding)
         self._seg_staged[name + ".raw"] = jax.device_put(
             raws, self._sharding)
-        self._account("mesh_slot_tables", name,
+        self._account("doc_values", name,
                       int(keys.nbytes + raws.nbytes))
         self.sort_meta[name] = {"vocab": vocab}
         return name, name + ".raw"
@@ -2944,6 +3276,59 @@ class MeshPlanExecutor:
         self._account("mesh_slot_tables", name, int(out.nbytes))
         return name
 
+    def stage_doc_value_columns(self, builds: Dict[str, object]) -> bool:
+        """Stage fused-aggregation doc-value columns (ISSUE 13,
+        docs/AGGS.md): ``builds`` maps a representative table name to a
+        callable producing ``{name: np.ndarray}`` groups of per-slot
+        columns. Registered under the ``doc_values`` ledger kind with
+        the PR-9/PR-10 contracts: budget-gated (``try_reserve`` — a
+        denial returns False and the caller demotes the aggs to the
+        host reduce with reason ``hbm_budget``), TRANSACTIONAL
+        (register-then-commit: nothing publishes or registers until
+        every transfer landed; a fault mid-group leaves no trace), and
+        evictable with this executor generation's scope. Transient
+        device faults retry with the classified backoff
+        (``search.staging.retry.*``); a terminal fault propagates to
+        the caller (fallback reason ``staging_fault``)."""
+        from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.common.staging import run_staged
+
+        with self._kernel_stage_lock:
+            arrays: Dict[str, np.ndarray] = {}
+            for fn in builds.values():
+                for name, arr in fn().items():
+                    if name not in self._seg_staged:
+                        arrays[name] = arr
+            if not arrays:
+                return True
+            estimate = sum(int(a.nbytes) for a in arrays.values())
+            if not memory_accountant().try_reserve(
+                    self.index_name, estimate, exclude_scope=self.scope):
+                return False
+
+            def _attempt():
+                from elasticsearch_tpu.testing.disruption import (
+                    on_device_staging,
+                )
+
+                t0 = _time.monotonic()
+                on_device_staging(self.index_name, "doc_values",
+                                  "agg_columns")
+                staged = {name: jax.device_put(a, self._sharding)
+                          for name, a in arrays.items()}
+                # publish atomically-enough (dict.update under the GIL)
+                # AFTER every transfer landed, then register the exact
+                # bytes — a fault above leaves nothing behind
+                self._seg_staged.update(staged)
+                dur = (_time.monotonic() - t0) * 1000.0
+                for name, a in arrays.items():
+                    self._account("doc_values", name, int(a.nbytes),
+                                  duration_ms=dur)
+
+            run_staged(_attempt, index=self.index_name,
+                       kind="doc_values", plane="mesh")
+        return True
+
     def execute(self, plans: List[PlanNode], k: int,
                 sort_keys: Optional[Tuple[str, str]] = None,
                 with_views: bool = False,
@@ -2953,16 +3338,18 @@ class MeshPlanExecutor:
                 features: frozenset = frozenset(),
                 slice_col: Optional[str] = None,
                 rescore_static: Optional[Tuple[int, str]] = None,
-                tracer=None):
+                tracer=None, agg_static: tuple = ()):
         """plans: one per shard, same query. Returns (top_keys [k],
         top_shard [k], top_doc [k], total, top_score [k], top_raw [k]
-        [, matched [n_dev, nd1], scores [n_dev, nd1]]) — doc ids are in
-        the STACKED doc space (valid per-shard ids since every shard
-        zero-bases).
+        [, matched [n_dev, nd1], scores [n_dev, nd1]]
+        [, fused-agg partials...]) — doc ids are in the STACKED doc
+        space (valid per-shard ids since every shard zero-bases).
 
         pf_plans / rs_plans: optional per-shard post_filter and rescore
         query plans; scalars: traced values for `features` and rescore
-        weights (compiled once per feature SET, not per value)."""
+        weights (compiled once per feature SET, not per value).
+        agg_static: fused-agg descriptors (search/fused_aggs.py) whose
+        staged doc-value columns reduce inside the program."""
         if len(plans) != len(self.segments):
             raise ValueError("one plan per staged shard required")
         if tracer is None:
@@ -2989,13 +3376,15 @@ class MeshPlanExecutor:
         key = ("|".join(key_parts)
                + f"|k{k}|n{self.n_dev}|p{self.slots_per_dev}"
                + f"|s{sort_keys}|v{with_views}"
-               + f"|f{sorted(features)}|sl{slice_col}|r{rescore_static}")
+               + f"|f{sorted(features)}|sl{slice_col}|r{rescore_static}"
+               + f"|a{agg_static}")
         run = _mesh_query_program(
             self.mesh,
             _TemplateHolder(_strip_plan(plans[0]), key, pf_tpl, rs_tpl), k,
             spd=self.slots_per_dev,
             sort_keys=sort_keys, with_views=with_views, features=features,
-            slice_col=slice_col, rescore_static=rescore_static)
+            slice_col=slice_col, rescore_static=rescore_static,
+            agg_static=agg_static)
         staged_plan = [jax.device_put(a, self._sharding) for a in stacked]
         staged_pf = [jax.device_put(a, self._sharding) for a in stacked_pf]
         staged_rs = [jax.device_put(a, self._sharding) for a in stacked_rs]

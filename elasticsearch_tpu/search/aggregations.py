@@ -818,27 +818,15 @@ def _run_one_inner(spec: AggSpec, views: List[SegmentView]) -> dict:
             for p in partials:
                 for k, c in p["counts"].items():
                     merged[k] = merged.get(k, 0) + c
-        size = int(spec.body.get("size", 10))
-        order = spec.body.get("order", {"_count": "desc"})
-        items = list(merged.items())
-        items = _sort_buckets(items, order)
-        selected = items[:size]
-        sum_other = sum(c for _, c in items[size:])
-        buckets = []
-        for key, count in selected:
-            b = {"key": key, "doc_count": count}
-            if spec.subs:
+        sub_cb = None
+        if spec.subs:
+            def sub_cb(key):
                 sub_views = [
                     v.with_mask(_term_bucket_mask(v, spec.body["field"], key))
                     for v in views
                 ]
-                b.update(run_aggregations(spec.subs, sub_views))
-            buckets.append(b)
-        return {
-            "doc_count_error_upper_bound": 0,
-            "sum_other_doc_count": sum_other,
-            "buckets": buckets,
-        }
+                return run_aggregations(spec.subs, sub_views)
+        return finalize_terms(spec, merged, sub_cb)
 
     if spec.type in ("histogram", "date_histogram"):
         is_date = spec.type == "date_histogram"
@@ -847,38 +835,19 @@ def _run_one_inner(spec: AggSpec, views: List[SegmentView]) -> dict:
         for p in partials:
             for k, c in p["counts"].items():
                 merged[k] = merged.get(k, 0) + c
-        min_doc_count = int(spec.body.get("min_doc_count", 1 if not is_date else 0))
-        keys = sorted(merged.keys())
-        # date_histogram fills empty buckets between min and max (min_doc_count=0)
-        if keys and min_doc_count == 0:
-            interval = spec.body.get("interval") or spec.body.get(
-                "calendar_interval") or spec.body.get("fixed_interval")
-            ms = _date_interval_ms(interval) if is_date else float(spec.body["interval"])
-            if ms is not None:
-                full, k = [], keys[0]
-                while k <= keys[-1] and len(full) < 10000:
-                    full.append(k)
-                    k += ms if not is_date else int(ms)
-                keys = [k for k in full]
-        buckets = []
-        for key in keys:
-            count = merged.get(key, 0)
-            if count < min_doc_count:
-                continue
-            b = {"key": key, "doc_count": count}
-            if is_date:
-                b["key_as_string"] = format_epoch_millis(int(key))
-            if spec.subs and count > 0:
-                sub_views = [
-                    v.with_mask(_histo_bucket_mask(v, spec, key, is_date))
-                    for v in views
-                ]
-                b.update(run_aggregations(spec.subs, sub_views))
-            elif spec.subs:
-                empty_views = [v.with_mask(np.zeros_like(v.mask)) for v in views]
-                b.update(run_aggregations(spec.subs, empty_views))
-            buckets.append(b)
-        return {"buckets": buckets}
+        sub_cb = None
+        if spec.subs:
+            def sub_cb(key, count):
+                if count > 0:
+                    sub_views = [
+                        v.with_mask(_histo_bucket_mask(v, spec, key, is_date))
+                        for v in views
+                    ]
+                else:
+                    sub_views = [v.with_mask(np.zeros_like(v.mask))
+                                 for v in views]
+                return run_aggregations(spec.subs, sub_views)
+        return finalize_histogram(spec, merged, is_date, sub_cb)
 
     if spec.type == "nested":
         # nested agg (search/aggregations/bucket/nested/NestedAggregator):
@@ -1189,6 +1158,69 @@ def _sort_buckets(items: List[Tuple], order) -> List[Tuple]:
         return sorted(items, key=lambda kv: kv[0], reverse=reverse)
     # sub-agg ordering unsupported pre-selection; fall back to count desc
     return sorted(items, key=lambda kv: (-kv[1], str(kv[0])))
+
+
+def finalize_terms(spec: AggSpec, merged: Dict, sub_cb=None) -> dict:
+    """Terms bucket selection/formatting from a merged {key: count} map.
+
+    SHARED by the host reduce and the fused on-device plane
+    (search/fused_aggs.py): both produce the same merged counts, so
+    routing them through one assembly function makes ordering, size
+    cutoff, sum_other and key formatting byte-identical by construction
+    (docs/AGGS.md parity contract). ``sub_cb(key) -> dict`` attaches
+    sub-aggregation results per surviving bucket (host path only — the
+    fused plane excludes sub-aggs structurally)."""
+    size = int(spec.body.get("size", 10))
+    order = spec.body.get("order", {"_count": "desc"})
+    items = _sort_buckets(list(merged.items()), order)
+    selected = items[:size]
+    sum_other = sum(c for _, c in items[size:])
+    buckets = []
+    for key, count in selected:
+        b = {"key": key, "doc_count": count}
+        if sub_cb is not None:
+            b.update(sub_cb(key))
+        buckets.append(b)
+    return {
+        "doc_count_error_upper_bound": 0,
+        "sum_other_doc_count": sum_other,
+        "buckets": buckets,
+    }
+
+
+def finalize_histogram(spec: AggSpec, merged: Dict, is_date: bool,
+                       sub_cb=None) -> dict:
+    """Histogram/date_histogram bucket assembly from merged {key: count}
+    (min_doc_count filtering, empty-bucket fill, key_as_string) —
+    SHARED by the host reduce and the fused on-device plane, same
+    contract as finalize_terms. ``sub_cb(key, count) -> dict``."""
+    min_doc_count = int(spec.body.get("min_doc_count",
+                                      1 if not is_date else 0))
+    keys = sorted(merged.keys())
+    # date_histogram fills empty buckets between min and max (min_doc_count=0)
+    if keys and min_doc_count == 0:
+        interval = spec.body.get("interval") or spec.body.get(
+            "calendar_interval") or spec.body.get("fixed_interval")
+        ms = (_date_interval_ms(interval) if is_date
+              else float(spec.body["interval"]))
+        if ms is not None:
+            full, k = [], keys[0]
+            while k <= keys[-1] and len(full) < 10000:
+                full.append(k)
+                k += ms if not is_date else int(ms)
+            keys = [k for k in full]
+    buckets = []
+    for key in keys:
+        count = merged.get(key, 0)
+        if count < min_doc_count:
+            continue
+        b = {"key": key, "doc_count": count}
+        if is_date:
+            b["key_as_string"] = format_epoch_millis(int(key))
+        if sub_cb is not None:
+            b.update(sub_cb(key, count))
+        buckets.append(b)
+    return {"buckets": buckets}
 
 
 def _term_bucket_mask(view: SegmentView, field: str, key) -> np.ndarray:

@@ -46,12 +46,18 @@ from typing import Dict, List, Optional
 #   plan_build     per-shard plan / kernel lane-table construction
 #   staging        host->device transfer of plan arrays / union tables
 #   kernel         device program dispatch -> block_until_ready
-#                  (includes first-call compilation)
-#   merge          ICI/host top-k merge + DocRef assembly + aggs reduce
+#                  (includes first-call compilation; fused on-device
+#                  agg reduction executes inside this span)
+#   merge          ICI/host top-k merge + DocRef assembly
+#   aggregate      aggregation reduce OUTSIDE the device program: the
+#                  host-path agg execution over segment views, the mesh
+#                  with_views fallback reduce, and the fused plane's
+#                  tiny partial-accumulator finalize (ISSUE 13 — what
+#                  fusion removes shows up as this span collapsing)
 #   batch_demux    micro-batch member demultiplex / response split
 #   fetch          fetch phase (_source, highlight, sort values)
 PHASES = ("parse_rewrite", "plan_build", "staging", "kernel", "merge",
-          "batch_demux", "fetch")
+          "aggregate", "batch_demux", "fetch")
 
 _now_ns = time.monotonic_ns
 

@@ -277,6 +277,46 @@ class TestServiceLeakCheck:
         finally:
             idx.close()
 
+    def test_doc_values_kind_populated_and_leak_free(self, monkeypatch,
+                                                     ledger_leak_check):
+        # ISSUE 13 (docs/AGGS.md): the fused-agg plane stages columnar
+        # doc values under the `doc_values` ledger kind — exact bytes in
+        # the per-kind map, lifecycle events with reasons, leak-free
+        # across force-merge/evict cycles
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+        acct = ledger_leak_check
+        idx = _mk_index("dmdv", shards=2)
+        try:
+            body = {"query": {"match": {"body": "w1"}}, "size": 5,
+                    "aggs": {"s": {"sum": {"field": "n"}}}}
+            got = idx.search(dict(body))
+            assert got["_plane"] == "mesh_pallas", got["_plane"]
+            st = acct.stats("dmdv")
+            assert st["staged_bytes"]["doc_values"] > 0
+            dv_events = [e for e in st["staging_events"]
+                         if e["kind"] == "doc_values"]
+            assert dv_events and all(e["reason"] for e in dv_events)
+            assert (st["staged_bytes_total"]
+                    == sum(st["staged_bytes"].values()))
+            # merge retires the segment set; the rebuilt executor
+            # restages the columns on the next agg query, exactly once
+            idx.force_merge()
+            idx.refresh()
+            got2 = idx.search(dict(body))
+            assert got2["aggregations"] == got["aggregations"]
+            assert acct.stats("dmdv")["staged_bytes"]["doc_values"] > 0
+            # eviction drops the columns with their executor scope; the
+            # next query restages them (no orphaned doc_values bytes)
+            assert acct.force_evict(scopes=8) > 0
+            got3 = idx.search(dict(body))
+            assert got3["aggregations"] == got["aggregations"]
+            st3 = acct.stats("dmdv")
+            assert (st3["staged_bytes_total"]
+                    == sum(st3["staged_bytes"].values()))
+        finally:
+            idx.close()
+        assert acct.staged_bytes("dmdv") == 0
+
     def test_mesh_staging_accounted_and_released(self, ledger_leak_check):
         acct = ledger_leak_check
         idx = _mk_index("dmmesh", {"index.search.mesh": True})

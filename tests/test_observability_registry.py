@@ -132,6 +132,27 @@ class TestObservabilityRegistryLint:
         assert "batch_window_effective_ms" in batch
         assert "batch_window_effective_ms" in doc
 
+    def test_fused_agg_counters_exported_and_documented(
+            self, exercised_index):
+        # ISSUE 13 (docs/AGGS.md): the fused-aggregation plane's
+        # adoption counters — and the fallback-reason vocabulary — are
+        # part of the documented operator surface
+        doc = _doc_text()
+        planes = exercised_index.search_stats()["planes"]
+        for key in ("agg_fused_query_total", "agg_host_fallback_total",
+                    "agg_host_fallback_by_reason"):
+            assert key in planes, planes.keys()
+            assert key in doc, f"[{key}] undocumented"
+        # the `aggregate` phase joined the taxonomy ring
+        phases = exercised_index.search_stats()["phases"]
+        assert "aggregate" in phases["taxonomy"]
+        assert "aggregate" in doc
+        for reason in ("disabled", "unsupported_agg", "sub_aggs",
+                       "multi_valued", "values_not_fusable",
+                       "bucket_range", "unsupported_params",
+                       "field_ineligible", "resolve_error"):
+            assert reason in doc, f"fallback reason [{reason}] undocumented"
+
     def test_lint_catches_undocumented_key(self):
         doc = _doc_text()
         keys: set = set()

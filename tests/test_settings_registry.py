@@ -86,6 +86,18 @@ class TestSettingsRegistryLint:
                     "index.search.plane_quarantine.cooldown"):
             assert key in registered, key
 
+    def test_fused_aggs_settings_registered_and_dynamic(self):
+        # ISSUE 13 (docs/AGGS.md): the fused-aggregation plane's node
+        # default is dynamic (PUT _cluster/settings retunes it live with
+        # the explicitness contract) and the per-index override is a
+        # registered INDEX-scoped key create_index seeds like
+        # search.pallas.*
+        registry = cluster_settings()
+        assert registry.is_registered("search.aggs.fused")
+        assert registry.is_dynamic("search.aggs.fused")
+        index_registry = index_scoped_settings()
+        assert index_registry.is_registered("index.search.aggs.fused")
+
     def test_overload_control_settings_registered_and_dynamic(self):
         # ISSUE 12 (docs/OVERLOAD.md): every overload-control knob is
         # registered AND dynamic — operators must be able to resize the
