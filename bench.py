@@ -533,6 +533,18 @@ def run_measurement() -> dict:
             extra_configs["overload_zipfian"] = {
                 "error": f"{type(e).__name__}: {e}"}
         stamp_mem(extra_configs["overload_zipfian"])
+        # ISSUE 14 acceptance config: cold-start stall elimination —
+        # first-query latency cold vs compile-cache-warmed + drain p99
+        # (docs/RESILIENCE.md "Rollout & drain")
+        try:
+            extra_configs["cold_start"] = run_cold_start_config()
+        except Exception as e:  # noqa: BLE001 — recorded, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            extra_configs["cold_start"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        stamp_mem(extra_configs["cold_start"])
 
     # ---------------- timings: legacy scatter path (r03) ----------------
     legacy_p50 = legacy_p50_2 = None
@@ -1531,6 +1543,162 @@ def run_fault_soak_config():
     finally:
         clear_search_disruptions()
         idx.close()
+
+
+def run_cold_start_config():
+    """ISSUE 14 config: what does a restart cost the first query, and
+    what does the rollout plane save (docs/RESILIENCE.md "Rollout &
+    drain")?
+
+    Three headline numbers, all measured on this backend (a future TPU
+    run quantifies the real 2–27 s stall elimination):
+
+    - ``first_query_cold_ms``: restart with NO persistent cache and NO
+      warming — compiled-program caches cleared, the first query pays
+      trace + XLA compile on its own path;
+    - ``first_query_warmed_ms``: restart WITH the persistent
+      compilation cache + variant-registry warming — programs warm in
+      the background off the clock, the first query pays only its
+      serving latency (``query_path_first_compiles`` proves it paid no
+      compile);
+    - ``drain_p99_ms``: p99 time for a drain to quiesce the index
+      under concurrent in-flight searches (begin_drain →
+      await_drained over repeated cycles).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from elasticsearch_tpu.common import compile_cache as cc
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.index_service import IndexService
+    from elasticsearch_tpu.parallel.plan_exec import (
+        clear_compiled_programs,
+    )
+    from elasticsearch_tpu.testing.disruption import SearchDelayScheme
+
+    root = tempfile.mkdtemp(prefix="estpu-coldstart-")
+    N_DOCS = 4000
+    rng = np.random.RandomState(14)
+    vocab = [f"w{i}" for i in range(24)]
+    settings = Settings({
+        "index.number_of_shards": 4,
+        "index.search.mesh": True,
+        "index.search.mesh.plane": "pallas",
+        "index.refresh_interval": -1,
+    })
+    mapping = {"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"}}}
+    data_path = os.path.join(root, "index")
+
+    def mk():
+        return IndexService("bench_cold_start", settings,
+                            mapping=mapping, data_path=data_path)
+
+    probe = {"query": {"match": {"body": "w0 w1"}}, "size": 10}
+
+    def timed_query(svc):
+        t0 = time.perf_counter()
+        svc.search(dict(probe))
+        return (time.perf_counter() - t0) * 1000.0
+
+    prev_registry = cc.variant_registry()
+    try:
+        cc.configure_compile_cache(None)
+        registry_path = os.path.join(root, "variants.json")
+        cc.set_variant_registry(cc.VariantRegistry(registry_path))
+        svc = mk()
+        for d in range(N_DOCS):
+            toks = [vocab[min(int(rng.zipf(1.4)) - 1, len(vocab) - 1)]
+                    for _ in range(3 + int(rng.randint(5)))]
+            svc.index_doc(str(d), {"body": " ".join(toks)})
+        svc.refresh()
+        svc.flush()
+
+        # ---- cold restart: no cache, no warming ----
+        clear_compiled_programs()
+        first_query_cold_ms = timed_query(svc)
+
+        # ---- populate the persistent cache (the "previous process") --
+        cache_dir = os.path.join(root, "jax_cache")
+        cache_on = cc.configure_compile_cache(cache_dir)
+        clear_compiled_programs()
+        svc.search(dict(probe))  # compiles + serializes to disk
+        svc.close()
+
+        # ---- warmed restart: cache + registry + background warming --
+        clear_compiled_programs()
+        cc.set_variant_registry(cc.VariantRegistry(registry_path))
+        svc = mk()
+        t_warm0 = time.perf_counter()
+        warmed = svc.warm_compile_variants()
+        warm_ms = (time.perf_counter() - t_warm0) * 1000.0
+        qp0 = cc.compile_stats().stats()[
+            "query_path_first_compile_total"]
+        first_query_warmed_ms = timed_query(svc)
+        query_path_first_compiles = (
+            cc.compile_stats().stats()["query_path_first_compile_total"]
+            - qp0)
+
+        # ---- drain p99 under concurrent in-flight searches ----
+        adm = svc.admission
+        delay = SearchDelayScheme(0.004,
+                                  indices=["bench_cold_start"]).install()
+        drain_ms = []
+        try:
+            for _ in range(20):
+                stop = threading.Barrier(3)
+
+                def inflight():
+                    stop.wait(timeout=5)
+                    try:
+                        svc.search(dict(probe))
+                    except Exception:  # noqa: BLE001 — drain may refuse
+                        pass
+
+                threads = [threading.Thread(target=inflight)
+                           for _ in range(2)]
+                for t in threads:
+                    t.start()
+                stop.wait(timeout=5)
+                time.sleep(0.002)  # searches admitted + executing
+                t0 = time.perf_counter()
+                adm.begin_drain()
+                drained = adm.await_drained(10.0)
+                drain_ms.append(time.perf_counter() - t0)  # seconds;
+                # pctl() scales to ms
+                adm.end_drain()
+                for t in threads:
+                    t.join()
+                if not drained:
+                    break
+        finally:
+            delay.remove()
+        svc.close()
+        return {
+            "n_docs": N_DOCS,
+            "cache_enabled": bool(cache_on),
+            "variants_recorded": len(cc.variant_registry().programs),
+            "warm_specs_replayed": warmed,
+            "warm_background_ms": round(warm_ms, 3),
+            # headline keys (BENCH_rNN)
+            "first_query_cold_ms": round(first_query_cold_ms, 3),
+            "first_query_warmed_ms": round(first_query_warmed_ms, 3),
+            "cold_start_stall_saved_ms": round(
+                first_query_cold_ms - first_query_warmed_ms, 3),
+            "query_path_first_compiles": query_path_first_compiles,
+            "drain_p99_ms": round(pctl(drain_ms, 99), 3) if drain_ms
+            else None,
+            "drain_p50_ms": round(pctl(drain_ms, 50), 3) if drain_ms
+            else None,
+            "drain_cycles": len(drain_ms),
+        }
+    finally:
+        cc.configure_compile_cache(None)
+        cc.set_variant_registry(prev_registry)
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def run_overload_zipfian_config():

@@ -64,6 +64,7 @@ from elasticsearch_tpu.utils.murmur3 import shard_id_for
 ACTION_PUBLISH = "internal:cluster/coordination/publish_state"
 ACTION_COMMIT = "internal:cluster/coordination/commit_state"
 ACTION_JOIN = "internal:discovery/zen/join"
+ACTION_LEAVE = "internal:discovery/zen/leave"
 ACTION_SHARD_FAILED = "internal:cluster/shard/failure"
 ACTION_SHARD_STARTED = "internal:cluster/shard/started"
 ACTION_WRITE_PRIMARY = "indices:data/write/bulk[s][p]"
@@ -305,6 +306,7 @@ class ClusterNode:
         t.register_handler(ACTION_PUBLISH, self._on_publish)
         t.register_handler(ACTION_COMMIT, self._on_commit)
         t.register_handler(ACTION_JOIN, self._on_join)
+        t.register_handler(ACTION_LEAVE, self._on_leave)
         t.register_handler(ACTION_SHARD_FAILED, self._on_shard_failed)
         t.register_handler(ACTION_SHARD_STARTED, self._on_shard_started)
         t.register_handler(ACTION_WRITE_PRIMARY, self._on_write_primary)
@@ -381,7 +383,78 @@ class ClusterNode:
                 raise IllegalArgumentException("node_left must run on the master")
             if departed in self.known_nodes:
                 self.known_nodes.remove(departed)
+            self.node_info_map.pop(departed, None)
         self._master_reroute_and_publish()
+
+    def _on_leave(self, payload, src) -> dict:
+        """Graceful-leave announcement (ISSUE 14, docs/RESILIENCE.md
+        "Rollout & drain"): the departing node tells the master BEFORE
+        shutting down, so the coordinator routes around it and replicas
+        promote NOW instead of after the fault-detection timeout."""
+        with self._lock:
+            if not self.is_master:
+                return {"ok": False, "master": self.master_id}
+        try:
+            self.node_left(payload["node"])
+        except (IllegalArgumentException,
+                FailedToCommitClusterStateException):
+            return {"ok": False, "master": self.master_id}
+        return {"ok": True}
+
+    def graceful_leave(self, timeout_s: float = 2.0) -> bool:
+        """Announce this node's departure before shutdown (the rollout
+        contract): a follower notifies the master (one redirect hop,
+        like join); the master ABDICATES — one state update removes it
+        from the node set, hands mastership to the lowest-id other
+        eligible node, and reroutes, so its primaries' replicas promote
+        under the leave publish instead of after FD timeout. Bounded
+        and best-effort: False means peers will learn via fault
+        detection, exactly the pre-ISSUE-14 behavior."""
+        with self._lock:
+            peers = [n for n in self.known_nodes if n != self.node_id]
+            master = self.master_id
+            am_master = self.is_master
+        if not peers:
+            return True  # last node: nobody to tell
+        if not am_master:
+            target = master
+            for _hop in range(2):  # one redirect, like join()
+                if target is None or target == self.node_id:
+                    return False
+                try:
+                    resp = self.transport.send_request(
+                        target, ACTION_LEAVE, {"node": self.node_id},
+                        timeout=min(self.request_timeout, timeout_s)) or {}
+                except (NodeNotConnectedException,
+                        ElasticsearchTpuException):
+                    return False
+                if resp.get("ok"):
+                    return True
+                target = resp.get("master")
+            return False
+
+        # master: abdicate
+        def mutate():
+            successor = next(
+                (n for n in self._master_eligible_nodes(
+                    exclude=self.node_id) if n != self.node_id), None)
+            if self.node_id in self.known_nodes:
+                self.known_nodes.remove(self.node_id)
+            self.node_info_map.pop(self.node_id, None)
+            self.master_id = successor
+            # a mastership TRANSFER bumps the epoch exactly like an
+            # election: followers order states by (epoch, version) and
+            # break same-epoch master conflicts toward the LOWER id —
+            # without the bump, handing off to a higher-id successor
+            # would be rejected as a lost election
+            self.cluster_epoch += 1
+
+        try:
+            self._submit_state_update(mutate)
+            return True
+        except (FailedToCommitClusterStateException,
+                NodeNotConnectedException, ElasticsearchTpuException):
+            return False
 
     def check_nodes(self) -> List[str]:
         """Fault detection (NodesFaultDetection): master pings all nodes;
@@ -1068,6 +1141,10 @@ class ClusterNode:
                                 and other.state == ShardRoutingState.STARTED):
                             tracker.mark_in_sync(other.node_id, -1, force=True)
                     shard.checkpoints = tracker
+                    # post-failover warming (ISSUE 14): heat the promoted
+                    # primary's search path off the query path
+                    deferred.append(
+                        lambda sh=shard: self._warm_promoted_primary(sh))
                 elif copy.state == ShardRoutingState.INITIALIZING and not copy.primary:
                     deferred.append(
                         lambda i=index, s=sid: self._recover_replica(i, s))
@@ -1731,12 +1808,51 @@ class ClusterNode:
             shard.refresh()
         return {"ok": True}
 
-    def close(self) -> None:
+    def _warm_promoted_primary(self, shard) -> None:
+        """Post-failover promotion warming (ISSUE 14): heat the promoted
+        primary's search path in the background, off the query path —
+        the first client search after a promotion must not pay the cold
+        path (compile_cache.warming marks any first compile as warmed)."""
+        def warm():
+            from elasticsearch_tpu.common.compile_cache import warming
+
+            try:
+                with warming():
+                    shard.searcher.query({"query": {"match_all": {}}},
+                                         size_hint=1)
+            except Exception:  # noqa: BLE001 — warming is best-effort
+                pass
+
+        threading.Thread(target=warm, daemon=True,
+                         name=f"promote-warm[{shard.index_name}]"
+                              f"[{shard.shard_id}]").start()
+
+    def close(self, graceful: bool = True) -> None:
+        """Shutdown ordering (ISSUE 14): durable synced-flush marker
+        first (warm restart over this data path recovers ops-free),
+        then the graceful-leave announcement (peers reroute and promote
+        NOW), then transport deregistration BEFORE the shards close —
+        a closing node must never receive and half-serve a routed
+        request mid-teardown."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         if getattr(self, "_fd_stop", None) is not None:
             self._fd_stop.set()
-        for shard in self.shards.values():
-            shard.close()
+        if self.data_path:
+            for shard in list(self.shards.values()):
+                try:
+                    shard.synced_flush()
+                except Exception:  # noqa: BLE001 — flush is best-effort
+                    pass  # at shutdown; translog replay covers the gap
+        if graceful:
+            try:
+                self.graceful_leave()
+            except Exception:  # noqa: BLE001 — fall back to FD removal
+                pass
         self.transport.close()
+        for shard in list(self.shards.values()):
+            shard.close()
 
 
 class ClusterClient:

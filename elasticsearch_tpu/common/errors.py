@@ -153,6 +153,18 @@ class EsRejectedExecutionException(ElasticsearchTpuException):
     status_code = 429
 
 
+class NodeDrainingException(ElasticsearchTpuException):
+    """The node is draining for a rollout/restart (ISSUE 14,
+    docs/RESILIENCE.md "Rollout & drain"): new searches are refused with
+    a clean 503 + Retry-After so the balancer/client routes around the
+    node; in-flight work finishes within the drain deadline. Never a
+    timeout, never a 5xx-with-stack — the REST layer renders the
+    ``retry_after_s`` attribute as the ``Retry-After`` header exactly
+    like the 429 rejections."""
+
+    status_code = 503
+
+
 class TaskCancelledException(ElasticsearchTpuException):
     status_code = 400
 

@@ -672,6 +672,34 @@ SEARCH_STAGING_RETRY_BACKOFF_MS = Setting.float_setting(
     "search.staging.retry.backoff_ms", 10.0, min_value=0.0, dynamic=True
 )
 
+# --- zero-downtime rollout: compile cache + graceful drain (ISSUE 14,
+# docs/RESILIENCE.md "Rollout & drain") ---
+
+SEARCH_COMPILE_CACHE_PATH = Setting.str_setting(
+    # JAX persistent compilation cache directory: a restarted node
+    # deserializes compiled mesh-program executables from disk instead
+    # of paying the 2–27 s first-compile stall per variant. Empty =
+    # disabled. Startup-only (the XLA cache must configure before the
+    # first compile).
+    "search.compile.cache_path", ""
+)
+SEARCH_COMPILE_WARM_ON_START = Setting.bool_setting(
+    # replay the persisted program-variant lattice in the background
+    # after node start / index recovery (compile_cache.VariantRegistry):
+    # first compiles — persistent-cache deserializations included — are
+    # absorbed OFF the query path (programs_warmed_total), so a warmed
+    # rolling restart serves zero query-path first compiles
+    "search.compile.warm_on_start", True
+)
+SEARCH_DRAIN_DEADLINE = Setting.time_setting(
+    # graceful-drain deadline: a draining node stops admitting (clean
+    # 503 + Retry-After, queued entries shed with the same contract)
+    # and waits at most this long for in-flight searches before it
+    # flushes (synced-flush marker) and shuts down; also the
+    # Retry-After a drain rejection carries
+    "search.drain.deadline", "30s", dynamic=True
+)
+
 # --- phase-attributed query telemetry (docs/OBSERVABILITY.md) ---
 
 SEARCH_TELEMETRY_ENABLED = Setting.bool_setting(
@@ -737,6 +765,9 @@ NODE_SETTINGS = [
     SEARCH_MEMORY_HBM_BUDGET,
     SEARCH_STAGING_RETRY_MAX_ATTEMPTS,
     SEARCH_STAGING_RETRY_BACKOFF_MS,
+    SEARCH_COMPILE_CACHE_PATH,
+    SEARCH_COMPILE_WARM_ON_START,
+    SEARCH_DRAIN_DEADLINE,
     SEARCH_TELEMETRY_ENABLED,
 ]
 

@@ -104,6 +104,9 @@ class Engine:
         # write counters are otherwise unchanged
         self.visibility_epoch = 0
         self.flush_count = 0
+        # last stamped synced-flush marker (graceful drain stamps one at
+        # shutdown; recover_from_store re-adopts it from the commit)
+        self.last_sync_id: Optional[str] = None
         self.indexing_total = 0
         self.delete_total = 0
         self.indexing_time = 0.0
@@ -216,6 +219,9 @@ class Engine:
                     TranslogOp.INDEX, seqno, doc_id, source, routing,
                     new_version, primary_term, parent=parent
                 ))
+            # any write voids the synced-flush marker (reference: a
+            # sync_id is only valid while the commit covers every op)
+            self.last_sync_id = None
             self.indexing_total += 1
             self.indexing_time += time.monotonic() - t0
             return {
@@ -296,6 +302,7 @@ class Engine:
                     TranslogOp.DELETE, seqno, doc_id, version=new_version,
                     primary_term=primary_term
                 ))
+            self.last_sync_id = None  # a delete voids the marker too
             self.delete_total += 1
             return {
                 "_id": doc_id,
@@ -460,15 +467,32 @@ class Engine:
             else:
                 self._refresh_listeners.append(listener)
 
-    def flush(self) -> None:
-        """Refresh + durable commit + translog trim (InternalEngine.flush)."""
+    def flush(self, sync_id: Optional[str] = None) -> None:
+        """Refresh + durable commit + translog trim (InternalEngine.flush).
+        ``sync_id``: stamp a synced-flush marker into the commit (ISSUE
+        14 graceful drain — the reference's _flush/synced sync_id)."""
         with self._lock:
             self.refresh()
             if self.store is not None:
-                self.store.commit(self.segments, self.max_seqno, self.version_map)
+                self.store.commit(self.segments, self.max_seqno,
+                                  self.version_map, sync_id=sync_id)
             self.translog.mark_committed(self.max_seqno)
             self.translog.roll_generation()
             self.flush_count += 1
+            if sync_id is not None:
+                self.last_sync_id = sync_id
+
+    def synced_flush(self) -> str:
+        """Flush + stamp a fresh synced-flush marker (SyncedFlushService
+        analog for the drained-shutdown path): after this, the commit
+        provably covers every acked op — a warm restart over the same
+        data path replays ZERO translog ops (`_cat/recovery` ops-free
+        contract, docs/RESILIENCE.md "Rollout & drain")."""
+        import uuid as _uuid
+
+        sync_id = _uuid.uuid4().hex
+        self.flush(sync_id=sync_id)
+        return sync_id
 
     def force_merge(self) -> None:
         """Rewrite all segments into one (expunges deletes). The reference

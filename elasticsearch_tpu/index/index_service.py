@@ -367,9 +367,80 @@ class IndexService:
         for shard in self.shards.values():
             shard.flush()
 
+    def synced_flush(self) -> Dict[int, str]:
+        """Flush + synced-flush marker per shard (ISSUE 14 graceful
+        drain; the reference's _flush/synced): after it a warm restart
+        over the same data path recovers ops-free. Returns
+        {shard_id: sync_id}."""
+        self._flush_total += 1
+        return {sid: shard.synced_flush()
+                for sid, shard in self.shards.items()}
+
     def force_merge(self) -> None:
         for shard in self.shards.values():
             shard.force_merge()
+
+    # ------------------------------------------------------------------
+    # Compiled program-variant lattice (ISSUE 14, docs/RESILIENCE.md
+    # "Rollout & drain"): record the query shapes the mesh plane served,
+    # so a restart can warm their compiled variants off the query path.
+    # ------------------------------------------------------------------
+
+    def _record_warm_variant(self, kind: str, bodies: List[dict],
+                             plane: str) -> None:
+        if plane not in ("mesh_pallas", "mesh") or not bodies:
+            return
+        from elasticsearch_tpu.common import compile_cache as cc
+
+        if cc.in_warming():
+            return  # a warm replay must not re-record itself
+        import json as _json
+
+        try:
+            # dedup BEFORE any copying/serialization: on the steady
+            # state every query's variant is already recorded and this
+            # is one skeleton hash + one dict probe
+            key = (kind + "|" + str(min(len(bodies), 16)) + "|"
+                   + "|".join(sorted({cc.body_skeleton(b)
+                                      for b in bodies[:16]})))
+            registry = cc.variant_registry()
+            if registry.has_warm(self.name, key):
+                return
+            clean = [{k: v for k, v in (b or {}).items()
+                      if k not in ("profile", "preference")}
+                     for b in bodies[:16]]
+            _json.dumps(clean)  # only JSON-serializable bodies persist
+            registry.record_warm(self.name, key,
+                                 {"kind": kind, "bodies": clean})
+        except (TypeError, ValueError):
+            pass  # unserializable body: this variant just isn't warmable
+
+    def warm_compile_variants(self) -> int:
+        """Replay this index's recorded program-variant lattice under
+        the warming context — first compiles (or persistent-cache
+        deserializations) land in ``programs_warmed_total``, never on
+        the query path. Called in the background on node start / index
+        open; returns how many warm specs replayed cleanly."""
+        from elasticsearch_tpu.common import compile_cache as cc
+
+        warmed = 0
+        for spec in cc.variant_registry().warm_entries(self.name):
+            try:
+                with cc.warming():
+                    bodies = [dict(b) for b in spec.get("bodies") or []]
+                    if not bodies:
+                        continue
+                    if spec.get("kind") == "search_batch":
+                        self.search_batch(bodies)
+                    else:
+                        for body in bodies:
+                            self._search_uncached(body)
+                warmed += 1
+            except Exception:  # noqa: BLE001 — warming must never fail
+                # the node; a stale spec (deleted field, changed
+                # mapping) just warms nothing
+                continue
+        return warmed
 
     # ------------------------------------------------------------------
     # Search (scatter -> merge -> fetch; §3.2 of SURVEY.md)
@@ -429,6 +500,11 @@ class IndexService:
         tracer into the phase histograms, attach the plane-truthful
         profile section, and emit the (mesh-plane) slowlog line."""
         self.telemetry.record_query(plane, tracer)
+        # program-variant warm spec (ISSUE 14, docs/RESILIENCE.md): a
+        # mesh-served query shape joins the index's recorded lattice so
+        # the next restart can warm its compiled variant off the query
+        # path (deduped by structure — one record per variant)
+        self._record_warm_variant("search", [body], plane)
         if body.get("profile"):
             prof = resp.setdefault("profile", {"shards": []})
             prof["plane"] = plane
@@ -1146,6 +1222,12 @@ class IndexService:
                 except Exception as e:  # noqa: BLE001 — per-member fetch
                     results[i] = e
             self.batch_stats.note_batch(len(live))
+            # batched program-variant warm spec (ISSUE 14): record the
+            # burst's shape so restart warming replays a same-shaped
+            # batch through query_batch (the batched q_pad/kk variants
+            # are distinct compiled programs from the serial ones)
+            self._record_warm_variant("search_batch", live_bodies,
+                                      "mesh_pallas")
             set_opaque_id(leader_oid)
             return results
 
@@ -1482,6 +1564,12 @@ class IndexService:
             # staging/eviction lifecycle event rings, and the
             # restage-amplification metric ROADMAP item 3 drives down
             "memory": _memory_stats(self.name),
+            # compile plane (ISSUE 14, docs/OBSERVABILITY.md): the
+            # persistent-cache hit/miss counters, warmed-program count,
+            # query-path first compiles, and the first-compile-stall
+            # histogram — a PROCESS resource like the memory ledger
+            # (_nodes/stats re-exports the same node-wide block)
+            "compile": _compile_stats(),
         }
         if groups:
             search["groups"] = groups
@@ -1581,6 +1669,12 @@ def _memory_stats(index: Optional[str]) -> dict:
     from elasticsearch_tpu.common.memory import memory_accountant
 
     return memory_accountant().stats(index)
+
+
+def _compile_stats() -> dict:
+    from elasticsearch_tpu.common.compile_cache import compile_stats
+
+    return compile_stats().stats()
 
 
 def _pure_knn_mesh_clause(body: dict) -> Optional[dict]:

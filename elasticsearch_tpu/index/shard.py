@@ -167,6 +167,10 @@ class IndexShard:
     # ------------------------------------------------------------------
 
     def recover_from_store(self) -> None:
+        # a _cat/recovery "store" row is recorded only for a cold boot:
+        # peer recovery re-enters this method to install shipped files
+        # (already STARTED), and that recovery owns its own "peer" row
+        boot = self.state == ShardState.CREATED
         self.state = ShardState.RECOVERING
         segments = self.engine.store.load_segments()
         self.engine.segments = segments
@@ -208,7 +212,31 @@ class IndexShard:
             max_seq = max(max_seq, t["seq_no"])
         if max_seq >= 0:
             self.engine.note_external_seqno(max_seq)
-        self.engine.recover_from_translog()
+        # re-adopt the synced-flush marker (ISSUE 14): its presence plus
+        # a zero-op translog replay is the ops-free warm-restart proof
+        self.engine.last_sync_id = commit.get("sync_id")
+        replayed = self.engine.recover_from_translog()
+        if boot:
+            # _cat/recovery row for the store recovery (RecoveryState
+            # type "store"): a drained shutdown's synced flush makes
+            # `replayed` ZERO — the ops-free warm-restart contract
+            # (docs/RESILIENCE.md "Rollout & drain"; lazy import:
+            # multinode imports this module)
+            from elasticsearch_tpu.cluster.multinode import (
+                record_recovery_progress,
+            )
+
+            import time as _time
+
+            now_ms = int(_time.time() * 1000)
+            record_recovery_progress(
+                self.index_name, self.shard_id,
+                f"store[{self.shard_id}]",
+                source=None, type="store", stage="done",
+                start_ms=now_ms, stop_ms=now_ms,
+                files_total=len(segments), files_recovered=len(segments),
+                bytes_total=0, bytes_recovered=0,
+                ops_total=replayed, ops_recovered=replayed)
         self.state = ShardState.POST_RECOVERY
         self.state = ShardState.STARTED
 
@@ -320,6 +348,12 @@ class IndexShard:
 
     def flush(self) -> None:
         self.engine.flush()
+
+    def synced_flush(self) -> str:
+        """Drain-path flush + synced-flush marker (docs/RESILIENCE.md
+        "Rollout & drain"): after it, restart recovery over this data
+        path replays zero translog ops."""
+        return self.engine.synced_flush()
 
     def force_merge(self) -> None:
         self.engine.force_merge()

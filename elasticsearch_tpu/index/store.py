@@ -49,7 +49,8 @@ class Store:
         return os.path.join(self.directory, "commit.json")
 
     def commit(self, segments: List[Segment], max_seqno: int,
-               version_map: Optional[dict] = None) -> None:
+               version_map: Optional[dict] = None,
+               sync_id: Optional[str] = None) -> None:
         for seg in segments:
             if not os.path.exists(self._seg_dir(seg.name)):
                 self.write_segment(seg)
@@ -59,6 +60,12 @@ class Store:
             "segments": [s.name for s in segments],
             "max_seq_no": int(max_seqno),
         }
+        if sync_id is not None:
+            # synced-flush marker (ISSUE 14, the reference's _flush/synced
+            # sync_id commit user-data): a drained shutdown stamps it so a
+            # warm restart can prove the commit covers every acked op —
+            # recovery is then ops-free (zero translog replay)
+            commit["sync_id"] = sync_id
         if version_map is not None:
             # persist what segments cannot re-derive: delete tombstones
             # (the seqno staleness guard consults them after restart) and

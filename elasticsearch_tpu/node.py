@@ -134,6 +134,22 @@ class Node:
             lambda v: configure_staging_retry(backoff_ms=float(v)))
         self.data_path = data_path or PATH_DATA.get(settings)
         self.persistent_path = data_path is not None or "path.data" in settings
+        # zero-downtime rollout (ISSUE 14, docs/RESILIENCE.md "Rollout &
+        # drain"): enable JAX's persistent compilation cache
+        # (search.compile.cache_path) and install the program-variant
+        # registry persisted beside the store, so restart never pays a
+        # query-path first compile. Like the ES_TPU_* exports, the
+        # process-global registry follows the last-constructed Node.
+        from elasticsearch_tpu.common import compile_cache as _cc
+
+        cache_path = settings.get_str("search.compile.cache_path", "")
+        if cache_path:
+            _cc.configure_compile_cache(cache_path)
+        if self.persistent_path:
+            _cc.set_variant_registry(_cc.VariantRegistry(
+                os.path.join(self.data_path, "_state",
+                             "compile_variants.json")))
+        self._draining = False
         # secure settings from the encrypted keystore (KeyStoreWrapper):
         # kept OUT of the displayed settings (filtered) — consumers read
         # node.secure_settings explicitly, like the reference's
@@ -215,6 +231,12 @@ class Node:
             self.cluster_service.add_applier(self._persist_global_meta)
             self._recover_global_meta()
             self._recover_indices_from_disk()
+            # AOT variant warming (ISSUE 14): replay the recorded
+            # program-variant lattice in the background, off the query
+            # path — a warmed restart serves zero query-path first
+            # compiles (the rolling-restart soak's headline invariant)
+            if settings.get_bool("search.compile.warm_on_start", True):
+                self._start_compile_warming()
 
     # ------------------------------------------------------------------
     # Index lifecycle (MetaDataCreateIndexService / MetaDataDeleteIndexService)
@@ -280,7 +302,8 @@ class Node:
         # index Settings would shadow later dynamic cluster updates)
         for prefix in ("search.batch.", "search.pallas.", "search.knn.",
                        "search.aggs.", "search.telemetry.",
-                       "search.queue.", "search.admission."):
+                       "search.queue.", "search.admission.",
+                       "search.drain."):
             cluster_dynamic = state.persistent_settings.merged_with(
                 state.transient_settings).filtered_by_prefix(prefix)
             merged_settings = self.settings.filtered_by_prefix(
@@ -291,6 +314,12 @@ class Node:
         svc = IndexService(name, merged_settings, merged_mappings,
                            self._index_data_path(name))
         svc.doc_type = doc_type  # 6.x custom type name echoed in responses
+        if self._draining:
+            # an index created while the node drains (auto-create from a
+            # straggling write) joins the drain: its searches get the
+            # same clean 503 instead of silently serving on a node the
+            # orchestrator believes is quiescing
+            svc.admission.begin_drain()
         self.indices[name] = svc
 
         def update(state: ClusterState) -> ClusterState:
@@ -1546,6 +1575,11 @@ class Node:
         # node-wide view instead of summed per-index blocks (summing
         # restage_amplification ratios would be meaningless)
         search["memory"] = memory_accountant().stats(None)
+        # the compile plane is a process resource too: re-export the
+        # node-wide block instead of the per-index sum (ISSUE 14)
+        from elasticsearch_tpu.common.compile_cache import compile_stats
+
+        search["compile"] = compile_stats().stats()
         return {
             "cluster_name": self.cluster_service.state.cluster_name,
             "nodes": {
@@ -2001,11 +2035,99 @@ class Node:
             raise ResourceNotFoundException(f"unable to find script [{script_id}]")
         return {"_id": script_id, "found": True, "script": script}
 
+    def _start_compile_warming(self) -> None:
+        """Background AOT warming of every recovered index's recorded
+        program-variant lattice (daemon thread — never blocks boot or
+        the first query; the query path simply finds warm programs)."""
+        from elasticsearch_tpu.common import compile_cache as _cc
+
+        targets = [svc for svc in self.indices.values()
+                   if _cc.variant_registry().warm_entries(svc.name)]
+        if not targets:
+            return
+
+        def warm():
+            for svc in targets:
+                try:
+                    svc.warm_compile_variants()
+                except Exception:  # noqa: BLE001 — warming is best-effort
+                    pass
+
+        threading.Thread(target=warm, daemon=True,
+                         name=f"compile-warm[{self.node_name}]").start()
+
+    # ------------------------------------------------------------------
+    # Graceful drain + shutdown (ISSUE 14, docs/RESILIENCE.md
+    # "Rollout & drain")
+    # ------------------------------------------------------------------
+
+    def _drain_deadline_s(self) -> float:
+        committed = self.cluster_service.state.persistent_settings \
+            .merged_with(self.cluster_service.state.transient_settings)
+        source = (committed if committed.get("search.drain.deadline")
+                  is not None else self.settings)
+        v = source.get_time("search.drain.deadline", 30.0)
+        return float(v) if v is not None else 30.0
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Enter the draining state (the rollout API): every index's
+        admission controller stops admitting (clean 503 + Retry-After;
+        queued entries shed with the same contract), in-flight searches
+        finish within the drain deadline, then every shard flushes with
+        a synced-flush marker so warm restart recovery is ops-free.
+        Idempotent; ``undrain()`` aborts. Returns the drain report."""
+        t0 = time.monotonic()
+        deadline_s = (self._drain_deadline_s() if deadline_s is None
+                      else float(deadline_s))
+        self._draining = True
+        shed = 0
+        for svc in self.indices.values():
+            shed += svc.admission.begin_drain()
+        deadline_at = time.monotonic() + deadline_s
+        drained = True
+        for svc in self.indices.values():
+            remaining = max(deadline_at - time.monotonic(), 0.0)
+            drained = svc.admission.await_drained(remaining) and drained
+        # flush + synced-flush marker AFTER the in-flight work finished:
+        # the commit then covers every acked op (ops-free warm restart).
+        # Only a persistent data path benefits — a tempdir-backed node
+        # has nothing to warm-restart into, so skip the commit I/O.
+        if self.persistent_path:
+            for name in list(self.indices):
+                self._persist_index_meta(name)
+                try:
+                    self.indices[name].synced_flush()
+                except Exception:  # noqa: BLE001 — a failed flush must
+                    # not block shutdown; translog replay covers the gap
+                    pass
+        return {
+            "draining": True,
+            "drained": drained,
+            "queued_shed": shed,
+            "in_flight_remaining": sum(
+                svc.admission.in_flight for svc in self.indices.values()),
+            "took_ms": int((time.monotonic() - t0) * 1000),
+        }
+
+    def undrain(self) -> dict:
+        """Abort a drain (rollout cancelled): indices admit again."""
+        self._draining = False
+        for svc in self.indices.values():
+            svc.admission.end_drain()
+        return {"draining": False}
+
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
         self._reaper_stop.set()
+        # shutdown ordering (ISSUE 14): FIRST stop admitting and shed
+        # the admission queues (queued entries get the clean rejection
+        # contract, not a silent drop), drain in-flight searches within
+        # the deadline, and stamp synced-flush markers — all BEFORE the
+        # thread pool goes down, so no queued work is stranded behind a
+        # dead executor and no index closes under an in-flight search
+        self.drain()
         self.thread_pool.shutdown()
         from elasticsearch_tpu.transport.remote_cluster import unregister_node
 
@@ -2013,9 +2135,6 @@ class Node:
         self.plugins_service.close()
         self.snapshots.close()
         for name in list(self.indices):
-            if self.persistent_path:
-                self._persist_index_meta(name)
-                self.indices[name].flush()
             self.indices[name].close()
 
 

@@ -544,7 +544,14 @@ def _mesh_query_program(mesh: Mesh, holder: _TemplateHolder, k: int,
         merged = tuple(o[0] for o in outs[:n_merged])
         return merged + tuple(outs[n_merged:])
 
-    return run
+    from elasticsearch_tpu.common.compile_cache import (
+        instrument_program,
+        variant_key,
+    )
+
+    return instrument_program(
+        run, "serial",
+        variant_key("serial", holder._key, len(mesh.devices)))
 
 
 def _shapes_sig(arrays) -> str:
@@ -617,7 +624,15 @@ def _mesh_batched_kernel_program(mesh: Mesh, spd: int, q_batch: int,
         outs = mapped(*args)
         return tuple(o[0] for o in outs)  # replicated: row 0 == row i
 
-    return run
+    from elasticsearch_tpu.common.compile_cache import (
+        instrument_program,
+        variant_key,
+    )
+
+    return instrument_program(
+        run, "batched",
+        variant_key("batched", len(mesh.devices), spd, q_batch, kk,
+                    t_pad, cb, sub, tps, interpret, codec))
 
 
 @functools.lru_cache(maxsize=32)
@@ -722,7 +737,16 @@ def _mesh_batched_dense_agg_program(mesh: Mesh, spd: int, q_batch: int,
         # merged outputs replicated; agg partials stay sharded per slot
         return tuple(o[0] for o in outs[:4]) + tuple(outs[4:])
 
-    return run
+    from elasticsearch_tpu.common.compile_cache import (
+        instrument_program,
+        variant_key,
+    )
+
+    return instrument_program(
+        run, "batched_agg",
+        variant_key("batched_agg", len(mesh.devices), spd, q_batch, kk,
+                    t_pad, cb, sub, tps, interpret, codec, agg_statics,
+                    nd1))
 
 
 @functools.lru_cache(maxsize=32)
@@ -852,7 +876,15 @@ def _mesh_batched_pruned_program(mesh: Mesh, spd: int, q_batch: int,
         outs = mapped(*args)
         return tuple(o[0] for o in outs)  # replicated: row 0 == row i
 
-    return run
+    from elasticsearch_tpu.common.compile_cache import (
+        instrument_program,
+        variant_key,
+    )
+
+    return instrument_program(
+        run, "pruned",
+        variant_key("pruned", len(mesh.devices), spd, q_batch, kk, t_pad,
+                    cb, sub, tps, interpret, codec, probe, n_rest))
 
 
 @functools.lru_cache(maxsize=32)
@@ -914,7 +946,27 @@ def _mesh_knn_program(mesh: Mesh, spd: int, q_pad: int, kk: int,
         outs = mapped(*args)
         return tuple(o[0] for o in outs)  # replicated: row 0 == row i
 
-    return run
+    from elasticsearch_tpu.common.compile_cache import (
+        instrument_program,
+        variant_key,
+    )
+
+    return instrument_program(
+        run, "knn",
+        variant_key("knn", len(mesh.devices), spd, q_pad, kk, sub,
+                    d_pad, nd_knn, interpret))
+
+
+def clear_compiled_programs() -> None:
+    """Drop every cached compiled-program entry (all five lru_cache'd
+    mesh-program builders). Used by the rolling-restart soak and the
+    cold_start bench to simulate a fresh process: the next query (or
+    warm replay) re-traces and re-compiles — against the persistent
+    compilation cache when one is configured."""
+    for builder in (_mesh_query_program, _mesh_batched_kernel_program,
+                    _mesh_batched_dense_agg_program,
+                    _mesh_batched_pruned_program, _mesh_knn_program):
+        builder.cache_clear()
 
 
 class IndexMeshSearch:

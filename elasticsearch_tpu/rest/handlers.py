@@ -112,6 +112,11 @@ def register_all(c) -> None:
     r("PUT", "/{index}/_shrink/{target}", _shrink)
     r("GET", "/_nodes/hot_threads", lambda n, q: (200, n.hot_threads()))
     r("GET", "/_nodes/{node_id}/hot_threads", lambda n, q: (200, n.hot_threads()))
+    # zero-downtime rollout (ISSUE 14, docs/RESILIENCE.md "Rollout &
+    # drain"): enter/abort the draining state — the operator's (or the
+    # orchestrator's preStop hook's) API for a graceful restart
+    r("POST", "/_nodes/_local/_drain", lambda n, q: (200, n.drain()))
+    r("DELETE", "/_nodes/_local/_drain", lambda n, q: (200, n.undrain()))
 
     # --- reindex family ---
     r("POST", "/_reindex", _reindex)

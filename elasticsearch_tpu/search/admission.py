@@ -49,7 +49,10 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from elasticsearch_tpu.common.errors import EsRejectedExecutionException
+from elasticsearch_tpu.common.errors import (
+    EsRejectedExecutionException,
+    NodeDrainingException,
+)
 
 # tenant bucket for requests without an X-Opaque-Id header
 DEFAULT_TENANT = "_anonymous"
@@ -117,6 +120,20 @@ def rejection(index_name: str, capacity: int, queued: int,
     return exc
 
 
+def drain_rejection(index_name: str,
+                    retry_after_s: float) -> NodeDrainingException:
+    """The graceful-drain 503 (ISSUE 14, docs/RESILIENCE.md "Rollout &
+    drain"): the node is restarting — route around it and retry after
+    the drain deadline. ``retry_after_s`` rides as an attribute and the
+    REST layer renders the ``Retry-After`` header, exactly like the
+    429 rejections."""
+    exc = NodeDrainingException(
+        f"rejected execution of search request on [{index_name}]: "
+        f"node is draining for shutdown/rollout")
+    exc.retry_after_s = float(retry_after_s)
+    return exc
+
+
 class SearchAdmissionController:
     """Bounded admission queue + DRR fairness + brownout ladder for one
     index's query path.
@@ -127,6 +144,7 @@ class SearchAdmissionController:
     explicitness contract as search.pallas.pruning.*)."""
 
     _OVERRIDE_PREFIXES = ("search.queue.", "search.admission.",
+                          "search.drain.",
                           "search.batch.max_window_ms")
 
     def __init__(self, index_name: str, settings=None):
@@ -135,6 +153,13 @@ class SearchAdmissionController:
         self._overrides = None  # Settings of explicit cluster values
         self._lock = threading.Lock()
         self._shut = False
+        # graceful drain (ISSUE 14): while True, new acquires get the
+        # clean 503 + Retry-After and queued entries were shed; in-flight
+        # queries finish (await_drained) before the node flushes/closes
+        self._draining = False
+        self.drain_rejected_total = 0
+        # signaled whenever in_flight reaches 0 (the drain waiter's cue)
+        self._idle = threading.Condition(self._lock)
         # per-tenant FIFO queues + the weighted-round-robin cursor
         self._queues: Dict[str, deque] = {}
         self._rr_order: List[str] = []
@@ -365,15 +390,40 @@ class SearchAdmissionController:
         queued — the caller serves the partial timed-out response
         WITHOUT executing), or raises the 429 rejection on overflow.
         Every call must be paired with ``release`` via try/finally."""
-        if not self._enabled() or _IN_ADMITTED_QUERY.get():
+        if _IN_ADMITTED_QUERY.get():
             return AdmissionToken(DEFAULT_TENANT, noop=True)
         if tenant is None:
             from elasticsearch_tpu.search.telemetry import get_opaque_id
 
             tenant = get_opaque_id() or DEFAULT_TENANT
+        if self._draining:
+            # rollout drain (docs/RESILIENCE.md): stop admitting — the
+            # clean 503 + Retry-After, counted into the exact
+            # admitted/rejected/expired partition (rejected side).
+            # Checked BEFORE the enabled kill switch: disabling
+            # admission must not void the drain contract (with the
+            # switch off, in-flight work is untracked and await_drained
+            # cannot wait for it — but new arrivals still get the 503)
+            with self._lock:
+                if self._draining:
+                    self.rejected_total += 1
+                    self.drain_rejected_total += 1
+                    self._tenant_bucket(tenant)["rejected_total"] += 1
+                    raise drain_rejection(self.index_name,
+                                          self._drain_deadline_s())
+        if not self._enabled():
+            return AdmissionToken(DEFAULT_TENANT, noop=True)
         occupancy, blocked, _delay = self._synthetic_pressure()
         entry = None
         with self._lock:
+            if self._draining:
+                # re-check under the lock: a drain may have begun
+                # between the fast check above and here
+                self.rejected_total += 1
+                self.drain_rejected_total += 1
+                self._tenant_bucket(tenant)["rejected_total"] += 1
+                raise drain_rejection(self.index_name,
+                                      self._drain_deadline_s())
             limit = max(0, self._max_concurrent() - blocked)
             self._update_level_locked(occupancy)
             # opportunistic drain: queued entries stranded by a since-
@@ -438,7 +488,13 @@ class SearchAdmissionController:
                                            steps=self._steps)
                     token._cv_token = _IN_ADMITTED_QUERY.set(1)
                     return token
-                if entry.state in ("shed", "closed", "displaced"):
+                if entry.state in ("shed", "closed", "displaced",
+                                   "draining"):
+                    if entry.state == "draining":
+                        # the node began draining while this entry was
+                        # queued: its clean 503 (counted by begin_drain)
+                        raise drain_rejection(self.index_name,
+                                              self._drain_deadline_s())
                     if entry.state in ("closed", "displaced"):
                         # displacement/shutdown: this entry's clean 429
                         # (already counted by the displacer)
@@ -587,6 +643,8 @@ class SearchAdmissionController:
             self._completions.append(time.monotonic())
             self._dequeue_locked(blocked)
             self._update_level_locked(occupancy)
+            if self.in_flight == 0:
+                self._idle.notify_all()  # drain waiters (await_drained)
 
     def refresh_level(self) -> int:
         """Recompute the brownout level from current pressure (queued +
@@ -597,6 +655,61 @@ class SearchAdmissionController:
             count_hit=False)
         with self._lock:
             return self._update_level_locked(occupancy)
+
+    # -- graceful drain (ISSUE 14, docs/RESILIENCE.md) ------------------
+
+    def _drain_deadline_s(self) -> float:
+        v = self._cfg("get_time", "search.drain.deadline", 30.0)
+        return float(v) if v is not None else 30.0
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> int:
+        """Enter the draining state: new acquires get the clean 503 +
+        Retry-After, every QUEUED entry is shed with the same contract
+        (counted — no silent drops), and in-flight queries keep their
+        slots until they finish (``await_drained``). Returns how many
+        queued entries were shed. Idempotent."""
+        with self._lock:
+            if self._draining:
+                return 0
+            self._draining = True
+            shed = 0
+            for q in self._queues.values():
+                for entry in q:
+                    entry.state = "draining"
+                    self.rejected_total += 1
+                    self.drain_rejected_total += 1
+                    self._tenant_bucket(entry.tenant)["rejected_total"] += 1
+                    entry.event.set()
+                    shed += 1
+            self._queues.clear()
+            self._rr_order = []
+            self._queued_total = 0
+            for b in self._tenants.values():
+                b["queued"] = 0
+            return shed
+
+    def await_drained(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until every in-flight search released its slot (True)
+        or the drain deadline passed (False — the caller proceeds with
+        shutdown anyway; stragglers fail their shard the normal way)."""
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self._drain_deadline_s())
+        with self._idle:
+            while self.in_flight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+            return True
+
+    def end_drain(self) -> None:
+        """Cancel a drain (rollout aborted): the node admits again."""
+        with self._lock:
+            self._draining = False
 
     def shutdown(self) -> None:
         """Index close: wake every queued waiter with a clean rejection
@@ -632,6 +745,8 @@ class SearchAdmissionController:
                 "admitted_total": self.admitted_total,
                 "rejected_total": self.rejected_total,
                 "expired_in_queue_total": self.expired_in_queue_total,
+                "draining": self._draining,
+                "drain_rejected_total": self.drain_rejected_total,
                 "brownout_level": self._level,
                 "brownout": {f"{step}_total": n for step, n
                              in self.brownout_counts.items()},

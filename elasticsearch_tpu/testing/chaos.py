@@ -669,3 +669,467 @@ class ChaosSoak:
                     close()
                 except Exception:  # noqa: BLE001 — teardown best-effort
                     pass
+
+
+class RollingRestartSoak:
+    """Zero-downtime rollout soak (ISSUE 14, docs/RESILIENCE.md
+    "Rollout & drain"): restart must be a measured non-event. Four
+    phases, each seeded and deterministic:
+
+    1. **drain** — a Node under concurrent slow searches drains:
+       in-flight searches finish inside the deadline, new arrivals get
+       the clean 503 + Retry-After (never a 5xx), queued entries are
+       shed with exact counters, and the shutdown stamps synced-flush
+       markers.
+    2. **warm restart** — the drained node restarts over the same data
+       path: `_cat/recovery` shows ZERO translog ops replayed (the
+       synced-flush contract) and search results are byte-identical
+       (ids AND scores) on the restored planes.
+    3. **rolling cluster restart** — every node of a replicated
+       multinode cluster rolls (graceful leave → close → restart →
+       rejoin → recover) under concurrent zipfian search + bulk
+       ingest: no acked-write loss, zero non-429/503 errors, the
+       departing node's primaries promote on the leave publish (not
+       the FD timeout), and post-roll hits are byte-identical to an
+       undisrupted oracle.
+    4. **compile-warm restart** — with the persistent compilation
+       cache + variant registry active, a simulated process restart
+       (compiled-program caches dropped, registry reloaded from disk)
+       warms the recorded lattice off the query path: the post-restart
+       query set pays ZERO query-path first compiles, and the
+       device-memory ledger returns byte-exactly to its pre-restart
+       per-kind snapshot.
+    """
+
+    def __init__(self, data_root: str, seed: int = 0, nodes: int = 3,
+                 shards: int = 2, seed_docs: int = 24,
+                 docs_per_roll: int = 8, searches_per_roll: int = 6,
+                 drain_searches: int = 4, index: str = "roll"):
+        self.data_root = data_root
+        self.seed = int(seed)
+        self.n_nodes = int(nodes)
+        self.shards = int(shards)
+        self.seed_docs = int(seed_docs)
+        self.docs_per_roll = int(docs_per_roll)
+        self.searches_per_roll = int(searches_per_roll)
+        self.drain_searches = int(drain_searches)
+        self.index = index
+        self.vocab = [f"w{i}" for i in range(12)]
+
+    # -- shared helpers --------------------------------------------------
+
+    def _zipf_term(self, rng: np.random.RandomState) -> int:
+        return min(int(rng.zipf(1.4)) - 1, len(self.vocab) - 1)
+
+    def _doc(self, rng: np.random.RandomState, d: int) -> dict:
+        toks = [self.vocab[self._zipf_term(rng)]
+                for _ in range(3 + int(rng.randint(4)))]
+        return {"body": " ".join(toks), "n": int(d)}
+
+    @staticmethod
+    def _hits_key(resp) -> list:
+        return [(h["_id"], h["_score"], tuple(h.get("sort") or ()))
+                for h in resp["hits"]["hits"]]
+
+    # -- phase 1+2: drain + warm restart of a single node ----------------
+
+    def run_drain_and_warm_restart(self) -> dict:
+        import os
+
+        from elasticsearch_tpu.common.errors import NodeDrainingException
+        from elasticsearch_tpu.cluster.multinode import (
+            clear_recovery_progress,
+            recovery_progress_rows,
+        )
+        from elasticsearch_tpu.node import Node
+
+        clear_recovery_progress()
+        report: dict = {"in_flight_ok": 0, "drain_rejects": 0,
+                        "errors": []}
+        rng = np.random.RandomState(self.seed)
+        path = os.path.join(self.data_root, "drain_node")
+        node = Node(Settings({
+            "search.drain.deadline": "10s",
+        }), data_path=path)
+        node.create_index(self.index, {"settings": {
+            "index.number_of_shards": self.shards,
+            "index.refresh_interval": -1}})
+        for d in range(self.seed_docs):
+            node.index_doc(self.index, str(d), self._doc(rng, d))
+        node.indices[self.index].refresh()
+        probe = {"query": {"match": {"body": self.vocab[0]}}, "size": 10}
+        want = self._hits_key(node.search(self.index, dict(probe)))
+
+        # concurrent slow searches in flight while the drain begins
+        slow = dis.SearchDelayScheme(0.05, indices=[self.index]).install()
+        started = threading.Barrier(self.drain_searches + 1)
+
+        def searcher():
+            try:
+                started.wait(timeout=5)
+                r = node.search(self.index, dict(probe))
+                if r["_shards"]["failed"]:
+                    report["errors"].append(f"failed shards {r['_shards']}")
+                else:
+                    report["in_flight_ok"] += 1
+            except NodeDrainingException:
+                # admitted-before-drain is not guaranteed for every
+                # thread — a clean 503 is the other legal outcome
+                report["drain_rejects"] += 1
+            except Exception as e:  # noqa: BLE001 — anything else is the bug
+                report["errors"].append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=searcher)
+                   for _ in range(self.drain_searches)]
+        for t in threads:
+            t.start()
+        started.wait(timeout=5)
+        time.sleep(0.01)  # let the searchers acquire their slots
+        drain = node.drain()
+        for t in threads:
+            t.join()
+        slow.remove()
+        report["drain"] = drain
+        if not drain["drained"] or drain["in_flight_remaining"]:
+            raise ChaosSoakViolation(
+                f"drain did not quiesce in-flight work: {drain}")
+        if report["errors"]:
+            raise ChaosSoakViolation(
+                f"drain leaked non-503 errors: {report['errors'][:4]}")
+        # draining node refuses new work with the clean 503 + Retry-After
+        try:
+            node.search(self.index, dict(probe))
+            raise ChaosSoakViolation("draining node admitted a search")
+        except NodeDrainingException as e:
+            if getattr(e, "retry_after_s", None) is None:
+                raise ChaosSoakViolation("drain 503 without Retry-After")
+            report["drain_rejects"] += 1
+        adm = node.indices[self.index].admission.stats_dict()
+        if not adm["draining"] or adm["drain_rejected_total"] < 1:
+            raise ChaosSoakViolation(f"drain state not exported: {adm}")
+        node.close()
+
+        # warm restart over the same data path: ops-free + byte-identical
+        node2 = Node(Settings({"index.refresh_interval": "-1"}),
+                     data_path=path)
+        try:
+            rows = [r for r in recovery_progress_rows()
+                    if r["index"] == self.index and r["type"] == "store"]
+            if not rows:
+                raise ChaosSoakViolation("no store-recovery rows recorded")
+            replayed = sum(r["ops_recovered"] for r in rows)
+            if replayed:
+                raise ChaosSoakViolation(
+                    f"warm restart replayed {replayed} translog ops "
+                    f"despite the synced flush (rows: {rows})")
+            got = self._hits_key(node2.search(self.index, dict(probe)))
+            if got != want:
+                raise ChaosSoakViolation(
+                    f"restart changed results: {got} != {want}")
+            for sid, shard in node2.indices[self.index].shards.items():
+                if shard.engine.last_sync_id is None:
+                    raise ChaosSoakViolation(
+                        f"shard {sid} lost its synced-flush marker")
+            report["ops_replayed"] = replayed
+            report["restart_hits_identical"] = True
+        finally:
+            node2.close()
+        return report
+
+    # -- phase 3: rolling restart of a replicated cluster ----------------
+
+    def run_rolling_cluster(self) -> dict:
+        import os
+
+        from elasticsearch_tpu.cluster.multinode import (
+            ClusterClient,
+            ClusterNode,
+        )
+        from elasticsearch_tpu.index.index_service import IndexService
+        from elasticsearch_tpu.transport.local import TransportHub
+
+        rng = np.random.RandomState(self.seed + 1)
+        hub = TransportHub()
+        names = [f"roll{i}" for i in range(self.n_nodes)]
+        mk = lambda n: ClusterNode(  # noqa: E731
+            n, hub, settings=_CLUSTER_SETTINGS,
+            data_path=os.path.join(self.data_root, "cluster", n))
+        nodes = {n: mk(n) for n in names}
+        nodes[names[0]].bootstrap_cluster()
+        for n in names[1:]:
+            nodes[n].join(names[0])
+        idx = self.index + "_c"
+        nodes[names[0]].create_index(idx, {
+            "index": {"number_of_shards": self.shards,
+                      "number_of_replicas": 1}},
+            {"properties": {"body": {"type": "text",
+                                     "analyzer": "whitespace"},
+                            "n": {"type": "integer"}}})
+        self._wait_all_started(nodes, idx)
+        # undisrupted oracle: same shard count => same routing + stats
+        oracle = IndexService(idx + "_oracle", Settings({
+            "index.number_of_shards": self.shards,
+            "index.refresh_interval": -1}),
+            mapping={"properties": {
+                "body": {"type": "text", "analyzer": "whitespace"},
+                "n": {"type": "integer"}}})
+        report: dict = {"acked": 0, "rolls": [], "errors": [],
+                        "searches_during_roll": 0,
+                        "write_retries": 0}
+        acked: List[str] = []
+
+        def write(client, doc_id: str, doc: dict) -> None:
+            last = None
+            for attempt in range(8):
+                try:
+                    client.index(idx, doc_id, doc)
+                    acked.append(doc_id)
+                    oracle.index_doc(doc_id, doc)
+                    report["acked"] += 1
+                    if attempt:
+                        report["write_retries"] += attempt
+                    return
+                except Exception as e:  # noqa: BLE001 — roll in progress
+                    last = e
+                    time.sleep(0.05)
+            raise ChaosSoakViolation(
+                f"write [{doc_id}] never acked through the roll: {last}")
+
+        try:
+            doc_id = 0
+            client0 = ClusterClient(nodes[names[0]])
+            for _ in range(self.seed_docs):
+                write(client0, str(doc_id), self._doc(rng, doc_id))
+                doc_id += 1
+            for victim in list(names):
+                survivor = next(n for n in names if n != victim)
+                client = ClusterClient(nodes[survivor])
+                stop = threading.Event()
+                errors: List[str] = []
+                searched = [0]
+
+                def load(client=client):
+                    q_rng = np.random.RandomState(self.seed + 7)
+                    while not stop.is_set():
+                        body = {"query": {"match": {
+                            "body": self.vocab[self._zipf_term(q_rng)]}},
+                            "size": 5}
+                        try:
+                            r = client.search(idx, body)
+                            # degraded-but-clean is legal mid-roll; a
+                            # RAISE that is not a 429/503 is the bug
+                            _ = r["hits"]["total"]
+                            searched[0] += 1
+                        except Exception as e:  # noqa: BLE001
+                            status = getattr(e, "status_code", 500)
+                            if status not in (429, 503):
+                                errors.append(
+                                    f"{type(e).__name__}: {e}")
+                        time.sleep(0.005)
+
+                loader = threading.Thread(target=load)
+                loader.start()
+                t0 = time.monotonic()
+                try:
+                    # a few writes through the survivor DURING the roll
+                    nodes[victim].close(graceful=True)
+                    master = next(n for n in names
+                                  if n != victim
+                                  and nodes[n].master_id is not None)
+                    if victim in nodes[master].known_nodes:
+                        raise ChaosSoakViolation(
+                            f"[{victim}] still in known_nodes after a "
+                            f"graceful leave")
+                    self._assert_primaries_available(
+                        nodes, idx, exclude=victim)
+                    for _ in range(self.docs_per_roll):
+                        write(client, str(doc_id),
+                              self._doc(rng, doc_id))
+                        doc_id += 1
+                    # restart over the same data path and rejoin
+                    nodes[victim] = mk(victim)
+                    nodes[victim].join(survivor)
+                    self._wait_all_started(nodes, idx)
+                finally:
+                    stop.set()
+                    loader.join()
+                if errors:
+                    raise ChaosSoakViolation(
+                        f"roll of [{victim}] leaked non-429/503 errors: "
+                        f"{errors[:4]}")
+                report["searches_during_roll"] += searched[0]
+                report["rolls"].append({
+                    "node": victim, "took_ms":
+                        int((time.monotonic() - t0) * 1000)})
+            # barrier: all writes acked — verify totals + byte-identity
+            client = ClusterClient(nodes[names[0]])
+            client.refresh(idx)
+            oracle.refresh()
+            res = client.search(idx, {"query": {"match_all": {}},
+                                      "size": 0})
+            if res["hits"]["total"] != len(acked):
+                raise ChaosSoakViolation(
+                    f"acked-write loss through the roll: "
+                    f"{res['hits']['total']} != {len(acked)}")
+            # deterministic ordered query: byte-identical sort values,
+            # ids, and order vs the oracle (scores are None both sides)
+            body = {"query": {"match_all": {}},
+                    "sort": [{"n": "asc"}], "size": 20}
+            got = self._hits_key(client.search(idx, dict(body)))
+            want = self._hits_key(oracle.search(dict(body)))
+            if got != want:
+                raise ChaosSoakViolation(
+                    f"post-roll hits diverged from the oracle:\n got: "
+                    f"{got}\nwant: {want}")
+            report["hits_identical"] = True
+            return report
+        finally:
+            for n in nodes.values():
+                try:
+                    n.close(graceful=False)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            oracle.close()
+
+    def _wait_all_started(self, nodes, idx, attempts: int = 100) -> None:
+        from elasticsearch_tpu.cluster.state import ShardRoutingState
+
+        for _ in range(attempts):
+            master = next((n for n in nodes.values() if n.is_master), None)
+            if master is not None:
+                try:
+                    master.reroute()
+                except Exception:  # noqa: BLE001 — mid-roll churn
+                    pass
+                routing = master.routing.get(idx, {})
+                copies = [c for cs in routing.values() for c in cs]
+                if copies and all(c.state == ShardRoutingState.STARTED
+                                  for c in copies):
+                    return
+            time.sleep(0.05)
+        raise ChaosSoakViolation(
+            f"cluster copies of [{idx}] never all reached STARTED")
+
+    def _assert_primaries_available(self, nodes, idx, exclude) -> None:
+        master = next((n for name, n in nodes.items()
+                       if name != exclude and n.is_master), None)
+        if master is None:
+            raise ChaosSoakViolation("no master after a graceful leave")
+        for sid, copies in master.routing.get(idx, {}).items():
+            primary = next((c for c in copies if c.primary), None)
+            if primary is None or primary.node_id == exclude:
+                raise ChaosSoakViolation(
+                    f"shard [{sid}] has no promoted primary after the "
+                    f"leave (copies: {copies})")
+
+    # -- phase 4: compile-cache warm restart -----------------------------
+
+    def run_compile_warm_restart(self) -> dict:
+        import os
+
+        from elasticsearch_tpu.common import compile_cache as cc
+        from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.index.index_service import IndexService
+        from elasticsearch_tpu.parallel.plan_exec import (
+            clear_compiled_programs,
+        )
+
+        rng = np.random.RandomState(self.seed + 2)
+        idx = self.index + "_warm"
+        data_path = os.path.join(self.data_root, "warm_index")
+        prev_registry = cc.variant_registry()
+        cc.configure_compile_cache(
+            os.path.join(self.data_root, "jax_cache"))
+        registry_path = os.path.join(self.data_root,
+                                     "compile_variants.json")
+        cc.set_variant_registry(cc.VariantRegistry(registry_path))
+        settings = Settings({
+            "index.number_of_shards": self.shards,
+            "index.search.mesh": True,
+            "index.search.mesh.plane": "pallas",
+            "index.refresh_interval": -1,
+        })
+        mapping = {"properties": {
+            "body": {"type": "text", "analyzer": "whitespace"},
+            "n": {"type": "integer"}}}
+
+        def mk():
+            return IndexService(idx, settings, mapping=mapping,
+                                data_path=data_path)
+
+        queries = [
+            {"query": {"match": {"body": self.vocab[0]}}, "size": 10},
+            {"query": {"match": {"body": f"{self.vocab[1]} "
+                                         f"{self.vocab[2]}"}}, "size": 5},
+        ]
+        svc = mk()
+        for d in range(self.seed_docs):
+            svc.index_doc(str(d), self._doc(rng, d))
+        svc.refresh()
+        svc.flush()
+        want = [self._hits_key(svc.search(dict(q))) for q in queries]
+        plane = svc.search(dict(queries[0]))["_plane"]
+        if plane not in ("mesh_pallas", "mesh"):
+            raise ChaosSoakViolation(
+                f"compile-warm phase needs the mesh plane, got {plane}")
+        if not cc.variant_registry().warm_entries(idx):
+            raise ChaosSoakViolation(
+                "mesh-served queries recorded no warmable variants")
+        ledger_before = memory_accountant().staged_bytes_by_kind(idx)
+        svc.close()
+
+        # simulated process restart: compiled programs gone, registry
+        # reloaded from disk (preexisting => hits), same data path
+        clear_compiled_programs()
+        cc.set_variant_registry(cc.VariantRegistry(registry_path))
+        svc2 = mk()
+        try:
+            stats_pre = cc.compile_stats().stats()
+            warmed = svc2.warm_compile_variants()
+            if warmed < 1:
+                raise ChaosSoakViolation("warm replay covered nothing")
+            stats0 = cc.compile_stats().stats()
+            got = [self._hits_key(svc2.search(dict(q))) for q in queries]
+            stats1 = cc.compile_stats().stats()
+            delta = (stats1["query_path_first_compile_total"]
+                     - stats0["query_path_first_compile_total"])
+            if delta:
+                raise ChaosSoakViolation(
+                    f"warmed restart paid {delta} query-path first "
+                    f"compiles (events: "
+                    f"{stats1['first_compile_events'][-4:]})")
+            if got != want:
+                raise ChaosSoakViolation(
+                    f"warmed restart changed results: {got} != {want}")
+            ledger_after = memory_accountant().staged_bytes_by_kind(idx)
+            if ledger_after != ledger_before:
+                raise ChaosSoakViolation(
+                    f"ledger not restored after the warmed restart:\n "
+                    f"before={ledger_before}\n after={ledger_after}")
+            return {
+                "warm_specs_replayed": warmed,
+                "programs_warmed": stats1["programs_warmed_total"]
+                    - stats_pre["programs_warmed_total"],
+                "cache_hits": stats1["compile_cache_hit_total"],
+                "query_path_first_compiles": delta,
+                "hits_identical": True,
+                "ledger_restored": True,
+            }
+        finally:
+            svc2.close()
+            # restore process-global compile-plane state: the soak's
+            # data_root (and the jax cache dir inside it) may be a
+            # temporary directory the caller deletes
+            cc.configure_compile_cache(None)
+            cc.set_variant_registry(prev_registry)
+
+    # -- the whole soak --------------------------------------------------
+
+    def run(self) -> dict:
+        report = {
+            "seed": self.seed,
+            "drain": self.run_drain_and_warm_restart(),
+            "cluster": self.run_rolling_cluster(),
+            "compile": self.run_compile_warm_restart(),
+        }
+        return report

@@ -153,6 +153,26 @@ class TestObservabilityRegistryLint:
                        "field_ineligible", "resolve_error"):
             assert reason in doc, f"fallback reason [{reason}] undocumented"
 
+    def test_compile_block_exported_and_documented(self, exercised_index):
+        # ISSUE 14 (docs/RESILIENCE.md "Rollout & drain"): the compile
+        # plane's counters — persistent-cache hit/miss, warmed
+        # programs, query-path first compiles, the stall histogram —
+        # are part of the documented operator surface, as are the
+        # admission drain keys
+        doc = _doc_text()
+        comp = exercised_index.search_stats()["compile"]
+        for key in ("cache_enabled", "cache_path", "variants_recorded",
+                    "compile_cache_hit_total", "compile_cache_miss_total",
+                    "programs_warmed_total",
+                    "query_path_first_compile_total",
+                    "first_compile_stall_ms", "first_compile_events"):
+            assert key in comp, comp.keys()
+            assert key in doc, f"[{key}] undocumented"
+        adm = exercised_index.search_stats()["admission"]
+        for key in ("draining", "drain_rejected_total"):
+            assert key in adm, adm.keys()
+            assert key in doc, f"[{key}] undocumented"
+
     def test_lint_catches_undocumented_key(self):
         doc = _doc_text()
         keys: set = set()

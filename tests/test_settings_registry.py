@@ -116,6 +116,33 @@ class TestSettingsRegistryLint:
             assert registry.is_registered(key), key
             assert registry.is_dynamic(key), f"[{key}] must be dynamic"
 
+    def test_rollout_settings_registered(self):
+        # ISSUE 14 (docs/RESILIENCE.md "Rollout & drain"): the compile
+        # cache is startup-only (XLA's cache must configure before the
+        # first compile), warming is a boot decision, and the drain
+        # deadline is dynamic — an operator mid-rollout must be able to
+        # stretch it via PUT _cluster/settings
+        registry = cluster_settings()
+        for key in ("search.compile.cache_path",
+                    "search.compile.warm_on_start",
+                    "search.drain.deadline"):
+            assert registry.is_registered(key), key
+        assert registry.is_dynamic("search.drain.deadline")
+
+    def test_drain_deadline_seeded_by_create_index(self):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.node import Node
+
+        node = Node(Settings({"cluster.name": "lint-drain",
+                              "search.drain.deadline": "7s"}))
+        try:
+            node.create_index("drainseed", {"settings": {
+                "number_of_shards": 1}})
+            adm = node.indices["drainseed"].admission
+            assert adm._drain_deadline_s() == 7.0
+        finally:
+            node.close()
+
     def test_overload_settings_seeded_by_create_index(self):
         # the admission controller reads its config from the index's
         # Settings map: node-file values must reach indices created
