@@ -147,7 +147,9 @@ def run_staged(fn, *, index: str, kind: str, plane: str = "host",
     class) is recorded on the accountant — the CALLER owns rollback of
     any partially-published arrays and the ladder/quarantine decision —
     and re-raised."""
+    from elasticsearch_tpu.common.errors import TaskCancelledException
     from elasticsearch_tpu.common.memory import memory_accountant
+    from elasticsearch_tpu.search.cancellation import TimeExceededException
 
     max_attempts, backoff_ms = retry or staging_retry_config(settings)
     acct = memory_accountant()
@@ -157,6 +159,12 @@ def run_staged(fn, *, index: str, kind: str, plane: str = "host",
             return fn()
         except StagingBail:
             raise  # structural inability: the caller's contract, not ours
+        except (TaskCancelledException, TimeExceededException):
+            # cancellation-passthrough contract (tested by the contract
+            # lint): a cancelled/timed-out attempt is the CALLER's clean
+            # partial/cancel path — recording it as a device fault would
+            # retry a dead query and bench a healthy plane
+            raise
         except Exception as e:  # noqa: BLE001 — classified below;
             # non-Exception BaseExceptions (KeyboardInterrupt) pass
             cls = classify_staging_fault(e)

@@ -278,7 +278,8 @@ class IndexService:
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None,
                 realtime: bool = True):
-        self._get_total += 1
+        with self._stats_lock:
+            self._get_total += 1
         shard = self.shards[self._route(doc_id, routing)]
         return shard.get_doc(doc_id, realtime=realtime)
 
@@ -358,12 +359,14 @@ class IndexService:
         return self.index_doc(doc_id, new_source, routing)
 
     def refresh(self) -> None:
-        self._refresh_total += 1
+        with self._stats_lock:
+            self._refresh_total += 1
         for shard in self.shards.values():
             shard.refresh()
 
     def flush(self) -> None:
-        self._flush_total += 1
+        with self._stats_lock:
+            self._flush_total += 1
         for shard in self.shards.values():
             shard.flush()
 
@@ -372,7 +375,8 @@ class IndexService:
         drain; the reference's _flush/synced): after it a warm restart
         over the same data path recovers ops-free. Returns
         {shard_id: sync_id}."""
-        self._flush_total += 1
+        with self._stats_lock:
+            self._flush_total += 1
         return {sid: shard.synced_flush()
                 for sid, shard in self.shards.items()}
 
@@ -1278,7 +1282,7 @@ class IndexService:
         ladder). Fills ``results`` in place."""
         from elasticsearch_tpu.search.batching import knn_batch_spec
 
-        from elasticsearch_tpu.search.telemetry import set_opaque_id
+        from elasticsearch_tpu.search.telemetry import scoped_opaque_id
 
         if tracers is None:
             tracers = [None] * len(bodies)
@@ -1309,20 +1313,24 @@ class IndexService:
                 specs, ks,
                 stats=[norm_bodies[i].get("stats") for i in knn_live],
                 tracers=[tracers[i] for i in knn_live])
+        # scoped stamps (PR-15 contract-lint fix): the bare set_opaque_id
+        # shape left the LAST member's id in the leader's context on both
+        # exit paths, mis-attributing its later slowlog/profile lines
         if mesh_out is not None:
             for j, i in enumerate(knn_live):
-                set_opaque_id(oids[i])
-                try:
-                    results[i] = self._mesh_batch_response(
-                        norm_bodies[i], mesh_out[j], tracer=tracers[i])
-                except Exception as e:  # noqa: BLE001 — per-member fetch
-                    results[i] = e
+                with scoped_opaque_id(oids[i]):
+                    try:
+                        results[i] = self._mesh_batch_response(
+                            norm_bodies[i], mesh_out[j],
+                            tracer=tracers[i])
+                    except Exception as e:  # noqa: BLE001 — per-member
+                        results[i] = e  # fetch isolation
             self.batch_stats.note_batch(len(knn_live))
             return
         for i in knn_live:
-            set_opaque_id(oids[i])
-            results[i] = self._batch_member_single(bodies[i], deadlines[i],
-                                                   tracer=tracers[i])
+            with scoped_opaque_id(oids[i]):
+                results[i] = self._batch_member_single(
+                    bodies[i], deadlines[i], tracer=tracers[i])
 
     def _batch_member_single(self, body, deadline, score_caches=None,
                              skip_mesh=False, tracer=None):

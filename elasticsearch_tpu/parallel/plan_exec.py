@@ -1219,6 +1219,11 @@ class IndexMeshSearch:
                 # updates via configure_staging_retry) — NOT the index's
                 # create-time Settings snapshot, which would freeze it
                 # against later dynamic updates.
+                from elasticsearch_tpu.common.errors import \
+                    TaskCancelledException
+                from elasticsearch_tpu.search.cancellation import \
+                    TimeExceededException
+
                 try:
                     staged = run_staged(
                         lambda: MeshPlanExecutor(
@@ -1228,6 +1233,9 @@ class IndexMeshSearch:
                             stage_reason=reason),
                         index=self.svc.name, kind="mesh_slot_tables",
                         plane="mesh")
+                except (TaskCancelledException, TimeExceededException):
+                    raise  # PR-4 contract: caller owns partial/cancel —
+                    # never bench the staging for a dead query
                 except Exception:  # noqa: BLE001 — terminal classified
                     # staging fault: bench the staging for the cooldown
                     # and quarantine the plane so _stats planes tells

@@ -34,6 +34,7 @@ Three pieces:
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import threading
 import time
@@ -285,3 +286,19 @@ def set_opaque_id(value: Optional[str]) -> None:
 
 def get_opaque_id() -> Optional[str]:
     return _OPAQUE_ID.get()
+
+
+@contextlib.contextmanager
+def scoped_opaque_id(value: Optional[str]):
+    """Stamp a MEMBER's X-Opaque-Id for the duration of the block and
+    restore the previous (leader's) id on every exit path — the safe
+    idiom for batch leaders building member results on their own
+    thread. The contract-lint thread-local-hygiene pass flags bare
+    ``set_opaque_id`` member stamps whose early returns skip the
+    restore (the PR-9 stale-contextvar bug class); prefer this."""
+    prev = _OPAQUE_ID.get()
+    _OPAQUE_ID.set(value if value else None)
+    try:
+        yield
+    finally:
+        _OPAQUE_ID.set(prev)

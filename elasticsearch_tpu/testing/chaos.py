@@ -83,6 +83,30 @@ class ChaosSoakViolation(AssertionError):
     """One of the standing invariants failed under the soak."""
 
 
+def _run_witnessed(body) -> dict:
+    """Run a soak body under the runtime lock-order witness (ISSUE 15,
+    docs/LOCK_ORDER.md): install the instrumented-lock factories, wrap
+    the central singletons that predate the install window (the mesh
+    execution lock, the accountant's evict-path lock), fold the
+    observation report into the soak's (``lock_witness`` key), and
+    convert an observed order inversion into the soak's own violation
+    type. ``body`` returns the report dict."""
+    from elasticsearch_tpu.testing.lockwitness import (
+        LockOrderViolation,
+        lock_order_witness,
+    )
+
+    with lock_order_witness() as witness:
+        witness.wrap_central_locks()
+        report = body()
+    report["lock_witness"] = witness.report()
+    try:
+        witness.assert_acyclic()
+    except LockOrderViolation as e:
+        raise ChaosSoakViolation(str(e)) from e
+    return report
+
+
 class ChaosSoak:
     def __init__(self, seed: int = 0, rounds: int = 2,
                  docs_per_round: int = 24, searches_per_round: int = 6,
@@ -222,7 +246,17 @@ class ChaosSoak:
 
     def run(self) -> dict:
         """Run the soak; returns the report dict or raises
-        :class:`ChaosSoakViolation` with the first broken invariant."""
+        :class:`ChaosSoakViolation` with the first broken invariant.
+
+        The whole soak executes under the runtime lock-order witness
+        (ISSUE 15, docs/LOCK_ORDER.md): every package lock created
+        during the run — plus the wrapped central singletons — records
+        its per-thread acquisition order, and an observed order
+        INVERSION — the dynamic form of the static pass-5 cycle —
+        fails the soak like any other invariant."""
+        return _run_witnessed(self._run_soak)
+
+    def _run_soak(self) -> dict:
         report: dict = {
             "seed": self.seed, "rounds": self.rounds,
             "schedule": self.schedule(),
@@ -1126,10 +1160,12 @@ class RollingRestartSoak:
     # -- the whole soak --------------------------------------------------
 
     def run(self) -> dict:
-        report = {
+        # same witness contract as ChaosSoak.run: the rolling restarts
+        # exercise drain/promotion/recovery lock paths the steady-state
+        # soak never takes, so they confirm docs/LOCK_ORDER.md too
+        return _run_witnessed(lambda: {
             "seed": self.seed,
             "drain": self.run_drain_and_warm_restart(),
             "cluster": self.run_rolling_cluster(),
             "compile": self.run_compile_warm_restart(),
-        }
-        return report
+        })

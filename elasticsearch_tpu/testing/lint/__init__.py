@@ -1,0 +1,17 @@
+"""Contract-lint subsystem (ISSUE 15, docs/STATIC_ANALYSIS.md).
+
+Entry point: ``python -m elasticsearch_tpu.testing.lint`` — runs every
+registered pass over the source tree and exits non-zero on any
+unallowlisted finding. Tier-1 coverage: tests/test_contract_lint.py.
+"""
+
+from elasticsearch_tpu.testing.lint.core import (  # noqa: F401
+    Allowlist,
+    Finding,
+    LintPass,
+    LintResult,
+    SourceTree,
+    all_passes,
+    register_pass,
+    run_lint,
+)

@@ -1,0 +1,108 @@
+"""``python -m elasticsearch_tpu.testing.lint`` — the pre-PR contract
+gate (scripts/check.sh wraps it together with the registry lints).
+
+Exit status 0 iff every finding is allowlisted (with justification),
+no allowlist entry is stale, and — unless ``--no-doc-check`` — the
+checked-in docs/LOCK_ORDER.md matches the current source tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from elasticsearch_tpu.testing.lint.core import (
+    Allowlist,
+    SourceTree,
+    all_passes,
+    repo_root,
+    run_lint,
+)
+from elasticsearch_tpu.testing.lint.pass_lockorder import (
+    lock_graph_for,
+    render_lock_order,
+)
+
+LOCK_ORDER_DOC = os.path.join(repo_root(), "docs", "LOCK_ORDER.md")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticsearch_tpu.testing.lint",
+        description="AST contract lints + lock-discipline analyzer")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered passes and exit")
+    parser.add_argument("--pass", dest="passes", action="append",
+                        metavar="NAME",
+                        help="run only this pass (repeatable; disables "
+                             "the stale-allowlist check)")
+    parser.add_argument("--allowlist", default=None,
+                        help="alternate allowlist file")
+    parser.add_argument("--emit-lock-order", nargs="?", metavar="PATH",
+                        const=LOCK_ORDER_DOC, default=None,
+                        help=f"write the lock-order artifact (default "
+                             f"{os.path.relpath(LOCK_ORDER_DOC, repo_root())}"
+                             f") and exit")
+    parser.add_argument("--no-doc-check", action="store_true",
+                        help="skip the docs/LOCK_ORDER.md freshness check")
+    args = parser.parse_args(argv)
+
+    registry = all_passes()
+    if args.list:
+        for name in sorted(registry):
+            print(f"{name}: {registry[name].description}")
+        return 0
+
+    tree = SourceTree()
+    if args.emit_lock_order:
+        content = render_lock_order(lock_graph_for(tree))
+        with open(args.emit_lock_order, "w", encoding="utf-8") as f:
+            f.write(content)
+        print(f"wrote {args.emit_lock_order}")
+        return 0
+
+    unknown = [p for p in (args.passes or []) if p not in registry]
+    if unknown:
+        print(f"unknown pass(es): {unknown}; "
+              f"known: {sorted(registry)}", file=sys.stderr)
+        return 2
+    allow = (Allowlist.load(args.allowlist) if args.allowlist
+             else None)
+    result = run_lint(tree, passes=args.passes, allowlist=allow)
+
+    allowlisted = len(result.findings) - len(result.unallowlisted)
+    for f in result.unallowlisted:
+        print(f.render())
+    for err in result.allowlist_errors:
+        print(f"ALLOWLIST ERROR: {err}")
+    for entry in result.stale_entries:
+        print(f"STALE ALLOWLIST ENTRY (no finding matches — remove it): "
+              f"{entry}")
+
+    doc_ok = True
+    if args.passes is None and not args.no_doc_check:
+        # reuses the LockGraph the lock-order pass already built on
+        # this tree (lock_graph_for cache)
+        current = render_lock_order(lock_graph_for(tree))
+        try:
+            with open(LOCK_ORDER_DOC, encoding="utf-8") as f:
+                on_disk = f.read()
+        except OSError:
+            on_disk = ""
+        if on_disk != current:
+            doc_ok = False
+            print("docs/LOCK_ORDER.md is stale — regenerate with "
+                  "`python -m elasticsearch_tpu.testing.lint "
+                  "--emit-lock-order`")
+
+    print(f"contract-lint: {len(result.findings)} finding(s), "
+          f"{allowlisted} allowlisted, "
+          f"{len(result.unallowlisted)} unallowlisted, "
+          f"{len(result.stale_entries)} stale allowlist entr(ies), "
+          f"{len(result.allowlist_errors)} allowlist error(s)")
+    return 0 if (result.ok and doc_ok) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

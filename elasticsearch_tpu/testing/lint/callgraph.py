@@ -1,0 +1,229 @@
+"""Method-level call-graph approximation shared by the lock-order and
+thread-local-hygiene passes (ISSUE 15, docs/STATIC_ANALYSIS.md).
+
+Resolution is BY BARE NAME, package-wide: a call ``self.m()`` /
+``obj.m()`` / ``m()`` maps to every function named ``m`` anywhere in the
+tree (``self.m()`` prefers methods of the lexically-enclosing class when
+any exist). This over-approximates — the price of not running a type
+checker — which is the right direction for a deadlock lint (extra edges
+can only ADD candidate cycles, and candidate cycles are triaged against
+the allowlist with a mandatory justification) and is documented as the
+analyzer's precision bound in docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.testing.lint.core import SourceTree
+
+# calls that never acquire package locks and only blow up the graph
+_IGNORED_CALLEES = {
+    "append", "extend", "pop", "get", "set", "add", "items", "keys",
+    "values", "update", "join", "split", "strip", "format", "sort",
+    "sorted", "len", "int", "float", "str", "bool", "list", "dict",
+    "tuple", "range", "isinstance", "getattr", "setattr", "hasattr",
+    "min", "max", "sum", "abs", "repr", "print", "enumerate", "zip",
+    "copy", "deepcopy", "monotonic", "time", "sleep", "wait", "notify",
+    "notify_all", "warning", "info", "debug", "error", "exception",
+    # standard container-protocol names: a call like
+    # ``self._entries.clear()`` must not resolve to a same-named method
+    # of the enclosing class (the OrderedDict is not the class)
+    "clear", "popitem", "move_to_end", "discard", "setdefault",
+    "appendleft", "popleft", "count", "index", "remove", "insert",
+}
+
+
+def ignored_callee(name: Optional[str]) -> bool:
+    return name is None or name in _IGNORED_CALLEES
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Bare callee name of a Call node, or None when unresolvable."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def call_is_self(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name) and f.value.id == "self")
+
+
+# a bare name defined in more places than this is too ambiguous to
+# resolve on a non-self receiver — edges through it would be noise
+# (``close``/``stats``/``run`` exist on a dozen classes); the runtime
+# witness covers what this precision bound drops
+MAX_AMBIGUITY = 3
+
+
+class CallGraph:
+    """funcqual ('relpath::Class.method') -> (called name, self-recv)
+    pairs, plus the reverse index bare name -> defining funcquals."""
+
+    def __init__(self, tree: SourceTree):
+        self.tree = tree
+        self.calls: Dict[str, Set[Tuple[str, bool]]] = {}
+        self.defs_by_name: Dict[str, List[str]] = {}
+        self.class_of: Dict[str, Optional[str]] = {}
+        for rel, sf in tree.files.items():
+            for qual, node in sf.defs.items():
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                fq = f"{rel}::{qual}"
+                bare = qual.rsplit(".", 1)[-1]
+                self.defs_by_name.setdefault(bare, []).append(fq)
+                self.class_of[fq] = (qual.rsplit(".", 1)[0]
+                                     if "." in qual else None)
+                called: Set[Tuple[str, bool]] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        name = call_name(sub)
+                        if name and name not in _IGNORED_CALLEES:
+                            called.add((name, call_is_self(sub)))
+                self.calls[fq] = called
+
+    def resolve(self, caller: str, name: str,
+                is_self: bool = False) -> List[str]:
+        """Callees a bare name may refer to, from ``caller``'s view.
+
+        ``self.m()`` resolves within the enclosing class when it defines
+        ``m`` (exactly). Any OTHER receiver must NOT take that shortcut
+        — ``shard.refresh()`` inside ``IndexService.refresh`` is the
+        shard's method, and binding it to the enclosing class would
+        silently DROP the real callee (hiding its lock acquisitions,
+        the one direction a deadlock lint must never err). Non-self
+        receivers use the package-wide by-name candidates, dropped
+        entirely when the name is defined in more than MAX_AMBIGUITY
+        places (precision over recall; see module docstring)."""
+        cands = self.defs_by_name.get(name, [])
+        cls = self.class_of.get(caller)
+        if is_self and cls is not None:
+            rel = caller.split("::", 1)[0]
+            same = [c for c in cands
+                    if c.startswith(f"{rel}::{cls}.")]
+            if same:
+                return same
+        if len(cands) > MAX_AMBIGUITY:
+            return []
+        return cands
+
+    def transitive_closure(
+            self, seed: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+        """Fixed point of ``seed`` (funcqual -> facts) propagated from
+        callee to caller: a caller accumulates every fact of every
+        function its bare-name calls may resolve to."""
+        facts: Dict[str, Set[str]] = {fq: set(v)
+                                      for fq, v in seed.items()}
+        for fq in self.calls:
+            facts.setdefault(fq, set())
+        changed = True
+        while changed:
+            changed = False
+            for fq, called in self.calls.items():
+                acc = facts[fq]
+                before = len(acc)
+                for name, is_self in called:
+                    for callee in self.resolve(fq, name, is_self):
+                        acc |= facts.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+        return facts
+
+
+# ---------------------------------------------------------------------------
+# Lock-site discovery (shared vocabulary for pass 5 and the witness docs)
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _lock_ctor_kind(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS:
+        if isinstance(f.value, ast.Name) and f.value.id == "threading":
+            return f.attr
+        return None
+    if isinstance(f, ast.Name) and f.id in _LOCK_CTORS:
+        return f.id
+    return None
+
+
+def lock_sites(tree: SourceTree) -> Dict[str, Tuple[str, int, str]]:
+    """site-id -> (relpath, lineno, kind) for every
+    ``threading.Lock/RLock/Condition`` creation in the tree.
+
+    Site ids are stable across line drift: ``module.Class.attr`` for
+    ``self.attr = threading.Lock()`` in a class body / __init__,
+    ``module.NAME`` for module globals, ``module.func.NAME`` for
+    function locals."""
+    sites: Dict[str, Tuple[str, int, str]] = {}
+    for rel, sf in tree.files.items():
+        mod = rel[:-3].replace("/", ".")
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            kind = _lock_ctor_kind(node.value)
+            if kind is None:
+                continue
+            for target in node.targets:
+                qual = sf.qualname_at(node)
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    cls = qual.rsplit(".", 1)[0] if "." in qual else qual
+                    sites[f"{mod}.{cls}.{target.attr}"] = (rel, node.lineno,
+                                                           kind)
+                elif isinstance(target, ast.Name):
+                    if qual == "<module>":
+                        sites[f"{mod}.{target.id}"] = (rel, node.lineno,
+                                                       kind)
+                    else:
+                        sites[f"{mod}.{qual}.{target.id}"] = (
+                            rel, node.lineno, kind)
+    return sites
+
+
+def with_lock_site(item: ast.withitem, rel: str, qualname: str,
+                   known: Dict[str, Tuple[str, int]]) -> Optional[str]:
+    """Resolve one ``with <expr>:`` item to a known lock site id.
+
+    Handles ``self._x`` (own class first, then any class declaring the
+    attr), bare module-global names, and ``obj._x`` attribute reads
+    (matched against every class declaring ``_x`` — the by-name
+    over-approximation again)."""
+    expr = item.context_expr
+    mod = rel[:-3].replace("/", ".")
+    if isinstance(expr, ast.Attribute):
+        attr = expr.attr
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = (qualname.rsplit(".", 1)[0]
+                   if "." in qualname else qualname)
+            own = f"{mod}.{cls}.{attr}"
+            if own in known:
+                return own
+        matches = [s for s in known if s.endswith(f".{attr}")]
+        if len(matches) == 1:
+            return matches[0]
+        if matches:
+            # ambiguous attr name across classes: pick deterministically
+            # (documented approximation; distinct classes sharing a lock
+            # attr name collapse into one graph node, which only merges
+            # orderings — never hides an edge)
+            return sorted(matches)[0]
+        return None
+    if isinstance(expr, ast.Name):
+        own = f"{mod}.{expr.id}"
+        if own in known:
+            return own
+        matches = [s for s in known if s.endswith(f".{expr.id}")]
+        return sorted(matches)[0] if matches else None
+    return None

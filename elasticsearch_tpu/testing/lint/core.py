@@ -1,0 +1,273 @@
+"""Contract-lint framework core (ISSUE 15, docs/STATIC_ANALYSIS.md).
+
+The reference enforces its cross-cutting contracts (circuit-breaker
+accounting balance, cancellable-task propagation, settings registration)
+with dedicated infrastructure; this package is our reproduction's
+equivalent: AST-based lint passes that encode the invariants the PR 2-14
+review logs kept re-fixing by hand, run over the whole source tree by
+``python -m elasticsearch_tpu.testing.lint`` and by the tier-1 test
+``tests/test_contract_lint.py``.
+
+Three pieces:
+
+- :class:`SourceTree` — the parsed source universe (one ``ast.parse``
+  per file, shared by every pass) plus the qualname index the passes
+  key their findings on.
+- :class:`LintPass` / :func:`register_pass` — the pass registry. A pass
+  receives the tree and yields :class:`Finding`s; its ``targets`` set
+  (when not None) restricts it to the files whose contracts it encodes.
+- :class:`Allowlist` — the per-finding allowlist. Every entry carries a
+  MANDATORY justification string; entries that no longer match any
+  finding are themselves reported (a stale allowlist hides regressions),
+  so the file can only ever shrink truthfully.
+
+Finding identity is ``pass:relpath:qualname[:key]`` — stable across
+line-number drift so allowlist entries survive unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+
+def package_root() -> str:
+    """Absolute path of the ``elasticsearch_tpu`` package directory."""
+    import elasticsearch_tpu
+
+    return os.path.dirname(os.path.abspath(elasticsearch_tpu.__file__))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One contract violation (or justified-false-positive candidate)."""
+
+    pass_name: str
+    path: str          # relative to the package root, '/'-separated
+    qualname: str      # Class.method / function / '<module>'
+    lineno: int
+    message: str
+    key: str = ""      # disambiguator when one symbol yields several
+
+    @property
+    def id(self) -> str:
+        base = f"{self.pass_name}:{self.path}:{self.qualname}"
+        return f"{base}:{self.key}" if self.key else base
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.lineno}: [{self.pass_name}] "
+                f"{self.message}\n    id: {self.id}")
+
+
+# ---------------------------------------------------------------------------
+# Parsed-source universe
+# ---------------------------------------------------------------------------
+
+
+class SourceFile:
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source)
+        # node -> qualname ('Class.method', nested functions dotted)
+        self.qualnames: Dict[ast.AST, str] = {}
+        # function/class defs by qualname
+        self.defs: Dict[str, ast.AST] = {}
+        self._index()
+
+    def _index(self) -> None:
+        def walk(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qual = (f"{prefix}.{child.name}" if prefix
+                            else child.name)
+                    self.qualnames[child] = qual
+                    self.defs[qual] = child
+                    walk(child, qual)
+                else:
+                    walk(child, prefix)
+
+        walk(self.tree, "")
+
+    def qualname_at(self, node: ast.AST) -> str:
+        """Qualname of the innermost def/class enclosing ``node`` (by
+        position), or '<module>'."""
+        best = "<module>"
+        best_span = None
+        for d, qual in self.qualnames.items():
+            if not isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            end = getattr(d, "end_lineno", d.lineno)
+            if d.lineno <= node.lineno <= end:
+                span = end - d.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = qual, span
+        return best
+
+
+class SourceTree:
+    """Every ``.py`` file under ``root``, parsed once.
+
+    ``fixture_mode`` lifts per-pass ``targets`` restrictions so the
+    lint_fixtures self-test snippets exercise every pass regardless of
+    their file names."""
+
+    def __init__(self, root: Optional[str] = None,
+                 fixture_mode: bool = False):
+        self.root = root or package_root()
+        self.fixture_mode = fixture_mode
+        self.files: Dict[str, SourceFile] = {}
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__",)]
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as f:
+                    self.files[rel] = SourceFile(rel, f.read())
+
+    def applies(self, relpath: str,
+                targets: Optional[Set[str]]) -> bool:
+        return self.fixture_mode or targets is None or relpath in targets
+
+
+# ---------------------------------------------------------------------------
+# Pass registry
+# ---------------------------------------------------------------------------
+
+
+class LintPass:
+    """Base class: subclasses set ``name``/``description`` (and
+    optionally ``targets``) and implement :meth:`run`."""
+
+    name: str = ""
+    description: str = ""
+    # None = whole tree; otherwise the set of relpaths whose contracts
+    # this pass encodes
+    targets: Optional[Set[str]] = None
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, LintPass] = {}
+
+
+def register_pass(cls):
+    """Class decorator adding a pass (by its ``name``) to the registry."""
+    inst = cls()
+    assert inst.name and inst.name not in _REGISTRY, inst.name
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_passes() -> Dict[str, LintPass]:
+    # importing the pass modules registers them; keep the import here so
+    # `from ...lint.core import ...` stays cycle-free
+    from elasticsearch_tpu.testing.lint import (  # noqa: F401
+        pass_cancellation,
+        pass_counters,
+        pass_ledger,
+        pass_lockorder,
+        pass_settings_docs,
+        pass_threadlocal,
+    )
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Allowlist
+# ---------------------------------------------------------------------------
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "allowlist.txt")
+
+
+@dataclass
+class Allowlist:
+    """``finding-id | justification`` lines; '#' comments; justification
+    is mandatory — an entry without one is a lint failure itself."""
+
+    entries: Dict[str, str] = field(default_factory=dict)
+    errors: List[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str = DEFAULT_ALLOWLIST) -> "Allowlist":
+        out = cls()
+        if not os.path.exists(path):
+            return out
+        with open(path, encoding="utf-8") as f:
+            for n, raw in enumerate(f, 1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if "|" not in line:
+                    out.errors.append(
+                        f"allowlist line {n}: missing '| justification' "
+                        f"— every entry must say WHY it is a false "
+                        f"positive: {line}")
+                    continue
+                fid, just = (s.strip() for s in line.split("|", 1))
+                if not just:
+                    out.errors.append(
+                        f"allowlist line {n}: empty justification for "
+                        f"[{fid}]")
+                    continue
+                if fid in out.entries:
+                    out.errors.append(
+                        f"allowlist line {n}: duplicate entry [{fid}]")
+                    continue
+                out.entries[fid] = just
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    unallowlisted: List[Finding]
+    stale_entries: List[str]
+    allowlist_errors: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not (self.unallowlisted or self.stale_entries
+                    or self.allowlist_errors)
+
+
+def run_lint(tree: Optional[SourceTree] = None,
+             passes: Optional[List[str]] = None,
+             allowlist: Optional[Allowlist] = None) -> LintResult:
+    tree = tree or SourceTree()
+    registry = all_passes()
+    names = passes or sorted(registry)
+    allow = allowlist if allowlist is not None else Allowlist.load()
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(registry[name].run(tree))
+    findings.sort(key=lambda f: (f.path, f.lineno, f.pass_name, f.key))
+    seen_ids = {f.id for f in findings}
+    unallow = [f for f in findings if f.id not in allow.entries]
+    # stale check only makes sense on a full default run: a restricted
+    # pass list would report every other pass's entries as stale
+    stale = ([e for e in sorted(allow.entries)
+              if e not in seen_ids] if passes is None else [])
+    return LintResult(findings, unallow, stale, allow.errors)

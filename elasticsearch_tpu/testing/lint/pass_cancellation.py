@@ -1,0 +1,173 @@
+"""Pass 1 — cancellation-passthrough (ISSUE 15).
+
+The PR-4/PR-10 contract: ``TimeExceededException`` /
+``TaskCancelledException`` / ``StagingBail`` must pass THROUGH the
+plane-ladder, fault-recording and staging-retry ``except`` blocks — a
+broad handler that records a fault (quarantine, staging-fault
+accounting, ladder decision) or swallows the error entirely would turn
+a clean cancellation into a bogus plane quarantine or a silently-eaten
+timeout. Review logs re-fixed this class in PRs 4, 10, 11 and 13; this
+pass mechanizes it.
+
+Rule, per ``try`` in the target files: a broad handler (bare ``except``,
+``except Exception``/``BaseException``) is flagged when
+
+- its body calls a fault-recording function (``record_failure``,
+  ``note_staging_fault``, ``_note``, ``_note_agg_fallback``,
+  ``note_decision``, ``shard_failure_entry``), OR
+- the ``try`` body can raise a cancellation (it checkpoints a deadline
+  or blocks on a device program),
+
+UNLESS the cancellation types are re-raised first: an earlier handler
+in the same ``try`` catches one of the passthrough types and re-raises,
+or the broad handler itself re-raises unconditionally as its LAST
+statement (the ``run_staged`` record-then-re-raise shape still
+propagates the exception; recording a cancellation as a device fault is
+noisy telemetry, so target files should prefer the explicit
+passthrough handler).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from elasticsearch_tpu.testing.lint.callgraph import call_name
+from elasticsearch_tpu.testing.lint.core import (
+    Finding,
+    LintPass,
+    SourceTree,
+    register_pass,
+)
+
+PASSTHROUGH_TYPES = {
+    "TaskCancelledException",
+    "TimeExceededException",
+    "StagingBail",
+}
+
+FAULT_CALLS = {
+    "record_failure",
+    "note_staging_fault",
+    "_note",
+    "_note_agg_fallback",
+    "note_decision",
+    "shard_failure_entry",
+}
+
+# calls whose presence in a try body means a cancellation can surface
+# inside it (deadline checkpoints; device-program completion points sit
+# behind them on every ladder path)
+CANCELLATION_SOURCES = {"checkpoint"}
+
+
+def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    if t is None:
+        return ["<bare>"]
+    out = []
+    for node in ([t.elts] if isinstance(t, ast.Tuple) else [[t]])[0]:
+        if isinstance(node, ast.Name):
+            out.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.append(node.attr)
+    return out
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    names = _handler_names(handler)
+    return any(n in ("<bare>", "Exception", "BaseException")
+               for n in names)
+
+
+def _records_fault(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            if call_name(node) in FAULT_CALLS:
+                return True
+    return False
+
+
+def _reraises_unconditionally(handler: ast.ExceptHandler) -> bool:
+    """Last top-level statement of the handler is a bare ``raise``."""
+    body = handler.body
+    return bool(body) and isinstance(body[-1], ast.Raise) \
+        and body[-1].exc is None
+
+
+def _try_can_cancel(node: ast.Try) -> bool:
+    for stmt in node.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                if call_name(sub) in CANCELLATION_SOURCES:
+                    return True
+    return False
+
+
+REQUIRED_PASSTHROUGH = {"TaskCancelledException", "TimeExceededException"}
+
+
+def _passthrough_before(node: ast.Try,
+                        broad: ast.ExceptHandler) -> bool:
+    """Earlier handlers HANDLE both cancellation types — re-raising
+    (the ladder shape) or converting deliberately (the per-shard
+    partial-results shape turns TimeExceeded into ``timed_out``); what
+    the contract forbids is the BROAD handler ever seeing them.
+    (StagingBail passthrough is accepted as a bonus but not required —
+    it only has meaning at staging-retry sites, and those must still
+    let the two cancellation types through.)"""
+    covered: set = set()
+    for handler in node.handlers:
+        if handler is broad:
+            break
+        covered |= set(_handler_names(handler)) & PASSTHROUGH_TYPES
+    return covered >= REQUIRED_PASSTHROUGH
+
+
+@register_pass
+class CancellationPassthroughPass(LintPass):
+    name = "cancellation-passthrough"
+    description = ("broad except blocks on plane-ladder / fault-recording"
+                   " / staging-retry paths must re-raise TimeExceeded/"
+                   "TaskCancelled/StagingBail before recording a fault")
+    targets = {
+        "parallel/plan_exec.py",
+        "common/staging.py",
+        "index/index_service.py",
+        "search/batching.py",
+        "index/segment.py",
+        "search/fused_aggs.py",
+    }
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        for rel, sf in tree.files.items():
+            if not tree.applies(rel, self.targets):
+                continue
+            counters: dict = {}
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not _is_broad(handler):
+                        continue
+                    records = _records_fault(handler)
+                    cancellable = _try_can_cancel(node)
+                    if not (records or cancellable):
+                        continue
+                    if _passthrough_before(node, handler):
+                        continue
+                    if not records and _reraises_unconditionally(handler):
+                        # pure rethrow shapes propagate cancellation fine
+                        continue
+                    qual = sf.qualname_at(handler)
+                    n = counters.get(qual, 0) + 1
+                    counters[qual] = n
+                    what = ("records a fault" if records
+                            else "guards a cancellable body")
+                    yield Finding(
+                        self.name, rel, qual, handler.lineno,
+                        f"broad except {what} without re-raising "
+                        f"TimeExceeded/TaskCancelled/StagingBail first "
+                        f"— add an `except (TaskCancelledException, "
+                        f"TimeExceededException): raise` arm before it",
+                        key=f"h{n}" if n > 1 else "")

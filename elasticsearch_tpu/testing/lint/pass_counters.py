@@ -1,0 +1,154 @@
+"""Pass 3 — counter lock discipline (ISSUE 15).
+
+The PR-8 stats-consistency contract (docs/OBSERVABILITY.md): exported
+counters are mutated from concurrent query/ingest threads, and a bare
+``self.x_total += 1`` is a read-modify-write race that silently loses
+increments — the exact class the PR-8 concurrency hardening fixed by
+hand across plan_exec/telemetry/admission. This pass flags every
+augmented assignment to a ``self.*_total`` attribute (and every write
+through a ``self.*_by_reason`` mapping) that is not covered by one of
+the repo's synchronization idioms:
+
+- lexically inside ``with self.<lock>:`` / ``with <module lock>:``
+  where the context expression names a lock/condition (attribute or
+  global whose name contains ``lock``, ``_cv``, or ``cond``);
+- in a function whose name ends in ``_locked`` (the caller-holds-lock
+  naming convention); or
+- in a function whose docstring states the convention explicitly
+  ("caller holds", "lock held", or "single-threaded by design").
+
+Counters on local variables don't race and are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from elasticsearch_tpu.testing.lint.core import (
+    Finding,
+    LintPass,
+    SourceTree,
+    register_pass,
+)
+
+_DOC_MARKERS = ("caller holds", "lock held", "single-threaded by design")
+
+
+def _expr_names(expr: ast.AST) -> List[str]:
+    out = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            out.append(node.attr)
+        elif isinstance(node, ast.Name):
+            out.append(node.id)
+    return out
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    return any("lock" in n.lower() or n in ("_cv", "cv")
+               or "cond" in n.lower()
+               for n in _expr_names(expr))
+
+
+def _counter_target(node: ast.AST) -> Optional[str]:
+    """The counter name when ``node`` writes a tracked counter."""
+    if isinstance(node, ast.AugAssign):
+        t = node.target
+        if (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"
+                and t.attr.endswith("_total")):
+            return t.attr
+        if isinstance(t, ast.Subscript):
+            v = t.value
+            if (isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self"
+                    and v.attr.endswith("_by_reason")):
+                return v.attr
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                v = t.value
+                if (isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "self"
+                        and v.attr.endswith("_by_reason")):
+                    return v.attr
+    return None
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self):
+        self.findings: List[tuple] = []  # (node, counter, func)
+        self._with_lock_depth = 0
+        self._func_stack: List[ast.FunctionDef] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(_is_lock_expr(item.context_expr)
+                     for item in node.items)
+        if locked:
+            self._with_lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self._with_lock_depth -= 1
+
+    def _visit_func(self, node) -> None:
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _func_exempt(self) -> bool:
+        if not self._func_stack:
+            return False
+        fn = self._func_stack[-1]
+        if fn.name.endswith("_locked"):
+            return True
+        doc = (ast.get_docstring(fn) or "").lower()
+        return any(marker in doc for marker in _DOC_MARKERS)
+
+    def _check(self, node) -> None:
+        counter = _counter_target(node)
+        if counter and not self._with_lock_depth \
+                and not self._func_exempt():
+            fn = self._func_stack[-1] if self._func_stack else None
+            self.findings.append((node, counter, fn))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check(node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check(node)
+        self.generic_visit(node)
+
+
+@register_pass
+class CounterLockPass(LintPass):
+    name = "counter-lock-discipline"
+    description = ("self.*_total / self.*_by_reason counter writes must "
+                   "happen under a lock (or in a function documented as "
+                   "caller-holds-lock)")
+    targets = None  # whole tree
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        for rel, sf in tree.files.items():
+            if rel.startswith("testing/lint/"):
+                continue
+            scanner = _Scanner()
+            scanner.visit(sf.tree)
+            per_qual: dict = {}
+            for node, counter, _fn in scanner.findings:
+                qual = sf.qualname_at(node)
+                n = per_qual.get((qual, counter), 0) + 1
+                per_qual[(qual, counter)] = n
+                yield Finding(
+                    self.name, rel, qual, node.lineno,
+                    f"unsynchronized write to self.{counter}: wrap it in"
+                    f" the owning lock (concurrent increments lose "
+                    f"updates — the PR-8 race class), name the function "
+                    f"*_locked, or document 'caller holds' the lock",
+                    key=(counter if n == 1 else f"{counter}{n}"))

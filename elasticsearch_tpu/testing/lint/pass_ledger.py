@@ -1,0 +1,135 @@
+"""Pass 2 — ledger balance (ISSUE 15).
+
+The device-memory accounting contract (ISSUE 9/10, docs/RESILIENCE.md
+"Device-plane faults"): every ``DeviceMemoryAccountant.register`` call
+site must leave the ledger RECLAIMABLE — registered bytes that nothing
+can ever release (an "orphan register") grow ``staged_bytes`` forever
+and starve the HBM budget gate. PRs 9-13 enforced this by review
+("register-then-commit", "transactional staging"); this pass mechanizes
+the two structural halves of the invariant:
+
+1. the register call passes an ``evict=`` callback, so the accountant
+   itself can reclaim the scope under budget pressure; and
+2. the enclosing class owns a release path — some method calls
+   ``release_scope``/``release_index`` — pairing every register with a
+   reachable rollback (module-level registers need a module-level
+   release call).
+
+Call sites are matched structurally: ``<expr>.register(...)`` where the
+receiver involves ``memory_accountant()`` (directly, or via a local
+alias assigned from it in the same function). Registries with the same
+method name (settings, tasks, transport hubs, REST routes) never match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from elasticsearch_tpu.testing.lint.core import (
+    Finding,
+    LintPass,
+    SourceTree,
+    register_pass,
+)
+
+RELEASE_CALLS = {"release_scope", "release_index"}
+
+
+def _aliases_of_accountant(func: ast.AST) -> Set[str]:
+    """Local names assigned from ``memory_accountant()`` in ``func``."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            callee = node.value.func
+            name = (callee.id if isinstance(callee, ast.Name)
+                    else getattr(callee, "attr", None))
+            if name == "memory_accountant":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _is_accountant_register(call: ast.Call,
+                            aliases: Set[str]) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "register"):
+        return False
+    recv = f.value
+    if isinstance(recv, ast.Call):
+        callee = recv.func
+        name = (callee.id if isinstance(callee, ast.Name)
+                else getattr(callee, "attr", None))
+        return name == "memory_accountant"
+    if isinstance(recv, ast.Name):
+        return recv.id in aliases
+    return False
+
+
+def _enclosing_class(sf, node: ast.AST) -> Optional[str]:
+    qual = sf.qualname_at(node)
+    return qual.rsplit(".", 1)[0] if "." in qual else None
+
+
+def _scope_has_release(sf, cls: Optional[str]) -> bool:
+    """The class (or the whole module, for free functions) contains a
+    reachable ``release_scope``/``release_index`` call."""
+    scope = sf.defs.get(cls) if cls else sf.tree
+    if scope is None:
+        scope = sf.tree
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if node.func.attr in RELEASE_CALLS:
+                return True
+    return False
+
+
+@register_pass
+class LedgerBalancePass(LintPass):
+    name = "ledger-balance"
+    description = ("every memory-accountant register site must pass an "
+                   "evict= callback and sit in a scope owning a "
+                   "release_scope/release_index rollback path")
+    targets = None  # whole tree: new register sites anywhere must comply
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        for rel, sf in tree.files.items():
+            if rel.startswith("testing/lint/"):
+                continue  # the analyzer's own pattern tables
+            func_aliases: dict = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    func_aliases[node] = _aliases_of_accountant(node)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                aliases: Set[str] = set()
+                for fn, al in func_aliases.items():
+                    if fn.lineno <= node.lineno <= getattr(
+                            fn, "end_lineno", fn.lineno):
+                        aliases |= al
+                if not _is_accountant_register(node, aliases):
+                    continue
+                qual = sf.qualname_at(node)
+                kwargs = {k.arg for k in node.keywords}
+                if "evict" not in kwargs:
+                    yield Finding(
+                        self.name, rel, qual, node.lineno,
+                        "accountant.register without an evict= callback:"
+                        " the HBM budget gate cannot reclaim this scope "
+                        "— pass the generation's eviction hook",
+                        key="evict")
+                cls = _enclosing_class(sf, node)
+                if not _scope_has_release(sf, cls):
+                    yield Finding(
+                        self.name, rel, qual, node.lineno,
+                        "orphan register: no release_scope/release_index"
+                        " call anywhere in the enclosing "
+                        f"{'class ' + cls if cls else 'module'} — "
+                        "registered bytes could never be returned to "
+                        "the ledger",
+                        key="release")

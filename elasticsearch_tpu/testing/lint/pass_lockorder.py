@@ -1,0 +1,301 @@
+"""Pass 5 — static lock-ordering (ISSUE 15, docs/LOCK_ORDER.md).
+
+Builds the acquired-while-holding graph over every
+``threading.Lock/RLock/Condition`` site in the package (method-level
+call-graph approximation — see callgraph.py for the precision bound)
+and flags cycles as potential deadlocks. The same graph renders as the
+checked-in ``docs/LOCK_ORDER.md`` artifact
+(``python -m elasticsearch_tpu.testing.lint --emit-lock-order``), and
+the runtime witness (testing/lockwitness.py) confirms the ordering
+dynamically during the chaos soaks.
+
+Edge semantics: ``A -> B`` means "some code path may acquire B while
+holding A" — a ``with`` on site B nested (lexically, or through any
+chain of bare-name-resolved calls) inside a ``with`` on site A. A cycle
+among DISTINCT sites is a deadlock candidate. A self-edge on a plain
+``Lock`` site (the site's own closure re-acquires it) is flagged too —
+that is a single-thread deadlock unless the inner acquisition is on a
+different instance; self-edges on ``RLock``/``Condition`` sites are
+reentrancy by design and pass.
+
+Known precision limits (all covered by the runtime witness instead):
+callback-mediated acquisition (a lock held while invoking a stored
+callable — e.g. the accountant's evict callbacks) is invisible to the
+static graph; conversely, bare-name call resolution can fabricate
+edges between unrelated classes sharing a method name. Fabricated
+cycles are allowlisted with justification, never silently dropped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.testing.lint.callgraph import (
+    CallGraph,
+    call_is_self,
+    call_name,
+    ignored_callee,
+    lock_sites,
+    with_lock_site,
+)
+from elasticsearch_tpu.testing.lint.core import (
+    Finding,
+    LintPass,
+    SourceTree,
+    register_pass,
+)
+
+
+def _function_withs(fn: ast.AST) -> List[ast.With]:
+    return [n for n in ast.walk(fn) if isinstance(n, ast.With)]
+
+
+def lock_graph_for(tree: SourceTree) -> "LockGraph":
+    """The tree's LockGraph, built once — the call-graph closure is the
+    linter's heaviest analysis and both the pass and the LOCK_ORDER.md
+    renderer need it per run."""
+    lg = getattr(tree, "_lock_graph", None)
+    if lg is None:
+        lg = LockGraph(tree)
+        tree._lock_graph = lg
+    return lg
+
+
+class LockGraph:
+    """The full static analysis result, reused by the doc emitter."""
+
+    def __init__(self, tree: SourceTree):
+        self.tree = tree
+        self.sites = lock_sites(tree)
+        self.graph = CallGraph(tree)
+        # funcqual -> sites directly acquired in its body
+        self.direct: Dict[str, Set[str]] = {}
+        self._withs: Dict[str, List[Tuple[ast.With, str]]] = {}
+        for rel, sf in tree.files.items():
+            for qual, fn in sf.defs.items():
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                fq = f"{rel}::{qual}"
+                acq: Set[str] = set()
+                pairs: List[Tuple[ast.With, str]] = []
+                for w in _function_withs(fn):
+                    for item in w.items:
+                        site = with_lock_site(item, rel, qual, self.sites)
+                        if site is not None:
+                            acq.add(site)
+                            pairs.append((w, site))
+                self.direct[fq] = acq
+                self._withs[fq] = pairs
+        self.may_acquire = self.graph.transitive_closure(self.direct)
+        # (A, B) -> sorted example locations "rel::qual"
+        self.edges: Dict[Tuple[str, str], Set[str]] = {}
+        self._build_edges()
+
+    def _build_edges(self) -> None:
+        for fq, pairs in self._withs.items():
+            for w, held in pairs:
+                for stmt in w.body:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.With):
+                            for item in sub.items:
+                                rel, qual = fq.split("::", 1)
+                                inner = with_lock_site(
+                                    item, rel, qual, self.sites)
+                                if inner is not None:
+                                    self._edge(held, inner, fq)
+                        elif isinstance(sub, ast.Call):
+                            name = call_name(sub)
+                            if not name or ignored_callee(name):
+                                continue
+                            for callee in self.graph.resolve(
+                                    fq, name, call_is_self(sub)):
+                                for site in self.may_acquire.get(
+                                        callee, ()):
+                                    if site == held and site not in \
+                                            self.direct.get(callee, ()):
+                                        # self-edges keep only DIRECT
+                                        # re-acquisition: a transitive
+                                        # by-name chain ending back at
+                                        # the held site is noise at this
+                                        # precision (different
+                                        # instances / name collisions);
+                                        # the runtime witness owns the
+                                        # instance-accurate check
+                                        continue
+                                    self._edge(held, site,
+                                               f"{fq} -> {callee}")
+
+    def _edge(self, a: str, b: str, where: str) -> None:
+        self.edges.setdefault((a, b), set()).add(where)
+
+    # -- cycle analysis -------------------------------------------------
+
+    def cycles(self) -> List[List[str]]:
+        """SCCs with more than one site, plus plain-Lock self-loops."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        out: List[List[str]] = []
+        # Tarjan
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        stack: List[str] = []
+        on: Set[str] = set()
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in adj.get(v, ()):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        for (a, b) in sorted(self.edges):
+            if a == b and self.sites.get(a, ("", 0, "Lock"))[2] == "Lock":
+                out.append([a])
+        return out
+
+    def topo_order(self) -> List[str]:
+        """Deterministic acquisition order over the condensation (cycle
+        members sort together); the documented 'acquire in this order'
+        artifact."""
+        adj: Dict[str, Set[str]] = {s: set() for s in self.sites}
+        indeg: Dict[str, int] = {s: 0 for s in self.sites}
+        for (a, b) in self.edges:
+            if a != b and b not in adj.setdefault(a, set()):
+                adj[a].add(b)
+                indeg[b] = indeg.get(b, 0) + 1
+            adj.setdefault(b, set())
+            indeg.setdefault(a, 0)
+        order: List[str] = []
+        ready = sorted(s for s, d in indeg.items() if d == 0)
+        seen: Set[str] = set()
+        while ready:
+            v = ready.pop(0)
+            order.append(v)
+            seen.add(v)
+            for w in sorted(adj.get(v, ())):
+                indeg[w] -= 1
+                if indeg[w] == 0:
+                    ready.append(w)
+            ready.sort()
+        # cycle members (never reach indeg 0) appended sorted, marked
+        # in the doc
+        order.extend(sorted(s for s in adj if s not in seen))
+        return order
+
+
+def render_lock_order(lg: LockGraph) -> str:
+    """docs/LOCK_ORDER.md content — regenerate with
+    ``python -m elasticsearch_tpu.testing.lint --emit-lock-order``."""
+    lines = [
+        "# Lock acquisition order",
+        "",
+        "GENERATED by `python -m elasticsearch_tpu.testing.lint "
+        "--emit-lock-order` (pass 5, docs/STATIC_ANALYSIS.md) — do not "
+        "edit by hand; the tier-1 contract-lint test fails when this "
+        "file drifts from the source tree.",
+        "",
+        "`A -> B` means some code path may acquire B while holding A "
+        "(lexical nesting, or nesting through the bare-name call-graph "
+        "approximation). New code must not add an edge that reverses "
+        "an existing path; the runtime witness "
+        "(`elasticsearch_tpu/testing/lockwitness.py`) asserts the same "
+        "property dynamically during the chaos soaks.",
+        "",
+        "## Lock sites",
+        "",
+        "| site | kind | file |",
+        "|---|---|---|",
+    ]
+    for site in sorted(lg.sites):
+        rel, _lineno, kind = lg.sites[site]
+        lines.append(f"| `{site}` | {kind} | `{rel}` |")
+    lines += [
+        "",
+        "## Acquired-while-holding edges",
+        "",
+        "| held | acquired | via |",
+        "|---|---|---|",
+    ]
+    for (a, b) in sorted(lg.edges):
+        wheres = sorted(lg.edges[(a, b)])
+        shown = wheres[0] + (f" (+{len(wheres) - 1} more)"
+                             if len(wheres) > 1 else "")
+        lines.append(f"| `{a}` | `{b}` | `{shown}` |")
+    cycles = lg.cycles()
+    lines += ["", "## Cycles", ""]
+    if cycles:
+        lines.append("Candidate deadlock cycles (each must be fixed or "
+                     "allowlisted with justification):")
+        lines.append("")
+        for cyc in cycles:
+            lines.append("- " + " -> ".join(f"`{s}`" for s in cyc)
+                         + (" -> `" + cyc[0] + "`" if len(cyc) > 1
+                            else " (self-edge on a plain Lock)"))
+    else:
+        lines.append("None — the static graph is acyclic.")
+    lines += [
+        "",
+        "## Global acquisition order",
+        "",
+        "Acquire in this order (topological over the edge graph; "
+        "unordered sites sort lexicographically):",
+        "",
+    ]
+    for i, site in enumerate(lg.topo_order(), 1):
+        lines.append(f"{i}. `{site}`")
+    lines.append("")
+    return "\n".join(lines)
+
+
+@register_pass
+class LockOrderPass(LintPass):
+    name = "lock-order"
+    description = ("acquired-while-holding graph over every threading "
+                   "lock site must be acyclic (candidate deadlocks)")
+    targets = None
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        lg = lock_graph_for(tree)
+        for cyc in lg.cycles():
+            if len(cyc) == 1:
+                site = cyc[0]
+                rel, lineno, _kind = lg.sites[site]
+                yield Finding(
+                    self.name, rel, site, lineno,
+                    f"self-edge on plain Lock site `{site}`: its "
+                    f"holder's call closure may re-acquire it — a "
+                    f"single-thread deadlock unless the inner "
+                    f"acquisition is provably a different instance",
+                    key="self-edge")
+            else:
+                anchor = cyc[0]
+                rel, lineno, _kind = lg.sites.get(anchor,
+                                                  ("<unknown>", 0, ""))
+                yield Finding(
+                    self.name, rel, anchor, lineno,
+                    "candidate deadlock cycle: "
+                    + " -> ".join(cyc) + f" -> {anchor}",
+                    key="cycle:" + "|".join(cyc))

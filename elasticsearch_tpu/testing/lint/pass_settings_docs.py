@@ -1,0 +1,120 @@
+"""Pass 6 — settings/docs cross-check (ISSUE 15 satellite).
+
+Extends the tests/test_settings_registry.py lint (every settings LOOKUP
+must be registered) with the documentation half: every registered
+``search.*`` / ``index.search.*`` key must appear in EXACTLY ONE
+settings table across docs/*.md, and every settings-table row must name
+a registered key. This catches the two recurring drift shapes the
+review logs kept fixing: "registered but undocumented" (a knob ships
+with no operator surface) and duplicate rows that rot independently.
+
+A settings-table ROW is a markdown table line whose FIRST cell is the
+backticked key (``| `search.foo` | ...``) — keys mentioned mid-row or
+in prose are cross-references, not the documenting row, and don't
+count. The docs may intentionally cross-reference a key from several
+subsystem pages; only one page owns its row.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from elasticsearch_tpu.testing.lint.core import (
+    Finding,
+    LintPass,
+    SourceTree,
+    register_pass,
+    repo_root,
+)
+
+# settings keys are lowercase dotted words — the case restriction keeps
+# generated artifacts like LOCK_ORDER.md (whose site ids embed
+# CamelCase class names under a `search.` module prefix) out of scope
+_ROW_RE = re.compile(r"^\|\s*`((?:index\.)?search\.[a-z0-9_.]+)`\s*\|")
+
+
+def doc_rows(docs_dir: str) -> Dict[str, List[Tuple[str, int]]]:
+    """key -> [(doc relname, lineno)] for every settings-table row."""
+    rows: Dict[str, List[Tuple[str, int]]] = {}
+    for fname in sorted(os.listdir(docs_dir)):
+        if not fname.endswith(".md") or fname == "LOCK_ORDER.md":
+            # LOCK_ORDER.md is the GENERATED pass-5 artifact, never a
+            # settings page — its site ids live under a `search.`
+            # module prefix and a future lowercase module-level lock
+            # in search/ would otherwise read as an unregistered key
+            continue
+        with open(os.path.join(docs_dir, fname), encoding="utf-8") as f:
+            for n, line in enumerate(f, 1):
+                m = _ROW_RE.match(line.strip())
+                if m:
+                    rows.setdefault(m.group(1), []).append((fname, n))
+    return rows
+
+
+def registered_search_keys() -> set:
+    from elasticsearch_tpu.common.settings import (
+        cluster_settings,
+        index_scoped_settings,
+    )
+
+    keys = set()
+    for registry in (cluster_settings(), index_scoped_settings()):
+        keys.update(k for k in registry._settings
+                    if k.startswith("search.")
+                    or k.startswith("index.search."))
+    return keys
+
+
+def cross_check(keys: set, rows: Dict[str, List[Tuple[str, int]]],
+                pass_name: str) -> Iterable[Finding]:
+    """The testable core: findings for undocumented / duplicated /
+    unregistered keys (docs path is symbolic — the finding id anchors
+    on the key, so allowlist entries survive doc reflows)."""
+    for key in sorted(keys):
+        sites = rows.get(key, [])
+        if not sites:
+            yield Finding(
+                pass_name, "common/settings.py", "<registry>", 1,
+                f"registered setting [{key}] has no settings-table row "
+                f"in docs/*.md — document it (catches the 'registered "
+                f"but undocumented' drift)",
+                key=key)
+        elif len(sites) > 1:
+            where = ", ".join(f"{d}:{n}" for d, n in sites)
+            yield Finding(
+                pass_name, "common/settings.py", "<registry>", 1,
+                f"setting [{key}] documented in {len(sites)} tables "
+                f"({where}) — exactly one page owns a key's row; turn "
+                f"the others into cross-references",
+                key=key)
+    for key in sorted(rows):
+        if key not in keys:
+            d, n = rows[key][0]
+            yield Finding(
+                pass_name, "common/settings.py", "<registry>", 1,
+                f"docs table row for [{key}] ({d}:{n}) names a key the "
+                f"settings registries don't know — register it or drop "
+                f"the row",
+                key=key)
+
+
+@register_pass
+class SettingsDocsPass(LintPass):
+    name = "settings-docs"
+    description = ("every registered search.*/index.search.* setting "
+                   "appears in exactly one docs/*.md settings table, "
+                   "and vice versa")
+    targets = None
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        if tree.fixture_mode:
+            # self-test drives cross_check() directly with synthetic
+            # inputs; a fixtures tree has no registry to import
+            return
+        docs_dir = os.path.join(repo_root(), "docs")
+        if not os.path.isdir(docs_dir):
+            return
+        yield from cross_check(registered_search_keys(),
+                               doc_rows(docs_dir), self.name)

@@ -1,0 +1,214 @@
+"""Pass 4 — thread-local / contextvar hygiene (ISSUE 15).
+
+Two PR-9 review-history bug classes, mechanized:
+
+A. **Denial-reason reset-first.** The plane-ladder denial reasons
+   (``staging_denied_reason`` / ``kernel_denied_reason``) are
+   thread-local by design: each query reads the reason ITS OWN ensure_*
+   call produced. The invariant that kept regressing: any function that
+   writes a non-None reason must reset the attribute to ``None`` BEFORE
+   its first early return — otherwise a thread whose last call was a
+   budget denial keeps reporting ``hbm_budget`` for what is now a mode
+   gap or staging fault. Tracked attributes: ``*denied_reason``.
+
+B. **Opaque-id restore.** ``set_opaque_id`` stamps the per-request
+   ``X-Opaque-Id`` contextvar; batch leaders stamp each MEMBER's id
+   while building its result and must restore their own snapshot
+   (``leader_oid = get_opaque_id()``) before every return — a stale
+   member id attributes the leader's subsequent slowlog/profile lines
+   to the wrong client. The pass walks each function's statements in
+   source order: a ``set_opaque_id(<non-snapshot>)`` marks the context
+   dirty, ``set_opaque_id(<snapshot var>)`` cleans it, and any
+   ``return`` (or falling off the end) while dirty is a finding. A
+   ``try/finally`` whose finally restores the snapshot makes the whole
+   function compliant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from elasticsearch_tpu.testing.lint.core import (
+    Finding,
+    LintPass,
+    SourceTree,
+    register_pass,
+)
+
+TRACKED_SUFFIX = "denied_reason"
+
+
+def _writes_tracked(node: ast.Assign) -> Optional[tuple]:
+    """(attr, is_none) when ``node`` writes self.*denied_reason."""
+    for t in node.targets:
+        if (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and t.attr.endswith(TRACKED_SUFFIX)):
+            is_none = (isinstance(node.value, ast.Constant)
+                       and node.value.value is None)
+            return t.attr, is_none
+    return None
+
+
+def _check_reset_first(fn: ast.FunctionDef, rel: str, qual: str,
+                       pass_name: str) -> Iterable[Finding]:
+    """Rule A for one function: collect tracked writes in source order;
+    a non-None write is only legal after a None reset in the same
+    function (property setters — one-statement passthroughs — are the
+    storage shim itself and exempt)."""
+    if any(isinstance(d, ast.Name) and d.id in ("property", "setter")
+           or isinstance(d, ast.Attribute) and d.attr == "setter"
+           for d in fn.decorator_list):
+        return
+    writes: List[tuple] = []  # (lineno, attr, is_none)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            w = _writes_tracked(node)
+            if w:
+                writes.append((node.lineno, w[0], w[1]))
+    writes.sort()
+    reset_seen: Set[str] = set()
+    flagged: Set[str] = set()
+    for lineno, attr, is_none in writes:
+        if is_none:
+            reset_seen.add(attr)
+        elif attr not in reset_seen and attr not in flagged:
+            flagged.add(attr)
+            yield Finding(
+                pass_name, rel, qual, lineno,
+                f"self.{attr} set to a non-None reason without a "
+                f"reset-to-None earlier in the same function: a stale "
+                f"thread-local from a previous call relabels this "
+                f"thread's next denial (PR-9 bug class) — reset FIRST, "
+                f"before every early return, or justify that every "
+                f"caller resets",
+                key=attr)
+
+
+# ---------------------------------------------------------------------------
+# Rule B: opaque-id restore
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_vars(fn: ast.FunctionDef) -> Set[str]:
+    """Names assigned from get_opaque_id() anywhere in the function."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            callee = node.value.func
+            name = (callee.id if isinstance(callee, ast.Name)
+                    else getattr(callee, "attr", None))
+            if name == "get_opaque_id":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _is_set_opaque(node: ast.AST) -> Optional[ast.Call]:
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        callee = node.value.func
+        name = (callee.id if isinstance(callee, ast.Name)
+                else getattr(callee, "attr", None))
+        if name == "set_opaque_id":
+            return node.value
+    return None
+
+
+def _finally_restores(fn: ast.FunctionDef, snaps: Set[str]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                call = _is_set_opaque(stmt)
+                if call and call.args and isinstance(call.args[0],
+                                                     ast.Name) \
+                        and call.args[0].id in snaps:
+                    return True
+    return False
+
+
+class _OpaqueScan:
+    """Source-order scan (a linear approximation of dominance — good
+    enough for the straight-line set/restore shapes the codebase uses,
+    and wrong answers land in the allowlist with a justification)."""
+
+    def __init__(self, snaps: Set[str]):
+        self.snaps = snaps
+        self.dirty_since: Optional[int] = None
+        self.dirty_returns: List[int] = []
+
+    def scan(self, stmts) -> None:
+        for stmt in stmts:
+            call = _is_set_opaque(stmt)
+            if call is not None:
+                arg = call.args[0] if call.args else None
+                if isinstance(arg, ast.Name) and arg.id in self.snaps:
+                    self.dirty_since = None
+                else:
+                    self.dirty_since = stmt.lineno
+                continue
+            if isinstance(stmt, ast.Return):
+                if self.dirty_since is not None:
+                    self.dirty_returns.append(stmt.lineno)
+                continue
+            for body in (getattr(stmt, "body", None),
+                         getattr(stmt, "orelse", None),
+                         getattr(stmt, "finalbody", None)):
+                if body:
+                    self.scan(body)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self.scan(handler.body)
+
+
+@register_pass
+class ThreadLocalHygienePass(LintPass):
+    name = "thread-local-hygiene"
+    description = ("thread-local denial reasons must reset-first; "
+                   "set_opaque_id must restore the leader's snapshot on "
+                   "every return path")
+    targets = None  # whole tree
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        for rel, sf in tree.files.items():
+            if rel.startswith("testing/lint/"):
+                continue
+            for qual, fn in sf.defs.items():
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                yield from _check_reset_first(fn, rel, qual, self.name)
+                # rule B — only functions that stamp a foreign id
+                sets = [n for n in ast.walk(fn)
+                        if _is_set_opaque(n) is not None]
+                if not sets:
+                    continue
+                snaps = _snapshot_vars(fn)
+                if (not snaps and len(sets) == 1
+                        and sets[0] in fn.body):
+                    # the request-entry stamp (REST dispatch): ONE
+                    # top-level set, no snapshot taken — each request
+                    # overwrites it on arrival, nothing later on the
+                    # thread reads the old value; the restore contract
+                    # is for leaders that stamp MEMBER ids
+                    continue
+                scan = _OpaqueScan(snaps)
+                scan.scan(fn.body)
+                if scan.dirty_since is None and not scan.dirty_returns:
+                    continue
+                if _finally_restores(fn, snaps):
+                    continue
+                lines = scan.dirty_returns or [scan.dirty_since]
+                for i, lineno in enumerate(lines, 1):
+                    where = ("return" if scan.dirty_returns
+                             else "function end")
+                    yield Finding(
+                        self.name, rel, qual, lineno,
+                        f"set_opaque_id stamped a member id but the "
+                        f"{where} is reached without restoring the "
+                        f"snapshot (leader_oid = get_opaque_id()) — "
+                        f"the stale id mis-attributes later slowlog/"
+                        f"profile lines (PR-9 bug class)",
+                        key=f"oid{i}" if len(lines) > 1 else "oid")

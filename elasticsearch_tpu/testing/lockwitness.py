@@ -1,0 +1,345 @@
+"""Runtime lock-order witness (ISSUE 15 — the dynamic half of pass 5).
+
+Eraser-style confirmation of docs/LOCK_ORDER.md: while installed, every
+``threading.Lock``/``threading.RLock`` CREATED from package code is
+wrapped in a shim that records, per thread, which lock sites were held
+when another site was acquired. At the end of a soak
+``assert_acyclic()`` fails if two sites were ever acquired in both
+orders — the observed-inversion signal static pass 5 approximates, but
+instance-accurate and inclusive of the paths the static graph cannot
+see (callback-mediated acquisition like the accountant's evict hooks,
+dynamic dispatch, thread hops).
+
+Scope and precision:
+
+- Only locks whose creation frame lies inside ``elasticsearch_tpu``
+  are instrumented; everything else (jax internals, stdlib Events
+  created by library code) gets a raw lock — zero overhead off-package.
+- Only locks CREATED while installed are observed. Module globals and
+  process singletons that predate the install window (``
+  _MESH_EXEC_LOCK``, the memory accountant's lock) would be invisible
+  — ``wrap_central_locks()`` closes exactly that gap by swapping a
+  shim over the live attribute (new acquisitions go through the shim,
+  the shim delegates to the SAME inner lock, so mutual exclusion with
+  any in-flight holder is preserved); ``uninstall()`` restores the
+  originals. The evict-callback paths the static graph cannot see are
+  observable only through these wrapped singletons.
+- A site is the CREATION statement (``file:line``), one node per site
+  regardless of how many instances it creates — matching the static
+  graph's granularity.
+- Same-site pairs (holding one instance of a site while acquiring
+  another instance of the same site, e.g. peer nodes locking each
+  other's engines) carry no order information at site granularity;
+  they are reported (``same_site_nestings``) but excluded from the
+  cycle assertion.
+- Reentrant RLock re-acquisition by the owning thread records nothing.
+
+Install via the ``lock_order_witness()`` context manager — the chaos
+soaks (testing/chaos.py) run their whole body under it and fold
+``report()`` into theirs; tests/test_contract_lint.py drives it
+directly with deliberate inversions.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_THIS_FILE = os.path.abspath(__file__)
+
+
+class LockOrderViolation(AssertionError):
+    """Two lock sites were observed acquired in both orders."""
+
+
+def _creation_site(skip_files: Tuple[str, ...]) -> Optional[str]:
+    """``relpath:lineno`` of the first package frame below the factory,
+    or None when the lock is created outside the package (frames inside
+    threading.py — Condition/Event/Semaphore internals — are skipped so
+    a ``threading.Event()`` in package code attributes to that code)."""
+    frame = sys._getframe(2)
+    while frame is not None:
+        fname = os.path.abspath(frame.f_code.co_filename)
+        if fname not in skip_files and not fname.endswith("threading.py"):
+            if fname.startswith(_PKG_DIR + os.sep):
+                rel = os.path.relpath(fname, _PKG_DIR).replace(os.sep, "/")
+                return f"{rel}:{frame.f_lineno}"
+            return None
+        frame = frame.f_back
+    return None
+
+
+class _Held(threading.local):
+    def __init__(self):
+        self.stack: List[str] = []  # site per successful acquisition
+
+
+class LockOrderWitness:
+    """One observation session. Use via :func:`lock_order_witness`."""
+
+    def __init__(self):
+        self._reg_lock = _thread.allocate_lock()
+        self._held = _Held()
+        # (held site, acquired site) -> observation count
+        self.pairs: Dict[Tuple[str, str], int] = {}
+        self._installed = False
+        self._orig_lock = None
+        self._orig_rlock = None
+        # (holder, attr, original) for wrap_existing restores
+        self._wrapped: List[Tuple[object, str, object]] = []
+
+    # -- bookkeeping (called from the shims) ---------------------------
+
+    def _note_acquired(self, site: str) -> None:
+        held = self._held.stack
+        for h in held:
+            key = (h, site)
+            with self._reg_lock:
+                self.pairs[key] = self.pairs.get(key, 0) + 1
+        held.append(site)
+
+    def _note_released(self, site: str) -> None:
+        held = self._held.stack
+        # remove the most recent matching acquisition; a release from a
+        # thread that never acquired (cross-thread handoff) is ignored
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    # -- install / uninstall -------------------------------------------
+
+    def install(self) -> "LockOrderWitness":
+        assert not self._installed
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        witness = self
+        orig_lock, orig_rlock = self._orig_lock, self._orig_rlock
+
+        def lock_factory():
+            site = _creation_site((_THIS_FILE,))
+            inner = orig_lock()
+            return inner if site is None else _LockShim(witness, site,
+                                                        inner)
+
+        def rlock_factory():
+            site = _creation_site((_THIS_FILE,))
+            inner = orig_rlock()
+            return inner if site is None else _RLockShim(witness, site,
+                                                         inner)
+
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        self._installed = True
+        return self
+
+    def wrap_existing(self, holder: object, attr: str,
+                      site: str) -> None:
+        """Swap an ALREADY-CREATED lock attribute for an instrumented
+        shim over the same inner lock (see module docstring: this is
+        how locks predating the install window become observable).
+        Restored by :meth:`uninstall`."""
+        inner = getattr(holder, attr)
+        if isinstance(inner, (_LockShim, _RLockShim)):
+            return
+        shim = (_RLockShim(self, site, inner)
+                if hasattr(inner, "_is_owned")  # C RLock protocol
+                else _LockShim(self, site, inner))
+        self._wrapped.append((holder, attr, inner))
+        setattr(holder, attr, shim)
+
+    def wrap_central_locks(self) -> None:
+        """Wrap the process singletons every soak cares about: the mesh
+        execution lock (module global, created at import) and the
+        device-memory accountant's lock (singleton, created on first
+        use — the lock every evict callback runs under)."""
+        from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.parallel import plan_exec
+
+        self.wrap_existing(plan_exec, "_MESH_EXEC_LOCK",
+                           "parallel/plan_exec.py:_MESH_EXEC_LOCK")
+        self.wrap_existing(memory_accountant(), "_lock",
+                           "common/memory.py:DeviceMemoryAccountant."
+                           "_lock")
+
+    def uninstall(self) -> None:
+        if self._installed:
+            threading.Lock = self._orig_lock
+            threading.RLock = self._orig_rlock
+            self._installed = False
+        while self._wrapped:
+            holder, attr, inner = self._wrapped.pop()
+            setattr(holder, attr, inner)
+
+    # -- analysis -------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], int]:
+        with self._reg_lock:
+            return {k: v for k, v in self.pairs.items() if k[0] != k[1]}
+
+    def same_site_nestings(self) -> Dict[str, int]:
+        with self._reg_lock:
+            return {a: n for (a, b), n in self.pairs.items() if a == b}
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """A cycle among distinct sites in the observed-order graph, or
+        None. Any cycle here means two threads interleaving those code
+        paths can deadlock."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges():
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {v: WHITE for v in adj}
+        parent: Dict[str, Optional[str]] = {}
+
+        def dfs(v: str) -> Optional[List[str]]:
+            color[v] = GREY
+            for w in sorted(adj[v]):
+                if color[w] == GREY:
+                    cycle = [w, v]
+                    p = parent.get(v)
+                    while p is not None and p != w:
+                        cycle.append(p)
+                        p = parent.get(p)
+                    cycle.reverse()
+                    return cycle
+                if color[w] == WHITE:
+                    parent[w] = v
+                    found = dfs(w)
+                    if found:
+                        return found
+            color[v] = BLACK
+            return None
+
+        for v in sorted(adj):
+            if color[v] == WHITE:
+                parent[v] = None
+                found = dfs(v)
+                if found:
+                    return found
+        return None
+
+    def assert_acyclic(self) -> None:
+        cycle = self.find_cycle()
+        if cycle is not None:
+            raise LockOrderViolation(
+                "lock sites acquired in conflicting orders (observed at "
+                "runtime): " + " -> ".join(cycle) + f" -> {cycle[0]} — "
+                "two threads interleaving these paths can deadlock; fix "
+                "the ordering (docs/LOCK_ORDER.md) or split the lock")
+
+    def report(self) -> dict:
+        edges = self.edges()
+        return {
+            "instrumented_edges": len(edges),
+            "observations": sum(edges.values()),
+            "same_site_nestings": self.same_site_nestings(),
+            "cycle": self.find_cycle(),
+        }
+
+
+class _LockShim:
+    """threading.Lock lookalike recording acquisition order."""
+
+    __slots__ = ("_witness", "_site", "_inner")
+
+    def __init__(self, witness: LockOrderWitness, site: str, inner):
+        self._witness = witness
+        self._site = site
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness._note_acquired(self._site)
+        return got
+
+    def release(self) -> None:
+        self._witness._note_released(self._site)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _RLockShim:
+    """threading.RLock lookalike; reentrant re-acquisition records no
+    edge, and the ``_release_save``/``_acquire_restore``/``_is_owned``
+    protocol keeps ``threading.Condition`` correct on top of it."""
+
+    __slots__ = ("_witness", "_site", "_inner", "_count")
+
+    def __init__(self, witness: LockOrderWitness, site: str, inner):
+        self._witness = witness
+        self._site = site
+        self._inner = inner
+        self._count = _Held()  # per-thread reentrancy depth
+
+    def _depth(self) -> int:
+        return len([s for s in self._count.stack if s == "d"])
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if self._depth() == 0:
+                self._witness._note_acquired(self._site)
+            self._count.stack.append("d")
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        if self._count.stack:
+            self._count.stack.pop()
+            if self._depth() == 0:
+                self._witness._note_released(self._site)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition protocol (wait() must fully release a reentrant hold)
+    def _release_save(self):
+        depth = self._depth()
+        self._count.stack = []
+        if depth:
+            self._witness._note_released(self._site)
+        state = self._inner._release_save()
+        return (state, depth)
+
+    def _acquire_restore(self, saved) -> None:
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        if depth:
+            self._witness._note_acquired(self._site)
+        self._count.stack = ["d"] * depth
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+class lock_order_witness:
+    """``with lock_order_witness() as w: ...; w.assert_acyclic()``"""
+
+    def __init__(self):
+        self.witness = LockOrderWitness()
+
+    def __enter__(self) -> LockOrderWitness:
+        return self.witness.install()
+
+    def __exit__(self, *exc) -> None:
+        self.witness.uninstall()

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Pre-PR contract gate (ISSUE 15, docs/STATIC_ANALYSIS.md): the AST
+# contract lints + lock-discipline analyzer, then the two registry
+# lints. Run it from the repo root before every PR:
+#
+#   scripts/check.sh            # the full gate
+#   scripts/check.sh --fast     # contract lints only (skip pytest)
+#
+# Exits non-zero on the first failing stage. The same checks run in
+# tier-1 (tests/test_contract_lint.py, tests/test_settings_registry.py,
+# tests/test_observability_registry.py) — this script is the fast local
+# loop, not a different gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== contract lints (python -m elasticsearch_tpu.testing.lint) =="
+python -m elasticsearch_tpu.testing.lint
+
+if [[ "${1:-}" == "--fast" ]]; then
+    exit 0
+fi
+
+echo "== registry lints =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_contract_lint.py \
+    tests/test_settings_registry.py \
+    tests/test_observability_registry.py

@@ -1,0 +1,34 @@
+"""Known-bad snippet for the cancellation-passthrough pass: the broad
+handler records a fault (quarantine) without letting
+TimeExceeded/TaskCancelled through first. Parsed only, never imported."""
+
+
+class BadLadder:
+    def serve(self, deadline):
+        try:
+            deadline.checkpoint()
+            return self.launch()
+        except Exception:  # BAD: swallows cancellation, records a fault
+            self.plane_health.record_failure("mesh_pallas")
+            return None
+
+
+class AlsoBadSwallow:
+    def serve(self, deadline):
+        try:
+            deadline.checkpoint()
+            return self.launch()
+        except Exception:  # BAD: cancellable body, silently eaten
+            return None
+
+
+class GoodLadder:
+    def serve(self, deadline):
+        try:
+            deadline.checkpoint()
+            return self.launch()
+        except (TaskCancelledException, TimeExceededException):  # noqa: F821
+            raise
+        except Exception:  # OK: cancellation already re-raised above
+            self.plane_health.record_failure("mesh_pallas")
+            return None

@@ -1,0 +1,23 @@
+"""Known-bad snippet for the counter-lock-discipline pass. Parsed only."""
+
+import threading
+
+
+class BadStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.query_total = 0
+        self.fallback_by_reason = {}
+
+    def note(self, reason):
+        self.query_total += 1  # BAD: read-modify-write outside the lock
+        self.fallback_by_reason[reason] = \
+            self.fallback_by_reason.get(reason, 0) + 1  # BAD too
+
+    def note_locked(self, reason):
+        # OK: *_locked naming convention — the caller holds self._lock
+        self.query_total += 1
+
+    def note_safe(self, reason):
+        with self._lock:
+            self.query_total += 1  # OK

@@ -1,0 +1,22 @@
+"""Known-bad snippet for the ledger-balance pass: a register with no
+evict callback, in a class with no release path. Parsed only."""
+
+from elasticsearch_tpu.common.memory import memory_accountant  # noqa: F401
+
+
+class BadStager:
+    def stage(self, nbytes):
+        # BAD on both axes: no evict= kwarg, and BadStager owns no
+        # release_scope/release_index call anywhere
+        memory_accountant().register(
+            "idx", "scope1", "postings_raw", "tbl", nbytes)
+
+
+class GoodStager:
+    def stage(self, nbytes):
+        acct = memory_accountant()
+        acct.register("idx", "scope2", "postings_raw", "tbl", nbytes,
+                      evict=self.drop)
+
+    def drop(self):
+        memory_accountant().release_scope("idx", "scope2")

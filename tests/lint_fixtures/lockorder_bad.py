@@ -1,0 +1,33 @@
+"""Known-bad snippet for the static lock-order pass: two locks acquired
+in both orders (an A->B->A cycle), plus a plain-Lock self-deadlock.
+Parsed only, never imported."""
+
+import threading
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+
+
+def forward():
+    with _LOCK_A:
+        with _LOCK_B:  # A -> B
+            pass
+
+
+def backward():
+    with _LOCK_B:
+        with _LOCK_A:  # B -> A: the deadlock cycle
+            pass
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._plain = threading.Lock()
+
+    def outer(self):
+        with self._plain:
+            self.inner()  # BAD: re-acquires the same plain Lock
+
+    def inner(self):
+        with self._plain:
+            pass

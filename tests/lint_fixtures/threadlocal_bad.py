@@ -1,0 +1,35 @@
+"""Known-bad snippet for the thread-local-hygiene pass. Parsed only."""
+
+
+class BadExecutor:
+    def ensure_plane(self):
+        # BAD: writes a non-None denial reason with no reset-to-None
+        # earlier in the function — a stale value from the previous call
+        # on this thread survives every path that doesn't reach here
+        if self.over_budget():
+            self.kernel_denied_reason = "hbm_budget"
+            return None
+        return self.session
+
+
+class BadLeader:
+    def run_members(self, oids, members):
+        from elasticsearch_tpu.search.telemetry import (  # noqa: F401
+            get_opaque_id,
+            set_opaque_id,
+        )
+
+        leader_oid = get_opaque_id()
+        for oid, member in zip(oids, members):
+            set_opaque_id(oid)
+            member()
+        return True  # BAD: falls off with the last member's id staged
+
+
+class GoodExecutor:
+    def ensure_plane(self):
+        self.kernel_denied_reason = None  # reset FIRST
+        if self.over_budget():
+            self.kernel_denied_reason = "hbm_budget"
+            return None
+        return self.session

@@ -1,0 +1,339 @@
+"""Contract-lint subsystem (ISSUE 15, docs/STATIC_ANALYSIS.md).
+
+Three layers, all tier-1:
+
+1. the GATE — every lint pass over the real source tree must be clean
+   (zero unallowlisted findings, no stale allowlist entries, every
+   entry justified) and docs/LOCK_ORDER.md must match the tree;
+2. the SELF-TESTS — each pass must flag its known-bad
+   tests/lint_fixtures snippet (a refactor of the analyzer cannot
+   silently stop detecting anything) and must NOT flag the good shape
+   sitting next to it;
+3. the runtime lock-order WITNESS — deliberate inversions are caught,
+   consistent orders and RLock reentrancy are not.
+"""
+
+import ast
+import os
+import threading
+
+import pytest
+
+from elasticsearch_tpu.testing.lint import (
+    Allowlist,
+    SourceTree,
+    all_passes,
+    run_lint,
+)
+from elasticsearch_tpu.testing.lint.core import repo_root
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def _fixture_findings(pass_name):
+    tree = SourceTree(root=FIXTURES, fixture_mode=True)
+    return list(all_passes()[pass_name].run(tree))
+
+
+# ---------------------------------------------------------------------------
+# 1. the gate
+# ---------------------------------------------------------------------------
+
+
+class TestContractLintGate:
+    def test_source_tree_clean(self):
+        result = run_lint()
+        assert not result.allowlist_errors, result.allowlist_errors
+        assert not result.stale_entries, (
+            f"stale allowlist entries (no finding matches — remove "
+            f"them): {result.stale_entries}")
+        assert not result.unallowlisted, (
+            "unallowlisted contract-lint findings:\n"
+            + "\n".join(f.render() for f in result.unallowlisted)
+            + "\nFix the violation, or — for a justified false positive"
+              " — add the id to elasticsearch_tpu/testing/lint/"
+              "allowlist.txt WITH a justification")
+
+    def test_at_least_five_passes_registered(self):
+        passes = all_passes()
+        assert len(passes) >= 5, sorted(passes)
+        for expected in ("cancellation-passthrough", "ledger-balance",
+                         "counter-lock-discipline",
+                         "thread-local-hygiene", "lock-order",
+                         "settings-docs"):
+            assert expected in passes
+
+    def test_lock_order_doc_fresh(self):
+        from elasticsearch_tpu.testing.lint.pass_lockorder import (
+            lock_graph_for,
+            render_lock_order,
+        )
+
+        doc = os.path.join(repo_root(), "docs", "LOCK_ORDER.md")
+        with open(doc, encoding="utf-8") as f:
+            on_disk = f.read()
+        current = render_lock_order(lock_graph_for(SourceTree()))
+        assert on_disk == current, (
+            "docs/LOCK_ORDER.md is stale — regenerate with `python -m "
+            "elasticsearch_tpu.testing.lint --emit-lock-order`")
+
+    def test_static_lock_graph_sees_the_real_tree(self):
+        # the analyzer is only trustworthy if it still finds the known
+        # lock population; anchor on sites that exist today
+        from elasticsearch_tpu.testing.lint.pass_lockorder import (
+            lock_graph_for,
+        )
+
+        lg = lock_graph_for(SourceTree())
+        assert len(lg.sites) >= 40, len(lg.sites)
+        assert len(lg.edges) >= 20, len(lg.edges)
+        for site in ("parallel.plan_exec._MESH_EXEC_LOCK",
+                     "common.memory.DeviceMemoryAccountant._lock",
+                     "parallel.plan_exec.IndexMeshSearch._stage_lock",
+                     "search.admission.SearchAdmissionController._lock"):
+            assert site in lg.sites, site
+        # the documented stage->accountant ordering is an edge the
+        # analyzer must keep seeing (try_reserve under _stage_lock)
+        assert ("parallel.plan_exec.IndexMeshSearch._stage_lock",
+                "common.memory.DeviceMemoryAccountant._lock") in lg.edges
+
+    def test_cli_main_exits_zero(self):
+        from elasticsearch_tpu.testing.lint.__main__ import main
+
+        assert main([]) == 0
+        assert main(["--list"]) == 0
+        assert main(["--pass", "no-such-pass"]) == 2
+
+    def test_allowlist_requires_justification(self, tmp_path):
+        p = tmp_path / "allow.txt"
+        p.write_text("some-pass:file.py:qual\n"
+                     "other-pass:file.py:qual |   \n")
+        allow = Allowlist.load(str(p))
+        assert len(allow.errors) == 2
+        assert not allow.entries
+
+
+# ---------------------------------------------------------------------------
+# 2. fixture self-tests — every pass must keep firing
+# ---------------------------------------------------------------------------
+
+
+class TestPassSelfTests:
+    def test_cancellation_pass_fires(self):
+        ids = {f.id for f in _fixture_findings("cancellation-passthrough")}
+        assert ("cancellation-passthrough:cancellation_bad.py:"
+                "BadLadder.serve") in ids
+        assert ("cancellation-passthrough:cancellation_bad.py:"
+                "AlsoBadSwallow.serve") in ids
+        assert not any("GoodLadder" in i for i in ids)
+
+    def test_ledger_pass_fires(self):
+        ids = {f.id for f in _fixture_findings("ledger-balance")}
+        assert ("ledger-balance:ledger_bad.py:BadStager.stage:evict"
+                in ids)
+        assert ("ledger-balance:ledger_bad.py:BadStager.stage:release"
+                in ids)
+        assert not any("GoodStager" in i for i in ids)
+
+    def test_counter_pass_fires(self):
+        ids = {f.id for f in _fixture_findings("counter-lock-discipline")}
+        assert ("counter-lock-discipline:counter_bad.py:BadStats.note:"
+                "query_total") in ids
+        assert ("counter-lock-discipline:counter_bad.py:BadStats.note:"
+                "fallback_by_reason") in ids
+        assert not any("note_locked" in i or "note_safe" in i
+                       for i in ids)
+
+    def test_threadlocal_pass_fires(self):
+        ids = {f.id for f in _fixture_findings("thread-local-hygiene")}
+        assert ("thread-local-hygiene:threadlocal_bad.py:"
+                "BadExecutor.ensure_plane:kernel_denied_reason") in ids
+        assert any(i.startswith("thread-local-hygiene:threadlocal_bad"
+                                ".py:BadLeader.run_members:oid")
+                   for i in ids)
+        assert not any("GoodExecutor" in i for i in ids)
+
+    def test_lockorder_pass_fires(self):
+        findings = _fixture_findings("lock-order")
+        keys = {f.key for f in findings}
+        assert any(k.startswith("cycle:") and "_LOCK_A" in k
+                   and "_LOCK_B" in k for k in keys), keys
+        assert any(f.qualname == "lockorder_bad.SelfDeadlock._plain"
+                   and f.key == "self-edge" for f in findings), findings
+
+    def test_settings_docs_pass_fires(self):
+        from elasticsearch_tpu.testing.lint.pass_settings_docs import (
+            cross_check,
+        )
+
+        findings = list(cross_check(
+            keys={"search.documented", "search.undocumented",
+                  "search.twice"},
+            rows={"search.documented": [("A.md", 1)],
+                  "search.twice": [("A.md", 2), ("B.md", 3)],
+                  "search.unregistered": [("A.md", 4)]},
+            pass_name="settings-docs"))
+        by_key = {f.key: f.message for f in findings}
+        assert "search.undocumented" in by_key
+        assert "no settings-table row" in by_key["search.undocumented"]
+        assert "search.twice" in by_key
+        assert "2 tables" in by_key["search.twice"]
+        assert "search.unregistered" in by_key
+        assert "search.documented" not in by_key
+
+    def test_fixture_files_parse(self):
+        # the snippets are parsed, never imported — keep them valid AST
+        for fname in sorted(os.listdir(FIXTURES)):
+            if fname.endswith(".py"):
+                with open(os.path.join(FIXTURES, fname)) as f:
+                    ast.parse(f.read())
+
+
+# ---------------------------------------------------------------------------
+# 3. runtime lock-order witness
+# ---------------------------------------------------------------------------
+
+
+class TestLockOrderWitness:
+    @pytest.fixture(autouse=True)
+    def _instrument_test_locks(self, monkeypatch):
+        # locks created by THIS file count as package locks for the
+        # duration (the witness only instruments in-package creations)
+        from elasticsearch_tpu.testing import lockwitness
+
+        monkeypatch.setattr(lockwitness, "_PKG_DIR",
+                            os.path.dirname(os.path.abspath(__file__)))
+
+    def test_consistent_order_is_green(self):
+        from elasticsearch_tpu.testing.lockwitness import (
+            lock_order_witness,
+        )
+
+        with lock_order_witness() as w:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def worker():
+                with a:
+                    with b:
+                        pass
+
+            threads = [threading.Thread(target=worker) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            with a:
+                with b:
+                    pass
+        assert w.edges(), "witness observed nothing"
+        assert w.find_cycle() is None
+        w.assert_acyclic()
+
+    def test_inversion_is_caught(self):
+        from elasticsearch_tpu.testing.lockwitness import (
+            LockOrderViolation,
+            lock_order_witness,
+        )
+
+        with lock_order_witness() as w:
+            a = threading.Lock()
+            b = threading.Lock()
+            # sequential on one thread: the ORDER inversion is the bug
+            # signal, no actual deadlock needed (Eraser-style)
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        assert w.find_cycle() is not None
+        with pytest.raises(LockOrderViolation):
+            w.assert_acyclic()
+
+    def test_rlock_reentrancy_records_no_pair(self):
+        from elasticsearch_tpu.testing.lockwitness import (
+            lock_order_witness,
+        )
+
+        with lock_order_witness() as w:
+            r = threading.RLock()
+            with r:
+                with r:  # reentrant: not an ordering observation
+                    pass
+        assert w.edges() == {}
+        assert w.same_site_nestings() == {}
+
+    def test_same_site_distinct_instances_reported_not_failed(self):
+        from elasticsearch_tpu.testing.lockwitness import (
+            lock_order_witness,
+        )
+
+        with lock_order_witness() as w:
+            l1, l2 = [threading.Lock() for _ in range(2)]
+            with l1:
+                with l2:  # same creation site, different instances
+                    pass
+        assert w.same_site_nestings(), "same-site nesting not recorded"
+        w.assert_acyclic()  # but never a failure by itself
+
+    def test_condition_and_event_still_work_installed(self):
+        from elasticsearch_tpu.testing.lockwitness import (
+            lock_order_witness,
+        )
+
+        with lock_order_witness():
+            cv = threading.Condition()
+            done = threading.Event()
+            out = []
+
+            def waiter():
+                with cv:
+                    while not out:
+                        cv.wait(timeout=5.0)
+                done.set()
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            with cv:
+                out.append(1)
+                cv.notify_all()
+            assert done.wait(timeout=5.0)
+            t.join(timeout=5.0)
+
+    def test_wrap_existing_observes_preexisting_locks(self):
+        # locks created BEFORE install (module globals, singletons) are
+        # invisible unless wrapped — the soak helper's central-lock gap
+        from elasticsearch_tpu.testing.lockwitness import (
+            lock_order_witness,
+        )
+
+        class Holder:
+            pass
+
+        h = Holder()
+        h.lock = threading.Lock()      # created pre-install
+        h.rlock = threading.RLock()
+        orig_lock, orig_rlock = h.lock, h.rlock
+        with lock_order_witness() as w:
+            w.wrap_existing(h, "lock", "pre:lock")
+            w.wrap_existing(h, "rlock", "pre:rlock")
+            with h.lock:
+                with h.rlock:
+                    pass
+        assert ("pre:lock", "pre:rlock") in w.edges()
+        # uninstall restored the original objects
+        assert h.lock is orig_lock
+        assert h.rlock is orig_rlock
+
+    def test_uninstall_restores_factories(self):
+        from elasticsearch_tpu.testing.lockwitness import (
+            lock_order_witness,
+        )
+
+        before_lock = threading.Lock
+        before_rlock = threading.RLock
+        with lock_order_witness():
+            assert threading.Lock is not before_lock
+        assert threading.Lock is before_lock
+        assert threading.RLock is before_rlock

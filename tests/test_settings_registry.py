@@ -143,6 +143,29 @@ class TestSettingsRegistryLint:
         finally:
             node.close()
 
+    def test_docs_cross_check_clean(self):
+        # ISSUE 15 (docs/STATIC_ANALYSIS.md): this lint's docs half now
+        # lives in the contract-lint subsystem — every registered
+        # search.* / index.search.* key must own exactly one docs/*.md
+        # settings-table row and vice versa; run that pass here so the
+        # settings story stays one test file for discoverability
+        from elasticsearch_tpu.testing.lint import Allowlist
+        from elasticsearch_tpu.testing.lint.core import repo_root
+        from elasticsearch_tpu.testing.lint.pass_settings_docs import (
+            cross_check,
+            doc_rows,
+            registered_search_keys,
+        )
+
+        allow = Allowlist.load()
+        findings = [
+            f for f in cross_check(
+                registered_search_keys(),
+                doc_rows(os.path.join(repo_root(), "docs")),
+                "settings-docs")
+            if f.id not in allow.entries]
+        assert not findings, "\n".join(f.render() for f in findings)
+
     def test_overload_settings_seeded_by_create_index(self):
         # the admission controller reads its config from the index's
         # Settings map: node-file values must reach indices created
