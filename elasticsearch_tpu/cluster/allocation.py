@@ -87,7 +87,8 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
              node_info: Optional[Dict[str, dict]] = None,
              awareness_attributes: Optional[List[str]] = None,
              watermark_low: float = WATERMARK_LOW,
-             watermark_high: float = WATERMARK_HIGH) -> RoutingTable:
+             watermark_high: float = WATERMARK_HIGH,
+             no_fresh_primary: Optional[set] = None) -> RoutingTable:
     """Compute the routing table for the current node set.
 
     indices_meta: {name: IndexMetadata}. Copies on departed nodes are
@@ -96,7 +97,13 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
     unassigned copies fill onto the least-loaded eligible node.
     node_info: {node_id: {"attrs": {...}, "disk": used_fraction}} — feeds
     the disk-threshold + awareness deciders.
+    no_fresh_primary: (index, sid) keys that must NEVER receive a fresh
+    empty primary (ISSUE 16 corruption quarantine: the shard HAD data —
+    its last copy is corrupt-retained — so filling an empty primary
+    would be silent data-loss resurrection; the shard stays red until a
+    verified copy returns via snapshot restore or marker repair).
     """
+    no_fresh_primary = no_fresh_primary or set()
     previous = previous or {}
     alive = set(data_nodes)
     # DiskThresholdMonitor: nodes above the high watermark shed replicas —
@@ -182,6 +189,11 @@ def allocate(indices_meta: Dict, data_nodes: List[str],
         for sid in range(md.num_shards):
             copies = table[name][sid]
             if not any(c.primary for c in copies):
+                if (name, sid) in no_fresh_primary:
+                    # corrupt-retained last copy (ISSUE 16): the shard
+                    # had data — an empty primary here would resurrect
+                    # the index over lost bytes. Stays red/unassigned.
+                    continue
                 # reached only when the shard never had copies (fresh
                 # index / previously unplaceable): a shard that LOST its
                 # data keeps its departed primary routed above, so it

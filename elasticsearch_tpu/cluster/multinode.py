@@ -25,6 +25,7 @@ optimization this path adds later).
 from __future__ import annotations
 
 import base64
+import hashlib
 import os
 import shutil
 import threading
@@ -51,7 +52,9 @@ from elasticsearch_tpu.common.errors import (
 )
 from elasticsearch_tpu.common import settings as S
 from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.integrity import integrity_service
 from elasticsearch_tpu.index.shard import IndexShard
+from elasticsearch_tpu.index.store import CorruptIndexException
 from elasticsearch_tpu.mapper.mapping import MapperService
 from elasticsearch_tpu.transport.local import (
     ConnectionHealth,
@@ -297,6 +300,12 @@ class ClusterNode:
         self._recovery_session_seq = 0
         # indices.recovery.max_bytes_per_sec analog (None = unthrottled)
         self.recovery_max_bytes_per_sec: Optional[float] = None
+        # master-side registry of last-copy corruption (ISSUE 16):
+        # (index, sid) -> {"node", "reason"} — the copy stays routed
+        # (dropping it would let the allocator fill a fresh EMPTY
+        # primary, i.e. silent data loss) and the quarantine is surfaced
+        # through allocation explain / _cat/shards instead
+        self.corrupt_retained: Dict[Tuple[str, int], dict] = {}
         self._register_handlers()
 
     # ------------------------------------------------------------------
@@ -893,6 +902,12 @@ class ClusterNode:
 
     def _master_reroute_locked(self) -> Tuple[dict, list]:
         data_nodes = [n for n in self.known_nodes]  # all nodes are data nodes here
+        # prune the corrupt-retained registry: a deleted index releases
+        # its keys (a RECREATED index with the same name must get a
+        # fresh primary — it never had the lost data)
+        self.corrupt_retained = {
+            k: v for k, v in self.corrupt_retained.items()
+            if k[0] in self.indices_meta}
         old_primaries = {
             (index, sid): copy.node_id
             for index, shards in self.routing.items()
@@ -902,7 +917,8 @@ class ClusterNode:
         self.routing = allocate(
             self.indices_meta, data_nodes, self.routing,
             node_info=self.node_info_map,
-            awareness_attributes=self.awareness_attributes or None)
+            awareness_attributes=self.awareness_attributes or None,
+            no_fresh_primary=set(self.corrupt_retained) or None)
         # bump the term wherever the primary copy moved to another node
         # (promotion after failure, cancel+reassign): the old primary may
         # still be alive and issuing writes — the higher term fences it
@@ -1098,9 +1114,27 @@ class ClusterNode:
                         shard.engine.store.read_commit() is not None
                         or os.path.exists(os.path.join(
                             shard_path, "translog", "translog.ckp"))):
-                    # restart over an existing data path: store load +
-                    # translog replay bring back every acked write
-                    shard.recover_from_store()
+                    try:
+                        # restart over an existing data path: store load +
+                        # translog replay bring back every acked write
+                        shard.recover_from_store()
+                    except CorruptIndexException as e:
+                        # marked/corrupt bytes under the data path: never
+                        # reload them. Quarantine the copy — a replica
+                        # heals via peer recovery (the file pull wipes the
+                        # directory and installs a verified set); a
+                        # primary stays quarantined and fails reads
+                        # loudly until a healthy copy takes over.
+                        integrity_service().record_corruption(
+                            index, sid, "load", str(e))
+                        already = shard.engine.store.is_corrupted()
+                        marker = shard.engine.store.mark_corrupted(
+                            str(e), site="load")
+                        if not already:
+                            integrity_service().record_marker(
+                                index, sid, marker, action="marked")
+                        shard.store_corrupted = True
+                        shard.start_fresh()
                 else:
                     shard.start_fresh()
                 if copy.primary:
@@ -1171,9 +1205,35 @@ class ClusterNode:
     # Recovery (ops-based peer recovery, §3.5)
     # ------------------------------------------------------------------
 
-    def _recover_replica(self, index: str, sid: int) -> None:
+    def _schedule_recovery_retry(self, index: str, sid: int,
+                                 attempt: int) -> None:
+        """Re-run a replica recovery that hit a transient race: the new
+        primary's promotion can ride the SAME publish that assigned this
+        INITIALIZING copy, so the source answers "not the primary" until
+        it applies that state itself — and with no further state change
+        coming, nothing would re-defer the recovery and the copy would
+        park INITIALIZING forever. Bounded backoff, off the publish path
+        (deferred actions run inside the commit RPC)."""
+        if attempt >= 5:
+            return
+
+        def retry():
+            copy = next((c for c in self.routing.get(index, {}).get(sid, [])
+                         if c.node_id == self.node_id), None)
+            if copy is None or copy.primary \
+                    or copy.state != ShardRoutingState.INITIALIZING:
+                return  # no longer ours to recover
+            self._recover_replica(index, sid, _attempt=attempt + 1)
+
+        t = threading.Timer(0.2 * (attempt + 1), retry)
+        t.daemon = True
+        t.start()
+
+    def _recover_replica(self, index: str, sid: int,
+                         _attempt: int = 0) -> None:
         primary_node = self._primary_node(index, sid)
         if primary_node is None or primary_node == self.node_id:
+            self._schedule_recovery_retry(index, sid, _attempt)
             return
         # _cat/recovery progress (RecoveryState analog): one row per
         # copy, updated through every stage of this recovery. A RE-run
@@ -1190,6 +1250,26 @@ class ClusterNode:
         above_seqno = -1
         try:
             above_seqno = self._pull_recovery_files(index, sid, primary_node)
+        except CorruptIndexException as e:
+            # corrupt bytes detected while installing the shipped set
+            # (digest mismatch or checksum failure on install): retry the
+            # whole session ONCE — transport-hop corruption is transient
+            # and a fresh pull starts from a clean directory (PR-2 retry
+            # machinery covers the per-chunk layer). A second failure
+            # falls back to full ops replay, which rebuilds a correct
+            # copy from the primary's live docs.
+            integrity_service().record_corruption(
+                index, sid, "recovery", str(e))
+            try:
+                above_seqno = self._pull_recovery_files(
+                    index, sid, primary_node)
+            except CorruptIndexException as e2:
+                integrity_service().record_corruption(
+                    index, sid, "recovery", str(e2))
+                above_seqno = -1
+            except (NodeNotConnectedException, ElasticsearchTpuException,
+                    OSError, ValueError):
+                above_seqno = -1
         except (NodeNotConnectedException, ElasticsearchTpuException,
                 OSError, ValueError):
             above_seqno = -1
@@ -1204,7 +1284,10 @@ class ClusterNode:
                 timeout=self.recovery_action_timeout,
                 retry=self.recovery_retry)
         except (NodeNotConnectedException, ElasticsearchTpuException):
-            return  # retries with backoff exhausted; next reroute retries
+            # retries with backoff exhausted — often the publish-ordering
+            # race above (source not yet primary): retry off-path
+            self._schedule_recovery_retry(index, sid, _attempt)
+            return
         # recovery runs outside the node lock (deferred from
         # _apply_state): a concurrent newer state may have removed the
         # local copy in the meantime — bail instead of KeyError-ing
@@ -1242,8 +1325,11 @@ class ClusterNode:
             except (NodeNotConnectedException, ElasticsearchTpuException):
                 pass
             if fin is None:
-                return  # primary unreachable: stay INITIALIZING; the next
-                # cluster-state publish or master health check re-runs recovery
+                # primary unreachable: stay INITIALIZING; the bounded
+                # backoff (or the next publish / master health check)
+                # re-runs the recovery from the top — it is idempotent
+                self._schedule_recovery_retry(index, sid, _attempt)
+                return
             if not fin.get("ops"):
                 break
             # delta ops may race with the live write fan-out (this copy is
@@ -1307,13 +1393,23 @@ class ClusterNode:
             raise ElasticsearchTpuException(
                 f"recovery source is not the primary for "
                 f"[{payload['index']}][{payload['shard']}]")
-        shard.flush()  # durable commit: segments + tombstones + terms
         store = shard.engine.store
+        if store.is_corrupted():
+            # a marked copy must never be a recovery source: shipping its
+            # bytes would propagate the corruption to a healthy target
+            raise ElasticsearchTpuException(
+                f"recovery source [{payload['index']}][{payload['shard']}]"
+                f" on [{self.node_id}] is marked corrupted")
+        shard.flush()  # durable commit: segments + tombstones + terms
         commit = store.read_commit() or {}
         files: Dict[str, bytes] = {}
         base = store.directory
+        from elasticsearch_tpu.index.store import MARKER_PREFIX
         for root, _dirs, names in os.walk(base):
             for name in names:
+                if (root == base and name.startswith(MARKER_PREFIX)
+                        and name.endswith(".json")):
+                    continue  # corruption markers never ship
                 full = os.path.join(root, name)
                 rel = os.path.relpath(full, base)
                 with open(full, "rb") as f:
@@ -1332,7 +1428,13 @@ class ClusterNode:
                 "files": files, "t0": time.monotonic(),
                 "last_used": time.monotonic(), "sent": 0, "target": src,
             }
-        manifest = [{"path": p, "size": len(b)} for p, b in files.items()]
+        # per-file SHA-256 digests ride the manifest (ISSUE 16): the
+        # target verifies every installed file against the SOURCE's
+        # digest before adopting the set — the transport/disk hop can
+        # never silently corrupt a copy
+        manifest = [{"path": p, "size": len(b),
+                     "digest": hashlib.sha256(b).hexdigest()}
+                    for p, b in files.items()]
         return {"session": session, "files": manifest,
                 "max_seq_no": int(commit.get("max_seq_no", -1))}
 
@@ -1414,6 +1516,10 @@ class ClusterNode:
     def _pull_session_files(self, shard, start: dict,
                             primary_node: str) -> int:
         store = shard.engine.store
+        # capture markers before the wipe: a successful install below is
+        # the ONE legal transition out of quarantine, and the clears must
+        # land in the integrity event ring (ISSUE 16)
+        prior_markers = store.corruption_markers()
         # a retry may leave partial files behind — start clean
         shutil.rmtree(store.directory, ignore_errors=True)
         os.makedirs(store.directory, exist_ok=True)
@@ -1449,12 +1555,29 @@ class ClusterNode:
             if os.path.getsize(full) != size:
                 raise ElasticsearchTpuException(
                     f"short file [{rel}]: {os.path.getsize(full)} != {size}")
+            # verify the installed bytes against the SOURCE's digest
+            # before adopting (Lucene verifies checksums on every file
+            # adoption the same way) — a mismatch is corruption in
+            # flight, caught before recover_from_store can read it
+            expected = entry.get("digest")
+            if expected is not None:
+                with open(full, "rb") as rf:
+                    actual = hashlib.sha256(rf.read()).hexdigest()
+                if actual != expected:
+                    raise CorruptIndexException(
+                        f"recovery file [{rel}] digest mismatch "
+                        f"(source={expected[:12]}, installed={actual[:12]})")
             record_recovery_progress(shard.index_name, shard.shard_id,
                                      self.node_id, add_files_recovered=1)
         # install: load the shipped commit (verifies per-segment
         # checksums), rebuild the version map and tombstones — the same
         # path a restarting node uses (IndexShard.recover_from_store)
         shard.recover_from_store()
+        # the verified set is installed: the copy leaves quarantine
+        for marker in prior_markers:
+            integrity_service().record_marker(
+                shard.index_name, shard.shard_id, marker, action="cleared")
+        shard.store_corrupted = False
         return int(start["max_seq_no"])
 
     def _on_recovery_files_close(self, payload, src) -> dict:
@@ -1592,6 +1715,26 @@ class ClusterNode:
                 # a benign no-op, not a crash across the reporter's RPC
                 raise NotMasterException("index no longer routed")
             copies = self.routing[payload["index"]].get(payload["shard"], [])
+            if payload.get("corrupt"):
+                key = (payload["index"], payload["shard"])
+                survivors = [
+                    c for c in copies
+                    if c.node_id != payload["node"]
+                    and c.state == ShardRoutingState.STARTED]
+                if not survivors:
+                    # LAST-copy corruption: dropping it would let the
+                    # allocator fill a fresh EMPTY primary — silent
+                    # data-loss resurrection. Retain the copy routed
+                    # (quarantined on its node, every read fails loudly)
+                    # and surface the marker via allocation explain /
+                    # _cat/shards until an operator restores a snapshot
+                    # or the bytes are repaired out of band.
+                    self.corrupt_retained[key] = {
+                        "node": payload["node"],
+                        "reason": payload.get("reason", ""),
+                    }
+                    raise NotMasterException("last copy retained")
+                self.corrupt_retained.pop(key, None)
             self.routing[payload["index"]][payload["shard"]] = [
                 c for c in copies if c.node_id != payload["node"]
             ]
@@ -1788,10 +1931,27 @@ class ClusterNode:
         prev_oid = get_opaque_id()
         set_opaque_id(headers.get("X-Opaque-Id") or prev_oid)
         try:
-            result = shard.searcher.query(body,
-                                          size_hint=payload.get("k", 10))
-            hits = fetch_hits(result.refs, {shard.shard_id: shard}, body,
-                              payload["index"])
+            if getattr(shard, "store_corrupted", False):
+                # quarantined copy: fail fast (no re-read of marked
+                # bytes, no re-detection) — the coordinator fails over
+                # to the next ranked copy (ClusterClient.search)
+                raise CorruptIndexException(
+                    f"shard [{payload['index']}][{payload['shard']}] "
+                    f"copy on [{self.node_id}] is marked corrupted")
+            try:
+                result = shard.searcher.query(body,
+                                              size_hint=payload.get("k", 10))
+                hits = fetch_hits(result.refs, {shard.shard_id: shard},
+                                  body, payload["index"])
+            except CorruptIndexException as e:
+                # first detection on the cluster query path: quarantine
+                # this copy and tell the master so a healthy copy takes
+                # over (promotion / re-recovery); re-raise so the
+                # coordinator's failover walk tries the next copy — the
+                # PR-4 partial contract, never a silent wrong result
+                self._fail_corrupted_copy(
+                    payload["index"], payload["shard"], shard, e)
+                raise
         finally:
             set_opaque_id(prev_oid)
         for ref, hit in zip(result.refs, hits):
@@ -1801,6 +1961,43 @@ class ClusterNode:
             "max_score": result.max_score,
             "hits": hits,
         }
+
+    def _fail_corrupted_copy(self, index: str, sid: int, shard,
+                             exc: Exception) -> None:
+        """Local quarantine + master report for a corrupt copy detected
+        on the serve path (ISSUE 16): write the marker, flag the shard,
+        release its device staging through the accountant (ledger exact
+        — a quarantined copy must not pin HBM), then report our own copy
+        failed with the corrupt flag so the master heals — replica:
+        re-recover from the primary; primary: fail over to a STARTED
+        replica; last copy: retained quarantined (RED), never replaced
+        with a fresh empty primary."""
+        store = shard.engine.store
+        integ = integrity_service()
+        integ.record_corruption(index, sid, "query", str(exc))
+        already = store.is_corrupted()
+        marker = store.mark_corrupted(str(exc), site="query")
+        if not already:
+            integ.record_marker(index, sid, marker, action="marked")
+        shard.store_corrupted = True
+        for seg in list(shard.engine.segments):
+            try:
+                seg.release_device_staging()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass  # shard close's release backstop covers it
+        try:
+            self.transport.send_request(
+                self.master_id, ACTION_SHARD_FAILED, {
+                    "index": index, "shard": sid, "node": self.node_id,
+                    "corrupt": True, "reason": str(exc)[:200],
+                },
+                timeout=self.request_timeout, retry=self.report_retry)
+        except (NodeNotConnectedException, ElasticsearchTpuException,
+                FailedToCommitClusterStateException):
+            # unreachable/stepped-down master: the copy stays quarantined
+            # locally (queries fail over); the next master health pass or
+            # state publish re-reports through reconciliation
+            pass
 
     def _on_refresh(self, payload, src) -> dict:
         shard = self.shards.get((payload["index"], payload["shard"]))

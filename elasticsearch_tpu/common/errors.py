@@ -179,6 +179,15 @@ class TranslogCorruptedException(ElasticsearchTpuException):
     status_code = 500
 
 
+class CorruptedSnapshotException(ElasticsearchTpuException):
+    """Snapshot blob bytes no longer match the per-file digests the
+    create recorded in the manifest (ES: CorruptedSnapshotException,
+    snake type ``corrupted_snapshot_exception``) — the restore of THAT
+    index fails rather than installing unverified bytes (ISSUE 16)."""
+
+    status_code = 500
+
+
 class SearchPhaseExecutionException(ElasticsearchTpuException):
     status_code = 500
 

@@ -1,0 +1,174 @@
+"""IntegrityService: the corruption-detection ledger (ISSUE 16).
+
+Role model: the reference's corruption bookkeeping spread across
+``Store.markStoreCorrupted`` + ``ShardStateMetaData`` + the
+``indices.stats`` store block — pulled into one process singleton so
+every detection site (store load, peer-recovery file install, snapshot
+restore, query-path staging, the background scrubber) reports through
+the same counters and the ``_stats`` integrity block can answer "has
+this node ever served — or refused to serve — corrupt bytes, and
+where was it caught?".
+
+Three pieces (docs/OBSERVABILITY.md "Data integrity"):
+
+- ``corruption_detected_total`` + the per-site split
+  (``corruption_detected_by_site``): one increment per DETECTION, keyed
+  by where the bad bytes were caught (``load``, ``recovery``,
+  ``restore``, ``query``, ``scrub``, ``snapshot``). Detection is the
+  contract: a corruption nobody counted is a corruption that may have
+  served.
+
+- the **marker events ring**: every ``corrupted_*`` marker write and
+  clear appends ``{index, shard, site, reason, marker, action}`` to a
+  bounded ring — the operator's join key between a RED shard in
+  ``_cat/shards`` and the detection that quarantined it.
+
+- the **scrub counters**: ``scrub_runs_total`` /
+  ``scrub_bytes_verified_total`` / ``scrub_drift_total`` — how much the
+  background scrubber (``index.scrub.interval``) has re-verified and
+  how often device-staged tables drifted from host truth (each drift
+  invalidates the staging and restages with lifecycle reason
+  ``scrub`` — drifted tables count, never serve).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Detection sites (the per-site axis of corruption_detected_by_site).
+# Every detection site classifies itself onto these:
+#   load      segment load over an existing data path (boot/reconcile)
+#   recovery  peer-recovery file install digest verification
+#   restore   snapshot restore manifest-digest verification
+#   query     a CorruptIndexException surfacing on the search path
+#   scrub     the background scrubber (checksums or device drift)
+#   snapshot  snapshot create reading a copy that fails verification
+SITES = ("load", "recovery", "restore", "query", "scrub", "snapshot")
+
+
+class IntegrityService:
+    """Process-wide corruption/scrub ledger (thread-safe)."""
+
+    MAX_EVENTS = 128
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.corruption_detected_total = 0
+        self._by_site: Dict[str, int] = {site: 0 for site in SITES}
+        self.scrub_runs_total = 0
+        self.scrub_bytes_verified_total = 0
+        self.scrub_drift_total = 0
+        self.markers_written_total = 0
+        self.markers_cleared_total = 0
+        self.marker_events: List[dict] = []
+        self.events_dropped = 0
+
+    def _push(self, event: dict) -> None:
+        self.marker_events.append(event)
+        if len(self.marker_events) > self.MAX_EVENTS:
+            del self.marker_events[0]
+            self.events_dropped += 1
+
+    # -- detection -------------------------------------------------------
+
+    def record_corruption(self, index: str, shard: int, site: str,
+                          reason: str) -> None:
+        """One detected corruption (counted at DETECTION, before any
+        quarantine/heal side effects run — even a failed heal leaves
+        the detection visible)."""
+        assert site in SITES, site
+        with self._lock:
+            self.corruption_detected_total += 1
+            self._by_site[site] += 1
+            self._push({
+                "action": "detected", "index": index or "_unknown",
+                "shard": int(shard), "site": site,
+                "reason": str(reason)[:200],
+                "timestamp_ms": int(time.time() * 1000),
+            })
+
+    def record_marker(self, index: str, shard: int, marker: dict, *,
+                      action: str = "marked") -> None:
+        """A ``corrupted_*`` marker lifecycle event (``marked`` when the
+        quarantine wrote it, ``cleared`` when a successful re-recovery
+        replaced the bytes)."""
+        assert action in ("marked", "cleared"), action
+        with self._lock:
+            if action == "marked":
+                self.markers_written_total += 1
+            else:
+                self.markers_cleared_total += 1
+            self._push({
+                "action": action, "index": index or "_unknown",
+                "shard": int(shard),
+                "site": str(marker.get("site", "load")),
+                "reason": str(marker.get("reason", ""))[:200],
+                "marker": str(marker.get("marker", "")),
+                "timestamp_ms": int(time.time() * 1000),
+            })
+
+    # -- scrubber --------------------------------------------------------
+
+    def record_scrub_run(self, nbytes_verified: int) -> None:
+        with self._lock:
+            self.scrub_runs_total += 1
+            self.scrub_bytes_verified_total += max(0, int(nbytes_verified))
+
+    def record_scrub_drift(self, index: str, shard: int, scope: str,
+                           kind: str) -> None:
+        """Device-staged table digest drifted from host truth: the
+        staging was invalidated (restage reason ``scrub``) — the drifted
+        bytes never served."""
+        with self._lock:
+            self.scrub_drift_total += 1
+            self._push({
+                "action": "drift", "index": index or "_unknown",
+                "shard": int(shard), "site": "scrub",
+                "reason": f"device staging drift [{scope}/{kind}]",
+                "timestamp_ms": int(time.time() * 1000),
+            })
+
+    # -- export ----------------------------------------------------------
+
+    def stats(self, index: Optional[str] = None) -> dict:
+        """The ``search.integrity`` stats block (per index, or node-wide
+        with ``index=None``). Counters are node-global (detections on a
+        since-deleted index must stay visible); the event ring filters
+        per index."""
+        with self._lock:
+            events = (list(self.marker_events) if index is None
+                      else [e for e in self.marker_events
+                            if e["index"] == index])
+            return {
+                "corruption_detected_total": self.corruption_detected_total,
+                "corruption_detected_by_site": dict(self._by_site),
+                "scrub_runs_total": self.scrub_runs_total,
+                "scrub_bytes_verified_total": self.scrub_bytes_verified_total,
+                "scrub_drift_total": self.scrub_drift_total,
+                "markers_written_total": self.markers_written_total,
+                "markers_cleared_total": self.markers_cleared_total,
+                "marker_events": events,
+                "events_dropped": self.events_dropped,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Process-level singleton (detection sites reach it through
+# integrity_service(); mirrors the memory_accountant() idiom)
+# ---------------------------------------------------------------------------
+
+_service: Optional[IntegrityService] = None
+_service_lock = threading.Lock()
+
+
+def integrity_service() -> IntegrityService:
+    global _service
+    svc = _service
+    if svc is not None:
+        return svc
+    with _service_lock:
+        if _service is None:
+            _service = IntegrityService()
+        return _service

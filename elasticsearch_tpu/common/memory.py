@@ -66,8 +66,12 @@ KINDS = (KIND_POSTINGS_RAW, KIND_POSTINGS_PACKED, KIND_LIVE_MASK,
 #                       sublane ladder) changed shape
 #   probe               re-staged on demand after an eviction or a
 #                       quarantine probe
+#   scrub               the background scrubber (ISSUE 16,
+#                       index.scrub.interval) found device/host digest
+#                       drift and invalidated the staging — the restage
+#                       re-adopts host truth
 REASONS = ("initial", "refresh", "delete_invalidation", "geometry_change",
-           "probe")
+           "probe", "scrub")
 
 
 class _Entry:

@@ -869,6 +869,15 @@ INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN = Setting.time_setting(
     "index.search.plane_quarantine.cooldown", "60s", scope=Scope.INDEX,
     dynamic=True
 )
+INDEX_SCRUB_INTERVAL = Setting.time_setting(
+    # background store/device scrubber (ISSUE 16, docs/RESILIENCE.md
+    # "Data integrity"): re-verify sealed-segment checksums and compare
+    # a sampled digest of device-staged tables against host truth every
+    # interval. Off by default (None/negative disables) — scrubbing
+    # reads every committed byte, so operators opt in per index or via
+    # the cluster-level override like every other dynamic knob
+    "index.scrub.interval", None, scope=Scope.INDEX, dynamic=True
+)
 INDEX_SEARCH_SLOWLOG_WARN = Setting.time_setting(
     "index.search.slowlog.threshold.query.warn", None, scope=Scope.INDEX,
     dynamic=True
@@ -885,6 +894,7 @@ INDEX_SETTINGS = [
     INDEX_SEARCH_PALLAS_POSTINGS_CODEC,
     INDEX_SEARCH_AGGS_FUSED,
     INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN,
+    INDEX_SCRUB_INTERVAL,
     INDEX_SEARCH_SLOWLOG_WARN,
     INDEX_SEARCH_SLOWLOG_INFO,
     INDEX_NUMBER_OF_SHARDS,

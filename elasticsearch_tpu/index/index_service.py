@@ -29,7 +29,9 @@ from elasticsearch_tpu.common.settings import (
     INDEX_TRANSLOG_DURABILITY,
     Settings,
 )
+from elasticsearch_tpu.common.integrity import integrity_service
 from elasticsearch_tpu.index.shard import IndexShard
+from elasticsearch_tpu.index.store import CorruptIndexException
 from elasticsearch_tpu.mapper.mapping import MapperService
 from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggregations
 from elasticsearch_tpu.search.service import fetch_hits, merge_refs, normalize_sort
@@ -95,15 +97,23 @@ class IndexService:
             shard.searcher.num_shards = self.num_shards
             shard.searcher.max_slices = settings.get_int(
                 "index.max_slices_per_scroll", 1024)
-            if shard_path and shard.engine.store.read_commit() is not None:
-                shard.recover_from_store()
-            elif shard_path and os.path.exists(
-                os.path.join(shard_path, "translog", "translog.ckp")
-            ):
-                shard.recover_from_store()
-            else:
-                shard.start_fresh()
             self.shards[sid] = shard
+            try:
+                if shard_path and shard.engine.store.read_commit() is not None:
+                    shard.recover_from_store()
+                elif shard_path and os.path.exists(
+                    os.path.join(shard_path, "translog", "translog.ckp")
+                ):
+                    shard.recover_from_store()
+                else:
+                    shard.start_fresh()
+            except CorruptIndexException as e:
+                # boot over corrupt/marked bytes (ISSUE 16): quarantine
+                # the copy instead of crashing index open — the shard
+                # stays allocated but every query against it fails into
+                # failures[] (never silent empty hits), and a healthy
+                # copy elsewhere (replica / snapshot) is the way back
+                self._quarantine_shard(sid, e, site="load")
         # periodic NRT refresh (index.refresh_interval, default 1s; -1
         # disables — IndexService#getRefreshInterval + refresh scheduling)
         # mesh-executed query phase (parallel/plan_exec.IndexMeshSearch):
@@ -205,6 +215,18 @@ class IndexService:
 
             threading.Thread(target=_refresh_loop, daemon=True,
                              name=f"refresh[{name}]").start()
+        # background store/device scrubber (ISSUE 16, docs/RESILIENCE.md
+        # "Data integrity"): index.scrub.interval, off by default. The
+        # thread always runs (cheap idle poll) so turning the knob on
+        # dynamically — via _settings or the cluster-level override —
+        # needs no thread lifecycle management; each wake re-reads the
+        # effective interval.
+        import threading as _scrub_threading
+
+        self.scrub_interval_override: Optional[float] = None
+        self._scrub_stop = _scrub_threading.Event()
+        _scrub_threading.Thread(target=self._scrub_loop, daemon=True,
+                                name=f"scrub[{name}]").start()
 
     def _rebuild_parents(self) -> None:
         """Re-derive the _parent registry from recovered shard state: the
@@ -225,6 +247,145 @@ class IndexService:
             for local, p in enumerate(getattr(buf, "parents", []) or []):
                 if p is not None and local not in eng._buffer_deletes:
                     self.parents[str(buf.doc_ids[local])] = str(p)
+
+    # ------------------------------------------------------------------
+    # Corruption quarantine + the background scrubber (ISSUE 16)
+    # ------------------------------------------------------------------
+
+    def _quarantine_shard(self, sid: int, exc: Exception,
+                          site: str = "query") -> None:
+        """Quarantine a corrupt shard copy (Store.markStoreCorrupted +
+        IndexShard#failShard parity): write the ``corrupted_*`` marker
+        (once — first cause wins), record the detection, flag the shard
+        so the query path fails it into failures[] per the PR-4 partial
+        contract, and release the copy's device staging through the
+        PR-9 accountant — a quarantined copy must not pin HBM, and the
+        ledger must return to baseline exactly (no leak)."""
+        shard = self.shards.get(sid)
+        if shard is None:
+            return
+        store = shard.engine.store
+        integ = integrity_service()
+        integ.record_corruption(self.name, sid, site, str(exc))
+        already = store.is_corrupted()
+        marker = store.mark_corrupted(str(exc), site=site)
+        if not already:
+            integ.record_marker(self.name, sid, marker, action="marked")
+        shard.store_corrupted = True
+        for seg in list(shard.engine.segments):
+            try:
+                seg.release_device_staging()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass  # the index-level release_index backstop covers it
+
+    def unquarantine_shard(self, sid: int) -> None:
+        """A successful re-recovery installed a verified byte set over
+        the quarantined copy: clear the markers + flag (the ONLY legal
+        transition out of quarantine — never called on load)."""
+        shard = self.shards.get(sid)
+        if shard is None:
+            return
+        store = shard.engine.store
+        for marker in store.corruption_markers():
+            integrity_service().record_marker(
+                self.name, sid, marker, action="cleared")
+        store.clear_corruption_markers()
+        shard.store_corrupted = False
+
+    def _scrub_effective_interval(self) -> Optional[float]:
+        """Cluster-level override wins when an operator committed one
+        (explicitness contract, mirroring the other dynamic knobs);
+        otherwise the index setting. None/<=0 disables."""
+        if self.scrub_interval_override is not None:
+            return self.scrub_interval_override
+        return self.settings.get_time("index.scrub.interval")
+
+    def _scrub_loop(self) -> None:
+        import logging
+
+        logger = logging.getLogger("elasticsearch_tpu.index.scrub")
+        while True:
+            iv = self._scrub_effective_interval()
+            wait = iv if iv is not None and iv > 0 else 5.0
+            if self._scrub_stop.wait(wait):
+                return
+            iv = self._scrub_effective_interval()
+            if iv is None or iv <= 0:
+                continue  # disabled (or disabled mid-wait): idle poll
+            try:
+                self.scrub_now()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.warning("[%s] scrub pass failed", self.name,
+                               exc_info=True)
+
+    def scrub_now(self) -> dict:
+        """One synchronous scrubber pass (the loop body; tests call it
+        directly for determinism). Two checks per shard:
+
+        - **disk**: re-verify every committed segment's checksums
+          recursively (sealed files are immutable — any mismatch is
+          at-rest corruption) → quarantine with site=``scrub``;
+        - **device drift**: digest device-staged base tables
+          (block_docs / block_tfs / norms) against host truth cast to
+          the staged dtype — drift invalidates the staging (restage
+          classifies with the ``scrub`` lifecycle reason) and counts,
+          never serves.
+        """
+        import hashlib
+
+        import numpy as np
+
+        bytes_verified = 0
+        checksum_failures = 0
+        drift_count = 0
+        for sid, shard in list(self.shards.items()):
+            store = shard.engine.store
+            if getattr(shard, "store_corrupted", False) \
+                    or store.is_corrupted():
+                continue  # already quarantined — heal, don't re-verify
+            commit = store.read_commit() or {}
+            for seg_name in commit.get("segments", []):
+                try:
+                    bytes_verified += store.verify_segment(seg_name)
+                except CorruptIndexException as e:
+                    checksum_failures += 1
+                    self._quarantine_shard(sid, e, site="scrub")
+                    break
+                except OSError:
+                    continue  # raced a concurrent merge/commit GC
+            if getattr(shard, "store_corrupted", False):
+                continue
+            for seg in list(shard.engine.segments):
+                dev = getattr(seg, "_device", None)
+                if not dev:
+                    continue
+                for key, host in (("block_docs", seg.block_docs),
+                                  ("block_tfs", seg.block_tfs),
+                                  ("norms", seg.norms)):
+                    staged = dev.get(key)
+                    if staged is None:
+                        continue
+                    dev_np = np.asarray(staged)
+                    bytes_verified += int(dev_np.nbytes)
+                    # host truth cast to the staged dtype: staging used
+                    # the same conversion, so a clean table matches
+                    # bit-for-bit and x64 downcasts never false-positive
+                    host_np = np.asarray(host).astype(dev_np.dtype,
+                                                      copy=False)
+                    if (hashlib.sha256(dev_np.tobytes()).digest()
+                            != hashlib.sha256(host_np.tobytes()).digest()):
+                        drift_count += 1
+                        integrity_service().record_scrub_drift(
+                            self.name, sid, seg.name, key)
+                        # invalidate: the restage re-adopts host truth
+                        # and classifies as `scrub` in the ledger ring
+                        seg.stage_reason_initial = "scrub"
+                        seg.release_device_staging()
+                        break
+        integrity_service().record_scrub_run(bytes_verified)
+        return {"bytes_verified": bytes_verified,
+                "checksum_failures": checksum_failures,
+                "drift": drift_count}
 
     # ------------------------------------------------------------------
     # Routing + document ops
@@ -936,7 +1097,14 @@ class IndexService:
         # the LIVE segment set.
         if (self._mesh_enabled and not skip_mesh
                 and preference_shards is None
-                and pinned_segments is None and not body.get("scroll")):
+                and pinned_segments is None and not body.get("scroll")
+                # a quarantined copy must FAIL, not serve (ISSUE 16):
+                # the mesh plane executes all shards as one program and
+                # cannot report a per-shard failure, so any corrupt-
+                # flagged shard forces the host path below where the
+                # flag becomes a failures[] entry
+                and not any(getattr(s, "store_corrupted", False)
+                            for s in self.shards.values())):
             try:
                 knn_clause = _pure_knn_mesh_clause(body)
                 if knn_clause is not None:
@@ -987,6 +1155,14 @@ class IndexService:
                     deadline.timed_out = True
                 break
             try:
+                if getattr(self.shards[sid], "store_corrupted", False):
+                    # quarantined copy (ISSUE 16): fail the shard into
+                    # failures[] — never silent empty hits, never a
+                    # re-read of the marked bytes
+                    raise CorruptIndexException(
+                        f"shard [{self.name}][{sid}] store is marked "
+                        f"corrupted — awaiting re-recovery from a "
+                        f"healthy copy")
                 shard_cache = None
                 if score_caches:
                     shard_cache = {
@@ -1011,6 +1187,13 @@ class IndexService:
                     # deterministic on every shard — surface it with its
                     # own 4xx status instead of masking it as failures
                     raise
+                if (isinstance(e, CorruptIndexException)
+                        and not getattr(self.shards[sid],
+                                        "store_corrupted", False)):
+                    # first detection on the query path: quarantine the
+                    # copy (marker + staging release) — subsequent
+                    # queries fail fast on the flag without recounting
+                    self._quarantine_shard(sid, e, site="query")
                 # one bad shard (corrupt segment, injected fault, compile
                 # error) becomes a failures[] entry + _shards.failed, not
                 # a 500 (AbstractSearchAsyncAction.onShardFailure)
@@ -1208,7 +1391,11 @@ class IndexService:
         # rung 1: batched mesh_pallas launch (one program, Q queries).
         # A plane fault inside quarantines mesh_pallas exactly once.
         mesh_out = None
-        if (self._mesh_enabled and len(self.shards) >= 2):
+        if (self._mesh_enabled and len(self.shards) >= 2
+                # quarantined copies fail per-shard on the host path
+                # (ISSUE 16) — same gate as the serial mesh dispatch
+                and not any(getattr(s, "store_corrupted", False)
+                            for s in self.shards.values())):
             if self._mesh_search is None:
                 from elasticsearch_tpu.parallel.plan_exec import (
                     IndexMeshSearch,
@@ -1578,6 +1765,11 @@ class IndexService:
             # histogram — a PROCESS resource like the memory ledger
             # (_nodes/stats re-exports the same node-wide block)
             "compile": _compile_stats(),
+            # data integrity (ISSUE 16, docs/OBSERVABILITY.md): detected
+            # corruptions by site, corrupted_* marker lifecycle events,
+            # and the background scrubber's verified-bytes/drift counters
+            # — counters node-global, marker_events filtered per index
+            "integrity": integrity_service().stats(self.name),
         }
         if groups:
             search["groups"] = groups
@@ -1657,6 +1849,7 @@ class IndexService:
     def close(self) -> None:
         if self._refresh_stop is not None:
             self._refresh_stop.set()
+        self._scrub_stop.set()
         # wake queued admission waiters with a clean rejection so no
         # caller hangs on a closing index
         self.admission.shutdown()

@@ -160,6 +160,12 @@ class IndexShard:
             slowlog_warn_s=slowlog_warn_s, slowlog_info_s=slowlog_info_s,
             index_name=index_name,
         )
+        # corruption quarantine flag (ISSUE 16): set when the copy's
+        # store carries a corrupted_* marker so the query path fails the
+        # shard (PR-4 partial contract) without an os.listdir per query;
+        # cleared only by a successful re-recovery installing verified
+        # bytes (IndexService._quarantine_shard / multinode heal path)
+        self.store_corrupted = False
         self._lock = threading.RLock()
 
     # ------------------------------------------------------------------

@@ -9,6 +9,15 @@ numpy ``.npz`` archives + JSON sidecars (term dictionary, sources).
 Checksums: each segment directory carries a metadata file with per-array
 SHA-256 digests, verified on load — the analog of Store's checksum
 verification of Lucene segment files.
+
+Corruption markers (ISSUE 16): the analog of the reference's
+``Store.markStoreCorrupted`` — a detected ``CorruptIndexException``
+writes a ``corrupted_*.json`` marker into the shard's store directory so
+the bad copy can never be silently reused: every load path checks the
+marker first and refuses. The marker is written once (the first detected
+cause wins) and cleared only when a verified byte set replaces the
+directory (peer-recovery file install wipes the directory; explicit
+:meth:`Store.clear_corruption_markers` covers rebuild-in-place paths).
 """
 
 from __future__ import annotations
@@ -16,6 +25,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
+import uuid
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -35,10 +46,89 @@ class CorruptIndexException(ElasticsearchTpuException):
     status_code = 500
 
 
+MARKER_PREFIX = "corrupted_"
+
+
 class Store:
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    # corruption markers (Store.markStoreCorrupted parity)
+
+    def corruption_markers(self) -> List[dict]:
+        """Parsed ``corrupted_*.json`` markers, oldest first. An
+        unreadable marker file still counts (an empty dict with its
+        filename) — a torn marker must not unlock the copy."""
+        out: List[dict] = []
+        try:
+            entries = sorted(os.listdir(self.directory))
+        except FileNotFoundError:
+            return out
+        for entry in entries:
+            if not (entry.startswith(MARKER_PREFIX)
+                    and entry.endswith(".json")):
+                continue
+            p = os.path.join(self.directory, entry)
+            if not os.path.isfile(p):
+                continue
+            try:
+                with open(p, encoding="utf-8") as f:
+                    marker = json.load(f)
+            except (OSError, ValueError):
+                marker = {}
+            marker.setdefault("marker", entry)
+            out.append(marker)
+        return out
+
+    def is_corrupted(self) -> bool:
+        return bool(self.corruption_markers())
+
+    def mark_corrupted(self, reason: str, site: str = "load") -> dict:
+        """Write the corruption marker (once — the first cause wins) and
+        return it. Idempotent: re-marking an already-marked store keeps
+        the original marker so the first detected cause survives."""
+        existing = self.corruption_markers()
+        if existing:
+            return existing[0]
+        marker = {
+            "marker": f"{MARKER_PREFIX}{uuid.uuid4().hex[:16]}.json",
+            "reason": str(reason),
+            "site": site,
+            "timestamp_ms": int(time.time() * 1000),
+        }
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = os.path.join(self.directory, marker["marker"] + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(marker, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.directory, marker["marker"]))
+        return marker
+
+    def clear_corruption_markers(self) -> int:
+        """Remove the markers — ONLY legal after a successful
+        re-recovery installed a verified byte set (peer-recovery wipes
+        the whole directory instead; this covers rebuild-in-place)."""
+        cleared = 0
+        for marker in self.corruption_markers():
+            try:
+                os.remove(os.path.join(self.directory, marker["marker"]))
+                cleared += 1
+            except OSError:
+                pass
+        return cleared
+
+    def _check_not_corrupted(self) -> None:
+        markers = self.corruption_markers()
+        if markers:
+            m = markers[0]
+            raise CorruptIndexException(
+                f"store [{self.directory}] is marked corrupted "
+                f"[{m.get('marker')}]: {m.get('reason', 'unknown')} — "
+                f"the copy must be re-recovered from a healthy copy, "
+                f"never reloaded")
 
     # ------------------------------------------------------------------
 
@@ -111,6 +201,7 @@ class Store:
             return None
 
     def load_segments(self) -> List[Segment]:
+        self._check_not_corrupted()
         commit = self.read_commit()
         if commit is None:
             return []
@@ -233,6 +324,32 @@ class Store:
     def verify_checksums(self, name: str) -> None:
         self._verify_checksums_dir(self._seg_dir(name))
 
+    def verify_segment(self, name: str) -> int:
+        """Re-verify a sealed segment's checksums RECURSIVELY (nested
+        sub-segments included) — the background scrubber's disk pass
+        (ISSUE 16). Returns the number of bytes verified; raises
+        :class:`CorruptIndexException` on the first mismatch."""
+        return self._verify_segment_dir(self._seg_dir(name))
+
+    def _verify_segment_dir(self, d: str) -> int:
+        self._verify_checksums_dir(d)
+        total = 0
+        try:
+            with open(os.path.join(d, "checksums.json"),
+                      encoding="utf-8") as f:
+                sums = json.load(f)
+            for fn in sums:
+                total += os.path.getsize(os.path.join(d, fn))
+        except (OSError, ValueError):
+            pass  # _verify_checksums_dir already vouched for the bytes
+        nested = os.path.join(d, "nested")
+        if os.path.isdir(nested):
+            for entry in sorted(os.listdir(nested)):
+                sub = os.path.join(nested, entry)
+                if os.path.isdir(sub):
+                    total += self._verify_segment_dir(sub)
+        return total
+
     def _verify_checksums_dir(self, d: str) -> None:
         try:
             with open(os.path.join(d, "checksums.json"), encoding="utf-8") as f:
@@ -241,9 +358,21 @@ class Store:
             raise CorruptIndexException(
                 f"segment [{os.path.basename(d)}] missing checksums"
             ) from None
+        except ValueError:
+            # torn/truncated checksums.json: unparseable manifest is
+            # corruption, not a crash — same contract as a mismatch
+            raise CorruptIndexException(
+                f"segment [{os.path.basename(d)}] torn checksums"
+            ) from None
         for fn, expected in sums.items():
-            with open(os.path.join(d, fn), "rb") as f:
-                actual = hashlib.sha256(f.read()).hexdigest()
+            try:
+                with open(os.path.join(d, fn), "rb") as f:
+                    actual = hashlib.sha256(f.read()).hexdigest()
+            except FileNotFoundError:
+                raise CorruptIndexException(
+                    f"segment file [{os.path.basename(d)}/{fn}] listed "
+                    f"in checksums but missing on disk"
+                ) from None
             if actual != expected:
                 raise CorruptIndexException(
                     f"checksum failed for [{os.path.basename(d)}/{fn}] "
@@ -251,6 +380,7 @@ class Store:
                 )
 
     def read_segment(self, name: str) -> Segment:
+        self._check_not_corrupted()
         return self._read_segment_dir(self._seg_dir(name))
 
     def _read_segment_dir(self, d: str) -> Segment:

@@ -1764,6 +1764,17 @@ class Node:
             source = (committed if committed.get(setting.key) is not None
                       else self.settings)
             configure_staging_retry(**{kw: setting.get(source)})
+        # background integrity scrubber cadence (index.scrub.interval,
+        # ISSUE 16, docs/RESILIENCE.md "Data integrity"): same
+        # explicitness contract — an explicit cluster value overrides
+        # every index's own setting, clearing hands control back
+        from elasticsearch_tpu.common.settings import INDEX_SCRUB_INTERVAL
+
+        scrub_explicit = committed.get(INDEX_SCRUB_INTERVAL.key) is not None
+        scrub_value = (INDEX_SCRUB_INTERVAL.get(committed)
+                       if scrub_explicit else None)
+        for svc in self.indices.values():
+            svc.scrub_interval_override = scrub_value
         return {
             "acknowledged": True,
             "persistent": state.persistent_settings.as_nested_dict(),

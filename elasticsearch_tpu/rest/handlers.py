@@ -250,6 +250,10 @@ def register_all(c) -> None:
         q.param("repo"), q.param("snapshot"))))
     r("POST", "/_snapshot/{repo}/{snapshot}/_restore", lambda n, q: (200, n.snapshots.restore_snapshot(
         q.param("repo"), q.param("snapshot"), q.json_body({}))))
+    # repository verification probe (ISSUE 16): write/read/delete a
+    # probe blob and report the nodes that could see it
+    r("POST", "/_snapshot/{repo}/_verify", lambda n, q: (200, n.snapshots.verify_repository(
+        q.param("repo"))))
 
     # --- cat API (rest/action/cat/, 22 handlers in the reference) ---
     r("GET", "/_cat", _cat_help)
@@ -1612,10 +1616,30 @@ def _get_cluster_settings(node, req):
 
 
 def _allocation_explain(node, req):
-    return 200, {
+    # corruption markers (ISSUE 16): a quarantined copy is unusable for
+    # allocation, so explain surfaces every marked (index, shard) — the
+    # operator-visible trail for a RED last-copy corruption
+    corrupted = []
+    for name, svc in node.indices.items():
+        for sid, shard in svc.shards.items():
+            for marker in shard.engine.store.corruption_markers():
+                corrupted.append({
+                    "index": name, "shard": sid,
+                    "marker": marker.get("marker", "corrupted"),
+                    "site": marker.get("site", "load"),
+                    "reason": marker.get("reason", ""),
+                })
+    out = {
         "note": "single-node cluster: all primaries allocated locally",
         "can_allocate": "yes",
     }
+    if corrupted:
+        out["can_allocate"] = "no"
+        out["note"] = ("corrupted store copies are unusable for "
+                       "allocation until re-recovered from a healthy "
+                       "copy (docs/RESILIENCE.md \"Data integrity\")")
+        out["corrupted_copies"] = corrupted
+    return 200, out
 
 
 def _get_task(node, req):
@@ -1776,10 +1800,17 @@ def _cat_shards(node, req):
             continue
         for sid, shard in svc.shards.items():
             store = shard.stats()["segments"]["memory_in_bytes"]
+            # integrity column (ISSUE 16): newest corruption marker name,
+            # or "-" for a healthy copy — operators see quarantined
+            # copies directly in _cat/shards
+            markers = shard.engine.store.corruption_markers()
+            integrity = markers[0].get("marker", "corrupted") \
+                if markers else "-"
             rows.append([name, sid, "p", shard.state, shard.num_docs,
-                         f"{store}b", "127.0.0.1", node.node_name])
+                         f"{store}b", "127.0.0.1", node.node_name,
+                         integrity])
     return _cat_table(req, rows, ["index", "shard", "prirep", "state", "docs",
-                                  "store", "ip", "node"])
+                                  "store", "ip", "node", "integrity"])
 
 
 def _cat_staging(node, req):

@@ -14,20 +14,24 @@ never the source of truth.
 from __future__ import annotations
 
 import atexit
+import hashlib
 import json
 import os
 import shutil
 import tempfile
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import (
+    CorruptedSnapshotException,
     ElasticsearchTpuException,
     IllegalArgumentException,
     ResourceAlreadyExistsException,
     ResourceNotFoundException,
 )
+from elasticsearch_tpu.common.integrity import integrity_service
 from elasticsearch_tpu.common.settings import Settings
 
 
@@ -185,6 +189,41 @@ class SnapshotsService:
             raise ResourceNotFoundException(f"[{name}] missing")
         return repo
 
+    def verify_repository(self, name: str) -> dict:
+        """POST /_snapshot/{repo}/_verify (VerifyRepositoryAction):
+        write, read back, and delete a probe blob so a misconfigured /
+        read-only / bit-flipping repository is caught at registration
+        time, not at the first snapshot. Reports the verifying
+        "node"s, reference-shaped."""
+        repo = self._repo(name)
+        probe = os.path.join(
+            repo.location, f"verify-{uuid.uuid4().hex[:12]}.probe")
+        payload = uuid.uuid4().hex.encode("ascii")
+        try:
+            with open(probe, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            with open(probe, "rb") as f:
+                echoed = f.read()
+        except OSError as e:
+            raise ElasticsearchTpuException(
+                f"[{name}] repository verification failed: probe blob "
+                f"could not be written/read ({e})") from e
+        finally:
+            try:
+                os.remove(probe)
+            except OSError:
+                pass
+        if echoed != payload:
+            raise ElasticsearchTpuException(
+                f"[{name}] repository verification failed: probe blob "
+                f"read back different bytes than written")
+        node_id = (getattr(self.node, "node_id", None)
+                   or getattr(self.node, "node_name", None) or "node")
+        node_name = getattr(self.node, "node_name", None) or node_id
+        return {"nodes": {node_id: {"name": node_name}}}
+
     # --- snapshot ---
 
     def create_snapshot(self, repo_name: str, snapshot: str,
@@ -272,11 +311,44 @@ class SnapshotsService:
                         break
                     progress["shards"][(name, sid)] = "STARTED"
                     shards_total += 1
-                    src = shard.engine.store.directory
+                    store = shard.engine.store
+                    if store.is_corrupted():
+                        # a marked copy must never seed a snapshot: the
+                        # repo would preserve the corruption forever
+                        integrity_service().record_corruption(
+                            name, sid, "snapshot",
+                            "store is marked corrupted")
+                        progress["shards"][(name, sid)] = "FAILURE"
+                        raise ElasticsearchTpuException(
+                            f"cannot snapshot [{name}][{sid}]: store is "
+                            f"marked corrupted")
+                    src = store.directory
                     dst = os.path.join(idx_dir, str(sid))
-                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                    # per-file SHA-256 of the SOURCE bytes (ISSUE 16):
+                    # restore verifies the repo blobs against these
+                    # before install, so repo-side bit rot is caught —
+                    # never adopted (markers are excluded: they never
+                    # ship, same as peer recovery)
+                    from elasticsearch_tpu.index.store import MARKER_PREFIX
+                    digests = {}
+                    for root, _dirs, fnames in os.walk(src):
+                        for fn in fnames:
+                            if (root == src
+                                    and fn.startswith(MARKER_PREFIX)
+                                    and fn.endswith(".json")):
+                                continue
+                            full = os.path.join(root, fn)
+                            rel = os.path.relpath(full, src)
+                            with open(full, "rb") as fh:
+                                digests[rel] = hashlib.sha256(
+                                    fh.read()).hexdigest()
+                    shutil.copytree(
+                        src, dst, dirs_exist_ok=True,
+                        ignore=shutil.ignore_patterns(
+                            f"{MARKER_PREFIX}*.json"))
                     shard_info[str(sid)] = {
-                        "segments": len(shard.engine.segments)}
+                        "segments": len(shard.engine.segments),
+                        "digests": digests}
                     progress["shards"][(name, sid)] = "DONE"
                 if aborted:
                     break
@@ -381,6 +453,33 @@ class SnapshotsService:
             shards = {(iname, sid)
                       for iname, info in m["indices"].items()
                       for sid in info.get("shards", {})}
+            snap_dir = repo.snapshot_path(key[1])
+            per_index: dict = {}
+            for iname, info in m["indices"].items():
+                for sid, sinfo in (info.get("shards") or {}).items():
+                    entry: dict = {"stage": "DONE"}
+                    digests = (sinfo or {}).get("digests")
+                    if digests:
+                        # per-file digest verification state (ISSUE 16):
+                        # re-hash the repo blobs against the manifest so
+                        # _status answers "would this snapshot restore?"
+                        shard_dir = os.path.join(
+                            snap_dir, "indices", iname, str(sid))
+                        ok = 0
+                        for rel, expected in digests.items():
+                            try:
+                                with open(os.path.join(shard_dir, rel),
+                                          "rb") as f:
+                                    if (hashlib.sha256(f.read())
+                                            .hexdigest() == expected):
+                                        ok += 1
+                            except OSError:
+                                pass
+                        entry["verification"] = {
+                            "files_total": len(digests),
+                            "files_verified": ok,
+                            "verified": ok == len(digests)}
+                    per_index.setdefault(iname, {})[str(sid)] = entry
             out.append({
                 "snapshot": key[1],
                 "repository": repo_name,
@@ -388,9 +487,7 @@ class SnapshotsService:
                 "shards_stats": {"initializing": 0, "started": 0,
                                  "failed": 0, "done": len(shards),
                                  "total": len(shards)},
-                "indices": {iname: {str(sid): {"stage": "DONE"}
-                                    for sid in info.get("shards", {})}
-                            for iname, info in m["indices"].items()},
+                "indices": per_index,
             })
         return {"snapshots": out}
 
@@ -461,6 +558,7 @@ class SnapshotsService:
         rename_pattern = body.get("rename_pattern")
         rename_replacement = body.get("rename_replacement")
         restored = []
+        failures = []
         for name, info in manifest["indices"].items():
             if indices_expr and name not in str(indices_expr).split(","):
                 continue
@@ -474,13 +572,25 @@ class SnapshotsService:
                     f"cannot restore index [{target}] because an open index with "
                     "same name already exists"
                 )
+            snap_idx_dir = os.path.join(repo.snapshot_path(snapshot), "indices", name)
+            # verify the repo blobs against the manifest digests BEFORE
+            # creating the index (ISSUE 16): repo-side corruption fails
+            # the restore of THIS index only — no half-created index, no
+            # unverified bytes installed, the other indices restore
+            try:
+                self._verify_index_blobs(snapshot, name, info, snap_idx_dir)
+            except CorruptedSnapshotException as e:
+                failures.append({
+                    "index": name,
+                    "type": "corrupted_snapshot_exception",
+                    "reason": str(e)})
+                continue
             self.node.create_index(target, {
                 "settings": Settings(info["settings"]).as_nested_dict(),
                 "mappings": info["mappings"],
                 "aliases": info.get("aliases", {}),
             })
             svc = self.node.indices[target]
-            snap_idx_dir = os.path.join(repo.snapshot_path(snapshot), "indices", name)
             for sid, shard in svc.shards.items():
                 src = os.path.join(snap_idx_dir, str(sid))
                 if not os.path.exists(src):
@@ -492,9 +602,41 @@ class SnapshotsService:
                 shard.engine.version_map = {}
                 shard.recover_from_store()
             restored.append(target)
-        return {"snapshot": {
+        resp = {"snapshot": {
             "snapshot": snapshot,
             "indices": restored,
-            "shards": {"total": len(restored), "failed": 0,
+            "shards": {"total": len(restored) + len(failures),
+                       "failed": len(failures),
                        "successful": len(restored)},
         }}
+        if failures:
+            resp["snapshot"]["failures"] = failures
+        return resp
+
+    def _verify_index_blobs(self, snapshot: str, name: str, info: dict,
+                            snap_idx_dir: str) -> None:
+        """Compare every repo blob of one snapshotted index against the
+        per-file digests the create recorded; raise
+        :class:`CorruptedSnapshotException` on the first mismatch."""
+        for sid_str, sinfo in (info.get("shards") or {}).items():
+            digests = (sinfo or {}).get("digests")
+            if not digests:
+                continue  # pre-ISSUE-16 snapshot: no digests to verify
+            shard_dir = os.path.join(snap_idx_dir, sid_str)
+            for rel, expected in digests.items():
+                full = os.path.join(shard_dir, rel)
+                try:
+                    with open(full, "rb") as f:
+                        actual = hashlib.sha256(f.read()).hexdigest()
+                except OSError:
+                    actual = "<missing>"
+                if actual != expected:
+                    integrity_service().record_corruption(
+                        name, int(sid_str), "restore",
+                        f"snapshot [{snapshot}] blob [{rel}] digest "
+                        f"mismatch")
+                    raise CorruptedSnapshotException(
+                        f"[{snapshot}] index [{name}] shard [{sid_str}] "
+                        f"blob [{rel}] failed verification "
+                        f"(manifest={expected[:12]}, "
+                        f"actual={actual[:12]})")

@@ -704,6 +704,355 @@ class ChaosSoak:
                 except Exception:  # noqa: BLE001 — teardown best-effort
                     pass
 
+    # -- data-integrity corruption phase (ISSUE 16) ----------------------
+
+    def run_corruption(self, data_root: str) -> dict:
+        """Corruption chaos (docs/RESILIENCE.md "Data integrity"): inject
+        real at-rest and in-flight store corruption, then assert the
+        end-to-end integrity invariants:
+
+        - **every injected corruption detected** — the integrity
+          counters move for each injection; zero silent wrong results
+          (a corrupt copy fails its shard per the partial-results
+          contract, never serves);
+        - **self-heal converges** — a corrupt replica re-recovers from
+          the primary, a corrupt primary fails over to the STARTED
+          replica and rebuilds, and post-heal hits are byte-identical
+          to the pre-corruption answers;
+        - **no acked-write loss** through every quarantine/heal cycle;
+        - **ledger leak-free** — quarantine releases device staging
+          through the accountant exactly (all scopes at zero after
+          close).
+        """
+        return _run_witnessed(lambda: self._run_corruption(data_root))
+
+    def _run_corruption(self, data_root: str) -> dict:
+        from elasticsearch_tpu.common.integrity import integrity_service
+
+        report: dict = {"seed": self.seed, "injected": 0}
+        before = integrity_service().stats()
+        report["local"] = self._corruption_local(report)
+        report["cluster"] = self._corruption_cluster(data_root, report)
+        after = integrity_service().stats()
+        # device drift counts on its own axis (scrub_drift_total): the
+        # staged copy drifted, not the store bytes
+        detected = (after["corruption_detected_total"]
+                    - before["corruption_detected_total"]
+                    + after["scrub_drift_total"]
+                    - before["scrub_drift_total"])
+        report["detected"] = detected
+        if detected < report["injected"]:
+            raise ChaosSoakViolation(
+                f"silent corruption: injected {report['injected']} faults "
+                f"but only {detected} detections were counted "
+                f"(by_site={after['corruption_detected_by_site']})")
+        return report
+
+    def _corruption_local(self, report: dict) -> dict:
+        """In-process detection matrix: at-rest checksum corruption is
+        caught by the scrubber and degrades queries per the PR-4 partial
+        contract; device-staging drift is caught by the scrub digest
+        compare, restaged, and never serves."""
+        from elasticsearch_tpu.common.memory import memory_accountant
+
+        out: dict = {}
+        svc = self._mk_index(self.index + "_int")
+        oracle = self._mk_index(self.index + "_int_oracle")
+        try:
+            rng = np.random.RandomState(self.seed + 3)
+            for d in range(self.seed_docs):
+                doc = self._doc(rng, d)
+                svc.index_doc(str(d), doc)
+                oracle.index_doc(str(d), doc)
+            svc.refresh()
+            oracle.refresh()
+            svc.flush()  # sealed, checksummed segments on disk
+            oracle.flush()
+            probe = {"query": {"match": {"body": self.vocab[0]}},
+                     "size": 10}
+            want = self._hits_key(oracle.search(dict(probe)))
+            if self._hits_key(svc.search(dict(probe))) != want:
+                raise ChaosSoakViolation("corpora diverged before faults")
+
+            # --- at-rest: bit-flip a committed array, scrub detects ----
+            scheme = dis.StoreCorruptionScheme("bitflip", seed=self.seed)
+            scheme.corrupt_store(svc.shards[0].engine.store)
+            report["injected"] += 1
+            scrub = svc.scrub_now()
+            if scrub["checksum_failures"] < 1:
+                raise ChaosSoakViolation(
+                    f"scrub missed the injected at-rest corruption: "
+                    f"{scrub} (corrupted: {scheme.corrupted})")
+            if not svc.shards[0].store_corrupted \
+                    or not svc.shards[0].engine.store.is_corrupted():
+                raise ChaosSoakViolation(
+                    "scrub detection did not quarantine the copy")
+            # quarantine released the copy's device staging exactly
+            for seg in svc.shards[0].engine.searchable_segments():
+                if seg._device:
+                    raise ChaosSoakViolation(
+                        f"quarantined shard still holds device staging "
+                        f"for segment [{seg.name}]")
+            # partial contract: failures[] + degraded 200, never a raise
+            r = svc.search(dict(probe))
+            if not r["_shards"]["failed"]:
+                raise ChaosSoakViolation(
+                    "quarantined shard served instead of failing "
+                    "(zero-silent-wrong-results violated)")
+            out["at_rest"] = {"scrub": scrub,
+                              "failed_shards": r["_shards"]["failed"]}
+
+            # --- device drift: tamper a staged table, scrub restages ---
+            # stage the per-segment host-path tables (the mesh plane
+            # keeps its own executor tables; the drift scan below reads
+            # Segment._device)
+            oracle._search_uncached(dict(probe), skip_mesh=True)
+            drifted = None
+            for shard in oracle.shards.values():
+                for seg in shard.engine.searchable_segments():
+                    dev = getattr(seg, "_device", None) or {}
+                    if dev.get("norms") is not None:
+                        import jax.numpy as jnp
+
+                        host = np.asarray(dev["norms"]).copy()
+                        host.flat[0] = host.flat[0] + 1.0
+                        dev["norms"] = jnp.asarray(host)
+                        drifted = seg.name
+                        break
+                if drifted:
+                    break
+            if drifted is None:
+                raise ChaosSoakViolation(
+                    "no staged norms table found to drift")
+            report["injected"] += 1
+            scrub2 = oracle.scrub_now()
+            if scrub2["drift"] < 1:
+                raise ChaosSoakViolation(
+                    f"scrub missed the injected device drift on "
+                    f"[{drifted}]: {scrub2}")
+            if self._hits_key(oracle.search(dict(probe))) != want:
+                raise ChaosSoakViolation(
+                    "drifted staging served wrong results after restage")
+            out["drift"] = {"segment": drifted, "scrub": scrub2}
+            return out
+        finally:
+            svc.close()
+            oracle.close()
+            # ledger leak-free: the quarantine release path + close must
+            # return every scope to zero — no stranded HBM bytes
+            for name in (self.index + "_int", self.index + "_int_oracle"):
+                leaked = {k: v for k, v in memory_accountant()
+                          .staged_bytes_by_kind(name).items() if v}
+                if leaked:
+                    raise ChaosSoakViolation(
+                        f"ledger leak through the corruption phase on "
+                        f"[{name}]: {leaked}")
+
+    def _corruption_cluster(self, data_root: str, report: dict) -> dict:
+        """Replicated self-heal: corrupt replica → re-recovers from the
+        primary; corrupt primary → fails over to the STARTED replica and
+        rebuilds; in-flight recovery corruption → digest mismatch
+        detected, session retried once, heals. Green + byte-identical
+        hits + zero acked-write loss after every cycle."""
+        import os
+        import shutil
+
+        from elasticsearch_tpu.common.integrity import integrity_service
+        from elasticsearch_tpu.cluster.multinode import (
+            ClusterClient,
+            ClusterNode,
+        )
+        from elasticsearch_tpu.index.store import Store
+        from elasticsearch_tpu.transport.local import TransportHub
+
+        idx = self.index + "_int_tx"
+        hub = TransportHub()
+        mk = lambda n: ClusterNode(  # noqa: E731
+            n, hub, settings=_CLUSTER_SETTINGS,
+            data_path=os.path.join(data_root, "int_cluster", n))
+        names = ["int1", "int2"]
+        nodes = {n: mk(n) for n in names}
+        out: dict = {"scenarios": []}
+        try:
+            nodes["int1"].bootstrap_cluster()
+            nodes["int2"].join("int1")
+            nodes["int1"].create_index(idx, {
+                "index": {"number_of_shards": 1,
+                          "number_of_replicas": 1}},
+                {"properties": {"body": {"type": "text",
+                                         "analyzer": "whitespace"},
+                                "n": {"type": "integer"}}})
+            self._wait_copies_started(nodes, idx)
+            rng = np.random.RandomState(self.seed + 4)
+            acked: List[str] = []
+            client = ClusterClient(nodes["int1"])
+            for d in range(self.seed_docs // 2):
+                doc = self._doc(rng, d)
+                client.index(idx, str(d), {"body": doc["body"],
+                                           "n": int(d)})
+                acked.append(str(d))
+            client.refresh(idx)
+            ordered = {"query": {"match_all": {}},
+                       "sort": [{"n": "asc"}], "size": len(acked)}
+            want = self._cluster_hits(client, idx, ordered)
+
+            def roll_with_corruption(victim: str, wipe: bool,
+                                     in_flight: bool) -> dict:
+                """Close ``victim``, corrupt (or wipe) its store, restart
+                it, and let recovery self-heal. Returns scenario stats."""
+                store_dir = nodes[victim].shards[(idx, 0)] \
+                    .engine.store.directory
+                base = integrity_service().stats()
+                nodes[victim].close(graceful=True)
+                scheme = None
+                if wipe:
+                    shutil.rmtree(os.path.dirname(store_dir),
+                                  ignore_errors=True)
+                else:
+                    dis.StoreCorruptionScheme(
+                        "bitflip", seed=self.seed).corrupt_store(
+                        Store(store_dir))
+                    report["injected"] += 1
+                if in_flight:
+                    survivor_node = next(nodes[n] for n in names
+                                         if n != victim)
+                    scheme = dis.StoreCorruptionScheme(
+                        "bitflip", seed=self.seed,
+                        source_node=survivor_node).apply_to(hub)
+                    report["injected"] += 1
+                try:
+                    nodes[victim] = mk(victim)
+                    nodes[victim].join(next(n for n in names
+                                            if n != victim))
+                    self._wait_copies_started(nodes, idx)
+                finally:
+                    if scheme is not None:
+                        scheme.remove()
+                        if not scheme.hits:
+                            raise ChaosSoakViolation(
+                                "in-flight corruption scheme never fired")
+                # the healed copy left quarantine: markers gone
+                markers = Store(store_dir).corruption_markers()
+                if markers:
+                    raise ChaosSoakViolation(
+                        f"healed copy still carries markers: {markers}")
+                after = integrity_service().stats()
+                return {
+                    "victim": victim,
+                    "detected": after["corruption_detected_total"]
+                        - base["corruption_detected_total"],
+                    "by_site": {
+                        s: after["corruption_detected_by_site"][s]
+                        - base["corruption_detected_by_site"][s]
+                        for s in after["corruption_detected_by_site"]},
+                    "cleared": after["markers_cleared_total"]
+                        - base["markers_cleared_total"],
+                }
+
+            def verify_green(tag: str) -> None:
+                client = ClusterClient(nodes["int1"])
+                client.refresh(idx)
+                res = client.search(idx, {"query": {"match_all": {}},
+                                          "size": 0})
+                if res["_shards"]["failed"]:
+                    raise ChaosSoakViolation(
+                        f"[{tag}] shard failures after heal: "
+                        f"{res['_shards']}")
+                if res["hits"]["total"] != len(acked):
+                    raise ChaosSoakViolation(
+                        f"[{tag}] acked-write loss: "
+                        f"{res['hits']['total']} != {len(acked)}")
+                got = self._cluster_hits(client, idx, ordered)
+                if got != want:
+                    raise ChaosSoakViolation(
+                        f"[{tag}] post-heal hits diverged:\n got: {got}"
+                        f"\nwant: {want}")
+
+            # scenario 1: corrupt REPLICA re-recovers from the primary
+            primary = self._primary_node(nodes, idx)
+            replica = next(n for n in names if n != primary)
+            s1 = roll_with_corruption(replica, wipe=False,
+                                      in_flight=False)
+            if s1["by_site"].get("load", 0) < 1:
+                raise ChaosSoakViolation(
+                    f"corrupt replica not detected at load: {s1}")
+            if s1["cleared"] < 1:
+                raise ChaosSoakViolation(
+                    f"replica heal cleared no markers: {s1}")
+            verify_green("corrupt-replica")
+            s1["scenario"] = "corrupt_replica"
+            out["scenarios"].append(s1)
+
+            # scenario 2: corrupt PRIMARY fails over to the STARTED
+            # replica, then rebuilds from the promoted copy
+            primary = self._primary_node(nodes, idx)
+            s2 = roll_with_corruption(primary, wipe=False,
+                                      in_flight=False)
+            if s2["by_site"].get("load", 0) < 1:
+                raise ChaosSoakViolation(
+                    f"corrupt primary not detected at load: {s2}")
+            new_primary = self._primary_node(nodes, idx)
+            if new_primary == primary:
+                raise ChaosSoakViolation(
+                    "corrupt primary did not fail over to the replica")
+            verify_green("corrupt-primary")
+            s2["scenario"] = "corrupt_primary"
+            out["scenarios"].append(s2)
+
+            # scenario 3: in-flight recovery corruption — the shipped
+            # bytes stop matching the manifest digests, the target
+            # detects, the session retries once and heals
+            primary = self._primary_node(nodes, idx)
+            replica = next(n for n in names if n != primary)
+            s3 = roll_with_corruption(replica, wipe=True, in_flight=True)
+            if s3["by_site"].get("recovery", 0) < 1:
+                raise ChaosSoakViolation(
+                    f"in-flight corruption not detected at the "
+                    f"recovery install: {s3}")
+            verify_green("in-flight-recovery")
+            s3["scenario"] = "recovery_in_flight"
+            out["scenarios"].append(s3)
+            return out
+        finally:
+            hub.clear_disruptions()
+            for node in nodes.values():
+                try:
+                    node.close(graceful=False)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+
+    @staticmethod
+    def _cluster_hits(client, idx: str, body: dict) -> list:
+        resp = client.search(idx, dict(body))
+        return [(h["_id"], h["_score"], tuple(h.get("sort") or ()))
+                for h in resp["hits"]["hits"]]
+
+    def _primary_node(self, nodes, idx: str) -> str:
+        master = next(n for n in nodes.values() if n.is_master)
+        copies = master.routing[idx][0]
+        return next(c.node_id for c in copies if c.primary)
+
+    def _wait_copies_started(self, nodes, idx: str,
+                             attempts: int = 100) -> None:
+        from elasticsearch_tpu.cluster.state import ShardRoutingState
+
+        for _ in range(attempts):
+            master = next((n for n in nodes.values() if n.is_master),
+                          None)
+            if master is not None:
+                try:
+                    master.reroute()
+                except Exception:  # noqa: BLE001 — mid-heal churn
+                    pass
+                routing = master.routing.get(idx, {})
+                copies = [c for cs in routing.values() for c in cs]
+                if copies and all(c.state == ShardRoutingState.STARTED
+                                  for c in copies):
+                    return
+            time.sleep(0.05)
+        raise ChaosSoakViolation(
+            f"copies of [{idx}] never all reached STARTED")
+
 
 class RollingRestartSoak:
     """Zero-downtime rollout soak (ISSUE 14, docs/RESILIENCE.md

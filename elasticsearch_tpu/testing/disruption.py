@@ -513,6 +513,178 @@ def queue_pressure(index: str, count_hit: bool = True):
     return occ, blocked, delay
 
 
+# ---------------------------------------------------------------------------
+# Store corruption (data-integrity fault injection, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+class StoreCorruptionScheme:
+    """Deterministic at-rest / in-flight store corruption injector
+    (ISSUE 16; the reference's ``CorruptionUtils`` used by
+    ``CorruptedFileIT``). Every injected corruption MUST be detected —
+    the chaos soak's zero-silent-wrong-results assertion — so each
+    injection is logged in ``self.corrupted``.
+
+    Kinds:
+
+    - ``bitflip``: flip one bit of one byte of a checksummed data file
+      (``target`` names it, default ``arrays.npz`` — the chosen array);
+    - ``truncate``: cut the tail byte off a data file (short read);
+    - ``torn_checksums``: truncate ``checksums.json`` mid-JSON (the
+      verification metadata itself is damaged);
+    - ``missing_checksums``: delete ``checksums.json`` outright.
+
+    At rest: ``corrupt_store(store)`` / ``corrupt_segment(dir)`` mutate
+    committed files directly — the next load / scrub / recovery-source
+    walk must catch it. In flight ("during recovery"): install on a hub
+    with ``source_node`` set and the scheme flips a byte inside the
+    source's in-memory recovery-session snapshot on the first matching
+    file-chunk delivery — the bytes no longer match the manifest digest
+    the source computed, so the TARGET's install verification must
+    catch it (and the retried session, re-read from clean disk, heals).
+
+    ``seed`` makes the chosen file/byte/bit reproducible.
+    """
+
+    KINDS = ("bitflip", "truncate", "torn_checksums", "missing_checksums")
+
+    def __init__(self, kind: str = "bitflip",
+                 target: Optional[str] = None,
+                 seed: Optional[int] = None,
+                 source_node=None, times: int = 1):
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown corruption kind [{kind}]")
+        self.kind = kind
+        self.target = target
+        self.source_node = source_node
+        self.times = max(1, int(times))
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.hub = None
+        self.hits = 0
+        self.corrupted: list = []  # (path, description) per injection
+
+    # --- at-rest ------------------------------------------------------
+
+    def corrupt_segment(self, seg_dir: str) -> str:
+        """Corrupt one file inside a sealed segment directory; returns
+        the path corrupted. Deterministic under ``seed``."""
+        import json as _json
+        import os
+
+        sums_path = os.path.join(seg_dir, "checksums.json")
+        if self.kind == "missing_checksums":
+            os.remove(sums_path)
+            self._log(sums_path, "deleted checksums.json")
+            return sums_path
+        if self.kind == "torn_checksums":
+            size = os.path.getsize(sums_path)
+            with open(sums_path, "r+b") as f:
+                f.truncate(max(1, size // 2))  # mid-JSON tear
+            self._log(sums_path, "tore checksums.json")
+            return sums_path
+        with open(sums_path, encoding="utf-8") as f:
+            names = sorted(_json.load(f))
+        if not names:
+            raise ValueError(f"segment [{seg_dir}] has no checksummed files")
+        if self.target is not None:
+            if self.target not in names:
+                raise ValueError(
+                    f"target [{self.target}] not checksummed in [{seg_dir}]")
+            name = self.target
+        else:
+            name = ("arrays.npz" if "arrays.npz" in names
+                    else self._rng.choice(names))
+        path = os.path.join(seg_dir, name)
+        if self.kind == "truncate":
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(0, size - 1))
+            self._log(path, "truncated 1 byte")
+            return path
+        # bitflip
+        size = os.path.getsize(path)
+        offset = self._rng.randrange(max(1, size))
+        bit = 1 << self._rng.randrange(8)
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            byte = f.read(1)
+            f.seek(offset)
+            f.write(bytes([byte[0] ^ bit]))
+        self._log(path, f"flipped bit {bit:#04x} at offset {offset}")
+        return path
+
+    def corrupt_store(self, store, segment: Optional[str] = None) -> str:
+        """Corrupt one committed segment of ``store`` (the newest by
+        default) — the at-rest entry point for shard-level tests."""
+        commit = store.read_commit() or {}
+        names = [s["name"] if isinstance(s, dict) else s
+                 for s in commit.get("segments", [])]
+        if not names:
+            raise ValueError("store has no committed segments to corrupt")
+        name = segment if segment is not None else names[-1]
+        return self.corrupt_segment(store._seg_dir(name))
+
+    def _log(self, path: str, what: str) -> None:
+        with self._lock:
+            self.hits += 1
+            self.corrupted.append((path, f"{self.kind}: {what}"))
+
+    # --- in-flight (recovery stream) ----------------------------------
+    #
+    # Duck-types the DisruptionScheme hub protocol (apply_to / applies /
+    # disrupt) instead of subclassing: the effect is a payload mutation
+    # on the SOURCE, not a delivery failure, so none of the base class's
+    # raise/sleep semantics apply.
+
+    def apply_to(self, hub) -> "StoreCorruptionScheme":
+        if self.source_node is None:
+            raise ValueError(
+                "in-flight corruption needs source_node (the recovery "
+                "source's MultiNodeService)")
+        hub.add_disruption(self)
+        self.hub = hub
+        return self
+
+    def remove(self) -> None:
+        if self.hub is not None:
+            self.hub.remove_disruption(self)
+            self.hub = None
+
+    def applies(self, src: str, dst: str, action: str) -> bool:
+        return (self.source_node is not None
+                and action == "internal:index/shard/recovery/files/chunk"
+                and dst == self.source_node.node_id)
+
+    def disrupt(self, src: str, dst: str, action: str) -> None:
+        """Flip one bit inside every open recovery session's snapshot on
+        the source — AFTER the manifest digests were computed, so the
+        shipped bytes can no longer verify. Fires ``times`` deliveries,
+        then goes inert (the retried session re-reads clean disk)."""
+        with self._lock:
+            if self.hits >= self.times:
+                return
+            sessions = getattr(self.source_node, "_recovery_sessions", {})
+            flipped = False
+            for sess in sessions.values():
+                for rel in sorted(sess.get("files", {})):
+                    data = sess["files"][rel]
+                    if not data:
+                        continue
+                    offset = self._rng.randrange(len(data))
+                    bit = 1 << self._rng.randrange(8)
+                    sess["files"][rel] = (data[:offset]
+                                          + bytes([data[offset] ^ bit])
+                                          + data[offset + 1:])
+                    self.hits += 1
+                    self.corrupted.append(
+                        (rel, f"in-flight bitflip at offset {offset}"))
+                    flipped = True
+                    break
+                if flipped:
+                    break
+
+
 class ActionBlackhole(DisruptionScheme):
     """Requests matching the action patterns vanish: the delivery blocks
     until the caller's deadline (MockTransportService's request

@@ -183,6 +183,7 @@ def all_passes() -> Dict[str, LintPass]:
         pass_counters,
         pass_ledger,
         pass_lockorder,
+        pass_quarantine,
         pass_settings_docs,
         pass_threadlocal,
     )

@@ -1,0 +1,99 @@
+"""Pass 7 — quarantine release discipline (ISSUE 16).
+
+The corruption-quarantine contract (docs/RESILIENCE.md "Data
+integrity"): flipping a shard copy's ``store_corrupted`` flag is only
+legal as the last step of a full quarantine — the same scope must also
+
+1. write the durable ``corrupted_*`` marker (``mark_corrupted``) so the
+   quarantine survives restart and the allocator can see it;
+2. record the detection (``record_corruption``) so the integrity
+   counters never undercount a corruption the cluster acted on; and
+3. release the copy's device staging through the PR-9 accountant
+   (``release_device_staging``, or a ``release_scope``/``release_index``
+   sweep) — a quarantined copy must not pin HBM, and the ledger must
+   return to baseline exactly.
+
+A flag flip missing any leg is the bug class ISSUE 16's chaos phase
+exists to catch at runtime (silent-unmarked copies, leaked staged
+bytes, undercounted detections); this pass catches it at lint time.
+Sites that provably have nothing staged (a copy that was never opened)
+belong in the allowlist with that justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from elasticsearch_tpu.testing.lint.core import (
+    Finding,
+    LintPass,
+    SourceTree,
+    register_pass,
+)
+
+RELEASE_CALLS = {"release_device_staging", "release_scope",
+                 "release_index"}
+
+
+def _called_names(scope: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                out.add(f.attr)
+            elif isinstance(f, ast.Name):
+                out.add(f.id)
+    return out
+
+
+def _is_quarantine_flip(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Assign)
+            and any(isinstance(t, ast.Attribute)
+                    and t.attr == "store_corrupted"
+                    for t in node.targets)
+            and isinstance(node.value, ast.Constant)
+            and node.value.value is True)
+
+
+@register_pass
+class QuarantineReleasePass(LintPass):
+    name = "quarantine-release"
+    description = ("every store_corrupted = True site must mark the "
+                   "store, record the detection, and release the "
+                   "copy's device staging in the same scope")
+    targets = None  # whole tree: new quarantine sites must comply
+
+    def run(self, tree: SourceTree) -> Iterable[Finding]:
+        for rel, sf in tree.files.items():
+            if rel.startswith("testing/lint/"):
+                continue  # the analyzer's own pattern tables
+            for node in ast.walk(sf.tree):
+                if not _is_quarantine_flip(node):
+                    continue
+                qual = sf.qualname_at(node)
+                scope = sf.defs.get(qual, sf.tree)
+                called = _called_names(scope)
+                if "mark_corrupted" not in called:
+                    yield Finding(
+                        self.name, rel, qual, node.lineno,
+                        "store_corrupted flipped without writing the "
+                        "durable corrupted_* marker (mark_corrupted) — "
+                        "the quarantine would not survive restart",
+                        key="marker")
+                if "record_corruption" not in called:
+                    yield Finding(
+                        self.name, rel, qual, node.lineno,
+                        "store_corrupted flipped without "
+                        "record_corruption — the integrity counters "
+                        "would undercount an acted-on detection",
+                        key="record")
+                if not (RELEASE_CALLS & called):
+                    yield Finding(
+                        self.name, rel, qual, node.lineno,
+                        "store_corrupted flipped without releasing the "
+                        "copy's device staging (release_device_staging/"
+                        "release_scope/release_index) — a quarantined "
+                        "copy must not pin HBM (ledger exactness)",
+                        key="staging-release")

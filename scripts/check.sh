@@ -18,6 +18,49 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== contract lints (python -m elasticsearch_tpu.testing.lint) =="
 python -m elasticsearch_tpu.testing.lint
 
+echo "== integrity ledger balance (quarantine releases staged scope) =="
+# The quarantine-release lint pass proves every store_corrupted flip
+# releases device staging; this runtime probe proves the accountant's
+# ledger actually returns to baseline through that path (ISSUE 16).
+python - <<'EOF'
+import os
+import tempfile
+
+os.environ.setdefault("ES_TPU_PALLAS", "interpret")
+
+from elasticsearch_tpu.common.memory import memory_accountant
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.index.store import CorruptIndexException
+
+with tempfile.TemporaryDirectory() as d:
+    svc = IndexService(
+        "ledger_probe",
+        Settings({"index.number_of_shards": 1,
+                  "index.search.mesh": False}),
+        mapping={"properties": {"body": {"type": "text"}}},
+        data_path=d)
+    try:
+        for i in range(8):
+            svc.index_doc(str(i), {"body": f"alpha beta {i}"})
+        svc.refresh()
+        svc.search({"query": {"match": {"body": "alpha"}}})
+        acct = memory_accountant()
+        before = acct.staged_bytes("ledger_probe")
+        assert before > 0, "probe search staged nothing"
+        svc._quarantine_shard(
+            0, CorruptIndexException("check.sh ledger probe"),
+            site="scrub")
+        after = acct.staged_bytes("ledger_probe")
+        assert after == 0, (before, after)
+        assert all(not seg._device
+                   for sh in svc.shards.values()
+                   for seg in sh.engine.segments)
+        print(f"   ledger ok: staged {before} -> {after} bytes")
+    finally:
+        svc.close()
+EOF
+
 if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
@@ -27,3 +70,6 @@ python -m pytest -q -p no:cacheprovider \
     tests/test_contract_lint.py \
     tests/test_settings_registry.py \
     tests/test_observability_registry.py
+
+echo "== corruption matrix =="
+python -m pytest -q -p no:cacheprovider tests/test_corruption.py

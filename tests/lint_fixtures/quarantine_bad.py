@@ -1,0 +1,20 @@
+"""Known-bad snippet for the quarantine-release pass: a shard copy
+flagged corrupt without the marker, the detection record, or the
+device-staging release. Parsed only."""
+
+
+class BadQuarantiner:
+    def fail_copy(self, shard):
+        # BAD on all three axes: no mark_corrupted, no
+        # record_corruption, no staging release — a silent in-memory
+        # quarantine that leaks HBM and vanishes on restart
+        shard.store_corrupted = True
+
+
+class GoodQuarantiner:
+    def fail_copy(self, shard, integ, exc):
+        integ.record_corruption("idx", 0, "query", str(exc))
+        shard.engine.store.mark_corrupted(str(exc), site="query")
+        shard.store_corrupted = True
+        for seg in shard.engine.segments:
+            seg.release_device_staging()

@@ -64,6 +64,37 @@ class TestChaosSoakSmoke:
         assert ov["rejected"] == ov["offered"] - ov["admitted"]
 
 
+class TestCorruptionSoakSmoke:
+    """Data-integrity corruption phase (ISSUE 16 acceptance): every
+    injected corruption is detected (zero silent wrong results), a
+    corrupt replica re-recovers from the primary, a corrupt primary
+    fails over to the STARTED replica and rebuilds, in-flight recovery
+    corruption is caught by the manifest-digest check and retried, and
+    the device-memory ledger stays leak-free through every quarantine."""
+
+    @pytest.fixture(autouse=True)
+    def _interpret(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_PALLAS", "interpret")
+
+    def test_corruption_phase(self, tmp_path):
+        soak = ChaosSoak(seed=SMOKE_SEED, shards=3, seed_docs=24,
+                         index="chaos_int")
+        report = soak.run_corruption(str(tmp_path))
+        # every injection was counted as a detection somewhere
+        assert report["injected"] >= 4, report
+        assert report["detected"] >= report["injected"], report
+        local = report["local"]
+        assert local["at_rest"]["scrub"]["checksum_failures"] >= 1
+        assert local["at_rest"]["failed_shards"] >= 1
+        assert local["drift"]["scrub"]["drift"] >= 1
+        scenarios = {s["scenario"]: s
+                     for s in report["cluster"]["scenarios"]}
+        assert scenarios["corrupt_replica"]["by_site"]["load"] >= 1
+        assert scenarios["corrupt_replica"]["cleared"] >= 1
+        assert scenarios["corrupt_primary"]["by_site"]["load"] >= 1
+        assert scenarios["recovery_in_flight"]["by_site"]["recovery"] >= 1
+
+
 @pytest.mark.slow
 class TestChaosSoakFull:
     def test_full_soak(self, monkeypatch):

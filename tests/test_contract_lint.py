@@ -60,7 +60,7 @@ class TestContractLintGate:
         for expected in ("cancellation-passthrough", "ledger-balance",
                          "counter-lock-discipline",
                          "thread-local-hygiene", "lock-order",
-                         "settings-docs"):
+                         "settings-docs", "quarantine-release"):
             assert expected in passes
 
     def test_lock_order_doc_fresh(self):
@@ -180,6 +180,24 @@ class TestPassSelfTests:
         assert "2 tables" in by_key["search.twice"]
         assert "search.unregistered" in by_key
         assert "search.documented" not in by_key
+
+    def test_quarantine_pass_fires(self):
+        ids = {f.id for f in _fixture_findings("quarantine-release")}
+        for key in ("marker", "record", "staging-release"):
+            assert (f"quarantine-release:quarantine_bad.py:"
+                    f"BadQuarantiner.fail_copy:{key}") in ids
+        assert not any("GoodQuarantiner" in i for i in ids)
+
+    def test_quarantine_pass_sees_the_real_sites(self):
+        # the pass is only trustworthy while it still matches the
+        # quarantine population the tree actually has: the load-time
+        # reconcile site is allowlisted (never-staged copy), so its
+        # finding must keep existing for the stale check to hold
+        findings = list(all_passes()["quarantine-release"].run(
+            SourceTree()))
+        assert any(f.qualname == "ClusterNode._reconcile_shards"
+                   and f.key == "staging-release" for f in findings), (
+            [f.id for f in findings])
 
     def test_fixture_files_parse(self):
         # the snippets are parsed, never imported — keep them valid AST

@@ -430,7 +430,8 @@ class TestNestedCorruptionDetection:
         import glob
         import os
 
-        from elasticsearch_tpu.index.store import CorruptIndexException
+        from elasticsearch_tpu.common.integrity import integrity_service
+        from elasticsearch_tpu.index.store import MARKER_PREFIX
 
         path = str(tmp_path / "c")
         idx = IndexService("c", Settings({"index.number_of_shards": 1}),
@@ -450,9 +451,31 @@ class TestNestedCorruptionDetection:
             f.seek(-1, os.SEEK_END)
             f.write(bytes([byte[0] ^ 0xFF]))
 
-        with pytest.raises(CorruptIndexException):
-            IndexService("c", Settings({"index.number_of_shards": 1}),
-                         data_path=path)
+        # boot over the corrupt bytes QUARANTINES the copy instead of
+        # crashing index open (docs/RESILIENCE.md "Data integrity"):
+        # detection is still mandatory — counted at the load site, a
+        # durable corrupted_* marker lands in the shard dir, and every
+        # query fails loudly rather than serving silent empty hits
+        before = integrity_service().stats()[
+            "corruption_detected_by_site"]["load"]
+        reopened = IndexService("c", Settings({"index.number_of_shards": 1}),
+                                data_path=path)
+        try:
+            after = integrity_service().stats()[
+                "corruption_detected_by_site"]["load"]
+            assert after == before + 1
+            assert reopened.shards[0].store_corrupted
+            assert reopened.shards[0].engine.store.corruption_markers()
+            assert any(f.startswith(MARKER_PREFIX)
+                       for f in os.listdir(os.path.join(path, "0", "index")))
+            from elasticsearch_tpu.common.errors import (
+                SearchPhaseExecutionException,
+            )
+
+            with pytest.raises(SearchPhaseExecutionException):
+                reopened.search({"query": {"match_all": {}}})
+        finally:
+            reopened.close()
 
 
 class TestIncludeInRoot:

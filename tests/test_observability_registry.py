@@ -198,6 +198,30 @@ class TestObservabilityRegistryLint:
             assert kind in mem["staged_bytes"], mem["staged_bytes"]
             assert kind in doc, f"ledger kind [{kind}] undocumented"
 
+    def test_integrity_stats_keys_documented(self, exercised_index):
+        # ISSUE 16: the `search.integrity` block — detection counters
+        # with the per-site split, marker lifecycle counters + event
+        # ring, scrub counters — every exported key (site names
+        # included) must be in docs/OBSERVABILITY.md
+        doc = _doc_text()
+        integ = exercised_index.search_stats()["integrity"]
+        keys: set = set()
+        _walk_keys(integ, keys)
+        missing = sorted(k for k in keys if k not in doc)
+        assert not missing, (
+            f"search.integrity keys absent from docs/OBSERVABILITY.md: "
+            f"{missing}")
+        from elasticsearch_tpu.common.integrity import SITES
+
+        for site in SITES:
+            assert site in integ["corruption_detected_by_site"], integ
+            assert site in doc, f"detection site [{site}] undocumented"
+        # the marker-event vocabulary (action values + event fields) is
+        # part of the documented operator surface
+        for word in ("detected", "marked", "cleared", "drift",
+                     "action", "marker", "reason", "timestamp_ms"):
+            assert word in doc, f"event vocabulary [{word}] undocumented"
+
     def test_staging_fault_counters_documented_and_exported(
             self, exercised_index):
         # ISSUE 10: the classified staging-fault model must export its
