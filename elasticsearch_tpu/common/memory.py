@@ -70,8 +70,19 @@ KINDS = (KIND_POSTINGS_RAW, KIND_POSTINGS_PACKED, KIND_LIVE_MASK,
 #                       index.scrub.interval) found device/host digest
 #                       drift and invalidated the staging — the restage
 #                       re-adopts host truth
+#   delta_append        an incremental refresh staged ONLY the new
+#                       segments' tables into free slots of the live
+#                       mesh generation (ISSUE 20) — the delta bytes
+#                       count as restaged AND logically changed, so a
+#                       pure-append refresh drives amplification to ~1
+#   tombstone           a delete updated only the affected slots'
+#                       live-mask columns in place (kNN exists∧live and
+#                       fused-agg matched masks included)
+#   compaction          the background compaction pass merged sparse
+#                       slots into fresh ones and released the old
+#                       generation (index.staging.compact.threshold)
 REASONS = ("initial", "refresh", "delete_invalidation", "geometry_change",
-           "probe", "scrub")
+           "probe", "scrub", "delta_append", "tombstone", "compaction")
 
 
 class _Entry:
@@ -152,12 +163,22 @@ class DeviceMemoryAccountant:
                  nbytes: int, *, reason: str = "initial",
                  duration_ms: float = 0.0, plane: str = "host",
                  evict: Optional[Callable[[], None]] = None,
-                 quiet: bool = False) -> None:
+                 quiet: bool = False,
+                 amplify_bytes: Optional[int] = None) -> None:
         """Record ``table`` (one staged array group) as holding
         ``nbytes`` of device memory. Re-registering the same key
         REPLACES its bytes (a restage, not a leak). ``quiet`` skips the
         event ring and amplification counters — for accumulator-style
-        caches that re-register per increment (the ub-column cache)."""
+        caches that re-register per increment (the ub-column cache).
+
+        ``amplify_bytes`` decouples ledger truth from amplification
+        truth for DELTA restages (ISSUE 20): a tombstone or slot append
+        replaces a whole device array (the ledger must hold its full
+        ``nbytes``) while only the changed slot ROWS were actually
+        restaged — those row bytes feed the amplification counters and
+        the event ring. ``delta_append`` rows count as restaged AND
+        logically changed (new data arriving IS the logical change), so
+        a pure-append refresh reports amplification ~1."""
         assert kind in KINDS, kind
         assert reason in REASONS, reason
         index = index or "_unassigned"
@@ -181,15 +202,23 @@ class DeviceMemoryAccountant:
             if evict is not None:
                 self._scope_evict[(index, scope)] = evict
             if not quiet:
+                amp = int(nbytes if amplify_bytes is None
+                          else amplify_bytes)
                 if reason == "initial":
                     self._logical[index] = (self._logical.get(index, 0)
-                                            + int(nbytes))
+                                            + amp)
                 else:
                     self._restaged[index] = (self._restaged.get(index, 0)
-                                             + int(nbytes))
+                                             + amp)
+                    if reason == "delta_append":
+                        # the appended rows are new data: they grow the
+                        # logical denominator too, keeping the ratio ~1
+                        # for a clean append
+                        self._logical[index] = (
+                            self._logical.get(index, 0) + amp)
                 self._push(self.staging_events, {
                     "index": index, "segment": scope, "kind": kind,
-                    "table": table, "bytes": int(nbytes),
+                    "table": table, "bytes": amp,
                     "duration_ms": round(float(duration_ms), 3),
                     "reason": reason, "plane": plane,
                     "timestamp_ms": int(time.time() * 1000),

@@ -869,6 +869,22 @@ INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN = Setting.time_setting(
     "index.search.plane_quarantine.cooldown", "60s", scope=Scope.INDEX,
     dynamic=True
 )
+INDEX_STAGING_DELTA_ENABLED = Setting.bool_setting(
+    # delta device staging (ISSUE 20, docs/MESH.md "Slot allocator &
+    # generations"): refreshes that add segments within free slot
+    # capacity append ONLY the new tables, deletes flip only live-mask
+    # columns in place; false forces the pre-delta full-rebuild path
+    # (the geometry-change fallback becomes the only path)
+    "index.staging.delta.enabled", True, scope=Scope.INDEX, dynamic=True
+)
+INDEX_STAGING_COMPACT_THRESHOLD = Setting.float_setting(
+    # background slot compaction trigger: when any staged slot's
+    # tombstone density reaches this fraction (or free slots are
+    # exhausted), a single-flight background pass merges sparse slots
+    # into fresh ones and restages a compact generation; <= 0 disables
+    "index.staging.compact.threshold", 0.25, scope=Scope.INDEX,
+    dynamic=True
+)
 INDEX_SCRUB_INTERVAL = Setting.time_setting(
     # background store/device scrubber (ISSUE 16, docs/RESILIENCE.md
     # "Data integrity"): re-verify sealed-segment checksums and compare
@@ -894,6 +910,8 @@ INDEX_SETTINGS = [
     INDEX_SEARCH_PALLAS_POSTINGS_CODEC,
     INDEX_SEARCH_AGGS_FUSED,
     INDEX_SEARCH_PLANE_QUARANTINE_COOLDOWN,
+    INDEX_STAGING_DELTA_ENABLED,
+    INDEX_STAGING_COMPACT_THRESHOLD,
     INDEX_SCRUB_INTERVAL,
     INDEX_SEARCH_SLOWLOG_WARN,
     INDEX_SEARCH_SLOWLOG_INFO,

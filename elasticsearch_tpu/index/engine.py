@@ -494,11 +494,14 @@ class Engine:
         self.flush(sync_id=sync_id)
         return sync_id
 
-    def force_merge(self) -> None:
+    def force_merge(self, stage_reason: str = "refresh") -> None:
         """Rewrite all segments into one (expunges deletes). The reference
         merges Lucene segments; we re-index live docs from stored source —
         correct and simple, at rebuild cost (acceptable: force-merge is an
-        offline optimization op)."""
+        offline optimization op). ``stage_reason`` classifies the merge
+        product's first device staging in the lifecycle ring — "refresh"
+        for an operator force-merge, "compaction" when the background
+        slot-compaction pass (ISSUE 20) drives the merge."""
         with self._lock:
             self.refresh()
             live_docs = []
@@ -541,7 +544,7 @@ class Engine:
             # restage in the lifecycle ring, like the mesh plane
             # classifies the same merge (Segment.stage_reason_initial)
             def _mark_restage(seg: Segment) -> None:
-                seg.stage_reason_initial = "refresh"
+                seg.stage_reason_initial = stage_reason
                 for nctx in seg.nested.values():
                     _mark_restage(nctx.segment)
 

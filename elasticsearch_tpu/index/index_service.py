@@ -227,6 +227,14 @@ class IndexService:
         self._scrub_stop = _scrub_threading.Event()
         _scrub_threading.Thread(target=self._scrub_loop, daemon=True,
                                 name=f"scrub[{name}]").start()
+        # background slot compaction (ISSUE 20): no polling loop — the
+        # mesh plane nudges maybe_compact_async() after a delta commit;
+        # the lock makes the pass single-flight (a second trigger while
+        # one runs is a no-op, never a queue)
+        self.staging_delta_enabled_override: Optional[bool] = None
+        self.staging_compact_threshold_override: Optional[float] = None
+        self._compact_lock = _scrub_threading.Lock()
+        self._closing = False
 
     def _rebuild_parents(self) -> None:
         """Re-derive the _parent registry from recovered shard state: the
@@ -386,6 +394,107 @@ class IndexService:
         return {"bytes_verified": bytes_verified,
                 "checksum_failures": checksum_failures,
                 "drift": drift_count}
+
+    # ------------------------------------------------------------------
+    # Background slot compaction (ISSUE 20)
+    # ------------------------------------------------------------------
+
+    def _compact_threshold(self) -> float:
+        """index.staging.compact.threshold with the explicitness-aware
+        cluster override on top; <= 0 disables compaction."""
+        if self.staging_compact_threshold_override is not None:
+            return float(self.staging_compact_threshold_override)
+        return float(self.settings.get_float(
+            "index.staging.compact.threshold", 0.25))
+
+    def _compaction_due(self) -> bool:
+        """Tombstone density or slot fragmentation crossed the
+        threshold on the live staged generation (cheap: host-side
+        counters only, no device work)."""
+        threshold = self._compact_threshold()
+        if threshold <= 0:
+            return False
+        ms = self._mesh_search
+        stats = (ms.staging_slot_stats() if ms is not None else None)
+        if not stats or not stats["slots"]:
+            return False
+        if any(s["tombstone_density"] >= threshold
+               for s in stats["slots"]):
+            return True
+        # fragmentation: occupied slots beyond what the live docs need —
+        # sparse slots (delete-heavy or many tiny appended segments)
+        # waste HBM rows and merge-loop work; when the occupied count
+        # exceeds the post-merge slot need by more than the threshold
+        # fraction, a compaction pass would shrink the generation
+        occupied = len(stats["slots"])
+        needed = max(1, -(-sum(s["live"] for s in stats["slots"])
+                          // max(max(s["docs"] for s in stats["slots"]),
+                                 1)))
+        return occupied > needed and (
+            (occupied - needed) / occupied >= threshold)
+
+    def maybe_compact_async(self) -> bool:
+        """Delta-commit hook (called by the mesh plane, possibly under
+        its stage lock): decide cheaply, then run the pass on a
+        background thread — compaction never runs on the query path.
+        Returns True when a pass was kicked off."""
+        if (self._closing or self.admission.draining
+                or not self._compaction_due()):
+            return False
+        if self._compact_lock.locked():
+            return False  # single-flight: a pass is already running
+        import threading as _t
+
+        _t.Thread(target=self.compact_now, daemon=True,
+                  name=f"compact[{self.name}]").start()
+        return True
+
+    def compact_now(self) -> dict:
+        """One synchronous compaction pass (the background thread body;
+        tests call it directly for determinism). Force-merges the
+        tombstone-dense shards (expunging deletes), then restages a
+        FRESH generation with fresh slot headroom and releases the old
+        one — ledger-exact through the transactional staging path.
+        Single-flight via ``_compact_lock``; interruptible by drain
+        (docs/RESILIENCE.md): a drain beginning mid-pass aborts between
+        shards, leaving a consistent (merely uncompacted) staging."""
+        if not self._compact_lock.acquire(blocking=False):
+            return {"ran": False, "reason": "already_running"}
+        try:
+            if self._closing:
+                return {"ran": False, "reason": "closing"}
+            if self.admission.draining:
+                return {"ran": False, "reason": "draining"}
+            threshold = self._compact_threshold()
+            merged_shards = []
+            for sid, shard in list(self.shards.items()):
+                if self._closing:
+                    return {"ran": False, "reason": "closing",
+                            "merged_shards": merged_shards}
+                if self.admission.draining:
+                    return {"ran": False, "reason": "draining",
+                            "merged_shards": merged_shards}
+                eng = shard.engine
+                total = sum(int(s.num_docs) for s in eng.segments)
+                live = sum(int(s.live_doc_count) for s in eng.segments)
+                dense = (total > 0 and threshold > 0
+                         and (total - live) / total >= threshold)
+                frag = len(eng.segments) > 1
+                if dense or frag:
+                    eng.force_merge(stage_reason="compaction")
+                    merged_shards.append(sid)
+            if self._closing:
+                return {"ran": False, "reason": "closing",
+                        "merged_shards": merged_shards}
+            ms = self._mesh_search
+            restaged = (ms.restage_for_compaction()
+                        if ms is not None else False)
+            if ms is not None:
+                ms.note_compaction_run()
+            return {"ran": True, "merged_shards": merged_shards,
+                    "restaged": bool(restaged)}
+        finally:
+            self._compact_lock.release()
 
     # ------------------------------------------------------------------
     # Routing + document ops
@@ -1717,6 +1826,18 @@ class IndexService:
                 "pruned_query_total": (
                     self._mesh_search.pruned_query_total
                     if self._mesh_search is not None else 0),
+                # delta device staging (ISSUE 20): incremental appends
+                # served without a geometry rebuild, in-place tombstone
+                # mask updates, and background compaction passes
+                "delta_restage_total": (
+                    self._mesh_search.delta_restage_total
+                    if self._mesh_search is not None else 0),
+                "tombstone_update_total": (
+                    self._mesh_search.tombstone_update_total
+                    if self._mesh_search is not None else 0),
+                "compaction_runs_total": (
+                    self._mesh_search.compaction_runs_total
+                    if self._mesh_search is not None else 0),
                 "tiles_scored_total": (
                     self._mesh_search.tiles_scored_total
                     if self._mesh_search is not None else 0),
@@ -1847,6 +1968,12 @@ class IndexService:
         self.mapper_service.merge(mapping)
 
     def close(self) -> None:
+        self._closing = True
+        # wait out an in-flight background compaction pass: its restage
+        # must not re-stage bytes after the releases below (the
+        # leak-check contract) — new passes see _closing and bail
+        with self._compact_lock:
+            pass
         if self._refresh_stop is not None:
             self._refresh_stop.set()
         self._scrub_stop.set()

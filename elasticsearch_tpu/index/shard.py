@@ -361,8 +361,8 @@ class IndexShard:
         path replays zero translog ops."""
         return self.engine.synced_flush()
 
-    def force_merge(self) -> None:
-        self.engine.force_merge()
+    def force_merge(self, stage_reason: str = "refresh") -> None:
+        self.engine.force_merge(stage_reason=stage_reason)
 
     def _ensure_started(self) -> None:
         if self.state not in (ShardState.STARTED, ShardState.POST_RECOVERY):

@@ -303,7 +303,7 @@ class Node:
         for prefix in ("search.batch.", "search.pallas.", "search.knn.",
                        "search.aggs.", "search.telemetry.",
                        "search.queue.", "search.admission.",
-                       "search.drain."):
+                       "search.drain.", "index.staging."):
             cluster_dynamic = state.persistent_settings.merged_with(
                 state.transient_settings).filtered_by_prefix(prefix)
             merged_settings = self.settings.filtered_by_prefix(
@@ -314,6 +314,22 @@ class Node:
         svc = IndexService(name, merged_settings, merged_mappings,
                            self._index_data_path(name))
         svc.doc_type = doc_type  # 6.x custom type name echoed in responses
+        # an index created AFTER a cluster-level index.staging.* commit
+        # must honor the live override like its older peers (the
+        # put_cluster_settings sync only reaches indices alive then)
+        from elasticsearch_tpu.common.settings import (
+            INDEX_STAGING_COMPACT_THRESHOLD,
+            INDEX_STAGING_DELTA_ENABLED,
+        )
+
+        committed = state.persistent_settings.merged_with(
+            state.transient_settings)
+        if committed.get(INDEX_STAGING_DELTA_ENABLED.key) is not None:
+            svc.staging_delta_enabled_override = (
+                INDEX_STAGING_DELTA_ENABLED.get(committed))
+        if committed.get(INDEX_STAGING_COMPACT_THRESHOLD.key) is not None:
+            svc.staging_compact_threshold_override = (
+                INDEX_STAGING_COMPACT_THRESHOLD.get(committed))
         if self._draining:
             # an index created while the node drains (auto-create from a
             # straggling write) joins the drain: its searches get the
@@ -1775,6 +1791,25 @@ class Node:
                        if scrub_explicit else None)
         for svc in self.indices.values():
             svc.scrub_interval_override = scrub_value
+        # delta device staging knobs (index.staging.*, ISSUE 20): same
+        # explicitness contract — an explicit cluster value overrides
+        # every index's own setting, clearing hands control back
+        from elasticsearch_tpu.common.settings import (
+            INDEX_STAGING_COMPACT_THRESHOLD,
+            INDEX_STAGING_DELTA_ENABLED,
+        )
+
+        delta_explicit = (
+            committed.get(INDEX_STAGING_DELTA_ENABLED.key) is not None)
+        delta_value = (INDEX_STAGING_DELTA_ENABLED.get(committed)
+                       if delta_explicit else None)
+        compact_explicit = (
+            committed.get(INDEX_STAGING_COMPACT_THRESHOLD.key) is not None)
+        compact_value = (INDEX_STAGING_COMPACT_THRESHOLD.get(committed)
+                         if compact_explicit else None)
+        for svc in self.indices.values():
+            svc.staging_delta_enabled_override = delta_value
+            svc.staging_compact_threshold_override = compact_value
         return {
             "acknowledged": True,
             "persistent": state.persistent_settings.as_nested_dict(),

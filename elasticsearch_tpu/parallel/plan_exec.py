@@ -58,6 +58,13 @@ class _KnnStructuralError(StagingBail):
     device fault — ensure_knn pins the field to the host rung."""
 
 
+class _DeltaIneligible(StagingBail):
+    """A delta staging attempt hit a structural surprise the cheap
+    eligibility pre-check could not see (ISSUE 20): not a device fault —
+    run_staged re-raises it untouched (StagingBail contract) and
+    IndexMeshSearch falls back to the full geometry rebuild."""
+
+
 _plane_logger = logging.getLogger("elasticsearch_tpu.parallel.plane")
 
 # Two mesh programs in flight at once interleave their collective
@@ -1025,6 +1032,13 @@ class IndexMeshSearch:
         self.pruned_query_total = 0
         self.tiles_scored_total = 0
         self.tiles_pruned_total = 0
+        # delta device staging (ISSUE 20, docs/MESH.md): refreshes
+        # served by a slot append instead of a rebuild, deletes served
+        # by in-place tombstone mask updates, and background compaction
+        # passes that rebuilt a compact generation
+        self.delta_restage_total = 0
+        self.tombstone_update_total = 0
+        self.compaction_runs_total = 0
         settings = getattr(index_service, "settings", None)
         # packing limit: segments are packed max_slots-deep per device
         # before the index falls back to the host path (registered as
@@ -1132,6 +1146,144 @@ class IndexMeshSearch:
             return "refresh"
         return "delete_invalidation"
 
+    @staticmethod
+    def _key_for(pairs) -> frozenset:
+        """Staged-set identity: ORDER-INDEPENDENT (a frozenset), so a
+        delta-append successor — whose slot order appends new segments
+        at the tail instead of re-sorting — compares equal to the same
+        logical set (ISSUE 20). live_doc_count participates: deletes
+        mutate a sealed segment's live mask in place, which must
+        invalidate (tombstone-update) the staged live1."""
+        return frozenset((sid, id(seg), seg.live_doc_count)
+                         for sid, seg in pairs)
+
+    def _delta_enabled(self) -> bool:
+        """index.staging.delta.enabled with the explicitness-aware
+        cluster override on top (put_cluster_settings)."""
+        override = getattr(self.svc, "staging_delta_enabled_override",
+                           None)
+        if override is not None:
+            return bool(override)
+        settings = getattr(self.svc, "settings", None)
+        if settings is None:
+            return True
+        return bool(settings.get_bool("index.staging.delta.enabled",
+                                      True))
+
+    def _classify_delta(self, old, pairs, codec):
+        """Decide whether the staged-key change is servable as a DELTA
+        on the live generation (ISSUE 20). Returns
+        ``("tombstone", [], changed_slots)`` when only live-doc counts
+        changed, ``("append", new_pairs, changed_slots)`` when segments
+        were added within free slot capacity (deletes may ride along),
+        or None for the full-rebuild fallback (segments retired, slots
+        exhausted, tile-geometry mismatch, codec change)."""
+        staged_counts = {(sid, kid): n
+                         for sid, kid, n in self._staged_key}
+        slot_of = {(sid, id(seg)): slot
+                   for slot, (sid, seg) in enumerate(old.pairs)}
+        if set(slot_of) != set(staged_counts):
+            return None  # key/generation disagree: rebuild from truth
+        new_ids = {(sid, id(seg)) for sid, seg in pairs}
+        if not set(slot_of) <= new_ids:
+            return None  # segments retired (merge): rebuild
+        if codec != old.postings_codec_pref:
+            return None  # codec change: rebuild fallback
+        append_pairs = [(sid, seg) for sid, seg in pairs
+                        if (sid, id(seg)) not in slot_of]
+        changed = sorted(
+            slot_of[(sid, id(seg))] for sid, seg in pairs
+            if (sid, id(seg)) in slot_of
+            and staged_counts[(sid, id(seg))] != seg.live_doc_count)
+        if not append_pairs:
+            return ("tombstone", [], changed) if changed else None
+        if not MeshPlanExecutor.delta_append_compatible(
+                old, [seg for _sid, seg in append_pairs]):
+            return None
+        return ("append", append_pairs, changed)
+
+    def _apply_delta(self, old, delta, key) -> Optional[bool]:
+        """Serve a classified delta on/over the live generation (caller
+        holds ``_stage_lock``). Returns True on success, False on a
+        terminal fault (staging benched — host rung serves), or None
+        when a structural surprise says fall back to the rebuild."""
+        from elasticsearch_tpu.common.errors import \
+            TaskCancelledException
+        from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.common.staging import run_staged
+        from elasticsearch_tpu.search.cancellation import \
+            TimeExceededException
+
+        # thread-local hygiene (PR-9 bug class): this is a staging
+        # attempt in its own right — reset before any denial below
+        self.staging_denied_reason = None
+        kind_of, append_pairs, changed_slots = delta
+        try:
+            if kind_of == "tombstone":
+                run_staged(
+                    lambda: old.apply_tombstones(changed_slots),
+                    index=self.svc.name, kind="live_mask", plane="mesh")
+                self._staged_key = key
+                with self._counter_lock:
+                    self.tombstone_update_total += 1
+                old.touch()
+                self._maybe_compact()
+                return True
+            # append: budget-gate the DELTA rows only (the carried
+            # arrays are already in the ledger under the old scope)
+            estimate = sum(
+                seg.block_docs.nbytes + seg.block_tfs.nbytes
+                + seg.norms.nbytes + seg.nd_pad + 1
+                for _sid, seg in append_pairs)
+            if not memory_accountant().try_reserve(
+                    self.svc.name, estimate, exclude_scope=old.scope):
+                self.staging_denied_reason = "hbm_budget"
+                return False
+            staged = run_staged(
+                lambda: MeshPlanExecutor.delta_append(
+                    old, append_pairs, changed_slots,
+                    index_name=self.svc.name),
+                index=self.svc.name, kind="mesh_slot_tables",
+                plane="mesh")
+            old.release()
+            self._pairs = list(staged.pairs)
+            self._executor = staged
+            self._staged_key = key
+            with self._counter_lock:
+                self.delta_restage_total += 1
+                if changed_slots:
+                    self.tombstone_update_total += 1
+            staged.make_evictable(self._drop_staging)
+            self._maybe_compact()
+            return True
+        except _DeltaIneligible:
+            return None  # structural surprise: full rebuild fallback
+        except (TaskCancelledException, TimeExceededException):
+            raise  # PR-4 contract: caller owns partial/cancel
+        except Exception:  # noqa: BLE001 — terminal classified staging
+            # fault: same bench + quarantine as a full-rebuild fault
+            # (the attempt rolled back; pre-attempt ledger is exact)
+            _plane_logger.warning(
+                "[%s] mesh delta staging failed; serving from the host "
+                "rung for %.1fs (reason staging_fault)",
+                self.svc.name, self.plane_health.cooldown_s,
+                exc_info=True)
+            self._staging_faulted = True
+            self._staging_fault_until = (
+                _time.monotonic() + self.plane_health.cooldown_s)
+            self.plane_health.record_failure(
+                "mesh_pallas", reason="staging_fault")
+            self.staging_denied_reason = "staging_fault"
+            return False
+
+    def _maybe_compact(self) -> None:
+        """Opportunistic compaction trigger after a delta commit: the
+        owner decides (threshold/fragmentation/drain) and runs it OFF
+        the query path (ISSUE 20 — no polling loop to leak)."""
+        hook = getattr(self.svc, "maybe_compact_async", None)
+        if hook is not None:
+            hook()
+
     def _ensure_staged(self) -> bool:
         self.staging_denied_reason = None
         # staging-fault backoff (ISSUE 10, docs/RESILIENCE.md): after a
@@ -1148,9 +1300,7 @@ class IndexMeshSearch:
         mesh = self._mesh_or_default()
         if len(pairs) > mesh.devices.size * max(self.max_slots, 1):
             return False  # packing bound (not a one-segment-per-device cap)
-        # live_doc_count participates: deletes mutate a sealed segment's
-        # live mask in place, which must invalidate the staged live1
-        key = tuple((sid, id(seg), seg.live_doc_count) for sid, seg in pairs)
+        key = self._key_for(pairs)
         # the "or executor is None" leg self-heals any state where the
         # staged key survived but the executor didn't (an eviction
         # racing an install): the next query restages instead of being
@@ -1175,99 +1325,189 @@ class IndexMeshSearch:
                     # a concurrent attempt faulted while we waited
                     self.staging_denied_reason = "staging_fault"
                     return False
-                from elasticsearch_tpu.common.memory import \
-                    memory_accountant
-                from elasticsearch_tpu.common.staging import run_staged
-
-                n_dev = mesh.devices.size
-                n_slots = max(1, -(-len(pairs) // n_dev)) * n_dev
-                # HBM budget gate (search.memory.hbm_budget_bytes): the
-                # gate uses a cheap per-slot estimate — the ledger
-                # records the EXACT bytes once staged. Denial demotes
-                # this query (and every one until the budget frees) to
-                # the host rung with ladder decision reason hbm_budget
-                # — degrade, never 5xx.
-                estimate = n_slots * max(
-                    seg.block_docs.nbytes + seg.block_tfs.nbytes
-                    + seg.norms.nbytes + seg.nd_pad + 1
-                    for _sid, seg in pairs)
-                if not memory_accountant().try_reserve(self.svc.name,
-                                                       estimate):
-                    self.staging_denied_reason = "hbm_budget"
-                    return False
                 settings = getattr(self.svc, "settings", None)
                 codec = (settings.get_str(
                     "index.search.pallas.postings_codec", "default")
                     if settings is not None else None)
-                reason = self._restage_reason(self._staged_key, key,
-                                              self._executor, n_slots)
-                if self._staging_faulted:
-                    self._stage_probing = True
+                # ---- delta paths (ISSUE 20): tombstone a delete /
+                # append new segments into free slots, keeping the
+                # collective geometry — the rebuild below becomes the
+                # FALLBACK (slots exhausted, tile-geometry mismatch,
+                # codec change), not the default
                 old = self._executor
-                # construct UNARMED (not yet evictable), install, THEN
-                # arm: a budget eviction firing mid-construction would
-                # otherwise run _drop_staging against the PREVIOUS
-                # generation and the install below would pin a staged
-                # key whose executor is gone (see make_evictable).
-                # The construction is one transactional staging attempt
-                # (register-then-commit: a constructor fault registers
-                # nothing) run through the classified retry loop —
-                # transient device faults back off and retry, terminal
-                # faults bench the staging AND quarantine the kernel
-                # plane with reason staging_fault. The retry budget is
-                # the PROCESS-level config (node file + live cluster
-                # updates via configure_staging_retry) — NOT the index's
-                # create-time Settings snapshot, which would freeze it
-                # against later dynamic updates.
-                from elasticsearch_tpu.common.errors import \
-                    TaskCancelledException
-                from elasticsearch_tpu.search.cancellation import \
-                    TimeExceededException
-
-                try:
-                    staged = run_staged(
-                        lambda: MeshPlanExecutor(
-                            [seg for _, seg in pairs], mesh,
-                            postings_codec=codec,
-                            index_name=self.svc.name,
-                            stage_reason=reason),
-                        index=self.svc.name, kind="mesh_slot_tables",
-                        plane="mesh")
-                except (TaskCancelledException, TimeExceededException):
-                    raise  # PR-4 contract: caller owns partial/cancel —
-                    # never bench the staging for a dead query
-                except Exception:  # noqa: BLE001 — terminal classified
-                    # staging fault: bench the staging for the cooldown
-                    # and quarantine the plane so _stats planes tells
-                    # staging_fault from kernel_fault (docs/RESILIENCE.md)
-                    _plane_logger.warning(
-                        "[%s] mesh staging failed; serving from the host "
-                        "rung for %.1fs (reason staging_fault)",
-                        self.svc.name, self.plane_health.cooldown_s,
-                        exc_info=True)
-                    self._staging_faulted = True
-                    self._staging_fault_until = (
-                        _time.monotonic() + self.plane_health.cooldown_s)
-                    self.plane_health.record_failure(
-                        "mesh_pallas", reason="staging_fault")
-                    self.staging_denied_reason = "staging_fault"
-                    return False
-                finally:
-                    self._stage_probing = False
-                staged.pairs = pairs
-                if old is not None:
-                    old.release()
-                self._pairs = pairs
-                self._executor = staged
-                self._staged_key = key
-                self._staging_faulted = False
-                self._staging_fault_until = 0.0
-                staged.make_evictable(self._drop_staging)
+                if (old is not None and self._staged_key is not None
+                        and not self._staging_faulted
+                        and self._delta_enabled()):
+                    delta = self._classify_delta(old, pairs, codec)
+                    if delta is not None:
+                        handled = self._apply_delta(old, delta, key)
+                        if handled is not None:
+                            return handled
+                return self._stage_rebuild(mesh, pairs, key, codec)
         else:
             executor = self._executor
             if executor is not None:
                 executor.touch()
         return self._executor is not None
+
+    def _stage_rebuild(self, mesh, pairs, key, codec,
+                       reason: Optional[str] = None) -> bool:
+        """Full-generation build + install (caller holds _stage_lock).
+        The pre-ISSUE-20 default, now the delta paths' fallback — and
+        the compaction pass's restage (reason="compaction")."""
+        from elasticsearch_tpu.common.memory import memory_accountant
+        from elasticsearch_tpu.common.staging import run_staged
+
+        # thread-local hygiene (PR-9 bug class): a fresh staging
+        # attempt — reset before any denial below (also covers the
+        # compaction thread entering via restage_for_compaction)
+        self.staging_denied_reason = None
+        n_dev = mesh.devices.size
+        spd = max(1, -(-len(pairs) // n_dev))
+        if self._delta_enabled() and spd < max(self.max_slots, 1):
+            # slot-allocator headroom (ISSUE 20): spare slots for ONE
+            # refresh's worth of appended segments (a refresh seals at
+            # most one per shard) so the NEXT refresh delta-appends
+            # instead of rebuilding — bounded by the packing limit
+            extra = max(1, -(-len(self.svc.shards) // n_dev))
+            spd = min(spd + extra, max(self.max_slots, 1))
+        n_slots = spd * n_dev
+        # HBM budget gate (search.memory.hbm_budget_bytes): the
+        # gate uses a cheap per-slot estimate — the ledger
+        # records the EXACT bytes once staged. Denial demotes
+        # this query (and every one until the budget frees) to
+        # the host rung with ladder decision reason hbm_budget
+        # — degrade, never 5xx.
+        estimate = n_slots * max(
+            seg.block_docs.nbytes + seg.block_tfs.nbytes
+            + seg.norms.nbytes + seg.nd_pad + 1
+            for _sid, seg in pairs)
+        if not memory_accountant().try_reserve(self.svc.name,
+                                               estimate):
+            self.staging_denied_reason = "hbm_budget"
+            return False
+        if reason is None:
+            reason = self._restage_reason(self._staged_key, key,
+                                          self._executor, n_slots)
+        if self._staging_faulted:
+            self._stage_probing = True
+        old = self._executor
+        # construct UNARMED (not yet evictable), install, THEN
+        # arm: a budget eviction firing mid-construction would
+        # otherwise run _drop_staging against the PREVIOUS
+        # generation and the install below would pin a staged
+        # key whose executor is gone (see make_evictable).
+        # The construction is one transactional staging attempt
+        # (register-then-commit: a constructor fault registers
+        # nothing) run through the classified retry loop —
+        # transient device faults back off and retry, terminal
+        # faults bench the staging AND quarantine the kernel
+        # plane with reason staging_fault. The retry budget is
+        # the PROCESS-level config (node file + live cluster
+        # updates via configure_staging_retry) — NOT the index's
+        # create-time Settings snapshot, which would freeze it
+        # against later dynamic updates.
+        from elasticsearch_tpu.common.errors import \
+            TaskCancelledException
+        from elasticsearch_tpu.search.cancellation import \
+            TimeExceededException
+
+        try:
+            staged = run_staged(
+                lambda: MeshPlanExecutor(
+                    [seg for _, seg in pairs], mesh,
+                    postings_codec=codec,
+                    index_name=self.svc.name,
+                    stage_reason=reason,
+                    slots_per_dev=spd),
+                index=self.svc.name, kind="mesh_slot_tables",
+                plane="mesh")
+        except (TaskCancelledException, TimeExceededException):
+            raise  # PR-4 contract: caller owns partial/cancel —
+            # never bench the staging for a dead query
+        except Exception:  # noqa: BLE001 — terminal classified
+            # staging fault: bench the staging for the cooldown
+            # and quarantine the plane so _stats planes tells
+            # staging_fault from kernel_fault (docs/RESILIENCE.md)
+            _plane_logger.warning(
+                "[%s] mesh staging failed; serving from the host "
+                "rung for %.1fs (reason staging_fault)",
+                self.svc.name, self.plane_health.cooldown_s,
+                exc_info=True)
+            self._staging_faulted = True
+            self._staging_fault_until = (
+                _time.monotonic() + self.plane_health.cooldown_s)
+            self.plane_health.record_failure(
+                "mesh_pallas", reason="staging_fault")
+            self.staging_denied_reason = "staging_fault"
+            return False
+        finally:
+            self._stage_probing = False
+        staged.pairs = pairs
+        if old is not None:
+            old.release()
+        self._pairs = pairs
+        self._executor = staged
+        self._staged_key = key
+        self._staging_faulted = False
+        self._staging_fault_until = 0.0
+        staged.make_evictable(self._drop_staging)
+        return True
+
+    def staging_slot_stats(self) -> Optional[dict]:
+        """Live-generation slot occupancy (ISSUE 20): per-device free
+        slot capacity + per-slot tombstone density — the _cat/staging
+        operator surface and the compaction trigger's inputs. None when
+        nothing is staged."""
+        executor = self._executor
+        if executor is None:
+            return None
+        slots = []
+        for slot, (sid, seg) in enumerate(executor.pairs):
+            total = int(seg.num_docs)
+            live = int(seg.live_doc_count)
+            slots.append({
+                "slot": slot, "shard": int(sid), "segment": seg.name,
+                "docs": total, "live": live,
+                "tombstone_density": (round(1.0 - live / total, 4)
+                                      if total else 0.0),
+            })
+        free = executor.free_slots()
+        return {
+            "n_slots": executor.n_slots,
+            "slots_per_device": executor.slots_per_dev,
+            "free_slots": free,
+            "free_slots_per_device": round(free / executor.n_dev, 2),
+            "slots": slots,
+        }
+
+    def note_compaction_run(self) -> None:
+        with self._counter_lock:
+            self.compaction_runs_total += 1
+
+    def restage_for_compaction(self) -> bool:
+        """Background slot compaction's restage (ISSUE 20): build a
+        FRESH generation over the current segment set with fresh slot
+        headroom, classified ``compaction`` — merges sparse slots into
+        fresh ones and releases the old generation. Off the query path
+        (the owner's single-flight pass calls it); ledger-exact through
+        the same register-then-commit rebuild as any staging."""
+        pairs = self._current_pairs()
+        mesh = self._mesh_or_default()
+        if (not pairs
+                or len(pairs) > mesh.devices.size * max(self.max_slots,
+                                                        1)):
+            return False
+        key = self._key_for(pairs)
+        with self._stage_lock:
+            if self._executor is None:
+                return False  # nothing staged: the next query goes cold
+            settings = getattr(self.svc, "settings", None)
+            codec = (settings.get_str(
+                "index.search.pallas.postings_codec", "default")
+                if settings is not None else None)
+            return self._stage_rebuild(mesh, pairs, key, codec,
+                                       reason="compaction")
 
     @staticmethod
     def _needs_counts(q) -> bool:
@@ -2632,7 +2872,8 @@ class MeshPlanExecutor:
     def __init__(self, segments: List, mesh: Optional[Mesh] = None,
                  postings_codec: Optional[str] = None,
                  index_name: Optional[str] = None,
-                 stage_reason: str = "initial"):
+                 stage_reason: str = "initial",
+                 slots_per_dev: Optional[int] = None):
         from elasticsearch_tpu.parallel.distributed import stack_shard_arrays
         from elasticsearch_tpu.parallel.mesh import shard_mesh
 
@@ -2665,7 +2906,15 @@ class MeshPlanExecutor:
         # staged posting bytes + effective codec, exported via _stats
         self.postings_bytes_staged = 0
         self.postings_codec = "raw"
+        # slot-allocator headroom (ISSUE 20): the owner may hint MORE
+        # slots per device than the segment set needs — the extra slots
+        # stage as dead rows (all-zero live masks) and give incremental
+        # refreshes free capacity to delta-append into without a
+        # geometry rebuild
         self.slots_per_dev = max(1, -(-len(segments) // self.n_dev))
+        if slots_per_dev is not None:
+            self.slots_per_dev = max(self.slots_per_dev,
+                                     int(slots_per_dev))
         self.n_slots = self.slots_per_dev * self.n_dev
         # set by release(): a query pinned to a replaced generation may
         # still lazily stage tables — those must NOT re-register under
@@ -2743,7 +2992,8 @@ class MeshPlanExecutor:
 
     def _account(self, kind: str, table: str, nbytes: int,
                  reason: Optional[str] = None, duration_ms: float = 0.0,
-                 quiet: bool = False) -> None:
+                 quiet: bool = False,
+                 amplify_bytes: Optional[int] = None) -> None:
         from elasticsearch_tpu.common.memory import memory_accountant
 
         if self._released:
@@ -2758,7 +3008,8 @@ class MeshPlanExecutor:
         memory_accountant().register(
             self.index_name, self.scope, kind, table, int(nbytes),
             reason=reason or self._stage_reason, duration_ms=duration_ms,
-            plane="mesh", evict=self._evict_cb, quiet=quiet)
+            plane="mesh", evict=self._evict_cb, quiet=quiet,
+            amplify_bytes=amplify_bytes)
 
     def release(self) -> int:
         """This executor generation is being replaced/dropped: return
@@ -2774,6 +3025,450 @@ class MeshPlanExecutor:
         from elasticsearch_tpu.common.memory import memory_accountant
 
         memory_accountant().touch(self.index_name, self.scope)
+
+    # ------------------------------------------------------------------
+    # Delta staging (ISSUE 20): incremental append + tombstone deletes
+    # ------------------------------------------------------------------
+
+    def free_slots(self) -> int:
+        """Unoccupied slots in this generation (the append headroom)."""
+        return self.n_slots - len(self.segments)
+
+    @staticmethod
+    def delta_append_compatible(old: "MeshPlanExecutor",
+                                new_segments: List) -> bool:
+        """Cheap structural pre-check: can ``new_segments`` delta-append
+        into ``old``'s free slots without a geometry rebuild? False on
+        any of the ISSUE 20 rebuild-fallback conditions (slots
+        exhausted, tile-geometry mismatch); codec changes are the
+        owner's check (it knows the live settings value)."""
+        if old._released:
+            return False
+        if len(old.segments) + len(new_segments) > old.n_slots:
+            return False  # slots exhausted
+        bd = old._seg_staged.get("block_docs")
+        nm = old._seg_staged.get("norms")
+        if bd is None or nm is None:
+            return False
+        n_blocks, blk = int(bd.shape[1]), int(bd.shape[2])
+        n_norm = int(nm.shape[1])
+        kernel = old._kernel if isinstance(old._kernel, dict) else None
+        n_rows = None
+        if kernel is not None:
+            from elasticsearch_tpu.ops import pallas_scoring as psc
+
+            k_arr = old._seg_staged.get(
+                "k_packed" if kernel["codec"] == "packed" else "k_docs")
+            if k_arr is None:
+                return False
+            n_rows = int(k_arr.shape[1]) - psc.CB_MAX
+        for seg in new_segments:
+            if (seg.nd_pad > old.nd_pad
+                    or seg.block_docs.shape[0] > n_blocks
+                    or seg.block_docs.shape[1] != blk
+                    or seg.norms.shape[0] > n_norm):
+                return False  # tile-geometry mismatch
+            if n_rows is not None and seg.block_docs.shape[0] > n_rows:
+                return False  # kernel posting window would overflow
+        return True
+
+    @classmethod
+    def delta_append(cls, old: "MeshPlanExecutor", append_pairs: List,
+                     refresh_slots: List[int] = (),
+                     index_name: Optional[str] = None
+                     ) -> "MeshPlanExecutor":
+        """Copy-on-write SUCCESSOR generation for an incremental refresh
+        (ISSUE 20): stage ONLY the new segments' tables (postings, live
+        masks, bound tables, embeddings) into free slots — every
+        already-staged slot's arrays are shared with the old generation
+        untouched (non-donating ``.at[slot].set`` scatters), so queries
+        pinned to the old generation keep serving from intact arrays
+        until the last reference drops.
+
+        ``refresh_slots``: already-occupied slots whose live masks must
+        also refresh (deletes riding along with the append).
+
+        One transactional attempt inside the owner's run_staged loop:
+        nothing publishes or registers until every array is built — a
+        fault mid-way discards the half-built successor with the old
+        generation and the ledger exactly as they were. The delta row
+        bytes feed the amplification counters (reason ``delta_append``);
+        the successor scope's full array bytes land in the ledger so
+        release stays exact. Derived columns the append invalidates
+        (sort keys — keyword global ordinals change with the vocab —
+        slice masks, fused-agg doc values) are dropped and rebuild
+        lazily. Raises ``_DeltaIneligible`` (a StagingBail: no retry, no
+        fault accounting) on structural surprises the pre-check missed."""
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
+        new_segs = [seg for _sid, seg in append_pairs]
+        if not cls.delta_append_compatible(old, new_segs):
+            raise _DeltaIneligible("segment set cannot delta-append")
+        self = cls.__new__(cls)
+        self.mesh = old.mesh
+        self.n_dev = old.n_dev
+        self.index_name = index_name or old.index_name
+        self.scope = f"mesh#{next(cls._SCOPE_SEQ)}"
+        self.segments = list(old.segments) + new_segs
+        self.pairs = list(old.pairs) + list(append_pairs)
+        self._evict_cb = None
+        # lazy stagings AFTER install classify as refresh (the segment
+        # set did change); the construction below registers its delta
+        # rows explicitly as delta_append
+        self._stage_reason = "refresh"
+        self.postings_codec_pref = old.postings_codec_pref
+        self.postings_bytes_staged = old.postings_bytes_staged
+        self.postings_codec = old.postings_codec
+        self.slots_per_dev = old.slots_per_dev
+        self.n_slots = old.n_slots
+        self.nd_pad = old.nd_pad
+        self.nd1 = old.nd1
+        self._sharding = old._sharding
+        self._released = False
+        self._kernel_stage_lock = threading.Lock()
+        self.sort_meta = {}
+        self._kernel = None
+        self._denied = threading.local()
+        self._knn = {}
+        self._ub_cache = {}
+        self._seg_staged = {}
+
+        t0 = _time.monotonic()
+        base = old._seg_staged
+        first_new = len(old.segments)
+        new_slots = list(range(first_new, len(self.segments)))
+        # live-mask rows refresh for appended slots AND tombstoned ones
+        live_slots = sorted(set(refresh_slots)) + new_slots
+        nd_pad = self.nd_pad
+
+        # injection point (ISSUE 10 schemes): a raise here aborts the
+        # attempt with nothing registered and the old generation intact
+        on_device_staging(self.index_name, "mesh_slot_tables",
+                          "delta_append")
+
+        # --- base slot tables: delta rows at stacked geometry ---------
+        n_blocks, blk = int(base["block_docs"].shape[1]), \
+            int(base["block_docs"].shape[2])
+        n_norm = int(base["norms"].shape[1])
+        bd_rows = np.full((len(new_slots), n_blocks, blk), nd_pad,
+                          np.int32)
+        bt_rows = np.zeros((len(new_slots), n_blocks, blk), np.float32)
+        nm_rows = np.ones((len(new_slots), n_norm, nd_pad + 1),
+                          np.float32)
+        for j, seg in enumerate(new_segs):
+            bd = seg.block_docs.copy()
+            bd[bd == seg.nd_pad] = nd_pad  # re-point sentinel
+            bd_rows[j, : bd.shape[0]] = bd
+            bt_rows[j, : seg.block_tfs.shape[0]] = seg.block_tfs
+            nm_rows[j, : seg.norms.shape[0], : seg.norms.shape[1] - 1] \
+                = seg.norms[:, :-1]
+            nm_rows[j, :, nd_pad] = 1.0
+        lv_rows = np.zeros((len(live_slots), nd_pad + 1), bool)
+        for j, slot in enumerate(live_slots):
+            seg = self.segments[slot]
+            lv_rows[j, : seg.live.shape[0]] = seg.live
+        idx_new = jnp.asarray(np.asarray(new_slots, np.int32))
+        idx_live = jnp.asarray(np.asarray(live_slots, np.int32))
+        staged = {
+            "block_docs": jax.device_put(
+                base["block_docs"].at[idx_new].set(jnp.asarray(bd_rows)),
+                self._sharding),
+            "block_tfs": jax.device_put(
+                base["block_tfs"].at[idx_new].set(jnp.asarray(bt_rows)),
+                self._sharding),
+            "norms": jax.device_put(
+                base["norms"].at[idx_new].set(jnp.asarray(nm_rows)),
+                self._sharding),
+            "live1": jax.device_put(
+                base["live1"].at[idx_live].set(jnp.asarray(lv_rows)),
+                self._sharding),
+        }
+        amp_base = int(bd_rows.nbytes + bt_rows.nbytes + nm_rows.nbytes
+                       + lv_rows.nbytes)
+
+        # --- kernel plane: delta posting windows + live_t rows --------
+        kernel = old._kernel if isinstance(old._kernel, dict) else None
+        live_t_amp: Dict[str, int] = {}
+        amp_postings = 0
+        amp_bounds = 0
+        meta = None
+        if kernel is not None:
+            from elasticsearch_tpu.ops import pallas_scoring as psc
+
+            geom, codec = kernel["geom"], kernel["codec"]
+            k_key = "k_packed" if codec == "packed" else "k_docs"
+            n_rows = int(base[k_key].shape[1])
+            meta = dict(kernel["meta"])
+            if codec == "packed":
+                pk_rows = np.zeros((len(new_slots), n_rows, psc.LANE),
+                                   np.int32)
+            else:
+                dc_rows = np.full((len(new_slots), n_rows, psc.LANE),
+                                  nd_pad, np.int32)
+                fr_rows = np.zeros((len(new_slots), n_rows, psc.LANE),
+                                   np.float32)
+            for j, seg in enumerate(new_segs):
+                f = seg._block_frac()
+                bmin, bmax = psc.block_min_max(
+                    seg.block_docs, seg.block_tfs, seg.nd_pad)
+                if codec == "packed":
+                    fq = psc.quantize_frac(f)
+                    pk = psc.pack_segment_blocks(seg.block_docs, f,
+                                                 seg.nd_pad, q=fq)
+                    if pk.shape[0] > n_rows:
+                        raise _DeltaIneligible(
+                            "packed posting window exceeds the staged "
+                            "kernel rows")
+                    pk_rows[j, : pk.shape[0]] = pk
+                    bfmax = psc.block_frac_max(psc.dequantize_frac(fq))
+                else:
+                    dp, fp = psc.pad_segment_blocks(seg.block_docs, f,
+                                                    seg.nd_pad)
+                    if dp.shape[0] > n_rows:
+                        raise _DeltaIneligible(
+                            "raw posting window exceeds the staged "
+                            "kernel rows")
+                    dc_rows[j, : dp.shape[0]] = dp
+                    fr_rows[j, : fp.shape[0]] = fp
+                    bfmax = psc.block_frac_max(f)
+                meta[id(seg)] = (bmin, bmax, bfmax)
+                amp_bounds += sum(int(b.nbytes) for b in meta[id(seg)])
+            if codec == "packed":
+                staged["k_packed"] = jax.device_put(
+                    base["k_packed"].at[idx_new].set(
+                        jnp.asarray(pk_rows)), self._sharding)
+                amp_postings = int(pk_rows.nbytes)
+            else:
+                staged["k_docs"] = jax.device_put(
+                    base["k_docs"].at[idx_new].set(
+                        jnp.asarray(dc_rows)), self._sharding)
+                staged["k_frac"] = jax.device_put(
+                    base["k_frac"].at[idx_new].set(
+                        jnp.asarray(fr_rows)), self._sharding)
+                amp_postings = int(dc_rows.nbytes + fr_rows.nbytes)
+            for key in [k for k in base if k.startswith("k_live_t")]:
+                g = (geom if key == "k_live_t" else psc.tile_geometry(
+                    geom.nd_pad, int(key.rsplit("_", 1)[1])))
+                lt_rows = np.zeros(
+                    (len(live_slots), g.n_tiles * psc.LANE, g.tile_sub),
+                    np.float32)
+                for j, slot in enumerate(live_slots):
+                    seg = self.segments[slot]
+                    live = np.zeros(g.nd_pad, np.float32)
+                    live[: seg.nd_pad] = seg.live.astype(np.float32)
+                    lt_rows[j] = psc.build_live_t(live, g)
+                staged[key] = jax.device_put(
+                    base[key].at[idx_live].set(jnp.asarray(lt_rows)),
+                    self._sharding)
+                live_t_amp[key] = int(lt_rows.nbytes)
+
+        # --- kNN planes: delta embedding/scale/mask rows per field ----
+        knn_new: Dict[str, object] = {}
+        knn_amp: Dict[str, Tuple[int, int, int]] = {}
+        for field, entry in old._knn.items():
+            if not isinstance(entry, dict):
+                # None/False: the successor re-evaluates lazily (a new
+                # segment may change the structural verdict either way)
+                continue
+            dims = entry.get("dims")
+            if dims is None or any(
+                    seg.vector_columns.get(field) is not None
+                    and seg.vector_columns[field].dims != dims
+                    for seg in new_segs):
+                continue  # dims surprise: lazy restage decides
+            import ml_dtypes
+
+            from elasticsearch_tpu.ops import pallas_knn as pkn
+
+            d_pad, nd_knn = entry["d_pad"], entry["nd_pad"]
+            emb_rows = np.zeros((len(new_slots), nd_knn, d_pad),
+                                ml_dtypes.bfloat16)
+            sc_rows = np.zeros((len(new_slots), nd_knn, 1), np.float32)
+            for j, seg in enumerate(new_segs):
+                col = seg.vector_columns.get(field)
+                if col is None:
+                    continue  # slot stays dead
+                emb_rows[j, : col.vectors.shape[0], : dims] = \
+                    col.vectors.astype(ml_dtypes.bfloat16)
+                sc = pkn.vector_scale_column(col.vectors,
+                                             entry["metric"])
+                sc_rows[j, : sc.shape[0]] = sc
+            mk_rows = np.zeros((len(live_slots), nd_knn, 1), np.float32)
+            for j, slot in enumerate(live_slots):
+                seg = self.segments[slot]
+                col = seg.vector_columns.get(field)
+                if col is None:
+                    continue
+                m = (col.exists
+                     & seg.live[: col.vectors.shape[0]]).astype(
+                         np.float32)
+                mk_rows[j, : m.shape[0], 0] = m
+            knn_new[field] = dict(
+                entry,
+                emb=jax.device_put(
+                    entry["emb"].at[idx_new].set(jnp.asarray(emb_rows)),
+                    self._sharding),
+                scale=jax.device_put(
+                    entry["scale"].at[idx_new].set(
+                        jnp.asarray(sc_rows)), self._sharding),
+                mask=jax.device_put(
+                    entry["mask"].at[idx_live].set(
+                        jnp.asarray(mk_rows)), self._sharding))
+            knn_amp[field] = (int(emb_rows.nbytes), int(sc_rows.nbytes),
+                              int(mk_rows.nbytes))
+
+        # --- commit: publish, then register (register-then-commit) ----
+        self._seg_staged = staged
+        self._knn = knn_new
+        if kernel is not None:
+            self._kernel = {"geom": kernel["geom"], "meta": meta,
+                            "codec": kernel["codec"]}
+        dur = (_time.monotonic() - t0) * 1000.0
+        self._account(
+            "mesh_slot_tables", "seg_stacked",
+            sum(int(staged[k].nbytes) for k in
+                ("block_docs", "block_tfs", "norms", "live1")),
+            reason="delta_append", amplify_bytes=amp_base,
+            duration_ms=dur)
+        if kernel is not None:
+            kind_postings = ("postings_packed"
+                             if kernel["codec"] == "packed"
+                             else "postings_raw")
+            self._account(kind_postings, "k_postings",
+                          self.postings_bytes_staged,
+                          reason="delta_append",
+                          amplify_bytes=amp_postings, duration_ms=dur)
+            for key, amp in live_t_amp.items():
+                self._account("live_mask", key,
+                              int(staged[key].nbytes),
+                              reason="delta_append", amplify_bytes=amp,
+                              duration_ms=dur)
+            self._account("bound_tables", "k_bounds",
+                          sum(int(b.nbytes) for t in meta.values()
+                              for b in t),
+                          reason="delta_append",
+                          amplify_bytes=amp_bounds)
+        for field, entry in knn_new.items():
+            e_amp, s_amp, m_amp = knn_amp[field]
+            self._account("embeddings", f"knn:{field}",
+                          int(entry["emb"].nbytes),
+                          reason="delta_append", amplify_bytes=e_amp,
+                          duration_ms=dur)
+            self._account("scale_norm", f"knn_scale:{field}",
+                          int(entry["scale"].nbytes),
+                          reason="delta_append", amplify_bytes=s_amp,
+                          duration_ms=dur)
+            self._account("live_mask", f"knn_mask:{field}",
+                          int(entry["mask"].nbytes),
+                          reason="delta_append", amplify_bytes=m_amp,
+                          duration_ms=dur)
+        return self
+
+    def apply_tombstones(self, slots: List[int]) -> int:
+        """Tombstone deletes (ISSUE 20): recompute ONLY the given
+        slots' live-mask columns — the base ``live1`` row (which also
+        feeds the fused-agg matched masks), every staged kernel
+        transposed-mask layout (``k_live_t`` + per-sub variants), and
+        each staged kNN field's exists∧live mask — and publish them IN
+        PLACE on this generation. No geometry rebuild, no scope change:
+        the same ledger keys re-register at their (unchanged) full
+        bytes with the changed ROW bytes as the amplification truth
+        (reason ``tombstone``).
+
+        One transactional attempt inside the owner's run_staged loop:
+        every replacement array is built before anything publishes, so
+        a fault leaves the generation serving the old masks and the
+        ledger at its exact pre-attempt state. In-flight queries see
+        either the old or the new masks — both are valid point-in-time
+        views (the reference's flip-a-live-bit-under-readers contract).
+        Returns the mask bytes actually restaged."""
+        from elasticsearch_tpu.testing.disruption import on_device_staging
+
+        with self._kernel_stage_lock:
+            if self._released or not slots:
+                return 0
+            t0 = _time.monotonic()
+            slots = sorted(slots)
+            idx = jnp.asarray(np.asarray(slots, np.int32))
+            # injection point (ISSUE 10): a raise here leaves nothing
+            # published and nothing registered
+            on_device_staging(self.index_name, "live_mask",
+                              "tombstone_masks")
+            nd_pad = self.nd_pad
+            lv_rows = np.zeros((len(slots), nd_pad + 1), bool)
+            for j, slot in enumerate(slots):
+                seg = self.segments[slot]
+                lv_rows[j, : seg.live.shape[0]] = seg.live
+            updates = {"live1": jax.device_put(
+                self._seg_staged["live1"].at[idx].set(
+                    jnp.asarray(lv_rows)), self._sharding)}
+            amp: Dict[str, int] = {"live1": int(lv_rows.nbytes)}
+            if isinstance(self._kernel, dict):
+                from elasticsearch_tpu.ops import pallas_scoring as psc
+
+                geom = self._kernel["geom"]
+                for key in [k for k in self._seg_staged
+                            if k.startswith("k_live_t")]:
+                    g = (geom if key == "k_live_t"
+                         else psc.tile_geometry(
+                             geom.nd_pad, int(key.rsplit("_", 1)[1])))
+                    lt = np.zeros(
+                        (len(slots), g.n_tiles * psc.LANE, g.tile_sub),
+                        np.float32)
+                    for j, slot in enumerate(slots):
+                        seg = self.segments[slot]
+                        live = np.zeros(g.nd_pad, np.float32)
+                        live[: seg.nd_pad] = seg.live.astype(np.float32)
+                        lt[j] = psc.build_live_t(live, g)
+                    updates[key] = jax.device_put(
+                        self._seg_staged[key].at[idx].set(
+                            jnp.asarray(lt)), self._sharding)
+                    amp[key] = int(lt.nbytes)
+            knn_updates: Dict[str, dict] = {}
+            knn_amp: Dict[str, int] = {}
+            for field, entry in self._knn.items():
+                if not isinstance(entry, dict):
+                    continue
+                nd_knn = entry["nd_pad"]
+                mk = np.zeros((len(slots), nd_knn, 1), np.float32)
+                for j, slot in enumerate(slots):
+                    seg = self.segments[slot]
+                    col = seg.vector_columns.get(field)
+                    if col is None:
+                        continue
+                    m = (col.exists
+                         & seg.live[: col.vectors.shape[0]]).astype(
+                             np.float32)
+                    mk[j, : m.shape[0], 0] = m
+                knn_updates[field] = dict(entry, mask=jax.device_put(
+                    entry["mask"].at[idx].set(jnp.asarray(mk)),
+                    self._sharding))
+                knn_amp[field] = int(mk.nbytes)
+            restaged = sum(amp.values()) + sum(knn_amp.values())
+            # commit: publish every replacement, then re-register the
+            # same keys (full bytes unchanged; amplification = rows)
+            self._seg_staged.update(updates)
+            for field, entry in knn_updates.items():
+                self._knn[field] = entry
+            dur = (_time.monotonic() - t0) * 1000.0
+            self._account(
+                "mesh_slot_tables", "seg_stacked",
+                sum(int(self._seg_staged[k].nbytes) for k in
+                    ("block_docs", "block_tfs", "norms", "live1")),
+                reason="tombstone", amplify_bytes=amp.pop("live1"),
+                duration_ms=dur)
+            for key, a in amp.items():
+                self._account("live_mask", key,
+                              int(self._seg_staged[key].nbytes),
+                              reason="tombstone", amplify_bytes=a,
+                              duration_ms=dur)
+            for field, entry in knn_updates.items():
+                self._account("live_mask", f"knn_mask:{field}",
+                              int(entry["mask"].nbytes),
+                              reason="tombstone",
+                              amplify_bytes=knn_amp[field],
+                              duration_ms=dur)
+            return restaged
 
     # ------------------------------------------------------------------
     # Tile-kernel plane staging (the unified fast plane)
@@ -3053,6 +3748,9 @@ class MeshPlanExecutor:
             "d_pad": d_pad,
             "nd_pad": nd_knn,
             "metric": metric,
+            # mapping dims: delta_append verifies a new segment's column
+            # against it before carrying this plane forward (ISSUE 20)
+            "dims": dims,
         }
         self._knn[field] = entry
         dur = (_time.monotonic() - t0) * 1000.0

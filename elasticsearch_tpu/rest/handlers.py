@@ -1814,23 +1814,60 @@ def _cat_shards(node, req):
 
 
 def _cat_staging(node, req):
-    """_cat/staging (ISSUE 9, docs/OBSERVABILITY.md): the at-a-glance
-    per-(index, segment/plane, kind) view of the device-memory ledger —
-    what is staged in HBM right now, how big, how hot, and whether the
-    budget breaker may evict it."""
+    """_cat/staging (ISSUE 9 + 20, docs/OBSERVABILITY.md): the
+    at-a-glance per-(index, segment/plane, kind) view of the
+    device-memory ledger — what is staged in HBM right now, how big,
+    how hot, and whether the budget breaker may evict it — plus the
+    mesh generation's slot occupancy: per-device free slot capacity
+    (``free/dev`` on the generation's scope rows) and per-slot
+    tombstone density (``tombs`` on its slot rows), so operators can
+    see when the ISSUE-20 background compaction will trigger."""
     from elasticsearch_tpu.common.memory import memory_accountant
 
+    # mesh slot occupancy, keyed by the generation scope the ledger
+    # rows carry in their segment column (e.g. "mesh#3")
+    scope_meta: dict = {}
+    for name in node.cluster_service.state.resolve_index_names("_all"):
+        svc = node.indices.get(name)
+        ms = getattr(svc, "_mesh_search", None) if svc else None
+        stats = ms.staging_slot_stats() if ms is not None else None
+        if not stats:
+            continue
+        scope = ms._executor.scope if ms._executor is not None else None
+        if scope is None:
+            continue
+        scope_meta[(name, scope)] = stats["free_slots_per_device"]
     rows = []
     for row in memory_accountant().table():
+        free_dev = scope_meta.get((row["index"], row["segment"]))
+        # kind rows under a mesh scope show the generation's headroom;
+        # the scope summary columns stay "-" for host-plane
+        # (per-segment) rows, which have no slot allocator
         rows.append([
             row["index"], row["segment"], row["kind"],
             f"{row['bytes']}b", row["tables"], row["stage_count"],
             "-" if row["idle_s"] is None else f"{row['idle_s']:.1f}s",
             "*" if row["evictable"] else "-",
+            "-" if free_dev is None else f"{free_dev}",
+            "-",
         ])
+    # one summary row per staged slot (ISSUE 20): slot → segment →
+    # live/total docs → tombstone density, the compaction trigger's
+    # exact inputs
+    for (name, scope), free_dev in sorted(scope_meta.items()):
+        svc = node.indices.get(name)
+        stats = svc._mesh_search.staging_slot_stats() if svc else None
+        if not stats:
+            continue
+        for s in stats["slots"]:
+            rows.append([
+                name, f"{scope}/slot{s['slot']}", "slot",
+                f"{s['live']}/{s['docs']}d", 1, "-", "-", "-",
+                f"{free_dev}", f"{s['tombstone_density']}",
+            ])
     return _cat_table(req, rows, [
         "index", "segment", "kind", "bytes", "tables", "stage_count",
-        "idle", "evictable",
+        "idle", "evictable", "free_slots_per_dev", "tombstone_density",
     ])
 
 

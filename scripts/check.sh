@@ -61,6 +61,72 @@ with tempfile.TemporaryDirectory() as d:
         svc.close()
 EOF
 
+echo "== restage amplification (delta staging keeps appends ~1x) =="
+# ISSUE 20: a scripted refresh/delete sequence against a mesh index —
+# the pure-append window must ride the delta path (amplification of
+# restaged over logically-changed bytes <= 1.5, not ~n_slots), and a
+# delete must restage only live-mask bytes (tombstone path).
+python - <<'EOF'
+import os
+
+os.environ.setdefault("ES_TPU_PALLAS", "interpret")
+
+from elasticsearch_tpu.common.memory import memory_accountant
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+svc = IndexService(
+    "amp_probe",
+    Settings({"index.number_of_shards": 3,
+              "index.search.mesh": True,
+              "index.search.mesh.plane": "pallas",
+              "index.search.mesh.max_slots_per_device": 16,
+              "index.staging.compact.threshold": 0.0,
+              "index.refresh_interval": -1}),
+    mapping={"properties": {"body": {"type": "text",
+                                     "analyzer": "whitespace"}}})
+try:
+    for i in range(48):
+        svc.index_doc(str(i), {"body": f"alpha beta w{i % 7}"})
+    svc.refresh()
+    q = {"query": {"match": {"body": "alpha"}}, "size": 10}
+    svc.search(dict(q))
+    acct = memory_accountant()
+    base = acct.stats("amp_probe")
+    # pure-append window: new docs -> refresh -> search restages
+    for i in range(48, 72):
+        svc.index_doc(str(i), {"body": f"alpha gamma w{i % 7}"})
+    svc.refresh()
+    svc.search(dict(q))
+    after = acct.stats("amp_probe")
+    restaged = (after["restaged_bytes_total"]
+                - base["restaged_bytes_total"])
+    logical = (after["bytes_logically_changed_total"]
+               - base["bytes_logically_changed_total"])
+    assert logical > 0, "append window logically changed nothing"
+    amp = restaged / logical
+    assert amp <= 1.5, f"append amplification {amp:.2f} > 1.5"
+    planes = svc.search_stats()["planes"]
+    assert planes["delta_restage_total"] >= 1, \
+        "append window never rode the delta path"
+    # delete window: tombstone restages live-mask bytes only
+    n_ev = len(after["staging_events"])
+    for i in range(0, 12):
+        svc.delete_doc(str(i))
+    svc.refresh()
+    svc.search(dict(q))
+    events = acct.stats("amp_probe")["staging_events"][n_ev:]
+    kinds = {e["kind"] for e in events}
+    assert kinds and kinds <= {"live_mask", "mesh_slot_tables"}, (
+        f"delete restaged non-mask kinds: {sorted(kinds)}")
+    assert svc.search_stats()["planes"]["tombstone_update_total"] >= 1
+    print(f"   amplification ok: append {amp:.2f}x "
+          f"({restaged}/{logical} bytes), delete restaged only "
+          f"{sorted(kinds)}")
+finally:
+    svc.close()
+EOF
+
 if [[ "${1:-}" == "--fast" ]]; then
     exit 0
 fi
